@@ -1,0 +1,29 @@
+"""brpc_tpu — a TPU-native RPC and tensor-transport framework.
+
+A brand-new framework with the capabilities of brpc (reference surveyed in
+SURVEY.md): zero-copy chained buffers whose blocks can live in TPU HBM, an
+M:N user-space scheduler (native C++ core under native/), wait-free
+connection writes with pluggable transports (host TCP as baseline, an ICI
+endpoint in the role of brpc's RDMA endpoint), multi-protocol framed RPC with
+timeouts/retries/backup requests, combo channels whose fan-out maps onto XLA
+collectives over a jax.sharding.Mesh, streaming RPC with window flow control
+for tensor pipelines, and bvar-style observability with an embedded HTTP
+debug console.
+
+Layering mirrors the reference's strict 4-library stack
+(/root/reference/src: butil -> bthread+bvar -> brpc):
+
+  brpc_tpu.butil     -- base: IOBuf, pools, DoublyBufferedData, EndPoint, flags
+  brpc_tpu.bvar      -- lock-light metrics (per-thread agents + sampler)
+  brpc_tpu.rpc       -- Server / Channel / Controller / protocols / LB / NS
+  brpc_tpu.parallel  -- combo channels + XLA-collective fan-out over a Mesh
+  brpc_tpu.tensor    -- ring attention, MoE, pipeline blocks (transport users)
+  brpc_tpu.builtin   -- HTTP debug console (/status /vars /flags /rpcz ...)
+  brpc_tpu.native    -- ctypes bindings to the C++ core (libbrpc_tpu.so)
+"""
+
+__version__ = "0.1.0"
+
+from brpc_tpu.butil.status import Status  # noqa: F401
+from brpc_tpu.butil.endpoint import EndPoint  # noqa: F401
+from brpc_tpu.butil.iobuf import IOBuf, IOBufAppender, IOPortal  # noqa: F401

@@ -1,0 +1,17 @@
+"""butil — base library (Python surface of the native core).
+
+Python counterparts of /root/reference/src/butil: IOBuf (iobuf.h:64),
+ObjectPool/ResourcePool (object_pool.h:27, resource_pool.h),
+DoublyBufferedData (containers/doubly_buffered_data.h:38), EndPoint
+(endpoint.h), Status (status.h), flags (gflags usage throughout).
+
+The C++ native core (native/src/butil_*) is the performance path; these
+Python classes are the veneer used by the pure-Python RPC surface and by
+tests, with identical semantics.
+"""
+
+from brpc_tpu.butil.status import Status  # noqa: F401
+from brpc_tpu.butil.endpoint import EndPoint  # noqa: F401
+from brpc_tpu.butil.iobuf import IOBuf, IOBufAppender, IOPortal  # noqa: F401
+from brpc_tpu.butil.pools import ObjectPool, ResourcePool, INVALID_RESOURCE_ID  # noqa: F401
+from brpc_tpu.butil.dbd import DoublyBufferedData  # noqa: F401

@@ -1,0 +1,79 @@
+"""DoublyBufferedData — read-mostly data with near-lock-free reads.
+
+Counterpart of butil::DoublyBufferedData
+(/root/reference/src/butil/containers/doubly_buffered_data.h:38-67): readers
+grab a per-thread mutex (uncontended in steady state) and read the foreground
+copy; Modify() applies the mutation to the background copy, flips fg/bg, then
+serially acquires every reader mutex to make sure no reader still sees the
+old foreground, and applies the mutation again. Backbone of load-balancer
+server lists (load_balancer.h:72).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, List, TypeVar
+
+T = TypeVar("T")
+
+
+class _ReaderTls:
+    __slots__ = ("lock",)
+
+    def __init__(self):
+        self.lock = threading.Lock()
+
+
+class DoublyBufferedData(Generic[T]):
+    def __init__(self, factory: Callable[[], T]):
+        self._data: List[T] = [factory(), factory()]
+        self._fg_index = 0
+        self._modify_lock = threading.Lock()
+        self._readers_lock = threading.Lock()
+        self._readers: List[_ReaderTls] = []
+        self._tls = threading.local()
+
+    def _reader(self) -> _ReaderTls:
+        r = getattr(self._tls, "r", None)
+        if r is None:
+            r = _ReaderTls()
+            self._tls.r = r
+            with self._readers_lock:
+                self._readers.append(r)
+        return r
+
+    class _ScopedPtr(Generic[T]):
+        __slots__ = ("data", "_lock")
+
+        def __init__(self, data: T, lock: threading.Lock):
+            self.data = data
+            self._lock = lock
+
+        def __enter__(self) -> T:
+            return self.data
+
+        def __exit__(self, *exc):
+            self._lock.release()
+            return False
+
+    def read(self) -> "DoublyBufferedData._ScopedPtr[T]":
+        """Usage: `with dbd.read() as value: ...` — holds only this thread's
+        own mutex, so concurrent readers never contend with each other."""
+        r = self._reader()
+        r.lock.acquire()
+        return self._ScopedPtr(self._data[self._fg_index], r.lock)
+
+    def modify(self, fn: Callable[[T], object]):
+        """Apply fn to both copies with a fg/bg flip in between. fn must be
+        deterministic w.r.t. the copy it receives."""
+        with self._modify_lock:
+            bg = 1 - self._fg_index
+            fn(self._data[bg])
+            self._fg_index = bg  # new readers now see the modified copy
+            # Wait out readers of the old foreground: acquiring each reader
+            # mutex once proves no reader holds a reference to it.
+            with self._readers_lock:
+                readers = list(self._readers)
+            for r in readers:
+                r.lock.acquire()
+                r.lock.release()
+            fn(self._data[1 - bg])

@@ -1,0 +1,94 @@
+"""EndPoint — addressable location of a peer.
+
+Counterpart of butil::EndPoint (/root/reference/src/butil/endpoint.h) — an
+(ip, port) value type — extended TPU-first with optional device coordinates
+(pod, slice, chip, core), so one address type names both DCN peers (host
+TCP) and ICI peers (chips inside a pod slice), the way the survey's build
+plan calls for (SURVEY.md section 7 stage 1).
+"""
+from __future__ import annotations
+
+import re
+import socket
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True, order=True)
+class DeviceCoord:
+    """Position of a TPU chip: (pod, slice, chip, core)."""
+
+    pod: int = 0
+    slice: int = 0
+    chip: int = 0
+    core: int = 0
+
+    def __str__(self) -> str:
+        return f"tpu:{self.pod}.{self.slice}.{self.chip}.{self.core}"
+
+
+_ENDPOINT_RE = re.compile(
+    r"^(?P<host>[^:]+|\[[0-9a-fA-F:]+\]):(?P<port>\d+)"
+    r"(?:/tpu:(?P<pod>\d+)\.(?P<slc>\d+)\.(?P<chip>\d+)\.(?P<core>\d+))?$"
+)
+
+
+@dataclass(frozen=True, order=True)
+class EndPoint:
+    ip: str = "0.0.0.0"
+    port: int = 0
+    device: Optional[DeviceCoord] = field(default=None, compare=False)
+
+    @classmethod
+    def parse(cls, text: str) -> "EndPoint":
+        """Parse 'ip:port' or 'ip:port/tpu:p.s.c.r' forms.
+
+        Mirrors str2endpoint (/root/reference/src/butil/endpoint.h) with the
+        device-coordinate extension.
+        """
+        m = _ENDPOINT_RE.match(text.strip())
+        if not m:
+            raise ValueError(f"invalid endpoint: {text!r}")
+        host = m.group("host").strip("[]")
+        port = int(m.group("port"))
+        if not 0 <= port <= 65535:
+            raise ValueError(f"port out of range: {port}")
+        dev = None
+        if m.group("pod") is not None:
+            dev = DeviceCoord(
+                int(m.group("pod")),
+                int(m.group("slc")),
+                int(m.group("chip")),
+                int(m.group("core")),
+            )
+        return cls(host, port, dev)
+
+    @classmethod
+    def of_device(cls, coord: DeviceCoord, port: int = 0) -> "EndPoint":
+        """An ICI-only endpoint (no routable host ip)."""
+        return cls("0.0.0.0", port, coord)
+
+    def with_device(self, coord: DeviceCoord) -> "EndPoint":
+        return EndPoint(self.ip, self.port, coord)
+
+    def resolve(self) -> "EndPoint":
+        """Resolve a hostname to an IPv4 address (hostname2endpoint)."""
+        try:
+            socket.inet_aton(self.ip)
+            return self
+        except OSError:
+            ip = socket.gethostbyname(self.ip)
+            return EndPoint(ip, self.port, self.device)
+
+    def as_sockaddr(self) -> Tuple[str, int]:
+        return (self.ip, self.port)
+
+    def is_ici(self) -> bool:
+        return self.device is not None
+
+    def __str__(self) -> str:
+        host = f"[{self.ip}]" if ":" in self.ip else self.ip
+        base = f"{host}:{self.port}"
+        if self.device is not None:
+            return f"{base}/{self.device}"
+        return base

@@ -1,0 +1,19 @@
+"""tensor — the tensor-transport compute layer.
+
+The reference is an RPC framework, so its "models" are its transport users
+(SURVEY.md section 2.12 maps ParallelChannel/PartitionChannel/streaming onto
+collective patterns). This package is the TPU-native realization of that
+mapping: ring neighbor-exchange attention for sequence/context parallelism
+(the streaming-RPC analog, stream.cpp:458-586), expert-parallel MoE via
+all_to_all (DynamicPartitionChannel, partition_channel.h:136), and an SPMD
+pipeline via ppermute (the cascade_echo staging pattern), composed into a
+flagship transformer used by __graft_entry__ and bench.
+"""
+
+from brpc_tpu.tensor.config import ModelConfig  # noqa: F401
+from brpc_tpu.tensor.model import (  # noqa: F401
+    init_params,
+    forward_local,
+    make_spmd_forward,
+    make_spmd_train_step,
+)

@@ -1,0 +1,100 @@
+"""Mixture-of-Experts with expert parallelism over an `ep` mesh axis.
+
+Top-1 (switch) routing with static capacity, dense one-hot dispatch/combine
+einsums (MXU-friendly — no gathers/scatters with dynamic shapes), and an
+all_to_all shuffle along the `ep` axis so each device runs only its local
+expert shard. This is the TPU-native DynamicPartitionChannel
+(/root/reference/src/brpc/partition_channel.h:136-142): requests (tokens)
+are routed to partitions (experts) whose capacity differs, over a collective
+transport instead of per-partition sockets.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEParams(NamedTuple):
+    router: jax.Array  # [D, E]
+    w_in: jax.Array  # [E, D, F]
+    w_out: jax.Array  # [E, F, D]
+
+
+def init_moe(key, d_model: int, d_ff: int, n_experts: int, dtype) -> MoEParams:
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / jnp.sqrt(d_model)
+    scale_out = 1.0 / jnp.sqrt(d_ff)
+    return MoEParams(
+        router=(jax.random.normal(k1, (d_model, n_experts)) * scale_in).astype(dtype),
+        w_in=(jax.random.normal(k2, (n_experts, d_model, d_ff)) * scale_in).astype(dtype),
+        w_out=(jax.random.normal(k3, (n_experts, d_ff, d_model)) * scale_out).astype(dtype),
+    )
+
+
+def _route(x, router, n_experts: int, capacity: int):
+    """Top-1 routing -> (dispatch [N,E,C] one-hot, combine [N,E,C] weighted)."""
+    logits = jnp.einsum("nd,de->ne", x, router)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.max(probs, axis=-1)  # [N]
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # [N,E]
+    # Position of each token within its expert's capacity buffer.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [N,E], -1 if unrouted
+    in_cap = (pos >= 0) & (pos < capacity)
+    pos_cap = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    cap_onehot = jax.nn.one_hot(pos_cap, capacity, dtype=jnp.float32)  # [N,E,C]
+    dispatch = cap_onehot * in_cap[..., None]  # [N,E,C]
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_layer(
+    params: MoEParams,
+    x,  # [N, D] local tokens (flattened batch*seq shard)
+    *,
+    n_experts: int,
+    capacity_factor: float = 2.0,
+    ep_axis: str | None = None,
+):
+    """Run the MoE. With ep_axis inside shard_map, params.w_in/w_out hold
+    only the local expert shard [E/ep, D, F] and tokens shuttle via
+    all_to_all; without ep_axis all experts are local (single-chip path).
+    """
+    N, D = x.shape
+    dtype = x.dtype
+    capacity = max(1, int(capacity_factor * N / n_experts))
+    dispatch, combine = _route(x, params.router, n_experts, capacity)
+    dispatch = dispatch.astype(dtype)
+    combine = combine.astype(dtype)
+    # Dense dispatch: [E, C, D] expert input buffers.
+    buf = jnp.einsum("nec,nd->ecd", dispatch, x)
+
+    if ep_axis is not None:
+        ep = lax.psum(1, ep_axis)
+        e_local = n_experts // ep
+        assert e_local * ep == n_experts, "n_experts must divide by ep size"
+        # [E, C, D] -> [ep, E_local, C, D]; all_to_all swaps the ep dim with
+        # the (implicit) device dim: afterwards device j holds, for each of
+        # its local experts, the C-slots contributed by every peer.
+        buf = buf.reshape(ep, e_local, capacity, D)
+        buf = lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=0, tiled=False)
+        # buf: [ep, E_local, C, D] -- first dim now indexes source peer.
+        buf = buf.transpose(1, 0, 2, 3).reshape(e_local, ep * capacity, D)
+        y = jnp.einsum("ecd,edf->ecf", buf, params.w_in)
+        y = jax.nn.gelu(y)
+        y = jnp.einsum("ecf,efd->ecd", y, params.w_out)
+        y = y.reshape(e_local, ep, capacity, D).transpose(1, 0, 2, 3)
+        y = lax.all_to_all(y, ep_axis, split_axis=0, concat_axis=0, tiled=False)
+        # y: [ep, E_local, C, D] -- dim0 = expert shard; global expert id is
+        # shard * E_local + local, matching the dispatch layout.
+        y = y.reshape(n_experts, capacity, D)
+    else:
+        y = jnp.einsum("ecd,edf->ecf", buf, params.w_in)
+        y = jax.nn.gelu(y)
+        y = jnp.einsum("ecf,efd->ecd", y, params.w_out)
+
+    # Combine back to token order: [N, D].
+    return jnp.einsum("nec,ecd->nd", combine, y)

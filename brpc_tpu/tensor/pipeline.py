@@ -1,0 +1,68 @@
+"""SPMD pipeline parallelism over a `pp` mesh axis.
+
+GPipe-style schedule expressed as one SPMD program: every pipeline stage
+runs the same lax.scan; microbatch activations hop stage-to-stage with
+lax.ppermute (neighbor ICI transfers). This is the TPU-native cascade /
+streaming-stage pattern of the reference (SURVEY.md section 2.12 "Pipelining
+(PP-like)": cascade_echo + streaming RPC + async calls).
+
+All control flow is static (scan over M + S - 1 ticks with where-guards), so
+XLA sees a fixed communication schedule it can overlap with compute.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def spmd_pipeline(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,  # [M, ...mb_shape] — replicated along the pp axis
+    axis_name: str,
+):
+    """Run `stage_fn(stage_params, x_mb)` as a pipeline over `axis_name`.
+
+    Each device holds its own stage's params (stage_params is pp-sharded by
+    the caller's shard_map in_specs). Returns the last stage's outputs
+    [M, ...mb_shape], broadcast to every stage via a masked psum so callers
+    on any stage can compute the loss. Differentiable end-to-end (ppermute
+    and the where-guards have transpose rules).
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    n_ticks = m + n_stages - 1
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    outputs0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
+    recv0 = jnp.zeros(mb_shape, microbatches.dtype)
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # Stage 0 feeds from the microbatch queue; later stages consume what
+        # the previous stage sent last tick.
+        feed_idx = jnp.clip(t, 0, m - 1)
+        feed = lax.dynamic_index_in_dim(microbatches, feed_idx, 0, keepdims=False)
+        x_in = jnp.where(stage == 0, feed, recv)
+        y = stage_fn(stage_params, x_in)
+        # Last stage commits microbatch (t - (S-1)) when it is in range.
+        out_idx = t - (n_stages - 1)
+        valid = jnp.logical_and(stage == n_stages - 1,
+                                jnp.logical_and(out_idx >= 0, out_idx < m))
+        committed = lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.clip(out_idx, 0, m - 1), 0
+        )
+        outputs = jnp.where(valid, committed, outputs)
+        recv = lax.ppermute(y, axis_name, perm)
+        return (recv, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (recv0, outputs0), jnp.arange(n_ticks))
+    # Only the last stage holds real outputs; zero-mask + psum broadcasts
+    # them to every stage (the reference's "response returns to caller").
+    outputs = jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)

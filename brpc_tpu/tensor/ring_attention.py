@@ -1,0 +1,108 @@
+"""Ring attention — sequence-parallel attention via ICI neighbor exchange.
+
+Long-context attention with the sequence sharded over a mesh axis: K/V
+shards rotate around the ring with lax.ppermute while each device
+accumulates its queries' attention online (flash-attention style
+log-sum-exp rescaling), so peak memory is O(T_local) and all communication
+is neighbor-to-neighbor over ICI.
+
+This is the tensor-stream analog of the reference's streaming RPC + combo
+channels (SURVEY.md section 5 "long-context" row): the ring is a
+PartitionChannel over the sequence dimension whose transport is XLA
+ppermute instead of sockets.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, m, l, o, mask):
+    """One online-softmax accumulation step.
+
+    q: [B, Tq, H, Dh]; k, v: [B, Tk, H, Dh]
+    m, l: [B, H, Tq] running max / normalizer; o: [B, Tq, H, Dh]
+    mask: [Tq, Tk] additive mask (0 or -inf) or None.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
+    if mask is not None:
+        s = s + mask[None, None, :, :]
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp of fully-masked rows: m stays at _NEG_INF, guard the subtraction.
+    p = jnp.exp(s - m_new[..., None])
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Attention over a sequence sharded along `axis_name`.
+
+    q, k, v: [B, T_local, H, Dh] — this device's sequence shard.
+    Device i holds tokens [i*T_local, (i+1)*T_local). Must run inside
+    shard_map with `axis_name` in scope. Differentiable (ppermute has a
+    transpose rule), so the same code path serves fwd+bwd.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, T, H, Dh = q.shape
+
+    m0 = jnp.full((B, H, T), _NEG_INF, dtype=q.dtype)
+    l0 = jnp.zeros((B, H, T), dtype=q.dtype)
+    o0 = jnp.zeros_like(q)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    iota = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    iota_t = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+
+    def step(carry, i):
+        m, l, o, k_cur, v_cur = carry
+        src_idx = (my_idx - i) % axis_size  # origin of the held K/V shard
+        if causal:
+            # src block fully in the past -> no mask; same block -> lower
+            # triangular; future block -> fully masked.
+            tri = jnp.where(iota >= iota_t, 0.0, _NEG_INF).astype(q.dtype)
+            full = jnp.zeros((T, T), q.dtype)
+            none = jnp.full((T, T), _NEG_INF, q.dtype)
+            mask = jnp.where(
+                src_idx < my_idx, full, jnp.where(src_idx == my_idx, tri, none)
+            )
+        else:
+            mask = None
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, mask)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, o, k_nxt, v_nxt), None
+
+    (m, l, o, _, _), _ = lax.scan(
+        step, (m0, l0, o0, k, v), jnp.arange(axis_size)
+    )
+    # Fully-masked rows have l == 0; emit zeros there.
+    l = jnp.where(l == 0.0, 1.0, l)
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def local_attention(q, k, v, causal: bool = True):
+    """Single-device reference path (ring of size 1) used by forward_local
+    and by tests as the ground truth for ring_attention."""
+    B, T, H, Dh = q.shape
+    m = jnp.full((B, H, T), _NEG_INF, dtype=q.dtype)
+    l = jnp.zeros((B, H, T), dtype=q.dtype)
+    o = jnp.zeros_like(q)
+    mask = None
+    if causal:
+        iota = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        iota_t = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        mask = jnp.where(iota >= iota_t, 0.0, _NEG_INF).astype(q.dtype)
+    m, l, o = _block_attend(q, k, v, m, l, o, mask)
+    l = jnp.where(l == 0.0, 1.0, l)
+    return o / l.transpose(0, 2, 1)[..., None]

@@ -1,0 +1,22 @@
+"""Root conftest: force JAX onto a virtual 8-device CPU mesh for all tests.
+
+Mirrors the reference's test strategy (SURVEY.md section 4): "distributed"
+behavior is exercised with many in-process endpoints before real hardware —
+here, an 8-device host-platform mesh standing in for a TPU slice.
+
+The environment's sitecustomize registers the axon TPU platform and sets
+jax_platforms via jax.config (which overrides the JAX_PLATFORMS env var), so
+we must override it back through jax.config before any backend initializes.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,125 @@
+"""IOBuf tests — modeled on the reference's iobuf_unittest.cpp shape."""
+import os
+import socket
+
+import pytest
+
+from brpc_tpu.butil.iobuf import (
+    IOBuf,
+    IOBufAppender,
+    IOBufCutter,
+    IOPortal,
+    DEFAULT_BLOCK_SIZE,
+)
+
+
+def test_append_and_read():
+    b = IOBuf()
+    b.append(b"hello ")
+    b.append("world")
+    assert len(b) == 11
+    assert b.to_bytes() == b"hello world"
+    assert b == b"hello world"
+
+
+def test_append_iobuf_is_zero_copy():
+    a = IOBuf(b"x" * 100)
+    b = IOBuf()
+    b.append(a)
+    assert len(a) == 100 and len(b) == 100
+    # Shares blocks: cutting from b must not disturb a.
+    b.cut(50)
+    assert len(a) == 100
+
+
+def test_cut_zero_copy_split():
+    b = IOBuf(b"0123456789")
+    front = b.cut(4)
+    assert front.to_bytes() == b"0123"
+    assert b.to_bytes() == b"456789"
+    assert len(b) == 6
+
+
+def test_cut_across_blocks():
+    b = IOBuf()
+    big = bytes(range(256)) * 100  # > 1 block
+    b.append(big)
+    assert len(b) == len(big)
+    front = b.cut(DEFAULT_BLOCK_SIZE + 17)
+    assert front.to_bytes() == big[: DEFAULT_BLOCK_SIZE + 17]
+    assert b.to_bytes() == big[DEFAULT_BLOCK_SIZE + 17 :]
+
+
+def test_pop_front_back():
+    b = IOBuf(b"abcdefgh")
+    assert b.pop_front(3) == 3
+    assert b.to_bytes() == b"defgh"
+    assert b.pop_back(2) == 2
+    assert b.to_bytes() == b"def"
+    assert b.pop_front(100) == 3
+    assert b.empty()
+
+
+def test_copy_to_bytes_with_pos():
+    b = IOBuf(b"0123456789")
+    assert b.copy_to_bytes(3, pos=2) == b"234"
+    assert b.copy_to_bytes() == b"0123456789"
+    assert len(b) == 10  # non-destructive
+
+
+def test_user_data_zero_copy_and_meta():
+    freed = []
+    mem = bytearray(b"tensor-bytes")
+    b = IOBuf()
+    b.append_user_data(mem, deleter=lambda m: freed.append(m), meta=0xDEAD)
+    assert b.to_bytes() == b"tensor-bytes"
+    assert b._refs[0].block.meta == 0xDEAD
+
+
+def test_appender_and_cutter():
+    app = IOBufAppender()
+    app.append(b"\x00\x00\x00\x05")
+    app.append(b"hello")
+    buf = app.take()
+    cut = IOBufCutter(buf)
+    n = cut.cut_uint32_be()
+    assert n == 5
+    assert cut.cutn(5) == b"hello"
+    assert cut.remaining() == 0
+    with pytest.raises(EOFError):
+        cut.cutn(1)
+
+
+def test_fd_io_roundtrip():
+    r, w = socket.socketpair()
+    try:
+        src = IOBuf()
+        payload = os.urandom(DEFAULT_BLOCK_SIZE * 3 + 123)
+        src.append(payload)
+        total = len(src)
+        while not src.empty():
+            src.cut_into_socket(w)
+        w.close()
+        portal = IOPortal()
+        while True:
+            n = portal.append_from_socket(r)
+            if n == 0:
+                break
+        assert len(portal) == total
+        assert portal.to_bytes() == payload
+    finally:
+        r.close()
+
+
+def test_device_block_materializes_once():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    import numpy as np
+
+    arr = jnp.arange(16, dtype=jnp.float32)
+    b = IOBuf()
+    b.append_device_array(arr, meta=7)
+    assert len(b) == arr.nbytes
+    assert b.device_arrays()[0] is arr
+    host = b.to_bytes()
+    assert np.frombuffer(host, dtype=np.float32).tolist() == list(range(16))

@@ -1,0 +1,100 @@
+"""EndPoint / Status / flags / pools / DoublyBufferedData tests."""
+import threading
+
+import pytest
+
+from brpc_tpu.butil import flags
+from brpc_tpu.butil.dbd import DoublyBufferedData
+from brpc_tpu.butil.endpoint import DeviceCoord, EndPoint
+from brpc_tpu.butil.pools import INVALID_RESOURCE_ID, ObjectPool, ResourcePool
+from brpc_tpu.butil.status import Status
+
+
+def test_endpoint_parse_roundtrip():
+    ep = EndPoint.parse("10.0.0.1:8000")
+    assert ep.ip == "10.0.0.1" and ep.port == 8000 and not ep.is_ici()
+    assert str(ep) == "10.0.0.1:8000"
+    ep2 = EndPoint.parse("10.0.0.1:8000/tpu:0.1.2.0")
+    assert ep2.is_ici()
+    assert ep2.device == DeviceCoord(0, 1, 2, 0)
+    assert EndPoint.parse(str(ep2)) == ep2
+
+
+def test_endpoint_invalid():
+    for bad in ("nohost", "a:b", "1.2.3.4:99999"):
+        with pytest.raises(ValueError):
+            EndPoint.parse(bad)
+
+
+def test_status():
+    assert Status.ok().is_ok()
+    s = Status.error(1008, "rpc timed out")
+    assert not s
+    assert s.code == 1008
+    with pytest.raises(ValueError):
+        Status.error(0, "not an error")
+
+
+def test_flags_define_set_validate():
+    flags.define_int("test_timeout_ms", 500, "test flag")
+    assert flags.get_flag("test_timeout_ms") == 500
+    assert flags.set_flag("test_timeout_ms", "750")
+    assert flags.get_flag("test_timeout_ms") == 750
+    flags.define_int(
+        "test_positive", 1, validator=lambda v: v > 0
+    )
+    assert not flags.set_flag("test_positive", -5)
+    assert flags.get_flag("test_positive") == 1
+    assert not flags.set_flag("no_such_flag", 1)
+    with pytest.raises(ValueError):
+        flags.define_int("test_timeout_ms", 1)
+
+
+def test_object_pool_reuse():
+    pool = ObjectPool(list)
+    a = pool.get()
+    pool.put(a)
+    b = pool.get()
+    assert a is b
+
+
+def test_resource_pool_versioned_ids():
+    pool = ResourcePool(dict)
+    rid, obj = pool.get_resource()
+    obj["k"] = 1
+    assert pool.address(rid) is obj
+    assert pool.return_resource(rid)
+    # Stale id no longer addresses anything — the SocketId trick.
+    assert pool.address(rid) is None
+    assert not pool.return_resource(rid)
+    rid2, obj2 = pool.get_resource()
+    assert (rid2 & 0xFFFFFFFF) == (rid & 0xFFFFFFFF)  # slot reused
+    assert rid2 != rid  # version differs
+    assert pool.address(rid) is None
+    assert pool.address(INVALID_RESOURCE_ID) is None
+
+
+def test_dbd_concurrent_read_modify():
+    dbd = DoublyBufferedData(list)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            with dbd.read() as servers:
+                snapshot = list(servers)
+                # A snapshot must always be a consistent prefix.
+                if snapshot != sorted(snapshot):
+                    errors.append(snapshot)
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(200):
+        dbd.modify(lambda lst, i=i: lst.append(i) if (not lst or lst[-1] != i) else None)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+    with dbd.read() as servers:
+        assert servers == list(range(200))
