@@ -1,0 +1,35 @@
+"""brpc_tpu.bvar — lock-light metrics (SURVEY.md section 2.3).
+
+Per-thread-agent reducers + background sampler + windows + percentiles, the
+instrumentation substrate consumed by the scheduler, sockets, servers,
+channels, and the builtin console — mirroring how bvar underpins every brpc
+layer (/root/reference/src/bvar/).
+"""
+from brpc_tpu.bvar.variable import (  # noqa: F401
+    PassiveStatus,
+    StatusVar,
+    Variable,
+    count_exposed,
+    dump_exposed,
+    dump_prometheus,
+    find_exposed,
+    list_exposed,
+)
+from brpc_tpu.bvar.reducer import Adder, IntRecorder, Maxer, Miner, Stat  # noqa: F401
+from brpc_tpu.bvar.window import PerSecond, Window  # noqa: F401
+from brpc_tpu.bvar.percentile import Percentile  # noqa: F401
+from brpc_tpu.bvar.latency_recorder import LatencyRecorder  # noqa: F401
+from brpc_tpu.bvar.multi_dimension import MultiDimension  # noqa: F401
+from brpc_tpu.bvar.sampler import force_tick_for_tests  # noqa: F401
+from brpc_tpu.bvar.default_variables import expose_default_variables  # noqa: F401
+
+
+def expose_flags_as_bvars():
+    """gflag bridge (bvar/gflag.{h,cpp}): every defined flag becomes a
+    PassiveStatus named flag_<name>."""
+    from brpc_tpu.butil import flags as _flags
+
+    for name, f in _flags.all_flags().items():
+        bvar_name = f"flag_{name}"
+        if find_exposed(bvar_name) is None:
+            PassiveStatus(lambda f=f: f.value, bvar_name)

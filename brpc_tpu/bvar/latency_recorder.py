@@ -1,0 +1,73 @@
+"""LatencyRecorder — the compound qps+latency+percentile metric.
+
+Counterpart of bvar::LatencyRecorder
+(/root/reference/src/bvar/latency_recorder.h:49-139): one `update(latency)`
+per request feeds window-averaged latency, max latency, qps, count, and
+p50/90/99/99.9 — the standard per-method instrument consumed by MethodStatus
+and the /status page.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from brpc_tpu.bvar.percentile import Percentile
+from brpc_tpu.bvar.reducer import Adder, IntRecorder, Maxer
+from brpc_tpu.bvar.sampler import Sampler
+from brpc_tpu.bvar.window import PerSecond, Window
+
+
+class LatencyRecorder:
+    def __init__(self, name: Optional[str] = None, window_size: int = 10):
+        self._latency = IntRecorder()
+        self._max_latency = Maxer()
+        self._count = Adder()
+        self._latency_window = Window(self._latency, window_size)
+        self._max_window = Window(self._max_latency, window_size)
+        self._qps_window = PerSecond(self._count, window_size)
+        self._percentile = Percentile(window_size)
+        self._percentile_sampler = Sampler(self._rotate_percentile, window_size)
+        if name:
+            self.expose(name)
+
+    def _rotate_percentile(self):
+        self._percentile.rotate()
+
+    def expose(self, name: str):
+        self._latency_window.expose(f"{name}_latency")
+        self._max_window.expose(f"{name}_max_latency")
+        self._qps_window.expose(f"{name}_qps")
+        self._count.expose(f"{name}_count")
+
+    # -- hot path ----------------------------------------------------------
+    def update(self, latency_us: float):
+        self._latency.update(latency_us)
+        self._max_latency.update(latency_us)
+        self._count.update(1)
+        self._percentile.update(latency_us)
+
+    __lshift__ = update
+
+    # -- reads -------------------------------------------------------------
+    def latency(self) -> float:
+        """Window-averaged latency (us)."""
+        v = self._latency_window.get_value()
+        return v.average if hasattr(v, "average") else 0.0
+
+    def max_latency(self) -> float:
+        return self._max_window.get_value()
+
+    def qps(self) -> float:
+        return self._qps_window.get_value()
+
+    def count(self) -> int:
+        return self._count.get_value()
+
+    def latency_percentile(self, ratio: float) -> float:
+        return self._percentile.get_number(ratio)
+
+    def describe(self) -> str:
+        return (
+            f"count={self.count()} qps={self.qps():.1f} "
+            f"avg={self.latency():.1f}us max={self.max_latency():.0f}us "
+            f"{self._percentile.describe()}"
+        )

@@ -1,0 +1,172 @@
+"""Reducer family — contention-free writes via per-thread agents.
+
+Counterpart of bvar::Reducer (/root/reference/src/bvar/reducer.h:69-224) and
+its agent machinery (detail/agent_group.h, detail/combiner.h): each writing
+thread owns a private cell; readers merge all cells. Writes touch only
+thread-local state (no shared cacheline in the reference; no shared lock in
+the hot path here), which is what lets every layer of the framework
+instrument itself without serializing.
+
+Adder/Maxer/Miner (reducer.h:224,258,308) and IntRecorder (average with a
+(sum, num) compound value, int_recorder.h) are provided.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from brpc_tpu.bvar.variable import Variable
+
+
+class _Cell:
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+class Reducer(Variable):
+    """op must be commutative + associative; identity is its neutral value."""
+
+    def __init__(
+        self,
+        op: Callable,
+        identity,
+        name: Optional[str] = None,
+        series_op: Optional[Callable] = None,
+    ):
+        self._op = op
+        self._identity = identity
+        # series_op combines adjacent window samples; defaults to op
+        # (max-of-maxes), while Adder overrides nothing — windows of Adders
+        # difference samples instead (see window.py).
+        self._series_op = series_op or op
+        self._tls = threading.local()
+        self._cells: List[_Cell] = []
+        self._cells_lock = threading.Lock()
+        # value carried over from dead/reset threads
+        self._carry = identity
+        super().__init__(name)
+
+    # -- hot path ----------------------------------------------------------
+    def _cell(self) -> _Cell:
+        cell = getattr(self._tls, "cell", None)
+        if cell is None:
+            cell = _Cell(self._identity)
+            self._tls.cell = cell
+            with self._cells_lock:
+                self._cells.append(cell)
+        return cell
+
+    def update(self, value):
+        cell = self._cell()
+        cell.value = self._op(cell.value, value)
+
+    __lshift__ = update  # brpc idiom: adder << 1
+
+    # -- read path ---------------------------------------------------------
+    def get_value(self):
+        with self._cells_lock:
+            result = self._carry
+            for cell in self._cells:
+                result = self._op(result, cell.value)
+        return result
+
+    def reset(self):
+        """Combine-and-clear all agents; returns the combined value
+        (Reducer::reset, used by window sampling of non-invertible ops)."""
+        with self._cells_lock:
+            result = self._carry
+            self._carry = self._identity
+            for cell in self._cells:
+                result = self._op(result, cell.value)
+                cell.value = self._identity
+        return result
+
+    @property
+    def op(self):
+        return self._op
+
+    @property
+    def series_op(self):
+        return self._series_op
+
+    @property
+    def identity(self):
+        return self._identity
+
+
+class Adder(Reducer):
+    """Summing reducer; supports negative updates (reducer.h:224)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(lambda a, b: a + b, 0, name)
+
+    # Adders are invertible: window value = now - then (see window.py).
+    invertible = True
+
+
+class Maxer(Reducer):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(max, float("-inf"), name)
+
+    invertible = False
+
+    def get_value(self):
+        v = super().get_value()
+        return 0 if v == float("-inf") else v
+
+
+class Miner(Reducer):
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(min, float("inf"), name)
+
+    invertible = False
+
+    def get_value(self):
+        v = super().get_value()
+        return 0 if v == float("inf") else v
+
+
+class _Stat:
+    __slots__ = ("sum", "num")
+
+    def __init__(self, sum_=0, num=0):
+        self.sum = sum_
+        self.num = num
+
+    def __add__(self, other):
+        return _Stat(self.sum + other.sum, self.num + other.num)
+
+    def __sub__(self, other):
+        return _Stat(self.sum - other.sum, self.num - other.num)
+
+    @property
+    def average(self) -> float:
+        return self.sum / self.num if self.num else 0.0
+
+
+class IntRecorder(Reducer):
+    """Average-of-samples recorder (bvar::IntRecorder, int_recorder.h):
+    compound (sum, num) value; get_value() -> _Stat with .average."""
+
+    invertible = True  # _Stat supports __sub__, so windows can difference it
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(lambda a, b: a + b, _Stat(), name)
+
+    def update(self, sample: float):
+        cell = self._cell()
+        cell.value = cell.value + _Stat(sample, 1)
+
+    __lshift__ = update
+
+    def average(self) -> float:
+        return self.get_value().average
+
+    def describe(self) -> str:
+        s = self.get_value()
+        return f"avg={s.average:.3f} num={s.num}"
+
+
+Stat = _Stat

@@ -1,0 +1,148 @@
+"""Variable — named, exposable metric base + global registry.
+
+Counterpart of bvar::Variable (/root/reference/src/bvar/variable.h:102-129):
+every metric can be exposed under a unique name, hidden, described as text,
+and dumped in bulk — the data source behind /vars and /brpc_metrics.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+_registry: "Dict[str, Variable]" = {}
+_registry_lock = threading.Lock()
+
+
+class Variable:
+    """Base of all metrics. Subclasses implement get_value()."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._name: Optional[str] = None
+        if name:
+            self.expose(name)
+
+    # -- registry ----------------------------------------------------------
+    def expose(self, name: str) -> bool:
+        name = name.strip().replace(" ", "_")
+        with _registry_lock:
+            if name in _registry and _registry[name] is not self:
+                return False
+            if self._name and self._name != name:
+                _registry.pop(self._name, None)
+            _registry[name] = self
+            self._name = name
+            return True
+
+    def expose_as(self, prefix: str, name: str) -> bool:
+        return self.expose(f"{prefix}_{name}" if prefix else name)
+
+    def hide(self) -> bool:
+        with _registry_lock:
+            if self._name and _registry.get(self._name) is self:
+                del _registry[self._name]
+                self._name = None
+                return True
+            return False
+
+    @property
+    def name(self) -> Optional[str]:
+        return self._name
+
+    def is_hidden(self) -> bool:
+        return self._name is None
+
+    # -- value -------------------------------------------------------------
+    def get_value(self):
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return str(self.get_value())
+
+    def __del__(self):
+        try:
+            self.hide()
+        except Exception:
+            pass
+
+
+class StatusVar(Variable):
+    """Explicitly-set value (bvar::Status, status.h)."""
+
+    def __init__(self, name: Optional[str] = None, value=None):
+        self._value = value
+        self._lock = threading.Lock()
+        super().__init__(name)
+
+    def set_value(self, value):
+        with self._lock:
+            self._value = value
+
+    def get_value(self):
+        with self._lock:
+            return self._value
+
+
+class PassiveStatus(Variable):
+    """Callback-computed value (bvar::PassiveStatus, passive_status.h)."""
+
+    def __init__(self, callback: Callable[[], object], name: Optional[str] = None):
+        self._callback = callback
+        super().__init__(name)
+
+    def get_value(self):
+        return self._callback()
+
+
+def find_exposed(name: str) -> Optional[Variable]:
+    with _registry_lock:
+        return _registry.get(name)
+
+
+def list_exposed() -> List[str]:
+    with _registry_lock:
+        return sorted(_registry.keys())
+
+
+def count_exposed() -> int:
+    with _registry_lock:
+        return len(_registry)
+
+
+def dump_exposed(filter_fn: Optional[Callable[[str], bool]] = None) -> List[Tuple[str, object]]:
+    """Snapshot of (name, value) for every exposed variable — the /vars body."""
+    with _registry_lock:
+        items = list(_registry.items())
+    out = []
+    for name, var in sorted(items):
+        if filter_fn and not filter_fn(name):
+            continue
+        try:
+            out.append((name, var.get_value()))
+        except Exception as e:  # a broken callback must not break /vars
+            out.append((name, f"<error: {e}>"))
+    return out
+
+
+def dump_prometheus() -> str:
+    """Prometheus text exposition of all exposed scalar variables
+    (builtin/prometheus_metrics_service.cpp equivalent)."""
+    lines = []
+    for name, value in dump_exposed():
+        metric = name.replace("-", "_").replace(".", "_").replace("/", "_")
+        if isinstance(value, bool):
+            value = int(value)
+        if isinstance(value, (int, float)):
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value}")
+        elif isinstance(value, dict):  # multi-dimension: labels -> scalar
+            lines.append(f"# TYPE {metric} gauge")
+            for labels, v in value.items():
+                if isinstance(v, (int, float)):
+                    label_s = ",".join(f'{k}="{val}"' for k, val in labels)
+                    lines.append(f"{metric}{{{label_s}}} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def clear_registry_for_tests():
+    with _registry_lock:
+        _registry.clear()

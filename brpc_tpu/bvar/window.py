@@ -1,0 +1,72 @@
+"""Window / PerSecond — time-windowed views over reducers.
+
+Counterpart of bvar::Window / bvar::PerSecond
+(/root/reference/src/bvar/window.h:43-197): a Window(reducer, N) shows the
+reducer's delta (invertible ops: Adder, IntRecorder) or series-combine
+(Maxer/Miner) over the last N seconds, fed by the Sampler thread.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from brpc_tpu.bvar.reducer import Reducer
+from brpc_tpu.bvar.sampler import Sampler
+from brpc_tpu.bvar.variable import Variable
+
+
+class Window(Variable):
+    def __init__(self, reducer: Reducer, window_size: int = 10,
+                 name: Optional[str] = None):
+        self._reducer = reducer
+        self._window_size = window_size
+        self._sampler = Sampler(reducer.get_value, window_size)
+        super().__init__(name)
+
+    @property
+    def window_size(self) -> int:
+        return self._window_size
+
+    def get_value(self):
+        if getattr(self._reducer, "invertible", False):
+            now = self._reducer.get_value()
+            oldest = self._sampler.oldest_in(self._window_size)
+            if oldest is None:
+                return now
+            return now - oldest[1]
+        # Non-invertible (Maxer/Miner): series-combine the samples + live.
+        samples = self._sampler.samples_in(self._window_size)
+        result = self._reducer.get_value()
+        for _, v in samples:
+            result = self._reducer.series_op(result, v)
+        return result
+
+    def get_span(self) -> float:
+        """Seconds actually covered (may be < window_size early on)."""
+        oldest = self._sampler.oldest_in(self._window_size)
+        latest = self._sampler.latest()
+        if oldest is None or latest is None:
+            return 0.0
+        return max(0.0, latest[0] - oldest[0])
+
+    def destroy(self):
+        self._sampler.destroy()
+        self.hide()
+
+
+class PerSecond(Window):
+    """Windowed delta divided by elapsed seconds (window.h:174-197)."""
+
+    def get_value(self):
+        import time
+
+        now = self._reducer.get_value()
+        oldest = self._sampler.oldest_in(self._window_size)
+        if oldest is None:
+            return 0.0
+        dt = time.monotonic() - oldest[0]
+        if dt <= 0:
+            return 0.0
+        delta = now - oldest[1]
+        if hasattr(delta, "sum"):  # IntRecorder _Stat
+            delta = delta.sum
+        return delta / dt

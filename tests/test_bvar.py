@@ -1,0 +1,172 @@
+"""bvar tests — shaped after test/bvar_*_unittest.cpp (SURVEY.md section 4):
+real threads exercising the per-thread-agent reducers, windows fed by forced
+sampler ticks (no 1s sleeps), percentile distribution sanity.
+"""
+import threading
+
+import pytest
+
+from brpc_tpu import bvar
+
+
+def test_adder_basic():
+    a = bvar.Adder()
+    a.update(1)
+    a.update(2)
+    a << 3
+    assert a.get_value() == 6
+    a.update(-6)
+    assert a.get_value() == 0
+
+
+def test_adder_multithreaded():
+    a = bvar.Adder()
+    n_threads, per_thread = 8, 1000
+
+    def work():
+        for _ in range(per_thread):
+            a.update(1)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert a.get_value() == n_threads * per_thread
+
+
+def test_maxer_miner():
+    mx, mn = bvar.Maxer(), bvar.Miner()
+    for v in (3, 9, 1):
+        mx.update(v)
+        mn.update(v)
+    assert mx.get_value() == 9
+    assert mn.get_value() == 1
+
+
+def test_maxer_across_threads():
+    mx = bvar.Maxer()
+
+    def work(v):
+        mx.update(v)
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(20)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert mx.get_value() == 19
+
+
+def test_int_recorder_average():
+    r = bvar.IntRecorder()
+    for v in (10, 20, 30):
+        r.update(v)
+    assert r.average() == pytest.approx(20.0)
+    assert r.get_value().num == 3
+
+
+def test_reducer_reset():
+    a = bvar.Adder()
+    a.update(5)
+    assert a.reset() == 5
+    assert a.get_value() == 0
+
+
+def test_window_adder_delta():
+    a = bvar.Adder()
+    w = bvar.Window(a, window_size=10)
+    bvar.force_tick_for_tests()  # sample with value 0
+    a.update(7)
+    assert w.get_value() == 7  # now - oldest = 7 - 0
+    w.destroy()
+
+
+def test_window_maxer_series():
+    mx = bvar.Maxer()
+    w = bvar.Window(mx, window_size=10)
+    mx.update(42)
+    bvar.force_tick_for_tests()
+    assert w.get_value() == 42
+    w.destroy()
+
+
+def test_per_second_positive():
+    a = bvar.Adder()
+    ps = bvar.PerSecond(a, window_size=10)
+    bvar.force_tick_for_tests()
+    import time
+
+    a.update(100)
+    time.sleep(0.05)
+    assert ps.get_value() > 0
+    ps.destroy()
+
+
+def test_percentile():
+    p = bvar.Percentile()
+    for v in range(1, 1001):
+        p.update(v)
+    assert 400 <= p.get_number(0.5) <= 600
+    assert p.get_number(0.99) >= 900
+    assert p.get_number(0.999) >= p.get_number(0.5)
+
+
+def test_latency_recorder():
+    lr = bvar.LatencyRecorder(window_size=10)
+    bvar.force_tick_for_tests()  # baseline sample before any updates
+    for v in (100, 200, 300):
+        lr.update(v)
+    assert lr.count() == 3
+    assert lr.latency() == pytest.approx(200.0)
+    assert lr.max_latency() == 300
+    assert lr.latency_percentile(0.5) in (100, 200, 300)
+
+
+def test_status_and_passive():
+    s = bvar.StatusVar(value="init")
+    assert s.get_value() == "init"
+    s.set_value("changed")
+    assert s.get_value() == "changed"
+    p = bvar.PassiveStatus(lambda: 41 + 1)
+    assert p.get_value() == 42
+
+
+def test_registry_expose_hide():
+    a = bvar.Adder("test_registry_counter_xyz")
+    assert bvar.find_exposed("test_registry_counter_xyz") is a
+    assert ("test_registry_counter_xyz", 0) in bvar.dump_exposed()
+    a.hide()
+    assert bvar.find_exposed("test_registry_counter_xyz") is None
+
+
+def test_duplicate_expose_rejected():
+    a = bvar.Adder("dup_name_abc")
+    b = bvar.Adder()
+    assert not b.expose("dup_name_abc")
+    a.hide()
+
+
+def test_multi_dimension():
+    md = bvar.MultiDimension(["method", "code"], bvar.Adder)
+    md.get_stats("echo", "200").update(3)
+    md.get_stats("echo", "500").update(1)
+    assert md.count_stats() == 2
+    v = md.get_value()
+    assert v[(("method", "echo"), ("code", "200"))] == 3
+
+
+def test_prometheus_dump():
+    a = bvar.Adder("prom_test_counter")
+    a.update(5)
+    text = bvar.dump_prometheus()
+    assert "prom_test_counter 5" in text
+    a.hide()
+
+
+def test_default_variables():
+    bvar.expose_default_variables()
+    dump = dict(bvar.dump_exposed())
+    assert dump["process_pid"] > 0
+    assert dump["process_memory_resident_bytes"] > 0
+    assert dump["process_fd_count"] > 0
