@@ -1,0 +1,109 @@
+"""ExecutionQueue — MPSC serialized executor with batching.
+
+Counterpart of bthread::ExecutionQueue
+(/root/reference/src/bthread/execution_queue.h:78-203): many producers
+execute() tasks; at most one consumer runs at a time, draining a batch
+through a TaskIterator; a high-priority lane jumps the queue. Used by
+streaming RPC's ordered delivery and the locality-aware LB here exactly as
+in the reference.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Deque, Iterator, Optional
+
+
+class TaskIterator:
+    """Batch iterator handed to the consumer fn (execution_queue.h:94-136)."""
+
+    def __init__(self, tasks, stopped: bool):
+        self._tasks = tasks
+        self._stopped = stopped
+
+    def __iter__(self) -> Iterator:
+        return iter(self._tasks)
+
+    def is_queue_stopped(self) -> bool:
+        return self._stopped
+
+
+class ExecutionQueue:
+    def __init__(self, execute_fn: Callable[[TaskIterator], int],
+                 scheduler=None, batch_size: int = 256):
+        """execute_fn(iterator) -> int; negative return stops the queue.
+        scheduler: callable(fn) running fn asynchronously; defaults to the
+        global bthread pool."""
+        self._execute_fn = execute_fn
+        self._tasks: Deque = deque()
+        self._high_tasks: Deque = deque()
+        self._lock = threading.Lock()
+        self._running = False  # one consumer at a time
+        self._stopped = False
+        self._joined = threading.Event()
+        self._batch_size = batch_size
+        if scheduler is None:
+            from brpc_tpu.bthread.task_control import start_background
+
+            scheduler = start_background
+        self._schedule = scheduler
+
+    def execute(self, task, high_priority: bool = False) -> bool:
+        with self._lock:
+            if self._stopped:
+                return False
+            (self._high_tasks if high_priority else self._tasks).append(task)
+            if self._running:
+                return True
+            self._running = True
+        self._schedule(self._consume)
+        return True
+
+    def _consume(self):
+        while True:
+            with self._lock:
+                batch = []
+                while self._high_tasks and len(batch) < self._batch_size:
+                    batch.append(self._high_tasks.popleft())
+                while self._tasks and len(batch) < self._batch_size:
+                    batch.append(self._tasks.popleft())
+                stopped = self._stopped
+                if not batch and not stopped:
+                    self._running = False
+                    return
+            # Tasks accepted before stop() are still drained; only the final,
+            # empty iteration reports is_queue_stopped (execution_queue.h
+            # stop semantics).
+            rc = 0
+            try:
+                rc = self._execute_fn(
+                    TaskIterator(batch, stopped and not batch)
+                ) or 0
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "execution queue consumer raised"
+                )
+            if (stopped and not batch) or rc < 0:
+                with self._lock:
+                    self._stopped = True
+                    self._running = False
+                self._joined.set()
+                return
+
+    def stop(self):
+        """No new tasks; consumer gets one final stopped-iterator run."""
+        with self._lock:
+            self._stopped = True
+            if self._running:
+                return
+            self._running = True
+        self._schedule(self._consume)
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return self._joined.wait(timeout)
+
+
+def execution_queue_start(execute_fn, **kw) -> ExecutionQueue:
+    return ExecutionQueue(execute_fn, **kw)

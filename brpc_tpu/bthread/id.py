@@ -1,0 +1,192 @@
+"""bthread_id — versioned, lockable 64-bit correlation ids.
+
+Counterpart of bthread/id.{h,cpp} (/root/reference/src/bthread/id.h:38-60):
+an id names one in-flight operation; lock() serializes all touches to its
+data; error() delivers a failure to the owner's on_error under the lock
+(queued if the lock is held); destroy() invalidates every outstanding copy
+of the id (ABA-proof via version); ranged creation lets id+n address the
+same slot — brpc's CallId+nretry trick (controller.h:655-664) that gives
+every retry attempt its own addressable version.
+
+This is the completion backbone of the RPC layer here, as in the reference:
+the response/timeout/cancel paths race by design and the id lock arbitrates.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+INVALID_BTHREAD_ID = 0
+
+# on_error(id_value, data, error_code, error_text) -> None
+# MUST finish by calling unlock(id) or unlock_and_destroy(id).
+OnError = Callable[[int, object, int, str], None]
+
+
+class _IdSlot:
+    __slots__ = (
+        "first_version", "range", "locked", "destroyed", "data", "on_error",
+        "pending_errors", "cond", "joined",
+    )
+
+    def __init__(self):
+        self.first_version = 1
+        self.range = 1
+        self.locked = False
+        self.destroyed = True
+        self.data = None
+        self.on_error: Optional[OnError] = None
+        self.pending_errors: deque = deque()
+        self.cond = threading.Condition()
+        self.joined = threading.Event()
+
+
+_slots: Dict[int, _IdSlot] = {}
+_free_indexes: deque = deque()
+_next_index = 1
+_registry_lock = threading.Lock()
+
+
+def _default_on_error(id_value: int, data, error_code: int, error_text: str):
+    unlock_and_destroy(id_value)
+
+
+def create(data=None, on_error: Optional[OnError] = None) -> int:
+    return create_ranged(data, on_error, 1)
+
+
+def create_ranged(data=None, on_error: Optional[OnError] = None,
+                  range_: int = 1) -> int:
+    """Versions [v, v+range_) all address this slot (id.h:55-60)."""
+    global _next_index
+    with _registry_lock:
+        if _free_indexes:
+            index = _free_indexes.popleft()
+            slot = _slots[index]
+        else:
+            index = _next_index
+            _next_index += 1
+            slot = _IdSlot()
+            _slots[index] = slot
+    with slot.cond:
+        slot.range = max(1, range_)
+        slot.locked = False
+        slot.destroyed = False
+        slot.data = data
+        slot.on_error = on_error or _default_on_error
+        slot.pending_errors.clear()
+        slot.joined = threading.Event()
+        return (index << 32) | slot.first_version
+
+
+def _resolve(id_value: int) -> Tuple[Optional[_IdSlot], int]:
+    index = id_value >> 32
+    version = id_value & 0xFFFFFFFF
+    with _registry_lock:
+        slot = _slots.get(index)
+    return slot, version
+
+
+def _valid(slot: _IdSlot, version: int) -> bool:
+    return (not slot.destroyed
+            and slot.first_version <= version < slot.first_version + slot.range)
+
+
+def lock(id_value: int, timeout: Optional[float] = None):
+    """Lock the id; returns its data. Raises KeyError if the id is
+    destroyed/stale (EINVAL in the reference)."""
+    slot, version = _resolve(id_value)
+    if slot is None:
+        raise KeyError(f"invalid bthread_id {id_value:#x}")
+    with slot.cond:
+        while True:
+            if not _valid(slot, version):
+                raise KeyError(f"destroyed bthread_id {id_value:#x}")
+            if not slot.locked:
+                slot.locked = True
+                return slot.data
+            if not slot.cond.wait(timeout):
+                raise TimeoutError(f"lock timeout on {id_value:#x}")
+
+
+def trylock(id_value: int):
+    slot, version = _resolve(id_value)
+    if slot is None:
+        raise KeyError(f"invalid bthread_id {id_value:#x}")
+    with slot.cond:
+        if not _valid(slot, version) or slot.locked:
+            return None
+        slot.locked = True
+        return slot.data
+
+
+def unlock(id_value: int):
+    """Release the lock — but first deliver one queued error, if any, to
+    on_error while still holding the lock (id.cpp error-queue semantics)."""
+    slot, version = _resolve(id_value)
+    if slot is None:
+        raise KeyError(f"invalid bthread_id {id_value:#x}")
+    pending = None
+    with slot.cond:
+        if not slot.locked:
+            raise RuntimeError(f"unlock of unlocked id {id_value:#x}")
+        if slot.pending_errors and _valid(slot, version):
+            pending = slot.pending_errors.popleft()
+        else:
+            slot.locked = False
+            slot.cond.notify()
+    if pending is not None:
+        code, text = pending
+        slot.on_error(id_value, slot.data, code, text)
+
+
+def unlock_and_destroy(id_value: int):
+    """Invalidate all copies of the id; wake joiners and lock-waiters."""
+    slot, version = _resolve(id_value)
+    if slot is None:
+        raise KeyError(f"invalid bthread_id {id_value:#x}")
+    index = id_value >> 32
+    with slot.cond:
+        slot.first_version += slot.range  # all outstanding versions now stale
+        slot.destroyed = True
+        slot.locked = False
+        slot.pending_errors.clear()
+        slot.cond.notify_all()
+        slot.joined.set()
+    with _registry_lock:
+        _free_indexes.append(index)
+
+
+def join(id_value: int, timeout: Optional[float] = None) -> bool:
+    """Block until the id is destroyed. Returns immediately for stale ids."""
+    slot, version = _resolve(id_value)
+    if slot is None:
+        return True
+    with slot.cond:
+        if not _valid(slot, version):
+            return True
+        joined = slot.joined
+    return joined.wait(timeout)
+
+
+def error(id_value: int, error_code: int, error_text: str = "") -> bool:
+    """Deliver an error: runs on_error under the id lock, or queues it if
+    the lock is held (bthread_id_error2). Returns False for stale ids."""
+    slot, version = _resolve(id_value)
+    if slot is None:
+        return False
+    with slot.cond:
+        if not _valid(slot, version):
+            return False
+        if slot.locked:
+            slot.pending_errors.append((error_code, error_text))
+            return True
+        slot.locked = True
+    slot.on_error(id_value, slot.data, error_code, error_text)
+    return True
+
+
+def is_destroyed(id_value: int) -> bool:
+    slot, version = _resolve(id_value)
+    return slot is None or not _valid(slot, version)

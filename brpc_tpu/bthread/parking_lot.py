@@ -1,0 +1,43 @@
+"""ParkingLot — sleep/wake of idle workers.
+
+Counterpart of bthread::ParkingLot
+(/root/reference/src/bthread/parking_lot.h:31-77): a 31-bit signal counter
+plus a stop bit; workers read the expected state before their final queue
+check, then park only if the counter is unchanged (no lost wakeups). The
+monographdb fork gives each worker its own lot for precise wakeup
+(task_control.h:123-126) — TaskControl here does the same.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class ParkingLot:
+    STOP_BIT = 1 << 31
+
+    def __init__(self):
+        self._pending_signal = 0
+        self._cond = threading.Condition()
+
+    def signal(self, num_task: int = 1):
+        with self._cond:
+            self._pending_signal = (self._pending_signal + (num_task << 1)) & 0xFFFFFFFF
+            self._cond.notify(num_task)
+
+    def get_state(self) -> int:
+        return self._pending_signal
+
+    def wait(self, expected_state: int, timeout: float = None) -> bool:
+        """Park unless a signal arrived since expected_state was read."""
+        with self._cond:
+            if self._pending_signal != expected_state:
+                return False  # state moved: don't sleep, recheck queues
+            return not self._cond.wait(timeout)
+
+    def stop(self):
+        with self._cond:
+            self._pending_signal |= self.STOP_BIT
+            self._cond.notify_all()
+
+    def stopped(self) -> bool:
+        return bool(self._pending_signal & self.STOP_BIT)

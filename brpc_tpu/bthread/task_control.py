@@ -1,0 +1,261 @@
+"""TaskControl / TaskGroup — the M:N scheduler's worker fleet.
+
+Counterparts of bthread::TaskControl and bthread::TaskGroup
+(/root/reference/src/bthread/task_control.h:55-126, task_group.h/cpp):
+TaskControl owns N worker threads, each running a TaskGroup loop over a
+local work-stealing deque `_rq`, a `_remote_rq` fed by non-workers, and a
+fork-style `_bound_rq` of group-pinned tasks that thieves may not touch
+(task_group.h:327-330). The idle loop reproduces the monographdb fork's
+pluggable shape (task_group.cpp:139-232): registered idle hooks run before
+parking — the seam where that fork polls io_uring / an external transaction
+processor, and where the TPU build polls libtpu transfer completions
+(SURVEY.md section 2.10).
+
+CPython cannot cheaply switch user-space stacks, so a "bthread" here is a
+callable executed to completion on a worker (the reference's own
+pthread-compatible mode); blocking primitives park the worker thread. The
+native C++ core (brpc_tpu/native) provides the real stack-switching M:N
+scheduler.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from brpc_tpu import bvar
+from brpc_tpu.bthread.parking_lot import ParkingLot
+from brpc_tpu.bthread.work_stealing_queue import WorkStealingQueue
+
+
+class TaskMeta:
+    __slots__ = ("fn", "args", "tid", "joined", "about_to_quit")
+
+    def __init__(self, fn: Callable, args, tid: int):
+        self.fn = fn
+        self.args = args
+        self.tid = tid
+        self.joined = threading.Event()
+        self.about_to_quit = False
+
+
+class TaskGroup:
+    def __init__(self, control: "TaskControl", group_id: int):
+        self.control = control
+        self.group_id = group_id
+        self._rq = WorkStealingQueue()
+        self._remote_rq: deque = deque()
+        self._remote_lock = threading.Lock()
+        self._bound_rq: deque = deque()  # group-pinned, never stolen
+        self._bound_lock = threading.Lock()
+        # fork: one parking lot per worker for precise wakeup
+        self.parking_lot = ParkingLot()
+        self.nswitch = 0
+
+    # -- producers ---------------------------------------------------------
+    def push_local(self, meta: TaskMeta):
+        if not self._rq.push(meta):
+            self.push_remote(meta)
+
+    def push_remote(self, meta: TaskMeta):
+        with self._remote_lock:
+            self._remote_rq.append(meta)
+        self.parking_lot.signal(1)
+
+    def push_bound(self, meta: TaskMeta):
+        """ready_to_run_bound (fork): pin a task to this group."""
+        with self._bound_lock:
+            self._bound_rq.append(meta)
+        self.parking_lot.signal(1)
+
+    # -- consumer ----------------------------------------------------------
+    def _next_task(self) -> Optional[TaskMeta]:
+        with self._bound_lock:
+            if self._bound_rq:
+                return self._bound_rq.popleft()
+        meta = self._rq.pop()
+        if meta is not None:
+            return meta
+        with self._remote_lock:
+            if self._remote_rq:
+                return self._remote_rq.popleft()
+        return self.control.steal_task(self.group_id)
+
+    def run_main_task(self):
+        """Worker main loop (task_group.cpp:238-270 + wait_task 139-232)."""
+        control = self.control
+        while not control._stopping:
+            meta = self._next_task()
+            if meta is None:
+                # Idle: run registered hooks (libtpu poll / ext-processor
+                # slot), then park on this worker's lot.
+                did_work = False
+                for hook in control.idle_hooks:
+                    try:
+                        did_work |= bool(hook())
+                    except Exception:
+                        pass
+                if did_work:
+                    continue
+                expected = self.parking_lot.get_state()
+                if self._rq.empty() and not self._remote_rq and not self._bound_rq:
+                    self.parking_lot.wait(expected, timeout=0.1)
+                continue
+            self.nswitch += 1
+            control._nswitch_var.update(1)
+            try:
+                meta.fn(*meta.args)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("bthread raised")
+            finally:
+                meta.joined.set()
+                control._finished_var.update(1)
+
+
+class TaskControl:
+    def __init__(self, concurrency: int = 4):
+        self.concurrency = max(1, concurrency)
+        self.groups: List[TaskGroup] = []
+        self._threads: List[threading.Thread] = []
+        self._stopping = False
+        self._init_lock = threading.Lock()
+        self._started = False
+        self._next_tid = 1
+        self._tid_lock = threading.Lock()
+        self.idle_hooks: List[Callable[[], bool]] = []
+        self._metas: Dict[int, TaskMeta] = {}
+        # bvar instrumentation mirroring task_control.h:111-121
+        self._nswitch_var = bvar.Adder("bthread_switch_count")
+        self._finished_var = bvar.Adder("bthread_count_finished")
+        bvar.PassiveStatus(lambda: len(self._threads), "bthread_worker_count")
+        bvar.PassiveStatus(self._queued_count, "bthread_queued_count")
+
+    def _queued_count(self) -> int:
+        return sum(
+            len(g._rq) + len(g._remote_rq) + len(g._bound_rq)
+            for g in self.groups
+        )
+
+    def init(self):
+        with self._init_lock:
+            if self._started:
+                return
+            for i in range(self.concurrency):
+                g = TaskGroup(self, i)
+                self.groups.append(g)
+            for g in self.groups:
+                t = threading.Thread(
+                    target=g.run_main_task, name=f"bthread_worker_{g.group_id}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+            self._started = True
+
+    def add_workers(self, n: int):
+        """Grow the fleet at runtime (task_control.h:78)."""
+        with self._init_lock:
+            base = len(self.groups)
+            for i in range(n):
+                g = TaskGroup(self, base + i)
+                self.groups.append(g)
+                t = threading.Thread(
+                    target=g.run_main_task, name=f"bthread_worker_{g.group_id}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+            self.concurrency += n
+
+    def add_idle_hook(self, hook: Callable[[], bool]):
+        """Register work for the idle loop (the fork's ext-processor seam,
+        task_group.h:223-228). hook() returns True if it did work."""
+        self.idle_hooks.append(hook)
+
+    # -- spawn -------------------------------------------------------------
+    def start_background(self, fn: Callable, *args) -> int:
+        """bthread_start_background: queue to a group, signal its lot."""
+        self.init()
+        with self._tid_lock:
+            tid = self._next_tid
+            self._next_tid += 1
+        meta = TaskMeta(fn, args, tid)
+        self._metas[tid] = meta
+        group = self.groups[tid % len(self.groups)]
+        group.push_remote(meta)
+        return tid
+
+    def start_urgent(self, fn: Callable, *args) -> int:
+        """bthread_start_urgent: jumps ahead via the bound lane."""
+        self.init()
+        with self._tid_lock:
+            tid = self._next_tid
+            self._next_tid += 1
+        meta = TaskMeta(fn, args, tid)
+        self._metas[tid] = meta
+        group = self.groups[tid % len(self.groups)]
+        group.push_bound(meta)
+        return tid
+
+    def join(self, tid: int, timeout: Optional[float] = None) -> bool:
+        meta = self._metas.get(tid)
+        if meta is None:
+            return True
+        ok = meta.joined.wait(timeout)
+        if ok:
+            self._metas.pop(tid, None)
+        return ok
+
+    def steal_task(self, thief_group_id: int) -> Optional[TaskMeta]:
+        """Steal from a random victim's local queue (task_control.h:55);
+        bound queues are exempt by construction."""
+        n = len(self.groups)
+        if n <= 1:
+            return None
+        start = random.randrange(n)
+        for i in range(n):
+            victim = self.groups[(start + i) % n]
+            if victim.group_id == thief_group_id:
+                continue
+            meta = victim._rq.steal()
+            if meta is not None:
+                return meta
+        return None
+
+    def stop_and_join(self):
+        self._stopping = True
+        for g in self.groups:
+            g.parking_lot.stop()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+_control: Optional[TaskControl] = None
+_control_lock = threading.Lock()
+
+
+def get_task_control(concurrency: Optional[int] = None) -> TaskControl:
+    global _control
+    if _control is None:
+        with _control_lock:
+            if _control is None:
+                import os
+
+                default = min(8, (os.cpu_count() or 1) + 3)
+                _control = TaskControl(concurrency or default)
+    return _control
+
+
+def start_background(fn: Callable, *args) -> int:
+    return get_task_control().start_background(fn, *args)
+
+
+def start_urgent(fn: Callable, *args) -> int:
+    return get_task_control().start_urgent(fn, *args)
+
+
+def bthread_join(tid: int, timeout: Optional[float] = None) -> bool:
+    return get_task_control().join(tid, timeout)

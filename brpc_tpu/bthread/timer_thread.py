@@ -1,0 +1,155 @@
+"""TimerThread — the one dedicated timing thread behind all timeouts.
+
+Counterpart of bthread::TimerThread
+(/root/reference/src/bthread/timer_thread.h:32-90): schedule() inserts into
+one of 13 hashed buckets to spread producer contention, a single thread
+drains buckets into a global min-heap and runs due tasks. RPC timeouts and
+backup-request timers ride this (controller.cpp:605,1256).
+
+unschedule() is best-effort exactly as in the reference: it can race the
+run; callers needing certainty use the returned Timer's `cancelled` flag
+which run() rechecks under the bucket lock.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+NUM_BUCKETS = 13
+
+
+class _Task:
+    __slots__ = ("run_time", "fn", "args", "seq", "cancelled", "done")
+
+    def __init__(self, run_time: float, fn: Callable, args, seq: int):
+        self.run_time = run_time
+        self.fn = fn
+        self.args = args
+        self.seq = seq
+        self.cancelled = False
+        self.done = False
+
+    def __lt__(self, other: "_Task") -> bool:
+        return (self.run_time, self.seq) < (other.run_time, other.seq)
+
+
+TimerId = int
+
+
+class TimerThread:
+    def __init__(self):
+        self._buckets = [[] for _ in range(NUM_BUCKETS)]
+        self._bucket_locks = [threading.Lock() for _ in range(NUM_BUCKETS)]
+        self._tasks: Dict[TimerId, _Task] = {}
+        self._tasks_lock = threading.Lock()
+        self._heap: list = []
+        self._seq = itertools.count(1)
+        self._cond = threading.Condition()
+        self._nearest = float("inf")
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._started_lock = threading.Lock()
+
+    def _ensure_started(self):
+        if self._thread is None:
+            with self._started_lock:
+                if self._thread is None:
+                    t = threading.Thread(
+                        target=self._run, name="bthread_timer", daemon=True
+                    )
+                    t.start()
+                    self._thread = t
+
+    def schedule(self, fn: Callable, delay_s: float, *args) -> TimerId:
+        """Run fn(*args) delay_s seconds from now; returns an id for
+        unschedule()."""
+        self._ensure_started()
+        seq = next(self._seq)
+        task = _Task(time.monotonic() + max(0.0, delay_s), fn, args, seq)
+        bucket = seq % NUM_BUCKETS
+        with self._bucket_locks[bucket]:
+            self._buckets[bucket].append(task)
+        with self._tasks_lock:
+            self._tasks[seq] = task
+        # Wake the run loop if this beats the nearest deadline.
+        with self._cond:
+            if task.run_time < self._nearest:
+                self._cond.notify()
+        return seq
+
+    def unschedule(self, timer_id: TimerId) -> int:
+        """0 = cancelled, 1 = already ran/running, -1 = unknown id
+        (timer_thread.h unschedule semantics)."""
+        with self._tasks_lock:
+            task = self._tasks.get(timer_id)
+        if task is None:
+            return -1
+        if task.done:
+            return 1
+        task.cancelled = True
+        return 0
+
+    def _collect(self):
+        for i in range(NUM_BUCKETS):
+            with self._bucket_locks[i]:
+                pending, self._buckets[i] = self._buckets[i], []
+            for t in pending:
+                heapq.heappush(self._heap, t)
+
+    def _run(self):
+        while not self._stop:
+            self._collect()
+            now = time.monotonic()
+            while self._heap and self._heap[0].run_time <= now:
+                task = heapq.heappop(self._heap)
+                task.done = True
+                with self._tasks_lock:
+                    self._tasks.pop(task.seq, None)
+                if not task.cancelled:
+                    try:
+                        task.fn(*task.args)
+                    except Exception:
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "timer task raised"
+                        )
+            next_deadline = self._heap[0].run_time if self._heap else now + 1.0
+            with self._cond:
+                self._nearest = next_deadline
+                wait = max(0.0, min(next_deadline - time.monotonic(), 1.0))
+                if wait > 0:
+                    self._cond.wait(wait)
+                self._nearest = float("inf")
+
+    def stop_and_join(self):
+        self._stop = True
+        with self._cond:
+            self._cond.notify()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+
+_global_timer: Optional[TimerThread] = None
+_global_timer_lock = threading.Lock()
+
+
+def get_global_timer_thread() -> TimerThread:
+    global _global_timer
+    if _global_timer is None:
+        with _global_timer_lock:
+            if _global_timer is None:
+                _global_timer = TimerThread()
+    return _global_timer
+
+
+def timer_add(delay_s: float, fn: Callable, *args) -> TimerId:
+    """bthread_timer_add equivalent."""
+    return get_global_timer_thread().schedule(fn, delay_s, *args)
+
+
+def timer_del(timer_id: TimerId) -> int:
+    return get_global_timer_thread().unschedule(timer_id)

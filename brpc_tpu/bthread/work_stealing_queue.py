@@ -1,0 +1,48 @@
+"""WorkStealingQueue — per-worker deque: owner pushes/pops one end, thieves
+steal the other.
+
+Counterpart of bthread::WorkStealingQueue
+(/root/reference/src/bthread/work_stealing_queue.h:31-157), the Chase-Lev
+single-producer ring. CPython can't do the lock-free version (no atomics on
+plain ints), so this preserves the *shape* — owner-end LIFO for cache warmth,
+thief-end FIFO for fairness — behind one short lock; the native C++ core
+(brpc_tpu/native) carries the lock-free implementation.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class WorkStealingQueue:
+    def __init__(self, capacity: int = 4096):
+        self._q: deque = deque()
+        self._capacity = capacity
+        self._lock = threading.Lock()
+
+    def push(self, item) -> bool:
+        """Owner-only push (bottom)."""
+        with self._lock:
+            if len(self._q) >= self._capacity:
+                return False
+            self._q.append(item)
+            return True
+
+    def pop(self) -> Optional[object]:
+        """Owner-only pop (bottom, LIFO — newest first for locality)."""
+        with self._lock:
+            return self._q.pop() if self._q else None
+
+    def steal(self) -> Optional[object]:
+        """Thief pop (top, FIFO — oldest first)."""
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def empty(self) -> bool:
+        return not self._q

@@ -1,0 +1,293 @@
+"""bthread layer tests — shaped after the reference suite (SURVEY.md
+section 4): real threads, real timing; ping-pong, stealing queue, butex,
+execution queue, timer, bthread_id tests mirror
+bthread_*_unittest.cpp shapes.
+"""
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import bthread
+from brpc_tpu.bthread import bthread_id
+
+
+def test_start_and_join():
+    out = []
+    tid = bthread.start_background(out.append, 42)
+    assert bthread.bthread_join(tid, timeout=5)
+    assert out == [42]
+
+
+def test_many_tasks_all_run():
+    n = 200
+    counter = []
+    lock = threading.Lock()
+
+    def work(i):
+        with lock:
+            counter.append(i)
+
+    tids = [bthread.start_background(work, i) for i in range(n)]
+    for t in tids:
+        assert bthread.bthread_join(t, timeout=10)
+    assert sorted(counter) == list(range(n))
+
+
+def test_urgent_runs():
+    done = threading.Event()
+    bthread.start_urgent(done.set)
+    assert done.wait(5)
+
+
+def test_ping_pong():
+    """bthread_ping_pong_unittest shape: two tasks alternating via butex."""
+    b1, b2 = bthread.Butex(0), bthread.Butex(0)
+    rounds = 50
+    trace = []
+
+    def ping():
+        for i in range(rounds):
+            trace.append("ping")
+            b2.value += 1
+            b2.wake(1)
+            b1.wait(i, timeout=5)
+
+    def pong():
+        for i in range(rounds):
+            b2.wait(i, timeout=5)
+            trace.append("pong")
+            b1.value += 1
+            b1.wake(1)
+
+    t1 = bthread.start_background(ping)
+    t2 = bthread.start_background(pong)
+    assert bthread.bthread_join(t1, 10) and bthread.bthread_join(t2, 10)
+    assert trace.count("ping") == rounds and trace.count("pong") == rounds
+
+
+def test_work_stealing_queue():
+    q = bthread.WorkStealingQueue()
+    for i in range(10):
+        assert q.push(i)
+    assert q.pop() == 9  # owner LIFO
+    assert q.steal() == 0  # thief FIFO
+    assert len(q) == 8
+
+
+def test_butex_wait_wake():
+    b = bthread.Butex(0)
+    woken = []
+
+    def waiter():
+        woken.append(b.wait(0, timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    b.value = 1
+    assert b.wake(1) == 1
+    t.join(5)
+    assert woken == [True]
+
+
+def test_butex_value_changed_no_block():
+    b = bthread.Butex(7)
+    t0 = time.monotonic()
+    assert b.wait(3, timeout=5) is False  # EWOULDBLOCK
+    assert time.monotonic() - t0 < 1
+
+
+def test_butex_requeue():
+    src, dst = bthread.Butex(0), bthread.Butex(0)
+    results = []
+
+    def waiter():
+        results.append(src.wait(0, timeout=5) or dst.wait(0, timeout=5))
+
+    ts = [threading.Thread(target=waiter) for _ in range(3)]
+    for t in ts:
+        t.start()
+    time.sleep(0.1)
+    src.requeue(dst)  # wakes 1, moves 2
+    time.sleep(0.05)
+    dst.wake_all()
+    for t in ts:
+        t.join(5)
+    assert len(results) == 3
+
+
+def test_mutex_mutual_exclusion():
+    m = bthread.Mutex()
+    counter = [0]
+
+    def work():
+        for _ in range(200):
+            with m:
+                counter[0] += 1
+
+    ts = [threading.Thread(target=work) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert counter[0] == 800
+
+
+def test_countdown_event():
+    ev = bthread.CountdownEvent(3)
+    for _ in range(3):
+        bthread.start_background(ev.signal)
+    assert ev.wait(5)
+
+
+def test_timer_fires():
+    fired = threading.Event()
+    bthread.timer_add(0.05, fired.set)
+    assert fired.wait(5)
+
+
+def test_timer_unschedule():
+    fired = []
+    tid = bthread.timer_add(0.3, lambda: fired.append(1))
+    assert bthread.timer_del(tid) == 0
+    time.sleep(0.5)
+    assert not fired
+
+
+def test_timer_ordering():
+    order = []
+    bthread.timer_add(0.15, lambda: order.append(2))
+    bthread.timer_add(0.05, lambda: order.append(1))
+    time.sleep(0.4)
+    assert order == [1, 2]
+
+
+def test_execution_queue_serial_and_batched():
+    seen = []
+
+    def consume(it):
+        batch = list(it)
+        seen.append(batch)
+        return 0
+
+    q = bthread.execution_queue_start(consume)
+    for i in range(50):
+        q.execute(i)
+    q.stop()
+    assert q.join(5)
+    flat = [x for b in seen for x in b]
+    assert sorted(flat) == list(range(50))  # every task delivered once
+
+
+def test_execution_queue_high_priority():
+    seen = []
+    gate = threading.Event()
+
+    def consume(it):
+        for x in it:
+            if x == "wait":
+                gate.wait(5)
+            seen.append(x)
+        return 0
+
+    q = bthread.execution_queue_start(consume, batch_size=1)
+    q.execute("wait")
+    time.sleep(0.05)  # consumer now blocked inside first batch
+    q.execute("normal")
+    q.execute("urgent", high_priority=True)
+    gate.set()
+    q.stop()
+    assert q.join(5)
+    assert seen.index("urgent") < seen.index("normal")
+
+
+def test_bthread_id_lifecycle():
+    calls = []
+
+    def on_error(idv, data, code, text):
+        calls.append((data, code))
+        bthread_id.unlock_and_destroy(idv)
+
+    idv = bthread_id.create("payload", on_error)
+    assert bthread_id.lock(idv) == "payload"
+    bthread_id.unlock(idv)
+    assert bthread_id.error(idv, 112)
+    assert bthread_id.is_destroyed(idv)
+    assert calls == [("payload", 112)]
+    assert bthread_id.join(idv, 1)
+    # stale id now rejected everywhere
+    assert not bthread_id.error(idv, 1)
+    with pytest.raises(KeyError):
+        bthread_id.lock(idv)
+
+
+def test_bthread_id_error_queued_while_locked():
+    calls = []
+
+    def on_error(idv, data, code, text):
+        calls.append(code)
+        bthread_id.unlock_and_destroy(idv)
+
+    idv = bthread_id.create(None, on_error)
+    bthread_id.lock(idv)
+    assert bthread_id.error(idv, 7)  # queued, not yet delivered
+    assert calls == []
+    bthread_id.unlock(idv)  # delivers queued error under lock
+    assert calls == [7]
+    assert bthread_id.is_destroyed(idv)
+
+
+def test_bthread_id_ranged_versions():
+    idv = bthread_id.create_ranged("d", lambda i, d, c, t: bthread_id.unlock_and_destroy(i), 4)
+    # id+1..+3 address the same slot (CallId+nretry trick)
+    assert bthread_id.lock(idv + 2) == "d"
+    bthread_id.unlock(idv + 2)
+    bthread_id.lock(idv)
+    bthread_id.unlock_and_destroy(idv)
+    assert bthread_id.is_destroyed(idv + 3)
+
+
+def test_bthread_id_join_blocks_until_destroy():
+    idv = bthread_id.create()
+    t0 = time.monotonic()
+
+    def destroyer():
+        time.sleep(0.1)
+        bthread_id.lock(idv)
+        bthread_id.unlock_and_destroy(idv)
+
+    threading.Thread(target=destroyer).start()
+    assert bthread_id.join(idv, 5)
+    assert time.monotonic() - t0 >= 0.09
+
+
+def test_idle_hook_runs():
+    control = bthread.get_task_control()
+    ran = threading.Event()
+
+    def hook():
+        ran.set()
+        return False
+
+    control.add_idle_hook(hook)
+    try:
+        assert ran.wait(5)
+    finally:
+        control.idle_hooks.remove(hook)
+
+
+def test_bthread_local_keys():
+    key = bthread.key_create()
+    results = {}
+
+    def work(name):
+        bthread.setspecific(key, name)
+        time.sleep(0.01)
+        results[name] = bthread.getspecific(key)
+
+    t1 = bthread.start_background(work, "a")
+    t2 = bthread.start_background(work, "b")
+    bthread.bthread_join(t1, 5)
+    bthread.bthread_join(t2, 5)
+    assert results == {"a": "a", "b": "b"}
