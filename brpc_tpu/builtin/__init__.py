@@ -1,0 +1,19 @@
+"""brpc_tpu.builtin — the HTTP debug console services
+(/status /vars /flags /connections /rpcz /brpc_metrics ...), counterpart of
+src/brpc/builtin/ (registered by server.cpp:468-563).
+
+Services register here; the HTTP protocol serves them once it lands.
+"""
+from __future__ import annotations
+
+
+def register_builtin_services(server) -> None:
+    """Attach builtin service handlers to the server (AddBuiltinServices,
+    server.cpp:949). Until the HTTP protocol lands this records the server
+    for the console; the HTTP layer routes /status etc. to handlers."""
+    try:
+        from brpc_tpu.builtin.console import attach_console
+
+        attach_console(server)
+    except ImportError:
+        pass

@@ -369,6 +369,8 @@ class IOPortal(IOBuf):
             try:
                 data = os.read(fd, want)
             except BlockingIOError:
+                if got == 0:
+                    raise  # no data at all: would-block, NOT EOF
                 break
             if not data:
                 if got == 0:

@@ -1,0 +1,27 @@
+"""brpc_tpu.rpc — the RPC layer (SURVEY.md sections 2.4-2.8).
+
+Server / Channel / Controller over a Socket + EventDispatcher +
+InputMessenger core with a pluggable Protocol registry — the counterpart of
+/root/reference/src/brpc/, architected for the TPU build: host TCP is the
+baseline transport, the device/ICI endpoint plugs in at the Socket
+app_connect seam, and attachments carry HBM-resident tensors.
+"""
+from brpc_tpu.rpc import errors  # noqa: F401
+from brpc_tpu.rpc.acceptor import Acceptor  # noqa: F401
+from brpc_tpu.rpc.channel import Channel, ChannelOptions  # noqa: F401
+from brpc_tpu.rpc.controller import Controller, RetryPolicy  # noqa: F401
+from brpc_tpu.rpc.event_dispatcher import EventDispatcher, get_global_dispatcher  # noqa: F401
+from brpc_tpu.rpc.input_messenger import InputMessenger  # noqa: F401
+from brpc_tpu.rpc.method_status import MethodStatus  # noqa: F401
+from brpc_tpu.rpc.protocol import (  # noqa: F401
+    ParseError,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    find_protocol_by_name,
+    globally_initialize,
+    register_protocol,
+)
+from brpc_tpu.rpc.server import Server, ServerOptions  # noqa: F401
+from brpc_tpu.rpc.service import ClosureGuard, MethodInfo, Service, rpc_method  # noqa: F401
+from brpc_tpu.rpc.socket import Socket, SocketUser  # noqa: F401

@@ -1,0 +1,93 @@
+"""Acceptor — the listen-socket accept loop.
+
+Counterpart of brpc::Acceptor (/root/reference/src/brpc/acceptor.{h,cpp}):
+the listening fd is itself a Socket whose edge-triggered handler accepts in
+a loop (OnNewConnections, acceptor.cpp:52-94) and creates one data Socket
+per connection, wired to an InputMessenger.
+"""
+from __future__ import annotations
+
+import socket as pysocket
+import threading
+from typing import Dict, Optional
+
+from brpc_tpu import bvar
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.rpc.input_messenger import InputMessenger
+from brpc_tpu.rpc.socket import Socket
+
+
+class Acceptor:
+    def __init__(self, messenger: InputMessenger):
+        self._messenger = messenger
+        self._listen_sid = 0
+        self._connections: Dict[int, int] = {}  # fd -> socket_id
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._accepted = bvar.Adder()
+
+    def start_accept(self, listen_fd: pysocket.socket) -> int:
+        listen_fd.setblocking(False)
+        self._listen_sid = Socket.create(
+            fd=listen_fd, on_edge_triggered_events=self._on_new_connections
+        )
+        return 0
+
+    def _on_new_connections(self, listen_sock: Socket):
+        while not self._stopped:
+            fd = listen_sock.fd()
+            if fd is None:
+                return
+            try:
+                conn, addr = fd.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            conn.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
+            remote = EndPoint(addr[0], addr[1])
+            sid = Socket.create(
+                fd=conn,
+                remote_side=remote,
+                on_edge_triggered_events=self._messenger.on_new_messages,
+            )
+            self._accepted.update(1)
+            with self._lock:
+                self._connections[conn.fileno()] = sid
+
+    def connection_count(self) -> int:
+        with self._lock:
+            alive = 0
+            dead = []
+            for fdno, sid in self._connections.items():
+                s = Socket.address(sid)
+                if s is not None and not s.failed():
+                    alive += 1
+                else:
+                    dead.append(fdno)
+            for fdno in dead:
+                self._connections.pop(fdno, None)
+            return alive
+
+    def list_connections(self):
+        with self._lock:
+            sids = list(self._connections.values())
+        out = []
+        for sid in sids:
+            s = Socket.address(sid)
+            if s is not None and not s.failed():
+                out.append(s)
+        return out
+
+    def stop_accept(self):
+        self._stopped = True
+        listen = Socket.address(self._listen_sid)
+        if listen is not None:
+            listen.set_failed(error_text="acceptor stopped")
+        with self._lock:
+            sids = list(self._connections.values())
+            self._connections.clear()
+        for sid in sids:
+            s = Socket.address(sid)
+            if s is not None:
+                s.set_failed(error_text="server stopping")

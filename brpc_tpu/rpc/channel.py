@@ -1,0 +1,231 @@
+"""Channel — the client endpoint.
+
+Counterpart of brpc::Channel (/root/reference/src/brpc/channel.{h,cpp}):
+init against a single server (channel.cpp:317) or a naming-service URL + LB
+policy (channel.cpp:354-393, LoadBalancerWithNaming); CallMethod sets up the
+Controller then drives IssueRPC (channel.cpp:407-576). Connection types
+single/pooled/short mirror socket.h:553-590 (SocketMap-pooled client
+connections, details/socket_map).
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.input_messenger import InputMessenger
+from brpc_tpu.rpc.protocol import (
+    ProtocolType,
+    find_protocol_by_name,
+    globally_initialize,
+    list_server_protocols,
+)
+from brpc_tpu.rpc.socket import Socket
+
+
+@dataclass
+class ChannelOptions:
+    """Mirror of brpc::ChannelOptions (channel.h:41-89)."""
+
+    connect_timeout_ms: float = 200
+    timeout_ms: float = 500
+    backup_request_ms: float = -1
+    max_retry: int = 3
+    protocol: str = "tpu_std"
+    connection_type: str = "single"  # single | pooled | short
+    health_check_interval_s: float = -1
+    enable_circuit_breaker: bool = False
+
+
+_client_messenger: Optional[InputMessenger] = None
+_client_messenger_lock = threading.Lock()
+
+
+def get_client_messenger() -> InputMessenger:
+    """The client-side InputMessenger shared by all channels (the role of
+    the global client messenger in socket creation)."""
+    global _client_messenger
+    if _client_messenger is None:
+        with _client_messenger_lock:
+            if _client_messenger is None:
+                globally_initialize()
+                _client_messenger = InputMessenger(list_server_protocols())
+    return _client_messenger
+
+
+class Channel:
+    def __init__(self, options: Optional[ChannelOptions] = None):
+        self.options = options or ChannelOptions()
+        self._protocol = None
+        self._server_ep: Optional[EndPoint] = None
+        self._single_sid: Optional[int] = None
+        self._single_lock = threading.Lock()
+        self._socket_pool: deque = deque()  # pooled connection type
+        self._pool_lock = threading.Lock()
+        self._lb = None
+        self._ns_thread = None
+        self._circuit_breakers = {}  # sid -> CircuitBreaker
+        self._cb_lock = threading.Lock()
+        self._init_done = False
+
+    # -- init --------------------------------------------------------------
+    def init(self, target, lb_name: str = "") -> int:
+        """init('ip:port') for a single server, or
+        init('list://h1:p1,h2:p2', 'rr') / init('file://...', ...) for
+        NS + load balancing (channel.cpp:317,354-393)."""
+        globally_initialize()
+        self._protocol = find_protocol_by_name(self.options.protocol)
+        if self._protocol is None:
+            return errors.EPROTONOTSUP
+        if "://" in str(target):
+            from brpc_tpu.rpc.load_balancer import create_load_balancer
+            from brpc_tpu.rpc.naming_service import start_naming_service
+
+            self._lb = create_load_balancer(lb_name or "rr")
+            if self._lb is None:
+                return errors.EINVAL
+            self._ns_thread = start_naming_service(
+                str(target), self._lb, self.options
+            )
+            if self._ns_thread is None:
+                return errors.EINVAL
+        else:
+            ep = target if isinstance(target, EndPoint) else EndPoint.parse(str(target))
+            self._server_ep = ep.resolve()
+        self._init_done = True
+        return 0
+
+    # -- socket selection (IssueRPC's server-selection half) ---------------
+    def _connect_new_socket(self, ep: EndPoint) -> Optional[Socket]:
+        messenger = get_client_messenger()
+        sid = Socket.create(
+            remote_side=ep,
+            on_edge_triggered_events=messenger.on_new_messages,
+            health_check_interval_s=self.options.health_check_interval_s,
+        )
+        sock = Socket.address(sid)
+        rc = sock.connect(timeout_s=self.options.connect_timeout_ms / 1000.0)
+        if rc != 0:
+            return None
+        return sock
+
+    def _select_socket(self, cntl: Controller):
+        """Returns (Socket, rc). Applies LB if configured, then the
+        connection type (controller.cpp:1048-1112)."""
+        if self._lb is not None:
+            sid = self._lb.select_server(exclude=cntl._excluded_sids)
+            if sid is None:
+                return None, errors.EFAILEDSOCKET
+            cntl._lb = self._lb
+            main_sock = Socket.address(sid)
+            if main_sock is None or main_sock.failed():
+                return None, errors.EFAILEDSOCKET
+            return self._apply_connection_type(main_sock, cntl)
+        if self._server_ep is None:
+            return None, errors.EINVAL
+        return self._apply_connection_type_ep(self._server_ep, cntl)
+
+    def _apply_connection_type(self, main_sock: Socket, cntl: Controller):
+        if self.options.connection_type == "single":
+            return main_sock, 0
+        return self._apply_connection_type_ep(main_sock.remote_side, cntl)
+
+    def _apply_connection_type_ep(self, ep: EndPoint, cntl: Controller):
+        ctype = self.options.connection_type
+        if ctype == "short":
+            sock = self._connect_new_socket(ep)
+            if sock is None:
+                return None, errors.EFAILEDSOCKET
+            sock.connection_type = "short"
+            return sock, 0
+        if ctype == "pooled":
+            with self._pool_lock:
+                while self._socket_pool:
+                    sock = self._socket_pool.popleft()
+                    if not sock.failed():
+                        return sock, 0
+            sock = self._connect_new_socket(ep)
+            if sock is None:
+                return None, errors.EFAILEDSOCKET
+            sock.connection_type = "pooled"
+            sock.conn_data = self  # home pool
+            return sock, 0
+        # single (default): one shared connection, created/revived lazily
+        with self._single_lock:
+            if self._single_sid is not None:
+                sock = Socket.address(self._single_sid)
+                if sock is not None and not sock.failed():
+                    return sock, 0
+            sock = self._connect_new_socket(ep)
+            if sock is None:
+                return None, errors.EFAILEDSOCKET
+            self._single_sid = sock.socket_id
+            return sock, 0
+
+    def _on_rpc_end(self, cntl: Controller):
+        sock = cntl._current_sock
+        if sock is None:
+            return
+        if sock.connection_type == "short":
+            if not sock.failed():
+                sock.set_failed(errors.ECLOSE, "short connection done")
+        elif sock.connection_type == "pooled" and not sock.failed():
+            with self._pool_lock:
+                self._socket_pool.append(sock)
+        if self.options.enable_circuit_breaker:
+            self._feed_circuit_breaker(sock, cntl)
+
+    def _feed_circuit_breaker(self, sock: Socket, cntl: Controller):
+        from brpc_tpu.rpc.circuit_breaker import CircuitBreaker
+
+        with self._cb_lock:
+            cb = self._circuit_breakers.get(sock.socket_id)
+            if cb is None:
+                cb = CircuitBreaker()
+                self._circuit_breakers[sock.socket_id] = cb
+        if not cb.on_call_end(cntl.error_code_value, cntl.latency_us):
+            sock.set_failed(errors.EFAILEDSOCKET, "isolated by circuit breaker")
+
+    # -- the RPC -----------------------------------------------------------
+    def call_method(self, method_full_name: str, cntl: Controller,
+                    request, response, done: Optional[Callable] = None):
+        """CallMethod (channel.cpp:407-576). done=None → synchronous."""
+        if not self._init_done:
+            cntl.set_failed(errors.EINVAL, "channel not initialized")
+            if done:
+                done(cntl)
+            return
+        if cntl.timeout_ms is None:
+            cntl.timeout_ms = self.options.timeout_ms
+        if cntl.max_retry == 3:
+            cntl.max_retry = self.options.max_retry
+        if cntl.backup_request_ms is None and self.options.backup_request_ms > 0:
+            cntl.backup_request_ms = self.options.backup_request_ms
+        cntl._setup_call(self, method_full_name, request, response, done)
+        try:
+            cntl._request_payload = self._protocol.serialize_request(
+                request, cntl
+            )
+        except Exception as e:
+            cntl.set_failed(errors.EREQUEST, f"fail to serialize request: {e}")
+            cntl._end_rpc_locked_or_not(locked=False)
+            return
+        cntl.issue_rpc()
+        if done is None:
+            cntl.join()
+
+    def call(self, method_full_name: str, request, response_class,
+             timeout_ms: Optional[float] = None, **cntl_kwargs):
+        """Convenience sync call returning (controller, response)."""
+        cntl = Controller()
+        if timeout_ms is not None:
+            cntl.timeout_ms = timeout_ms
+        for k, v in cntl_kwargs.items():
+            setattr(cntl, k, v)
+        response = response_class() if response_class is not None else None
+        self.call_method(method_full_name, cntl, request, response)
+        return cntl, response

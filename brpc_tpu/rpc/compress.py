@@ -1,0 +1,49 @@
+"""Compression registry — counterpart of brpc/compress.{h,cpp} +
+policy/gzip_compress.cpp (registered in global.cpp:379-391). gzip and zlib
+via the stdlib; the registry is pluggable like the reference's.
+"""
+from __future__ import annotations
+
+import zlib
+from typing import Callable, Dict, Tuple
+
+# compress_type codes match controller.py / rpc_meta.proto
+COMPRESS_NONE = 0
+COMPRESS_GZIP = 1
+COMPRESS_ZLIB = 2
+
+_handlers: Dict[int, Tuple[Callable[[bytes], bytes], Callable[[bytes], bytes]]] = {}
+
+
+def register_compress(ctype: int, compress_fn, decompress_fn):
+    _handlers[ctype] = (compress_fn, decompress_fn)
+
+
+def compress(data: bytes, ctype: int) -> bytes:
+    if ctype == COMPRESS_NONE or not data:
+        return data
+    pair = _handlers.get(ctype)
+    if pair is None:
+        raise ValueError(f"unknown compress type {ctype}")
+    return pair[0](data)
+
+
+def decompress(data: bytes, ctype: int) -> bytes:
+    if ctype == COMPRESS_NONE or not data:
+        return data
+    pair = _handlers.get(ctype)
+    if pair is None:
+        raise ValueError(f"unknown compress type {ctype}")
+    return pair[1](data)
+
+
+register_compress(
+    COMPRESS_GZIP,
+    lambda d: zlib.compress(d, 6, wbits=31),
+    lambda d: zlib.decompress(d, wbits=31),
+)
+register_compress(
+    COMPRESS_ZLIB,
+    lambda d: zlib.compress(d, 6),
+    lambda d: zlib.decompress(d),
+)

@@ -1,0 +1,276 @@
+"""Controller — the per-RPC god object (both sides).
+
+Counterpart of brpc::Controller (/root/reference/src/brpc/controller.{h,cpp}):
+client side it carries timeout/retry/backup state and drives IssueRPC →
+OnVersionedRPCReturned; server side it exposes peer identity, attachments,
+and set_failed. The CallId is a ranged bthread_id (controller.h:655-664):
+version v+1+nretry addresses attempt nretry, so a late response from an
+abandoned attempt and the live attempt cannot be confused, and
+timeout/socket-failure/response delivery all serialize through the id lock
+(the on_error path of id.py).
+
+Tensor-native extension: request/response attachments are IOBufs, so
+jax.Arrays ride them zero-copy until a host wire boundary
+(butil/iobuf.py DEVICE blocks).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from brpc_tpu import bvar
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.bthread import timer_add, timer_del
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+
+_client_count = bvar.Adder("rpc_client_calls")
+_backup_count = bvar.Adder("rpc_backup_requests")
+_retry_count = bvar.Adder("rpc_retries")
+
+COMPRESS_NONE = 0
+COMPRESS_GZIP = 1
+COMPRESS_ZLIB = 2
+
+
+class RetryPolicy:
+    """Pluggable retry decision (brpc::RetryPolicy, retry_policy.h)."""
+
+    def do_retry(self, controller: "Controller") -> bool:
+        # Default: retry connection-level failures, never timeouts/app errors
+        # (policy of retry_policy.cpp DefaultRetryPolicy).
+        return controller.error_code in (
+            errors.EFAILEDSOCKET,
+            errors.ECLOSE,
+            errors.ETIMEDOUT,  # connect timeout, not RPC deadline
+            errors.EEOF,
+        )
+
+
+DEFAULT_RETRY_POLICY = RetryPolicy()
+
+
+class Controller:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        # shared
+        self.error_code_value = 0
+        self.error_text_value = ""
+        self.request_attachment = IOBuf()
+        self.response_attachment = IOBuf()
+        self.compress_type = COMPRESS_NONE
+        self.log_id = 0
+        self.remote_side = None
+        self.local_side = None
+        # client
+        self.timeout_ms: Optional[float] = None
+        self.max_retry: int = 3
+        self.backup_request_ms: Optional[float] = None
+        self.retry_policy: RetryPolicy = DEFAULT_RETRY_POLICY
+        self.retried_count = 0
+        self.has_backup_request = False
+        self.latency_us = 0.0
+        self._call_id = 0
+        self._start_time = 0.0
+        self._deadline: Optional[float] = None
+        self._timeout_timer = None
+        self._backup_timer = None
+        self._done: Optional[Callable] = None
+        self._ended = threading.Event()
+        self._request = None
+        self._response = None
+        self._request_payload = b""
+        self._method_full_name = ""
+        self._channel = None
+        self._current_sock = None
+        self._single_server_sid = None
+        self._lb = None
+        self._excluded_sids = set()
+        self._accessed_sids = set()
+        # server
+        self.server = None
+        self.method_name = ""
+        self.service_name = ""
+        self.close_connection_flag = False
+        self.server_start_time = 0.0
+        self._server_meta = None
+        self.auth_context = None
+        self.session_local_data = None
+        # tracing
+        self.trace_id = 0
+        self.span_id = 0
+        self.span = None
+
+    # -- error state -------------------------------------------------------
+    @property
+    def error_code(self) -> int:
+        return self.error_code_value
+
+    @property
+    def error_text(self) -> str:
+        return self.error_text_value
+
+    def failed(self) -> bool:
+        return self.error_code_value != 0
+
+    def set_failed(self, error_code: int, error_text: str = ""):
+        self.error_code_value = error_code or errors.EINVAL
+        self.error_text_value = error_text or errors.berror(self.error_code_value)
+
+    def close_connection(self, reason: str = ""):
+        self.close_connection_flag = True
+
+    # -- client call lifecycle --------------------------------------------
+    @property
+    def call_id(self) -> int:
+        return self._call_id
+
+    def _setup_call(self, channel, method_full_name: str, request, response,
+                    done: Optional[Callable]):
+        self._channel = channel
+        self._method_full_name = method_full_name
+        self._request = request
+        self._response = response
+        self._done = done
+        self._start_time = time.monotonic()
+        if self.timeout_ms is not None and self.timeout_ms >= 0:
+            self._deadline = self._start_time + self.timeout_ms / 1000.0
+        # range = max_retry+2: version v is the "collective" id, v+1+k is
+        # attempt k (controller.h:655-664).
+        self._call_id = bthread_id.create_ranged(
+            self, self._on_error, self.max_retry + 2
+        )
+        _client_count.update(1)
+
+    def current_attempt_id(self) -> int:
+        return self._call_id + 1 + self.retried_count
+
+    def issue_rpc(self):
+        """LB select → socket → pack → write → arm timers
+        (Controller::IssueRPC, controller.cpp:1010-1207)."""
+        channel = self._channel
+        sock, rc = channel._select_socket(self)
+        if rc != 0 or sock is None:
+            self.set_failed(rc or errors.EFAILEDSOCKET, "no usable server")
+            self._end_rpc_locked_or_not(locked=False)
+            return
+        self._current_sock = sock
+        self._accessed_sids.add(sock.socket_id)
+        self.remote_side = sock.remote_side
+        attempt_cid = self.current_attempt_id()
+        packet = channel._protocol.pack_request(
+            self._request_payload, self, attempt_cid
+        )
+        rc = sock.write(packet, id_wait=attempt_cid)
+        if rc != 0:
+            return  # id_wait already errored via socket failure path
+        if self._deadline is not None and self._timeout_timer is None:
+            remain = max(0.0, self._deadline - time.monotonic())
+            self._timeout_timer = timer_add(remain, self._handle_timeout,
+                                            self._call_id)
+        if (self.backup_request_ms is not None
+                and self.retried_count == 0
+                and self._backup_timer is None):
+            self._backup_timer = timer_add(
+                self.backup_request_ms / 1000.0, self._handle_backup,
+                self._call_id
+            )
+
+    # -- timer callbacks (run on timer thread) -----------------------------
+    def _handle_timeout(self, cid: int):
+        bthread_id.error(cid, errors.ERPCTIMEDOUT, "deadline exceeded")
+
+    def _handle_backup(self, cid: int):
+        bthread_id.error(cid, errors.EBACKUPREQUEST, "")
+
+    # -- completion state machine (runs under the id lock) -----------------
+    def _on_error(self, idv: int, data, error_code: int, error_text: str):
+        """on_error of the CallId — the OnVersionedRPCReturned analog
+        (controller.cpp:554-640). Called with the id LOCKED; must unlock or
+        destroy."""
+        if error_code == errors.EBACKUPREQUEST:
+            # Fire a backup attempt; the original stays in flight.
+            if self.retried_count < self.max_retry:
+                self.retried_count += 1
+                self.has_backup_request = True
+                _backup_count.update(1)
+                self.issue_rpc()
+            bthread_id.unlock(idv)
+            return
+        self.set_failed(error_code, error_text)
+        if (error_code != errors.ERPCTIMEDOUT
+                and self.retried_count < self.max_retry
+                and self.retry_policy.do_retry(self)
+                and (self._deadline is None
+                     or time.monotonic() < self._deadline)):
+            self.retried_count += 1
+            _retry_count.update(1)
+            if self._current_sock is not None:
+                self._excluded_sids.add(self._current_sock.socket_id)
+            self.error_code_value = 0
+            self.error_text_value = ""
+            self.issue_rpc()
+            bthread_id.unlock(idv)
+            return
+        self._end_rpc_locked_or_not(locked=True)
+
+    def _on_response(self, meta, payload: bytes, attachment: IOBuf, sock):
+        """Called by the protocol's process_response with the id locked."""
+        if meta.response.error_code != 0:
+            self.set_failed(meta.response.error_code,
+                            meta.response.error_text)
+        else:
+            try:
+                if self._response is not None and payload:
+                    self._response.ParseFromString(payload)
+                self.response_attachment = attachment
+            except Exception as e:
+                self.set_failed(errors.EREQUEST, f"fail to parse response: {e}")
+        self._end_rpc_locked_or_not(locked=True)
+
+    def _end_rpc_locked_or_not(self, locked: bool):
+        """Common tail: cancel timers, feed the LB, run done, wake joiner."""
+        if self._timeout_timer is not None:
+            timer_del(self._timeout_timer)
+            self._timeout_timer = None
+        if self._backup_timer is not None:
+            timer_del(self._backup_timer)
+            self._backup_timer = None
+        self.latency_us = (time.monotonic() - self._start_time) * 1e6
+        for sid in self._accessed_sids:
+            from brpc_tpu.rpc.socket import Socket
+
+            s = Socket.address(sid)
+            if s is not None:
+                s.remove_inflight(self._call_id)
+                for k in range(self.max_retry + 1):
+                    s.remove_inflight(self._call_id + 1 + k)
+        if self._lb is not None and self._current_sock is not None:
+            try:
+                self._lb.feedback(self._current_sock.socket_id,
+                                  self.error_code_value, self.latency_us)
+            except Exception:
+                pass
+        if self._channel is not None:
+            self._channel._on_rpc_end(self)
+        cid = self._call_id
+        if locked:
+            bthread_id.unlock_and_destroy(cid)
+        else:
+            try:
+                bthread_id.lock(cid)
+                bthread_id.unlock_and_destroy(cid)
+            except KeyError:
+                pass
+        done = self._done
+        self._ended.set()
+        if done is not None:
+            done(self)
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for completion (synchronous CallMethod tail — the
+        bthread_id_join of channel.cpp)."""
+        return self._ended.wait(timeout)

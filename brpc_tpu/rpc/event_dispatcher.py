@@ -1,0 +1,170 @@
+"""EventDispatcher — the epoll loop(s) feeding the RPC stack.
+
+Counterpart of brpc::EventDispatcher
+(/root/reference/src/brpc/event_dispatcher.{h,cpp},
+event_dispatcher_epoll.cpp:249-262): N dedicated loops; readable fds hand
+off to their consumer (Socket input event) which runs user work in scheduler
+tasks, never on the loop thread; EPOLLOUT waiters register one-shot wakeups
+(AddEpollOut) used by connects and KeepWrite.
+
+Registration calls arrive from any thread, so they queue through a self-pipe
+(the loop's selector is only touched by the loop thread).
+"""
+from __future__ import annotations
+
+import os
+import selectors
+import threading
+from typing import Callable, Dict, List, Optional
+
+from brpc_tpu.butil import flags
+
+flags.define_int("event_dispatcher_num", 1,
+                 "number of event dispatcher loops (event_dispatcher.cpp:30)")
+
+
+class EventDispatcher:
+    def __init__(self):
+        self._selector = selectors.DefaultSelector()
+        self._wakeup_r, self._wakeup_w = os.pipe()
+        os.set_blocking(self._wakeup_r, False)
+        self._selector.register(self._wakeup_r, selectors.EVENT_READ, None)
+        self._pending: List = []
+        self._pending_lock = threading.Lock()
+        self._read_consumers: Dict[int, Callable] = {}
+        self._write_consumers: Dict[int, Callable] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._started_lock = threading.Lock()
+
+    # -- public (thread-safe) ---------------------------------------------
+    def add_consumer(self, fd: int, on_readable: Callable[[], None]):
+        """Register fd for read events (AddConsumer, event_dispatcher.h:61).
+        on_readable() is invoked on the loop thread and must only schedule."""
+        self._enqueue(("add_read", fd, on_readable))
+
+    def add_epollout(self, fd: int, on_writable: Callable[[], None]):
+        """One-shot writable wakeup (AddEpollOut, event_dispatcher.h:80)."""
+        self._enqueue(("add_write", fd, on_writable))
+
+    def remove_consumer(self, fd: int):
+        self._enqueue(("remove", fd, None))
+
+    def start(self):
+        with self._started_lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="event_dispatcher", daemon=True
+                )
+                self._thread.start()
+
+    def stop(self):
+        self._stop = True
+        self._wake()
+
+    def join(self, timeout: float = 2.0):
+        if self._thread:
+            self._thread.join(timeout)
+
+    # -- internals ---------------------------------------------------------
+    def _enqueue(self, op):
+        self.start()
+        with self._pending_lock:
+            self._pending.append(op)
+        self._wake()
+
+    def _wake(self):
+        try:
+            os.write(self._wakeup_w, b"x")
+        except OSError:
+            pass
+
+    def _apply_pending(self):
+        with self._pending_lock:
+            ops, self._pending = self._pending, []
+        for kind, fd, cb in ops:
+            try:
+                if kind == "add_read":
+                    self._read_consumers[fd] = cb
+                    self._reregister(fd)
+                elif kind == "add_write":
+                    self._write_consumers[fd] = cb
+                    self._reregister(fd)
+                elif kind == "remove":
+                    self._read_consumers.pop(fd, None)
+                    self._write_consumers.pop(fd, None)
+                    try:
+                        self._selector.unregister(fd)
+                    except (KeyError, ValueError, OSError):
+                        pass
+            except (ValueError, OSError):
+                # fd already closed — consumer cleanup races are benign
+                self._read_consumers.pop(fd, None)
+                self._write_consumers.pop(fd, None)
+
+    def _reregister(self, fd: int):
+        events = 0
+        if fd in self._read_consumers:
+            events |= selectors.EVENT_READ
+        if fd in self._write_consumers:
+            events |= selectors.EVENT_WRITE
+        try:
+            self._selector.modify(fd, events, None)
+        except KeyError:
+            self._selector.register(fd, events, None)
+
+    def _run(self):
+        while not self._stop:
+            self._apply_pending()
+            try:
+                events = self._selector.select(timeout=0.5)
+            except OSError:
+                continue
+            for key, mask in events:
+                fd = key.fd
+                if fd == self._wakeup_r:
+                    try:
+                        os.read(self._wakeup_r, 4096)
+                    except OSError:
+                        pass
+                    continue
+                if mask & selectors.EVENT_WRITE:
+                    cb = self._write_consumers.pop(fd, None)
+                    if cb is not None:
+                        try:
+                            self._reregister(fd)
+                        except (KeyError, ValueError, OSError):
+                            pass
+                        try:
+                            cb()
+                        except Exception:
+                            _log_cb_error()
+                if mask & selectors.EVENT_READ:
+                    cb = self._read_consumers.get(fd)
+                    if cb is not None:
+                        try:
+                            cb()
+                        except Exception:
+                            _log_cb_error()
+
+
+def _log_cb_error():
+    import logging
+
+    logging.getLogger(__name__).exception("dispatcher consumer raised")
+
+
+_dispatchers: List[EventDispatcher] = []
+_dispatchers_lock = threading.Lock()
+
+
+def get_global_dispatcher(fd_hint: int = 0) -> EventDispatcher:
+    """fd-hashed pick among -event_dispatcher_num loops
+    (GetGlobalEventDispatcher, event_dispatcher.cpp)."""
+    with _dispatchers_lock:
+        if not _dispatchers:
+            for _ in range(max(1, flags.get_flag("event_dispatcher_num"))):
+                d = EventDispatcher()
+                d.start()
+                _dispatchers.append(d)
+    return _dispatchers[fd_hint % len(_dispatchers)]

@@ -1,0 +1,6 @@
+"""HTTP/1.1 protocol — placeholder registration point.
+
+Counterpart of policy/http_rpc_protocol.cpp; the full implementation
+(RESTful routing + builtin console pages + pb-over-http) registers here.
+"""
+# Filled in by the builtin-console milestone; see http_impl.py once present.

@@ -1,0 +1,122 @@
+"""InputMessenger — the per-socket read/cut/dispatch loop.
+
+Counterpart of brpc::InputMessenger
+(/root/reference/src/brpc/input_messenger.{h,cpp}): reads into the socket's
+IOPortal, tries each registered protocol's parse() in order until one
+matches (then remembers the match for the connection's lifetime —
+input_messenger.h:33-154), and processes every cut message in a fresh
+scheduler task so the read loop never blocks behind user code
+(input_messenger.cpp:331).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from brpc_tpu import bvar
+from brpc_tpu.bthread import start_background
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.protocol import ParseError, Protocol
+from brpc_tpu.rpc.socket import Socket, _in_bytes
+
+_msg_count = bvar.Adder("input_messenger_messages")
+
+
+class InputMessenger:
+    def __init__(self, protocols: Optional[List[Protocol]] = None, arg=None):
+        # ordered handler list (AddHandler, input_messenger.h:60); arg is
+        # delivered to process_* with each message (the Server on the server
+        # side, None on the client side), mirroring InputMessageHandler.arg.
+        self._protocols = list(protocols or [])
+        self.arg = arg
+
+    def add_handler(self, protocol: Protocol):
+        self._protocols.append(protocol)
+
+    def on_new_messages(self, sock: Socket):
+        """Entry installed as the socket's edge-triggered handler."""
+        portal = sock.read_portal
+        while not sock.failed():
+            fd = sock.fd()
+            if fd is None:
+                return
+            try:
+                n = portal.append_from_socket(fd, 262144)
+            except (BlockingIOError, InterruptedError):
+                n = -1
+            except OSError as e:
+                sock.set_failed(e.errno or errors.EFAILEDSOCKET,
+                                f"read failed: {e}")
+                return
+            if n == 0:  # EOF
+                if portal.empty():
+                    sock.set_failed(errors.ECLOSE, "remote closed")
+                    return
+            elif n > 0:
+                _in_bytes.update(n)
+            # Cut every complete message currently buffered.
+            progressed = self._cut_and_process(sock, read_eof=(n == 0))
+            if n == 0:
+                sock.set_failed(errors.ECLOSE, "remote closed")
+                return
+            if n < 0 and not progressed:
+                return  # would-block and nothing parseable: wait for epoll
+            if n < 0:
+                # parsed something; check again for leftover partial data
+                if not portal.empty():
+                    continue
+                return
+
+    def _cut_and_process(self, sock: Socket, read_eof: bool) -> bool:
+        portal = sock.read_portal
+        progressed = False
+        while not portal.empty():
+            protocol = sock.matched_protocol
+            result = None
+            if protocol is not None:
+                result = protocol.parse(portal, sock, read_eof, None)
+            else:
+                # First message: try every handler in order
+                # (input_messenger.cpp CutInputMessage).
+                for p in self._protocols:
+                    r = p.parse(portal, sock, read_eof, None)
+                    if r.error == ParseError.TRY_OTHERS:
+                        continue
+                    result = r
+                    if r.error in (ParseError.OK, ParseError.NOT_ENOUGH_DATA):
+                        sock.matched_protocol = p
+                        protocol = p
+                    break
+                if result is None:
+                    sock.set_failed(errors.EPROTONOTSUP,
+                                    "no protocol matched input")
+                    return progressed
+            if result.error == ParseError.OK:
+                progressed = True
+                _msg_count.update(1)
+                msg = result.message
+                msg.socket = sock
+                msg.protocol = protocol
+                msg.arg = self.arg
+                # Each message processed in a new task; the read loop
+                # continues cutting (input_messenger.cpp:331).
+                process = (protocol.process_request
+                           if getattr(msg, "is_request", True)
+                           else protocol.process_response)
+                if process is None:
+                    continue
+                start_background(self._process_safely, process, msg)
+            elif result.error == ParseError.NOT_ENOUGH_DATA:
+                return progressed
+            else:
+                sock.set_failed(errors.EREQUEST, "protocol parse error")
+                return progressed
+        return progressed
+
+    @staticmethod
+    def _process_safely(process, msg):
+        try:
+            process(msg)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception("message processing raised")

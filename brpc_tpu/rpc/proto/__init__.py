@@ -1,0 +1,1 @@
+from brpc_tpu.rpc.proto import echo_pb2, rpc_meta_pb2  # noqa: F401

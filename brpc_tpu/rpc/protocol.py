@@ -1,0 +1,132 @@
+"""Protocol — the pluggable wire-format registry.
+
+Counterpart of brpc::Protocol (/root/reference/src/brpc/protocol.h:77-172)
+and its registry (protocol.cpp, populated by global.cpp:396-581): a protocol
+is a bundle of parse / serialize_request / pack_request / process_request /
+process_response functions registered under a ProtocolType. A server port
+tries every registered server-side protocol on the first bytes of a
+connection (multi-protocol port); a channel picks one by name.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Callable, Dict, List, Optional
+
+
+class ProtocolType(IntEnum):
+    UNKNOWN = 0
+    TPU_STD = 1  # framed pb-meta protocol (baidu_std's role)
+    STREAMING = 2  # stream frames (streaming_rpc's role)
+    HTTP = 3  # HTTP/1.1 (+RESTful, pb-over-http)
+    H2 = 4  # reserved
+    REDIS = 5
+    MEMCACHE = 6
+    THRIFT = 7
+    ESP = 8
+    TENSOR = 9  # raw tensor-transport frames (ICI path)
+
+
+class ParseError(IntEnum):
+    OK = 0
+    NOT_ENOUGH_DATA = 1  # keep reading
+    TRY_OTHERS = 2  # magic mismatch: not this protocol
+    ERROR = 3  # corrupt stream: close the connection
+
+
+@dataclass
+class ParseResult:
+    error: ParseError
+    message: Optional[object] = None  # an InputMessageBase when OK
+
+    @classmethod
+    def ok(cls, message) -> "ParseResult":
+        return cls(ParseError.OK, message)
+
+    @classmethod
+    def not_enough(cls) -> "ParseResult":
+        return cls(ParseError.NOT_ENOUGH_DATA)
+
+    @classmethod
+    def try_others(cls) -> "ParseResult":
+        return cls(ParseError.TRY_OTHERS)
+
+    @classmethod
+    def error_(cls) -> "ParseResult":
+        return cls(ParseError.ERROR)
+
+
+class InputMessageBase:
+    """A cut-out wire message awaiting processing (input_messenger.h:33)."""
+
+    __slots__ = ("socket", "protocol", "arg")
+
+    def __init__(self, socket=None, protocol: "Protocol" = None):
+        self.socket = socket
+        self.protocol = protocol
+        self.arg = None
+
+
+@dataclass
+class Protocol:
+    """Function bundle (protocol.h:77-172). Server-side protocols provide
+    parse+process_request; client-side provide serialize/pack/process_response.
+    """
+
+    name: str
+    type: ProtocolType
+    # parse(iobuf, socket, read_eof, arg) -> ParseResult
+    parse: Callable = None
+    # serialize_request(request, controller) -> bytes payload (or None on fail)
+    serialize_request: Callable = None
+    # pack_request(payload_bytes, controller, correlation_id) -> IOBuf packet
+    pack_request: Callable = None
+    # process_request(InputMessageBase) -> None   [server]
+    process_request: Callable = None
+    # process_response(InputMessageBase) -> None  [client]
+    process_response: Callable = None
+    # verify(InputMessageBase) -> bool            [server auth hook]
+    verify: Callable = None
+    supported_connection_types: tuple = ("single", "pooled", "short")
+    support_client: bool = True
+    support_server: bool = True
+    extra: dict = field(default_factory=dict)
+
+
+_protocols: Dict[ProtocolType, Protocol] = {}
+_lock = threading.Lock()
+
+
+def register_protocol(protocol: Protocol):
+    with _lock:
+        if protocol.type in _protocols:
+            raise ValueError(f"protocol {protocol.type} already registered")
+        _protocols[protocol.type] = protocol
+
+
+def find_protocol(ptype: ProtocolType) -> Optional[Protocol]:
+    return _protocols.get(ptype)
+
+
+def find_protocol_by_name(name: str) -> Optional[Protocol]:
+    for p in _protocols.values():
+        if p.name == name:
+            return p
+    return None
+
+
+def list_server_protocols() -> List[Protocol]:
+    """Protocols a server port tries, in registration order."""
+    return [p for p in _protocols.values() if p.support_server and p.parse]
+
+
+def globally_initialize():
+    """GlobalInitializeOrDie's role (global.cpp:354-606): register every
+    built-in protocol / LB / NS / compressor exactly once."""
+    with _lock:
+        if _protocols:
+            return
+    from brpc_tpu.rpc import tpu_std_protocol  # noqa: F401 (self-registers)
+    from brpc_tpu.rpc import http_protocol  # noqa: F401
+    from brpc_tpu.rpc import streaming_protocol  # noqa: F401

@@ -1,0 +1,190 @@
+"""Server — multi-protocol RPC server.
+
+Counterpart of brpc::Server (/root/reference/src/brpc/server.{h,cpp}):
+AddService builds the (service, method) map with a MethodStatus per method
+(server.cpp:705-719); Start listens, builds one InputMessenger carrying a
+handler per enabled protocol (multi-protocol port, server.cpp:576), starts
+the Acceptor (StartInternal, server.cpp:750+), registers builtin services
+unless disabled (server.cpp:468-563,949), and exposes default process
+variables; Stop/Join is graceful (server.h:426-441).
+"""
+from __future__ import annotations
+
+import socket as pysocket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+from brpc_tpu import bvar
+from brpc_tpu.bthread import get_task_control
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.rpc.acceptor import Acceptor
+from brpc_tpu.rpc.input_messenger import InputMessenger
+from brpc_tpu.rpc.method_status import MethodStatus
+from brpc_tpu.rpc.protocol import globally_initialize, list_server_protocols
+from brpc_tpu.rpc.service import MethodInfo, Service
+
+
+@dataclass
+class ServerOptions:
+    """Mirror of brpc::ServerOptions (server.h:59-285), trimmed to the
+    implemented surface."""
+
+    num_threads: int = 8
+    max_concurrency: int = 0  # 0 = unlimited; else per-server limiter
+    method_max_concurrency: Dict[str, int] = field(default_factory=dict)
+    idle_timeout_s: float = -1
+    has_builtin_services: bool = True
+    auth: Optional[object] = None  # Authenticator
+    interceptor: Optional[Callable] = None  # (cntl)->(ok, code, text)
+    server_info_name: str = ""
+    session_local_data_factory: Optional[Callable] = None
+    enabled_protocols: Tuple[str, ...] = ()  # empty = all registered
+
+
+class _ConstLimiter:
+    """'constant' concurrency limiter (policy/auto: see limiter module)."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def on_requested(self, current: int) -> bool:
+        return self.limit <= 0 or current < self.limit
+
+    def on_response(self, error_code: int, latency_us: float):
+        pass
+
+
+class Server:
+    def __init__(self, options: Optional[ServerOptions] = None):
+        self.options = options or ServerOptions()
+        self._services: Dict[str, Service] = {}
+        # full method map: (service, method) -> (svc obj, MethodInfo, MethodStatus)
+        self._methods: Dict[Tuple[str, str], Tuple[Service, MethodInfo, MethodStatus]] = {}
+        self._listen_fd: Optional[pysocket.socket] = None
+        self._acceptor: Optional[Acceptor] = None
+        self._messenger: Optional[InputMessenger] = None
+        self.listen_endpoint: Optional[EndPoint] = None
+        self._started = False
+        self._stopped_event = threading.Event()
+        self.start_time = 0.0
+        self.interceptor = self.options.interceptor
+        self.auth = self.options.auth
+        self._lock = threading.Lock()
+
+    # -- service registry --------------------------------------------------
+    def add_service(self, service: Service) -> int:
+        name = service.service_name()
+        with self._lock:
+            if self._started:
+                return -1  # services must be added before Start (server.h)
+            if name in self._services:
+                return -1
+            self._services[name] = service
+            for mname, minfo in service.methods().items():
+                full = f"{name}.{mname}"
+                limit = self.options.method_max_concurrency.get(full, 0)
+                limiter = _ConstLimiter(limit) if limit > 0 else None
+                if limiter is None and self.options.max_concurrency > 0:
+                    limiter = _ConstLimiter(self.options.max_concurrency)
+                status = MethodStatus(full, limiter)
+                self._methods[(name, mname)] = (service, minfo, status)
+        return 0
+
+    def remove_service(self, service: Service) -> int:
+        name = service.service_name()
+        with self._lock:
+            if self._started or name not in self._services:
+                return -1
+            del self._services[name]
+            for key in [k for k in self._methods if k[0] == name]:
+                del self._methods[key]
+        return 0
+
+    def find_service(self, name: str) -> Optional[Service]:
+        return self._services.get(name)
+
+    def find_method(self, service_name: str, method_name: str):
+        return self._methods.get((service_name, method_name))
+
+    def method_statuses(self) -> Dict[str, MethodStatus]:
+        return {f"{k[0]}.{k[1]}": v[2] for k, v in self._methods.items()}
+
+    @property
+    def service_count(self) -> int:
+        return len(self._services)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, address="127.0.0.1:0") -> int:
+        """StartInternal analog (server.cpp:750+). address: 'ip:port',
+        EndPoint, or bare port int (0 = ephemeral)."""
+        globally_initialize()
+        if isinstance(address, int):
+            ep = EndPoint("127.0.0.1", address)
+        elif isinstance(address, EndPoint):
+            ep = address
+        else:
+            ep = EndPoint.parse(address)
+        with self._lock:
+            if self._started:
+                return -1
+            get_task_control(self.options.num_threads)
+            if self.options.has_builtin_services:
+                from brpc_tpu.builtin import register_builtin_services
+
+                register_builtin_services(self)
+            lfd = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
+            lfd.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
+            try:
+                lfd.bind((ep.ip, ep.port))
+            except OSError:
+                lfd.close()
+                return -1
+            lfd.listen(1024)
+            self.listen_endpoint = EndPoint(ep.ip, lfd.getsockname()[1])
+            self._listen_fd = lfd
+            protocols = list_server_protocols()
+            if self.options.enabled_protocols:
+                protocols = [p for p in protocols
+                             if p.name in self.options.enabled_protocols]
+            self._messenger = InputMessenger(protocols, arg=self)
+            self._acceptor = Acceptor(self._messenger)
+            self._acceptor.start_accept(lfd)
+            self._started = True
+            self.start_time = time.time()
+        bvar.expose_default_variables()
+        return 0
+
+    def stop(self) -> int:
+        """Graceful stop: no new connections, existing RPCs drain."""
+        with self._lock:
+            if not self._started:
+                return -1
+            self._started = False
+        if self._acceptor is not None:
+            self._acceptor.stop_accept()
+        self._stopped_event.set()
+        return 0
+
+    def join(self, timeout: Optional[float] = None) -> int:
+        self._stopped_event.wait(timeout)
+        return 0
+
+    def run_until_asked_to_quit(self):
+        try:
+            while self._started:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            self.stop()
+            self.join()
+
+    @property
+    def is_running(self) -> bool:
+        return self._started
+
+    def connection_count(self) -> int:
+        return self._acceptor.connection_count() if self._acceptor else 0
+
+    def list_connections(self):
+        return self._acceptor.list_connections() if self._acceptor else []

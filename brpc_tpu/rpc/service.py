@@ -1,0 +1,81 @@
+"""Service & method registry — how user code exposes RPC methods.
+
+The reference builds its method maps from protobuf Service descriptors
+(server.h:343 AddService + details/method_status); here a Service subclass
+declares methods with @rpc_method(Request, Response), yielding the same
+(service_name, method_name) -> (request class, response class, handler)
+map, with handlers keeping brpc's CallMethod signature:
+
+    @rpc_method(EchoRequest, EchoResponse)
+    def Echo(self, controller, request, response, done):
+        response.message = request.message
+        done()
+
+`done` is the response-sending closure (the SendRpcResponse closure of
+baidu_rpc_protocol.cpp:507); ClosureGuard mirrors brpc::ClosureGuard.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple, Optional, Type
+
+
+class MethodInfo(NamedTuple):
+    name: str
+    request_class: Type
+    response_class: Type
+    handler: Callable  # bound later: handler(self, cntl, req, res, done)
+
+
+def rpc_method(request_class: Type, response_class: Type):
+    """Mark a Service method as an RPC method."""
+
+    def deco(fn):
+        fn.__rpc_method__ = (request_class, response_class)
+        return fn
+
+    return deco
+
+
+class Service:
+    """Base class; service name defaults to the class name."""
+
+    @classmethod
+    def service_name(cls) -> str:
+        return getattr(cls, "SERVICE_NAME", cls.__name__)
+
+    @classmethod
+    def methods(cls) -> Dict[str, MethodInfo]:
+        out = {}
+        for attr in dir(cls):
+            fn = getattr(cls, attr, None)
+            info = getattr(fn, "__rpc_method__", None)
+            if info is not None:
+                out[attr] = MethodInfo(attr, info[0], info[1], fn)
+        return out
+
+
+class ClosureGuard:
+    """Runs done() on exit unless released (brpc::ClosureGuard)."""
+
+    def __init__(self, done: Optional[Callable]):
+        self._done = done
+
+    def release(self):
+        d, self._done = self._done, None
+        return d
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._done is not None:
+            self._done()
+            self._done = None
+
+    def __del__(self):
+        if self._done is not None:
+            try:
+                self._done()
+            except Exception:
+                pass
+            self._done = None

@@ -1,0 +1,5 @@
+"""Streaming RPC frames — placeholder registration point.
+
+Counterpart of policy/streaming_rpc_protocol.cpp; filled by the streaming
+milestone (stream.py).
+"""

@@ -1,0 +1,225 @@
+"""End-to-end RPC tests over loopback — the minimum slice of SURVEY.md
+section 7 stage 4, shaped after brpc_server_unittest.cpp:168-417 /
+brpc_channel_unittest.cpp: client and server in one process over 127.0.0.1.
+"""
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        if request.code:
+            cntl.set_failed(request.code, "requested failure")
+            done()
+            return
+        if request.sleep_us:
+            time.sleep(request.sleep_us / 1e6)
+        response.message = request.message
+        # echo the attachment back (brpc echo example behavior)
+        cntl.response_attachment.append(cntl.request_attachment)
+        done()
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4))
+    assert srv.add_service(EchoService()) == 0
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+    srv.join(1)
+
+
+@pytest.fixture(scope="module")
+def channel(server):
+    ch = rpc.Channel()
+    assert ch.init(str(server.listen_endpoint)) == 0
+    return ch
+
+
+def test_sync_echo(channel):
+    cntl, resp = channel.call(
+        "EchoService.Echo", echo_pb2.EchoRequest(message="hello tpu"),
+        echo_pb2.EchoResponse,
+    )
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == "hello tpu"
+    assert cntl.latency_us > 0
+
+
+def test_many_sequential(channel):
+    for i in range(50):
+        cntl, resp = channel.call(
+            "EchoService.Echo", echo_pb2.EchoRequest(message=f"m{i}"),
+            echo_pb2.EchoResponse,
+        )
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == f"m{i}"
+
+
+def test_async_echo(channel):
+    done_ev = threading.Event()
+    results = {}
+
+    def on_done(cntl):
+        results["failed"] = cntl.failed()
+        done_ev.set()
+
+    cntl = rpc.Controller()
+    resp = echo_pb2.EchoResponse()
+    channel.call_method(
+        "EchoService.Echo", cntl,
+        echo_pb2.EchoRequest(message="async"), resp, on_done,
+    )
+    assert done_ev.wait(5)
+    assert results["failed"] is False
+    assert resp.message == "async"
+
+
+def test_concurrent_calls(channel):
+    n = 20
+    failures = []
+    done = threading.Event()
+    remaining = [n]
+    lock = threading.Lock()
+
+    def one(i):
+        cntl, resp = channel.call(
+            "EchoService.Echo", echo_pb2.EchoRequest(message=f"c{i}"),
+            echo_pb2.EchoResponse, timeout_ms=5000,
+        )
+        with lock:
+            if cntl.failed() or resp.message != f"c{i}":
+                failures.append((i, cntl.error_code, cntl.error_text))
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                done.set()
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    assert done.wait(20)
+    for t in threads:
+        t.join(5)
+    assert not failures, failures
+
+
+def test_attachment_roundtrip(channel):
+    cntl = rpc.Controller()
+    cntl.request_attachment.append(b"tensor-bytes-here" * 100)
+    resp = echo_pb2.EchoResponse()
+    channel.call_method(
+        "EchoService.Echo", cntl, echo_pb2.EchoRequest(message="att"), resp,
+    )
+    assert not cntl.failed(), cntl.error_text
+    assert cntl.response_attachment.to_bytes() == b"tensor-bytes-here" * 100
+
+
+def test_large_payload(channel):
+    big = "x" * (1 << 20)  # 1MB message
+    cntl, resp = channel.call(
+        "EchoService.Echo", echo_pb2.EchoRequest(message=big),
+        echo_pb2.EchoResponse, timeout_ms=10000,
+    )
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == big
+
+
+def test_server_side_error_propagates(channel):
+    cntl, _ = channel.call(
+        "EchoService.Echo",
+        echo_pb2.EchoRequest(message="boom", code=errors.EPERM),
+        echo_pb2.EchoResponse,
+    )
+    assert cntl.failed()
+    assert cntl.error_code == errors.EPERM
+    assert "requested failure" in cntl.error_text
+
+
+def test_unknown_method(channel):
+    cntl, _ = channel.call(
+        "EchoService.NoSuchMethod", echo_pb2.EchoRequest(message="x"),
+        echo_pb2.EchoResponse,
+    )
+    assert cntl.error_code == errors.ENOMETHOD
+
+
+def test_unknown_service(channel):
+    cntl, _ = channel.call(
+        "NoSuchService.Echo", echo_pb2.EchoRequest(message="x"),
+        echo_pb2.EchoResponse,
+    )
+    assert cntl.error_code == errors.ENOSERVICE
+
+
+def test_rpc_timeout(channel):
+    cntl, _ = channel.call(
+        "EchoService.Echo",
+        echo_pb2.EchoRequest(message="slow", sleep_us=500_000),
+        echo_pb2.EchoResponse, timeout_ms=50,
+    )
+    assert cntl.error_code == errors.ERPCTIMEDOUT
+    # latency should be ~timeout, far below the server sleep
+    assert cntl.latency_us < 400_000
+
+
+def test_connection_refused_fails_fast():
+    ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=2000, max_retry=0))
+    assert ch.init("127.0.0.1:1") == 0  # nothing listens there
+    cntl, _ = ch.call(
+        "EchoService.Echo", echo_pb2.EchoRequest(message="x"),
+        echo_pb2.EchoResponse,
+    )
+    assert cntl.failed()
+
+
+def test_compression_roundtrip(channel):
+    from brpc_tpu.rpc.controller import COMPRESS_GZIP
+
+    cntl = rpc.Controller()
+    cntl.compress_type = COMPRESS_GZIP
+    resp = echo_pb2.EchoResponse()
+    channel.call_method(
+        "EchoService.Echo", cntl,
+        echo_pb2.EchoRequest(message="z" * 10000), resp,
+    )
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == "z" * 10000
+
+
+def test_pooled_connection_type(server):
+    ch = rpc.Channel(rpc.ChannelOptions(connection_type="pooled"))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    for i in range(5):
+        cntl, resp = ch.call(
+            "EchoService.Echo", echo_pb2.EchoRequest(message=f"p{i}"),
+            echo_pb2.EchoResponse,
+        )
+        assert not cntl.failed(), cntl.error_text
+
+
+def test_short_connection_type(server):
+    ch = rpc.Channel(rpc.ChannelOptions(connection_type="short"))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    for i in range(3):
+        cntl, resp = ch.call(
+            "EchoService.Echo", echo_pb2.EchoRequest(message=f"s{i}"),
+            echo_pb2.EchoResponse,
+        )
+        assert not cntl.failed(), cntl.error_text
+
+
+def test_method_status_tracks(server, channel):
+    statuses = server.method_statuses()
+    st = statuses["EchoService.Echo"]
+    before = st.latency_recorder.count()
+    channel.call("EchoService.Echo", echo_pb2.EchoRequest(message="t"),
+                 echo_pb2.EchoResponse)
+    assert st.latency_recorder.count() == before + 1
