@@ -223,6 +223,12 @@ class TaskControl:
             meta = victim._rq.steal()
             if meta is not None:
                 return meta
+            # Remote queues are stealable too (task_control.cpp steal_task
+            # covers _remote_rq) — otherwise tasks assigned to a worker
+            # blocked in user code would starve.
+            with victim._remote_lock:
+                if victim._remote_rq:
+                    return victim._remote_rq.popleft()
         return None
 
     def stop_and_join(self):
@@ -244,7 +250,9 @@ def get_task_control(concurrency: Optional[int] = None) -> TaskControl:
             if _control is None:
                 import os
 
-                default = min(8, (os.cpu_count() or 1) + 3)
+                # Workers here block in user code (pthread-mode bthreads),
+                # so size generously — IO/sleep-bound, not CPU-bound.
+                default = max(16, (os.cpu_count() or 1) + 3)
                 _control = TaskControl(concurrency or default)
     return _control
 

@@ -124,6 +124,10 @@ class Channel:
             main_sock = Socket.address(sid)
             if main_sock is None or main_sock.failed():
                 return None, errors.EFAILEDSOCKET
+            if (self.options.connection_type == "single"
+                    and main_sock.ensure_connected(
+                        self.options.connect_timeout_ms / 1000.0) != 0):
+                return None, errors.EFAILEDSOCKET
             return self._apply_connection_type(main_sock, cntl)
         if self._server_ep is None:
             return None, errors.EINVAL

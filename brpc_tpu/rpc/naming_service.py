@@ -1,0 +1,201 @@
+"""NamingService — pushes server lists to the load balancer.
+
+Counterpart of brpc::NamingService (/root/reference/src/brpc/naming_service.h
+:36+) with the observer pattern of LoadBalancerWithNaming
+(details/load_balancer_with_naming.{h,cpp}) and periodic re-resolution
+(periodic_naming_service.{h,cpp}, details/naming_service_thread.{h,cpp}).
+
+Implemented schemes (registered like global.cpp:354-365):
+  list://host:port,host:port[ w][,...]  — static list (test fixture double,
+                                          policy/list_naming_service)
+  file:///path                          — re-read periodically
+                                          (policy/file_naming_service)
+  dns://hostname:port                   — re-resolve periodically
+                                          (policy/domain_naming_service)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from brpc_tpu.bthread import timer_add
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.rpc.socket import Socket
+
+# (endpoint, weight, tag)
+NodeSpec = Tuple[EndPoint, int, str]
+
+
+class NamingService:
+    """One resolution strategy. refresh_interval_s <= 0 means static."""
+
+    name = "base"
+    refresh_interval_s: float = 5.0
+
+    def get_servers(self, service_path: str) -> List[NodeSpec]:
+        raise NotImplementedError
+
+
+class ListNamingService(NamingService):
+    name = "list"
+    refresh_interval_s = -1.0
+
+    def get_servers(self, service_path: str) -> List[NodeSpec]:
+        out = []
+        for part in service_path.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            weight, tag = 1, ""
+            if " " in part:
+                part, _, tag = part.partition(" ")
+                tag = tag.strip()
+                if tag.isdigit():
+                    weight, tag = int(tag), ""
+            out.append((EndPoint.parse(part), weight, tag))
+        return out
+
+
+class FileNamingService(NamingService):
+    name = "file"
+    refresh_interval_s = 2.0
+
+    def get_servers(self, service_path: str) -> List[NodeSpec]:
+        out = []
+        try:
+            with open(service_path) as f:
+                lines = f.readlines()
+        except OSError:
+            return out
+        for line in lines:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            weight, tag = 1, ""
+            if " " in line:
+                line, _, tag = line.partition(" ")
+                tag = tag.strip()
+                if tag.isdigit():
+                    weight, tag = int(tag), ""
+            try:
+                out.append((EndPoint.parse(line), weight, tag))
+            except ValueError:
+                continue
+        return out
+
+
+class DnsNamingService(NamingService):
+    name = "dns"
+    refresh_interval_s = 5.0
+
+    def get_servers(self, service_path: str) -> List[NodeSpec]:
+        import socket as pysocket
+
+        host, _, port_s = service_path.partition(":")
+        port = int(port_s or 80)
+        out = []
+        try:
+            infos = pysocket.getaddrinfo(host, port, pysocket.AF_INET,
+                                         pysocket.SOCK_STREAM)
+        except OSError:
+            return out
+        seen = set()
+        for _, _, _, _, sockaddr in infos:
+            ep = EndPoint(sockaddr[0], sockaddr[1])
+            if ep not in seen:
+                seen.add(ep)
+                out.append((ep, 1, ""))
+        return out
+
+
+_ns_registry: Dict[str, Callable[[], NamingService]] = {
+    "list": ListNamingService,
+    "file": FileNamingService,
+    "dns": DnsNamingService,
+    "http": DnsNamingService,
+}
+
+
+def register_naming_service(scheme: str, factory):
+    _ns_registry[scheme] = factory
+
+
+class NamingServiceThread:
+    """Owns the NS → LB flow: resolves periodically, diffs the node set,
+    creates/destroys client Sockets, updates the LB
+    (details/naming_service_thread.{h,cpp})."""
+
+    def __init__(self, ns: NamingService, service_path: str, lb,
+                 channel_options=None,
+                 node_filter: Optional[Callable[[NodeSpec], bool]] = None):
+        self._ns = ns
+        self._path = service_path
+        self._lb = lb
+        self._options = channel_options
+        self._filter = node_filter
+        self._sockets: Dict[EndPoint, int] = {}  # endpoint -> sid
+        self._lock = threading.Lock()
+        self._stopped = False
+        self.refresh()  # first resolution is synchronous (blocking init)
+        if ns.refresh_interval_s > 0:
+            timer_add(ns.refresh_interval_s, self._periodic)
+
+    def _periodic(self):
+        if self._stopped:
+            return
+        try:
+            self.refresh()
+        finally:
+            if not self._stopped:
+                timer_add(self._ns.refresh_interval_s, self._periodic)
+
+    def refresh(self):
+        nodes = self._ns.get_servers(self._path)
+        if self._filter is not None:
+            nodes = [n for n in nodes if self._filter(n)]
+        from brpc_tpu.rpc.channel import get_client_messenger
+
+        messenger = get_client_messenger()
+        hc = (self._options.health_check_interval_s
+              if self._options is not None else -1)
+        new_eps = {}
+        for ep, weight, tag in nodes:
+            new_eps[ep] = (weight, tag)
+        with self._lock:
+            # additions
+            for ep, (weight, tag) in new_eps.items():
+                if ep not in self._sockets:
+                    sid = Socket.create(
+                        remote_side=ep,
+                        on_edge_triggered_events=messenger.on_new_messages,
+                        health_check_interval_s=hc,
+                    )
+                    self._sockets[ep] = sid
+                    self._lb.add_server(sid, weight, tag)
+            # removals
+            for ep in [e for e in self._sockets if e not in new_eps]:
+                sid = self._sockets.pop(ep)
+                self._lb.remove_server(sid)
+                s = Socket.address(sid)
+                if s is not None:
+                    s.recycle()
+
+    def endpoints(self) -> List[EndPoint]:
+        with self._lock:
+            return list(self._sockets)
+
+    def stop(self):
+        self._stopped = True
+
+
+def start_naming_service(url: str, lb, channel_options=None,
+                         node_filter=None) -> Optional[NamingServiceThread]:
+    """Parse scheme://path, build the NS, start its thread."""
+    scheme, sep, path = url.partition("://")
+    if not sep:
+        return None
+    factory = _ns_registry.get(scheme)
+    if factory is None:
+        return None
+    return NamingServiceThread(factory(), path, lb, channel_options,
+                               node_filter)

@@ -43,19 +43,6 @@ class ServerOptions:
     enabled_protocols: Tuple[str, ...] = ()  # empty = all registered
 
 
-class _ConstLimiter:
-    """'constant' concurrency limiter (policy/auto: see limiter module)."""
-
-    def __init__(self, limit: int):
-        self.limit = limit
-
-    def on_requested(self, current: int) -> bool:
-        return self.limit <= 0 or current < self.limit
-
-    def on_response(self, error_code: int, latency_us: float):
-        pass
-
-
 class Server:
     def __init__(self, options: Optional[ServerOptions] = None):
         self.options = options or ServerOptions()
@@ -82,12 +69,16 @@ class Server:
             if name in self._services:
                 return -1
             self._services[name] = service
+            from brpc_tpu.rpc.concurrency_limiter import (
+                create_concurrency_limiter,
+            )
+
             for mname, minfo in service.methods().items():
                 full = f"{name}.{mname}"
-                limit = self.options.method_max_concurrency.get(full, 0)
-                limiter = _ConstLimiter(limit) if limit > 0 else None
-                if limiter is None and self.options.max_concurrency > 0:
-                    limiter = _ConstLimiter(self.options.max_concurrency)
+                spec = self.options.method_max_concurrency.get(full, 0)
+                if not spec:
+                    spec = self.options.max_concurrency
+                limiter = create_concurrency_limiter(spec) if spec else None
                 status = MethodStatus(full, limiter)
                 self._methods[(name, mname)] = (service, minfo, status)
         return 0

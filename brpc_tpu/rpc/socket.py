@@ -179,6 +179,18 @@ class Socket:
                 return rc
         return 0
 
+    def ensure_connected(self, timeout_s: float = 1.0) -> int:
+        """Lazy connect for sockets created unconnected (NS-created LB
+        nodes); thread-safe connect-once."""
+        if self._fd is not None:
+            return 0
+        with self._write_lock:
+            if self._fd is not None:
+                return 0
+            if self._failed:
+                return self.error_code or errors.EFAILEDSOCKET
+        return self.connect(timeout_s)
+
     def _register_with_dispatcher(self):
         fdno = self._fd.fileno()
         get_global_dispatcher(fdno).add_consumer(fdno, self.start_input_event)
