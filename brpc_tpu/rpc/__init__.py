@@ -22,6 +22,16 @@ from brpc_tpu.rpc.protocol import (  # noqa: F401
     globally_initialize,
     register_protocol,
 )
+from brpc_tpu.rpc.combo_channels import (  # noqa: F401
+    CallMapper,
+    DynamicPartitionChannel,
+    ParallelChannel,
+    PartitionChannel,
+    PartitionParser,
+    ResponseMerger,
+    SelectiveChannel,
+    SubCall,
+)
 from brpc_tpu.rpc.server import Server, ServerOptions  # noqa: F401
 from brpc_tpu.rpc.service import ClosureGuard, MethodInfo, Service, rpc_method  # noqa: F401
 from brpc_tpu.rpc.socket import Socket, SocketUser  # noqa: F401

@@ -99,6 +99,29 @@ class Channel:
         self._init_done = True
         return 0
 
+    def init_with_filter(self, naming_url: str, lb_name: str,
+                         node_filter) -> int:
+        """NS init with a node filter (NamingServiceFilter role,
+        naming_service_filter.h) — PartitionChannel routes partition tags
+        through this."""
+        globally_initialize()
+        self._protocol = find_protocol_by_name(self.options.protocol)
+        if self._protocol is None:
+            return errors.EPROTONOTSUP
+        from brpc_tpu.rpc.load_balancer import create_load_balancer
+        from brpc_tpu.rpc.naming_service import start_naming_service
+
+        self._lb = create_load_balancer(lb_name or "rr")
+        if self._lb is None:
+            return errors.EINVAL
+        self._ns_thread = start_naming_service(
+            naming_url, self._lb, self.options, node_filter
+        )
+        if self._ns_thread is None:
+            return errors.EINVAL
+        self._init_done = True
+        return 0
+
     # -- socket selection (IssueRPC's server-selection half) ---------------
     def _connect_new_socket(self, ep: EndPoint) -> Optional[Socket]:
         messenger = get_client_messenger()

@@ -1,0 +1,406 @@
+"""Combo channels — fan-out / shard / failover composition of channels.
+
+Counterparts of brpc's combo channels (SURVEY.md section 2.6):
+
+* ParallelChannel (/root/reference/src/brpc/parallel_channel.h:94-218):
+  one call fans out to every sub-channel, each mapped by a CallMapper and
+  merged by a ResponseMerger; the call fails when failed sub-calls reach
+  fail_limit (default: all).
+* PartitionChannel (/root/reference/src/brpc/partition_channel.h:41-103):
+  one channel per partition drawn from a single naming service whose server
+  tags name partitions like "2/4" (index/total).
+* DynamicPartitionChannel (partition_channel.h:136-142): servers may belong
+  to different partitioning schemes (4-way and 8-way mixed during
+  migration); a call picks a scheme weighted by its capacity and fans to
+  that scheme's partitions.
+* SelectiveChannel (/root/reference/src/brpc/selective_channel.h:52-72):
+  picks ONE sub-channel per call with health-based failover retry.
+
+These are the RPC-call-shaped counterparts of DP/TP-style fan-out; the mesh
+fusion (fan-out as one XLA collective over ICI) lives in
+brpc_tpu.parallel.mesh_channel and composes with these.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.channel import Channel, ChannelOptions
+from brpc_tpu.rpc.controller import Controller
+
+
+class SubCall:
+    """What a CallMapper returns for one sub-channel
+    (parallel_channel.h SubCall)."""
+
+    __slots__ = ("method", "request", "response", "skip")
+
+    def __init__(self, method=None, request=None, response=None,
+                 skip: bool = False):
+        self.method = method
+        self.request = request
+        self.response = response
+        self.skip = skip
+
+    @classmethod
+    def skip_call(cls) -> "SubCall":
+        return cls(skip=True)
+
+
+class CallMapper:
+    """Maps the main call onto sub-channel i (parallel_channel.h:94)."""
+
+    def map(self, channel_index: int, method: str, request, response) -> SubCall:
+        # Default: broadcast the same request; fresh response per sub-call.
+        sub_resp = type(response)() if response is not None else None
+        return SubCall(method, request, sub_resp)
+
+
+class ResponseMerger:
+    """Merges one sub-response into the main response
+    (parallel_channel.h:185). Return 0 on success, <0 to count as failed."""
+
+    def merge(self, main_response, sub_response) -> int:
+        if main_response is None or sub_response is None:
+            return 0
+        try:
+            main_response.MergeFrom(sub_response)
+            return 0
+        except Exception:
+            return -1
+
+
+class ParallelChannel:
+    def __init__(self, fail_limit: int = -1):
+        self._subs: List[Tuple[Channel, Optional[CallMapper], Optional[ResponseMerger]]] = []
+        self.fail_limit = fail_limit
+
+    def add_channel(self, channel: Channel,
+                    call_mapper: Optional[CallMapper] = None,
+                    response_merger: Optional[ResponseMerger] = None):
+        self._subs.append((channel, call_mapper, response_merger))
+
+    @property
+    def channel_count(self) -> int:
+        return len(self._subs)
+
+    def call_method(self, method: str, cntl: Controller, request, response,
+                    done: Optional[Callable] = None):
+        n = len(self._subs)
+        if n == 0:
+            cntl.set_failed(errors.EINVAL, "no sub channels")
+            if done:
+                done(cntl)
+            return
+        fail_limit = self.fail_limit if self.fail_limit > 0 else n
+        default_mapper = CallMapper()
+        default_merger = ResponseMerger()
+        state = {
+            "pending": 0, "failed": 0, "merge_failed": 0,
+            "first_error": (0, ""), "lock": threading.Lock(),
+            "finished": False,
+        }
+        sub_cntls: List[Controller] = []
+        calls = []
+        for i, (ch, mapper, merger) in enumerate(self._subs):
+            sub = (mapper or default_mapper).map(i, method, request, response)
+            if sub.skip:
+                continue
+            calls.append((i, ch, sub, merger or default_merger))
+        if not calls:
+            cntl.set_failed(errors.EINVAL, "all sub calls skipped")
+            if done:
+                done(cntl)
+            return
+        state["pending"] = len(calls)
+        finished_ev = threading.Event()
+
+        def finalize():
+            if state["failed"] >= min(fail_limit, len(calls)):
+                code, text = state["first_error"]
+                cntl.set_failed(errors.ETOOMANYFAILS,
+                                f"{state['failed']}/{len(calls)} sub calls "
+                                f"failed, first: {errors.berror(code)} {text}")
+            import time as _t
+
+            cntl.latency_us = (_t.monotonic() - start_time) * 1e6
+            if done is not None:
+                done(cntl)
+            finished_ev.set()
+
+        def make_done(index, sub, merger):
+            def sub_done(sub_cntl: Controller):
+                run_final = False
+                with state["lock"]:
+                    if sub_cntl.failed():
+                        state["failed"] += 1
+                        if state["first_error"][0] == 0:
+                            state["first_error"] = (sub_cntl.error_code,
+                                                    sub_cntl.error_text)
+                    else:
+                        rc = merger.merge(response, sub.response)
+                        if rc < 0:
+                            state["failed"] += 1
+                            if state["first_error"][0] == 0:
+                                state["first_error"] = (
+                                    errors.EREQUEST, "response merge failed")
+                    state["pending"] -= 1
+                    if state["pending"] == 0 and not state["finished"]:
+                        state["finished"] = True
+                        run_final = True
+                if run_final:
+                    finalize()
+
+            return sub_done
+
+        import time as _t
+
+        start_time = _t.monotonic()
+        for index, ch, sub, merger in calls:
+            sub_cntl = Controller()
+            sub_cntl.timeout_ms = cntl.timeout_ms
+            sub_cntl.max_retry = cntl.max_retry
+            sub_cntl.compress_type = cntl.compress_type
+            sub_cntl.request_attachment.append(cntl.request_attachment)
+            sub_cntls.append(sub_cntl)
+            ch.call_method(sub.method or method, sub_cntl, sub.request,
+                           sub.response, make_done(index, sub, merger))
+        if done is None:
+            finished_ev.wait()
+
+    def call(self, method: str, request, response_class,
+             timeout_ms: Optional[float] = None):
+        cntl = Controller()
+        if timeout_ms is not None:
+            cntl.timeout_ms = timeout_ms
+        response = response_class() if response_class else None
+        self.call_method(method, cntl, request, response)
+        return cntl, response
+
+
+class PartitionParser:
+    """Parses a server tag into (partition_index, partition_count)
+    (partition_channel.h PartitionParser). Default syntax: 'N/M'."""
+
+    def parse(self, tag: str) -> Optional[Tuple[int, int]]:
+        try:
+            idx_s, _, total_s = tag.partition("/")
+            idx, total = int(idx_s), int(total_s)
+            if 0 <= idx < total:
+                return idx, total
+        except ValueError:
+            pass
+        return None
+
+
+class PartitionChannel(ParallelChannel):
+    """N sub-channels fed by ONE naming service; server tag picks the
+    partition (partition_channel.h:41-103)."""
+
+    def __init__(self, fail_limit: int = -1):
+        super().__init__(fail_limit)
+        self._ns_threads = []
+
+    def init(self, partition_count: int, naming_url: str, lb_name: str = "rr",
+             parser: Optional[PartitionParser] = None,
+             options: Optional[ChannelOptions] = None) -> int:
+        parser = parser or PartitionParser()
+        for part in range(partition_count):
+            ch = Channel(options)
+
+            def node_filter(node, part=part):
+                _, _, tag = node
+                parsed = parser.parse(tag)
+                return (parsed is not None and parsed[0] == part
+                        and parsed[1] == partition_count)
+
+            rc = ch.init_with_filter(naming_url, lb_name, node_filter)
+            if rc != 0:
+                return rc
+            self._ns_threads.append(ch._ns_thread)
+            self.add_channel(ch)
+        return 0
+
+    def stop(self):
+        for t in self._ns_threads:
+            if t is not None:
+                t.stop()
+
+
+class DynamicPartitionChannel:
+    """Multiple partitioning schemes co-existing; scheme chosen per call,
+    weighted by its server capacity (partition_channel.h:136-142)."""
+
+    def __init__(self, fail_limit: int = -1):
+        self.fail_limit = fail_limit
+        self._schemes: Dict[int, PartitionChannel] = {}
+        self._lock = threading.Lock()
+        self._url = ""
+        self._lb_name = "rr"
+        self._parser: Optional[PartitionParser] = None
+        self._options: Optional[ChannelOptions] = None
+
+    def init(self, naming_url: str, lb_name: str = "rr",
+             parser: Optional[PartitionParser] = None,
+             options: Optional[ChannelOptions] = None,
+             schemes: Optional[List[int]] = None) -> int:
+        """schemes: partition counts to serve (discovered from tags when
+        omitted requires a first resolution; explicit list keeps it simple
+        and deterministic)."""
+        self._url = naming_url
+        self._lb_name = lb_name
+        self._parser = parser or PartitionParser()
+        self._options = options
+        if not schemes:
+            from brpc_tpu.rpc.naming_service import start_naming_service  # noqa: F401
+            from brpc_tpu.rpc.naming_service import _ns_registry
+
+            scheme, _, path = naming_url.partition("://")
+            factory = _ns_registry.get(scheme)
+            if factory is None:
+                return errors.EINVAL
+            nodes = factory().get_servers(path)
+            found = set()
+            for _, _, tag in nodes:
+                parsed = self._parser.parse(tag)
+                if parsed:
+                    found.add(parsed[1])
+            schemes = sorted(found)
+        if not schemes:
+            return errors.EINVAL
+        for total in schemes:
+            pc = PartitionChannel(self.fail_limit)
+            rc = pc.init(total, naming_url, lb_name, self._parser, options)
+            if rc != 0:
+                return rc
+            self._schemes[total] = pc
+        return 0
+
+    def _pick_scheme(self) -> Optional[PartitionChannel]:
+        import random
+
+        with self._lock:
+            weighted = []
+            for total, pc in self._schemes.items():
+                capacity = sum(
+                    ch._lb.server_count() for ch, _, _ in pc._subs
+                    if ch._lb is not None
+                )
+                if capacity > 0:
+                    weighted.append((capacity, pc))
+            if not weighted:
+                return None
+            x = random.uniform(0, sum(w for w, _ in weighted))
+            acc = 0.0
+            for w, pc in weighted:
+                acc += w
+                if x <= acc:
+                    return pc
+            return weighted[-1][1]
+
+    def call_method(self, method: str, cntl: Controller, request, response,
+                    done: Optional[Callable] = None):
+        pc = self._pick_scheme()
+        if pc is None:
+            cntl.set_failed(errors.EFAILEDSOCKET, "no usable partition scheme")
+            if done:
+                done(cntl)
+            return
+        pc.call_method(method, cntl, request, response, done)
+
+    def call(self, method: str, request, response_class,
+             timeout_ms: Optional[float] = None):
+        cntl = Controller()
+        if timeout_ms is not None:
+            cntl.timeout_ms = timeout_ms
+        response = response_class() if response_class else None
+        self.call_method(method, cntl, request, response)
+        return cntl, response
+
+    def stop(self):
+        for pc in self._schemes.values():
+            pc.stop()
+
+
+class SelectiveChannel:
+    """LB over channels with failover (selective_channel.h:52-72): each call
+    goes to ONE sub-channel; failure retries another."""
+
+    def __init__(self, max_retry: int = 2):
+        self._channels: List[Channel] = []
+        self._health: Dict[int, int] = {}  # index -> consecutive failures
+        self._index = 0
+        self._lock = threading.Lock()
+        self.max_retry = max_retry
+
+    def add_channel(self, channel: Channel) -> int:
+        with self._lock:
+            self._channels.append(channel)
+            return len(self._channels) - 1
+
+    @property
+    def channel_count(self) -> int:
+        return len(self._channels)
+
+    def _select(self, exclude: set) -> Optional[int]:
+        with self._lock:
+            n = len(self._channels)
+            if n == 0:
+                return None
+            # prefer channels with fewest consecutive failures (health)
+            order = sorted(
+                (i for i in range(n) if i not in exclude),
+                key=lambda i: self._health.get(i, 0),
+            )
+            if not order:
+                return None
+            healthiest = self._health.get(order[0], 0)
+            candidates = [i for i in order
+                          if self._health.get(i, 0) == healthiest]
+            self._index = (self._index + 1) % len(candidates)
+            return candidates[self._index]
+
+    def call_method(self, method: str, cntl: Controller, request, response,
+                    done: Optional[Callable] = None):
+        tried = set()
+        last_cntl = None
+        for _ in range(self.max_retry + 1):
+            idx = self._select(tried)
+            if idx is None:
+                break
+            tried.add(idx)
+            sub_cntl = Controller()
+            sub_cntl.timeout_ms = cntl.timeout_ms
+            sub_cntl.max_retry = cntl.max_retry
+            sub_cntl.compress_type = cntl.compress_type
+            sub_cntl.request_attachment.append(cntl.request_attachment)
+            self._channels[idx].call_method(method, sub_cntl, request,
+                                            response, None)
+            last_cntl = sub_cntl
+            with self._lock:
+                if sub_cntl.failed():
+                    self._health[idx] = self._health.get(idx, 0) + 1
+                else:
+                    self._health[idx] = 0
+            if not sub_cntl.failed():
+                cntl.latency_us = sub_cntl.latency_us
+                cntl.remote_side = sub_cntl.remote_side
+                if done:
+                    done(cntl)
+                return
+        if last_cntl is not None:
+            cntl.set_failed(last_cntl.error_code, last_cntl.error_text)
+        else:
+            cntl.set_failed(errors.EFAILEDSOCKET, "no usable sub channel")
+        if done:
+            done(cntl)
+
+    def call(self, method: str, request, response_class,
+             timeout_ms: Optional[float] = None):
+        cntl = Controller()
+        if timeout_ms is not None:
+            cntl.timeout_ms = timeout_ms
+        response = response_class() if response_class else None
+        self.call_method(method, cntl, request, response)
+        return cntl, response

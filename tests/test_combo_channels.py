@@ -1,0 +1,188 @@
+"""Combo-channel tests — shaped after example/parallel_echo_c++,
+example/partition_echo_c++, example/selective_echo_c++ and
+brpc_channel_unittest.cpp's combo coverage (SURVEY.md sections 2.6, 4).
+"""
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class TaggedEcho(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self, name):
+        self.name = name
+
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = f"{self.name}"
+        done()
+
+
+def _start(name):
+    srv = rpc.Server(rpc.ServerOptions(num_threads=2))
+    srv.add_service(TaggedEcho(name))
+    assert srv.start("127.0.0.1:0") == 0
+    return srv
+
+
+@pytest.fixture(scope="module")
+def trio():
+    servers = [_start(f"n{i}") for i in range(3)]
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+class ConcatMerger(rpc.ResponseMerger):
+    def merge(self, main_response, sub_response):
+        main_response.message += sub_response.message + ";"
+        return 0
+
+
+def test_parallel_channel_fans_out(trio):
+    pc = rpc.ParallelChannel()
+    for srv in trio:
+        ch = rpc.Channel()
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        pc.add_channel(ch, response_merger=ConcatMerger())
+    cntl, resp = pc.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message="x"),
+                         echo_pb2.EchoResponse, timeout_ms=3000)
+    assert not cntl.failed(), cntl.error_text
+    parts = set(filter(None, resp.message.split(";")))
+    assert parts == {"n0", "n1", "n2"}
+
+
+def test_parallel_channel_tolerates_partial_failure(trio):
+    pc = rpc.ParallelChannel()  # default fail_limit = all
+    for srv in trio[:2]:
+        ch = rpc.Channel()
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        pc.add_channel(ch, response_merger=ConcatMerger())
+    dead = rpc.Channel(rpc.ChannelOptions(max_retry=0, timeout_ms=300))
+    assert dead.init("127.0.0.1:1") == 0
+    pc.add_channel(dead, response_merger=ConcatMerger())
+    cntl, resp = pc.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message="x"),
+                         echo_pb2.EchoResponse, timeout_ms=3000)
+    assert not cntl.failed(), cntl.error_text  # 2/3 succeeded
+    assert set(filter(None, resp.message.split(";"))) == {"n0", "n1"}
+
+
+def test_parallel_channel_fail_limit_one(trio):
+    pc = rpc.ParallelChannel(fail_limit=1)
+    ch = rpc.Channel()
+    assert ch.init(str(trio[0].listen_endpoint)) == 0
+    pc.add_channel(ch)
+    dead = rpc.Channel(rpc.ChannelOptions(max_retry=0, timeout_ms=300))
+    assert dead.init("127.0.0.1:1") == 0
+    pc.add_channel(dead)
+    cntl, _ = pc.call("EchoService.Echo", echo_pb2.EchoRequest(message="x"),
+                      echo_pb2.EchoResponse, timeout_ms=3000)
+    assert cntl.error_code == errors.ETOOMANYFAILS
+
+
+def test_parallel_channel_call_mapper(trio):
+    class IndexMapper(rpc.CallMapper):
+        def map(self, i, method, request, response):
+            if i == 2:
+                return rpc.SubCall.skip_call()
+            return rpc.SubCall(
+                method, echo_pb2.EchoRequest(message=f"sub{i}"),
+                echo_pb2.EchoResponse(),
+            )
+
+    pc = rpc.ParallelChannel()
+    for srv in trio:
+        ch = rpc.Channel()
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        pc.add_channel(ch, call_mapper=IndexMapper(),
+                       response_merger=ConcatMerger())
+    cntl, resp = pc.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message="main"),
+                         echo_pb2.EchoResponse, timeout_ms=3000)
+    assert not cntl.failed(), cntl.error_text
+    assert set(filter(None, resp.message.split(";"))) == {"n0", "n1"}
+
+
+def test_selective_channel_failover(trio):
+    sc = rpc.SelectiveChannel(max_retry=2)
+    dead = rpc.Channel(rpc.ChannelOptions(max_retry=0, timeout_ms=200))
+    assert dead.init("127.0.0.1:1") == 0
+    sc.add_channel(dead)
+    live = rpc.Channel()
+    assert live.init(str(trio[0].listen_endpoint)) == 0
+    sc.add_channel(live)
+    ok = 0
+    for _ in range(4):
+        cntl, resp = sc.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message="s"),
+                             echo_pb2.EchoResponse, timeout_ms=2000)
+        if not cntl.failed():
+            ok += 1
+            assert resp.message == "n0"
+    assert ok == 4  # failover makes every call succeed
+
+
+def test_partition_channel(trio):
+    # 3 partitions in a 3-way scheme, one server each, tags "i/3"
+    url = "list://" + ",".join(
+        f"{srv.listen_endpoint} {i}/3" for i, srv in enumerate(trio)
+    )
+    pc = rpc.PartitionChannel()
+    assert pc.init(3, url, "rr") == 0
+    assert pc.channel_count == 3
+    cntl = rpc.Controller()
+    cntl.timeout_ms = 3000
+    resp = echo_pb2.EchoResponse()
+
+    class Merger(rpc.ResponseMerger):
+        def merge(self, main, sub):
+            main.message += sub.message + ","
+            return 0
+
+    pc2 = rpc.PartitionChannel()
+    assert pc2.init(3, url, "rr") == 0
+    for i in range(len(pc2._subs)):
+        ch, m, _ = pc2._subs[i]
+        pc2._subs[i] = (ch, m, Merger())
+    cntl, resp = pc2.call("EchoService.Echo",
+                          echo_pb2.EchoRequest(message="p"),
+                          echo_pb2.EchoResponse, timeout_ms=3000)
+    assert not cntl.failed(), cntl.error_text
+    assert set(filter(None, resp.message.split(","))) == {"n0", "n1", "n2"}
+    pc.stop()
+    pc2.stop()
+
+
+def test_partition_parser_rejects_garbage():
+    p = rpc.PartitionParser()
+    assert p.parse("2/4") == (2, 4)
+    assert p.parse("4/4") is None
+    assert p.parse("x/4") is None
+    assert p.parse("") is None
+
+
+def test_dynamic_partition_channel(trio):
+    # two schemes: 1-way (n0) and 2-way (n1, n2)
+    url = (f"list://{trio[0].listen_endpoint} 0/1,"
+           f"{trio[1].listen_endpoint} 0/2,"
+           f"{trio[2].listen_endpoint} 1/2")
+    dc = rpc.DynamicPartitionChannel()
+    assert dc.init(url, "rr") == 0
+    assert sorted(dc._schemes.keys()) == [1, 2]
+    seen = set()
+    for _ in range(12):
+        cntl, resp = dc.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message="d"),
+                             echo_pb2.EchoResponse, timeout_ms=3000)
+        assert not cntl.failed(), cntl.error_text
+        seen.add(resp.message)
+    # over several calls both schemes should serve (capacity-weighted pick)
+    assert "n0" in seen and ("n1" in seen or "n2" in seen)
+    dc.stop()
