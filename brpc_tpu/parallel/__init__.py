@@ -1,0 +1,17 @@
+"""brpc_tpu.parallel — XLA-collective fan-out over a device mesh.
+
+The ICI-native realization of the combo channels (SURVEY.md section 2.12):
+ParallelChannel -> allreduce, PartitionChannel -> partition/all_to_all,
+cascade/streaming -> ring ppermute, all as single fused XLA programs over
+jax.sharding.Mesh axes.
+"""
+from brpc_tpu.parallel.collectives import (  # noqa: F401
+    all_to_all,
+    allgather,
+    allreduce,
+    ici_bandwidth_probe,
+    make_mesh,
+    reduce_scatter,
+    ring_shift,
+)
+from brpc_tpu.parallel.mesh_channel import MeshChannel, default_mesh  # noqa: F401

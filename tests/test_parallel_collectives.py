@@ -1,0 +1,110 @@
+"""Collective/mesh-channel tests on the virtual 8-device CPU mesh —
+the in-process multi-"chip" pattern of SURVEY.md section 4 (fake transport
+before real ICI).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from brpc_tpu import parallel
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device test mesh"
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return parallel.make_mesh({"dp": 8})
+
+
+def test_allreduce_add(mesh):
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+    out = parallel.allreduce(mesh, "dp", x, "add")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).sum(0))
+
+
+def test_allreduce_max_mean(mesh):
+    x = jnp.arange(8.0).reshape(8, 1)
+    assert float(parallel.allreduce(mesh, "dp", x, "max")[0]) == 7.0
+    assert float(parallel.allreduce(mesh, "dp", x, "mean")[0]) == 3.5
+
+
+def test_allgather(mesh):
+    x = jnp.arange(8 * 3, dtype=jnp.float32).reshape(8, 3)
+    out = parallel.allgather(mesh, "dp", x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_reduce_scatter(mesh):
+    x = jnp.ones((8, 16), jnp.float32)
+    out = parallel.reduce_scatter(mesh, "dp", x)
+    assert out.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+
+
+def test_ring_shift(mesh):
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    out = parallel.ring_shift(mesh, "dp", x, shift=1)
+    expect = np.roll(np.arange(8, dtype=np.float32), 1).reshape(8, 1)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_all_to_all(mesh):
+    x = jnp.arange(8 * 8 * 2, dtype=jnp.float32).reshape(8, 8, 2)
+    out = parallel.all_to_all(mesh, "dp", x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.swapaxes(np.asarray(x), 0, 1))
+
+
+def test_mesh_channel_parallel_call(mesh):
+    mc = parallel.MeshChannel(mesh, "dp")
+    x = jnp.ones((8, 4), jnp.float32)
+    out = mc.parallel_call(lambda s: s * 2.0, x, merger="add")
+    np.testing.assert_allclose(np.asarray(out), 16.0)
+
+
+def test_mesh_channel_concat_merger(mesh):
+    mc = parallel.MeshChannel(mesh, "dp")
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = mc.parallel_call(lambda s: s + 1.0, x, merger="concat")
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.arange(1.0, 9.0))
+
+
+def test_mesh_channel_ring_call(mesh):
+    mc = parallel.MeshChannel(mesh, "dp")
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = mc.ring_call(lambda s: s * 10.0, x)
+    expect = np.roll(np.arange(8.0) * 10.0, 1).reshape(8, 1)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_mesh_channel_partition_call(mesh):
+    mc = parallel.MeshChannel(mesh, "dp")
+    x = jnp.arange(16.0).reshape(8, 2)
+    out = mc.partition_call(lambda s: s.sum(axis=1, keepdims=True), x)
+    np.testing.assert_allclose(
+        np.asarray(out).ravel(), np.asarray(x).sum(1)
+    )
+
+
+def test_bandwidth_probe(mesh):
+    mc = parallel.MeshChannel(mesh, "dp")
+    stats = mc.bandwidth_probe(nbytes=1 << 16, iters=2)
+    assert stats["axis_size"] == 8
+    assert stats["allreduce_GBps"] > 0
+
+
+def test_grad_merge_matches_parallel_channel_semantics(mesh):
+    """DP gradient merge == ParallelChannel fan-out + add-merger
+    (SURVEY.md 2.12 row 1)."""
+    mc = parallel.MeshChannel(mesh, "dp")
+    w = jnp.float32(2.0)
+
+    def local_grad(batch):  # d/dw of sum(w * x) = sum(x)
+        return jax.grad(lambda w_, b: (w_ * b).sum())(w, batch)
+
+    batches = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+    merged = mc.parallel_call(local_grad, batches, merger="add")
+    np.testing.assert_allclose(float(merged), float(batches.sum()))
