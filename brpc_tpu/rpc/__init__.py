@@ -35,3 +35,10 @@ from brpc_tpu.rpc.combo_channels import (  # noqa: F401
 from brpc_tpu.rpc.server import Server, ServerOptions  # noqa: F401
 from brpc_tpu.rpc.service import ClosureGuard, MethodInfo, Service, rpc_method  # noqa: F401
 from brpc_tpu.rpc.socket import Socket, SocketUser  # noqa: F401
+from brpc_tpu.rpc.stream import (  # noqa: F401
+    Stream,
+    StreamInputHandler,
+    StreamOptions,
+    stream_accept,
+    stream_create,
+)

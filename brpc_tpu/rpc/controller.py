@@ -99,6 +99,12 @@ class Controller:
         self._server_meta = None
         self.auth_context = None
         self.session_local_data = None
+        # streaming (stream.py): client-created stream riding the request,
+        # server-side remote id + accepted stream (stream.cpp:98-115)
+        self._request_stream = None
+        self._remote_stream_id = 0
+        self._server_socket = None
+        self._accepted_stream = None
         # tracing
         self.trace_id = 0
         self.span_id = 0
@@ -219,6 +225,11 @@ class Controller:
 
     def _on_response(self, meta, payload: bytes, attachment: IOBuf, sock):
         """Called by the protocol's process_response with the id locked."""
+        if meta.stream_id and self._request_stream is not None:
+            # Stream setup completed: learn the peer endpoint id and bind
+            # to the RPC's connection (stream.cpp SetConnected path).
+            self._request_stream.peer_id = meta.stream_id
+            self._request_stream.bind(sock)
         if meta.response.error_code != 0:
             self.set_failed(meta.response.error_code,
                             meta.response.error_text)

@@ -74,7 +74,13 @@ class InputMessenger:
             result = None
             if protocol is not None:
                 result = protocol.parse(portal, sock, read_eof, None)
-            else:
+                if result.error == ParseError.TRY_OTHERS:
+                    # Mixed traffic on one connection (RPC frames +
+                    # streaming frames): re-run handler selection.
+                    result = None
+                    protocol = None
+                    sock.matched_protocol = None
+            if protocol is None:
                 # First message: try every handler in order
                 # (input_messenger.cpp CutInputMessage).
                 for p in self._protocols:
@@ -104,7 +110,10 @@ class InputMessenger:
                            else protocol.process_response)
                 if process is None:
                     continue
-                start_background(self._process_safely, process, msg)
+                if protocol.process_inline:
+                    self._process_safely(process, msg)
+                else:
+                    start_background(self._process_safely, process, msg)
             elif result.error == ParseError.NOT_ENOUGH_DATA:
                 return progressed
             else:

@@ -91,6 +91,10 @@ class Protocol:
     supported_connection_types: tuple = ("single", "pooled", "short")
     support_client: bool = True
     support_server: bool = True
+    # True: process on the read loop itself (must only enqueue, never
+    # block) — required for order-sensitive frames (streaming), mirroring
+    # how stream frames go straight into the stream's ExecutionQueue.
+    process_inline: bool = False
     extra: dict = field(default_factory=dict)
 
 

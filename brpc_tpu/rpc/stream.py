@@ -1,0 +1,238 @@
+"""Streaming RPC — ordered byte/tensor streams over an RPC connection.
+
+Counterpart of brpc Streams (/root/reference/src/brpc/stream.{h,cpp},
+stream_impl.h; SURVEY.md section 2.8): StreamCreate piggybacks stream setup
+on a normal RPC (stream.cpp:98-115), writes go through the connection's
+normal wait-free write path, receipt is serialized through a bthread
+ExecutionQueue into the user's StreamInputHandler (stream_impl.h:125), and
+a sliding window with explicit FEEDBACK frames provides flow control
+(stream.cpp:458-586; max_buf_size default 2MB, stream.h:50-67).
+
+This is the tensor-pipeline lane of the framework: IOBuf payloads may carry
+device arrays, so a pipeline stage can stream activations to the next stage
+while compute continues.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from brpc_tpu import bvar
+from brpc_tpu.bthread.execution_queue import ExecutionQueue
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+
+DEFAULT_MAX_BUF_SIZE = 2 * 1024 * 1024  # stream.h:50-67
+
+_stream_count = bvar.Adder("stream_count")
+
+
+class StreamInputHandler:
+    """User callbacks (stream.h StreamInputHandler)."""
+
+    def on_received_messages(self, stream: "Stream", messages: List[IOBuf]):
+        raise NotImplementedError
+
+    def on_idle_timeout(self, stream: "Stream"):
+        pass
+
+    def on_closed(self, stream: "Stream"):
+        pass
+
+
+class StreamOptions:
+    def __init__(self, handler: Optional[StreamInputHandler] = None,
+                 max_buf_size: int = DEFAULT_MAX_BUF_SIZE,
+                 messages_in_batch: int = 128):
+        self.handler = handler
+        self.max_buf_size = max_buf_size
+        self.messages_in_batch = messages_in_batch
+
+
+class Stream:
+    """One direction-agnostic stream endpoint. Writes block when the remote
+    window is exhausted; the receiver's consumption feeds it back."""
+
+    _registry: Dict[int, "Stream"] = {}
+    _registry_lock = threading.Lock()
+    _next_id = 1
+
+    def __init__(self, options: StreamOptions,
+                 peer_id: Optional[int] = None):
+        cls = type(self)
+        with cls._registry_lock:
+            stream_id = cls._next_id
+            cls._next_id += 1
+            self.stream_id = stream_id  # OUR endpoint id (frames to us)
+            cls._registry[stream_id] = self
+        self.peer_id = peer_id  # the remote endpoint id (frames from us)
+        self.options = options
+        self._sock = None
+        self._closed = False
+        self._close_reason = ""
+        # writer-side window accounting
+        self._unconsumed = 0  # bytes sent, not yet fed back as consumed
+        self._window_cond = threading.Condition()
+        # receiver-side ordered delivery
+        self._exec_q: Optional[ExecutionQueue] = None
+        self._connected = threading.Event()
+        _stream_count.update(1)
+
+    # -- registry ----------------------------------------------------------
+    @classmethod
+    def find(cls, stream_id: int) -> Optional["Stream"]:
+        with cls._registry_lock:
+            return cls._registry.get(stream_id)
+
+    # -- binding (SetConnected analog) -------------------------------------
+    def bind(self, sock):
+        self._sock = sock
+        if self.options.handler is not None and self._exec_q is None:
+            self._exec_q = ExecutionQueue(self._consume_batch,
+                                          batch_size=self.options.messages_in_batch)
+        self._connected.set()
+
+    def wait_connected(self, timeout: Optional[float] = None) -> bool:
+        return self._connected.wait(timeout)
+
+    @property
+    def connected(self) -> bool:
+        return self._connected.is_set()
+
+    # -- write path --------------------------------------------------------
+    def write(self, data, timeout_s: Optional[float] = 5.0) -> int:
+        """StreamWrite (stream.h:119): blocks while the window is full
+        (AppendIfNotFull semantics with wait_for_writable folded in)."""
+        from brpc_tpu.rpc import streaming_protocol
+
+        if self._closed:
+            return errors.EEOF
+        if self._sock is None or self.peer_id is None:
+            return errors.EINVAL
+        buf = data if isinstance(data, IOBuf) else IOBuf(data)
+        size = len(buf)
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._window_cond:
+            while (self._unconsumed + size > self.options.max_buf_size
+                   and not self._closed):
+                remain = None if deadline is None else deadline - time.monotonic()
+                if remain is not None and remain <= 0:
+                    return errors.EOVERCROWDED  # window still full
+                self._window_cond.wait(remain)
+            if self._closed:
+                return errors.EEOF
+            self._unconsumed += size
+        frame = streaming_protocol.pack_data_frame(self.peer_id, buf)
+        rc = self._sock.write(frame)
+        if rc != 0:
+            self.close("write failed")
+            return rc
+        return 0
+
+    def write_tensor(self, array) -> int:
+        """Zero-copy stream write of a device array."""
+        buf = IOBuf()
+        buf.append_device_array(array)
+        return self.write(buf)
+
+    def _on_feedback(self, consumed_bytes: int):
+        with self._window_cond:
+            self._unconsumed = max(0, self._unconsumed - consumed_bytes)
+            self._window_cond.notify_all()
+
+    @property
+    def unconsumed_bytes(self) -> int:
+        return self._unconsumed
+
+    # -- receive path ------------------------------------------------------
+    def _on_data(self, payload: IOBuf):
+        if self._exec_q is not None:
+            self._exec_q.execute(payload)
+        # no handler: drop (write-only remote peer misuse), still feed back
+        else:
+            self._send_feedback(len(payload))
+
+    def _consume_batch(self, it) -> int:
+        msgs = list(it)
+        if msgs:
+            total = sum(len(m) for m in msgs)
+            try:
+                self.options.handler.on_received_messages(self, msgs)
+            finally:
+                self._send_feedback(total)
+        if it.is_queue_stopped():
+            try:
+                self.options.handler.on_closed(self)
+            except Exception:
+                pass
+        return 0
+
+    def _send_feedback(self, consumed: int):
+        from brpc_tpu.rpc import streaming_protocol
+
+        if (self._sock is not None and not self._closed
+                and self.peer_id is not None):
+            try:
+                self._sock.write(
+                    streaming_protocol.pack_feedback_frame(self.peer_id,
+                                                           consumed)
+                )
+            except Exception:
+                pass
+
+    # -- close -------------------------------------------------------------
+    def close(self, reason: str = "", notify_remote: bool = True):
+        """StreamClose: CLOSE frame to the peer, local handler drained then
+        on_closed."""
+        from brpc_tpu.rpc import streaming_protocol
+
+        if self._closed:
+            return
+        self._closed = True
+        self._close_reason = reason
+        with self._window_cond:
+            self._window_cond.notify_all()
+        if (notify_remote and self._sock is not None
+                and not self._sock.failed() and self.peer_id is not None):
+            try:
+                self._sock.write(
+                    streaming_protocol.pack_close_frame(self.peer_id)
+                )
+            except Exception:
+                pass
+        if self._exec_q is not None:
+            self._exec_q.stop()
+        elif self.options.handler is not None:
+            try:
+                self.options.handler.on_closed(self)
+            except Exception:
+                pass
+        with type(self)._registry_lock:
+            type(self)._registry.pop(self.stream_id, None)
+        _stream_count.update(-1)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+def stream_create(cntl, options: Optional[StreamOptions] = None) -> Stream:
+    """Client side, BEFORE the call: create the local endpoint and ride the
+    setup on the RPC (StreamCreate, stream.h:102)."""
+    stream = Stream(options or StreamOptions())
+    cntl._request_stream = stream
+    return stream
+
+
+def stream_accept(cntl, options: Optional[StreamOptions] = None) -> Optional[Stream]:
+    """Server side, inside the handler: accept the stream riding the
+    current RPC (StreamAccept, stream.h:110). The response meta carries our
+    endpoint id back so the client learns its peer."""
+    sid = getattr(cntl, "_remote_stream_id", 0)
+    if not sid:
+        return None
+    stream = Stream(options or StreamOptions(), peer_id=sid)
+    stream.bind(cntl._server_socket)
+    cntl._accepted_stream = stream
+    return stream

@@ -147,6 +147,8 @@ def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf
         meta.request.timeout_ms = remain_ms
     meta.correlation_id = correlation_id
     meta.compress_type = cntl.compress_type
+    if cntl._request_stream is not None:
+        meta.stream_id = cntl._request_stream.stream_id
     payload = compress_mod.compress(payload, cntl.compress_type)
     return pack_frame(meta, payload, cntl.request_attachment)
 
@@ -179,6 +181,8 @@ def send_rpc_response(sock, correlation_id: int, cntl: Controller,
     meta.response.error_code = cntl.error_code_value
     if cntl.error_code_value:
         meta.response.error_text = cntl.error_text_value
+    if cntl._accepted_stream is not None:
+        meta.stream_id = cntl._accepted_stream.stream_id
     payload = b""
     if response is not None and not cntl.failed():
         payload = (bytes(response) if isinstance(response, (bytes, bytearray))
@@ -206,6 +210,8 @@ def process_request(msg: RpcMessage):
     cntl.trace_id = meta.request.trace_id
     cntl.compress_type = meta.compress_type
     cntl.request_attachment = msg.attachment
+    cntl._remote_stream_id = meta.stream_id
+    cntl._server_socket = sock
     cntl.server_start_time = time.monotonic()
     if meta.request.timeout_ms > 0:
         cntl.timeout_ms = meta.request.timeout_ms
