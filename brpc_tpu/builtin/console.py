@@ -1,0 +1,191 @@
+"""Builtin console — the HTTP debug pages every server carries.
+
+Counterpart of src/brpc/builtin/ (registered in server.cpp:468-563):
+/status /vars /flags /health /connections /index /version /brpc_metrics
+/protobufs /bthreads /sockets /rpcz /list — served by the HTTP protocol's
+router. Each handler: (server, http_request) -> (status, content_type, body).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from brpc_tpu import bvar
+from brpc_tpu.butil import flags as flags_mod
+
+
+def _status_handler(server, req):
+    """/status: server + per-method stats (builtin/status_service.cpp)."""
+    lines = [
+        f"version: brpc_tpu/{_version()}",
+        f"non-service: builtin",
+        f"uptime: {time.time() - (server.start_time or time.time()):.0f}s",
+        f"listen: {server.listen_endpoint}",
+        f"connection_count: {server.connection_count()}",
+        f"service_count: {server.service_count}",
+        "",
+    ]
+    for full, st in sorted(server.method_statuses().items()):
+        lines.append(st.describe())
+    return 200, "text/plain", "\n".join(lines) + "\n"
+
+
+def _vars_handler(server, req):
+    """/vars: every exposed bvar; /vars/<name> filters
+    (builtin/vars_service.cpp)."""
+    parts = [p for p in req.path.split("/") if p]
+    needle = parts[1] if len(parts) > 1 else None
+    out = []
+    for name, value in bvar.dump_exposed():
+        if needle and needle not in name:
+            continue
+        if hasattr(value, "average"):
+            value = f"avg={value.average:.3f} num={value.num}"
+        out.append(f"{name} : {value}")
+    return 200, "text/plain", "\n".join(out) + "\n"
+
+
+def _flags_handler(server, req):
+    """/flags list; /flags/<name>?setvalue=v live-edits a reloadable flag
+    (builtin/flags_service.cpp + reloadable_flags.h)."""
+    parts = [p for p in req.path.split("/") if p]
+    if len(parts) > 1:
+        name = parts[1]
+        setvalue = req.query.get("setvalue")
+        if setvalue is not None:
+            if flags_mod.set_flag(name, setvalue):
+                return 200, "text/plain", f"{name} set to {setvalue}\n"
+            return 403, "text/plain", f"cannot set {name}\n"
+        try:
+            f = flags_mod.flag(name)
+        except KeyError:
+            return 404, "text/plain", f"no such flag: {name}\n"
+        return 200, "text/plain", (
+            f"{f.name}={f.value} (default={f.default}) "
+            f"{'[reloadable]' if f.reloadable else ''} {f.help}\n"
+        )
+    out = []
+    for name, f in sorted(flags_mod.all_flags().items()):
+        mark = " (R)" if f.reloadable else ""
+        out.append(f"{name}={f.value}{mark}  # {f.help}")
+    return 200, "text/plain", "\n".join(out) + "\n"
+
+
+def _health_handler(server, req):
+    return 200, "text/plain", "OK\n"
+
+
+def _connections_handler(server, req):
+    """/connections (builtin/connections_service.cpp)."""
+    lines = ["remote_side          |socket_id          |state"]
+    for sock in server.list_connections():
+        lines.append(
+            f"{str(sock.remote_side):21s}|{sock.socket_id:<19d}|"
+            f"{'failed' if sock.failed() else 'ok'}"
+        )
+    return 200, "text/plain", "\n".join(lines) + "\n"
+
+
+def _index_handler(server, req):
+    pages = sorted(server._builtin_handlers.keys())
+    services = sorted(server.method_statuses().keys())
+    body = ("brpc_tpu server console\n\npages:\n"
+            + "\n".join(f"  /{p}" for p in pages)
+            + "\n\nmethods:\n"
+            + "\n".join(f"  /{m.replace('.', '/')}" for m in services)
+            + "\n")
+    return 200, "text/plain", body
+
+
+def _version_handler(server, req):
+    return 200, "text/plain", f"brpc_tpu/{_version()}\n"
+
+
+def _metrics_handler(server, req):
+    """/brpc_metrics: Prometheus exposition
+    (builtin/prometheus_metrics_service.cpp)."""
+    return 200, "text/plain; version=0.0.4", bvar.dump_prometheus()
+
+
+def _protobufs_handler(server, req):
+    """/protobufs: message schemas in use (builtin/protobufs_service.cpp)."""
+    seen = {}
+    for (svc, method), (obj, minfo, st) in server._methods.items():
+        for cls in (minfo.request_class, minfo.response_class):
+            try:
+                seen[cls.DESCRIPTOR.full_name] = str(cls.DESCRIPTOR.file.name)
+            except AttributeError:
+                seen[cls.__name__] = "<python>"
+    body = "\n".join(f"{k}  ({v})" for k, v in sorted(seen.items()))
+    return 200, "text/plain", body + "\n"
+
+
+def _bthreads_handler(server, req):
+    """/bthreads: scheduler stats (builtin/bthreads_service.cpp)."""
+    from brpc_tpu.bthread import get_task_control
+
+    tc = get_task_control()
+    lines = [
+        f"workers: {len(tc.groups)}",
+        f"queued: {tc._queued_count()}",
+        f"switches: {tc._nswitch_var.get_value()}",
+        f"finished: {tc._finished_var.get_value()}",
+    ]
+    for g in tc.groups:
+        lines.append(
+            f"  group {g.group_id}: rq={len(g._rq)} remote={len(g._remote_rq)}"
+            f" bound={len(g._bound_rq)} nswitch={g.nswitch}"
+        )
+    return 200, "text/plain", "\n".join(lines) + "\n"
+
+
+def _sockets_handler(server, req):
+    """/sockets: socket pool introspection (builtin/sockets_service.cpp)."""
+    from brpc_tpu.rpc.socket import Socket
+
+    pool = Socket._get_pool()
+    return 200, "text/plain", f"socket_slots: {pool.size()}\n"
+
+
+def _rpcz_handler(server, req):
+    """/rpcz: recent spans (builtin/rpcz_service.cpp); filled by the rpcz
+    module once tracing is enabled."""
+    try:
+        from brpc_tpu.rpcz import describe_recent_spans
+
+        return 200, "text/plain", describe_recent_spans(req.query)
+    except ImportError:
+        return 200, "text/plain", "rpcz: tracing module not loaded\n"
+
+
+def _list_handler(server, req):
+    """/list: service listing as JSON (builtin/list_service.cpp)."""
+    out = {}
+    for (svc, method) in server._methods:
+        out.setdefault(svc, []).append(method)
+    return 200, "application/json", json.dumps(out, indent=1) + "\n"
+
+
+def _version():
+    import brpc_tpu
+
+    return brpc_tpu.__version__
+
+
+def attach_console(server):
+    server._builtin_handlers = {
+        "status": _status_handler,
+        "vars": _vars_handler,
+        "flags": _flags_handler,
+        "health": _health_handler,
+        "connections": _connections_handler,
+        "index": _index_handler,
+        "version": _version_handler,
+        "brpc_metrics": _metrics_handler,
+        "protobufs": _protobufs_handler,
+        "bthreads": _bthreads_handler,
+        "sockets": _sockets_handler,
+        "rpcz": _rpcz_handler,
+        "list": _list_handler,
+    }
+    bvar.expose_flags_as_bvars()

@@ -1,0 +1,50 @@
+"""json2pb — JSON ⇄ protobuf conversion satellite.
+
+Counterpart of /root/reference/src/json2pb/ (json_to_pb.h, pb_to_json.h):
+the bridge the HTTP protocol uses to serve protobuf services as JSON REST
+endpoints. Backed by google.protobuf.json_format with brpc-compatible
+options (bytes as base64, enums as strings by default).
+"""
+from __future__ import annotations
+
+from typing import Optional, Type
+
+from google.protobuf import json_format
+
+
+class Pb2JsonOptions:
+    def __init__(self, bytes_to_base64: bool = True,
+                 jsonify_empty_array: bool = False,
+                 always_print_primitive_fields: bool = False,
+                 enum_option_as_int: bool = False):
+        self.bytes_to_base64 = bytes_to_base64
+        self.jsonify_empty_array = jsonify_empty_array
+        self.always_print_primitive_fields = always_print_primitive_fields
+        self.enum_option_as_int = enum_option_as_int
+
+
+def pb_to_json(message, options: Optional[Pb2JsonOptions] = None) -> str:
+    """ProtoMessageToJson (pb_to_json.h)."""
+    options = options or Pb2JsonOptions()
+    return json_format.MessageToJson(
+        message,
+        preserving_proto_field_name=True,
+        use_integers_for_enums=options.enum_option_as_int,
+        always_print_fields_with_no_presence=options.always_print_primitive_fields,
+    )
+
+
+def json_to_pb(json_text: str, message_class: Type):
+    """JsonToProtoMessage (json_to_pb.h); raises json_format.ParseError on
+    malformed input."""
+    msg = message_class()
+    json_format.Parse(json_text, msg, ignore_unknown_fields=True)
+    return msg
+
+
+def json_to_pb_inplace(json_text: str, message) -> bool:
+    try:
+        json_format.Parse(json_text, message, ignore_unknown_fields=True)
+        return True
+    except json_format.ParseError:
+        return False

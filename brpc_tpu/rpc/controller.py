@@ -105,6 +105,9 @@ class Controller:
         self._remote_stream_id = 0
         self._server_socket = None
         self._accepted_stream = None
+        # http (http_protocol.py): request/response objects on either side
+        self.http_request = None
+        self.http_response = None
         # tracing
         self.trace_id = 0
         self.span_id = 0
@@ -170,6 +173,9 @@ class Controller:
         packet = channel._protocol.pack_request(
             self._request_payload, self, attempt_cid
         )
+        on_packed = channel._protocol.extra.get("on_packed")
+        if on_packed is not None:
+            on_packed(sock, self, attempt_cid)
         rc = sock.write(packet, id_wait=attempt_cid)
         if rc != 0:
             return  # id_wait already errored via socket failure path

@@ -1,6 +1,275 @@
-"""HTTP/1.1 protocol — placeholder registration point.
+"""HTTP/1.1 protocol — RESTful access to services + the builtin console.
 
-Counterpart of policy/http_rpc_protocol.cpp; the full implementation
-(RESTful routing + builtin console pages + pb-over-http) registers here.
+Counterpart of policy/http_rpc_protocol.cpp
+(/root/reference/src/brpc/policy/http_rpc_protocol.cpp) with restful.cpp's
+routing role: POST /ServiceName/Method with a JSON (or binary-pb) body
+calls the same method map the tpu_std protocol serves (pb-over-http via
+json2pb); any other path routes to the builtin console services registered
+by brpc_tpu.builtin (server.cpp:468-563 equivalents).
+
+Client side: channels with options.protocol="http" serialize requests as
+JSON and pipeline correlation ids per connection (responses on an HTTP/1.1
+connection arrive in request order).
 """
-# Filled in by the builtin-console milestone; see http_impl.py once present.
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.json2pb import json_to_pb_inplace, pb_to_json
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.http_message import (
+    HttpRequest,
+    HttpResponse,
+    try_parse,
+)
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+
+_STATUS_REASON = {200: "OK", 400: "Bad Request", 403: "Forbidden",
+                  404: "Not Found", 500: "Internal Server Error",
+                  503: "Service Unavailable"}
+
+
+def http_status_from_error(code: int) -> int:
+    """grpc.h:27-152 role: framework error -> HTTP status."""
+    if code == 0:
+        return 200
+    return {
+        errors.ENOSERVICE: 404,
+        errors.ENOMETHOD: 404,
+        errors.EREQUEST: 400,
+        errors.EAUTH: 403,
+        errors.EPERM: 403,
+        errors.ELIMIT: 503,
+        errors.EOVERLOAD: 503,
+    }.get(code, 500)
+
+
+class HttpInputMessage(InputMessageBase):
+    __slots__ = ("http", "is_request")
+
+    def __init__(self, http_msg):
+        super().__init__()
+        self.http = http_msg
+        self.is_request = isinstance(http_msg, HttpRequest)
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    state, msg = try_parse(portal)
+    if state == "ok":
+        return ParseResult.ok(HttpInputMessage(msg))
+    if state == "more":
+        return ParseResult.not_enough()
+    if state == "not_http":
+        return ParseResult.try_others()
+    return ParseResult.error_()
+
+
+# -- server side -----------------------------------------------------------
+
+def _respond(sock, response: HttpResponse, close: bool = False):
+    response.reason = _STATUS_REASON.get(response.status_code,
+                                         response.reason or "")
+    response.headers.set("server", "brpc_tpu")
+    if close:
+        response.headers.set("connection", "close")
+    sock.write(response.serialize())
+    if close:
+        sock.set_failed(errors.ECLOSE, "http connection: close")
+
+
+def process_request(msg: HttpInputMessage):
+    """Route: /Service/Method RPC call, else builtin console page."""
+    server = msg.arg
+    req: HttpRequest = msg.http
+    sock = msg.socket
+    close = (req.headers.get("connection", "").lower() == "close")
+    resp = HttpResponse()
+    if server is None:
+        resp.status_code = 500
+        resp.set_body("no server bound")
+        return _respond(sock, resp, close)
+
+    parts = [p for p in req.path.split("/") if p]
+    # RPC-over-HTTP: /ServiceName/MethodName
+    if len(parts) == 2 and server.find_method(parts[0], parts[1]) is not None:
+        return _process_http_rpc(server, req, sock, resp, parts[0], parts[1],
+                                 close)
+    # builtin console
+    handlers = getattr(server, "_builtin_handlers", None)
+    if handlers:
+        name = parts[0] if parts else "index"
+        handler = handlers.get(name)
+        if handler is not None:
+            try:
+                status, ctype, body = handler(server, req)
+            except Exception as e:
+                status, ctype, body = 500, "text/plain", f"handler raised: {e}"
+            resp.status_code = status
+            resp.set_body(body, ctype)
+            return _respond(sock, resp, close)
+    resp.status_code = 404
+    resp.set_body(f"no such page or method: {req.path}\n")
+    _respond(sock, resp, close)
+
+
+def _process_http_rpc(server, req, sock, resp, service_name, method_name,
+                      close):
+    service_obj, minfo, method_status = server.find_method(service_name,
+                                                           method_name)
+    cntl = Controller()
+    cntl.server = server
+    cntl.remote_side = sock.remote_side
+    cntl.service_name = service_name
+    cntl.method_name = method_name
+    cntl.server_start_time = time.monotonic()
+    cntl.http_request = req
+    cntl.http_response = resp
+    if not method_status.on_requested():
+        cntl.set_failed(errors.ELIMIT, "reached max_concurrency")
+        resp.status_code = 503
+        resp.set_body(cntl.error_text_value)
+        return _respond(sock, resp, close)
+
+    request = minfo.request_class()
+    body = req.body.to_bytes()
+    ctype = (req.headers.get("content-type") or "application/json").lower()
+    try:
+        if "proto" in ctype:
+            request.ParseFromString(body)
+        elif body:
+            if not json_to_pb_inplace(body.decode("utf-8"), request):
+                raise ValueError("malformed JSON body")
+        # query params also populate fields (restful convenience)
+        elif req.query:
+            import json as _json
+
+            json_to_pb_inplace(_json.dumps(req.query), request)
+    except Exception as e:
+        method_status.on_response(errors.EREQUEST, cntl.server_start_time)
+        resp.status_code = 400
+        resp.set_body(f"fail to parse request: {e}")
+        return _respond(sock, resp, close)
+
+    response_pb = minfo.response_class()
+    responded = [False]
+
+    def done():
+        if responded[0]:
+            return
+        responded[0] = True
+        method_status.on_response(cntl.error_code_value,
+                                  cntl.server_start_time)
+        if cntl.failed():
+            resp.status_code = http_status_from_error(cntl.error_code_value)
+            resp.set_body(cntl.error_text_value + "\n")
+            resp.headers.set("x-error-code", cntl.error_code_value)
+        else:
+            if "proto" in ctype:
+                resp.set_body(response_pb.SerializeToString(),
+                              "application/proto")
+            else:
+                resp.set_body(pb_to_json(response_pb), "application/json")
+        _respond(sock, resp, close)
+
+    try:
+        minfo.handler(service_obj, cntl, request, response_pb, done)
+    except Exception as e:
+        if not responded[0]:
+            cntl.set_failed(errors.EINVAL, f"method raised: {e}")
+            done()
+
+
+# -- client side -----------------------------------------------------------
+
+def serialize_request(request, cntl: Controller):
+    if request is None:
+        return b""
+    if isinstance(request, (bytes, bytearray)):
+        return bytes(request)
+    return pb_to_json(request).encode("utf-8")
+
+
+def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf:
+    service, _, method = cntl._method_full_name.rpartition(".")
+    req = getattr(cntl, "http_request", None) or HttpRequest()
+    if req.uri == "/":
+        req.uri = f"/{service}/{method}"
+    if payload:
+        req.method = "POST"
+        req.body = IOBuf(payload)
+        if "content-type" not in req.headers:
+            req.headers.set("content-type", "application/json")
+    req.headers.set("host", str(cntl.remote_side or ""))
+    req.headers.set("x-correlation-id", correlation_id)
+    return req.serialize()
+
+
+def on_packed(sock, cntl: Controller, correlation_id: int):
+    """HTTP/1.1 responses arrive in request order: remember the cid queue
+    per connection (the http pipelining correlation of
+    http_rpc_protocol.cpp)."""
+    q = getattr(sock, "_http_pipeline", None)
+    if q is None:
+        q = deque()
+        sock._http_pipeline = q
+    q.append(correlation_id)
+
+
+def process_response(msg: HttpInputMessage):
+    sock = msg.socket
+    q = getattr(sock, "_http_pipeline", None)
+    if not q:
+        return
+    cid = q.popleft()
+    try:
+        cntl = bthread_id.lock(cid)
+    except (KeyError, TimeoutError):
+        return
+    if not isinstance(cntl, Controller):
+        try:
+            bthread_id.unlock(cid)
+        except Exception:
+            pass
+        return
+    http_resp: HttpResponse = msg.http
+    cntl.http_response = http_resp
+    body = http_resp.body.to_bytes()
+    if http_resp.status_code != 200:
+        code_hdr = http_resp.headers.get("x-error-code")
+        code = int(code_hdr) if code_hdr and code_hdr.isdigit() else errors.EHTTP
+        cntl.set_failed(code, body.decode("utf-8", "replace").strip()
+                        or f"http status {http_resp.status_code}")
+        cntl._end_rpc_locked_or_not(locked=True)
+        return
+    try:
+        if cntl._response is not None and body:
+            ctype = (http_resp.headers.get("content-type") or "").lower()
+            if "proto" in ctype:
+                cntl._response.ParseFromString(body)
+            else:
+                json_to_pb_inplace(body.decode("utf-8"), cntl._response)
+    except Exception as e:
+        cntl.set_failed(errors.EREQUEST, f"fail to parse http response: {e}")
+    cntl._end_rpc_locked_or_not(locked=True)
+
+
+register_protocol(Protocol(
+    name="http",
+    type=ProtocolType.HTTP,
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+    extra={"on_packed": on_packed},
+))

@@ -1,0 +1,164 @@
+"""HTTP protocol + builtin console tests — shaped after
+brpc_http_rpc_protocol_unittest.cpp and the builtin-service unittests:
+plain http.client requests against a started server; JSON RPC over HTTP;
+http client channel (SURVEY.md sections 2.5, 2.7).
+"""
+import http.client
+import json
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.butil import flags as flags_mod
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        if request.code:
+            cntl.set_failed(request.code, "requested failure")
+            done()
+            return
+        response.message = request.message
+        done()
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _get(server, path):
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      server.listen_endpoint.port, timeout=5)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, r.getheader("content-type", ""), body
+
+
+def test_health(server):
+    status, _, body = _get(server, "/health")
+    assert status == 200 and body == "OK\n"
+
+
+def test_status_page(server):
+    status, _, body = _get(server, "/status")
+    assert status == 200
+    assert "EchoService.Echo" in body
+    assert "connection_count" in body
+
+
+def test_vars_page(server):
+    status, _, body = _get(server, "/vars")
+    assert status == 200
+    assert "process_pid" in body
+    status, _, body = _get(server, "/vars/process_pid")
+    assert "process_pid" in body and "socket_in_bytes" not in body
+
+
+def test_flags_page_and_live_edit(server):
+    status, _, body = _get(server, "/flags")
+    assert status == 200 and "event_dispatcher_num" in body
+    flags_mod.define_int("test_http_flag", 1, "test flag")
+    status, _, body = _get(server, "/flags/test_http_flag?setvalue=42")
+    assert status == 200
+    assert flags_mod.get_flag("test_http_flag") == 42
+
+
+def test_prometheus_metrics(server):
+    status, ctype, body = _get(server, "/brpc_metrics")
+    assert status == 200
+    assert "# TYPE" in body and "process_cpu_seconds" in body
+
+
+def test_index_version_list(server):
+    status, _, body = _get(server, "/index")
+    assert status == 200 and "/status" in body
+    status, _, body = _get(server, "/version")
+    assert body.startswith("brpc_tpu/")
+    status, _, body = _get(server, "/list")
+    assert json.loads(body) == {"EchoService": ["Echo"]}
+
+
+def test_connections_bthreads_sockets_protobufs(server):
+    for page in ("connections", "bthreads", "sockets", "protobufs"):
+        status, _, body = _get(server, f"/{page}")
+        assert status == 200, page
+        assert body
+
+
+def test_404(server):
+    status, _, body = _get(server, "/no/such/page")
+    assert status == 404
+
+
+def test_json_rpc_over_http(server):
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      server.listen_endpoint.port, timeout=5)
+    conn.request("POST", "/EchoService/Echo",
+                 body=json.dumps({"message": "http-hello"}),
+                 headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    assert r.status == 200
+    assert json.loads(r.read()) == {"message": "http-hello"}
+    conn.close()
+
+
+def test_json_rpc_error_maps_status(server):
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      server.listen_endpoint.port, timeout=5)
+    conn.request("POST", "/EchoService/Echo",
+                 body=json.dumps({"message": "x", "code": errors.ENOMETHOD}),
+                 headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    assert r.status == 404  # ENOMETHOD → 404
+    conn.close()
+
+
+def test_query_params_populate_request(server):
+    status, _, body = _get(server, "/EchoService/Echo?message=via-query")
+    assert status == 200
+    assert json.loads(body) == {"message": "via-query"}
+
+
+def test_pb_body_over_http(server):
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      server.listen_endpoint.port, timeout=5)
+    conn.request("POST", "/EchoService/Echo",
+                 body=echo_pb2.EchoRequest(message="pb-body").SerializeToString(),
+                 headers={"Content-Type": "application/proto"})
+    r = conn.getresponse()
+    assert r.status == 200
+    resp = echo_pb2.EchoResponse()
+    resp.ParseFromString(r.read())
+    assert resp.message == "pb-body"
+    conn.close()
+
+
+def test_http_client_channel(server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="http"))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    cntl, resp = ch.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message="via-http-channel"),
+                         echo_pb2.EchoResponse, timeout_ms=3000)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == "via-http-channel"
+    assert cntl.http_response.status_code == 200
+
+
+def test_http_client_channel_error(server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="http"))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    cntl, _ = ch.call("EchoService.Echo",
+                      echo_pb2.EchoRequest(message="x", code=errors.EPERM),
+                      echo_pb2.EchoResponse, timeout_ms=3000)
+    assert cntl.failed()
+    assert cntl.error_code == errors.EPERM  # carried via x-error-code
