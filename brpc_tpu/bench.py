@@ -1,0 +1,102 @@
+"""Benchmark harnesses — the multi_threaded_echo + rdma_performance analogs.
+
+echo_bench(): in-process loopback echo QPS with several client threads,
+instrumented with a bvar LatencyRecorder exactly like
+example/multi_threaded_echo_c++/client.cpp; reported against the
+reference's 500k+ QPS production claim (docs/en/overview.md:88,
+BASELINE.md).
+
+collective_bench(): achieved allreduce bandwidth on the available device
+mesh — the rdma_performance role (example/rdma_performance/client.cpp)
+with ICI collectives in place of verbs.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+BASELINE_QPS = 500_000.0  # docs/en/overview.md:88
+
+
+def echo_bench(n_threads: int = 8, duration_s: float = 3.0,
+               payload: int = 16) -> dict:
+    from brpc_tpu import bvar, rpc
+    from brpc_tpu.rpc.proto import echo_pb2
+
+    class EchoService(rpc.Service):
+        @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = request.message
+            done()
+
+    srv = rpc.Server(rpc.ServerOptions(num_threads=8,
+                                       has_builtin_services=False))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+
+    recorder = bvar.LatencyRecorder()
+    stop = threading.Event()
+    counts = [0] * n_threads
+    errors_seen = [0] * n_threads
+    msg = "x" * payload
+
+    def client_thread(idx: int):
+        ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=2000))
+        ch.init(str(srv.listen_endpoint))
+        req = echo_pb2.EchoRequest(message=msg)
+        while not stop.is_set():
+            t0 = time.monotonic()
+            cntl, resp = ch.call("EchoService.Echo", req,
+                                 echo_pb2.EchoResponse)
+            if cntl.failed():
+                errors_seen[idx] += 1
+                continue
+            recorder.update((time.monotonic() - t0) * 1e6)
+            counts[idx] += 1
+
+    threads = [threading.Thread(target=client_thread, args=(i,))
+               for i in range(n_threads)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(5)
+    elapsed = time.monotonic() - t_start
+    srv.stop()
+
+    total = sum(counts)
+    qps = total / elapsed
+    return {
+        "metric": "echo_qps_loopback",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / BASELINE_QPS, 4),
+        "extra": {
+            "threads": n_threads,
+            "requests": total,
+            "errors": sum(errors_seen),
+            "avg_latency_us": round(recorder.latency(), 1),
+            "p99_latency_us": round(recorder.latency_percentile(0.99), 1),
+        },
+    }
+
+
+def collective_bench(nbytes: int = 1 << 24, iters: int = 20) -> dict:
+    """Allreduce bandwidth on the real device(s) — rdma_performance role."""
+    import jax
+
+    from brpc_tpu import parallel
+
+    n = len(jax.devices())
+    mesh = parallel.make_mesh({"x": n})
+    stats = parallel.ici_bandwidth_probe(mesh, "x", nbytes=nbytes,
+                                         iters=iters)
+    return {
+        "metric": "allreduce_GBps",
+        "value": round(stats["allreduce_GBps"], 3),
+        "unit": "GB/s",
+        "vs_baseline": 0.0,  # no published RDMA GB/s in the reference
+        "extra": stats,
+    }

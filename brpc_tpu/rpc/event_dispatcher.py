@@ -33,6 +33,7 @@ class EventDispatcher:
         self._pending_lock = threading.Lock()
         self._read_consumers: Dict[int, Callable] = {}
         self._write_consumers: Dict[int, Callable] = {}
+        self._suspended: set = set()
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._started_lock = threading.Lock()
@@ -49,6 +50,15 @@ class EventDispatcher:
 
     def remove_consumer(self, fd: int):
         self._enqueue(("remove", fd, None))
+
+    def suspend_read(self, fd: int):
+        """Stop delivering read events while a reader drains the fd —
+        edge-trigger-and-rearm semantics over a level-triggered selector
+        (the consumer is re-armed by resume_read)."""
+        self._enqueue(("suspend_read", fd, None))
+
+    def resume_read(self, fd: int):
+        self._enqueue(("resume_read", fd, None))
 
     def start(self):
         with self._started_lock:
@@ -86,13 +96,23 @@ class EventDispatcher:
             try:
                 if kind == "add_read":
                     self._read_consumers[fd] = cb
+                    self._suspended.discard(fd)
                     self._reregister(fd)
+                elif kind == "suspend_read":
+                    if fd in self._read_consumers:
+                        self._suspended.add(fd)
+                        self._reregister(fd)
+                elif kind == "resume_read":
+                    if fd in self._read_consumers:
+                        self._suspended.discard(fd)
+                        self._reregister(fd)
                 elif kind == "add_write":
                     self._write_consumers[fd] = cb
                     self._reregister(fd)
                 elif kind == "remove":
                     self._read_consumers.pop(fd, None)
                     self._write_consumers.pop(fd, None)
+                    self._suspended.discard(fd)
                     try:
                         self._selector.unregister(fd)
                     except (KeyError, ValueError, OSError):
@@ -104,10 +124,16 @@ class EventDispatcher:
 
     def _reregister(self, fd: int):
         events = 0
-        if fd in self._read_consumers:
+        if fd in self._read_consumers and fd not in self._suspended:
             events |= selectors.EVENT_READ
         if fd in self._write_consumers:
             events |= selectors.EVENT_WRITE
+        if events == 0:
+            try:
+                self._selector.unregister(fd)
+            except (KeyError, ValueError, OSError):
+                pass
+            return
         try:
             self._selector.modify(fd, events, None)
         except KeyError:

@@ -69,6 +69,22 @@ class InputMessenger:
     def _cut_and_process(self, sock: Socket, read_eof: bool) -> bool:
         portal = sock.read_portal
         progressed = False
+        # Deferred batch: all-but-last spawn as tasks, the last runs in
+        # THIS task — the reference's process-in-place optimization saves
+        # one wakeup on the common single-message read.
+        deferred = []
+        try:
+            progressed = self._cut_loop(sock, read_eof, deferred)
+        finally:
+            for process, msg in deferred[:-1]:
+                start_background(self._process_safely, process, msg)
+            if deferred:
+                self._process_safely(*deferred[-1])
+        return progressed
+
+    def _cut_loop(self, sock: Socket, read_eof: bool, deferred) -> bool:
+        portal = sock.read_portal
+        progressed = False
         while not portal.empty():
             protocol = sock.matched_protocol
             result = None
@@ -113,7 +129,7 @@ class InputMessenger:
                 if protocol.process_inline:
                     self._process_safely(process, msg)
                 else:
-                    start_background(self._process_safely, process, msg)
+                    deferred.append((process, msg))
             elif result.error == ParseError.NOT_ENOUGH_DATA:
                 return progressed
             else:

@@ -198,7 +198,9 @@ class Socket:
     # -- read entry --------------------------------------------------------
     def start_input_event(self):
         """Dispatcher callback (Socket::StartInputEvent, socket.cpp:2312):
-        start one reader task unless one is already draining this socket."""
+        start one reader task unless one is already draining this socket.
+        The fd's read events are suspended while the reader runs (edge
+        trigger + re-arm, as the reference's EPOLLET delivers)."""
         with self._reading_lock:
             if self._reading or self._failed:
                 return
@@ -208,14 +210,20 @@ class Socket:
             with self._reading_lock:
                 self._reading = False
             return
-        start_background(self._run_input_handler, handler)
+        fd = self._fd
+        fdno = fd.fileno() if fd is not None else -1
+        if fdno >= 0:
+            get_global_dispatcher(fdno).suspend_read(fdno)
+        start_background(self._run_input_handler, handler, fdno)
 
-    def _run_input_handler(self, handler):
+    def _run_input_handler(self, handler, fdno: int):
         try:
             handler(self)
         finally:
             with self._reading_lock:
                 self._reading = False
+            if fdno >= 0 and not self._failed:
+                get_global_dispatcher(fdno).resume_read(fdno)
 
     # -- write path --------------------------------------------------------
     def write(self, buf: IOBuf, id_wait: Optional[int] = None) -> int:
