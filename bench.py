@@ -12,7 +12,18 @@ import time
 
 
 def bench_echo():
-    """Echo QPS over loopback using the framework's RPC stack."""
+    """Echo QPS over loopback using the framework's RPC stack. Headline is
+    the native C++ data path (multi_threaded_echo analog); falls back to
+    the pure-Python stack when the native toolchain is absent."""
+    try:
+        from brpc_tpu import native
+
+        if native.available():
+            from brpc_tpu.bench import native_echo_bench
+
+            return native_echo_bench()
+    except Exception:
+        pass
     from brpc_tpu.bench import echo_bench  # implemented with the rpc layer
 
     return echo_bench()

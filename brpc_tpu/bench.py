@@ -83,6 +83,40 @@ def echo_bench(n_threads: int = 8, duration_s: float = 3.0,
     }
 
 
+def native_echo_bench(nconn: int = 2, seconds: float = 3.0,
+                      payload: int = 16, pipeline: int = 128) -> dict:
+    """Native C++ data path: epoll echo server + pipelined clients, both
+    speaking the tpu_std wire format (native/src/echo_runtime.cpp). The
+    pipelined window plays the role of the reference's many concurrent
+    client bthreads (docs/cn/benchmark.md 单机1 setup)."""
+    from brpc_tpu import native
+
+    port = native.echo_server_start()
+    try:
+        sync = native.echo_client_bench("127.0.0.1", port, nconn=1,
+                                        seconds=1.0, payload=payload,
+                                        pipeline=1)
+        piped = native.echo_client_bench("127.0.0.1", port, nconn=nconn,
+                                         seconds=seconds, payload=payload,
+                                         pipeline=pipeline)
+    finally:
+        native.echo_server_stop()
+    qps = piped["qps"]
+    return {
+        "metric": "echo_qps_native",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / BASELINE_QPS, 4),
+        "extra": {
+            "connections": nconn,
+            "pipeline_depth": pipeline,
+            "payload_bytes": payload,
+            "requests": piped["requests"],
+            "sync_single_conn_qps": round(sync["qps"], 1),
+        },
+    }
+
+
 def collective_bench(nbytes: int = 1 << 24, iters: int = 20) -> dict:
     """Allreduce bandwidth on the real device(s) — rdma_performance role."""
     import jax

@@ -1,0 +1,127 @@
+"""brpc_tpu.native — ctypes bindings to the C++ core (native/).
+
+The native components mirror the reference's native layers (SURVEY.md
+section 2: C++ throughout): a ucontext M:N fiber scheduler with lock-free
+work stealing and butex (bthread's role), a refcounted-block IOBuf, a
+varint RpcMeta codec, and an epoll echo runtime wire-compatible with the
+Python tpu_std protocol. Built on demand with `make` (g++); cached .so.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libbrpc_tpu_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=300)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError):
+        return False
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            if not _build():
+                raise NativeUnavailable(
+                    "native core not built and toolchain unavailable")
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.nat_sched_start.argtypes = [ctypes.c_int]
+        lib.nat_sched_start.restype = ctypes.c_int
+        lib.nat_sched_stop.restype = None
+        lib.nat_sched_workers.restype = ctypes.c_int
+        lib.nat_sched_switches.restype = ctypes.c_uint64
+        lib.nat_bench_spawn_join.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.nat_bench_spawn_join.restype = ctypes.c_uint64
+        lib.nat_bench_ping_pong.argtypes = [ctypes.c_int]
+        lib.nat_bench_ping_pong.restype = ctypes.c_double
+        lib.nat_wsq_selftest.restype = ctypes.c_int
+        lib.nat_iobuf_selftest.restype = ctypes.c_int
+        lib.nat_meta_selftest.restype = ctypes.c_int
+        lib.nat_echo_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.nat_echo_server_start.restype = ctypes.c_int
+        lib.nat_echo_server_stop.restype = None
+        lib.nat_echo_server_requests.restype = ctypes.c_uint64
+        lib.nat_echo_client_bench.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.nat_echo_client_bench.restype = ctypes.c_double
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+# -- convenience wrappers --------------------------------------------------
+
+def sched_start(nworkers: int = 4) -> int:
+    return load().nat_sched_start(nworkers)
+
+
+def sched_stop():
+    load().nat_sched_stop()
+
+
+def bench_spawn_join(nfibers: int, rounds: int) -> int:
+    return load().nat_bench_spawn_join(nfibers, rounds)
+
+
+def bench_ping_pong(rounds: int = 10000) -> float:
+    """Returns ns per fiber ping-pong round trip."""
+    return load().nat_bench_ping_pong(rounds)
+
+
+def echo_server_start(ip: str = "127.0.0.1", port: int = 0) -> int:
+    """Starts the native echo server; returns the bound port."""
+    rc = load().nat_echo_server_start(ip.encode(), port)
+    if rc <= 0:
+        raise RuntimeError("native echo server failed to start")
+    return rc
+
+
+def echo_server_stop():
+    load().nat_echo_server_stop()
+
+
+def echo_server_requests() -> int:
+    return load().nat_echo_server_requests()
+
+
+def echo_client_bench(ip: str, port: int, nconn: int = 2,
+                      seconds: float = 2.0, payload: int = 16,
+                      pipeline: int = 32) -> dict:
+    out_requests = ctypes.c_uint64(0)
+    qps = load().nat_echo_client_bench(ip.encode(), port, nconn, seconds,
+                                       payload, pipeline,
+                                       ctypes.byref(out_requests))
+    return {"qps": qps, "requests": out_requests.value}

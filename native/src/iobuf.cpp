@@ -1,0 +1,152 @@
+#include "iobuf.h"
+
+#include <errno.h>
+#include <unistd.h>
+#include <algorithm>
+
+namespace brpc_tpu {
+
+static thread_local IOBlock* tls_block = nullptr;  // share_tls_block analog
+
+static IOBlock* tls_share_block() {
+  if (tls_block == nullptr || tls_block->left() == 0) {
+    if (tls_block) tls_block->release();
+    tls_block = IOBlock::create();
+  }
+  return tls_block;
+}
+
+void IOBuf::push_ref(IOBlock* b, uint32_t off, uint32_t len) {
+  if (len == 0) return;
+  if (!refs_.empty()) {
+    BlockRef& tail = refs_.back();
+    if (tail.block == b && tail.offset + tail.length == off) {
+      tail.length += len;  // merge contiguous refs
+      length_ += len;
+      return;
+    }
+  }
+  b->add_ref();
+  refs_.push_back({b, off, len});
+  length_ += len;
+}
+
+void IOBuf::append(const void* data, size_t n) {
+  const char* p = (const char*)data;
+  while (n > 0) {
+    IOBlock* b = tls_share_block();
+    size_t take = std::min(n, b->left());
+    memcpy(b->data + b->size, p, take);
+    push_ref(b, (uint32_t)b->size, (uint32_t)take);
+    b->size += take;
+    p += take;
+    n -= take;
+  }
+}
+
+void IOBuf::append(const IOBuf& other) {
+  for (const auto& r : other.refs_) {
+    r.block->add_ref();
+    refs_.push_back(r);
+    length_ += r.length;
+  }
+}
+
+size_t IOBuf::cut_into(IOBuf* out, size_t n) {
+  n = std::min(n, length_);
+  size_t remain = n;
+  while (remain > 0) {
+    BlockRef& r = refs_.front();
+    if (r.length <= remain) {
+      out->refs_.push_back(r);  // transfer ref ownership
+      out->length_ += r.length;
+      remain -= r.length;
+      length_ -= r.length;
+      refs_.pop_front();
+    } else {
+      r.block->add_ref();
+      out->refs_.push_back({r.block, r.offset, (uint32_t)remain});
+      out->length_ += remain;
+      r.offset += remain;
+      r.length -= remain;
+      length_ -= remain;
+      remain = 0;
+    }
+  }
+  return n;
+}
+
+size_t IOBuf::pop_front(size_t n) {
+  n = std::min(n, length_);
+  size_t remain = n;
+  while (remain > 0) {
+    BlockRef& r = refs_.front();
+    if (r.length <= remain) {
+      remain -= r.length;
+      length_ -= r.length;
+      r.block->release();
+      refs_.pop_front();
+    } else {
+      r.offset += remain;
+      r.length -= remain;
+      length_ -= remain;
+      remain = 0;
+    }
+  }
+  return n;
+}
+
+size_t IOBuf::copy_to(void* out, size_t n, size_t pos) const {
+  char* dst = (char*)out;
+  size_t copied = 0, skip = pos;
+  for (const auto& r : refs_) {
+    if (copied >= n) break;
+    if (skip >= r.length) {
+      skip -= r.length;
+      continue;
+    }
+    size_t take = std::min((size_t)r.length - skip, n - copied);
+    memcpy(dst + copied, r.block->data + r.offset + skip, take);
+    copied += take;
+    skip = 0;
+  }
+  return copied;
+}
+
+std::string IOBuf::to_string() const {
+  std::string s;
+  s.resize(length_);
+  copy_to(&s[0], length_);
+  return s;
+}
+
+ssize_t IOBuf::cut_into_fd(int fd, size_t max_bytes) {
+  struct iovec iov[64];
+  int niov = 0;
+  size_t queued = 0;
+  for (const auto& r : refs_) {
+    if (niov >= 64 || queued >= max_bytes) break;
+    size_t take = std::min((size_t)r.length, max_bytes - queued);
+    iov[niov].iov_base = r.block->data + r.offset;
+    iov[niov].iov_len = take;
+    niov++;
+    queued += take;
+  }
+  if (niov == 0) return 0;
+  ssize_t nw = writev(fd, iov, niov);
+  if (nw > 0) pop_front((size_t)nw);
+  return nw;
+}
+
+ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes) {
+  IOBlock* b = tls_share_block();
+  size_t want = std::min(max_bytes, b->left());
+  ssize_t n = read(fd, b->data + b->size, want);
+  if (n > 0) {
+    push_ref(b, (uint32_t)b->size, (uint32_t)n);
+    b->size += (size_t)n;
+  }
+  return n;
+}
+
+}  // namespace brpc_tpu

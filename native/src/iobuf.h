@@ -1,0 +1,78 @@
+// IOBuf — refcounted non-contiguous buffer, native counterpart of
+// butil::IOBuf (/root/reference/src/butil/iobuf.h:64): chains of
+// (block, offset, length) refs over 8KB refcounted blocks; append/cut move
+// refs, not bytes; scatter-gather fd IO via readv/writev.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <sys/uio.h>
+
+namespace brpc_tpu {
+
+struct IOBlock {
+  static const size_t kSize = 8192;  // iobuf.h:70
+  std::atomic<int> ref{1};
+  size_t size = 0;  // filled prefix
+  char data[kSize];
+
+  static IOBlock* create() { return new IOBlock(); }
+  void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
+  void release() {
+    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+  }
+  size_t left() const { return kSize - size; }
+};
+
+struct BlockRef {
+  IOBlock* block;
+  uint32_t offset;
+  uint32_t length;
+};
+
+class IOBuf {
+ public:
+  IOBuf() = default;
+  ~IOBuf() { clear(); }
+  IOBuf(const IOBuf& other) { append(other); }
+  IOBuf& operator=(const IOBuf& other) {
+    if (this != &other) {
+      clear();
+      append(other);
+    }
+    return *this;
+  }
+
+  size_t length() const { return length_; }
+  bool empty() const { return length_ == 0; }
+
+  void clear() {
+    for (auto& r : refs_) r.block->release();
+    refs_.clear();
+    length_ = 0;
+  }
+
+  void append(const void* data, size_t n);
+  void append(const std::string& s) { append(s.data(), s.size()); }
+  void append(const IOBuf& other);  // zero-copy ref share
+
+  // move first n bytes of this into out (zero-copy)
+  size_t cut_into(IOBuf* out, size_t n);
+  size_t pop_front(size_t n);
+  size_t copy_to(void* out, size_t n, size_t pos = 0) const;
+  std::string to_string() const;
+
+  // scatter-gather IO
+  ssize_t cut_into_fd(int fd, size_t max_bytes = SIZE_MAX);
+  ssize_t append_from_fd(int fd, size_t max_bytes = 65536);
+
+ private:
+  void push_ref(IOBlock* b, uint32_t off, uint32_t len);
+  std::deque<BlockRef> refs_;
+  size_t length_ = 0;
+};
+
+}  // namespace brpc_tpu

@@ -1,0 +1,223 @@
+// Minimal protobuf varint codec for the tpu_std RpcMeta subset — enough to
+// speak brpc_tpu/rpc/proto/rpc_meta.proto on the wire without a protobuf
+// dependency (the native fast path of the tpu_std framing,
+// baidu_rpc_protocol.cpp:95-137 role).
+//
+// Fields handled: RpcMeta{request{service_name=1, method_name=2},
+// response{error_code=1, error_text=2}, compress_type=3, correlation_id=4,
+// attachment_size=5}. Unknown fields are skipped on decode.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace brpc_tpu {
+
+struct RpcRequestMetaN {
+  std::string service_name;
+  std::string method_name;
+};
+
+struct RpcResponseMetaN {
+  int32_t error_code = 0;
+  std::string error_text;
+};
+
+struct RpcMetaN {
+  bool has_request = false;
+  bool has_response = false;
+  RpcRequestMetaN request;
+  RpcResponseMetaN response;
+  int32_t compress_type = 0;
+  int64_t correlation_id = 0;
+  int64_t attachment_size = 0;
+};
+
+// ---- varint primitives ----
+
+inline void put_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back((char)((v & 0x7F) | 0x80));
+    v >>= 7;
+  }
+  out.push_back((char)v);
+}
+
+inline bool get_varint(const char*& p, const char* end, uint64_t* v) {
+  uint64_t r = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = (uint8_t)*p++;
+    r |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *v = r;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+inline void put_tag(std::string& out, int field, int wire) {
+  put_varint(out, (uint64_t)(field << 3 | wire));
+}
+
+inline void put_str(std::string& out, int field, const std::string& s) {
+  if (s.empty()) return;
+  put_tag(out, field, 2);
+  put_varint(out, s.size());
+  out += s;
+}
+
+inline void put_int(std::string& out, int field, int64_t v) {
+  if (v == 0) return;
+  put_tag(out, field, 0);
+  put_varint(out, (uint64_t)v);
+}
+
+// ---- RpcMeta encode ----
+
+inline std::string encode_request_meta(const RpcMetaN& m) {
+  std::string req;
+  put_str(req, 1, m.request.service_name);
+  put_str(req, 2, m.request.method_name);
+  std::string out;
+  put_tag(out, 1, 2);  // request submessage
+  put_varint(out, req.size());
+  out += req;
+  put_int(out, 3, m.compress_type);
+  put_int(out, 4, m.correlation_id);
+  put_int(out, 5, m.attachment_size);
+  return out;
+}
+
+inline std::string encode_response_meta(const RpcMetaN& m) {
+  std::string resp;
+  put_int(resp, 1, m.response.error_code);
+  put_str(resp, 2, m.response.error_text);
+  std::string out;
+  if (!resp.empty()) {
+    put_tag(out, 2, 2);  // response submessage
+    put_varint(out, resp.size());
+    out += resp;
+  } else {
+    // proto3 parsers need the field present to see HasField("response"):
+    put_tag(out, 2, 2);
+    put_varint(out, 0);
+  }
+  put_int(out, 3, m.compress_type);
+  put_int(out, 4, m.correlation_id);
+  put_int(out, 5, m.attachment_size);
+  return out;
+}
+
+// ---- RpcMeta decode ----
+
+inline bool skip_field(const char*& p, const char* end, int wire) {
+  uint64_t tmp;
+  switch (wire) {
+    case 0:
+      return get_varint(p, end, &tmp);
+    case 1:
+      if (end - p < 8) return false;
+      p += 8;
+      return true;
+    case 2: {
+      if (!get_varint(p, end, &tmp) || (uint64_t)(end - p) < tmp) return false;
+      p += tmp;
+      return true;
+    }
+    case 5:
+      if (end - p < 4) return false;
+      p += 4;
+      return true;
+  }
+  return false;
+}
+
+inline bool decode_submessage(const char* p, const char* end, RpcMetaN* m,
+                              bool is_request) {
+  while (p < end) {
+    uint64_t tag;
+    if (!get_varint(p, end, &tag)) return false;
+    int field = (int)(tag >> 3), wire = (int)(tag & 7);
+    if (is_request && field == 1 && wire == 2) {
+      uint64_t len;
+      if (!get_varint(p, end, &len) || (uint64_t)(end - p) < len) return false;
+      m->request.service_name.assign(p, len);
+      p += len;
+    } else if (is_request && field == 2 && wire == 2) {
+      uint64_t len;
+      if (!get_varint(p, end, &len) || (uint64_t)(end - p) < len) return false;
+      m->request.method_name.assign(p, len);
+      p += len;
+    } else if (!is_request && field == 1 && wire == 0) {
+      uint64_t v;
+      if (!get_varint(p, end, &v)) return false;
+      m->response.error_code = (int32_t)v;
+    } else if (!is_request && field == 2 && wire == 2) {
+      uint64_t len;
+      if (!get_varint(p, end, &len) || (uint64_t)(end - p) < len) return false;
+      m->response.error_text.assign(p, len);
+      p += len;
+    } else if (!skip_field(p, end, wire)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+inline bool decode_meta(const char* data, size_t size, RpcMetaN* m) {
+  const char* p = data;
+  const char* end = data + size;
+  while (p < end) {
+    uint64_t tag;
+    if (!get_varint(p, end, &tag)) return false;
+    int field = (int)(tag >> 3), wire = (int)(tag & 7);
+    switch (field) {
+      case 1: {  // request
+        uint64_t len;
+        if (wire != 2 || !get_varint(p, end, &len) ||
+            (uint64_t)(end - p) < len)
+          return false;
+        m->has_request = true;
+        if (!decode_submessage(p, p + len, m, true)) return false;
+        p += len;
+        break;
+      }
+      case 2: {  // response
+        uint64_t len;
+        if (wire != 2 || !get_varint(p, end, &len) ||
+            (uint64_t)(end - p) < len)
+          return false;
+        m->has_response = true;
+        if (!decode_submessage(p, p + len, m, false)) return false;
+        p += len;
+        break;
+      }
+      case 3: {
+        uint64_t v;
+        if (!get_varint(p, end, &v)) return false;
+        m->compress_type = (int32_t)v;
+        break;
+      }
+      case 4: {
+        uint64_t v;
+        if (!get_varint(p, end, &v)) return false;
+        m->correlation_id = (int64_t)v;
+        break;
+      }
+      case 5: {
+        uint64_t v;
+        if (!get_varint(p, end, &v)) return false;
+        m->attachment_size = (int64_t)v;
+        break;
+      }
+      default:
+        if (!skip_field(p, end, wire)) return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace brpc_tpu

@@ -1,0 +1,288 @@
+// See scheduler.h. Fiber switching uses ucontext (portable stand-in for the
+// reference's fcontext asm, bthread/context.cpp); stacks are mmap'd with a
+// guard page like bthread's StackPool (stack_inl.h:36-105).
+#include "scheduler.h"
+
+#include <sys/mman.h>
+#include <cassert>
+#include <chrono>
+#include <cstdio>
+#include <random>
+
+namespace brpc_tpu {
+
+static thread_local Worker* tls_worker = nullptr;
+
+// Fiber bodies migrate threads across swapcontext, but -O2 CSEs the TLS
+// address within a function (it assumes one thread per activation). Every
+// read that can happen AFTER a potential migration must go through this
+// noinline accessor so the DTV lookup is redone on the current thread.
+__attribute__((noinline)) static Worker* current_worker() {
+  Worker* w = tls_worker;
+  asm volatile("" : "+r"(w));  // defeat IPA/CSE across calls
+  return w;
+}
+
+static const size_t kStackSize = 256 * 1024;
+
+static char* alloc_stack(size_t size) {
+  void* mem = mmap(nullptr, size + 4096, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (mem == MAP_FAILED) return nullptr;
+  mprotect(mem, 4096, PROT_NONE);  // guard page
+  return (char*)mem + 4096;
+}
+
+static void free_stack(char* stack, size_t size) {
+  munmap(stack - 4096, size + 4096);
+}
+
+void Worker::signal() {
+  park_signal.fetch_add(1, std::memory_order_release);
+  {
+    std::lock_guard<std::mutex> g(park_mu);
+  }
+  park_cv.notify_one();
+}
+
+Scheduler* Scheduler::instance() {
+  static Scheduler s;
+  return &s;
+}
+
+int Scheduler::start(int nworkers) {
+  if (started_) return 0;
+  stopping_ = false;
+  for (int i = 0; i < nworkers; i++) {
+    Worker* w = new Worker();
+    w->sched = this;
+    w->id = i;
+    workers_.push_back(w);
+  }
+  for (Worker* w : workers_) {
+    w->thread = std::thread([this, w] { worker_loop(w); });
+  }
+  started_ = true;
+  return 0;
+}
+
+void Scheduler::stop() {
+  if (!started_) return;
+  stopping_ = true;
+  for (Worker* w : workers_) w->signal();
+  for (Worker* w : workers_) {
+    if (w->thread.joinable()) w->thread.join();
+  }
+  for (Worker* w : workers_) delete w;
+  workers_.clear();
+  started_ = false;
+}
+
+static void fiber_trampoline();
+
+Fiber* Scheduler::spawn(FiberFn fn, void* arg) {
+  Fiber* f = new Fiber();
+  f->fn = fn;
+  f->arg = arg;
+  f->stack = alloc_stack(kStackSize);
+  f->stack_size = kStackSize;
+  getcontext(&f->ctx);
+  f->ctx.uc_stack.ss_sp = f->stack;
+  f->ctx.uc_stack.ss_size = f->stack_size;
+  f->ctx.uc_link = nullptr;
+  makecontext(&f->ctx, (void (*)())fiber_trampoline, 0);
+  ready_fiber(f);
+  return f;
+}
+
+void Scheduler::ready_fiber(Fiber* f) {
+  f->state.store(FiberState::READY, std::memory_order_release);
+  Worker* w = current_worker();
+  if (w != nullptr) {
+    if (w->rq.push(f)) {
+      // A sibling may be parked while our local queue fills: poke one.
+      Worker* peer =
+          workers_[(w->id + 1) % workers_.size()];
+      if (peer != w) peer->signal();
+      return;
+    }
+  }
+  // From a non-worker thread (or full local queue): remote-queue a worker
+  // round-robin and wake it (start_background REMOTE path).
+  uint32_t idx = next_worker_.fetch_add(1) % workers_.size();
+  Worker* target = workers_[idx];
+  {
+    std::lock_guard<std::mutex> g(target->remote_mu);
+    target->remote_rq.push_back(f);
+  }
+  target->signal();
+}
+
+Fiber* Scheduler::next_task(Worker* w) {
+  Fiber* f = nullptr;
+  if (w->rq.pop(&f)) return f;
+  {
+    std::lock_guard<std::mutex> g(w->remote_mu);
+    if (!w->remote_rq.empty()) {
+      f = w->remote_rq.front();
+      w->remote_rq.pop_front();
+      return f;
+    }
+  }
+  // steal (task_control.h:55)
+  static thread_local std::mt19937 rng(std::random_device{}());
+  size_t n = workers_.size();
+  if (n > 1) {
+    size_t start = rng() % n;
+    for (size_t i = 0; i < n; i++) {
+      Worker* v = workers_[(start + i) % n];
+      if (v == w) continue;
+      if (v->rq.steal(&f)) return f;
+      {
+        std::lock_guard<std::mutex> g(v->remote_mu);
+        if (!v->remote_rq.empty()) {
+          f = v->remote_rq.front();
+          v->remote_rq.pop_front();
+          return f;
+        }
+      }
+    }
+  }
+  return nullptr;
+}
+
+static void fiber_trampoline() {
+  Worker* w = current_worker();
+  Fiber* f = w->current;
+  f->fn(f->arg);
+  // The body may have blocked and been stolen: we can resume on a
+  // DIFFERENT worker than the one that first ran us. Always finish
+  // against the worker this thread belongs to now.
+  w = current_worker();
+  f->state.store(FiberState::DONE, std::memory_order_release);
+  // Publish completion only after leaving this stack: a joiner frees the
+  // stack, so the wake must happen from the worker loop (ending_sched).
+  w->remained = [f]() {
+    f->join_butex.value.store(1, std::memory_order_release);
+    Scheduler::butex_wake(&f->join_butex, INT32_MAX);
+  };
+  swapcontext(&f->ctx, &w->main_ctx);
+}
+
+void Scheduler::run_fiber(Worker* w, Fiber* f) {
+  w->current = f;
+  f->state.store(FiberState::RUNNING, std::memory_order_release);
+  w->nswitch++;
+  swapcontext(&w->main_ctx, &f->ctx);
+  w->current = nullptr;
+  if (w->remained) {
+    auto r = std::move(w->remained);
+    w->remained = nullptr;
+    r();
+  }
+}
+
+void Scheduler::worker_loop(Worker* w) {
+  tls_worker = w;
+  while (!stopping_.load(std::memory_order_acquire)) {
+    Fiber* f = next_task(w);
+    if (f != nullptr) {
+      run_fiber(w, f);
+      continue;
+    }
+    // idle: run hooks (the libtpu/ext-processor seam), then park
+    bool did_work = false;
+    {
+      std::lock_guard<std::mutex> g(hooks_mu_);
+      for (auto& h : idle_hooks_) did_work |= h();
+    }
+    if (did_work) continue;
+    uint32_t expected = w->park_signal.load(std::memory_order_acquire);
+    std::unique_lock<std::mutex> lk(w->park_mu);
+    if (w->park_signal.load(std::memory_order_acquire) != expected) continue;
+    w->park_cv.wait_for(lk, std::chrono::milliseconds(100));
+  }
+  tls_worker = nullptr;
+}
+
+void Scheduler::yield() {
+  Worker* w = current_worker();
+  if (w == nullptr || w->current == nullptr) return;
+  Fiber* f = w->current;
+  // Requeue only after switching out (remained), else a thief could run
+  // this fiber while it is still on this stack.
+  w->remained = [w, f]() {
+    f->state.store(FiberState::READY, std::memory_order_release);
+    w->sched->ready_fiber(f);
+  };
+  swapcontext(&f->ctx, &w->main_ctx);
+}
+
+Fiber* Scheduler::current() {
+  Worker* w = current_worker();
+  return w ? w->current : nullptr;
+}
+
+bool Scheduler::butex_wait(Butex* b, int32_t expected) {
+  Worker* w = current_worker();
+  if (w == nullptr || w->current == nullptr) {
+    // pthread waiter (reference: real futex path, butex.cpp:297): spin+sleep
+    while (b->value.load(std::memory_order_acquire) == expected) {
+      std::this_thread::sleep_for(std::chrono::microseconds(50));
+    }
+    return true;
+  }
+  Fiber* f = w->current;
+  if (b->value.load(std::memory_order_acquire) != expected) return false;
+  f->state.store(FiberState::BLOCKED, std::memory_order_release);
+  // Enqueue to the waiter list only after leaving this stack; the lambda
+  // rechecks the value so a concurrent change-then-wake is never missed
+  // (the butex_wait ordering discipline of butex.cpp:258).
+  Scheduler* s = w->sched;
+  w->remained = [b, f, expected, s]() {
+    std::unique_lock<std::mutex> g(b->mu);
+    if (b->value.load(std::memory_order_acquire) != expected) {
+      g.unlock();
+      s->ready_fiber(f);  // value already moved: spurious-wake ourselves
+    } else {
+      b->waiters.push_back(f);
+    }
+  };
+  swapcontext(&f->ctx, &w->main_ctx);  // parked; wake requeues us
+  return true;
+}
+
+int Scheduler::butex_wake(Butex* b, int n) {
+  std::deque<Fiber*> woken;
+  {
+    std::lock_guard<std::mutex> g(b->mu);
+    while (!b->waiters.empty() && n-- > 0) {
+      woken.push_back(b->waiters.front());
+      b->waiters.pop_front();
+    }
+  }
+  Scheduler* s = Scheduler::instance();
+  for (Fiber* f : woken) s->ready_fiber(f);
+  return (int)woken.size();
+}
+
+void Scheduler::join(Fiber* f) {
+  // Single-joiner contract. From a non-fiber thread this spins on the
+  // butex; from a fiber it parks.
+  while (f->join_butex.value.load(std::memory_order_acquire) == 0) {
+    butex_wait(&f->join_butex, 0);
+  }
+  // Synchronize with the completion wake: once we hold/release the butex
+  // mutex, the finishing worker is done touching the waiter list.
+  { std::lock_guard<std::mutex> g(f->join_butex.mu); }
+  free_stack(f->stack, f->stack_size);
+  delete f;
+}
+
+uint64_t Scheduler::total_switches() const {
+  uint64_t total = 0;
+  for (Worker* w : workers_) total += w->nswitch;
+  return total;
+}
+
+}  // namespace brpc_tpu

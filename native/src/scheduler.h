@@ -1,0 +1,114 @@
+// M:N fiber scheduler — the native bthread core.
+//
+// Counterpart of bthread's TaskControl/TaskGroup/butex
+// (/root/reference/src/bthread/task_control.h, task_group.cpp, butex.cpp):
+// N worker pthreads, each owning a lock-free work-stealing runqueue and a
+// parking lot; fibers are ucontext stacks (the role of the hand-written
+// fcontext asm, bthread/context.cpp); butex gives fibers futex-shaped
+// blocking; the idle loop accepts pluggable hooks — the seam where the
+// monographdb fork runs io_uring/ext-processor work and where a TPU build
+// polls libtpu completions (SURVEY.md section 2.10).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <ucontext.h>
+#include <vector>
+
+#include "wsq.h"
+
+namespace brpc_tpu {
+
+using FiberFn = void (*)(void*);
+
+struct Fiber;
+class Scheduler;
+
+struct Butex {
+  std::atomic<int32_t> value{0};
+  std::mutex mu;
+  std::deque<Fiber*> waiters;
+};
+
+enum class FiberState : uint8_t { READY, RUNNING, BLOCKED, DONE };
+
+struct Fiber {
+  ucontext_t ctx;
+  char* stack = nullptr;
+  size_t stack_size = 0;
+  FiberFn fn = nullptr;
+  void* arg = nullptr;
+  std::atomic<FiberState> state{FiberState::READY};
+  Butex join_butex;  // value 0 = running, 1 = done
+};
+
+class Worker {
+ public:
+  WorkStealingQueue<Fiber*> rq;
+  std::mutex remote_mu;
+  std::deque<Fiber*> remote_rq;
+  // parking lot (per worker, as in the fork: task_control.h:123-126)
+  std::mutex park_mu;
+  std::condition_variable park_cv;
+  std::atomic<uint32_t> park_signal{0};
+  std::thread thread;
+  Scheduler* sched = nullptr;
+  int id = 0;
+  ucontext_t main_ctx;  // the worker loop's context
+  Fiber* current = nullptr;
+  uint64_t nswitch = 0;
+  // Runs on the worker loop right after a fiber switches out — the
+  // remained-callback mechanism (task_group.h:114-118) that lets a fiber
+  // publish itself to a wait queue only AFTER it left its own stack.
+  std::function<void()> remained;
+
+  void signal();
+};
+
+class Scheduler {
+ public:
+  static Scheduler* instance();
+
+  int start(int nworkers);
+  void stop();
+  bool started() const { return started_; }
+  int nworkers() const { return (int)workers_.size(); }
+
+  Fiber* spawn(FiberFn fn, void* arg);
+  void join(Fiber* f);
+  static void yield();        // from inside a fiber
+  static Fiber* current();    // running fiber or nullptr
+
+  // butex API (butex.h:36-71 analog)
+  static bool butex_wait(Butex* b, int32_t expected);
+  static int butex_wake(Butex* b, int n);
+
+  void add_idle_hook(std::function<bool()> hook) {
+    std::lock_guard<std::mutex> g(hooks_mu_);
+    idle_hooks_.push_back(std::move(hook));
+  }
+
+  uint64_t total_switches() const;
+
+  // internal
+  void worker_loop(Worker* w);
+  void ready_fiber(Fiber* f);  // requeue a woken fiber
+
+ private:
+  std::vector<Worker*> workers_;
+  std::atomic<bool> stopping_{false};
+  bool started_ = false;
+  std::atomic<uint32_t> next_worker_{0};
+  std::mutex hooks_mu_;
+  std::vector<std::function<bool()>> idle_hooks_;
+
+  Fiber* next_task(Worker* w);
+  void run_fiber(Worker* w, Fiber* f);
+};
+
+}  // namespace brpc_tpu
