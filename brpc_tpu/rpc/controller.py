@@ -108,6 +108,12 @@ class Controller:
         # http (http_protocol.py): request/response objects on either side
         self.http_request = None
         self.http_response = None
+        # tensor lane (device_transport.py): outbound arrays on the client,
+        # inbound/outbound RpcMeta handles on the server
+        self._outbound_tensors = None
+        self._rpc_meta = None
+        self._response_meta = None
+        self._response_rpc_meta = None
         # tracing
         self.trace_id = 0
         self.span_id = 0
@@ -231,6 +237,7 @@ class Controller:
 
     def _on_response(self, meta, payload: bytes, attachment: IOBuf, sock):
         """Called by the protocol's process_response with the id locked."""
+        self._response_rpc_meta = meta
         if meta.stream_id and self._request_stream is not None:
             # Stream setup completed: learn the peer endpoint id and bind
             # to the RPC's connection (stream.cpp SetConnected path).

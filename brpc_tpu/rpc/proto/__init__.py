@@ -1,1 +1,5 @@
-from brpc_tpu.rpc.proto import echo_pb2, rpc_meta_pb2  # noqa: F401
+from brpc_tpu.rpc.proto import (  # noqa: F401
+    echo_pb2,
+    rpc_meta_pb2,
+    tensor_service_pb2,
+)

@@ -100,6 +100,7 @@ class Protocol:
 
 _protocols: Dict[ProtocolType, Protocol] = {}
 _lock = threading.Lock()
+_globally_initialized = False
 
 
 def register_protocol(protocol: Protocol):
@@ -128,9 +129,12 @@ def list_server_protocols() -> List[Protocol]:
 def globally_initialize():
     """GlobalInitializeOrDie's role (global.cpp:354-606): register every
     built-in protocol / LB / NS / compressor exactly once."""
+    global _globally_initialized
     with _lock:
-        if _protocols:
+        if _globally_initialized:
             return
+        _globally_initialized = True
     from brpc_tpu.rpc import tpu_std_protocol  # noqa: F401 (self-registers)
     from brpc_tpu.rpc import http_protocol  # noqa: F401
     from brpc_tpu.rpc import streaming_protocol  # noqa: F401
+    from brpc_tpu.rpc import tensor_service  # noqa: F401 (device handshake)

@@ -171,12 +171,15 @@ class Socket:
             self.local_side = EndPoint(host, port)
         except OSError:
             pass
-        self._register_with_dispatcher()
+        # AppConnect runs BEFORE dispatcher registration so the handshake
+        # owns the connection's first bytes (the RDMA TCP-handshake order,
+        # rdma_endpoint.h:94-115).
         if self.app_connect is not None:
             rc = self.app_connect(self)
             if rc != 0:
                 self.set_failed(rc, "app connect failed")
                 return rc
+        self._register_with_dispatcher()
         return 0
 
     def ensure_connected(self, timeout_s: float = 1.0) -> int:

@@ -149,6 +149,17 @@ def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf
     meta.compress_type = cntl.compress_type
     if cntl._request_stream is not None:
         meta.stream_id = cntl._request_stream.stream_id
+    if cntl._outbound_tensors:
+        # Tensor lane: the socket's DeviceEndpoint (or a per-call fallback)
+        # fills meta.tensors + attachment (device_transport.py).
+        from brpc_tpu.rpc.device_transport import DeviceEndpoint
+
+        ep = (cntl._current_sock.app_state
+              if cntl._current_sock is not None else None)
+        if not isinstance(ep, DeviceEndpoint):
+            ep = DeviceEndpoint()
+        ep.prepare_send(cntl._outbound_tensors, meta,
+                        cntl.request_attachment)
     payload = compress_mod.compress(payload, cntl.compress_type)
     return pack_frame(meta, payload, cntl.request_attachment)
 
@@ -176,7 +187,8 @@ def process_response(msg: RpcMessage):
 def send_rpc_response(sock, correlation_id: int, cntl: Controller,
                       response, attachment: IOBuf):
     """SendRpcResponse analog (baidu_rpc_protocol.cpp:139)."""
-    meta = rpc_meta_pb2.RpcMeta()
+    # Handlers may have pre-filled tensors into the response meta.
+    meta = cntl._response_meta or rpc_meta_pb2.RpcMeta()
     meta.correlation_id = correlation_id
     meta.response.error_code = cntl.error_code_value
     if cntl.error_code_value:
@@ -212,6 +224,8 @@ def process_request(msg: RpcMessage):
     cntl.request_attachment = msg.attachment
     cntl._remote_stream_id = meta.stream_id
     cntl._server_socket = sock
+    cntl._rpc_meta = meta
+    cntl._response_meta = rpc_meta_pb2.RpcMeta()
     cntl.server_start_time = time.monotonic()
     if meta.request.timeout_ms > 0:
         cntl.timeout_ms = meta.request.timeout_ms
