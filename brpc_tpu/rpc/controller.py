@@ -158,6 +158,9 @@ class Controller:
         self._call_id = bthread_id.create_ranged(
             self, self._on_error, self.max_retry + 2
         )
+        from brpc_tpu import rpcz
+
+        self.span = rpcz.start_client_span(method_full_name, self)
         _client_count.update(1)
 
     def current_attempt_id(self) -> int:
@@ -264,6 +267,11 @@ class Controller:
             timer_del(self._backup_timer)
             self._backup_timer = None
         self.latency_us = (time.monotonic() - self._start_time) * 1e6
+        if self.span is not None:
+            self.span.remote_side = (str(self.remote_side)
+                                     if self.remote_side else None)
+            self.span.end(self.error_code_value)
+            self.span = None
         for sid in self._accessed_sids:
             from brpc_tpu.rpc.socket import Socket
 

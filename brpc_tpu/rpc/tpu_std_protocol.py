@@ -270,19 +270,31 @@ def process_request(msg: RpcMessage):
     response = method_info.response_class()
     responded = [False]
 
+    from brpc_tpu import rpcz
+
+    span = rpcz.start_server_span(
+        f"{cntl.service_name}.{cntl.method_name}", meta, sock.remote_side)
+    cntl.span = span
+    if span is not None:
+        span.request_size = len(msg.payload)
+
     def done():
         if responded[0]:
             return
         responded[0] = True
         method_status.on_response(cntl.error_code_value,
                                   cntl.server_start_time)
+        if span is not None:
+            span.end(cntl.error_code_value)
         send_rpc_response(sock, cid, cntl, response,
                           cntl.response_attachment)
 
     # The handler owns `done` (may call it asynchronously later); we only
-    # respond for it if it raises before responding.
+    # respond for it if it raises before responding. Nested client calls
+    # made by the handler parent under this span (tls_bls parenting).
     try:
-        method_info.handler(service_obj, cntl, request, response, done)
+        with rpcz.parent_scope(span):
+            method_info.handler(service_obj, cntl, request, response, done)
     except Exception as e:
         if not responded[0]:
             cntl.set_failed(errors.EINVAL, f"method raised: {e}")

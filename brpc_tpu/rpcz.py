@@ -1,0 +1,186 @@
+"""rpcz — per-call tracing spans.
+
+Counterpart of brpc's rpcz (SURVEY.md section 5; span.h:47-224,
+builtin/rpcz_service): a Span per server/client call; nested client calls
+parent under the enclosing server span via thread-local state (the
+tls_bls.rpcz_parent_span trick, span.h:76,116); trace/span ids propagate in
+the RpcMeta; spans are sampled into a bounded collector (the
+bvar::Collector role with its global sample budget, collector.h:40) and
+browsable at /rpcz. Annotate() adds free-text timeline entries.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Deque, List, Optional
+
+from brpc_tpu.butil import flags
+
+flags.define_bool("enable_rpcz", True, "collect rpcz spans")
+flags.define_int("rpcz_max_spans", 4096,
+                 "bounded span store (collector budget analog)")
+flags.define_int("rpcz_sample_every", 1,
+                 "keep 1 of every N spans (sampling rate limit)")
+
+_tls = threading.local()
+
+
+class Span:
+    __slots__ = (
+        "trace_id", "span_id", "parent_span_id", "kind", "full_method",
+        "remote_side", "start_time", "end_time", "error_code",
+        "request_size", "response_size", "annotations", "log_id",
+    )
+
+    def __init__(self, kind: str, full_method: str, trace_id: int = 0,
+                 parent_span_id: int = 0, log_id: int = 0):
+        self.kind = kind  # "server" | "client"
+        self.full_method = full_method
+        self.trace_id = trace_id or random.getrandbits(63)
+        self.span_id = random.getrandbits(63)
+        self.parent_span_id = parent_span_id
+        self.remote_side = None
+        self.start_time = time.time()
+        self.end_time = 0.0
+        self.error_code = 0
+        self.request_size = 0
+        self.response_size = 0
+        self.annotations: List = []
+        self.log_id = log_id
+
+    def annotate(self, text: str):
+        """Free-text timeline entry (Annotate, span.h:80-84)."""
+        self.annotations.append((time.time(), text))
+
+    def end(self, error_code: int = 0):
+        self.end_time = time.time()
+        self.error_code = error_code
+        _submit(self)
+
+    @property
+    def latency_us(self) -> float:
+        if not self.end_time:
+            return 0.0
+        return (self.end_time - self.start_time) * 1e6
+
+    def describe(self) -> str:
+        lines = [
+            f"trace={self.trace_id:016x} span={self.span_id:016x} "
+            f"parent={self.parent_span_id:016x} [{self.kind}] "
+            f"{self.full_method} remote={self.remote_side} "
+            f"latency={self.latency_us:.0f}us error={self.error_code} "
+            f"req={self.request_size}B resp={self.response_size}B"
+        ]
+        for ts, text in self.annotations:
+            offset_us = (ts - self.start_time) * 1e6
+            lines.append(f"    +{offset_us:.0f}us {text}")
+        return "\n".join(lines)
+
+
+# -- thread-local parenting (tls_bls analog) --------------------------------
+
+def current_parent() -> Optional[Span]:
+    return getattr(_tls, "parent_span", None)
+
+
+def set_parent(span: Optional[Span]):
+    _tls.parent_span = span
+
+
+class parent_scope:
+    """with parent_scope(server_span): handler()  — nested client calls
+    chain under it."""
+
+    def __init__(self, span: Optional[Span]):
+        self._span = span
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = current_parent()
+        set_parent(self._span)
+        return self._span
+
+    def __exit__(self, *exc):
+        set_parent(self._prev)
+
+
+# -- collector --------------------------------------------------------------
+
+_spans: Deque[Span] = deque(maxlen=4096)
+_spans_lock = threading.Lock()
+_counter = [0]
+
+
+def _submit(span: Span):
+    if not flags.get_flag("enable_rpcz"):
+        return
+    every = max(1, flags.get_flag("rpcz_sample_every"))
+    with _spans_lock:
+        _counter[0] += 1
+        if _counter[0] % every:
+            return
+        if _spans.maxlen != flags.get_flag("rpcz_max_spans"):
+            resized: Deque[Span] = deque(
+                _spans, maxlen=max(16, flags.get_flag("rpcz_max_spans")))
+            globals()["_spans"] = resized
+        _spans.append(span)
+
+
+def recent_spans(limit: int = 100) -> List[Span]:
+    with _spans_lock:
+        return list(_spans)[-limit:]
+
+
+def find_trace(trace_id: int) -> List[Span]:
+    with _spans_lock:
+        return [s for s in _spans if s.trace_id == trace_id]
+
+
+def clear_for_tests():
+    with _spans_lock:
+        _spans.clear()
+        _counter[0] = 0
+
+
+def describe_recent_spans(query: Optional[dict] = None) -> str:
+    """/rpcz page body (builtin/rpcz_service.cpp role)."""
+    query = query or {}
+    if "trace_id" in query:
+        try:
+            spans = find_trace(int(query["trace_id"], 16))
+        except ValueError:
+            return "bad trace_id\n"
+    else:
+        limit = int(query.get("limit", "50") or 50)
+        spans = recent_spans(limit)
+    if not spans:
+        return "no spans collected (enable_rpcz flag / traffic?)\n"
+    return "\n".join(s.describe() for s in reversed(spans)) + "\n"
+
+
+# -- wiring helpers ----------------------------------------------------------
+
+def start_server_span(full_method: str, meta, remote_side) -> Optional[Span]:
+    if not flags.get_flag("enable_rpcz"):
+        return None
+    span = Span("server", full_method,
+                trace_id=meta.request.trace_id,
+                parent_span_id=meta.request.span_id,
+                log_id=meta.request.log_id)
+    span.remote_side = str(remote_side) if remote_side else None
+    return span
+
+
+def start_client_span(full_method: str, cntl) -> Optional[Span]:
+    if not flags.get_flag("enable_rpcz"):
+        return None
+    parent = current_parent()
+    span = Span("client", full_method,
+                trace_id=parent.trace_id if parent else 0,
+                parent_span_id=parent.span_id if parent else 0,
+                log_id=cntl.log_id)
+    cntl.trace_id = span.trace_id
+    cntl.span_id = span.span_id
+    return span
