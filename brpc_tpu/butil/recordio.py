@@ -1,0 +1,77 @@
+"""recordio — length-prefixed record stream file format.
+
+Counterpart of butil::recordio (/root/reference/src/butil/recordio.h), the
+format rpc_dump writes and rpc_replay consumes. Record = magic "RIO1" +
+u32 meta_len + u32 payload_len + crc32(meta+payload) + meta + payload.
+Meta is a small JSON header (service/method/log_id); payload is the
+serialized request.
+"""
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Iterator, Optional, Tuple
+
+MAGIC = b"RIO1"
+_HEADER = struct.Struct(">4sIII")
+
+
+class RecordWriter:
+    def __init__(self, path: str):
+        self._f = open(path, "ab")
+
+    def write(self, meta: dict, payload: bytes) -> None:
+        meta_bytes = json.dumps(meta).encode()
+        crc = zlib.crc32(meta_bytes + payload) & 0xFFFFFFFF
+        self._f.write(_HEADER.pack(MAGIC, len(meta_bytes), len(payload), crc))
+        self._f.write(meta_bytes)
+        self._f.write(payload)
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordReader:
+    def __init__(self, path: str):
+        self._f = open(path, "rb")
+
+    def read(self) -> Optional[Tuple[dict, bytes]]:
+        header = self._f.read(_HEADER.size)
+        if len(header) < _HEADER.size:
+            return None
+        magic, meta_len, payload_len, crc = _HEADER.unpack(header)
+        if magic != MAGIC:
+            raise ValueError("corrupt recordio stream: bad magic")
+        meta_bytes = self._f.read(meta_len)
+        payload = self._f.read(payload_len)
+        if len(meta_bytes) < meta_len or len(payload) < payload_len:
+            return None  # truncated tail
+        if zlib.crc32(meta_bytes + payload) & 0xFFFFFFFF != crc:
+            raise ValueError("corrupt recordio record: crc mismatch")
+        return json.loads(meta_bytes), payload
+
+    def __iter__(self) -> Iterator[Tuple[dict, bytes]]:
+        while True:
+            rec = self.read()
+            if rec is None:
+                return
+            yield rec
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -241,6 +241,10 @@ class Channel:
             cntl.set_failed(errors.EREQUEST, f"fail to serialize request: {e}")
             cntl._end_rpc_locked_or_not(locked=False)
             return
+        from brpc_tpu.rpc.rpc_dump import maybe_dump_request
+
+        maybe_dump_request(method_full_name, cntl._request_payload,
+                           cntl.log_id)
         cntl.issue_rpc()
         if done is None:
             cntl.join()

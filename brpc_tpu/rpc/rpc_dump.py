@@ -1,0 +1,70 @@
+"""rpc_dump — sampled request recording for replay.
+
+Counterpart of brpc/rpc_dump.{h,cpp} (/root/reference/src/brpc/rpc_dump.h:
+50-88): when -rpc_dump is on, a sampled fraction of outgoing requests is
+persisted as recordio files under -rpc_dump_dir; tools/rpc_replay.py
+replays them against a live server. Sampling shares the bounded-budget
+philosophy of bvar::Collector.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from brpc_tpu.butil import flags
+from brpc_tpu.butil.recordio import RecordWriter
+
+flags.define_bool("rpc_dump", False, "sample and dump outgoing requests")
+flags.define_string("rpc_dump_dir", "./rpc_dump", "directory for dump files")
+flags.define_int("rpc_dump_sample_every", 1,
+                 "dump 1 of every N requests")
+
+_writer: Optional[RecordWriter] = None
+_writer_lock = threading.Lock()
+_counter = [0]
+
+
+def _get_writer() -> Optional[RecordWriter]:
+    global _writer
+    if _writer is None:
+        with _writer_lock:
+            if _writer is None:
+                d = flags.get_flag("rpc_dump_dir")
+                try:
+                    os.makedirs(d, exist_ok=True)
+                    path = os.path.join(
+                        d, f"rpc_dump.{os.getpid()}.{int(time.time())}.rio")
+                    _writer = RecordWriter(path)
+                except OSError:
+                    return None
+    return _writer
+
+
+def maybe_dump_request(method_full_name: str, payload: bytes, log_id: int = 0):
+    """Called from the client send path; cheap no-op unless -rpc_dump."""
+    if not flags.get_flag("rpc_dump"):
+        return
+    every = max(1, flags.get_flag("rpc_dump_sample_every"))
+    with _writer_lock:
+        _counter[0] += 1
+        if _counter[0] % every:
+            return
+    w = _get_writer()
+    if w is None:
+        return
+    service, _, method = method_full_name.rpartition(".")
+    with _writer_lock:
+        w.write({"service": service, "method": method, "log_id": log_id,
+                 "ts": time.time()}, payload)
+        w.flush()
+
+
+def reset_for_tests():
+    global _writer
+    with _writer_lock:
+        if _writer is not None:
+            _writer.close()
+            _writer = None
+        _counter[0] = 0

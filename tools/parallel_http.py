@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""parallel_http — mass concurrent HTTP fetcher.
+
+Counterpart of tools/parallel_http (/root/reference/tools/parallel_http/):
+fetches many URLs concurrently and reports success/latency stats.
+
+Usage:
+  python tools/parallel_http.py --url-file urls.txt --concurrency 16
+  python tools/parallel_http.py --url http://127.0.0.1:8000/status -n 100
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import sys
+import threading
+import time
+from collections import deque
+from urllib.parse import urlparse
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", help="single URL (with -n repeats)")
+    ap.add_argument("-n", type=int, default=1, help="repeat count for --url")
+    ap.add_argument("--url-file", help="file with one URL per line")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--timeout", type=float, default=5)
+    args = ap.parse_args()
+
+    urls = deque()
+    if args.url_file:
+        with open(args.url_file) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    urls.append(line)
+    elif args.url:
+        for _ in range(args.n):
+            urls.append(args.url)
+    else:
+        ap.error("need --url or --url-file")
+
+    from brpc_tpu import bvar
+
+    recorder = bvar.LatencyRecorder()
+    ok = bvar.Adder()
+    fail = bvar.Adder()
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                if not urls:
+                    return
+                url = urls.popleft()
+            u = urlparse(url)
+            t0 = time.monotonic()
+            try:
+                conn = http.client.HTTPConnection(
+                    u.hostname, u.port or 80, timeout=args.timeout)
+                conn.request("GET", u.path or "/")
+                r = conn.getresponse()
+                r.read()
+                conn.close()
+                if 200 <= r.status < 400:
+                    ok.update(1)
+                    recorder.update((time.monotonic() - t0) * 1e6)
+                else:
+                    fail.update(1)
+            except OSError:
+                fail.update(1)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker)
+               for _ in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.monotonic() - t0
+    total = ok.get_value() + fail.get_value()
+    print(f"fetched={total} ok={ok.get_value()} failed={fail.get_value()} "
+          f"in {dt:.1f}s ({total / dt:.1f}/s) "
+          f"avg={recorder.latency():.0f}us "
+          f"p99={recorder.latency_percentile(0.99):.0f}us")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
