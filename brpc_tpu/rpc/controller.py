@@ -302,6 +302,12 @@ class Controller:
         if done is not None:
             done(self)
 
+    def cancel(self):
+        """StartCancel analog: abort the in-flight RPC through the CallId
+        error path; done still runs, with ECANCELED."""
+        if self._call_id and not self._ended.is_set():
+            bthread_id.error(self._call_id, errors.ECANCELED, "cancelled")
+
     def join(self, timeout: Optional[float] = None) -> bool:
         """Wait for completion (synchronous CallMethod tail — the
         bthread_id_join of channel.cpp)."""

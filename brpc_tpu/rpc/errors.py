@@ -31,6 +31,8 @@ ELIMIT = 2004  # reached max_concurrency
 ECLOSE = 2005  # connection closed by peer
 EITP = 2006
 
+ECANCELED = _errno.ECANCELED  # RPC cancelled by caller (StartCancel)
+
 ENOBUF = 2401  # device buffer exhausted (TPU-native)
 EDEVICE = 2402  # device transfer failed (TPU-native)
 
@@ -51,6 +53,7 @@ _DESCRIPTIONS = {
     EOVERLOAD: "server overloaded",
     ELIMIT: "max concurrency reached",
     ECLOSE: "connection closed",
+    ECANCELED: "rpc cancelled",
     ENOBUF: "device buffer exhausted",
     EDEVICE: "device transfer failed",
 }

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""auto_concurrency_limiter — adaptive admission control
+(example/auto_concurrency_limiter counterpart): a server with method
+max_concurrency="auto" sheds load under a burst; the limiter re-sizes from
+measured qps and no-load latency.
+
+  python examples/auto_concurrency_limiter.py
+"""
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc import errors  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class WorkService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Work(self, cntl, request, response, done):
+        time.sleep(0.01)  # 10ms of "work"
+        response.message = "done"
+        done()
+
+
+def main():
+    srv = rpc.Server(rpc.ServerOptions(
+        num_threads=8, method_max_concurrency={"WorkService.Work": "auto"}))
+    srv.add_service(WorkService())
+    assert srv.start("127.0.0.1:0") == 0
+
+    status = srv.method_statuses()["WorkService.Work"]
+    ok = [0]
+    rejected = [0]
+    lock = threading.Lock()
+
+    def client(n):
+        ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=2000))
+        ch.init(str(srv.listen_endpoint))
+        for _ in range(n):
+            cntl, _ = ch.call("WorkService.Work",
+                              echo_pb2.EchoRequest(message="w"),
+                              echo_pb2.EchoResponse)
+            with lock:
+                if cntl.failed() and cntl.error_code == errors.ELIMIT:
+                    rejected[0] += 1
+                elif not cntl.failed():
+                    ok[0] += 1
+
+    threads = [threading.Thread(target=client, args=(30,)) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    print(f"ok={ok[0]} rejected={rejected[0]} "
+          f"final_limit={status.limiter.max_concurrency()}")
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""echo — the canonical example (example/echo_c++ counterpart).
+
+  python examples/echo.py server [--port 8000]
+  python examples/echo.py client [--server 127.0.0.1:8000] [--attachment x]
+  python examples/echo.py demo          # both in one process
+"""
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        with rpc.ClosureGuard(done):
+            response.message = request.message
+            # echo the attachment exactly as example/echo_c++ does
+            cntl.response_attachment.append(cntl.request_attachment)
+
+
+def run_server(port: int) -> rpc.Server:
+    srv = rpc.Server()
+    srv.add_service(EchoService())
+    assert srv.start(f"127.0.0.1:{port}") == 0
+    print(f"echo server on {srv.listen_endpoint} "
+          f"(console: http://{srv.listen_endpoint}/status)")
+    return srv
+
+
+def run_client(target: str, attachment: str):
+    ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=1000))
+    assert ch.init(target) == 0
+    cntl = rpc.Controller()
+    if attachment:
+        cntl.request_attachment.append(attachment)
+    resp = echo_pb2.EchoResponse()
+    ch.call_method("EchoService.Echo", cntl,
+                   echo_pb2.EchoRequest(message="hello tpu"), resp)
+    if cntl.failed():
+        print("failed:", cntl.error_text)
+        return 1
+    print(f"reply={resp.message!r} attachment="
+          f"{cntl.response_attachment.to_bytes()!r} "
+          f"latency={cntl.latency_us:.0f}us")
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mode", choices=["server", "client", "demo"])
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--server", default="127.0.0.1:8000")
+    ap.add_argument("--attachment", default="")
+    args = ap.parse_args()
+    if args.mode == "server":
+        run_server(args.port).run_until_asked_to_quit()
+    elif args.mode == "client":
+        sys.exit(run_client(args.server, args.attachment))
+    else:
+        srv = run_server(0)
+        rc = run_client(str(srv.listen_endpoint), "piggy-bytes")
+        srv.stop()
+        sys.exit(rc)

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""http — RESTful access + console (example/http_c++ counterpart): the same
+service answers tpu_std RPC, JSON-over-HTTP, and serves the builtin
+console on one port (brpc's multi-protocol port).
+
+  python examples/http_server.py          # demo: curl-style requests
+  python examples/http_server.py serve    # keep serving on :8000
+"""
+import http.client
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = f"http says: {request.message}"
+        done()
+
+
+def main():
+    serve = len(sys.argv) > 1 and sys.argv[1] == "serve"
+    srv = rpc.Server()
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:8000" if serve else "127.0.0.1:0") == 0
+    print(f"serving on {srv.listen_endpoint} — try:")
+    print(f"  curl http://{srv.listen_endpoint}/status")
+    print(f"  curl -d '{{\"message\":\"hi\"}}' "
+          f"http://{srv.listen_endpoint}/EchoService/Echo")
+    if serve:
+        srv.run_until_asked_to_quit()
+        return
+
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      srv.listen_endpoint.port, timeout=5)
+    conn.request("POST", "/EchoService/Echo",
+                 body=json.dumps({"message": "from-curl"}),
+                 headers={"Content-Type": "application/json"})
+    print("JSON RPC:", conn.getresponse().read().decode())
+    conn.request("GET", "/status")
+    print("console /status:\n", conn.getresponse().read().decode()[:400])
+    conn.close()
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""streaming_echo — Streams with flow control (example/streaming_echo_c++
+counterpart): the client opens a stream on an RPC, pushes chunks, the
+server echoes them back on the same stream.
+
+  python examples/streaming_echo.py
+"""
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class StreamingEchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Open(self, cntl, request, response, done):
+        class EchoBack(rpc.StreamInputHandler):
+            def on_received_messages(self, stream, messages):
+                for m in messages:
+                    stream.write(m)
+
+            def on_closed(self, stream):
+                print("[server] stream closed")
+
+        stream = rpc.stream_accept(cntl,
+                                   rpc.StreamOptions(handler=EchoBack()))
+        response.message = "accepted" if stream else "no stream"
+        done()
+
+
+def main():
+    srv = rpc.Server()
+    srv.add_service(StreamingEchoService())
+    assert srv.start("127.0.0.1:0") == 0
+
+    got = []
+    done_ev = threading.Event()
+
+    class Collect(rpc.StreamInputHandler):
+        def on_received_messages(self, stream, messages):
+            for m in messages:
+                got.append(m.to_bytes())
+            if len(got) >= 5:
+                done_ev.set()
+
+        def on_closed(self, stream):
+            print("[client] stream closed")
+
+    ch = rpc.Channel()
+    assert ch.init(str(srv.listen_endpoint)) == 0
+    cntl = rpc.Controller()
+    cntl.timeout_ms = 3000
+    stream = rpc.stream_create(cntl, rpc.StreamOptions(handler=Collect()))
+    resp = echo_pb2.EchoResponse()
+    ch.call_method("StreamingEchoService.Open", cntl,
+                   echo_pb2.EchoRequest(message="open"), resp)
+    assert not cntl.failed(), cntl.error_text
+    stream.wait_connected(3)
+    for i in range(5):
+        stream.write(f"chunk-{i}".encode())
+    done_ev.wait(5)
+    print("echoed back:", got)
+    stream.close()
+    time.sleep(0.1)
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

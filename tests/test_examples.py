@@ -1,0 +1,28 @@
+"""Smoke-run every example as a subprocess — the examples double as
+integration tests, as the reference's example/ suite does in its CI."""
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = [
+    ("examples/echo.py", ["demo"]),
+    ("examples/parallel_echo.py", []),
+    ("examples/partition_echo.py", []),
+    ("examples/streaming_echo.py", []),
+    ("examples/backup_request.py", []),
+    ("examples/cascade_echo.py", []),
+    ("examples/auto_concurrency_limiter.py", []),
+    ("examples/http_server.py", []),
+    ("examples/tensor_transport.py", ["--mb", "1", "--iters", "3"]),
+]
+
+
+@pytest.mark.parametrize("script,args", EXAMPLES,
+                         ids=[e[0].split("/")[-1] for e in EXAMPLES])
+def test_example_runs(script, args):
+    proc = subprocess.run(
+        [sys.executable, script, *args],
+        capture_output=True, text=True, timeout=180, cwd="/root/repo",
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
