@@ -1,0 +1,172 @@
+"""butil misc containers + utilities.
+
+Counterparts of the remaining §2.1 base pieces
+(/root/reference/src/butil/): FlatMap (containers/flat_map.h:110-132),
+fast_rand (fast_rand.cpp), crc32c (crc32c.cc), RawPacker/RawUnpacker
+(raw_pack.h), ThreadLocal (thread_local.h). CPython's dict is already an
+open-addressing hash table, so FlatMap keeps the reference's API
+(seek/insert/erase/init) over it rather than re-probing by hand —
+idiomatic, same capability.
+"""
+from __future__ import annotations
+
+import random
+import struct
+import threading
+from typing import Callable, Generic, Iterator, Optional, Tuple, TypeVar
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class FlatMap(Generic[K, V]):
+    """flat_map.h API surface over a native hash map."""
+
+    def __init__(self, nbucket: int = 32):
+        self._map: dict = {}
+        self._nbucket = nbucket  # kept for API parity; dict self-sizes
+
+    def init(self, nbucket: int) -> bool:
+        self._nbucket = nbucket
+        return True
+
+    def insert(self, key: K, value: V) -> V:
+        self._map[key] = value
+        return value
+
+    def seek(self, key: K) -> Optional[V]:
+        return self._map.get(key)
+
+    def __getitem__(self, key: K) -> V:
+        """operator[]: inserts default None if missing (flat_map semantic is
+        default-construct; here: None)."""
+        return self._map.setdefault(key, None)
+
+    def __setitem__(self, key: K, value: V):
+        self._map[key] = value
+
+    def erase(self, key: K) -> int:
+        return 1 if self._map.pop(key, _MISSING) is not _MISSING else 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __contains__(self, key: K) -> bool:
+        return key in self._map
+
+    def empty(self) -> bool:
+        return not self._map
+
+    def clear(self):
+        self._map.clear()
+
+    def __iter__(self) -> Iterator[Tuple[K, V]]:
+        return iter(self._map.items())
+
+
+_MISSING = object()
+
+
+# -- fast_rand (fast_rand.cpp) ----------------------------------------------
+
+_tls_rand = threading.local()
+
+
+def _rng() -> random.Random:
+    r = getattr(_tls_rand, "r", None)
+    if r is None:
+        r = random.Random()
+        _tls_rand.r = r
+    return r
+
+
+def fast_rand() -> int:
+    """64-bit thread-local PRNG draw."""
+    return _rng().getrandbits(64)
+
+
+def fast_rand_less_than(bound: int) -> int:
+    return _rng().randrange(bound) if bound > 0 else 0
+
+
+def fast_rand_in(lo: int, hi: int) -> int:
+    return _rng().randint(lo, hi)
+
+
+def fast_rand_double() -> float:
+    return _rng().random()
+
+
+# -- crc32c (crc32c.cc, Castagnoli polynomial) -------------------------------
+
+_CRC32C_POLY = 0x82F63B78
+_crc32c_table = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ _CRC32C_POLY if _c & 1 else _c >> 1
+    _crc32c_table.append(_c)
+
+
+def crc32c(data: bytes, init: int = 0) -> int:
+    crc = init ^ 0xFFFFFFFF
+    table = _crc32c_table
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+# -- RawPacker / RawUnpacker (raw_pack.h) ------------------------------------
+
+class RawPacker:
+    """Sequential big-endian scalar packing."""
+
+    def __init__(self):
+        self._parts = []
+
+    def pack32(self, v: int) -> "RawPacker":
+        self._parts.append(struct.pack(">I", v & 0xFFFFFFFF))
+        return self
+
+    def pack64(self, v: int) -> "RawPacker":
+        self._parts.append(struct.pack(">Q", v & 0xFFFFFFFFFFFFFFFF))
+        return self
+
+    def bytes(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class RawUnpacker:
+    def __init__(self, data: bytes):
+        self._data = data
+        self._pos = 0
+
+    def unpack32(self) -> int:
+        (v,) = struct.unpack_from(">I", self._data, self._pos)
+        self._pos += 4
+        return v
+
+    def unpack64(self) -> int:
+        (v,) = struct.unpack_from(">Q", self._data, self._pos)
+        self._pos += 8
+        return v
+
+
+# -- ThreadLocal (thread_local.h) --------------------------------------------
+
+class ThreadLocal(Generic[V]):
+    """Per-thread lazily-constructed object."""
+
+    def __init__(self, factory: Callable[[], V]):
+        self._factory = factory
+        self._tls = threading.local()
+
+    def get(self) -> V:
+        v = getattr(self._tls, "v", _MISSING)
+        if v is _MISSING:
+            v = self._factory()
+            self._tls.v = v
+        return v
+
+    def reset(self, value: V):
+        self._tls.v = value

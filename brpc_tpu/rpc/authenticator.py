@@ -1,0 +1,57 @@
+"""Authenticator — pluggable per-connection/per-request authentication.
+
+Counterpart of brpc::Authenticator
+(/root/reference/src/brpc/authenticator.h): the client generates a
+credential that rides the request meta (auth_data); the server verifies it
+before dispatch and exposes an AuthContext on the controller. Impl
+registry mirrors the policy/ authenticators (giano/redis/couchbase there).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import Optional, Tuple
+
+
+class AuthContext:
+    """What a verified credential resolves to (authenticator.h AuthContext)."""
+
+    __slots__ = ("user", "group", "roles", "is_service")
+
+    def __init__(self, user: str = "", group: str = "", roles: str = "",
+                 is_service: bool = False):
+        self.user = user
+        self.group = group
+        self.roles = roles
+        self.is_service = is_service
+
+
+class Authenticator:
+    def generate_credential(self, cntl) -> Optional[str]:
+        """Client side: the string to send; None = fail the call."""
+        raise NotImplementedError
+
+    def verify_credential(self, auth_str: str, remote_side) -> Tuple[bool, Optional[AuthContext]]:
+        """Server side: (ok, context)."""
+        raise NotImplementedError
+
+
+class HmacAuthenticator(Authenticator):
+    """Shared-secret HMAC credential: 'user:hexdigest(user)'. A practical
+    default for intra-pod trust (the giano-style policy slot)."""
+
+    def __init__(self, secret: bytes, user: str = "default"):
+        self._secret = secret
+        self._user = user
+
+    def _digest(self, user: str) -> str:
+        return hmac.new(self._secret, user.encode(), hashlib.sha256).hexdigest()
+
+    def generate_credential(self, cntl) -> Optional[str]:
+        return f"{self._user}:{self._digest(self._user)}"
+
+    def verify_credential(self, auth_str, remote_side):
+        user, _, digest = (auth_str or "").partition(":")
+        if not user or not hmac.compare_digest(digest, self._digest(user)):
+            return False, None
+        return True, AuthContext(user=user)

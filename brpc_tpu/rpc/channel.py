@@ -39,6 +39,7 @@ class ChannelOptions:
     connection_type: str = "single"  # single | pooled | short
     health_check_interval_s: float = -1
     enable_circuit_breaker: bool = False
+    auth: Optional[object] = None  # Authenticator (authenticator.h)
 
 
 _client_messenger: Optional[InputMessenger] = None

@@ -100,6 +100,11 @@ def process_request(msg: HttpInputMessage):
         return _respond(sock, resp, close)
 
     parts = [p for p in req.path.split("/") if p]
+    # RESTful mapping first (restful.cpp routing role)
+    mapped = server.restful_map.get(req.path)
+    if mapped is not None and server.find_method(*mapped) is not None:
+        return _process_http_rpc(server, req, sock, resp, mapped[0],
+                                 mapped[1], close)
     # RPC-over-HTTP: /ServiceName/MethodName
     if len(parts) == 2 and server.find_method(parts[0], parts[1]) is not None:
         return _process_http_rpc(server, req, sock, resp, parts[0], parts[1],

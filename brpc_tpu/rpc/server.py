@@ -41,6 +41,8 @@ class ServerOptions:
     server_info_name: str = ""
     session_local_data_factory: Optional[Callable] = None
     enabled_protocols: Tuple[str, ...] = ()  # empty = all registered
+    # restful.cpp role: "/v1/echo => EchoService.Echo, /v1/x => S.M"
+    restful_mappings: str = ""
 
 
 class Server:
@@ -59,6 +61,17 @@ class Server:
         self.interceptor = self.options.interceptor
         self.auth = self.options.auth
         self._lock = threading.Lock()
+        # restful path -> (service_name, method_name)
+        self.restful_map: Dict[str, Tuple[str, str]] = {}
+        for part in (self.options.restful_mappings or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            path, _, target = part.partition("=>")
+            service, _, method = target.strip().rpartition(".")
+            path = "/" + path.strip().strip("/")
+            if service and method:
+                self.restful_map[path] = (service, method)
 
     # -- service registry --------------------------------------------------
     def add_service(self, service: Service) -> int:

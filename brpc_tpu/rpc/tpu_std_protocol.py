@@ -145,6 +145,13 @@ def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf
     if cntl._deadline is not None:
         remain_ms = max(0, int((cntl._deadline - time.monotonic()) * 1000))
         meta.request.timeout_ms = remain_ms
+    auth = (cntl._channel.options.auth
+            if cntl._channel is not None else None)
+    if auth is not None:
+        cred = auth.generate_credential(cntl)
+        if cred is None:
+            raise ValueError("authenticator refused to generate credential")
+        meta.request.auth_data = cred
     meta.correlation_id = correlation_id
     meta.compress_type = cntl.compress_type
     if cntl._request_stream is not None:
@@ -233,6 +240,18 @@ def process_request(msg: RpcMessage):
     if server is None:
         cntl.set_failed(errors.EINVAL, "no server bound to connection")
         return send_rpc_response(sock, cid, cntl, None, IOBuf())
+
+    if server.auth is not None:
+        ok, ctx = False, None
+        try:
+            ok, ctx = server.auth.verify_credential(
+                meta.request.auth_data, sock.remote_side)
+        except Exception:
+            ok = False
+        if not ok:
+            cntl.set_failed(errors.EAUTH, "authentication failed")
+            return send_rpc_response(sock, cid, cntl, None, IOBuf())
+        cntl.auth_context = ctx
 
     if server.interceptor is not None:
         try:

@@ -1,0 +1,138 @@
+"""Auth + RESTful + butil-misc tests (authenticator.h, restful.cpp,
+flat_map/fast_rand/crc32c/raw_pack shapes)."""
+import http.client
+import json
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.butil.containers import (
+    FlatMap,
+    RawPacker,
+    RawUnpacker,
+    ThreadLocal,
+    crc32c,
+    fast_rand,
+    fast_rand_less_than,
+)
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.authenticator import AuthContext, HmacAuthenticator
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        user = cntl.auth_context.user if cntl.auth_context else "anon"
+        response.message = f"{request.message}@{user}"
+        done()
+
+
+def test_auth_accepts_and_identifies():
+    auth = HmacAuthenticator(b"secret", user="alice")
+    srv = rpc.Server(rpc.ServerOptions(auth=auth))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = rpc.Channel(rpc.ChannelOptions(auth=auth))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message="hi"),
+                             echo_pb2.EchoResponse, timeout_ms=3000)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "hi@alice"
+    finally:
+        srv.stop()
+
+
+def test_auth_rejects_bad_credential():
+    srv = rpc.Server(rpc.ServerOptions(auth=HmacAuthenticator(b"server-secret")))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        # client signs with the wrong secret
+        ch = rpc.Channel(rpc.ChannelOptions(
+            auth=HmacAuthenticator(b"wrong-secret")))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        cntl, _ = ch.call("EchoService.Echo",
+                          echo_pb2.EchoRequest(message="x"),
+                          echo_pb2.EchoResponse, timeout_ms=3000)
+        assert cntl.error_code == errors.EAUTH
+        # no credential at all
+        ch2 = rpc.Channel()
+        assert ch2.init(str(srv.listen_endpoint)) == 0
+        cntl2, _ = ch2.call("EchoService.Echo",
+                            echo_pb2.EchoRequest(message="x"),
+                            echo_pb2.EchoResponse, timeout_ms=3000)
+        assert cntl2.error_code == errors.EAUTH
+    finally:
+        srv.stop()
+
+
+def test_restful_mapping():
+    srv = rpc.Server(rpc.ServerOptions(
+        restful_mappings="/v1/echo => EchoService.Echo"))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          srv.listen_endpoint.port, timeout=5)
+        conn.request("POST", "/v1/echo",
+                     body=json.dumps({"message": "rest"}),
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200
+        assert json.loads(r.read())["message"].startswith("rest@")
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_flat_map():
+    m = FlatMap()
+    assert m.init(64)
+    m.insert("a", 1)
+    m["b"] = 2
+    assert m.seek("a") == 1 and m.seek("zz") is None
+    assert "b" in m and len(m) == 2
+    assert m.erase("a") == 1 and m.erase("a") == 0
+    assert dict(iter(m)) == {"b": 2}
+    m.clear()
+    assert m.empty()
+
+
+def test_fast_rand():
+    vals = {fast_rand() for _ in range(10)}
+    assert len(vals) == 10
+    assert all(0 <= fast_rand_less_than(7) < 7 for _ in range(100))
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors for CRC32C
+    assert crc32c(b"") == 0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_raw_pack_unpack():
+    data = RawPacker().pack32(0xDEADBEEF).pack64(0x0123456789ABCDEF).bytes()
+    u = RawUnpacker(data)
+    assert u.unpack32() == 0xDEADBEEF
+    assert u.unpack64() == 0x0123456789ABCDEF
+
+
+def test_thread_local():
+    import threading
+
+    tl = ThreadLocal(list)
+    tl.get().append(1)
+    seen = {}
+
+    def other():
+        seen["val"] = list(tl.get())
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert seen["val"] == []  # fresh per thread
+    assert tl.get() == [1]
