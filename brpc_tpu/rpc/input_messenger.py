@@ -89,7 +89,7 @@ class InputMessenger:
             protocol = sock.matched_protocol
             result = None
             if protocol is not None:
-                result = protocol.parse(portal, sock, read_eof, None)
+                result = protocol.parse(portal, sock, read_eof, self.arg)
                 if result.error == ParseError.TRY_OTHERS:
                     # Mixed traffic on one connection (RPC frames +
                     # streaming frames): re-run handler selection.
@@ -100,7 +100,7 @@ class InputMessenger:
                 # First message: try every handler in order
                 # (input_messenger.cpp CutInputMessage).
                 for p in self._protocols:
-                    r = p.parse(portal, sock, read_eof, None)
+                    r = p.parse(portal, sock, read_eof, self.arg)
                     if r.error == ParseError.TRY_OTHERS:
                         continue
                     result = r

@@ -1,0 +1,156 @@
+"""Redis wire protocol — counterpart of policy/redis_protocol.cpp
+(/root/reference/src/brpc/policy/redis_protocol.cpp): client side sends
+RESP command batches through Channel (responses matched in order, like the
+reference's pipelined redis connection); server side parses commands and
+dispatches to the Server's redis_service (ServerOptions.redis_service),
+replying in arrival order (handled inline on the reader to preserve it).
+"""
+from __future__ import annotations
+
+from collections import deque
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+from brpc_tpu.rpc.redis import (
+    RedisRequest,
+    RedisResponse,
+    parse_reply,
+)
+
+
+class RedisMessage(InputMessageBase):
+    __slots__ = ("replies", "commands", "is_request")
+
+    def __init__(self, replies=None, commands=None):
+        super().__init__()
+        self.replies = replies
+        self.commands = commands
+        self.is_request = commands is not None
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    if portal.empty():
+        return ParseResult.not_enough()
+    head = portal.copy_to_bytes(1)
+    server_side = arg is not None and getattr(arg, "redis_service", None)
+    if head not in (b"*", b"+", b"-", b":", b"$"):
+        return ParseResult.try_others()
+    data = portal.copy_to_bytes()
+    # Server side: expect command arrays; client: any RESP values. Parse as
+    # many complete values as available into ONE message (a batch).
+    values = []
+    pos = 0
+    try:
+        while pos < len(data):
+            r = parse_reply(data, pos)
+            if r is None:
+                break
+            value, pos = r
+            values.append(value)
+    except ValueError:
+        return ParseResult.error_()
+    if not values:
+        return ParseResult.not_enough()
+    portal.pop_front(pos)
+    if server_side and getattr(sock, "_is_server_conn", True) and any(
+            v.kind == "array" for v in values):
+        commands = []
+        for v in values:
+            if v.kind == "array":
+                commands.append([item.value for item in v.value])
+        return ParseResult.ok(RedisMessage(commands=commands))
+    return ParseResult.ok(RedisMessage(replies=values))
+
+
+def serialize_request(request, cntl: Controller):
+    if isinstance(request, RedisRequest):
+        cntl._redis_command_count = request.command_count
+        return request.serialize()
+    if isinstance(request, (bytes, bytearray)):
+        return bytes(request)
+    raise TypeError("redis channel takes a RedisRequest")
+
+
+def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf:
+    return IOBuf(payload)
+
+
+def on_packed(sock, cntl: Controller, correlation_id: int):
+    q = getattr(sock, "_redis_pipeline", None)
+    if q is None:
+        q = deque()
+        sock._redis_pipeline = q
+    q.append((correlation_id, getattr(cntl, "_redis_command_count", 1)))
+    sock._is_server_conn = False  # this end is a client
+
+
+def process_response(msg: RedisMessage):
+    sock = msg.socket
+    q = getattr(sock, "_redis_pipeline", None)
+    pending = getattr(sock, "_redis_pending", None)
+    if pending is None:
+        pending = []
+        sock._redis_pending = pending
+    pending.extend(msg.replies or [])
+    while q:
+        cid, want = q[0]
+        if len(pending) < want:
+            return
+        replies, sock._redis_pending = pending[:want], pending[want:]
+        pending = sock._redis_pending
+        q.popleft()
+        try:
+            cntl = bthread_id.lock(cid)
+        except (KeyError, TimeoutError):
+            continue
+        if not isinstance(cntl, Controller):
+            try:
+                bthread_id.unlock(cid)
+            except Exception:
+                pass
+            continue
+        resp = cntl._response
+        if isinstance(resp, RedisResponse):
+            for r in replies:
+                resp.add(r)
+        first_err = next((r for r in replies if r.is_error()), None)
+        if first_err is not None:
+            cntl.set_failed(errors.EREQUEST, str(first_err.value))
+        cntl._end_rpc_locked_or_not(locked=True)
+
+
+def process_request(msg: RedisMessage):
+    """Server dispatch (run inline: replies must go out in command order)."""
+    server = msg.arg
+    service = getattr(server, "redis_service", None) if server else None
+    out = IOBuf()
+    for args in msg.commands or []:
+        if service is None:
+            from brpc_tpu.rpc.redis import RedisReply
+
+            out.append(RedisReply.error("ERR no redis service").encode())
+        else:
+            out.append(service.dispatch(args).encode())
+    msg.socket.write(out)
+
+
+register_protocol(Protocol(
+    name="redis",
+    type=ProtocolType.REDIS,
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+    process_inline=True,
+    extra={"on_packed": on_packed},
+))

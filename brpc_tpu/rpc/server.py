@@ -43,6 +43,8 @@ class ServerOptions:
     enabled_protocols: Tuple[str, ...] = ()  # empty = all registered
     # restful.cpp role: "/v1/echo => EchoService.Echo, /v1/x => S.M"
     restful_mappings: str = ""
+    # server speaks redis when set (ServerOptions::redis_service role)
+    redis_service: Optional[object] = None
 
 
 class Server:
@@ -60,6 +62,7 @@ class Server:
         self.start_time = 0.0
         self.interceptor = self.options.interceptor
         self.auth = self.options.auth
+        self.redis_service = self.options.redis_service
         self._lock = threading.Lock()
         # restful path -> (service_name, method_name)
         self.restful_map: Dict[str, Tuple[str, str]] = {}

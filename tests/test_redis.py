@@ -1,0 +1,120 @@
+"""Redis protocol tests — brpc_redis_unittest.cpp shape: RESP codec units,
+then a brpc_tpu server SPEAKING redis (DictRedisService) exercised by the
+framework's own redis client AND by a raw socket speaking vanilla RESP.
+"""
+import socket
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.redis import (
+    DictRedisService,
+    RedisReply,
+    RedisRequest,
+    RedisResponse,
+    encode_command,
+    parse_reply,
+)
+
+
+def test_resp_encode_command():
+    assert encode_command(("SET", "k", "v")) == \
+        b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n"
+
+
+def test_resp_parse_scalars():
+    r, pos = parse_reply(b"+OK\r\n", 0)
+    assert r.kind == "status" and r.value == "OK" and pos == 5
+    r, _ = parse_reply(b"-ERR boom\r\n", 0)
+    assert r.is_error()
+    r, _ = parse_reply(b":42\r\n", 0)
+    assert r.value == 42
+    r, _ = parse_reply(b"$5\r\nhello\r\n", 0)
+    assert r.value == b"hello"
+    r, _ = parse_reply(b"$-1\r\n", 0)
+    assert r.is_nil()
+
+
+def test_resp_parse_array_and_partial():
+    data = b"*2\r\n$1\r\na\r\n:7\r\n"
+    r, pos = parse_reply(data, 0)
+    assert r.kind == "array" and r.value[0].value == b"a"
+    assert r.value[1].value == 7 and pos == len(data)
+    assert parse_reply(b"*2\r\n$1\r\na\r\n", 0) is None  # incomplete
+    assert parse_reply(b"$10\r\nabc", 0) is None
+
+
+def test_reply_encode_roundtrip():
+    for reply in (RedisReply.status("OK"), RedisReply.error("ERR x"),
+                  RedisReply.integer(-3), RedisReply.string(b"bin\x00ary"),
+                  RedisReply.nil(),
+                  RedisReply.array([RedisReply.integer(1),
+                                    RedisReply.string(b"two")])):
+        parsed, pos = parse_reply(reply.encode(), 0)
+        assert parsed.kind == reply.kind
+        assert pos == len(reply.encode())
+
+
+@pytest.fixture(scope="module")
+def redis_server():
+    srv = rpc.Server(rpc.ServerOptions(redis_service=DictRedisService(),
+                                       num_threads=2))
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def test_redis_client_through_channel(redis_server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="redis", timeout_ms=3000))
+    assert ch.init(str(redis_server.listen_endpoint)) == 0
+    req = RedisRequest()
+    req.add_command("SET", "name", "brpc_tpu")
+    req.add_command("GET", "name")
+    req.add_command("INCR", "counter")
+    req.add_command("GET missing")
+    resp = RedisResponse()
+    cntl = rpc.Controller()
+    ch.call_method("redis", cntl, req, resp)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.reply_count == 4
+    assert resp.reply(0).value == "OK"
+    assert resp.reply(1).value == b"brpc_tpu"
+    assert resp.reply(2).value == 1
+    assert resp.reply(3).is_nil()
+
+
+def test_redis_vanilla_client_interop(redis_server):
+    """A plain RESP client (what redis-cli sends) must work against the
+    multi-protocol port."""
+    s = socket.create_connection(
+        ("127.0.0.1", redis_server.listen_endpoint.port), timeout=5)
+    s.sendall(encode_command(("PING",)))
+    assert s.recv(100) == b"+PONG\r\n"
+    s.sendall(encode_command(("SET", "k1", "v1")))
+    assert s.recv(100) == b"+OK\r\n"
+    s.sendall(encode_command(("GET", "k1")))
+    assert s.recv(100) == b"$2\r\nv1\r\n"
+    s.sendall(encode_command(("DEL", "k1", "k2")))
+    assert s.recv(100) == b":1\r\n"
+    s.sendall(encode_command(("NOSUCHCMD",)))
+    assert s.recv(100).startswith(b"-ERR unknown command")
+    s.close()
+
+
+def test_redis_unknown_command_via_channel(redis_server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="redis", timeout_ms=3000))
+    assert ch.init(str(redis_server.listen_endpoint)) == 0
+    req = RedisRequest()
+    req.add_command("BOGUS")
+    resp = RedisResponse()
+    cntl = rpc.Controller()
+    ch.call_method("redis", cntl, req, resp)
+    assert cntl.failed()
+    assert "unknown command" in cntl.error_text
+
+
+def test_custom_handler():
+    svc = DictRedisService()
+    svc.add_command_handler(
+        "double", lambda args: RedisReply.integer(int(args[0]) * 2))
+    assert svc.dispatch([b"double", b"21"]).value == 42
