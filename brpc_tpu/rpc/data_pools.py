@@ -1,0 +1,76 @@
+"""Session/thread data pools — per-request user-state management.
+
+Counterparts of brpc::SimpleDataPool + session-local/thread-local data
+(/root/reference/src/brpc/simple_data_pool.{h,cpp}, server.h:137,285): a
+server can own a pool of user session objects, borrowing one per request
+(cntl.session_local_data) and returning it after done; thread-local data
+is created per worker on demand.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+
+class DataFactory:
+    """CreateData/DestroyData pair (data_factory.h)."""
+
+    def __init__(self, create: Callable[[], object],
+                 destroy: Optional[Callable[[object], None]] = None):
+        self.create = create
+        self.destroy = destroy or (lambda obj: None)
+
+
+class SimpleDataPool:
+    """Borrow/return pool with stats (simple_data_pool.h)."""
+
+    def __init__(self, factory: DataFactory, reserve: int = 0):
+        self._factory = factory
+        self._free: List[object] = []
+        self._lock = threading.Lock()
+        self._created = 0
+        for _ in range(reserve):
+            self._free.append(factory.create())
+            self._created += 1
+
+    def borrow(self):
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+            self._created += 1
+        return self._factory.create()
+
+    def return_(self, obj):
+        if obj is None:
+            return
+        with self._lock:
+            self._free.append(obj)
+
+    @property
+    def created_count(self) -> int:
+        return self._created
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def destroy_all(self):
+        with self._lock:
+            for obj in self._free:
+                self._factory.destroy(obj)
+            self._free.clear()
+
+
+class ThreadLocalDataFactory:
+    """thread_local_data() of ServerOptions: one object per worker thread."""
+
+    def __init__(self, factory: DataFactory):
+        self._factory = factory
+        self._tls = threading.local()
+
+    def get(self):
+        obj = getattr(self._tls, "obj", None)
+        if obj is None:
+            obj = self._factory.create()
+            self._tls.obj = obj
+        return obj

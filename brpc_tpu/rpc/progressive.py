@@ -1,0 +1,115 @@
+"""Progressive attachment — chunked server push after the response.
+
+Counterpart of brpc::ProgressiveAttachment / ProgressiveReader
+(/root/reference/src/brpc/progressive_attachment.{h,cpp},
+progressive_reader.h): the server responds immediately, keeps the
+connection, and appends body chunks as they become available; the client
+consumes them through a ProgressiveReader. Implemented over the Stream
+machinery (a progressive body IS a one-directional stream), which gives the
+same flow-control for free.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.stream import Stream, StreamInputHandler, StreamOptions
+
+
+class ProgressiveAttachment:
+    """Server side: returned by Controller.create_progressive_attachment();
+    write chunks after done(), close when finished
+    (progressive_attachment.h Write/n)."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+
+    def write(self, data) -> int:
+        return self._stream.write(data)
+
+    def close(self):
+        self._stream.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._stream.closed
+
+
+class ProgressiveReader(StreamInputHandler):
+    """Client side: receives chunks (progressive_reader.h OnReadOnePart /
+    OnEndOfMessage). Subclass or use iter_chunks()."""
+
+    def __init__(self):
+        self._chunks: List[bytes] = []
+        self._cond = threading.Condition()
+        self._ended = False
+        self._error: Optional[str] = None
+
+    # StreamInputHandler
+    def on_received_messages(self, stream, messages):
+        with self._cond:
+            for m in messages:
+                part = m.to_bytes()
+                self._chunks.append(part)
+                self.on_read_one_part(part)
+            self._cond.notify_all()
+
+    def on_closed(self, stream):
+        with self._cond:
+            self._ended = True
+            self._cond.notify_all()
+        self.on_end_of_message()
+
+    # overridable callbacks (reader.h names)
+    def on_read_one_part(self, data: bytes):
+        pass
+
+    def on_end_of_message(self):
+        pass
+
+    # pull-style consumption
+    def next_chunk(self, timeout: float = 5.0) -> Optional[bytes]:
+        with self._cond:
+            while not self._chunks and not self._ended:
+                if not self._cond.wait(timeout):
+                    return None
+            if self._chunks:
+                return self._chunks.pop(0)
+            return None  # ended
+
+    def read_all(self, timeout: float = 10.0) -> bytes:
+        out = []
+        while True:
+            c = self.next_chunk(timeout)
+            if c is None:
+                break
+            out.append(c)
+        return b"".join(out)
+
+    @property
+    def ended(self) -> bool:
+        return self._ended
+
+
+def create_progressive_attachment(cntl, max_buf_size: int = 2 << 20
+                                  ) -> Optional[ProgressiveAttachment]:
+    """Server handler API (Controller::CreateProgressiveAttachment role):
+    requires the client to have attached a reader (which rides the stream
+    setup)."""
+    from brpc_tpu.rpc.stream import stream_accept
+
+    s = stream_accept(cntl, StreamOptions(max_buf_size=max_buf_size))
+    if s is None:
+        return None
+    return ProgressiveAttachment(s)
+
+
+def attach_progressive_reader(cntl, reader: ProgressiveReader):
+    """Client side, BEFORE the call (Controller::ReadProgressiveAttachmentBy
+    role): the reader rides the stream-create lane."""
+    from brpc_tpu.rpc.stream import stream_create
+
+    stream = stream_create(cntl, StreamOptions(handler=reader))
+    return stream

@@ -63,6 +63,12 @@ class Server:
         self.interceptor = self.options.interceptor
         self.auth = self.options.auth
         self.redis_service = self.options.redis_service
+        self.session_pool = None
+        if self.options.session_local_data_factory is not None:
+            from brpc_tpu.rpc.data_pools import SimpleDataPool
+
+            self.session_pool = SimpleDataPool(
+                self.options.session_local_data_factory)
         self._lock = threading.Lock()
         # restful path -> (service_name, method_name)
         self.restful_map: Dict[str, Tuple[str, str]] = {}

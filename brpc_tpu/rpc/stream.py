@@ -74,8 +74,14 @@ class Stream:
         # writer-side window accounting
         self._unconsumed = 0  # bytes sent, not yet fed back as consumed
         self._window_cond = threading.Condition()
-        # receiver-side ordered delivery
+        # receiver-side ordered delivery — created NOW so frames arriving
+        # before bind() (remote may push the instant it accepts, ahead of
+        # our RPC-response processing) are buffered, never dropped.
         self._exec_q: Optional[ExecutionQueue] = None
+        if options.handler is not None:
+            self._exec_q = ExecutionQueue(
+                self._consume_batch, batch_size=options.messages_in_batch)
+        self._owed_feedback = 0  # consumed before bind: flushed on bind
         self._connected = threading.Event()
         _stream_count.update(1)
 
@@ -88,10 +94,11 @@ class Stream:
     # -- binding (SetConnected analog) -------------------------------------
     def bind(self, sock):
         self._sock = sock
-        if self.options.handler is not None and self._exec_q is None:
-            self._exec_q = ExecutionQueue(self._consume_batch,
-                                          batch_size=self.options.messages_in_batch)
         self._connected.set()
+        with self._window_cond:
+            owed, self._owed_feedback = self._owed_feedback, 0
+        if owed:
+            self._send_feedback(owed)
 
     def wait_connected(self, timeout: Optional[float] = None) -> bool:
         return self._connected.wait(timeout)
@@ -171,8 +178,11 @@ class Stream:
     def _send_feedback(self, consumed: int):
         from brpc_tpu.rpc import streaming_protocol
 
-        if (self._sock is not None and not self._closed
-                and self.peer_id is not None):
+        if self._sock is None or self.peer_id is None:
+            with self._window_cond:
+                self._owed_feedback += consumed  # flushed at bind()
+            return
+        if not self._closed:
             try:
                 self._sock.write(
                     streaming_protocol.pack_feedback_frame(self.peer_id,

@@ -297,6 +297,9 @@ def process_request(msg: RpcMessage):
     if span is not None:
         span.request_size = len(msg.payload)
 
+    if server.session_pool is not None:
+        cntl.session_local_data = server.session_pool.borrow()
+
     def done():
         if responded[0]:
             return
@@ -305,6 +308,9 @@ def process_request(msg: RpcMessage):
                                   cntl.server_start_time)
         if span is not None:
             span.end(cntl.error_code_value)
+        if server.session_pool is not None:
+            server.session_pool.return_(cntl.session_local_data)
+            cntl.session_local_data = None
         send_rpc_response(sock, cid, cntl, response,
                           cntl.response_attachment)
 

@@ -1,0 +1,129 @@
+"""Progressive attachment + session-local data tests
+(progressive_attachment.h / simple_data_pool.h shapes)."""
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.data_pools import DataFactory, SimpleDataPool
+from brpc_tpu.rpc.progressive import (
+    ProgressiveReader,
+    attach_progressive_reader,
+    create_progressive_attachment,
+)
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class PushService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Download(self, cntl, request, response, done):
+        pa = create_progressive_attachment(cntl)
+        response.message = "headers-sent"
+        done()  # respond first, then keep pushing
+        if pa is None:
+            return
+
+        def pusher():
+            for i in range(5):
+                pa.write(f"part-{i};".encode())
+                time.sleep(0.01)
+            pa.close()
+
+        threading.Thread(target=pusher, daemon=True).start()
+
+
+@pytest.fixture(scope="module")
+def push_server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4))
+    srv.add_service(PushService())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def test_progressive_download(push_server):
+    ch = rpc.Channel()
+    assert ch.init(str(push_server.listen_endpoint)) == 0
+    cntl = rpc.Controller()
+    cntl.timeout_ms = 3000
+    reader = ProgressiveReader()
+    attach_progressive_reader(cntl, reader)
+    resp = echo_pb2.EchoResponse()
+    ch.call_method("PushService.Download", cntl,
+                   echo_pb2.EchoRequest(message="get"), resp)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == "headers-sent"
+    body = reader.read_all(timeout=5)
+    assert body == b"part-0;part-1;part-2;part-3;part-4;"
+    assert reader.ended
+
+
+def test_progressive_callbacks(push_server):
+    ch = rpc.Channel()
+    assert ch.init(str(push_server.listen_endpoint)) == 0
+
+    parts = []
+    ended = threading.Event()
+
+    class MyReader(ProgressiveReader):
+        def on_read_one_part(self, data):
+            parts.append(data)
+
+        def on_end_of_message(self):
+            ended.set()
+
+    cntl = rpc.Controller()
+    cntl.timeout_ms = 3000
+    attach_progressive_reader(cntl, MyReader())
+    resp = echo_pb2.EchoResponse()
+    ch.call_method("PushService.Download", cntl,
+                   echo_pb2.EchoRequest(message="get"), resp)
+    assert not cntl.failed()
+    assert ended.wait(5)
+    assert len(parts) == 5
+
+
+def test_simple_data_pool():
+    created = []
+    pool = SimpleDataPool(DataFactory(lambda: created.append(1) or {"n": 0}))
+    a = pool.borrow()
+    b = pool.borrow()
+    assert pool.created_count == 2
+    pool.return_(a)
+    c = pool.borrow()
+    assert c is a  # reused
+    assert pool.created_count == 2
+    pool.return_(b)
+    pool.return_(c)
+    assert pool.free_count == 2
+
+
+def test_session_local_data_flows_through_rpc():
+    borrowed = []
+
+    class SessionEcho(rpc.Service):
+        @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            assert cntl.session_local_data is not None
+            cntl.session_local_data["hits"] += 1
+            borrowed.append(id(cntl.session_local_data))
+            response.message = "ok"
+            done()
+
+    srv = rpc.Server(rpc.ServerOptions(
+        session_local_data_factory=DataFactory(lambda: {"hits": 0})))
+    srv.add_service(SessionEcho())
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = rpc.Channel()
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        for _ in range(3):
+            cntl, _ = ch.call("SessionEcho.Echo",
+                              echo_pb2.EchoRequest(message="s"),
+                              echo_pb2.EchoResponse, timeout_ms=3000)
+            assert not cntl.failed(), cntl.error_text
+        assert srv.session_pool.created_count <= 3
+        assert len(borrowed) == 3
+    finally:
+        srv.stop()
