@@ -1,0 +1,140 @@
+"""Memcache binary wire protocol — counterpart of
+policy/memcache_binary_protocol.cpp: client requests batched per call and
+matched to in-order responses (the pipelined matching the reference's
+memcache connection uses); server side (when ServerOptions.memcache_service
+is set) dispatches to MemcacheService.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.memcache import (
+    MAGIC_REQUEST,
+    MAGIC_RESPONSE,
+    MemcacheRequest,
+    MemcacheResponse,
+    parse_op,
+)
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+
+
+class MemcacheMessage(InputMessageBase):
+    __slots__ = ("ops", "is_request")
+
+    def __init__(self, ops, is_request):
+        super().__init__()
+        self.ops = ops
+        self.is_request = is_request
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    if portal.empty():
+        return ParseResult.not_enough()
+    head = portal.copy_to_bytes(1)[0]
+    if head not in (MAGIC_REQUEST, MAGIC_RESPONSE):
+        return ParseResult.try_others()
+    data = portal.copy_to_bytes()
+    ops = []
+    pos = 0
+    while pos < len(data):
+        r = parse_op(data, pos)
+        if r is None:
+            break
+        op, pos = r
+        ops.append(op)
+    if not ops:
+        return ParseResult.not_enough()
+    portal.pop_front(pos)
+    return ParseResult.ok(MemcacheMessage(ops, ops[0]["magic"] == MAGIC_REQUEST))
+
+
+def serialize_request(request, cntl: Controller):
+    if isinstance(request, MemcacheRequest):
+        cntl._memcache_op_count = request.op_count
+        return request.serialize()
+    if isinstance(request, (bytes, bytearray)):
+        return bytes(request)
+    raise TypeError("memcache channel takes a MemcacheRequest")
+
+
+def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf:
+    return IOBuf(payload)
+
+
+def on_packed(sock, cntl: Controller, correlation_id: int):
+    q = getattr(sock, "_mc_pipeline", None)
+    if q is None:
+        q = deque()
+        sock._mc_pipeline = q
+    q.append((correlation_id, getattr(cntl, "_memcache_op_count", 1)))
+
+
+def process_response(msg: MemcacheMessage):
+    sock = msg.socket
+    q = getattr(sock, "_mc_pipeline", None)
+    pending = getattr(sock, "_mc_pending", None)
+    if pending is None:
+        pending = []
+        sock._mc_pending = pending
+    pending.extend(msg.ops)
+    while q:
+        cid, want = q[0]
+        if len(pending) < want:
+            return
+        ops, sock._mc_pending = pending[:want], pending[want:]
+        pending = sock._mc_pending
+        q.popleft()
+        try:
+            cntl = bthread_id.lock(cid)
+        except (KeyError, TimeoutError):
+            continue
+        if not isinstance(cntl, Controller):
+            try:
+                bthread_id.unlock(cid)
+            except Exception:
+                pass
+            continue
+        resp = cntl._response
+        if isinstance(resp, MemcacheResponse):
+            for op in ops:
+                resp.add_result(op)
+        cntl._end_rpc_locked_or_not(locked=True)
+
+
+def process_request(msg: MemcacheMessage):
+    server = msg.arg
+    service = getattr(server, "memcache_service", None) if server else None
+    out = IOBuf()
+    for op in msg.ops:
+        if service is None:
+            from brpc_tpu.rpc.memcache import STATUS_ITEM_NOT_STORED, pack_op
+
+            out.append(pack_op(op["opcode"], magic=MAGIC_RESPONSE,
+                               status=STATUS_ITEM_NOT_STORED,
+                               opaque=op["opaque"]))
+        else:
+            out.append(service.handle(op))
+    msg.socket.write(out)
+
+
+register_protocol(Protocol(
+    name="memcache",
+    type=ProtocolType.MEMCACHE,
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+    process_inline=True,
+    extra={"on_packed": on_packed},
+))

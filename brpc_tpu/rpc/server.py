@@ -45,6 +45,8 @@ class ServerOptions:
     restful_mappings: str = ""
     # server speaks redis when set (ServerOptions::redis_service role)
     redis_service: Optional[object] = None
+    # server speaks memcache binary protocol when set
+    memcache_service: Optional[object] = None
 
 
 class Server:
@@ -63,6 +65,7 @@ class Server:
         self.interceptor = self.options.interceptor
         self.auth = self.options.auth
         self.redis_service = self.options.redis_service
+        self.memcache_service = self.options.memcache_service
         self.session_pool = None
         if self.options.session_local_data_factory is not None:
             from brpc_tpu.rpc.data_pools import SimpleDataPool

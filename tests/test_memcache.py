@@ -1,0 +1,87 @@
+"""Memcache binary protocol tests — brpc_memcache_unittest shape: codec
+units + client against the in-process binary-protocol server."""
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.memcache import (
+    MemcacheRequest,
+    MemcacheResponse,
+    MemcacheService,
+    OP_GET,
+    pack_op,
+    parse_op,
+)
+
+
+def test_pack_parse_roundtrip():
+    pkt = pack_op(OP_GET, b"key", b"", b"", opaque=77)
+    op, pos = parse_op(pkt, 0)
+    assert pos == len(pkt)
+    assert op["opcode"] == OP_GET and op["key"] == b"key"
+    assert op["opaque"] == 77
+    assert parse_op(pkt[:10], 0) is None  # incomplete header
+    assert parse_op(pkt[:-1], 0) is None  # incomplete body
+
+
+@pytest.fixture(scope="module")
+def mc_server():
+    srv = rpc.Server(rpc.ServerOptions(memcache_service=MemcacheService(),
+                                       num_threads=2))
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _call(server, req):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="memcache",
+                                        timeout_ms=3000))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    resp = MemcacheResponse()
+    cntl = rpc.Controller()
+    ch.call_method("memcache", cntl, req, resp)
+    assert not cntl.failed(), cntl.error_text
+    return resp
+
+
+def test_set_get_delete(mc_server):
+    req = MemcacheRequest()
+    req.set("k1", "v1").get("k1").delete("k1").get("k1")
+    resp = _call(mc_server, req)
+    assert resp.result_count == 4
+    assert resp.pop_set()
+    ok, value = resp.pop_get()
+    assert ok and value == b"v1"
+    assert resp.pop_delete()
+    ok, _ = resp.pop_get()
+    assert not ok  # deleted
+
+
+def test_incr_decr(mc_server):
+    req = MemcacheRequest()
+    req.incr("counter", 5, initial=10).incr("counter", 5).decr("counter", 3)
+    resp = _call(mc_server, req)
+    ok, v = resp.pop_counter()
+    assert ok and v == 10  # initial on first touch
+    ok, v = resp.pop_counter()
+    assert ok and v == 15
+    ok, v = resp.pop_counter()
+    assert ok and v == 12
+
+
+def test_add_replace_semantics(mc_server):
+    req = MemcacheRequest()
+    req.add("ar", "first").add("ar", "second").replace("ar", "third") \
+       .replace("missing", "x").get("ar")
+    resp = _call(mc_server, req)
+    assert resp.pop_store()       # add new: ok
+    assert not resp.pop_store()   # add existing: KEY_EXISTS
+    assert resp.pop_store()       # replace existing: ok
+    assert not resp.pop_store()   # replace missing: NOT_STORED
+    ok, v = resp.pop_get()
+    assert ok and v == b"third"
+
+
+def test_version(mc_server):
+    resp = _call(mc_server, MemcacheRequest().version())
+    ok, v = resp.pop_version()
+    assert ok and "memcache" in v
