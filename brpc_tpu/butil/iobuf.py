@@ -25,6 +25,7 @@ from collections import deque
 from typing import Callable, Iterable, List, Optional, Tuple, Union
 
 DEFAULT_BLOCK_SIZE = 8192  # iobuf.h:70 — 8KB default payload per block
+errno_EAGAIN = 11
 
 _tls = threading.local()
 
@@ -341,6 +342,23 @@ class IOBuf:
         return nw
 
     def cut_into_socket(self, sock: socket.socket, max_bytes: Optional[int] = None) -> int:
+        import ssl as _ssl
+
+        if isinstance(sock, _ssl.SSLSocket):
+            # TLS records can't scatter-gather raw fds; send one view at a
+            # time through the SSL layer (iobuf.h:159-208 SSL write path).
+            if self._length == 0:
+                return 0
+            view = self._refs[0].view()
+            if max_bytes is not None:
+                view = view[:max_bytes]
+            try:
+                n = sock.send(view)
+            except _ssl.SSLWantWriteError:
+                raise BlockingIOError(errno_EAGAIN, "ssl want write")
+            if n > 0:
+                self.pop_front(n)
+            return n
         return self.cut_into_file_descriptor(sock.fileno(), max_bytes)
 
     def __eq__(self, other) -> bool:
@@ -386,6 +404,31 @@ class IOPortal(IOBuf):
         return got
 
     def append_from_socket(self, sock: socket.socket, max_bytes: int = 65536) -> int:
+        import ssl as _ssl
+
+        if isinstance(sock, _ssl.SSLSocket):
+            got = 0
+            while got < max_bytes:
+                b = share_tls_block()
+                want = min(b.left_space(), max_bytes - got)
+                try:
+                    data = sock.recv(want)
+                except _ssl.SSLWantReadError:
+                    if got == 0:
+                        raise BlockingIOError(errno_EAGAIN, "ssl want read")
+                    break
+                if not data:
+                    if got == 0:
+                        return 0  # EOF
+                    break
+                n = len(data)
+                b.data[b.size : b.size + n] = data
+                self._append_ref(BlockRef(b, b.size, n))
+                b.size += n
+                got += n
+                if n < want:
+                    break
+            return got
         return self.append_from_file_descriptor(sock.fileno(), max_bytes)
 
 

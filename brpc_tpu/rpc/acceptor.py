@@ -18,8 +18,9 @@ from brpc_tpu.rpc.socket import Socket
 
 
 class Acceptor:
-    def __init__(self, messenger: InputMessenger):
+    def __init__(self, messenger: InputMessenger, ssl_context=None):
         self._messenger = messenger
+        self._ssl_context = ssl_context
         self._listen_sid = 0
         self._connections: Dict[int, int] = {}  # fd -> socket_id
         self._lock = threading.Lock()
@@ -46,6 +47,13 @@ class Acceptor:
                 return
             conn.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
             remote = EndPoint(addr[0], addr[1])
+            if self._ssl_context is not None:
+                # TLS handshake must not block the accept loop: finish it in
+                # a scheduler task, then hand the socket to the messenger.
+                from brpc_tpu.bthread import start_background
+
+                start_background(self._ssl_accept, conn, remote)
+                continue
             sid = Socket.create(
                 fd=conn,
                 remote_side=remote,
@@ -54,6 +62,25 @@ class Acceptor:
             self._accepted.update(1)
             with self._lock:
                 self._connections[conn.fileno()] = sid
+
+    def _ssl_accept(self, conn: pysocket.socket, remote: EndPoint):
+        try:
+            conn.settimeout(5.0)
+            wrapped = self._ssl_context.wrap_socket(conn, server_side=True)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        sid = Socket.create(
+            fd=wrapped,
+            remote_side=remote,
+            on_edge_triggered_events=self._messenger.on_new_messages,
+        )
+        self._accepted.update(1)
+        with self._lock:
+            self._connections[wrapped.fileno()] = sid
 
     def connection_count(self) -> int:
         with self._lock:

@@ -40,6 +40,8 @@ class ChannelOptions:
     health_check_interval_s: float = -1
     enable_circuit_breaker: bool = False
     auth: Optional[object] = None  # Authenticator (authenticator.h)
+    use_ssl: bool = False
+    ssl_verify: bool = False  # verify server cert (off: self-signed dev)
 
 
 _client_messenger: Optional[InputMessenger] = None
@@ -124,12 +126,24 @@ class Channel:
         return 0
 
     # -- socket selection (IssueRPC's server-selection half) ---------------
+    def _client_ssl_context(self):
+        if not self.options.use_ssl:
+            return None
+        import ssl as _ssl
+
+        ctx = _ssl.create_default_context()
+        if not self.options.ssl_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = _ssl.CERT_NONE
+        return ctx
+
     def _connect_new_socket(self, ep: EndPoint) -> Optional[Socket]:
         messenger = get_client_messenger()
         sid = Socket.create(
             remote_side=ep,
             on_edge_triggered_events=messenger.on_new_messages,
             health_check_interval_s=self.options.health_check_interval_s,
+            ssl_context=self._client_ssl_context(),
         )
         sock = Socket.address(sid)
         rc = sock.connect(timeout_s=self.options.connect_timeout_ms / 1000.0)

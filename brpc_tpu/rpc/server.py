@@ -47,6 +47,9 @@ class ServerOptions:
     redis_service: Optional[object] = None
     # server speaks memcache binary protocol when set
     memcache_service: Optional[object] = None
+    # TLS (ServerSSLOptions role): PEM paths; empty = plaintext
+    ssl_certfile: str = ""
+    ssl_keyfile: str = ""
 
 
 class Server:
@@ -165,7 +168,14 @@ class Server:
                 protocols = [p for p in protocols
                              if p.name in self.options.enabled_protocols]
             self._messenger = InputMessenger(protocols, arg=self)
-            self._acceptor = Acceptor(self._messenger)
+            ssl_ctx = None
+            if self.options.ssl_certfile:
+                import ssl as _ssl
+
+                ssl_ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+                ssl_ctx.load_cert_chain(self.options.ssl_certfile,
+                                        self.options.ssl_keyfile or None)
+            self._acceptor = Acceptor(self._messenger, ssl_context=ssl_ctx)
             self._acceptor.start_accept(lfd)
             self._started = True
             self.start_time = time.time()

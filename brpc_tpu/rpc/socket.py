@@ -99,6 +99,7 @@ class Socket:
         self.connection_type = "single"
         self.app_connect = None  # AppConnect seam (device transport attaches)
         self.app_state = None  # transport-private state (e.g. DeviceEndpoint)
+        self.ssl_context = None  # client TLS context (ChannelSSLOptions)
         self.conn_data = None  # owner context (e.g. pooled-socket home)
         self.create_time = time.monotonic()
 
@@ -117,7 +118,7 @@ class Socket:
                on_edge_triggered_events=None,
                user: Optional[SocketUser] = None,
                health_check_interval_s: float = -1,
-               app_connect=None) -> int:
+               app_connect=None, ssl_context=None) -> int:
         """Returns a SocketId; Socket.address(sid) resolves it (or None once
         recycled)."""
         sid, sock = cls._get_pool().get_resource()
@@ -129,6 +130,7 @@ class Socket:
         sock.user = user
         sock.health_check_interval_s = health_check_interval_s
         sock.app_connect = app_connect
+        sock.ssl_context = ssl_context
         _conn_count.update(1)
         if fd is not None:
             fd.setblocking(False)
@@ -164,6 +166,17 @@ class Socket:
         except OSError as e:
             return e.errno or errors.EFAILEDSOCKET
         fd.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
+        if self.ssl_context is not None:
+            try:
+                fd.settimeout(timeout_s)
+                fd = self.ssl_context.wrap_socket(
+                    fd, server_hostname=self.remote_side.ip)
+            except OSError as e:
+                try:
+                    fd.close()
+                except OSError:
+                    pass
+                return errors.ESSL if not e.errno else e.errno
         fd.setblocking(False)
         self._fd = fd
         try:

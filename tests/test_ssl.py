@@ -1,0 +1,89 @@
+"""SSL/TLS tests — the brpc_ssl_unittest role: self-signed cert generated
+on the fly (the test/cert1.* fixture pattern), full RPC over TLS."""
+import subprocess
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    proc = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1"],
+        capture_output=True, timeout=60,
+    )
+    if proc.returncode != 0:
+        pytest.skip("openssl unavailable")
+    return cert, key
+
+
+@pytest.fixture(scope="module")
+def ssl_server(certs):
+    cert, key = certs
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4, ssl_certfile=cert,
+                                       ssl_keyfile=key))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def test_rpc_over_tls(ssl_server):
+    ch = rpc.Channel(rpc.ChannelOptions(use_ssl=True, timeout_ms=5000,
+                                        connect_timeout_ms=5000))
+    assert ch.init(str(ssl_server.listen_endpoint)) == 0
+    for i in range(5):
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message=f"tls{i}"),
+                             echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == f"tls{i}"
+
+
+def test_large_payload_over_tls(ssl_server):
+    ch = rpc.Channel(rpc.ChannelOptions(use_ssl=True, timeout_ms=10000,
+                                        connect_timeout_ms=5000))
+    assert ch.init(str(ssl_server.listen_endpoint)) == 0
+    big = "s" * 300_000
+    cntl, resp = ch.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message=big),
+                         echo_pb2.EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == big
+
+
+def test_plaintext_client_rejected_by_tls_server(ssl_server):
+    ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=1500, max_retry=0))
+    assert ch.init(str(ssl_server.listen_endpoint)) == 0
+    cntl, _ = ch.call("EchoService.Echo",
+                      echo_pb2.EchoRequest(message="plain"),
+                      echo_pb2.EchoResponse)
+    assert cntl.failed()  # handshake never completes for raw frames
+
+
+def test_https_console(ssl_server, certs):
+    import http.client
+    import ssl as pyssl
+
+    ctx = pyssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = pyssl.CERT_NONE
+    conn = http.client.HTTPSConnection(
+        "127.0.0.1", ssl_server.listen_endpoint.port, context=ctx, timeout=5)
+    conn.request("GET", "/health")
+    r = conn.getresponse()
+    assert r.status == 200 and r.read() == b"OK\n"
+    conn.close()
