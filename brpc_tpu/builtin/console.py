@@ -173,7 +173,16 @@ def _version():
 
 
 def attach_console(server):
+    from brpc_tpu.builtin.hotspots import (
+        hotspots_handler,
+        pprof_handler,
+        threads_handler,
+    )
+
     server._builtin_handlers = {
+        "hotspots": hotspots_handler,
+        "pprof": pprof_handler,
+        "threads": threads_handler,
         "status": _status_handler,
         "vars": _vars_handler,
         "flags": _flags_handler,

@@ -1,0 +1,96 @@
+"""Hotspots — on-demand CPU profiling behind the console.
+
+Counterpart of /hotspots/cpu + /pprof (builtin/hotspots_service.h:38-68,
+builtin/pprof_service.h:26-48): GET /hotspots/cpu?seconds=N runs a
+statistical sampler over sys._current_frames() (all threads, the
+whole-process view gperftools gives the reference) and returns collapsed
+stacks ("frame;frame;frame count" lines — flamegraph.pl / speedscope
+ingestible). The TPU-side profiler hook (XProf) plugs in the same handler
+table (SURVEY.md section 5).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict
+
+
+def sample_cpu(seconds: float = 1.0, hz: int = 99) -> str:
+    """Collapsed-stack sample of every live thread."""
+    seconds = max(0.1, min(10.0, seconds))
+    interval = 1.0 / max(1, hz)
+    stacks: Counter = Counter()
+    deadline = time.monotonic() + seconds
+    own = threading.get_ident()
+    nsamples = 0
+    while time.monotonic() < deadline:
+        frames: Dict[int, object] = sys._current_frames()
+        for tid, frame in frames.items():
+            if tid == own:
+                continue
+            parts = []
+            f = frame
+            depth = 0
+            while f is not None and depth < 64:
+                code = f.f_code
+                parts.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
+                f = f.f_back
+                depth += 1
+            if parts:
+                stacks[";".join(reversed(parts))] += 1
+        nsamples += 1
+        time.sleep(interval)
+    lines = [f"# cpu profile: {nsamples} samples at {hz}Hz over {seconds}s",
+             "# format: collapsed stacks (flamegraph.pl compatible)"]
+    for stack, count in stacks.most_common():
+        lines.append(f"{stack} {count}")
+    return "\n".join(lines) + "\n"
+
+
+def thread_dump() -> str:
+    """Instantaneous stacks of all threads (/threads page role)."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in frames.items():
+        out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+        f = frame
+        depth = 0
+        while f is not None and depth < 64:
+            code = f.f_code
+            out.append(f"  {code.co_filename}:{f.f_lineno} {code.co_name}")
+            f = f.f_back
+            depth += 1
+    return "\n".join(out) + "\n"
+
+
+def hotspots_handler(server, req):
+    parts = [p for p in req.path.split("/") if p]
+    kind = parts[1] if len(parts) > 1 else "cpu"
+    if kind == "cpu":
+        seconds = float(req.query.get("seconds", "1") or 1)
+        return 200, "text/plain", sample_cpu(seconds)
+    if kind in ("contention", "heap", "growth"):
+        return 200, "text/plain", (
+            f"{kind} profiling: not instrumented in the Python runtime; "
+            "the native core exposes scheduler counters at /bthreads and "
+            "device memory at /vars (tpu_*).\n")
+    return 404, "text/plain", f"unknown hotspots kind {kind}\n"
+
+
+def pprof_handler(server, req):
+    """/pprof/profile — same collapsed output (pprof_service.h slot)."""
+    parts = [p for p in req.path.split("/") if p]
+    kind = parts[1] if len(parts) > 1 else "profile"
+    if kind == "profile":
+        seconds = float(req.query.get("seconds", "1") or 1)
+        return 200, "text/plain", sample_cpu(seconds)
+    if kind == "symbol":
+        return 200, "text/plain", "python frames are pre-symbolized\n"
+    return 404, "text/plain", f"unknown pprof endpoint {kind}\n"
+
+
+def threads_handler(server, req):
+    return 200, "text/plain", thread_dump()

@@ -1,0 +1,67 @@
+"""trackme — version ping (phone-home), off by default.
+
+Counterpart of brpc/details/trackme.cpp (/root/reference/src/brpc/details/
+trackme.cpp:36-118): when -trackme_server is set, the process periodically
+reports its version to that endpoint and logs any severity notice in the
+reply. tools/trackme_server.py is the receiving end.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from brpc_tpu.butil import flags
+
+flags.define_string("trackme_server", "", "endpoint to report version to "
+                    "(empty = disabled)")
+flags.define_int("trackme_interval_s", 300, "seconds between pings")
+
+_started = False
+_lock = threading.Lock()
+
+
+def _ping_once() -> bool:
+    import http.client
+
+    import brpc_tpu
+
+    target = flags.get_flag("trackme_server")
+    if not target:
+        return False
+    host, _, port = target.partition(":")
+    try:
+        conn = http.client.HTTPConnection(host, int(port or 80), timeout=3)
+        conn.request("POST", "/trackme",
+                     body=json.dumps({"version": brpc_tpu.__version__}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        if resp.status == 200 and body:
+            notice = json.loads(body).get("notice")
+            if notice:
+                import logging
+
+                logging.getLogger(__name__).warning("trackme notice: %s",
+                                                    notice)
+        return resp.status == 200
+    except (OSError, ValueError):
+        return False
+
+
+def start_trackme():
+    """Idempotent; no-op unless -trackme_server set."""
+    global _started
+    if not flags.get_flag("trackme_server"):
+        return
+    with _lock:
+        if _started:
+            return
+        _started = True
+    from brpc_tpu.bthread import timer_add
+
+    def tick():
+        _ping_once()
+        timer_add(flags.get_flag("trackme_interval_s"), tick)
+
+    timer_add(0.0, tick)

@@ -1,0 +1,110 @@
+"""Hotspots profiler + Collector + trackme tests (builtin/hotspots_service,
+bvar/collector, details/trackme shapes)."""
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.builtin.hotspots import sample_cpu, thread_dump
+from brpc_tpu.bvar.collector import Collectable, Collector
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+def test_sample_cpu_sees_busy_thread():
+    stop = threading.Event()
+
+    def busy_loop_marker_fn():
+        while not stop.is_set():
+            sum(range(100))
+
+    t = threading.Thread(target=busy_loop_marker_fn, name="busy")
+    t.start()
+    try:
+        out = sample_cpu(seconds=0.3, hz=200)
+        assert "busy_loop_marker_fn" in out
+        assert "# cpu profile" in out
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_thread_dump():
+    out = thread_dump()
+    assert "thread" in out and "test_hotspots_collector" in out
+
+
+def test_hotspots_http_endpoint():
+    class S(rpc.Service):
+        @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = "x"
+            done()
+
+    srv = rpc.Server()
+    srv.add_service(S())
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          srv.listen_endpoint.port,
+                                          timeout=10)
+        conn.request("GET", "/hotspots/cpu?seconds=0.2")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert b"cpu profile" in r.read()
+        conn.request("GET", "/threads")
+        r = conn.getresponse()
+        assert r.status == 200 and b"thread" in r.read()
+        conn.request("GET", "/pprof/profile?seconds=0.2")
+        r = conn.getresponse()
+        assert r.status == 200
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_collector_budget():
+    c = Collector(max_samples_per_second=10)
+    kept = sum(1 for _ in range(100) if c.submit(object()))
+    assert kept == 10  # budget enforced within the 1s window
+    assert c.submitted_count == 100
+    assert len(c.drain()) == 10
+    assert c.pending_count == 0
+
+
+def test_collector_destroys_dropped():
+    destroyed = []
+
+    class Obj(Collectable):
+        def destroy(self):
+            destroyed.append(1)
+
+    c = Collector(max_samples_per_second=1)
+    c.submit(Obj())
+    c.submit(Obj())  # over budget: destroyed
+    assert len(destroyed) == 1
+
+
+def test_trackme_ping():
+    from brpc_tpu.butil import flags
+    from brpc_tpu.rpc import trackme
+
+    received = []
+
+    def handler(server, req):
+        received.append(json.loads(req.body.to_bytes()))
+        return 200, "application/json", json.dumps({"ok": True,
+                                                    "notice": "hello"})
+
+    srv = rpc.Server()
+    assert srv.start("127.0.0.1:0") == 0
+    srv._builtin_handlers["trackme"] = handler
+    try:
+        flags.set_flag("trackme_server", str(srv.listen_endpoint))
+        assert trackme._ping_once()
+        assert received and "version" in received[0]
+    finally:
+        flags.set_flag("trackme_server", "")
+        srv.stop()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""trackme_server — receives version pings
+(tools/trackme_server counterpart). Counts pings per version at /trackme
+and shows tallies at /status.
+
+  python tools/trackme_server.py [--port 8877]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=8877)
+    ap.add_argument("--notice", default="", help="notice pushed to pingers")
+    args = ap.parse_args()
+
+    from brpc_tpu import rpc
+
+    counts = {}
+    lock = threading.Lock()
+
+    def trackme_handler(server, req):
+        try:
+            version = json.loads(req.body.to_bytes() or b"{}").get(
+                "version", "unknown")
+        except ValueError:
+            version = "malformed"
+        with lock:
+            counts[version] = counts.get(version, 0) + 1
+        body = {"ok": True}
+        if args.notice:
+            body["notice"] = args.notice
+        return 200, "application/json", json.dumps(body)
+
+    def tallies_handler(server, req):
+        with lock:
+            return 200, "application/json", json.dumps(counts, indent=1)
+
+    srv = rpc.Server()
+    assert srv.start(f"127.0.0.1:{args.port}") == 0
+    srv._builtin_handlers["trackme"] = trackme_handler
+    srv._builtin_handlers["tallies"] = tallies_handler
+    print(f"trackme server on {srv.listen_endpoint} "
+          f"(POST /trackme, GET /tallies)")
+    srv.run_until_asked_to_quit()
+
+
+if __name__ == "__main__":
+    main()
