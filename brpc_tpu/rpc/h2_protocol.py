@@ -1,0 +1,559 @@
+"""HTTP/2 + gRPC — counterpart of policy/http2_rpc_protocol.cpp +
+grpc.{h,cpp} (/root/reference/src/brpc/policy/http2_rpc_protocol.cpp,
+grpc.h:27-152): full client+server h2 framing (HEADERS/DATA/SETTINGS/PING/
+WINDOW_UPDATE/RST/GOAWAY), HPACK header blocks (hpack.py), connection and
+per-stream flow control with queued sends, and the gRPC unary mapping
+(5-byte message frames, grpc-status trailers, grpc-timeout propagation)
+over the same service/method map every other protocol serves.
+
+Channels select it with options.protocol = "h2:grpc".
+"""
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.hpack import HpackDecoder, HpackEncoder
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+F_DATA = 0x0
+F_HEADERS = 0x1
+F_PRIORITY = 0x2
+F_RST_STREAM = 0x3
+F_SETTINGS = 0x4
+F_PUSH_PROMISE = 0x5
+F_PING = 0x6
+F_GOAWAY = 0x7
+F_WINDOW_UPDATE = 0x8
+F_CONTINUATION = 0x9
+
+FLAG_END_STREAM = 0x1
+FLAG_END_HEADERS = 0x4
+FLAG_ACK = 0x1
+FLAG_PADDED = 0x8
+FLAG_PRIORITY_F = 0x20
+
+SETTINGS_INITIAL_WINDOW_SIZE = 0x4
+SETTINGS_MAX_FRAME_SIZE = 0x5
+
+DEFAULT_WINDOW = 65535
+OUR_WINDOW = 1 << 28  # generous receive window we advertise
+MAX_FRAME = 16384
+
+# gRPC status <-> framework errors (grpc.h:27-152)
+GRPC_OK = 0
+GRPC_CANCELLED = 1
+GRPC_DEADLINE_EXCEEDED = 4
+GRPC_NOT_FOUND = 5
+GRPC_RESOURCE_EXHAUSTED = 8
+GRPC_UNIMPLEMENTED = 12
+GRPC_INTERNAL = 13
+GRPC_UNAVAILABLE = 14
+GRPC_UNAUTHENTICATED = 16
+
+_ERR_TO_GRPC = {
+    0: GRPC_OK,
+    errors.ECANCELED: GRPC_CANCELLED,
+    errors.ERPCTIMEDOUT: GRPC_DEADLINE_EXCEEDED,
+    errors.ENOSERVICE: GRPC_NOT_FOUND,
+    errors.ENOMETHOD: GRPC_UNIMPLEMENTED,
+    errors.ELIMIT: GRPC_RESOURCE_EXHAUSTED,
+    errors.EOVERLOAD: GRPC_RESOURCE_EXHAUSTED,
+    errors.EAUTH: GRPC_UNAUTHENTICATED,
+    errors.EFAILEDSOCKET: GRPC_UNAVAILABLE,
+}
+_GRPC_TO_ERR = {
+    GRPC_OK: 0,
+    GRPC_CANCELLED: errors.ECANCELED,
+    GRPC_DEADLINE_EXCEEDED: errors.ERPCTIMEDOUT,
+    GRPC_NOT_FOUND: errors.ENOSERVICE,
+    GRPC_UNIMPLEMENTED: errors.ENOMETHOD,
+    GRPC_RESOURCE_EXHAUSTED: errors.ELIMIT,
+    GRPC_UNAUTHENTICATED: errors.EAUTH,
+    GRPC_UNAVAILABLE: errors.EFAILEDSOCKET,
+    GRPC_INTERNAL: errors.EINVAL,
+}
+
+
+def error_to_grpc_status(code: int) -> int:
+    return _ERR_TO_GRPC.get(code, GRPC_INTERNAL)
+
+
+def grpc_status_to_error(status: int) -> int:
+    return _GRPC_TO_ERR.get(status, errors.EINVAL)
+
+
+def pack_frame(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes:
+    n = len(payload)
+    return (bytes([(n >> 16) & 0xFF, (n >> 8) & 0xFF, n & 0xFF, ftype,
+                   flags]) + struct.pack(">I", stream_id & 0x7FFFFFFF)
+            + payload)
+
+
+def grpc_wrap(message: bytes) -> bytes:
+    """5-byte gRPC message frame: compressed flag + length."""
+    return b"\x00" + struct.pack(">I", len(message)) + message
+
+
+def grpc_unwrap(data: bytes) -> Optional[bytes]:
+    if len(data) < 5:
+        return None
+    (length,) = struct.unpack(">I", data[1:5])
+    if len(data) < 5 + length:
+        return None
+    return data[5:5 + length]
+
+
+class H2Stream:
+    __slots__ = ("stream_id", "headers", "trailers", "data", "remote_end",
+                 "cid", "send_window", "pending_out", "headers_done")
+
+    def __init__(self, stream_id: int, initial_window: int):
+        self.stream_id = stream_id
+        self.headers: Optional[List[Tuple[str, str]]] = None
+        self.trailers: Optional[List[Tuple[str, str]]] = None
+        self.data = bytearray()
+        self.remote_end = False
+        self.cid: Optional[int] = None
+        self.send_window = initial_window
+        self.pending_out: List[Tuple[bytes, bool]] = []  # (chunk, end)
+        self.headers_done = False
+
+
+class H2Connection:
+    """Per-socket h2 state (the H2Context of http2_rpc_protocol.cpp)."""
+
+    def __init__(self, is_client: bool):
+        self.is_client = is_client
+        self.encoder = HpackEncoder()
+        self.decoder = HpackDecoder()
+        self.streams: Dict[int, H2Stream] = {}
+        self.next_stream_id = 1 if is_client else 2
+        self.send_window = DEFAULT_WINDOW
+        self.peer_initial_window = DEFAULT_WINDOW
+        self.preface_done = not is_client  # server: consumed during parse
+        self.lock = threading.Lock()
+        self._header_buf: Optional[Tuple[int, int, bytearray]] = None
+
+    def new_stream(self) -> H2Stream:
+        with self.lock:
+            sid = self.next_stream_id
+            self.next_stream_id += 2
+            s = H2Stream(sid, self.peer_initial_window)
+            self.streams[sid] = s
+            return s
+
+    def get_or_create(self, sid: int) -> H2Stream:
+        with self.lock:
+            s = self.streams.get(sid)
+            if s is None:
+                s = H2Stream(sid, self.peer_initial_window)
+                self.streams[sid] = s
+            return s
+
+    def initial_frames(self) -> bytes:
+        """Client preface + our SETTINGS (both sides send SETTINGS)."""
+        settings = struct.pack(">HI", SETTINGS_INITIAL_WINDOW_SIZE, OUR_WINDOW)
+        settings += struct.pack(">HI", SETTINGS_MAX_FRAME_SIZE, MAX_FRAME)
+        frames = pack_frame(F_SETTINGS, 0, 0, settings)
+        # open up the connection receive window too
+        frames += pack_frame(F_WINDOW_UPDATE, 0, 0,
+                             struct.pack(">I", OUR_WINDOW - DEFAULT_WINDOW))
+        if self.is_client:
+            return PREFACE + frames
+        return frames
+
+    # -- sending with flow control ----------------------------------------
+    def send_data(self, sock, stream: H2Stream, data: bytes, end: bool):
+        """Split into MAX_FRAME chunks, respecting windows; queue remainder
+        (flushed by WINDOW_UPDATE)."""
+        chunks: List[Tuple[bytes, bool]] = []
+        pos = 0
+        if not data:
+            chunks.append((b"", end))
+        while pos < len(data):
+            take = min(MAX_FRAME, len(data) - pos)
+            chunk = data[pos:pos + take]
+            pos += take
+            chunks.append((chunk, end and pos >= len(data)))
+        out = IOBuf()
+        with self.lock:
+            for i, (chunk, is_end) in enumerate(chunks):
+                if (self.send_window >= len(chunk)
+                        and stream.send_window >= len(chunk)
+                        and not stream.pending_out):
+                    self.send_window -= len(chunk)
+                    stream.send_window -= len(chunk)
+                    out.append(pack_frame(
+                        F_DATA, FLAG_END_STREAM if is_end else 0,
+                        stream.stream_id, chunk))
+                else:
+                    stream.pending_out.append((chunk, is_end))
+        if not out.empty():
+            sock.write(out)
+
+    def flush_pending(self, sock):
+        out = IOBuf()
+        with self.lock:
+            for s in self.streams.values():
+                while s.pending_out:
+                    chunk, is_end = s.pending_out[0]
+                    if (self.send_window < len(chunk)
+                            or s.send_window < len(chunk)):
+                        break
+                    s.pending_out.pop(0)
+                    self.send_window -= len(chunk)
+                    s.send_window -= len(chunk)
+                    out.append(pack_frame(
+                        F_DATA, FLAG_END_STREAM if is_end else 0,
+                        s.stream_id, chunk))
+        if not out.empty():
+            sock.write(out)
+
+
+class H2Message(InputMessageBase):
+    __slots__ = ("frames", "is_request")
+
+    def __init__(self, frames):
+        super().__init__()
+        self.frames = frames
+        self.is_request = True  # routed by connection role internally
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    conn: Optional[H2Connection] = getattr(sock, "h2_conn", None)
+    if conn is None:
+        # Server side: detect the client preface.
+        head = portal.copy_to_bytes(min(len(PREFACE), len(portal)))
+        if not PREFACE.startswith(head):
+            return ParseResult.try_others()
+        if len(portal) < len(PREFACE):
+            return ParseResult.not_enough()
+        portal.pop_front(len(PREFACE))
+        conn = H2Connection(is_client=False)
+        sock.h2_conn = conn
+        sock.write(IOBuf(conn.initial_frames()))
+    frames = []
+    while len(portal) >= 9:
+        header = portal.copy_to_bytes(9)
+        length = (header[0] << 16) | (header[1] << 8) | header[2]
+        if len(portal) < 9 + length:
+            break
+        portal.pop_front(9)
+        ftype, flags = header[3], header[4]
+        (sid,) = struct.unpack(">I", header[5:9])
+        sid &= 0x7FFFFFFF
+        payload = portal.cutn_bytes(length)
+        frames.append((ftype, flags, sid, payload))
+    if not frames:
+        return ParseResult.not_enough()
+    return ParseResult.ok(H2Message(frames))
+
+
+def process_frames(msg: H2Message):
+    sock = msg.socket
+    conn: H2Connection = sock.h2_conn
+    if conn is None:
+        return
+    for ftype, flags, sid, payload in msg.frames:
+        if ftype == F_SETTINGS:
+            if not (flags & FLAG_ACK):
+                pos = 0
+                while pos + 6 <= len(payload):
+                    ident, value = struct.unpack_from(">HI", payload, pos)
+                    pos += 6
+                    if ident == SETTINGS_INITIAL_WINDOW_SIZE:
+                        with conn.lock:
+                            delta = value - conn.peer_initial_window
+                            conn.peer_initial_window = value
+                            for s in conn.streams.values():
+                                s.send_window += delta
+                sock.write(IOBuf(pack_frame(F_SETTINGS, FLAG_ACK, 0, b"")))
+        elif ftype == F_PING:
+            if not (flags & FLAG_ACK):
+                sock.write(IOBuf(pack_frame(F_PING, FLAG_ACK, 0, payload)))
+        elif ftype == F_WINDOW_UPDATE:
+            (incr,) = struct.unpack(">I", payload[:4])
+            with conn.lock:
+                if sid == 0:
+                    conn.send_window += incr
+                else:
+                    s = conn.streams.get(sid)
+                    if s is not None:
+                        s.send_window += incr
+            conn.flush_pending(sock)
+        elif ftype in (F_HEADERS, F_CONTINUATION):
+            block = payload
+            if ftype == F_HEADERS:
+                if flags & FLAG_PRIORITY_F:
+                    block = block[5:]
+                if flags & FLAG_PADDED:
+                    pad = block[0]
+                    block = block[1:len(block) - pad]
+            if not (flags & FLAG_END_HEADERS):
+                conn._header_buf = (sid, flags, bytearray(block))
+                continue
+            if conn._header_buf is not None and conn._header_buf[0] == sid:
+                prev_sid, prev_flags, buf = conn._header_buf
+                conn._header_buf = None
+                buf.extend(block)
+                block = bytes(buf)
+                flags |= prev_flags
+            headers = conn.decoder.decode(bytes(block))
+            stream = conn.get_or_create(sid)
+            if stream.headers_done:
+                stream.trailers = headers
+            else:
+                stream.headers = headers
+                stream.headers_done = True
+            if flags & FLAG_END_STREAM:
+                stream.remote_end = True
+                _on_stream_complete(sock, conn, stream)
+        elif ftype == F_DATA:
+            stream = conn.get_or_create(sid)
+            body = payload
+            if flags & FLAG_PADDED:
+                pad = body[0]
+                body = body[1:len(body) - pad]
+            stream.data.extend(body)
+            if len(payload):
+                # replenish both windows (we advertise a large one)
+                upd = struct.pack(">I", len(payload))
+                out = IOBuf(pack_frame(F_WINDOW_UPDATE, 0, 0, upd))
+                out.append(pack_frame(F_WINDOW_UPDATE, 0, sid, upd))
+                sock.write(out)
+            if flags & FLAG_END_STREAM:
+                stream.remote_end = True
+                _on_stream_complete(sock, conn, stream)
+        elif ftype == F_RST_STREAM:
+            stream = conn.streams.get(sid)
+            if stream is not None and stream.cid is not None:
+                bthread_id.error(stream.cid, errors.EFAILEDSOCKET,
+                                 "h2 stream reset")
+            with conn.lock:
+                conn.streams.pop(sid, None)
+        elif ftype == F_GOAWAY:
+            sock.set_failed(errors.ECLOSE, "h2 goaway")
+
+
+def _headers_dict(headers) -> Dict[str, str]:
+    return {k: v for k, v in (headers or [])}
+
+
+def _on_stream_complete(sock, conn: H2Connection, stream: H2Stream):
+    if conn.is_client:
+        _complete_client_call(sock, conn, stream)
+    else:
+        _dispatch_server_request(sock, conn, stream)
+
+
+# -- server side ------------------------------------------------------------
+
+def _send_grpc_response(sock, conn: H2Connection, sid: int, payload: bytes,
+                        grpc_status: int, grpc_message: str = ""):
+    headers = [(":status", "200"), ("content-type", "application/grpc")]
+    block = conn.encoder.encode(headers)
+    out = IOBuf(pack_frame(F_HEADERS, FLAG_END_HEADERS, sid, block))
+    sock.write(out)
+    stream = conn.get_or_create(sid)
+    if payload:
+        conn.send_data(sock, stream, grpc_wrap(payload), end=False)
+    trailers = [("grpc-status", str(grpc_status))]
+    if grpc_message:
+        trailers.append(("grpc-message", grpc_message))
+    tblock = conn.encoder.encode(trailers)
+    sock.write(IOBuf(pack_frame(
+        F_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM, sid, tblock)))
+    with conn.lock:
+        conn.streams.pop(sid, None)
+
+
+def _dispatch_server_request(sock, conn: H2Connection, stream: H2Stream):
+    from brpc_tpu.rpc.input_messenger import InputMessenger  # noqa: F401
+
+    server = getattr(sock, "_h2_server", None)
+    headers = _headers_dict(stream.headers)
+    sid = stream.stream_id
+    path = headers.get(":path", "/")
+    parts = [p for p in path.split("/") if p]
+    if server is None or len(parts) != 2:
+        return _send_grpc_response(sock, conn, sid, b"", GRPC_UNIMPLEMENTED,
+                                   f"bad path {path}")
+    entry = server.find_method(parts[0], parts[1])
+    if entry is None:
+        missing_service = server.find_service(parts[0]) is None
+        return _send_grpc_response(
+            sock, conn, sid, b"",
+            GRPC_NOT_FOUND if missing_service else GRPC_UNIMPLEMENTED,
+            f"unknown method {path}")
+    service_obj, minfo, method_status = entry
+    cntl = Controller()
+    cntl.server = server
+    cntl.remote_side = sock.remote_side
+    cntl.service_name, cntl.method_name = parts[0], parts[1]
+    cntl.server_start_time = time.monotonic()
+    timeout = headers.get("grpc-timeout")
+    if timeout:
+        cntl.timeout_ms = _parse_grpc_timeout(timeout)
+    if not method_status.on_requested():
+        return _send_grpc_response(sock, conn, sid, b"",
+                                   GRPC_RESOURCE_EXHAUSTED,
+                                   "reached max_concurrency")
+    request = minfo.request_class()
+    body = grpc_unwrap(bytes(stream.data))
+    try:
+        if body:
+            request.ParseFromString(body)
+    except Exception as e:
+        method_status.on_response(errors.EREQUEST, cntl.server_start_time)
+        return _send_grpc_response(sock, conn, sid, b"", GRPC_INTERNAL,
+                                   f"fail to parse request: {e}")
+    response = minfo.response_class()
+    responded = [False]
+
+    def done():
+        if responded[0]:
+            return
+        responded[0] = True
+        method_status.on_response(cntl.error_code_value,
+                                  cntl.server_start_time)
+        if cntl.failed():
+            _send_grpc_response(sock, conn, sid, b"",
+                                error_to_grpc_status(cntl.error_code_value),
+                                cntl.error_text_value)
+        else:
+            _send_grpc_response(sock, conn, sid,
+                                response.SerializeToString(), GRPC_OK)
+
+    try:
+        minfo.handler(service_obj, cntl, request, response, done)
+    except Exception as e:
+        if not responded[0]:
+            cntl.set_failed(errors.EINVAL, f"method raised: {e}")
+            done()
+
+
+def _parse_grpc_timeout(text: str) -> float:
+    unit = text[-1]
+    value = float(text[:-1])
+    scale = {"H": 3600e3, "M": 60e3, "S": 1e3, "m": 1.0, "u": 1e-3,
+             "n": 1e-6}.get(unit, 1.0)
+    return value * scale
+
+
+# -- client side ------------------------------------------------------------
+
+def serialize_request(request, cntl: Controller):
+    if request is None:
+        return b""
+    if isinstance(request, (bytes, bytearray)):
+        return bytes(request)
+    return request.SerializeToString()
+
+
+def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf:
+    sock = cntl._current_sock
+    conn: Optional[H2Connection] = getattr(sock, "h2_conn", None)
+    out = IOBuf()
+    if conn is None:
+        conn = H2Connection(is_client=True)
+        sock.h2_conn = conn
+        out.append(conn.initial_frames())
+    stream = conn.new_stream()
+    stream.cid = correlation_id
+    service, _, method = cntl._method_full_name.rpartition(".")
+    headers = [
+        (":method", "POST"), (":scheme", "http"),
+        (":path", f"/{service}/{method}"),
+        (":authority", str(cntl.remote_side or "")),
+        ("content-type", "application/grpc"),
+        ("te", "trailers"),
+    ]
+    if cntl._deadline is not None:
+        remain_ms = max(1, int((cntl._deadline - time.monotonic()) * 1000))
+        headers.append(("grpc-timeout", f"{remain_ms}m"))
+    block = conn.encoder.encode(headers)
+    out.append(pack_frame(F_HEADERS, FLAG_END_HEADERS, stream.stream_id,
+                          block))
+    body = grpc_wrap(payload)
+    # split at MAX_FRAME (SETTINGS_MAX_FRAME_SIZE conformance)
+    pos = 0
+    while True:
+        take = min(MAX_FRAME, len(body) - pos)
+        chunk = body[pos:pos + take]
+        pos += take
+        is_end = pos >= len(body)
+        out.append(pack_frame(F_DATA, FLAG_END_STREAM if is_end else 0,
+                              stream.stream_id, chunk))
+        if is_end:
+            break
+    with conn.lock:
+        conn.send_window -= len(body)
+        stream.send_window -= len(body)
+    return out
+
+
+def _complete_client_call(sock, conn: H2Connection, stream: H2Stream):
+    cid = stream.cid
+    with conn.lock:
+        conn.streams.pop(stream.stream_id, None)
+    if cid is None:
+        return
+    try:
+        cntl = bthread_id.lock(cid)
+    except (KeyError, TimeoutError):
+        return
+    if not isinstance(cntl, Controller):
+        try:
+            bthread_id.unlock(cid)
+        except Exception:
+            pass
+        return
+    trailers = _headers_dict(stream.trailers or stream.headers)
+    status = int(trailers.get("grpc-status", "0") or 0)
+    if status != GRPC_OK:
+        cntl.set_failed(grpc_status_to_error(status),
+                        trailers.get("grpc-message",
+                                     f"grpc status {status}"))
+        cntl._end_rpc_locked_or_not(locked=True)
+        return
+    body = grpc_unwrap(bytes(stream.data))
+    try:
+        if cntl._response is not None and body:
+            cntl._response.ParseFromString(body)
+    except Exception as e:
+        cntl.set_failed(errors.EREQUEST, f"fail to parse grpc response: {e}")
+    cntl._end_rpc_locked_or_not(locked=True)
+
+
+def process_message(msg: H2Message):
+    # Server-side connections learn their server from the message arg.
+    if msg.arg is not None:
+        msg.socket._h2_server = msg.arg
+    process_frames(msg)
+
+
+register_protocol(Protocol(
+    name="h2:grpc",
+    type=ProtocolType.H2,
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_message,
+    process_response=process_message,
+    process_inline=True,  # frame ordering is load-bearing
+))

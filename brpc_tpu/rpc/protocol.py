@@ -140,3 +140,4 @@ def globally_initialize():
     from brpc_tpu.rpc import tensor_service  # noqa: F401 (device handshake)
     from brpc_tpu.rpc import redis_protocol  # noqa: F401
     from brpc_tpu.rpc import memcache_protocol  # noqa: F401
+    from brpc_tpu.rpc import h2_protocol  # noqa: F401

@@ -1,0 +1,157 @@
+"""h2/gRPC tests — brpc_grpc_protocol_unittest / http2 unittest shapes:
+frame+grpc codec units, unary calls over h2, error mapping through
+grpc-status trailers, timeout propagation, concurrent streams on one
+connection.
+"""
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.h2_protocol import (
+    GRPC_DEADLINE_EXCEEDED,
+    GRPC_UNIMPLEMENTED,
+    error_to_grpc_status,
+    grpc_status_to_error,
+    grpc_unwrap,
+    grpc_wrap,
+    pack_frame,
+    _parse_grpc_timeout,
+)
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+def test_grpc_frame_roundtrip():
+    msg = b"payload-bytes"
+    wrapped = grpc_wrap(msg)
+    assert wrapped[0] == 0 and len(wrapped) == 5 + len(msg)
+    assert grpc_unwrap(wrapped) == msg
+    assert grpc_unwrap(b"\x00\x00\x00") is None
+
+
+def test_frame_header_layout():
+    f = pack_frame(0x1, 0x5, 7, b"abc")
+    assert f[:3] == b"\x00\x00\x03"  # 24-bit length
+    assert f[3] == 0x1 and f[4] == 0x5
+    assert f[5:9] == b"\x00\x00\x00\x07"
+
+
+def test_status_mapping():
+    assert error_to_grpc_status(0) == 0
+    assert error_to_grpc_status(errors.ERPCTIMEDOUT) == GRPC_DEADLINE_EXCEEDED
+    assert error_to_grpc_status(errors.ENOMETHOD) == GRPC_UNIMPLEMENTED
+    assert grpc_status_to_error(GRPC_DEADLINE_EXCEEDED) == errors.ERPCTIMEDOUT
+    assert grpc_status_to_error(99) == errors.EINVAL
+
+
+def test_grpc_timeout_parse():
+    assert _parse_grpc_timeout("100m") == 100.0
+    assert _parse_grpc_timeout("2S") == 2000.0
+    assert _parse_grpc_timeout("1M") == 60000.0
+
+
+class GrpcEcho(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        if request.code:
+            cntl.set_failed(request.code, "requested failure")
+            done()
+            return
+        if request.sleep_us:
+            time.sleep(request.sleep_us / 1e6)
+        response.message = request.message
+        done()
+
+
+@pytest.fixture(scope="module")
+def grpc_server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4))
+    srv.add_service(GrpcEcho())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def grpc_channel(grpc_server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="h2:grpc",
+                                        timeout_ms=3000))
+    assert ch.init(str(grpc_server.listen_endpoint)) == 0
+    return ch
+
+
+def test_unary_call(grpc_channel):
+    cntl, resp = grpc_channel.call(
+        "EchoService.Echo", echo_pb2.EchoRequest(message="grpc-hello"),
+        echo_pb2.EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == "grpc-hello"
+
+
+def test_many_sequential_on_one_connection(grpc_channel):
+    for i in range(20):
+        cntl, resp = grpc_channel.call(
+            "EchoService.Echo", echo_pb2.EchoRequest(message=f"s{i}"),
+            echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == f"s{i}"
+
+
+def test_concurrent_streams(grpc_channel):
+    n = 10
+    failures = []
+    lock = threading.Lock()
+
+    def one(i):
+        cntl, resp = grpc_channel.call(
+            "EchoService.Echo", echo_pb2.EchoRequest(message=f"c{i}"),
+            echo_pb2.EchoResponse, timeout_ms=5000)
+        with lock:
+            if cntl.failed() or resp.message != f"c{i}":
+                failures.append((i, cntl.error_text))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(15)
+    assert not failures, failures
+
+
+def test_error_maps_through_trailers(grpc_channel):
+    cntl, _ = grpc_channel.call(
+        "EchoService.Echo",
+        echo_pb2.EchoRequest(message="x", code=errors.ELIMIT),
+        echo_pb2.EchoResponse)
+    assert cntl.failed()
+    # ELIMIT -> RESOURCE_EXHAUSTED -> back to ELIMIT
+    assert cntl.error_code == errors.ELIMIT
+    assert "requested failure" in cntl.error_text
+
+
+def test_unknown_method_is_unimplemented(grpc_channel):
+    cntl, _ = grpc_channel.call(
+        "EchoService.Nope", echo_pb2.EchoRequest(message="x"),
+        echo_pb2.EchoResponse)
+    assert cntl.error_code == errors.ENOMETHOD
+
+
+def test_deadline_exceeded(grpc_channel):
+    cntl, _ = grpc_channel.call(
+        "EchoService.Echo",
+        echo_pb2.EchoRequest(message="slow", sleep_us=500_000),
+        echo_pb2.EchoResponse, timeout_ms=80)
+    assert cntl.error_code == errors.ERPCTIMEDOUT
+
+
+def test_larger_payload(grpc_channel):
+    big = "g" * 200_000  # spans multiple DATA frames server->client
+    cntl, resp = grpc_channel.call(
+        "EchoService.Echo", echo_pb2.EchoRequest(message=big),
+        echo_pb2.EchoResponse, timeout_ms=10000)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == big
