@@ -47,6 +47,8 @@ class ServerOptions:
     redis_service: Optional[object] = None
     # server speaks memcache binary protocol when set
     memcache_service: Optional[object] = None
+    # server speaks framed thrift when set (ThriftService role)
+    thrift_service: Optional[object] = None
     # TLS (ServerSSLOptions role): PEM paths; empty = plaintext
     ssl_certfile: str = ""
     ssl_keyfile: str = ""
@@ -69,6 +71,7 @@ class Server:
         self.auth = self.options.auth
         self.redis_service = self.options.redis_service
         self.memcache_service = self.options.memcache_service
+        self.thrift_service = self.options.thrift_service
         self.session_pool = None
         if self.options.session_local_data_factory is not None:
             from brpc_tpu.rpc.data_pools import SimpleDataPool

@@ -1,0 +1,155 @@
+"""Thrift wire protocol glue — framed TBinary over the Socket stack
+(policy/thrift_protocol.cpp role). Client correlation via thrift seqid
+(== the attempt cid's low bits, matched through a per-connection map).
+"""
+from __future__ import annotations
+
+import struct
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+from brpc_tpu.rpc.thrift import (
+    MSG_CALL,
+    MSG_EXCEPTION,
+    MSG_REPLY,
+    ThriftMessage,
+    pack_message,
+    unpack_message,
+)
+
+MAX_FRAME = 64 << 20
+
+
+class ThriftInputMessage(InputMessageBase):
+    __slots__ = ("name", "msg_type", "seqid", "body", "is_request")
+
+    def __init__(self, name, msg_type, seqid, body):
+        super().__init__()
+        self.name = name
+        self.msg_type = msg_type
+        self.seqid = seqid
+        self.body = body
+        self.is_request = msg_type in (MSG_CALL, 4)
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    if len(portal) < 8:
+        head = portal.copy_to_bytes(min(8, len(portal)))
+        # framed thrift: 4-byte length then 0x8001 version
+        if len(head) >= 6 and head[4] == 0x80 and head[5] == 0x01:
+            return ParseResult.not_enough()
+        if len(head) < 6:
+            return ParseResult.not_enough() if _maybe(head) else ParseResult.try_others()
+        return ParseResult.try_others()
+    header = portal.copy_to_bytes(8)
+    if not (header[4] == 0x80 and header[5] == 0x01):
+        return ParseResult.try_others()
+    (length,) = struct.unpack(">I", header[:4])
+    if length > MAX_FRAME:
+        return ParseResult.error_()
+    if len(portal) < 4 + length:
+        return ParseResult.not_enough()
+    portal.pop_front(4)
+    payload = portal.cutn_bytes(length)
+    try:
+        name, msg_type, seqid, body = unpack_message(payload)
+    except (ValueError, EOFError):
+        return ParseResult.error_()
+    return ParseResult.ok(ThriftInputMessage(name, msg_type, seqid, body))
+
+
+def _maybe(head: bytes) -> bool:
+    # can't rule out framed thrift until we see byte 4/5
+    return len(head) <= 4
+
+
+def serialize_request(request, cntl: Controller):
+    if isinstance(request, ThriftMessage):
+        cntl._thrift_method = request.method_name
+        import pickle
+
+        return pickle.dumps(request.body)  # inter-fn carrier, not the wire
+    raise TypeError("thrift channel takes a ThriftMessage")
+
+
+def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf:
+    import pickle
+
+    body = pickle.loads(payload)
+    seqid = correlation_id & 0x7FFFFFFF
+    sock = cntl._current_sock
+    m = getattr(sock, "_thrift_cids", None)
+    if m is None:
+        m = {}
+        sock._thrift_cids = m
+    m[seqid] = correlation_id
+    return IOBuf(pack_message(cntl._thrift_method, MSG_CALL, seqid, body))
+
+
+def process_response(msg: ThriftInputMessage):
+    sock = msg.socket
+    m = getattr(sock, "_thrift_cids", None) or {}
+    cid = m.pop(msg.seqid, None)
+    if cid is None:
+        return
+    try:
+        cntl = bthread_id.lock(cid)
+    except (KeyError, TimeoutError):
+        return
+    if not isinstance(cntl, Controller):
+        try:
+            bthread_id.unlock(cid)
+        except Exception:
+            pass
+        return
+    if msg.msg_type == MSG_EXCEPTION:
+        text = msg.body.get(1, (0, b""))[1]
+        if isinstance(text, bytes):
+            text = text.decode("utf-8", "replace")
+        cntl.set_failed(errors.EREQUEST, f"thrift exception: {text}")
+    else:
+        resp = cntl._response
+        if isinstance(resp, ThriftMessage):
+            resp.method_name = msg.name
+            resp.body = msg.body
+    cntl._end_rpc_locked_or_not(locked=True)
+
+
+def process_request(msg: ThriftInputMessage):
+    from brpc_tpu.rpc.thrift import T_STRING
+
+    server = msg.arg
+    service = getattr(server, "thrift_service", None) if server else None
+    sock = msg.socket
+    if service is None:
+        out = pack_message(msg.name, MSG_EXCEPTION, msg.seqid,
+                           {1: (T_STRING, b"no thrift service")})
+        sock.write(IOBuf(out))
+        return
+    try:
+        result = service.dispatch(msg.name, msg.body)
+        out = pack_message(msg.name, MSG_REPLY, msg.seqid, result or {})
+    except Exception as e:
+        out = pack_message(msg.name, MSG_EXCEPTION, msg.seqid,
+                           {1: (T_STRING, str(e).encode())})
+    sock.write(IOBuf(out))
+
+
+register_protocol(Protocol(
+    name="thrift",
+    type=ProtocolType.THRIFT,
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+))

@@ -1,0 +1,97 @@
+"""One port, every protocol — brpc's signature multi-protocol port
+(server.cpp:576): the same server simultaneously answers tpu_std RPC,
+JSON-over-HTTP, gRPC-over-h2, redis, memcache and framed thrift."""
+import http.client
+import json
+import socket as pysocket
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.memcache import MemcacheRequest, MemcacheResponse, MemcacheService
+from brpc_tpu.rpc.redis import DictRedisService, RedisRequest, RedisResponse, encode_command
+from brpc_tpu.rpc.thrift import T_STRING, ThriftMessage, ThriftService
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+@pytest.fixture(scope="module")
+def omni_server():
+    tsvc = ThriftService()
+    tsvc.add_method("Echo", lambda body: {0: body.get(1, (T_STRING, b""))})
+    srv = rpc.Server(rpc.ServerOptions(
+        num_threads=4,
+        redis_service=DictRedisService(),
+        memcache_service=MemcacheService(),
+        thrift_service=tsvc,
+    ))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def test_all_protocols_on_one_port(omni_server):
+    ep = str(omni_server.listen_endpoint)
+    port = omni_server.listen_endpoint.port
+
+    # 1. tpu_std
+    ch = rpc.Channel()
+    assert ch.init(ep) == 0
+    cntl, resp = ch.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message="std"),
+                         echo_pb2.EchoResponse, timeout_ms=3000)
+    assert not cntl.failed() and resp.message == "std"
+
+    # 2. HTTP JSON
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    conn.request("POST", "/EchoService/Echo",
+                 body=json.dumps({"message": "http"}),
+                 headers={"Content-Type": "application/json"})
+    assert json.loads(conn.getresponse().read())["message"] == "http"
+    conn.close()
+
+    # 3. gRPC over h2
+    gch = rpc.Channel(rpc.ChannelOptions(protocol="h2:grpc",
+                                         timeout_ms=3000))
+    assert gch.init(ep) == 0
+    cntl, resp = gch.call("EchoService.Echo",
+                          echo_pb2.EchoRequest(message="grpc"),
+                          echo_pb2.EchoResponse)
+    assert not cntl.failed() and resp.message == "grpc"
+
+    # 4. redis (raw RESP like redis-cli)
+    s = pysocket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(encode_command(("PING",)))
+    assert s.recv(64) == b"+PONG\r\n"
+    s.close()
+
+    # 5. memcache binary
+    mch = rpc.Channel(rpc.ChannelOptions(protocol="memcache",
+                                         timeout_ms=3000))
+    assert mch.init(ep) == 0
+    mresp = MemcacheResponse()
+    mcntl = rpc.Controller()
+    mch.call_method("memcache", mcntl,
+                    MemcacheRequest().set("k", "v").get("k"), mresp)
+    assert not mcntl.failed()
+    assert mresp.pop_set()
+    ok, v = mresp.pop_get()
+    assert ok and v == b"v"
+
+    # 6. framed thrift
+    tch = rpc.Channel(rpc.ChannelOptions(protocol="thrift",
+                                         timeout_ms=3000))
+    assert tch.init(ep) == 0
+    tresp = ThriftMessage()
+    tcntl = rpc.Controller()
+    tch.call_method("thrift", tcntl,
+                    ThriftMessage("Echo", {1: (T_STRING, b"th")}), tresp)
+    assert not tcntl.failed(), tcntl.error_text
+    assert tresp.body[0][1] == b"th"

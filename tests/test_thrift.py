@@ -1,0 +1,108 @@
+"""Thrift protocol tests — codec units + framed client/server echo
+(brpc_thrift* test shape)."""
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.thrift import (
+    MSG_CALL,
+    T_BOOL,
+    T_DOUBLE,
+    T_I32,
+    T_I64,
+    T_LIST,
+    T_STRING,
+    T_STRUCT,
+    ThriftMessage,
+    ThriftService,
+    pack_message,
+    unpack_message,
+)
+
+
+def test_codec_roundtrip():
+    body = {
+        1: (T_STRING, b"hello"),
+        2: (T_I32, -42),
+        3: (T_I64, 1 << 40),
+        4: (T_BOOL, True),
+        5: (T_DOUBLE, 3.25),
+        6: (T_LIST, (T_I32, [1, 2, 3])),
+        7: (T_STRUCT, {1: (T_STRING, b"nested")}),
+    }
+    framed = pack_message("Method", MSG_CALL, 7, body)
+    import struct
+
+    (length,) = struct.unpack(">I", framed[:4])
+    assert length == len(framed) - 4
+    name, mtype, seqid, decoded = unpack_message(framed[4:])
+    assert (name, mtype, seqid) == ("Method", MSG_CALL, 7)
+    assert decoded == body
+
+
+@pytest.fixture(scope="module")
+def thrift_server():
+    svc = ThriftService()
+
+    def echo(body):
+        msg = body.get(1, (T_STRING, b""))[1]
+        return {0: (T_STRUCT, {1: (T_STRING, b"echo:" + msg)})}
+
+    def add(body):
+        a = body.get(1, (T_I32, 0))[1]
+        b = body.get(2, (T_I32, 0))[1]
+        return {0: (T_I32, a + b)}
+
+    svc.add_method("Echo", echo)
+    svc.add_method("Add", add)
+    srv = rpc.Server(rpc.ServerOptions(thrift_service=svc, num_threads=2))
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _thrift_channel(server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="thrift", timeout_ms=3000))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    return ch
+
+
+def test_thrift_echo(thrift_server):
+    ch = _thrift_channel(thrift_server)
+    req = ThriftMessage("Echo", {1: (T_STRING, b"hi")})
+    resp = ThriftMessage()
+    cntl = rpc.Controller()
+    ch.call_method("thrift", cntl, req, resp)
+    assert not cntl.failed(), cntl.error_text
+    result = resp.body[0][1]  # field 0 = success struct
+    assert result[1][1] == b"echo:hi"
+
+
+def test_thrift_add(thrift_server):
+    ch = _thrift_channel(thrift_server)
+    req = ThriftMessage("Add", {1: (T_I32, 20), 2: (T_I32, 22)})
+    resp = ThriftMessage()
+    cntl = rpc.Controller()
+    ch.call_method("thrift", cntl, req, resp)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.body[0] == (T_I32, 42)
+
+
+def test_thrift_unknown_method_raises_exception(thrift_server):
+    ch = _thrift_channel(thrift_server)
+    req = ThriftMessage("Missing", {})
+    resp = ThriftMessage()
+    cntl = rpc.Controller()
+    ch.call_method("thrift", cntl, req, resp)
+    assert cntl.failed()
+    assert "thrift exception" in cntl.error_text
+
+
+def test_thrift_sequential_calls(thrift_server):
+    ch = _thrift_channel(thrift_server)
+    for i in range(10):
+        req = ThriftMessage("Add", {1: (T_I32, i), 2: (T_I32, i)})
+        resp = ThriftMessage()
+        cntl = rpc.Controller()
+        ch.call_method("thrift", cntl, req, resp)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.body[0] == (T_I32, 2 * i)
