@@ -48,6 +48,28 @@ def usleep(us: float):
     time.sleep(us / 1e6)
 
 
+def fd_wait(fd: int, events: str = "r", timeout_s: Optional[float] = None) -> bool:
+    """bthread_fd_wait (bthread/fd.cpp:119-170): block the calling task
+    until fd is readable ('r') / writable ('w'). True if ready, False on
+    timeout."""
+    import select
+
+    r = [fd] if "r" in events else []
+    w = [fd] if "w" in events else []
+    rr, ww, _ = select.select(r, w, [], timeout_s)
+    return bool(rr or ww)
+
+
+def connect(address, timeout_s: float = 1.0):
+    """bthread_connect (fd.cpp): blocking-in-task TCP connect returning a
+    connected non-blocking socket, or raising OSError."""
+    import socket as pysocket
+
+    s = pysocket.create_connection(address, timeout=timeout_s)
+    s.setblocking(False)
+    return s
+
+
 class Mutex:
     """bthread_mutex built on butex (bthread/mutex.cpp shape): the lock word
     is the butex value (0 free, 1 locked no waiters, 2 contended)."""

@@ -74,6 +74,17 @@ class Channel:
         self._circuit_breakers = {}  # sid -> CircuitBreaker
         self._cb_lock = threading.Lock()
         self._init_done = False
+        self._mapped_ep = None  # endpoint held in the global SocketMap
+
+    def close(self):
+        """Release channel resources: NS thread + SocketMap reference."""
+        if self._ns_thread is not None:
+            self._ns_thread.stop()
+        if self._mapped_ep is not None:
+            from brpc_tpu.rpc.socket_map import get_global_socket_map
+
+            get_global_socket_map().remove(self._mapped_ep)
+            self._mapped_ep = None
 
     # -- init --------------------------------------------------------------
     def init(self, target, lb_name: str = "") -> int:
@@ -196,16 +207,37 @@ class Channel:
             sock.connection_type = "pooled"
             sock.conn_data = self  # home pool
             return sock, 0
-        # single (default): one shared connection, created/revived lazily
+        # single (default): the PROCESS-WIDE shared connection for this
+        # endpoint via SocketMap (details/socket_map role) — two channels to
+        # one server share a connection, created/revived lazily. TLS
+        # channels keep a private connection (the map key is plain-endpoint;
+        # reference keys by endpoint+ssl+auth, SocketMapKey).
+        from brpc_tpu.rpc.socket_map import get_global_socket_map
+
         with self._single_lock:
             if self._single_sid is not None:
                 sock = Socket.address(self._single_sid)
                 if sock is not None and not sock.failed():
                     return sock, 0
-            sock = self._connect_new_socket(ep)
+            if self.options.use_ssl:
+                sock = self._connect_new_socket(ep)
+                if sock is None:
+                    return None, errors.EFAILEDSOCKET
+                self._single_sid = sock.socket_id
+                return sock, 0
+            sid = get_global_socket_map().insert(
+                ep,
+                health_check_interval_s=self.options.health_check_interval_s,
+                ssl_context=self._client_ssl_context(),
+            )
+            sock = Socket.address(sid) if sid is not None else None
             if sock is None:
                 return None, errors.EFAILEDSOCKET
+            if sock.ensure_connected(
+                    self.options.connect_timeout_ms / 1000.0) != 0:
+                return None, errors.EFAILEDSOCKET
             self._single_sid = sock.socket_id
+            self._mapped_ep = ep
             return sock, 0
 
     def _on_rpc_end(self, cntl: Controller):

@@ -1,0 +1,98 @@
+"""SocketMap — process-wide client connection sharing.
+
+Counterpart of brpc's SocketMap (/root/reference/src/brpc/details/
+socket_map.{h,cpp}): "single"-type client connections to the same endpoint
+are shared by every channel in the process, reference-counted; Remove drops
+the ref and recycles on zero. Channels call get_client_socket instead of
+dialing their own.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.rpc.socket import Socket
+
+
+class _Entry:
+    __slots__ = ("sid", "refcount")
+
+    def __init__(self, sid: int):
+        self.sid = sid
+        self.refcount = 0
+
+
+class SocketMap:
+    def __init__(self):
+        self._map: Dict[Tuple[str, int], _Entry] = {}
+        self._lock = threading.Lock()
+
+    def insert(self, ep: EndPoint, messenger=None,
+               health_check_interval_s: float = -1,
+               ssl_context=None, app_connect=None) -> Optional[int]:
+        """Get-or-create the shared SocketId for this endpoint
+        (SocketMap::Insert)."""
+        key = (ep.ip, ep.port)
+        with self._lock:
+            entry = self._map.get(key)
+            if entry is not None:
+                sock = Socket.address(entry.sid)
+                if sock is not None and not sock.failed():
+                    entry.refcount += 1
+                    return entry.sid
+                del self._map[key]
+            if messenger is None:
+                from brpc_tpu.rpc.channel import get_client_messenger
+
+                messenger = get_client_messenger()
+            sid = Socket.create(
+                remote_side=ep,
+                on_edge_triggered_events=messenger.on_new_messages,
+                health_check_interval_s=health_check_interval_s,
+                ssl_context=ssl_context,
+                app_connect=app_connect,
+            )
+            entry = _Entry(sid)
+            entry.refcount = 1
+            self._map[key] = entry
+            return sid
+
+    def find(self, ep: EndPoint) -> Optional[int]:
+        with self._lock:
+            entry = self._map.get((ep.ip, ep.port))
+            return entry.sid if entry else None
+
+    def remove(self, ep: EndPoint):
+        """Drop one reference; recycle the socket at zero
+        (SocketMap::Remove)."""
+        key = (ep.ip, ep.port)
+        with self._lock:
+            entry = self._map.get(key)
+            if entry is None:
+                return
+            entry.refcount -= 1
+            if entry.refcount > 0:
+                return
+            del self._map[key]
+            sid = entry.sid
+        sock = Socket.address(sid)
+        if sock is not None:
+            sock.recycle()
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+_global_map: Optional[SocketMap] = None
+_global_lock = threading.Lock()
+
+
+def get_global_socket_map() -> SocketMap:
+    global _global_map
+    if _global_map is None:
+        with _global_lock:
+            if _global_map is None:
+                _global_map = SocketMap()
+    return _global_map

@@ -1,0 +1,66 @@
+"""SocketMap + bthread fd helper tests (details/socket_map, bthread/fd.cpp
+shapes)."""
+import socket as pysocket
+
+import pytest
+
+from brpc_tpu import bthread, rpc
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.rpc.proto import echo_pb2
+from brpc_tpu.rpc.socket_map import SocketMap, get_global_socket_map
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=2))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def test_channels_share_single_connection(server):
+    """Two channels, same endpoint, 'single' type → ONE shared socket."""
+    ch1, ch2 = rpc.Channel(), rpc.Channel()
+    assert ch1.init(str(server.listen_endpoint)) == 0
+    assert ch2.init(str(server.listen_endpoint)) == 0
+    c1, _ = ch1.call("EchoService.Echo", echo_pb2.EchoRequest(message="a"),
+                     echo_pb2.EchoResponse, timeout_ms=3000)
+    c2, _ = ch2.call("EchoService.Echo", echo_pb2.EchoRequest(message="b"),
+                     echo_pb2.EchoResponse, timeout_ms=3000)
+    assert not c1.failed() and not c2.failed()
+    assert ch1._single_sid == ch2._single_sid  # shared via SocketMap
+    ch1.close()
+    ch2.close()
+
+
+def test_socket_map_refcounting():
+    smap = SocketMap()
+    ep = EndPoint("127.0.0.1", 1)  # never connected: just identity mgmt
+    sid1 = smap.insert(ep)
+    sid2 = smap.insert(ep)
+    assert sid1 == sid2
+    assert smap.count() == 1
+    smap.remove(ep)  # ref 2 -> 1
+    assert smap.count() == 1
+    smap.remove(ep)  # ref 1 -> 0: recycled
+    assert smap.count() == 0
+    sid3 = smap.insert(ep)
+    assert sid3 != sid1  # new socket identity after recycle
+
+
+def test_fd_wait_and_connect(server):
+    s = bthread.connect(("127.0.0.1", server.listen_endpoint.port),
+                        timeout_s=2)
+    # writable right after connect
+    assert bthread.fd_wait(s.fileno(), "w", timeout_s=2)
+    # not readable yet (no data): timeout path
+    assert not bthread.fd_wait(s.fileno(), "r", timeout_s=0.05)
+    s.close()
