@@ -1,0 +1,207 @@
+"""nshead protocol — Baidu's classic 36-byte-header framing.
+
+Counterpart of brpc's nshead support (/root/reference/src/brpc/nshead.h:
+NSHEAD_MAGICNUM 0xfb709394; policy/nshead_protocol.cpp +
+nshead_pb_service_adaptor.{h,cpp}): header = {u16 id, u16 version,
+u32 log_id, char provider[16], u32 magic, u32 reserved, u32 body_len}
+(little-endian), then the body. Servers install an NsheadService whose
+handler sees (controller, NsheadMessage, done); the pb adaptor maps bodies
+to protobuf messages by content — here via the mcpack2pb front-end, the
+pairing the nshead_mcpack protocol uses.
+
+This single implementation carries the capability slot of the reference's
+Baidu legacy family (nshead/nshead_mcpack; hulu/sofa/nova/public/ubrpc are
+that company's internal pb-rpc variants of the same shape).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Callable, Optional
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+
+NSHEAD_MAGICNUM = 0xFB709394
+_HEAD = struct.Struct("<HHI16sIII")  # 36 bytes
+HEAD_SIZE = _HEAD.size
+
+
+class NsheadMessage:
+    """head fields + body bytes (nshead_message.h role)."""
+
+    def __init__(self, body: bytes = b"", id_: int = 0, version: int = 0,
+                 log_id: int = 0, provider: bytes = b"brpc_tpu"):
+        self.id = id_
+        self.version = version
+        self.log_id = log_id
+        self.provider = provider[:16]
+        self.body = body
+
+    def serialize(self) -> bytes:
+        return _HEAD.pack(self.id, self.version, self.log_id,
+                          self.provider.ljust(16, b"\x00"),
+                          NSHEAD_MAGICNUM, 0, len(self.body)) + self.body
+
+
+class NsheadInputMessage(InputMessageBase):
+    __slots__ = ("msg", "is_request")
+
+    def __init__(self, msg: NsheadMessage):
+        super().__init__()
+        self.msg = msg
+        self.is_request = True  # role decided by connection side
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    if len(portal) < HEAD_SIZE:
+        head = portal.copy_to_bytes(min(HEAD_SIZE, len(portal)))
+        if len(head) >= 28:
+            (magic,) = struct.unpack_from("<I", head, 24)
+            if magic != NSHEAD_MAGICNUM:
+                return ParseResult.try_others()
+            return ParseResult.not_enough()
+        # cannot see the magic yet; only claim if it could still match
+        return ParseResult.not_enough() if len(head) < 28 else ParseResult.try_others()
+    raw = portal.copy_to_bytes(HEAD_SIZE)
+    id_, version, log_id, provider, magic, _res, body_len = _HEAD.unpack(raw)
+    if magic != NSHEAD_MAGICNUM:
+        return ParseResult.try_others()
+    if body_len > (64 << 20):
+        return ParseResult.error_()
+    if len(portal) < HEAD_SIZE + body_len:
+        return ParseResult.not_enough()
+    portal.pop_front(HEAD_SIZE)
+    body = portal.cutn_bytes(body_len)
+    msg = NsheadMessage(body, id_, version, log_id,
+                        provider.rstrip(b"\x00"))
+    return ParseResult.ok(NsheadInputMessage(msg))
+
+
+def serialize_request(request, cntl: Controller):
+    if isinstance(request, NsheadMessage):
+        return request.body
+    if isinstance(request, (bytes, bytearray)):
+        return bytes(request)
+    raise TypeError("nshead channel takes an NsheadMessage or bytes")
+
+
+def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf:
+    # nshead has no correlation field wide enough; responses arrive in
+    # order on the connection (the reference treats nshead as
+    # one-request-at-a-time per connection too).
+    sock = cntl._current_sock
+    from collections import deque
+
+    q = getattr(sock, "_nshead_pipeline", None)
+    if q is None:
+        q = deque()
+        sock._nshead_pipeline = q
+    q.append(correlation_id)
+    msg = NsheadMessage(payload, log_id=cntl.log_id & 0xFFFFFFFF)
+    return IOBuf(msg.serialize())
+
+
+def process_response(msg: NsheadInputMessage):
+    sock = msg.socket
+    q = getattr(sock, "_nshead_pipeline", None)
+    if not q:
+        return
+    cid = q.popleft()
+    try:
+        cntl = bthread_id.lock(cid)
+    except (KeyError, TimeoutError):
+        return
+    if not isinstance(cntl, Controller):
+        try:
+            bthread_id.unlock(cid)
+        except Exception:
+            pass
+        return
+    resp = cntl._response
+    if isinstance(resp, NsheadMessage):
+        resp.body = msg.msg.body
+        resp.id = msg.msg.id
+        resp.log_id = msg.msg.log_id
+    cntl._end_rpc_locked_or_not(locked=True)
+
+
+def process_request(msg: NsheadInputMessage):
+    server = msg.arg
+    service = getattr(server, "nshead_service", None) if server else None
+    sock = msg.socket
+    if service is None:
+        # Not a serving connection: this frame is a RESPONSE to our client
+        # (nshead frames carry no request/response marker).
+        return process_response(msg)
+    cntl = Controller()
+    cntl.server = server
+    cntl.remote_side = sock.remote_side
+    cntl.log_id = msg.msg.log_id
+    responded = [False]
+
+    def done(response: Optional[NsheadMessage] = None):
+        if responded[0]:
+            return
+        responded[0] = True
+        out = response or NsheadMessage()
+        out.log_id = msg.msg.log_id
+        sock.write(IOBuf(out.serialize()))
+
+    try:
+        service.process_nshead_request(cntl, msg.msg, done)
+    except Exception as e:
+        if not responded[0]:
+            done(NsheadMessage(f"error: {e}".encode()))
+
+
+class NsheadService:
+    """Base for nshead servers (NsheadService role): override
+    process_nshead_request(cntl, request_msg, done)."""
+
+    def process_nshead_request(self, cntl, request: NsheadMessage,
+                               done: Callable):
+        done(NsheadMessage(request.body))  # default: echo
+
+
+class NsheadPbServiceAdaptor(NsheadService):
+    """pb front-end over nshead bodies via mcpack
+    (nshead_pb_service_adaptor.h + nshead_mcpack pairing): bodies are
+    mcpack-encoded pb messages; handler sees decoded pb."""
+
+    def __init__(self, request_class, response_class, handler):
+        self.request_class = request_class
+        self.response_class = response_class
+        self.handler = handler  # (cntl, request_pb, response_pb) -> None
+
+    def process_nshead_request(self, cntl, request: NsheadMessage, done):
+        from brpc_tpu.mcpack2pb import mcpack_to_pb, pb_to_mcpack
+
+        try:
+            req_pb = mcpack_to_pb(request.body, self.request_class)
+        except (ValueError, IndexError, KeyError) as e:
+            done(NsheadMessage(f"bad mcpack body: {e}".encode()))
+            return
+        resp_pb = self.response_class()
+        self.handler(cntl, req_pb, resp_pb)
+        done(NsheadMessage(pb_to_mcpack(resp_pb)))
+
+
+register_protocol(Protocol(
+    name="nshead",
+    type=ProtocolType.ESP,  # reuse a free slot id for the legacy family
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+    process_inline=True,
+))

@@ -142,3 +142,4 @@ def globally_initialize():
     from brpc_tpu.rpc import memcache_protocol  # noqa: F401
     from brpc_tpu.rpc import h2_protocol  # noqa: F401
     from brpc_tpu.rpc import thrift_protocol  # noqa: F401
+    from brpc_tpu.rpc import nshead_protocol  # noqa: F401

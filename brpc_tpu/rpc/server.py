@@ -49,6 +49,8 @@ class ServerOptions:
     memcache_service: Optional[object] = None
     # server speaks framed thrift when set (ThriftService role)
     thrift_service: Optional[object] = None
+    # server speaks nshead when set (NsheadService adaptor role)
+    nshead_service: Optional[object] = None
     # TLS (ServerSSLOptions role): PEM paths; empty = plaintext
     ssl_certfile: str = ""
     ssl_keyfile: str = ""
@@ -72,6 +74,7 @@ class Server:
         self.redis_service = self.options.redis_service
         self.memcache_service = self.options.memcache_service
         self.thrift_service = self.options.thrift_service
+        self.nshead_service = self.options.nshead_service
         self.session_pool = None
         if self.options.session_local_data_factory is not None:
             from brpc_tpu.rpc.data_pools import SimpleDataPool

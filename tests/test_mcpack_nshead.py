@@ -1,0 +1,105 @@
+"""mcpack2pb + nshead tests (mcpack codec roundtrips, pb front-end,
+nshead framing client/server, pb-over-mcpack adaptor)."""
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.mcpack2pb import dumps, loads, mcpack_to_pb, pb_to_mcpack
+from brpc_tpu.rpc.nshead_protocol import (
+    NsheadMessage,
+    NsheadPbServiceAdaptor,
+    NsheadService,
+)
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+def test_mcpack_scalar_roundtrip():
+    obj = {
+        "int": 42,
+        "negative": -7,
+        "big": 1 << 40,
+        "float": 2.5,
+        "string": "hello",
+        "binary": b"\x00\x01\x02",
+        "flag": True,
+        "none": None,
+    }
+    assert loads(dumps(obj)) == obj
+
+
+def test_mcpack_nested():
+    obj = {
+        "nested": {"a": 1, "b": "two"},
+        "list": [1, 2, 3],
+        "objlist": [{"x": 1}, {"x": 2}],
+        "longstr": "y" * 1000,  # exercises the long head
+        "bigbin": b"z" * 1000,
+    }
+    assert loads(dumps(obj)) == obj
+
+
+def test_mcpack_pb_front_end():
+    msg = echo_pb2.EchoRequest(message="mc", code=7)
+    data = pb_to_mcpack(msg)
+    back = mcpack_to_pb(data, echo_pb2.EchoRequest)
+    assert back.message == "mc" and back.code == 7
+
+
+def test_nshead_frame_roundtrip():
+    m = NsheadMessage(b"body-bytes", id_=3, log_id=99)
+    raw = m.serialize()
+    assert len(raw) == 36 + len(b"body-bytes")
+    from brpc_tpu.butil.iobuf import IOPortal
+    from brpc_tpu.rpc.nshead_protocol import parse
+
+    portal = IOPortal()
+    portal.append(raw)
+    result = parse(portal, None, False, None)
+    assert result.message.msg.body == b"body-bytes"
+    assert result.message.msg.log_id == 99
+
+
+@pytest.fixture(scope="module")
+def nshead_server():
+    class UpperService(NsheadService):
+        def process_nshead_request(self, cntl, request, done):
+            done(NsheadMessage(request.body.upper()))
+
+    srv = rpc.Server(rpc.ServerOptions(nshead_service=UpperService(),
+                                       num_threads=2))
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def test_nshead_client_server(nshead_server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="nshead", timeout_ms=3000))
+    assert ch.init(str(nshead_server.listen_endpoint)) == 0
+    resp = NsheadMessage()
+    cntl = rpc.Controller()
+    ch.call_method("nshead", cntl, NsheadMessage(b"hello nshead"), resp)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.body == b"HELLO NSHEAD"
+
+
+def test_nshead_pb_adaptor():
+    def handler(cntl, req, resp):
+        resp.message = f"adapted:{req.message}"
+
+    adaptor = NsheadPbServiceAdaptor(echo_pb2.EchoRequest,
+                                     echo_pb2.EchoResponse, handler)
+    srv = rpc.Server(rpc.ServerOptions(nshead_service=adaptor,
+                                       num_threads=2))
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="nshead",
+                                            timeout_ms=3000))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        body = pb_to_mcpack(echo_pb2.EchoRequest(message="pbmc"))
+        resp = NsheadMessage()
+        cntl = rpc.Controller()
+        ch.call_method("nshead", cntl, NsheadMessage(body), resp)
+        assert not cntl.failed(), cntl.error_text
+        out = mcpack_to_pb(resp.body, echo_pb2.EchoResponse)
+        assert out.message == "adapted:pbmc"
+    finally:
+        srv.stop()
