@@ -27,6 +27,13 @@ from typing import Callable, Dict, List, Optional
 from brpc_tpu import bvar
 from brpc_tpu.bthread.parking_lot import ParkingLot
 from brpc_tpu.bthread.work_stealing_queue import WorkStealingQueue
+from brpc_tpu.butil import flags
+
+# The monographdb fork's idle-loop tuning knobs (task_group.cpp:54-78):
+flags.define_int("worker_polling_time_us", 0,
+                 "busy-poll this long before parking an idle worker")
+flags.define_int("steal_task_rnd", 1,
+                 "steal every N idle rounds (1 = every round)")
 
 
 class TaskMeta:
@@ -70,7 +77,7 @@ class TaskGroup:
         self.parking_lot.signal(1)
 
     # -- consumer ----------------------------------------------------------
-    def _next_task(self) -> Optional[TaskMeta]:
+    def _next_task(self, steal: bool = True) -> Optional[TaskMeta]:
         with self._bound_lock:
             if self._bound_rq:
                 return self._bound_rq.popleft()
@@ -80,16 +87,25 @@ class TaskGroup:
         with self._remote_lock:
             if self._remote_rq:
                 return self._remote_rq.popleft()
+        if not steal:
+            return None
         return self.control.steal_task(self.group_id)
 
     def run_main_task(self):
-        """Worker main loop (task_group.cpp:238-270 + wait_task 139-232)."""
+        """Worker main loop (task_group.cpp:238-270 + wait_task 139-232,
+        including the fork's busy-poll window and steal frequency)."""
+        import time as _time
+
         control = self.control
+        idle_rounds = 0
         while not control._stopping:
-            meta = self._next_task()
+            steal_rnd = max(1, flags.get_flag("steal_task_rnd"))
+            meta = self._next_task(
+                steal=(idle_rounds % steal_rnd == 0))
             if meta is None:
+                idle_rounds += 1
                 # Idle: run registered hooks (libtpu poll / ext-processor
-                # slot), then park on this worker's lot.
+                # slot), busy-poll if configured, then park on this lot.
                 did_work = False
                 for hook in control.idle_hooks:
                     try:
@@ -98,10 +114,25 @@ class TaskGroup:
                         pass
                 if did_work:
                     continue
-                expected = self.parking_lot.get_state()
-                if self._rq.empty() and not self._remote_rq and not self._bound_rq:
-                    self.parking_lot.wait(expected, timeout=0.1)
-                continue
+                poll_us = flags.get_flag("worker_polling_time_us")
+                if poll_us > 0:
+                    deadline = _time.monotonic() + poll_us / 1e6
+                    polled = None
+                    while _time.monotonic() < deadline:
+                        polled = self._next_task()
+                        if polled is not None:
+                            break
+                    if polled is not None:
+                        meta = polled
+                    else:
+                        continue
+                if meta is None:
+                    expected = self.parking_lot.get_state()
+                    if (self._rq.empty() and not self._remote_rq
+                            and not self._bound_rq):
+                        self.parking_lot.wait(expected, timeout=0.1)
+                    continue
+            idle_rounds = 0
             self.nswitch += 1
             control._nswitch_var.update(1)
             try:
