@@ -121,9 +121,27 @@ def find_protocol_by_name(name: str) -> Optional[Protocol]:
     return None
 
 
+# Parse order for the multi-protocol port. Must be deterministic regardless
+# of module import order, and magic-discriminating protocols must precede
+# greedy ones (nshead cannot rule itself out on <28 bytes, thrift on <6).
+_PARSE_PRIORITY = {
+    ProtocolType.TPU_STD: 0,
+    ProtocolType.STREAMING: 1,
+    ProtocolType.TENSOR: 2,
+    ProtocolType.HTTP: 3,
+    ProtocolType.H2: 4,
+    ProtocolType.REDIS: 5,
+    ProtocolType.MEMCACHE: 6,
+    ProtocolType.THRIFT: 7,
+    ProtocolType.ESP: 8,  # nshead family — last: weakest magic
+}
+
+
 def list_server_protocols() -> List[Protocol]:
-    """Protocols a server port tries, in registration order."""
-    return [p for p in _protocols.values() if p.support_server and p.parse]
+    """Protocols a server port tries, in fixed priority order."""
+    ps = [p for p in _protocols.values() if p.support_server and p.parse]
+    ps.sort(key=lambda p: _PARSE_PRIORITY.get(p.type, 99))
+    return ps
 
 
 def globally_initialize():
