@@ -42,6 +42,9 @@ class ChannelOptions:
     auth: Optional[object] = None  # Authenticator (authenticator.h)
     use_ssl: bool = False
     ssl_verify: bool = False  # verify server cert (off: self-signed dev)
+    # use_rdma analog (channel.h:41-89): connections run the device
+    # handshake through the AppConnect seam and carry a DeviceEndpoint.
+    use_device_transport: bool = False
 
 
 _client_messenger: Optional[InputMessenger] = None
@@ -74,17 +77,20 @@ class Channel:
         self._circuit_breakers = {}  # sid -> CircuitBreaker
         self._cb_lock = threading.Lock()
         self._init_done = False
-        self._mapped_ep = None  # endpoint held in the global SocketMap
+        self._mapped_key = None  # SocketMapKey held in the global SocketMap
+        self._mapped_sid = None  # the shared SocketId our reference is on
 
     def close(self):
         """Release channel resources: NS thread + SocketMap reference."""
         if self._ns_thread is not None:
             self._ns_thread.stop()
-        if self._mapped_ep is not None:
+        if self._mapped_key is not None:
             from brpc_tpu.rpc.socket_map import get_global_socket_map
 
-            get_global_socket_map().remove(self._mapped_ep)
-            self._mapped_ep = None
+            get_global_socket_map().remove(key=self._mapped_key,
+                                           expected_sid=self._mapped_sid)
+            self._mapped_key = None
+            self._mapped_sid = None
 
     # -- init --------------------------------------------------------------
     def init(self, target, lb_name: str = "") -> int:
@@ -148,13 +154,25 @@ class Channel:
             ctx.verify_mode = _ssl.CERT_NONE
         return ctx
 
+    def _app_connect_factory(self):
+        """Per-socket app-level connect hook maker (AppConnect seam,
+        socket.h:108-130). Each new connection gets its OWN transport
+        endpoint, mirroring one RdmaEndpoint per Socket."""
+        if not self.options.use_device_transport:
+            return None
+        from brpc_tpu.rpc.device_transport import DeviceEndpoint
+
+        return lambda: DeviceEndpoint().app_connect
+
     def _connect_new_socket(self, ep: EndPoint) -> Optional[Socket]:
         messenger = get_client_messenger()
+        factory = self._app_connect_factory()
         sid = Socket.create(
             remote_side=ep,
             on_edge_triggered_events=messenger.on_new_messages,
             health_check_interval_s=self.options.health_check_interval_s,
             ssl_context=self._client_ssl_context(),
+            app_connect=factory() if factory is not None else None,
         )
         sock = Socket.address(sid)
         rc = sock.connect(timeout_s=self.options.connect_timeout_ms / 1000.0)
@@ -173,10 +191,16 @@ class Channel:
             main_sock = Socket.address(sid)
             if main_sock is None or main_sock.failed():
                 return None, errors.EFAILEDSOCKET
-            if (self.options.connection_type == "single"
-                    and main_sock.ensure_connected(
-                        self.options.connect_timeout_ms / 1000.0) != 0):
-                return None, errors.EFAILEDSOCKET
+            if self.options.connection_type == "single":
+                # NS-created sockets are dialed lazily; attach the device
+                # transport hook before the first connect (use_rdma analog).
+                factory = self._app_connect_factory()
+                if (factory is not None and main_sock.app_connect is None
+                        and main_sock.fd() is None):
+                    main_sock.app_connect = factory()
+                if main_sock.ensure_connected(
+                        self.options.connect_timeout_ms / 1000.0) != 0:
+                    return None, errors.EFAILEDSOCKET
             return self._apply_connection_type(main_sock, cntl)
         if self._server_ep is None:
             return None, errors.EINVAL
@@ -208,27 +232,31 @@ class Channel:
             sock.conn_data = self  # home pool
             return sock, 0
         # single (default): the PROCESS-WIDE shared connection for this
-        # endpoint via SocketMap (details/socket_map role) — two channels to
-        # one server share a connection, created/revived lazily. TLS
-        # channels keep a private connection (the map key is plain-endpoint;
-        # reference keys by endpoint+ssl+auth, SocketMapKey).
-        from brpc_tpu.rpc.socket_map import get_global_socket_map
+        # channel signature via SocketMap (details/socket_map role) — two
+        # channels with the same (endpoint, protocol, ssl, auth, transport)
+        # share one connection; any difference gets its own (SocketMapKey,
+        # socket_map.h).
+        from brpc_tpu.rpc.socket_map import get_global_socket_map, make_key
 
         with self._single_lock:
             if self._single_sid is not None:
                 sock = Socket.address(self._single_sid)
                 if sock is not None and not sock.failed():
                     return sock, 0
-            if self.options.use_ssl:
-                sock = self._connect_new_socket(ep)
-                if sock is None:
-                    return None, errors.EFAILEDSOCKET
-                self._single_sid = sock.socket_id
-                return sock, 0
+            key = make_key(
+                ep,
+                protocol=self.options.protocol,
+                ssl=self.options.use_ssl,
+                auth=self.options.auth,
+                app_connect_id=(
+                    "device" if self.options.use_device_transport else ""),
+            )
             sid = get_global_socket_map().insert(
                 ep,
                 health_check_interval_s=self.options.health_check_interval_s,
                 ssl_context=self._client_ssl_context(),
+                app_connect_factory=self._app_connect_factory(),
+                key=key,
             )
             sock = Socket.address(sid) if sid is not None else None
             if sock is None:
@@ -237,7 +265,8 @@ class Channel:
                     self.options.connect_timeout_ms / 1000.0) != 0:
                 return None, errors.EFAILEDSOCKET
             self._single_sid = sock.socket_id
-            self._mapped_ep = ep
+            self._mapped_key = key
+            self._mapped_sid = sid
             return sock, 0
 
     def _on_rpc_end(self, cntl: Controller):

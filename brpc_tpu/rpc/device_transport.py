@@ -175,6 +175,10 @@ class DeviceEndpoint:
         failure the connection falls back to plain TCP rather than dying
         (the FALLBACK_TCP story of rdma.md)."""
         self.state = HANDSHAKING
+        # Attach to the socket up-front so even FALLBACK_TCP outcomes leave
+        # the endpoint reachable via sock.app_state (window/ACK bookkeeping
+        # applies to the wire path too).
+        sock.app_state = self
         try:
             import json
 
@@ -201,7 +205,6 @@ class DeviceEndpoint:
                 self.state = ESTABLISHED
             else:
                 self.state = FALLBACK_TCP
-            sock.app_state = self
             return 0
         except OSError:
             self.state = FALLBACK_TCP

@@ -1,18 +1,35 @@
 """SocketMap — process-wide client connection sharing.
 
 Counterpart of brpc's SocketMap (/root/reference/src/brpc/details/
-socket_map.{h,cpp}): "single"-type client connections to the same endpoint
-are shared by every channel in the process, reference-counted; Remove drops
-the ref and recycles on zero. Channels call get_client_socket instead of
-dialing their own.
+socket_map.{h,cpp}): "single"-type client connections are shared by every
+channel in the process, reference-counted; Remove drops the ref and
+recycles on zero. Channels call get_client_socket instead of dialing their
+own.
+
+Keying follows SocketMapKey (socket_map.h): the map key is the endpoint
+PLUS the channel signature — protocol, ssl, authenticator and app-level
+connect identity — so channels that differ in any of those get distinct
+connections. (The reference folds ssl+auth into ChannelSignature; the
+observed failure mode of a bare-endpoint key is a memcache channel being
+handed a tpu_std channel's connection on a multi-protocol port.)
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from brpc_tpu.butil.endpoint import EndPoint
 from brpc_tpu.rpc.socket import Socket
+
+# (ip, port, protocol, ssl, auth_id, app_connect_id)
+SocketMapKey = Tuple[str, int, str, bool, int, str]
+
+
+def make_key(ep: EndPoint, protocol: str = "", ssl: bool = False,
+             auth=None, app_connect_id: str = "") -> SocketMapKey:
+    """Build the sharing key for one channel signature (SocketMapKey)."""
+    return (ep.ip, ep.port, protocol, bool(ssl),
+            id(auth) if auth is not None else 0, app_connect_id)
 
 
 class _Entry:
@@ -25,15 +42,22 @@ class _Entry:
 
 class SocketMap:
     def __init__(self):
-        self._map: Dict[Tuple[str, int], _Entry] = {}
+        self._map: Dict[SocketMapKey, _Entry] = {}
         self._lock = threading.Lock()
 
     def insert(self, ep: EndPoint, messenger=None,
                health_check_interval_s: float = -1,
-               ssl_context=None, app_connect=None) -> Optional[int]:
-        """Get-or-create the shared SocketId for this endpoint
-        (SocketMap::Insert)."""
-        key = (ep.ip, ep.port)
+               ssl_context=None, app_connect=None,
+               app_connect_factory: Optional[Callable] = None,
+               key: Optional[SocketMapKey] = None) -> Optional[int]:
+        """Get-or-create the shared SocketId for this key
+        (SocketMap::Insert). `app_connect_factory` makes a fresh per-socket
+        app-connect hook (each connection needs its own transport endpoint,
+        the RdmaEndpoint-per-Socket shape of rdma_endpoint.h)."""
+        if key is None:
+            hook = app_connect or app_connect_factory
+            key = make_key(ep, ssl=ssl_context is not None,
+                           app_connect_id=f"custom:{id(hook)}" if hook else "")
         with self._lock:
             entry = self._map.get(key)
             if entry is not None:
@@ -46,6 +70,8 @@ class SocketMap:
                 from brpc_tpu.rpc.channel import get_client_messenger
 
                 messenger = get_client_messenger()
+            if app_connect is None and app_connect_factory is not None:
+                app_connect = app_connect_factory()
             sid = Socket.create(
                 remote_side=ep,
                 on_edge_triggered_events=messenger.on_new_messages,
@@ -58,18 +84,32 @@ class SocketMap:
             self._map[key] = entry
             return sid
 
-    def find(self, ep: EndPoint) -> Optional[int]:
+    def find(self, ep: Optional[EndPoint] = None,
+             key: Optional[SocketMapKey] = None) -> Optional[int]:
+        if key is None:
+            if ep is None:
+                raise ValueError("find() needs an endpoint or a key")
+            key = make_key(ep)
         with self._lock:
-            entry = self._map.get((ep.ip, ep.port))
+            entry = self._map.get(key)
             return entry.sid if entry else None
 
-    def remove(self, ep: EndPoint):
+    def remove(self, ep: Optional[EndPoint] = None,
+               key: Optional[SocketMapKey] = None,
+               expected_sid: Optional[int] = None):
         """Drop one reference; recycle the socket at zero
-        (SocketMap::Remove)."""
-        key = (ep.ip, ep.port)
+        (SocketMap::Remove). `expected_sid` guards against decrementing a
+        NEWER entry that replaced the one this caller referenced
+        (SocketMap::Remove's expected_id)."""
+        if key is None:
+            if ep is None:
+                raise ValueError("remove() needs an endpoint or a key")
+            key = make_key(ep)
         with self._lock:
             entry = self._map.get(key)
             if entry is None:
+                return
+            if expected_sid is not None and entry.sid != expected_sid:
                 return
             entry.refcount -= 1
             if entry.refcount > 0:

@@ -198,33 +198,18 @@ class TensorClient:
 
 
 def make_device_channel(target, options=None):
-    """A Channel whose connections handshake the device transport
-    (use_rdma=true analog, channel option of the reference)."""
-    from brpc_tpu.rpc.channel import Channel
+    """A Channel whose connections handshake the device transport — sugar
+    for ChannelOptions(use_device_transport=True), the use_rdma analog
+    (channel.h:41-89)."""
+    import dataclasses
 
+    from brpc_tpu.rpc.channel import Channel, ChannelOptions
+
+    options = (dataclasses.replace(options, use_device_transport=True)
+               if options is not None
+               else ChannelOptions(use_device_transport=True))
     ch = Channel(options)
     rc = ch.init(target)
     if rc != 0:
         return None
-    orig_connect = ch._connect_new_socket
-
-    def connect_with_device(ep):
-        from brpc_tpu.rpc.channel import get_client_messenger
-        from brpc_tpu.rpc.socket import Socket
-
-        messenger = get_client_messenger()
-        dep = DeviceEndpoint()
-        sid = Socket.create(
-            remote_side=ep,
-            on_edge_triggered_events=messenger.on_new_messages,
-            health_check_interval_s=ch.options.health_check_interval_s,
-            app_connect=dep.app_connect,
-        )
-        sock = Socket.address(sid)
-        rc = sock.connect(timeout_s=ch.options.connect_timeout_ms / 1000.0)
-        if rc != 0:
-            return None
-        return sock
-
-    ch._connect_new_socket = connect_with_device
     return ch

@@ -1,0 +1,16 @@
+"""Make examples honor JAX_PLATFORMS.
+
+The environment's sitecustomize may pre-select a platform through
+jax.config (which overrides the JAX_PLATFORMS env var); the test runner
+forces the virtual-CPU mesh via that env var, so re-apply it here before
+any backend initializes.
+"""
+import os
+
+
+def apply():
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)

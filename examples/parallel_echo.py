@@ -9,6 +9,10 @@ import sys
 
 sys.path.insert(0, ".")
 
+import _jaxenv  # noqa: E402
+
+_jaxenv.apply()
+
 from brpc_tpu import rpc  # noqa: E402
 from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
 
@@ -62,7 +66,7 @@ def main():
         shards = jnp.arange(float(n)).reshape(n, 1)
         merged = mc.parallel_call(lambda s: s * 2.0, shards, merger="add")
         print(f"Mesh fan-out (ONE allreduce over {n} devices):",
-              float(merged[0]))
+              float(merged.ravel()[0]))
     for srv in servers:
         srv.stop()
 

@@ -12,6 +12,10 @@ import time
 
 sys.path.insert(0, ".")
 
+import _jaxenv  # noqa: E402
+
+_jaxenv.apply()
+
 import numpy as np  # noqa: E402
 
 from brpc_tpu import rpc  # noqa: E402

@@ -41,6 +41,39 @@ def test_channels_share_single_connection(server):
     ch2.close()
 
 
+def test_different_protocols_get_different_connections(server):
+    """SocketMapKey includes the protocol (socket_map.h): a tpu_std channel
+    and an http channel to the SAME endpoint must NOT share a socket."""
+    ch1 = rpc.Channel()
+    ch2 = rpc.Channel(rpc.ChannelOptions(protocol="http"))
+    assert ch1.init(str(server.listen_endpoint)) == 0
+    assert ch2.init(str(server.listen_endpoint)) == 0
+    c1, _ = ch1.call("EchoService.Echo", echo_pb2.EchoRequest(message="a"),
+                     echo_pb2.EchoResponse, timeout_ms=3000)
+    assert not c1.failed()
+    c2, r2 = ch2.call("EchoService.Echo", echo_pb2.EchoRequest(message="h"),
+                      echo_pb2.EchoResponse, timeout_ms=3000)
+    assert not c2.failed(), c2.error_text
+    assert r2.message == "h"
+    assert ch1._single_sid != ch2._single_sid
+    ch1.close()
+    ch2.close()
+
+
+def test_ssl_and_device_transport_keyed_separately():
+    """ssl / device-transport channels never share a plain connection."""
+    from brpc_tpu.rpc.socket_map import make_key
+
+    ep = EndPoint("127.0.0.1", 1)
+    plain = make_key(ep, protocol="tpu_std")
+    ssl = make_key(ep, protocol="tpu_std", ssl=True)
+    dev = make_key(ep, protocol="tpu_std", app_connect_id="device")
+    assert len({plain, ssl, dev}) == 3
+    smap = SocketMap()
+    assert smap.insert(ep, key=plain) != smap.insert(ep, key=dev)
+    assert smap.count() == 2
+
+
 def test_socket_map_refcounting():
     smap = SocketMap()
     ep = EndPoint("127.0.0.1", 1)  # never connected: just identity mgmt
