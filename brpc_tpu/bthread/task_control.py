@@ -143,6 +143,10 @@ class TaskGroup:
                 logging.getLogger(__name__).exception("bthread raised")
             finally:
                 meta.joined.set()
+                # Detached-by-default reap (bthread_start_background tasks
+                # are detached unless joined): tids are never reused, so a
+                # later join() of a reaped tid correctly reports finished.
+                control._metas.pop(meta.tid, None)
                 control._finished_var.update(1)
 
 

@@ -182,10 +182,15 @@ class Controller:
         packet = channel._protocol.pack_request(
             self._request_payload, self, attempt_cid
         )
+        # Pipelined-protocol correlation entries are pushed atomically with
+        # the queue append (on_queued runs under the socket's write lock),
+        # so concurrent callers on a shared connection cannot enqueue in
+        # one order but write in another.
         on_packed = channel._protocol.extra.get("on_packed")
-        if on_packed is not None:
-            on_packed(sock, self, attempt_cid)
-        rc = sock.write(packet, id_wait=attempt_cid)
+        on_queued = (
+            (lambda: on_packed(sock, self, attempt_cid))
+            if on_packed is not None else None)
+        rc = sock.write(packet, id_wait=attempt_cid, on_queued=on_queued)
         if rc != 0:
             return  # id_wait already errored via socket failure path
         if self._deadline is not None and self._timeout_timer is None:

@@ -126,6 +126,7 @@ def huffman_decode(data: bytes) -> bytes:
     out = bytearray()
     node = 0
     padding = 0
+    pad_all_ones = True
     for byte in data:
         for i in range(7, -1, -1):
             bit = (byte >> i) & 1
@@ -137,10 +138,16 @@ def huffman_decode(data: bytes) -> bytes:
                 out.append(sym)
                 node = 0
                 padding = 0
+                pad_all_ones = True
             else:
                 padding += 1
+                if bit == 0:
+                    pad_all_ones = False
     if padding > 7:
         raise ValueError("huffman padding too long")
+    # RFC 7541 5.2: an incomplete trailing code must be the EOS prefix.
+    if padding and not pad_all_ones:
+        raise ValueError("huffman padding is not an EOS prefix")
     return bytes(out)
 
 
@@ -204,6 +211,8 @@ def encode_str(s: str, huffman: bool = False) -> bytes:
 def decode_str(data: bytes, pos: int) -> Tuple[str, int]:
     huff = bool(data[pos] & 0x80)
     length, pos = decode_int(data, pos, 7)
+    if pos + length > len(data):
+        raise ValueError("hpack string extends past the header block")
     raw = data[pos: pos + length]
     pos += length
     if huff:

@@ -84,6 +84,7 @@ class Socket:
         self.error_text = ""
         self._write_q: deque = deque()
         self._write_lock = threading.Lock()
+        self._connect_lock = threading.Lock()
         self._writing = False
         self._epollout = threading.Event()
         self._reading = False
@@ -97,6 +98,7 @@ class Socket:
         self._inflight_ids = set()  # correlation ids to fail on SetFailed
         self._inflight_lock = threading.Lock()
         self.connection_type = "single"
+        self._conn_ready = False  # fd usable for RPC (post-handshake)
         self.app_connect = None  # AppConnect seam (device transport attaches)
         self.app_state = None  # transport-private state (e.g. DeviceEndpoint)
         self.ssl_context = None  # client TLS context (ChannelSSLOptions)
@@ -134,6 +136,7 @@ class Socket:
         _conn_count.update(1)
         if fd is not None:
             fd.setblocking(False)
+            sock._conn_ready = True
             sock._register_with_dispatcher()
         return sid
 
@@ -193,19 +196,24 @@ class Socket:
                 self.set_failed(rc, "app connect failed")
                 return rc
         self._register_with_dispatcher()
+        self._conn_ready = True
         return 0
 
     def ensure_connected(self, timeout_s: float = 1.0) -> int:
         """Lazy connect for sockets created unconnected (NS-created LB
-        nodes); thread-safe connect-once."""
-        if self._fd is not None:
+        nodes); thread-safe connect-once: the connect lock is held across
+        the whole dial so racing callers wait instead of double-dialing.
+        The lock-free fast path keys on _conn_ready, which connect()
+        publishes only AFTER the app-level handshake — a racing caller must
+        not write RPC bytes into a handshake in progress."""
+        if self._conn_ready:
             return 0
-        with self._write_lock:
-            if self._fd is not None:
+        with self._connect_lock:
+            if self._conn_ready:
                 return 0
             if self._failed:
                 return self.error_code or errors.EFAILEDSOCKET
-        return self.connect(timeout_s)
+            return self.connect(timeout_s)
 
     def _register_with_dispatcher(self):
         fdno = self._fd.fileno()
@@ -242,18 +250,34 @@ class Socket:
                 get_global_dispatcher(fdno).resume_read(fdno)
 
     # -- write path --------------------------------------------------------
-    def write(self, buf: IOBuf, id_wait: Optional[int] = None) -> int:
+    def write(self, buf: IOBuf, id_wait: Optional[int] = None,
+              on_queued: Optional[Callable[[], None]] = None) -> int:
         """Queue a whole message; never interleaves with other writers
-        (socket.h:293-333 semantics)."""
-        if self._failed:
-            self._notify_failure(id_wait)
-            return errors.EFAILEDSOCKET
+        (socket.h:293-333 semantics). `on_queued` runs under the queue lock
+        at append time, so per-connection ordered state (pipelined
+        correlation entries, as PipelinedInfo is pushed inside
+        Socket::Write in the reference) matches the wire order exactly."""
         if id_wait is not None:
             with self._inflight_lock:
                 self._inflight_ids.add(id_wait)
         req = _WriteRequest(buf, id_wait)
         with self._write_lock:
+            # Re-check failure under the lock: a concurrent set_failed has
+            # either drained the queue already (we must not append after
+            # it) or will drain our request after we append.
+            if self._failed:
+                # Only notify if set_failed's in-flight sweep did not
+                # already error this cid (double-error would look like two
+                # failed attempts to the retry machinery).
+                with self._inflight_lock:
+                    was_present = id_wait in self._inflight_ids
+                    self._inflight_ids.discard(id_wait)
+                if was_present:
+                    self._notify_failure(id_wait)
+                return errors.EFAILEDSOCKET
             self._write_q.append(req)
+            if on_queued is not None:
+                on_queued()
             if self._writing:
                 return 0  # current writer will flush us
             self._writing = True
@@ -271,8 +295,15 @@ class Socket:
                     self._writing = False
                     return True
                 req = self._write_q[0]
+            fd = self._fd
+            if fd is None:
+                # Concurrently failed; set_failed drains the queue. Step
+                # down as writer so a revived socket can elect a new one.
+                with self._write_lock:
+                    self._writing = False
+                return True
             try:
-                n = req.buf.cut_into_socket(self._fd)
+                n = req.buf.cut_into_socket(fd)
             except (BlockingIOError, InterruptedError):
                 return False
             except OSError as e:
@@ -306,6 +337,7 @@ class Socket:
             if self._failed:
                 return False
             self._failed = True
+            self._conn_ready = False
         self.error_code = error_code
         self.error_text = error_text
         fd = self._fd
@@ -381,6 +413,8 @@ class Socket:
         self.read_portal = IOPortal()
         self.matched_protocol = None
         self._epollout = threading.Event()
+        self._writing = False
+        self._conn_ready = False
 
     def recycle(self):
         """Return to the pool — all outstanding SocketIds become stale."""

@@ -226,9 +226,14 @@ Fiber* Scheduler::current() {
 bool Scheduler::butex_wait(Butex* b, int32_t expected) {
   Worker* w = current_worker();
   if (w == nullptr || w->current == nullptr) {
-    // pthread waiter (reference: real futex path, butex.cpp:297): spin+sleep
+    // pthread waiter (reference: real futex path, butex.cpp:297): block on
+    // the butex's condvar; butex_wake notifies it. Recheck under the lock
+    // so a change-then-wake between the load and the wait is never missed.
+    std::unique_lock<std::mutex> g(b->mu);
     while (b->value.load(std::memory_order_acquire) == expected) {
-      std::this_thread::sleep_for(std::chrono::microseconds(50));
+      ++b->pthread_waiters;
+      b->pthread_cv.wait_for(g, std::chrono::milliseconds(100));
+      --b->pthread_waiters;
     }
     return true;
   }
@@ -260,6 +265,7 @@ int Scheduler::butex_wake(Butex* b, int n) {
       woken.push_back(b->waiters.front());
       b->waiters.pop_front();
     }
+    if (b->pthread_waiters > 0) b->pthread_cv.notify_all();
   }
   Scheduler* s = Scheduler::instance();
   for (Fiber* f : woken) s->ready_fiber(f);

@@ -33,6 +33,10 @@ struct Butex {
   std::atomic<int32_t> value{0};
   std::mutex mu;
   std::deque<Fiber*> waiters;
+  // pthread waiters (the real-futex path of butex.cpp:297) block here
+  // instead of spinning; butex_wake notifies when any are parked.
+  std::condition_variable pthread_cv;
+  int pthread_waiters = 0;
 };
 
 enum class FiberState : uint8_t { READY, RUNNING, BLOCKED, DONE };

@@ -291,3 +291,24 @@ def test_bthread_local_keys():
     bthread.bthread_join(t1, 5)
     bthread.bthread_join(t2, 5)
     assert results == {"a": "a", "b": "b"}
+
+
+def test_detached_tasks_are_reaped():
+    """Fire-and-forget tasks must not accumulate TaskMetas (the per-request
+    leak on the socket read path): after completion the registry shrinks
+    back, without requiring join()."""
+    import time
+
+    from brpc_tpu.bthread.task_control import get_task_control
+
+    tc = get_task_control()
+    done = []
+    before = len(tc._metas)
+    for _ in range(500):
+        tc.start_background(lambda: done.append(1))
+    deadline = time.monotonic() + 10
+    while len(done) < 500 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(done) == 500
+    time.sleep(0.1)
+    assert len(tc._metas) <= before + 4  # only still-running strangers remain

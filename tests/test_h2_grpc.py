@@ -155,3 +155,24 @@ def test_larger_payload(grpc_channel):
         echo_pb2.EchoResponse, timeout_ms=10000)
     assert not cntl.failed(), cntl.error_text
     assert resp.message == big
+
+
+def test_hpack_rejects_truncated_string():
+    """RFC 7541: a declared string length past the block end is a decode
+    error, not a silently-short header."""
+    from brpc_tpu.rpc.hpack import decode_str, encode_int
+
+    blob = encode_int(10, 7, 0x00) + b"abc"  # says 10 bytes, has 3
+    with pytest.raises(ValueError):
+        decode_str(blob, 0)
+
+
+def test_hpack_rejects_bad_huffman_padding():
+    """RFC 7541 5.2: trailing padding must be the all-ones EOS prefix."""
+    from brpc_tpu.rpc.hpack import huffman_decode, huffman_encode
+
+    good = huffman_encode(b"www.example.com")
+    assert huffman_decode(good) == b"www.example.com"
+    # 'a' = 5-bit code 00011 -> 3 zero padding bits: invalid
+    with pytest.raises(ValueError):
+        huffman_decode(bytes([0b00011_000]))

@@ -118,3 +118,40 @@ def test_custom_handler():
     svc.add_command_handler(
         "double", lambda args: RedisReply.integer(int(args[0]) * 2))
     assert svc.dispatch([b"double", b"21"]).value == 42
+
+
+def test_concurrent_pipelined_correlation(redis_server):
+    """Many threads sharing ONE connection: pipeline entries are pushed
+    under the socket write lock, so every reply matches its own RPC."""
+    import threading
+
+    from brpc_tpu import rpc
+    from brpc_tpu.rpc.redis import RedisRequest, RedisResponse
+
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="redis", timeout_ms=5000))
+    assert ch.init(str(redis_server.listen_endpoint)) == 0
+    errs = []
+
+    def worker(i):
+        for j in range(10):
+            req = RedisRequest()
+            req.add_command("SET", f"ck{i}", str(i))
+            req.add_command("GET", f"ck{i}")
+            resp = RedisResponse()
+            cntl = rpc.Controller()
+            cntl.timeout_ms = 5000
+            ch.call_method("redis", cntl, req, resp)
+            if cntl.failed():
+                errs.append(cntl.error_text)
+                return
+            got = resp.reply(1).value
+            if got != str(i).encode():
+                errs.append(f"thread {i} got {got!r}")
+                return
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
