@@ -19,9 +19,9 @@ def bench_echo():
         from brpc_tpu import native
 
         if native.available():
-            from brpc_tpu.bench import native_echo_bench
+            from brpc_tpu.bench import framework_echo_bench
 
-            return native_echo_bench()
+            return framework_echo_bench()
     except Exception:
         pass
     from brpc_tpu.bench import echo_bench  # implemented with the rpc layer

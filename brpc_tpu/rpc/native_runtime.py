@@ -1,0 +1,104 @@
+"""Native-runtime mount for the Python Server.
+
+The native RPC runtime (native/src/nat_rpc.cpp) owns the port: accept,
+epoll, fiber readers, tpu_std framing, and the Socket write queue all run
+in C++ on native IOBuf blocks. Requests whose method has no NATIVE handler
+are handed to this adapter's pthread pool — the usercode_backup_pool
+discipline (details/usercode_backup_pool.h:29-72): Python user code runs on
+Python threads, never on fiber stacks — and the full Python server path
+(`process_request`: auth, interceptor, MethodStatus, rpcz spans,
+compression) executes unchanged, writing its response back through the
+native socket via a shim.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from brpc_tpu import native
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc.proto import rpc_meta_pb2
+
+
+class NativeSocketShim:
+    """Quacks like rpc.Socket for the server-side response path: write()
+    re-enters the native runtime's write queue for this connection."""
+
+    def __init__(self, sock_id: int):
+        self.sock_id = sock_id
+        self.remote_side: Optional[EndPoint] = None
+        self.app_state = None
+        self._failed = False
+
+    def write(self, buf: IOBuf, id_wait=None) -> int:
+        data = buf.copy_to_bytes(len(buf))
+        return native.sock_write(self.sock_id, data)
+
+    def set_failed(self, error_code=0, error_text: str = ""):
+        self._failed = True
+        native.sock_set_failed(self.sock_id)
+
+    def failed(self) -> bool:
+        return self._failed
+
+    def fd(self):
+        return None
+
+
+class NativeRuntimeMount:
+    """Runs a Python Server's services on a native port."""
+
+    def __init__(self, server, num_threads: int = 0):
+        self.server = server
+        self.port = 0
+        self._threads = []
+        self._stopping = False
+        self._num_threads = num_threads or max(2, server.options.num_threads)
+
+    def start(self, ip: str = "127.0.0.1", port: int = 0,
+              native_echo: bool = False) -> int:
+        self.port = native.rpc_server_start(ip, port,
+                                            nworkers=0,
+                                            native_echo=native_echo)
+        for i in range(self._num_threads):
+            t = threading.Thread(target=self._worker,
+                                 name=f"native_py_lane_{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self.port
+
+    def stop(self):
+        self._stopping = True
+        native.rpc_server_stop()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # -- the py lane --------------------------------------------------------
+    def _worker(self):
+        from brpc_tpu.rpc.tpu_std_protocol import RpcMessage, process_request
+
+        while not self._stopping:
+            item = native.take_request(100)
+            if item is None:
+                continue
+            handle, meta_bytes, payload, attachment, sock_id = item
+            try:
+                meta = rpc_meta_pb2.RpcMeta()
+                meta.ParseFromString(meta_bytes)
+                att = IOBuf()
+                if attachment:
+                    att.append(attachment)
+                msg = RpcMessage(meta, payload, att)
+                msg.socket = NativeSocketShim(sock_id)
+                msg.arg = self.server
+                process_request(msg)
+            except Exception as e:  # answer rather than drop
+                try:
+                    native.respond(handle, 2001, f"py-lane dispatch: {e}")
+                    handle = None
+                except Exception:
+                    pass
+            finally:
+                if handle is not None:
+                    native.req_free(handle)

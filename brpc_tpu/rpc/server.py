@@ -54,6 +54,12 @@ class ServerOptions:
     # TLS (ServerSSLOptions role): PEM paths; empty = plaintext
     ssl_certfile: str = ""
     ssl_keyfile: str = ""
+    # Mount the port on the native C++ runtime (nat_rpc.cpp): accept/epoll/
+    # framing/writes run on fibers + native IOBuf; Python services execute
+    # on the py lane (usercode_backup_pool discipline). tpu_std only —
+    # other protocols and the HTTP console need a Python-port server — and
+    # at most ONE native-runtime server may be live per process.
+    use_native_runtime: bool = False
 
 
 class Server:
@@ -64,6 +70,7 @@ class Server:
         self._methods: Dict[Tuple[str, str], Tuple[Service, MethodInfo, MethodStatus]] = {}
         self._listen_fd: Optional[pysocket.socket] = None
         self._acceptor: Optional[Acceptor] = None
+        self._native_mount = None  # NativeRuntimeMount when use_native_runtime
         self._messenger: Optional[InputMessenger] = None
         self.listen_endpoint: Optional[EndPoint] = None
         self._started = False
@@ -159,6 +166,23 @@ class Server:
                 from brpc_tpu.builtin import register_builtin_services
 
                 register_builtin_services(self)
+            if self.options.use_native_runtime:
+                from brpc_tpu.rpc.native_runtime import NativeRuntimeMount
+
+                self._native_mount = NativeRuntimeMount(
+                    self, self.options.num_threads)
+                try:
+                    port = self._native_mount.start(ep.ip, ep.port)
+                except Exception:
+                    # bind conflict, toolchain missing, or a second native
+                    # server (the runtime mounts ONE per process)
+                    self._native_mount = None
+                    return -1
+                self.listen_endpoint = EndPoint(ep.ip, port)
+                self._started = True
+                self.start_time = time.time()
+                bvar.expose_default_variables()
+                return 0
             lfd = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
             lfd.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_REUSEADDR, 1)
             try:
@@ -194,6 +218,9 @@ class Server:
             if not self._started:
                 return -1
             self._started = False
+        if getattr(self, "_native_mount", None) is not None:
+            self._native_mount.stop()
+            self._native_mount = None
         if self._acceptor is not None:
             self._acceptor.stop_accept()
         self._stopped_event.set()

@@ -52,6 +52,19 @@ void IOBuf::append(const IOBuf& other) {
   }
 }
 
+void IOBuf::append(IOBuf&& other) {
+  if (refs_.empty()) {
+    refs_.swap(other.refs_);
+    length_ = other.length_;
+    other.length_ = 0;
+    return;
+  }
+  for (auto& r : other.refs_) refs_.push_back(r);  // refs transfer as-is
+  length_ += other.length_;
+  other.refs_.clear();
+  other.length_ = 0;
+}
+
 size_t IOBuf::cut_into(IOBuf* out, size_t n) {
   n = std::min(n, length_);
   size_t remain = n;

@@ -45,6 +45,20 @@ class IOBuf {
     }
     return *this;
   }
+  IOBuf(IOBuf&& other) noexcept
+      : refs_(std::move(other.refs_)), length_(other.length_) {
+    other.refs_.clear();
+    other.length_ = 0;
+  }
+  IOBuf& operator=(IOBuf&& other) noexcept {
+    if (this != &other) {
+      clear();
+      refs_.swap(other.refs_);
+      length_ = other.length_;
+      other.length_ = 0;
+    }
+    return *this;
+  }
 
   size_t length() const { return length_; }
   bool empty() const { return length_ == 0; }
@@ -58,6 +72,7 @@ class IOBuf {
   void append(const void* data, size_t n);
   void append(const std::string& s) { append(s.data(), s.size()); }
   void append(const IOBuf& other);  // zero-copy ref share
+  void append(IOBuf&& other);       // zero-copy ref splice (no ref churn)
 
   // move first n bytes of this into out (zero-copy)
   size_t cut_into(IOBuf* out, size_t n);

@@ -1,0 +1,1107 @@
+// Native RPC runtime — the framework data path in C++.
+//
+// This is the native counterpart of the brpc core runtime (SURVEY.md §2.4),
+// built FROM the other native components rather than beside them:
+//
+//   NatSocket      ⇔ brpc::Socket (socket.cpp): versioned-id registry, a
+//                    single-writer write queue with inline first attempt +
+//                    KeepWrite fiber on partial writes (the lock+deque
+//                    rendition of the wait-free design, socket.h:293-333),
+//                    SetFailed draining queued writes.
+//   Dispatcher     ⇔ EventDispatcher (event_dispatcher_epoll.cpp:249):
+//                    one epoll loop, edge-triggered; EPOLLIN spawns a
+//                    reader FIBER on the scheduler; EPOLLOUT wakes the
+//                    socket's KeepWrite butex.
+//   Messenger      ⇔ InputMessenger (input_messenger.cpp:331): reader
+//                    fiber drains the fd into the socket's native IOBuf,
+//                    cuts tpu_std frames, and processes them — requests
+//                    inline in the reader fiber (the process-in-place
+//                    discipline for non-blocking handlers; blocking user
+//                    code belongs on the Python lane, the
+//                    usercode_backup_pool analog), responses routed to the
+//                    owning channel's pending-call table.
+//   NatServer      ⇔ brpc::Server + Acceptor: native method registry
+//                    dispatched on fibers/IOBuf, plus a Python lane — a
+//                    condvar MPSC queue Python worker threads drain via
+//                    ctypes (nat_take_request/nat_respond), so arbitrary
+//                    Python services mount the native port while Python
+//                    user code runs on pthreads, never on fiber stacks.
+//   NatChannel     ⇔ brpc::Channel/Controller client half: correlation-id
+//                    pending table; synchronous calls park on a butex
+//                    (fiber) or its condvar path (pthread callers).
+//
+// Wire format: tpu_std ("TRPC" + body + meta_size + RpcMeta), identical to
+// brpc_tpu/rpc/tpu_std_protocol.py — Python channels interoperate with the
+// native port and vice versa.
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "iobuf.h"
+#include "rpc_meta.h"
+#include "scheduler.h"
+
+namespace brpc_tpu {
+
+// error codes shared with brpc_tpu/rpc/errors.py
+static const int kENOSERVICE = 1001;
+static const int kENOMETHOD = 1002;
+static const int kEFAILEDSOCKET = 1009;
+
+static const char kMagicRpc[4] = {'T', 'R', 'P', 'C'};
+
+static uint32_t rd_be32(const char* p) {
+  return ((uint32_t)(uint8_t)p[0] << 24) | ((uint32_t)(uint8_t)p[1] << 16) |
+         ((uint32_t)(uint8_t)p[2] << 8) | (uint32_t)(uint8_t)p[3];
+}
+static void wr_be32(char* p, uint32_t v) {
+  p[0] = (char)(v >> 24);
+  p[1] = (char)(v >> 16);
+  p[2] = (char)(v >> 8);
+  p[3] = (char)v;
+}
+
+class Dispatcher;
+class NatServer;
+class NatChannel;
+
+// ---------------------------------------------------------------------------
+// NatSocket + versioned-id registry (socket_inl.h:28-185 shape)
+// ---------------------------------------------------------------------------
+
+struct NatSocket {
+  int fd = -1;
+  uint64_t id = 0;
+  Dispatcher* disp = nullptr;
+  NatServer* server = nullptr;    // set on accepted connections
+  NatChannel* channel = nullptr;  // set on client connections
+
+  std::atomic<bool> failed{false};
+  std::atomic<int> ref{1};
+
+  // read side (one reader fiber at a time; ET re-entry via read_pending)
+  std::atomic<bool> reading{false};
+  std::atomic<bool> read_pending{false};
+  IOBuf in_buf;
+
+  // write side
+  std::mutex write_mu;
+  IOBuf write_q;        // queued-but-unwritten bytes (frames are appended
+                        // whole, so content never interleaves)
+  bool writing = false; // a writer (inline or KeepWrite fiber) is active
+  Butex epollout;       // bumped by the dispatcher on EPOLLOUT
+  uint32_t epoll_events = 0;  // currently-armed event mask
+  // Deferred-write mode (the fork's io_uring submission-batching
+  // discipline, ring_listener.h): write() only queues; a writer fiber
+  // scheduled behind the currently-ready fibers drains everything they
+  // appended in ONE writev. Throughput over per-call latency.
+  bool defer_writes = false;
+
+  void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
+  void release();
+  int write(IOBuf&& frame);
+  bool flush_some();  // true = drained/failed-and-drained, false = EAGAIN
+  void set_failed();
+  void arm_epollout();
+  void disarm_epollout();
+};
+
+struct SockSlot {
+  NatSocket* sock = nullptr;
+  uint32_t version = 0;
+};
+
+static std::mutex g_reg_mu;
+static std::vector<SockSlot> g_reg;
+static std::vector<uint32_t> g_reg_free;
+
+static uint64_t sock_register(NatSocket* s) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  uint32_t idx;
+  if (!g_reg_free.empty()) {
+    idx = g_reg_free.back();
+    g_reg_free.pop_back();
+  } else {
+    idx = (uint32_t)g_reg.size();
+    g_reg.push_back(SockSlot());
+  }
+  g_reg[idx].sock = s;
+  g_reg[idx].version++;
+  uint64_t id = ((uint64_t)g_reg[idx].version << 32) | idx;
+  s->id = id;
+  return id;
+}
+
+// Address with a borrowed reference (caller must release()); nullptr once
+// the id generation is stale — use-after-free-proof addressing.
+static NatSocket* sock_address(uint64_t id) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  uint32_t idx = (uint32_t)(id & 0xffffffffu);
+  uint32_t ver = (uint32_t)(id >> 32);
+  if (idx >= g_reg.size()) return nullptr;
+  SockSlot& slot = g_reg[idx];
+  if (slot.version != ver || slot.sock == nullptr) return nullptr;
+  slot.sock->add_ref();
+  return slot.sock;
+}
+
+static void sock_unregister(NatSocket* s) {
+  std::lock_guard<std::mutex> g(g_reg_mu);
+  uint32_t idx = (uint32_t)(s->id & 0xffffffffu);
+  if (idx < g_reg.size() && g_reg[idx].sock == s) {
+    g_reg[idx].sock = nullptr;
+    g_reg_free.push_back(idx);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Dispatcher — one epoll loop feeding the fiber scheduler
+// ---------------------------------------------------------------------------
+
+static void reader_fiber(void* arg);
+
+class Dispatcher {
+ public:
+  int epfd = -1;
+  int wake_fd = -1;  // eventfd to break epoll_wait on stop
+  std::thread thread;
+  std::atomic<bool> stop{false};
+  // listen sockets: fd -> server
+  std::mutex listen_mu;
+  std::unordered_map<int, NatServer*> listeners;
+
+  int start() {
+    epfd = epoll_create1(0);
+    if (epfd < 0) return -1;
+    wake_fd = eventfd(0, EFD_NONBLOCK);
+    struct epoll_event ev;
+    ev.events = EPOLLIN;
+    ev.data.u64 = (uint64_t)-1;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, wake_fd, &ev);
+    thread = std::thread([this] { run(); });
+    return 0;
+  }
+
+  void shutdown() {
+    stop = true;
+    uint64_t one = 1;
+    ssize_t rc = ::write(wake_fd, &one, 8);
+    (void)rc;
+    if (thread.joinable()) thread.join();
+    ::close(wake_fd);
+    ::close(epfd);
+  }
+
+  // Register a connection socket for edge-triggered reads. The socket id
+  // (not the pointer) rides in epoll data so stale events can't touch a
+  // recycled socket.
+  void add_consumer(NatSocket* s) {
+    struct epoll_event ev;
+    ev.events = EPOLLIN | EPOLLET;
+    ev.data.u64 = s->id;
+    s->epoll_events = ev.events;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, s->fd, &ev);
+  }
+
+  void add_listener(int fd, NatServer* srv) {
+    {
+      std::lock_guard<std::mutex> g(listen_mu);
+      listeners[fd] = srv;
+    }
+    struct epoll_event ev;
+    ev.events = EPOLLIN;
+    // Listener tags stay below 2^32; socket ids are version<<32|idx with
+    // version >= 1, so the two ranges can never collide.
+    ev.data.u64 = (uint64_t)fd;
+    epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  void run();
+  void accept_loop(int listen_fd, NatServer* srv);
+};
+
+// ---------------------------------------------------------------------------
+// NatServer
+// ---------------------------------------------------------------------------
+
+// Native handler: fills response payload/attachment (zero-copy IOBuf) or an
+// error. Runs inline in the reader fiber — must not block.
+struct NativeHandlerCtx {
+  IOBuf* req_payload = nullptr;
+  IOBuf* req_attachment = nullptr;
+  IOBuf resp_payload;
+  IOBuf resp_attachment;
+  int32_t error_code = 0;
+  std::string error_text;
+};
+using NativeHandler = std::function<void(NativeHandlerCtx&)>;
+
+// A request handed to the Python lane (usercode_backup_pool discipline:
+// Python user code runs on pthreads, not fiber stacks).
+struct PyRequest {
+  uint64_t sock_id = 0;
+  int64_t cid = 0;
+  int32_t compress_type = 0;
+  std::string service;
+  std::string method;
+  std::string payload;
+  std::string attachment;
+  std::string meta_bytes;  // full RpcMeta wire bytes: Python re-parses for
+                           // log/trace ids, auth_data, timeout, tensors…
+};
+
+class NatServer {
+ public:
+  int listen_fd = -1;
+  int port = 0;
+  Dispatcher* disp = nullptr;
+  std::atomic<uint64_t> requests{0};
+  std::atomic<uint64_t> connections{0};
+
+  std::unordered_map<std::string, NativeHandler> handlers;  // frozen at start
+  bool py_lane_enabled = false;
+
+  // Python lane MPSC queue
+  std::mutex py_mu;
+  std::condition_variable py_cv;
+  std::deque<PyRequest*> py_q;
+  bool py_stopping = false;
+
+  void enqueue_py(PyRequest* r) {
+    {
+      std::lock_guard<std::mutex> g(py_mu);
+      py_q.push_back(r);
+    }
+    py_cv.notify_one();
+  }
+
+  PyRequest* take_py(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(py_mu);
+    if (py_q.empty() && !py_stopping) {
+      py_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+    }
+    if (py_q.empty()) return nullptr;
+    PyRequest* r = py_q.front();
+    py_q.pop_front();
+    return r;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// NatChannel (client half)
+// ---------------------------------------------------------------------------
+
+struct PendingCall {
+  Butex done;  // 0 = in flight, 1 = complete
+  int32_t error_code = 0;
+  std::string error_text;
+  IOBuf response;
+  IOBuf attachment;
+};
+
+class NatChannel {
+ public:
+  uint64_t sock_id = 0;
+  std::mutex mu;
+  std::unordered_map<int64_t, PendingCall*> pending;
+  std::atomic<int64_t> next_cid{1};
+  // Lifetime: the owning socket holds one reference (released in
+  // ~NatSocket) and the opener holds one (released in nat_channel_close),
+  // so a reader fiber mid-process_input can never see a freed channel.
+  std::atomic<int> ref{1};
+
+  void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
+  void release() {
+    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+  }
+
+  PendingCall* begin_call(int64_t* cid_out) {
+    PendingCall* pc = new PendingCall();
+    int64_t cid = next_cid.fetch_add(1, std::memory_order_relaxed);
+    {
+      std::lock_guard<std::mutex> g(mu);
+      pending[cid] = pc;
+    }
+    *cid_out = cid;
+    return pc;
+  }
+
+  PendingCall* take_pending(int64_t cid) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = pending.find(cid);
+    if (it == pending.end()) return nullptr;
+    PendingCall* pc = it->second;
+    pending.erase(it);
+    return pc;
+  }
+
+  void fail_all(int32_t code, const char* text) {
+    std::vector<PendingCall*> all;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (auto& kv : pending) all.push_back(kv.second);
+      pending.clear();
+    }
+    for (PendingCall* pc : all) {
+      pc->error_code = code;
+      pc->error_text = text;
+      pc->done.value.store(1, std::memory_order_release);
+      Scheduler::butex_wake(&pc->done, INT32_MAX);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// NatSocket implementation
+// ---------------------------------------------------------------------------
+
+void NatSocket::release() {
+  if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    // Deferred close (brpc defers to refcount-zero too, socket.cpp): the
+    // fd number is only recycled once no fiber can still syscall on it,
+    // so a stale writev can never land on a reused descriptor.
+    if (fd >= 0) ::close(fd);
+    if (channel != nullptr) channel->release();
+    delete this;
+  }
+}
+
+void NatSocket::set_failed() {
+  bool was = failed.exchange(true);
+  if (was) return;
+  {
+    std::lock_guard<std::mutex> g(write_mu);
+    write_q.clear();
+    writing = false;
+  }
+  if (fd >= 0) {
+    epoll_ctl(disp->epfd, EPOLL_CTL_DEL, fd, nullptr);
+    // shutdown (not close): in-flight reader/KeepWrite syscalls return
+    // with EOF/EPIPE instead of racing a recycled fd number.
+    ::shutdown(fd, SHUT_RDWR);
+  }
+  // wake any KeepWrite parked on EPOLLOUT
+  epollout.value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(&epollout, INT32_MAX);
+  if (channel != nullptr) channel->fail_all(kEFAILEDSOCKET, "socket failed");
+  if (server != nullptr) server->connections.fetch_sub(1);
+  sock_unregister(this);
+  release();  // drop the registry's reference
+}
+
+void NatSocket::arm_epollout() {
+  std::lock_guard<std::mutex> g(write_mu);
+  if (failed.load(std::memory_order_acquire)) return;
+  uint32_t want = EPOLLIN | EPOLLET | EPOLLOUT;
+  if (epoll_events == want) return;
+  struct epoll_event ev;
+  ev.events = want;
+  ev.data.u64 = id;
+  if (epoll_ctl(disp->epfd, EPOLL_CTL_MOD, fd, &ev) == 0) epoll_events = want;
+}
+
+void NatSocket::disarm_epollout() {
+  std::lock_guard<std::mutex> g(write_mu);
+  if (failed.load(std::memory_order_acquire)) return;
+  uint32_t want = EPOLLIN | EPOLLET;
+  if (epoll_events == want) return;
+  struct epoll_event ev;
+  ev.events = want;
+  ev.data.u64 = id;
+  if (epoll_ctl(disp->epfd, EPOLL_CTL_MOD, fd, &ev) == 0) epoll_events = want;
+}
+
+bool NatSocket::flush_some() {
+  while (true) {
+    IOBuf batch;
+    {
+      std::lock_guard<std::mutex> g(write_mu);
+      if (write_q.empty()) {
+        writing = false;
+        return true;
+      }
+      batch.append(std::move(write_q));  // take the whole queue: syscall
+                                         // batching across responses
+    }
+    while (!batch.empty()) {
+      ssize_t n = batch.cut_into_fd(fd);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+          // put leftovers back at the FRONT (later writes are behind us)
+          std::lock_guard<std::mutex> g(write_mu);
+          batch.append(std::move(write_q));
+          write_q = std::move(batch);
+          return false;
+        }
+        set_failed();
+        return true;
+      }
+    }
+  }
+}
+
+static void keep_write_fiber(void* arg) {
+  NatSocket* s = (NatSocket*)arg;
+  while (!s->failed.load(std::memory_order_acquire)) {
+    if (s->flush_some()) break;  // common case: drained, no epoll_ctl
+    int32_t expected = s->epollout.value.load(std::memory_order_acquire);
+    s->arm_epollout();
+    // second attempt covers a became-writable-before-arm race
+    if (s->flush_some()) break;
+    Scheduler::butex_wait(&s->epollout, expected);
+  }
+  s->disarm_epollout();
+  s->release();
+}
+
+int NatSocket::write(IOBuf&& frame) {
+  if (failed.load(std::memory_order_acquire)) return -1;
+  bool become_writer = false;
+  {
+    std::lock_guard<std::mutex> g(write_mu);
+    if (failed.load(std::memory_order_acquire)) return -1;
+    write_q.append(std::move(frame));
+    if (!writing) {
+      writing = true;
+      become_writer = true;
+    }
+  }
+  if (!become_writer) return 0;  // active writer will drain us
+  if (defer_writes) {
+    // Batch mode: the writer fiber runs AFTER the currently-ready fibers,
+    // so their appends coalesce into one writev.
+    add_ref();
+    Scheduler::instance()->spawn_detached_back(keep_write_fiber, this);
+    return 0;
+  }
+  // Inline first attempt on the caller's thread/fiber (socket.cpp:1287);
+  // leftovers go to a KeepWrite fiber waiting on EPOLLOUT.
+  if (!flush_some()) {
+    add_ref();
+    Scheduler::instance()->spawn_detached(keep_write_fiber, this);
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Messenger — tpu_std cut loop + dispatch (InputMessenger role)
+// ---------------------------------------------------------------------------
+
+static void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
+                                 const std::string& error_text,
+                                 IOBuf&& payload, IOBuf&& attachment) {
+  RpcMetaN meta;
+  meta.has_response = true;
+  meta.response.error_code = error_code;
+  meta.response.error_text = error_text;
+  meta.correlation_id = cid;
+  meta.attachment_size = (int64_t)attachment.length();
+  std::string mb = encode_response_meta(meta);
+  char header[12];
+  memcpy(header, kMagicRpc, 4);
+  wr_be32(header + 4,
+          (uint32_t)(mb.size() + payload.length() + attachment.length()));
+  wr_be32(header + 8, (uint32_t)mb.size());
+  out->append(header, 12);
+  out->append(mb);
+  out->append(std::move(payload));
+  out->append(std::move(attachment));
+}
+
+static void build_request_frame(IOBuf* out, int64_t cid,
+                                const std::string& service,
+                                const std::string& method,
+                                const char* payload, size_t payload_len,
+                                const char* att, size_t att_len) {
+  RpcMetaN meta;
+  meta.has_request = true;
+  meta.request.service_name = service;
+  meta.request.method_name = method;
+  meta.correlation_id = cid;
+  meta.attachment_size = (int64_t)att_len;
+  std::string mb = encode_request_meta(meta);
+  char header[12];
+  memcpy(header, kMagicRpc, 4);
+  wr_be32(header + 4, (uint32_t)(mb.size() + payload_len + att_len));
+  wr_be32(header + 8, (uint32_t)mb.size());
+  out->append(header, 12);
+  out->append(mb);
+  if (payload_len) out->append(payload, payload_len);
+  if (att_len) out->append(att, att_len);
+}
+
+// Cut + process every complete frame in s->in_buf. Server requests run
+// inline (responses batched into ONE socket write per read burst); client
+// responses complete pending calls.
+static bool process_input(NatSocket* s) {
+  IOBuf batch_out;
+  bool ok = true;
+  while (true) {
+    if (s->in_buf.length() < 12) break;
+    char header[12];
+    s->in_buf.copy_to(header, 12);
+    if (memcmp(header, kMagicRpc, 4) != 0) {
+      ok = false;  // protocol error: native port speaks tpu_std only
+      break;
+    }
+    uint32_t body = rd_be32(header + 4);
+    uint32_t meta_size = rd_be32(header + 8);
+    if (meta_size > body || body > (512u << 20)) {
+      ok = false;
+      break;
+    }
+    if (s->in_buf.length() < 12 + (size_t)body) break;
+    s->in_buf.pop_front(12);
+    std::string meta_bytes;
+    meta_bytes.resize(meta_size);
+    s->in_buf.copy_to(&meta_bytes[0], meta_size);
+    s->in_buf.pop_front(meta_size);
+    RpcMetaN meta;
+    if (!decode_meta(meta_bytes.data(), meta_bytes.size(), &meta)) {
+      ok = false;
+      break;
+    }
+    size_t att_size = (size_t)meta.attachment_size;
+    if (att_size > body - meta_size) {
+      ok = false;
+      break;
+    }
+    size_t payload_size = body - meta_size - att_size;
+    IOBuf payload, attachment;
+    s->in_buf.cut_into(&payload, payload_size);
+    s->in_buf.cut_into(&attachment, att_size);
+
+    if (meta.has_request && s->server != nullptr) {
+      NatServer* srv = s->server;
+      srv->requests.fetch_add(1, std::memory_order_relaxed);
+      std::string key = meta.request.service_name;
+      key += '.';
+      key += meta.request.method_name;
+      auto it = srv->handlers.find(key);
+      if (it != srv->handlers.end()) {
+        NativeHandlerCtx ctx;
+        ctx.req_payload = &payload;
+        ctx.req_attachment = &attachment;
+        it->second(ctx);
+        build_response_frame(&batch_out, meta.correlation_id, ctx.error_code,
+                             ctx.error_text, std::move(ctx.resp_payload),
+                             std::move(ctx.resp_attachment));
+      } else if (srv->py_lane_enabled) {
+        PyRequest* r = new PyRequest();
+        r->sock_id = s->id;
+        r->cid = meta.correlation_id;
+        r->compress_type = meta.compress_type;
+        r->service = meta.request.service_name;
+        r->method = meta.request.method_name;
+        r->payload = payload.to_string();
+        r->attachment = attachment.to_string();
+        r->meta_bytes = meta_bytes;
+        srv->enqueue_py(r);
+      } else {
+        build_response_frame(&batch_out, meta.correlation_id, kENOSERVICE,
+                             "no such service/method on native port",
+                             IOBuf(), IOBuf());
+      }
+    } else if (s->channel != nullptr) {
+      PendingCall* pc = s->channel->take_pending(meta.correlation_id);
+      if (pc != nullptr) {
+        pc->error_code = meta.has_response ? meta.response.error_code : 0;
+        pc->error_text = meta.has_response ? meta.response.error_text : "";
+        pc->response = std::move(payload);
+        pc->attachment = std::move(attachment);
+        pc->done.value.store(1, std::memory_order_release);
+        Scheduler::butex_wake(&pc->done, INT32_MAX);
+      }
+    }
+  }
+  if (!batch_out.empty()) s->write(std::move(batch_out));
+  return ok;
+}
+
+static void reader_fiber(void* arg) {
+  NatSocket* s = (NatSocket*)arg;
+  while (true) {
+    bool closed = false;
+    while (!s->failed.load(std::memory_order_acquire)) {
+      ssize_t n = s->in_buf.append_from_fd(s->fd, IOBlock::kSize);
+      if (n > 0) {
+        if (!process_input(s)) {
+          closed = true;
+          break;
+        }
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      if (n < 0 && errno == EINTR) continue;
+      closed = true;  // EOF or hard error
+      break;
+    }
+    if (closed || s->failed.load(std::memory_order_acquire)) {
+      s->set_failed();
+      break;
+    }
+    // ET re-entry check: clear reading, then re-take if an event landed
+    // while we were draining (the StartInputEvent re-arm discipline).
+    s->reading.store(false, std::memory_order_release);
+    if (!s->read_pending.exchange(false)) break;
+    if (s->reading.exchange(true)) break;  // another reader took over
+  }
+  s->release();
+}
+
+void Dispatcher::accept_loop(int lfd, NatServer* srv) {
+  while (true) {
+    int cfd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+    if (cfd < 0) break;
+    int one = 1;
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    NatSocket* s = new NatSocket();
+    s->fd = cfd;
+    s->disp = this;
+    s->server = srv;
+    srv->connections.fetch_add(1);
+    sock_register(s);  // the registry holds the initial reference
+    add_consumer(s);
+  }
+}
+
+void Dispatcher::run() {
+  std::vector<struct epoll_event> events(256);
+  while (!stop.load(std::memory_order_acquire)) {
+    int n = epoll_wait(epfd, events.data(), (int)events.size(), 100);
+    for (int i = 0; i < n; i++) {
+      uint64_t data = events[i].data.u64;
+      if (data == (uint64_t)-1) {  // wake eventfd
+        uint64_t drain;
+        ssize_t rc = ::read(wake_fd, &drain, 8);
+        (void)rc;
+        continue;
+      }
+      if (data < (1ull << 32)) {  // listener (socket ids are >= 2^32)
+        int lfd = (int)data;
+        NatServer* srv;
+        {
+          std::lock_guard<std::mutex> g(listen_mu);
+          auto it = listeners.find(lfd);
+          srv = (it == listeners.end()) ? nullptr : it->second;
+        }
+        if (srv != nullptr) accept_loop(lfd, srv);
+        continue;
+      }
+      NatSocket* s = sock_address(data);
+      if (s == nullptr) continue;
+      if (events[i].events & EPOLLOUT) {
+        s->epollout.value.fetch_add(1, std::memory_order_release);
+        Scheduler::butex_wake(&s->epollout, INT32_MAX);
+      }
+      if (events[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) {
+        if (!s->reading.exchange(true)) {
+          s->add_ref();
+          Scheduler::instance()->spawn_detached(reader_fiber, s);
+        } else {
+          s->read_pending.store(true, std::memory_order_release);
+        }
+      }
+      s->release();
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Server / channel lifecycle + C API
+// ---------------------------------------------------------------------------
+
+static Dispatcher* g_disp = nullptr;
+static NatServer* g_rpc_server = nullptr;
+static std::mutex g_rt_mu;
+
+static int ensure_runtime(int nworkers) {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  if (!Scheduler::instance()->started()) {
+    if (nworkers <= 0) {
+      unsigned hw = std::thread::hardware_concurrency();
+      nworkers = hw > 1 ? (int)hw : 1;
+    }
+    Scheduler::instance()->start(nworkers);
+  }
+  if (g_disp == nullptr) {
+    g_disp = new Dispatcher();
+    if (g_disp->start() != 0) {
+      delete g_disp;
+      g_disp = nullptr;
+      return -1;
+    }
+  }
+  return 0;
+}
+
+extern "C" {
+
+// Start the native RPC server. enable_native_echo registers the built-in
+// EchoService.Echo handler (zero-copy: response payload/attachment share
+// the request's IOBuf blocks). Python services ride the py lane.
+int nat_rpc_server_start(const char* ip, int port, int nworkers,
+                         int enable_native_echo) {
+  if (g_rpc_server != nullptr) return -1;
+  if (ensure_runtime(nworkers) != 0) return -1;
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, ip, &addr.sin_addr);
+  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 1024) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (struct sockaddr*)&addr, &alen);
+
+  NatServer* srv = new NatServer();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  srv->disp = g_disp;
+  srv->py_lane_enabled = true;
+  if (enable_native_echo) {
+    srv->handlers["EchoService.Echo"] = [](NativeHandlerCtx& ctx) {
+      // echo: hand the request blocks straight back (no copy)
+      ctx.resp_payload.append(std::move(*ctx.req_payload));
+      ctx.resp_attachment.append(std::move(*ctx.req_attachment));
+    };
+  }
+  g_rpc_server = srv;
+  g_disp->add_listener(fd, srv);
+  return srv->port;
+}
+
+// Stopped servers are parked in a graveyard rather than deleted: py-lane
+// taker threads blocked on py_cv, reader fibers holding s->server, and a
+// racing accept may still dereference the object after stop. The leak is
+// one small object per server start — bounded and safe (brpc Servers are
+// likewise process-lifetime objects).
+static std::vector<NatServer*> g_server_graveyard;
+
+void nat_rpc_server_stop() {
+  NatServer* srv = g_rpc_server;
+  if (srv == nullptr) return;
+  g_rpc_server = nullptr;
+  // remove the listener before failing sockets so no new conns register
+  epoll_ctl(g_disp->epfd, EPOLL_CTL_DEL, srv->listen_fd, nullptr);
+  {
+    std::lock_guard<std::mutex> g(g_disp->listen_mu);
+    g_disp->listeners.erase(srv->listen_fd);
+  }
+  ::close(srv->listen_fd);
+  // stop the python lane (wakes all waiters empty-handed)
+  {
+    std::lock_guard<std::mutex> g(srv->py_mu);
+    srv->py_stopping = true;
+  }
+  srv->py_cv.notify_all();
+  // fail remaining server-side connections
+  std::vector<uint64_t> ids;
+  {
+    std::lock_guard<std::mutex> g(g_reg_mu);
+    for (auto& slot : g_reg) {
+      if (slot.sock != nullptr && slot.sock->server == srv) {
+        ids.push_back(slot.sock->id);
+      }
+    }
+  }
+  for (uint64_t id : ids) {
+    NatSocket* s = sock_address(id);
+    if (s != nullptr) {
+      s->set_failed();
+      s->release();
+    }
+  }
+  // drain queued python-lane requests under the lane lock
+  {
+    std::lock_guard<std::mutex> g(srv->py_mu);
+    for (PyRequest* r : srv->py_q) delete r;
+    srv->py_q.clear();
+  }
+  {
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    g_server_graveyard.push_back(srv);
+  }
+}
+
+uint64_t nat_rpc_server_requests() {
+  return g_rpc_server ? g_rpc_server->requests.load() : 0;
+}
+
+uint64_t nat_rpc_server_connections() {
+  return g_rpc_server ? g_rpc_server->connections.load() : 0;
+}
+
+// ---- Python lane (usercode on pthreads) ----
+
+void* nat_take_request(int timeout_ms) {
+  NatServer* srv = g_rpc_server;
+  if (srv == nullptr) return nullptr;
+  return srv->take_py(timeout_ms);
+}
+
+const char* nat_req_field(void* h, int which, size_t* len) {
+  PyRequest* r = (PyRequest*)h;
+  const std::string* s = nullptr;
+  switch (which) {
+    case 0: s = &r->service; break;
+    case 1: s = &r->method; break;
+    case 2: s = &r->payload; break;
+    case 3: s = &r->attachment; break;
+    case 4: s = &r->meta_bytes; break;
+    default: *len = 0; return nullptr;
+  }
+  *len = s->size();
+  return s->data();
+}
+
+int64_t nat_req_cid(void* h) { return ((PyRequest*)h)->cid; }
+int32_t nat_req_compress(void* h) { return ((PyRequest*)h)->compress_type; }
+uint64_t nat_req_sock_id(void* h) { return ((PyRequest*)h)->sock_id; }
+void nat_req_free(void* h) { delete (PyRequest*)h; }
+
+// Raw write of pre-framed bytes onto a live connection — lets the Python
+// protocol layer (send_rpc_response with its full feature set) answer
+// py-lane requests through the native Socket write queue.
+int nat_sock_write(uint64_t sock_id, const char* data, size_t len) {
+  NatSocket* s = sock_address(sock_id);
+  if (s == nullptr) return -1;
+  IOBuf out;
+  out.append(data, len);
+  int rc = s->write(std::move(out));
+  s->release();
+  return rc;
+}
+
+int nat_sock_set_failed(uint64_t sock_id) {
+  NatSocket* s = sock_address(sock_id);
+  if (s == nullptr) return -1;
+  s->set_failed();
+  s->release();
+  return 0;
+}
+
+// Respond to a py-lane request and free it. Returns 0, or -1 if the
+// connection is gone.
+int nat_respond(void* h, int32_t error_code, const char* error_text,
+                const char* payload, size_t payload_len, const char* att,
+                size_t att_len) {
+  PyRequest* r = (PyRequest*)h;
+  NatSocket* s = sock_address(r->sock_id);
+  int rc = -1;
+  if (s != nullptr) {
+    IOBuf out, pay, attach;
+    if (payload_len) pay.append(payload, payload_len);
+    if (att_len) attach.append(att, att_len);
+    build_response_frame(&out, r->cid, error_code,
+                         error_text ? error_text : "", std::move(pay),
+                         std::move(attach));
+    rc = s->write(std::move(out));
+    s->release();
+  }
+  delete r;
+  return rc;
+}
+
+// ---- client channel ----
+
+void* nat_channel_open(const char* ip, int port, int nworkers,
+                       int batch_writes) {
+  if (ensure_runtime(nworkers) != 0) return nullptr;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, ip, &addr.sin_addr);
+  if (connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+
+  NatChannel* ch = new NatChannel();
+  NatSocket* s = new NatSocket();
+  s->fd = fd;
+  s->disp = g_disp;
+  s->channel = ch;
+  ch->add_ref();  // the socket's reference, dropped in NatSocket::release
+  s->defer_writes = (batch_writes != 0);
+  sock_register(s);
+  ch->sock_id = s->id;
+  g_disp->add_consumer(s);
+  return ch;
+}
+
+void nat_channel_close(void* h) {
+  NatChannel* ch = (NatChannel*)h;
+  NatSocket* s = sock_address(ch->sock_id);
+  if (s != nullptr) {
+    s->set_failed();  // fails pending calls via channel->fail_all
+    s->release();
+  }
+  ch->fail_all(kEFAILEDSOCKET, "channel closed");
+  ch->release();  // opener's reference; the socket may still hold one
+}
+
+// Synchronous call. Returns 0 on success (out buffers malloc'd, caller
+// frees with nat_buf_free), else an error code.
+int nat_channel_call(void* h, const char* service, const char* method,
+                     const char* payload, size_t payload_len, char** resp_out,
+                     size_t* resp_len, char** err_text_out) {
+  NatChannel* ch = (NatChannel*)h;
+  NatSocket* s = sock_address(ch->sock_id);
+  if (s == nullptr) return kEFAILEDSOCKET;
+  int64_t cid = 0;
+  PendingCall* pc = ch->begin_call(&cid);
+  IOBuf frame;
+  build_request_frame(&frame, cid, service, method, payload, payload_len,
+                      nullptr, 0);
+  if (s->write(std::move(frame)) != 0) {
+    s->release();
+    PendingCall* mine = ch->take_pending(cid);
+    if (mine != nullptr) delete mine;
+    return kEFAILEDSOCKET;
+  }
+  s->release();
+  while (pc->done.value.load(std::memory_order_acquire) == 0) {
+    Scheduler::butex_wait(&pc->done, 0);
+  }
+  int rc = pc->error_code;
+  if (rc == 0 && resp_out != nullptr) {
+    *resp_len = pc->response.length();
+    *resp_out = (char*)malloc(*resp_len ? *resp_len : 1);
+    pc->response.copy_to(*resp_out, *resp_len);
+  } else if (resp_out != nullptr) {
+    *resp_out = nullptr;
+    *resp_len = 0;
+  }
+  if (err_text_out != nullptr) {
+    if (rc != 0 && !pc->error_text.empty()) {
+      *err_text_out = (char*)malloc(pc->error_text.size() + 1);
+      memcpy(*err_text_out, pc->error_text.c_str(),
+             pc->error_text.size() + 1);
+    } else {
+      *err_text_out = nullptr;
+    }
+  }
+  delete pc;
+  return rc;
+}
+
+void nat_buf_free(char* p) { free(p); }
+
+// ---- framework-path benchmark ----
+// F fibers per channel issue synchronous EchoService.Echo calls through the
+// FULL native stack (Channel pending table -> Socket write queue ->
+// dispatcher -> reader fibers -> server dispatch -> response completion).
+// This is the multi_threaded_echo shape on fibers; the shared connection's
+// write queue gives natural syscall batching.
+
+struct BenchFiberArg {
+  NatChannel* ch;
+  std::atomic<bool>* stop;
+  std::atomic<uint64_t>* total;
+  const std::string* payload;
+  Butex* done_count;  // incremented as each fiber exits
+};
+
+static void bench_call_fiber(void* a) {
+  BenchFiberArg* arg = (BenchFiberArg*)a;
+  NatChannel* ch = arg->ch;
+  while (!arg->stop->load(std::memory_order_relaxed)) {
+    NatSocket* s = sock_address(ch->sock_id);
+    if (s == nullptr) break;
+    int64_t cid = 0;
+    PendingCall* pc = ch->begin_call(&cid);
+    IOBuf frame;
+    build_request_frame(&frame, cid, "EchoService", "Echo",
+                        arg->payload->data(), arg->payload->size(), nullptr,
+                        0);
+    int wrc = s->write(std::move(frame));
+    s->release();
+    if (wrc != 0) {
+      PendingCall* mine = ch->take_pending(cid);
+      if (mine != nullptr) delete mine;
+      break;
+    }
+    while (pc->done.value.load(std::memory_order_acquire) == 0) {
+      Scheduler::butex_wait(&pc->done, 0);
+    }
+    bool ok = (pc->error_code == 0);
+    delete pc;
+    if (!ok) break;
+    arg->total->fetch_add(1, std::memory_order_relaxed);
+  }
+  arg->done_count->value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(arg->done_count, 1);
+  delete arg;
+}
+
+double nat_rpc_client_bench(const char* ip, int port, int nconn,
+                            int fibers_per_conn, double seconds,
+                            int payload_size, uint64_t* out_requests) {
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> total{0};
+  std::string payload((size_t)payload_size, 'x');
+  Butex done_count;
+  std::vector<NatChannel*> channels;
+  int nfibers = 0;
+  for (int c = 0; c < nconn; c++) {
+    NatChannel* ch = (NatChannel*)nat_channel_open(ip, port, 0, 1);
+    if (ch == nullptr) continue;
+    channels.push_back(ch);
+    for (int f = 0; f < fibers_per_conn; f++) {
+      BenchFiberArg* arg = new BenchFiberArg{
+          ch, &stop, &total, &payload, &done_count};
+      Scheduler::instance()->spawn_detached(bench_call_fiber, arg);
+      nfibers++;
+    }
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((int64_t)(seconds * 1000)));
+  stop.store(true);
+  while (done_count.value.load(std::memory_order_acquire) < nfibers) {
+    Scheduler::butex_wait(&done_count,
+                          done_count.value.load(std::memory_order_acquire));
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(t1 - t0).count();
+  for (NatChannel* ch : channels) nat_channel_close(ch);
+  if (out_requests) *out_requests = total.load();
+  return dt > 0 ? (double)total.load() / dt : 0.0;
+}
+
+}  // extern "C"
+
+}  // namespace brpc_tpu

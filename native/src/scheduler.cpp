@@ -11,6 +11,46 @@
 
 namespace brpc_tpu {
 
+#if BRPC_TPU_FCTX
+// Register-only context switch, SysV x86-64: saves the callee-saved set on
+// the current stack, publishes the stack pointer, and resumes the target.
+// No signal-mask save/restore (the two rt_sigprocmask syscalls that make
+// swapcontext ~10x slower) — same tradeoff as bthread's fcontext asm.
+extern "C" void fctx_swap(void** save_sp, void* to_sp);
+asm(".text\n"
+    ".globl fctx_swap\n"
+    ".type fctx_swap,@function\n"
+    "fctx_swap:\n"
+    "  pushq %rbp\n"
+    "  pushq %rbx\n"
+    "  pushq %r12\n"
+    "  pushq %r13\n"
+    "  pushq %r14\n"
+    "  pushq %r15\n"
+    "  movq %rsp, (%rdi)\n"
+    "  movq %rsi, %rsp\n"
+    "  popq %r15\n"
+    "  popq %r14\n"
+    "  popq %r13\n"
+    "  popq %r12\n"
+    "  popq %rbx\n"
+    "  popq %rbp\n"
+    "  ret\n"
+    ".size fctx_swap,.-fctx_swap\n");
+
+// Build the initial context: a stack image that fctx_swap's epilogue pops
+// and whose `ret` lands in `entry` with ABI-correct alignment
+// (rsp % 16 == 8 at function entry).
+static void* fctx_make(char* stack_top, void (*entry)()) {
+  uint64_t* sp16 = (uint64_t*)((uintptr_t)stack_top & ~(uintptr_t)0xF);
+  uint64_t* p = sp16;
+  *--p = 0;                  // keeps the ret slot 16-aligned
+  *--p = (uint64_t)entry;    // ret target
+  for (int i = 0; i < 6; i++) *--p = 0;  // r15 r14 r13 r12 rbx rbp
+  return p;
+}
+#endif
+
 static thread_local Worker* tls_worker = nullptr;
 
 // Fiber bodies migrate threads across swapcontext, but -O2 CSEs the TLS
@@ -25,7 +65,21 @@ __attribute__((noinline)) static Worker* current_worker() {
 
 static const size_t kStackSize = 256 * 1024;
 
+// Pooled stacks (StackPool role, stack_inl.h:36-105): per-request fibers
+// must not pay an mmap/munmap round trip each spawn.
+static std::mutex g_stack_pool_mu;
+static std::vector<char*> g_stack_pool;
+static const size_t kStackPoolCap = 256;
+
 static char* alloc_stack(size_t size) {
+  {
+    std::lock_guard<std::mutex> g(g_stack_pool_mu);
+    if (!g_stack_pool.empty()) {
+      char* s = g_stack_pool.back();
+      g_stack_pool.pop_back();
+      return s;
+    }
+  }
   void* mem = mmap(nullptr, size + 4096, PROT_READ | PROT_WRITE,
                    MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
   if (mem == MAP_FAILED) return nullptr;
@@ -34,15 +88,27 @@ static char* alloc_stack(size_t size) {
 }
 
 static void free_stack(char* stack, size_t size) {
+  {
+    std::lock_guard<std::mutex> g(g_stack_pool_mu);
+    if (g_stack_pool.size() < kStackPoolCap) {
+      g_stack_pool.push_back(stack);
+      return;
+    }
+  }
   munmap(stack - 4096, size + 4096);
 }
 
 void Worker::signal() {
-  park_signal.fetch_add(1, std::memory_order_release);
-  {
-    std::lock_guard<std::mutex> g(park_mu);
+  // seq_cst store-then-load pairs with the waiter's parked-then-recheck
+  // (Dekker): either we see parked > 0 and notify, or the waiter's
+  // park_signal recheck sees our bump and skips the sleep.
+  park_signal.fetch_add(1);
+  if (parked.load() > 0) {
+    {
+      std::lock_guard<std::mutex> g(park_mu);
+    }
+    park_cv.notify_one();
   }
-  park_cv.notify_one();
 }
 
 Scheduler* Scheduler::instance() {
@@ -78,7 +144,36 @@ void Scheduler::stop() {
   started_ = false;
 }
 
+
+// Switch the running fiber out to this worker's main loop / resume a fiber.
+static inline void switch_out_to_main(Worker* w, Fiber* f) {
+#if BRPC_TPU_FCTX
+  fctx_swap(&f->sp, w->main_sp);
+#else
+  swapcontext(&f->ctx, &w->main_ctx);
+#endif
+}
+static inline void switch_into_fiber(Worker* w, Fiber* f) {
+#if BRPC_TPU_FCTX
+  fctx_swap(&w->main_sp, f->sp);
+#else
+  swapcontext(&w->main_ctx, &f->ctx);
+#endif
+}
+
 static void fiber_trampoline();
+
+static void init_fiber_ctx(Fiber* f) {
+#if BRPC_TPU_FCTX
+  f->sp = fctx_make(f->stack + f->stack_size, fiber_trampoline);
+#else
+  getcontext(&f->ctx);
+  f->ctx.uc_stack.ss_sp = f->stack;
+  f->ctx.uc_stack.ss_size = f->stack_size;
+  f->ctx.uc_link = nullptr;
+  makecontext(&f->ctx, (void (*)())fiber_trampoline, 0);
+#endif
+}
 
 Fiber* Scheduler::spawn(FiberFn fn, void* arg) {
   Fiber* f = new Fiber();
@@ -86,13 +181,40 @@ Fiber* Scheduler::spawn(FiberFn fn, void* arg) {
   f->arg = arg;
   f->stack = alloc_stack(kStackSize);
   f->stack_size = kStackSize;
-  getcontext(&f->ctx);
-  f->ctx.uc_stack.ss_sp = f->stack;
-  f->ctx.uc_stack.ss_size = f->stack_size;
-  f->ctx.uc_link = nullptr;
-  makecontext(&f->ctx, (void (*)())fiber_trampoline, 0);
+  init_fiber_ctx(f);
   ready_fiber(f);
   return f;
+}
+
+void Scheduler::spawn_detached(FiberFn fn, void* arg) {
+  Fiber* f = new Fiber();
+  f->detached = true;
+  f->fn = fn;
+  f->arg = arg;
+  f->stack = alloc_stack(kStackSize);
+  f->stack_size = kStackSize;
+  init_fiber_ctx(f);
+  ready_fiber(f);
+}
+
+void Scheduler::spawn_detached_back(FiberFn fn, void* arg) {
+  Fiber* f = new Fiber();
+  f->detached = true;
+  f->fn = fn;
+  f->arg = arg;
+  f->stack = alloc_stack(kStackSize);
+  f->stack_size = kStackSize;
+  init_fiber_ctx(f);
+  f->state.store(FiberState::READY, std::memory_order_release);
+  // Remote queues are FIFO and drained only when the local deque is empty:
+  // every already-ready producer runs before this fiber.
+  uint32_t idx = next_worker_.fetch_add(1) % workers_.size();
+  Worker* target = workers_[idx];
+  {
+    std::lock_guard<std::mutex> g(target->remote_mu);
+    target->remote_rq.push_back(f);
+  }
+  target->signal();
 }
 
 void Scheduler::ready_fiber(Fiber* f) {
@@ -160,31 +282,69 @@ static void fiber_trampoline() {
   // against the worker this thread belongs to now.
   w = current_worker();
   f->state.store(FiberState::DONE, std::memory_order_release);
-  // Publish completion only after leaving this stack: a joiner frees the
-  // stack, so the wake must happen from the worker loop (ending_sched).
-  w->remained = [f]() {
-    f->join_butex.value.store(1, std::memory_order_release);
-    Scheduler::butex_wake(&f->join_butex, INT32_MAX);
-  };
-  swapcontext(&f->ctx, &w->main_ctx);
+  // Publish completion only after leaving this stack: a joiner (or the
+  // detached self-reap) frees the stack, so it must happen from the worker
+  // loop (ending_sched).
+  w->remained_op = f->detached ? Worker::RemainedOp::FINISH_DETACHED
+                               : Worker::RemainedOp::FINISH_JOINABLE;
+  w->remained_fiber = f;
+  switch_out_to_main(w, f);
 }
 
 void Scheduler::run_fiber(Worker* w, Fiber* f) {
   w->current = f;
   f->state.store(FiberState::RUNNING, std::memory_order_release);
   w->nswitch++;
-  swapcontext(&w->main_ctx, &f->ctx);
+  switch_into_fiber(w, f);
   w->current = nullptr;
-  if (w->remained) {
-    auto r = std::move(w->remained);
-    w->remained = nullptr;
-    r();
+  switch (w->remained_op) {
+    case Worker::RemainedOp::NONE:
+      break;
+    case Worker::RemainedOp::READY: {
+      Fiber* rf = w->remained_fiber;
+      w->remained_op = Worker::RemainedOp::NONE;
+      rf->state.store(FiberState::READY, std::memory_order_release);
+      ready_fiber(rf);
+      break;
+    }
+    case Worker::RemainedOp::BUTEX_ENQUEUE: {
+      Fiber* rf = w->remained_fiber;
+      Butex* b = w->remained_butex;
+      int32_t expected = w->remained_expected;
+      w->remained_op = Worker::RemainedOp::NONE;
+      std::unique_lock<std::mutex> g(b->mu);
+      if (b->value.load(std::memory_order_acquire) != expected) {
+        g.unlock();
+        ready_fiber(rf);  // value already moved: spurious-wake ourselves
+      } else {
+        b->waiters.push_back(rf);
+      }
+      break;
+    }
+    case Worker::RemainedOp::FINISH_JOINABLE: {
+      Fiber* rf = w->remained_fiber;
+      w->remained_op = Worker::RemainedOp::NONE;
+      rf->join_butex.value.store(1, std::memory_order_release);
+      Scheduler::butex_wake(&rf->join_butex, INT32_MAX);
+      break;
+    }
+    case Worker::RemainedOp::FINISH_DETACHED: {
+      Fiber* rf = w->remained_fiber;
+      w->remained_op = Worker::RemainedOp::NONE;
+      free_stack(rf->stack, rf->stack_size);
+      delete rf;
+      break;
+    }
   }
 }
 
 void Scheduler::worker_loop(Worker* w) {
   tls_worker = w;
   while (!stopping_.load(std::memory_order_acquire)) {
+    // Read the lot BEFORE scanning queues: a push+signal landing between
+    // the scan and the park is then visible as a changed park_signal and
+    // the park is skipped (the ParkingLot expected-state discipline).
+    uint32_t expected = w->park_signal.load(std::memory_order_acquire);
     Fiber* f = next_task(w);
     if (f != nullptr) {
       run_fiber(w, f);
@@ -197,10 +357,17 @@ void Scheduler::worker_loop(Worker* w) {
       for (auto& h : idle_hooks_) did_work |= h();
     }
     if (did_work) continue;
-    uint32_t expected = w->park_signal.load(std::memory_order_acquire);
     std::unique_lock<std::mutex> lk(w->park_mu);
-    if (w->park_signal.load(std::memory_order_acquire) != expected) continue;
+    // Publish parked BEFORE the final recheck (Dekker pairing with
+    // signal()'s bump-then-load): a signaler that misses parked>0 must
+    // have bumped before our recheck, which then sees it and skips.
+    w->parked.fetch_add(1);
+    if (w->park_signal.load() != expected) {
+      w->parked.fetch_sub(1);
+      continue;
+    }
     w->park_cv.wait_for(lk, std::chrono::milliseconds(100));
+    w->parked.fetch_sub(1);
   }
   tls_worker = nullptr;
 }
@@ -211,11 +378,9 @@ void Scheduler::yield() {
   Fiber* f = w->current;
   // Requeue only after switching out (remained), else a thief could run
   // this fiber while it is still on this stack.
-  w->remained = [w, f]() {
-    f->state.store(FiberState::READY, std::memory_order_release);
-    w->sched->ready_fiber(f);
-  };
-  swapcontext(&f->ctx, &w->main_ctx);
+  w->remained_op = Worker::RemainedOp::READY;
+  w->remained_fiber = f;
+  switch_out_to_main(w, f);
 }
 
 Fiber* Scheduler::current() {
@@ -243,17 +408,11 @@ bool Scheduler::butex_wait(Butex* b, int32_t expected) {
   // Enqueue to the waiter list only after leaving this stack; the lambda
   // rechecks the value so a concurrent change-then-wake is never missed
   // (the butex_wait ordering discipline of butex.cpp:258).
-  Scheduler* s = w->sched;
-  w->remained = [b, f, expected, s]() {
-    std::unique_lock<std::mutex> g(b->mu);
-    if (b->value.load(std::memory_order_acquire) != expected) {
-      g.unlock();
-      s->ready_fiber(f);  // value already moved: spurious-wake ourselves
-    } else {
-      b->waiters.push_back(f);
-    }
-  };
-  swapcontext(&f->ctx, &w->main_ctx);  // parked; wake requeues us
+  w->remained_op = Worker::RemainedOp::BUTEX_ENQUEUE;
+  w->remained_fiber = f;
+  w->remained_butex = b;
+  w->remained_expected = expected;
+  switch_out_to_main(w, f);  // parked; wake requeues us
   return true;
 }
 

@@ -41,14 +41,27 @@ struct Butex {
 
 enum class FiberState : uint8_t { READY, RUNNING, BLOCKED, DONE };
 
+// On x86-64 we switch contexts with a register-only asm routine (the
+// fcontext discipline of bthread/context.cpp): ucontext's swapcontext
+// issues two rt_sigprocmask syscalls per switch, which dominates fiber
+// ping-pong cost (~1.2us measured vs ~100ns register-only).
+#if defined(__x86_64__)
+#define BRPC_TPU_FCTX 1
+#endif
+
 struct Fiber {
+#if BRPC_TPU_FCTX
+  void* sp = nullptr;  // saved stack pointer (callee-saved regs below it)
+#else
   ucontext_t ctx;
+#endif
   char* stack = nullptr;
   size_t stack_size = 0;
   FiberFn fn = nullptr;
   void* arg = nullptr;
   std::atomic<FiberState> state{FiberState::READY};
   Butex join_butex;  // value 0 = running, 1 = done
+  bool detached = false;  // self-reaping; never joined
 };
 
 class Worker {
@@ -60,16 +73,33 @@ class Worker {
   std::mutex park_mu;
   std::condition_variable park_cv;
   std::atomic<uint32_t> park_signal{0};
+  std::atomic<int> parked{0};  // gate: skip notify when nobody sleeps
   std::thread thread;
   Scheduler* sched = nullptr;
   int id = 0;
+#if BRPC_TPU_FCTX
+  void* main_sp = nullptr;  // worker loop's saved context
+#else
   ucontext_t main_ctx;  // the worker loop's context
+#endif
   Fiber* current = nullptr;
   uint64_t nswitch = 0;
   // Runs on the worker loop right after a fiber switches out — the
   // remained-callback mechanism (task_group.h:114-118) that lets a fiber
   // publish itself to a wait queue only AFTER it left its own stack.
-  std::function<void()> remained;
+  // POD-encoded (not std::function): it fires on EVERY park/yield/finish
+  // and a capturing lambda would heap-allocate each time.
+  enum class RemainedOp : uint8_t {
+    NONE,
+    READY,           // requeue fiber a
+    BUTEX_ENQUEUE,   // enqueue fiber a on butex b unless value moved
+    FINISH_JOINABLE, // publish completion of fiber a
+    FINISH_DETACHED, // reap fiber a
+  };
+  RemainedOp remained_op = RemainedOp::NONE;
+  Fiber* remained_fiber = nullptr;
+  Butex* remained_butex = nullptr;
+  int32_t remained_expected = 0;
 
   void signal();
 };
@@ -84,6 +114,13 @@ class Scheduler {
   int nworkers() const { return (int)workers_.size(); }
 
   Fiber* spawn(FiberFn fn, void* arg);
+  // Detached spawn (bthread_start_background without a join): the fiber
+  // frees its own stack from the worker loop after finishing.
+  void spawn_detached(FiberFn fn, void* arg);
+  // Like spawn_detached, but scheduled BEHIND every currently-ready fiber
+  // (the local deque is owner-LIFO): used by batching writers that want
+  // producers to run first so their appends coalesce.
+  void spawn_detached_back(FiberFn fn, void* arg);
   void join(Fiber* f);
   static void yield();        // from inside a fiber
   static Fiber* current();    // running fiber or nullptr
