@@ -33,7 +33,8 @@ _tls = threading.local()
 class Block:
     """A refcounted contiguous chunk. `data` is writable (bytearray)."""
 
-    __slots__ = ("data", "size", "capacity", "kind", "deleter", "meta", "device_array")
+    __slots__ = ("data", "size", "capacity", "kind", "deleter", "meta",
+                 "device_array", "__weakref__")
 
     HOST = 0
     USER = 1  # wraps caller-owned memory, freed via deleter
@@ -102,15 +103,44 @@ def _tls_block_cache() -> List[Block]:
     return cache
 
 
+# The blockmem_allocate seam (iobuf.cpp:163-168): a pluggable factory for
+# fresh blocks. brpc's RDMA pool points this at ibv_reg_mr'd arenas so all
+# IOBuf memory is transfer-registered; here the device transport points it
+# at a shared pinned-host arena (HostArena) so payload bytes are staged in
+# memory a cross-process peer can map directly. Returns None to fall back
+# to plain host blocks (arena exhausted).
+_block_allocator: Optional[Callable[[], Optional[Block]]] = None
+_alloc_gen = 0  # bumped on every allocator switch; stamps TLS caches
+
+
+def set_block_allocator(alloc: Optional[Callable[[], Optional[Block]]]):
+    global _block_allocator, _alloc_gen
+    _block_allocator = alloc
+    # Generation bump invalidates EVERY thread's cached pre-switch blocks
+    # (each thread checks its stamp on next use), not just this thread's.
+    _alloc_gen += 1
+
+
+def _new_block() -> Block:
+    if _block_allocator is not None:
+        b = _block_allocator()
+        if b is not None:
+            return b
+    return Block()
+
+
 def share_tls_block() -> Block:
     """Grab a thread-cached block with free space (iobuf.cpp:323-445)."""
+    if getattr(_tls, "alloc_gen", None) != _alloc_gen:
+        _tls.blocks = []
+        _tls.alloc_gen = _alloc_gen
     cache = _tls_block_cache()
     while cache:
         b = cache[-1]
         if b.left_space() > 0:
             return b
         cache.pop()
-    b = Block()
+    b = _new_block()
     cache.append(b)
     return b
 

@@ -39,20 +39,41 @@ from brpc_tpu.butil.iobuf import IOBuf
 _process_uuid = uuid.uuid4().hex
 
 
+def _host_boot_id() -> str:
+    """Same-host identity: two processes share a zero-copy arena only when
+    they share a kernel (the GID-subnet check analog)."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip()
+    except OSError:
+        import socket as pysocket
+
+        return pysocket.gethostname()
+
+
+_boot_id = _host_boot_id()
+
+
 def local_device_info() -> dict:
-    """Discovery: platform + device ids (GID/LID discovery analog)."""
+    """Discovery: platform + device ids (GID/LID discovery analog). The
+    send arena's name rides along like the GID/QPN credentials so the peer
+    can map our registered memory."""
+    arena = default_send_arena()
+    info = {
+        "process": _process_uuid,
+        "host": _boot_id,
+        "arena": arena.name if arena is not None else "",
+    }
     try:
         import jax
 
         devs = jax.devices()
-        return {
-            "process": _process_uuid,
-            "platform": devs[0].platform if devs else "none",
-            "device_count": len(devs),
-        }
+        info["platform"] = devs[0].platform if devs else "none"
+        info["device_count"] = len(devs)
     except Exception:
-        return {"process": _process_uuid, "platform": "none",
-                "device_count": 0}
+        info["platform"] = "none"
+        info["device_count"] = 0
+    return info
 
 
 # -- DeviceBlockPool (block_pool analog) ------------------------------------
@@ -117,29 +138,209 @@ def default_block_pool() -> DeviceBlockPool:
     return _default_pool
 
 
+# -- HostArena (the cross-process half of block_pool) ------------------------
+#
+# The reference registers big arenas with ibv_reg_mr so the NIC can DMA
+# them (block_pool.h:29-94). The TPU-host translation is a PINNED-HOST
+# shared-memory arena: the sender stages tensor bytes into it once
+# (device->host DMA), the wire carries only an (arena, offset) descriptor,
+# and a same-host peer maps the arena and hands the bytes straight to
+# jax.device_put — no payload bytes on the TCP stream, no pickling.
+
+class HostArena:
+    """Shared pinned-host arena carved by a first-fit span allocator."""
+
+    def __init__(self, size: int = 64 << 20, name: Optional[str] = None,
+                 create: bool = True):
+        from multiprocessing import shared_memory
+
+        if create:
+            self.shm = shared_memory.SharedMemory(create=True, size=size)
+        else:
+            self.shm = shared_memory.SharedMemory(name=name)
+            # A non-owner must NOT let Python's resource tracker unlink
+            # the segment when THIS process exits (3.12 has no track=False;
+            # the tracker would otherwise destroy the owner's live arena).
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(self.shm._name, "shared_memory")
+            except Exception:
+                pass
+        self.name = self.shm.name
+        self.size = self.shm.size
+        self._free = [(0, self.size)]  # sorted (offset, size) spans
+        self._lock = threading.Lock()
+        self.owner = create
+
+    # -- span allocator ----------------------------------------------------
+    def alloc(self, nbytes: int) -> Optional[int]:
+        nbytes = max(64, (nbytes + 63) & ~63)  # 64B-aligned spans
+        with self._lock:
+            for i, (off, sz) in enumerate(self._free):
+                if sz >= nbytes:
+                    if sz == nbytes:
+                        self._free.pop(i)
+                    else:
+                        self._free[i] = (off + nbytes, sz - nbytes)
+                    return off
+        return None
+
+    def free(self, offset: int, nbytes: int):
+        nbytes = max(64, (nbytes + 63) & ~63)
+        with self._lock:
+            self._free.append((offset, nbytes))
+            self._free.sort()
+            merged = []
+            for off, sz in self._free:
+                if merged and merged[-1][0] + merged[-1][1] == off:
+                    merged[-1] = (merged[-1][0], merged[-1][1] + sz)
+                else:
+                    merged.append((off, sz))
+            self._free = [(o, s) for o, s in merged]
+
+    def free_bytes(self) -> int:
+        with self._lock:
+            return sum(s for _, s in self._free)
+
+    def view(self, offset: int, nbytes: int) -> memoryview:
+        return memoryview(self.shm.buf)[offset:offset + nbytes]
+
+    # -- blockmem_allocate adapter ------------------------------------------
+    def make_block(self, capacity: int = 256 << 10):
+        """A writable IOBuf Block carved from this arena (the
+        blockmem_allocate hook, iobuf.cpp:163-168); freed back when the
+        block is collected. Returns None when exhausted."""
+        import weakref
+
+        from brpc_tpu.butil.iobuf import Block
+
+        off = self.alloc(capacity)
+        if off is None:
+            return None
+        b = Block.__new__(Block)
+        b.data = self.view(off, capacity)
+        b.size = 0
+        b.capacity = capacity
+        b.kind = Block.USER
+        b.deleter = None
+        b.meta = off
+        b.device_array = None
+        weakref.finalize(b, self.free, off, capacity)
+        return b
+
+    def install_as_iobuf_allocator(self, capacity: int = 256 << 10):
+        """Point IOBuf's block factory at this arena, so every appended
+        payload is staged in transfer-registered memory (the 'all IOBuf
+        memory is RDMA-registered' configuration of docs/cn/rdma.md)."""
+        from brpc_tpu.butil import iobuf as iobuf_mod
+
+        iobuf_mod.set_block_allocator(lambda: self.make_block(capacity))
+
+    def close(self):
+        try:
+            if self.owner:
+                self.shm.unlink()
+            # Live memoryviews (IOBuf blocks carved from the arena) keep
+            # the mapping pinned; unmapping then happens at process exit.
+            self.shm.close()
+        except (OSError, BufferError):
+            pass
+
+
+_send_arena: Optional[HostArena] = None
+_send_arena_lock = threading.Lock()
+_send_arena_enabled = True
+
+
+def default_send_arena() -> Optional[HostArena]:
+    """Process-wide outbound arena (created lazily; advertised in the
+    handshake)."""
+    global _send_arena
+    if not _send_arena_enabled:
+        return None
+    if _send_arena is None:
+        with _send_arena_lock:
+            if _send_arena is None:
+                try:
+                    _send_arena = HostArena()
+                except OSError:
+                    return None
+    return _send_arena
+
+
+_attached_arenas: Dict[str, HostArena] = {}
+_attached_lock = threading.Lock()
+
+
+def _cleanup_arenas():
+    global _send_arena
+    if _send_arena is not None:
+        _send_arena.close()
+        _send_arena = None
+    with _attached_lock:
+        for arena in _attached_arenas.values():
+            arena.close()
+        _attached_arenas.clear()
+
+
+import atexit  # noqa: E402
+
+atexit.register(_cleanup_arenas)
+
+
+def attach_arena(name: str) -> Optional[HostArena]:
+    """Map a peer's arena by name (their ibv_reg_mr region, our mmap)."""
+    with _attached_lock:
+        arena = _attached_arenas.get(name)
+        if arena is None:
+            try:
+                arena = HostArena(name=name, create=False)
+            except (OSError, FileNotFoundError):
+                return None
+            _attached_arenas[name] = arena
+    return arena
+
+
 # -- in-process tensor exchange (the loopback "ICI") ------------------------
 
-_inproc_registry: Dict[int, List] = {}
+_inproc_registry: Dict[int, Tuple[List, Optional[Tuple[int, object]]]] = {}
 _inproc_lock = threading.Lock()
 _inproc_next = [1]
 
 _dev_zero_copy = bvar.Adder("device_transport_zero_copy_transfers")
+_dev_shm = bvar.Adder("device_transport_shm_transfers")
 _dev_wire = bvar.Adder("device_transport_wire_transfers")
 
 
 def inproc_publish(arrays: List) -> int:
     """Register device arrays for same-process zero-copy pickup; returns a
-    ticket riding the wire in their place."""
+    ticket riding the wire in their place. The DeviceBlockPool brackets the
+    lane: a reservation is acquired per ticket (and released on claim), so
+    in-flight HBM handoffs are bounded by the pool — the role the
+    pre-registered block inventory plays in block_pool.h."""
+    reservation = None
+    try:
+        total = sum(int(a.nbytes) for a in arrays)
+        reservation = default_block_pool().acquire(total)
+    except Exception:
+        reservation = None
     with _inproc_lock:
         ticket = _inproc_next[0]
         _inproc_next[0] += 1
-        _inproc_registry[ticket] = arrays
+        _inproc_registry[ticket] = (arrays, reservation)
     return ticket
 
 
 def inproc_claim(ticket: int) -> Optional[List]:
     with _inproc_lock:
-        return _inproc_registry.pop(ticket, None)
+        entry = _inproc_registry.pop(ticket, None)
+    if entry is None:
+        return None
+    arrays, reservation = entry
+    if reservation is not None:
+        default_block_pool().release(*reservation)
+    return arrays
 
 
 # -- DeviceEndpoint (RdmaEndpoint analog) -----------------------------------
@@ -214,14 +415,23 @@ class DeviceEndpoint:
     def same_process(self) -> bool:
         return self.peer_info.get("process") == _process_uuid
 
+    @property
+    def same_host(self) -> bool:
+        return self.peer_info.get("host") == _boot_id
+
     # ---- send path ------------------------------------------------------
     def prepare_send(self, arrays: List, meta, attachment: IOBuf,
                      timeout_s: float = 10.0) -> bool:
         """Fill meta.tensors + attachment for `arrays` according to the
-        endpoint state; blocks while the send window is full."""
+        endpoint state; blocks while the send window is full.
+
+        Lane selection (rdma_endpoint.h:94-115 state machine applied to
+        locality): same process -> pass the jax.Array itself; same host ->
+        stage bytes ONCE into the shared HostArena and ship an (arena,
+        offset) descriptor (no payload on the wire); otherwise ->
+        FALLBACK_TCP wire bytes."""
         total = sum(int(a.nbytes) for a in arrays)
         with self._window_cond:
-            deadline = None
             import time
 
             deadline = time.monotonic() + timeout_s
@@ -234,34 +444,64 @@ class DeviceEndpoint:
         with self._lock:
             seq = self._next_seq
             self._next_seq += 1
-            self._retained[seq] = (arrays, total)
         meta.compress_type = 0
         for a in arrays:
             t = meta.tensors.add()
             t.dtype = str(a.dtype)
             t.shape.extend(int(d) for d in a.shape)
             t.nbytes = int(a.nbytes)
+
+        release = None
         if self.state == ESTABLISHED and self.same_process:
             # zero-copy: ship a ticket instead of bytes
             ticket = inproc_publish(arrays)
             meta.tensors[0].sharding_spec = f"inproc:{ticket}:{seq}"
             _dev_zero_copy.update(1)
-        else:
+            release = (lambda t=ticket: inproc_claim(t))
+        elif self.state == ESTABLISHED and self.same_host:
+            arena = default_send_arena()
+            offset = arena.alloc(total) if arena is not None else None
+            if offset is not None:
+                import numpy as np
+
+                pos = offset
+                for a in arrays:
+                    n = int(a.nbytes)
+                    dst = np.frombuffer(arena.shm.buf, dtype=np.uint8,
+                                        count=n, offset=pos)
+                    # one device->host DMA straight into registered memory
+                    host = np.ascontiguousarray(np.asarray(a))
+                    dst[:] = host.reshape(-1).view(np.uint8)
+                    pos += n
+                meta.tensors[0].sharding_spec = (
+                    f"shm:{arena.name}:{offset}:{seq}")
+                _dev_shm.update(1)
+                release = (lambda o=offset, n=total: arena.free(o, n))
+        if release is None and not (self.state == ESTABLISHED
+                                    and self.same_process):
             import numpy as np
 
             meta.tensors[0].sharding_spec = f"wire::{seq}"
             for a in arrays:
                 attachment.append(np.asarray(a).tobytes())
             _dev_wire.update(1)
+        with self._lock:
+            self._retained[seq] = (release, total)
         return True
 
     def on_ack(self, seq: int):
-        """Peer confirmed receipt: release retained buffers + open window
+        """Peer confirmed receipt: run the lane's release action (free the
+        arena span / drop the unclaimed ticket) + open the window
         (piggybacked-ACK path, rdma_endpoint.h:132-138)."""
         with self._lock:
             entry = self._retained.pop(seq, None)
         if entry is not None:
-            _, total = entry
+            release, total = entry
+            if release is not None:
+                try:
+                    release()
+                except Exception:
+                    pass
             with self._window_cond:
                 self._inflight = max(0, self._inflight - total)
                 self._window_cond.notify_all()
@@ -285,19 +525,55 @@ def _recv_exact(fd, n: int) -> Optional[bytes]:
     return out
 
 
+def _np_dtype(name: str):
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def receive_tensors(meta, attachment: IOBuf, device=None) -> Tuple[List, Optional[int]]:
     """Reconstruct arrays from a tensor-bearing message. Returns
-    (arrays, ack_seq). Zero-copy when the sender published in-process."""
+    (arrays, ack_seq). Zero-copy when the sender published in-process;
+    mapped straight out of the sender's shared arena when same-host (the
+    recv-zero-copy-into-registered-blocks path, rdma_endpoint.h:214-219)."""
     if not meta.tensors:
         return [], None
     spec = meta.tensors[0].sharding_spec or ""
     parts = spec.split(":")
     seq = None
-    if len(parts) == 3 and parts[2].isdigit():
-        seq = int(parts[2])
+    if len(parts) >= 3 and parts[-1].isdigit():
+        seq = int(parts[-1])
     if parts[0] == "inproc" and parts[1].isdigit():
         arrays = inproc_claim(int(parts[1]))
         if arrays is not None:
+            return arrays, seq
+    if parts[0] == "shm" and len(parts) == 4:
+        arena = attach_arena(parts[1])
+        if arena is not None:
+            import numpy as np
+
+            arrays = []
+            pos = int(parts[2])
+            for t in meta.tensors:
+                dtype = _np_dtype(t.dtype)
+                view = np.frombuffer(arena.shm.buf, dtype=np.uint8,
+                                     count=t.nbytes, offset=pos)
+                pos += t.nbytes
+                if device is not None:
+                    import jax
+
+                    # host->device DMA straight from the mapped arena
+                    arr = jax.device_put(
+                        view.view(dtype).reshape(tuple(t.shape)), device)
+                else:
+                    # own the bytes before ACK lets the sender reuse them
+                    arr = np.array(view.view(dtype).reshape(tuple(t.shape)))
+                arrays.append(arr)
             return arrays, seq
     # wire path: materialize from attachment bytes
     import numpy as np
@@ -305,13 +581,8 @@ def receive_tensors(meta, attachment: IOBuf, device=None) -> Tuple[List, Optiona
     arrays = []
     for t in meta.tensors:
         raw = attachment.cutn_bytes(t.nbytes)
-        try:
-            dtype = np.dtype(t.dtype)
-        except TypeError:
-            import ml_dtypes
-
-            dtype = np.dtype(getattr(ml_dtypes, t.dtype))
-        arr = np.frombuffer(raw, dtype=dtype).reshape(tuple(t.shape))
+        arr = np.frombuffer(raw, dtype=_np_dtype(t.dtype)).reshape(
+            tuple(t.shape))
         if device is not None:
             import jax
 

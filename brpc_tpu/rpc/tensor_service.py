@@ -149,6 +149,18 @@ class TensorStoreService(Service):
             ep.prepare_send(arrays, meta, cntl.response_attachment)
         done()
 
+    @rpc_method(ts_pb2.TensorAckRequest, ts_pb2.TensorAckResponse)
+    def Ack(self, cntl, request, response, done):
+        """Explicit ACK frame for pull transfers (the non-piggybacked ACK
+        of rdma_endpoint.h:222-226): releases the connection endpoint's
+        retained buffers/arena spans."""
+        ep = (cntl._server_socket.app_state
+              if cntl._server_socket is not None else None)
+        if isinstance(ep, DeviceEndpoint) and request.seq:
+            ep.on_ack(request.seq)
+        response.ok = True
+        done()
+
     def get(self, name: str) -> Optional[List]:
         with self._lock:
             return self._store.get(name)
@@ -178,7 +190,7 @@ class TensorClient:
                 ep.on_ack(response.ack_seq)
         return cntl, response
 
-    def pull(self, name: str, timeout_ms: float = 10000):
+    def pull(self, name: str, timeout_ms: float = 10000, device=None):
         from brpc_tpu.rpc.controller import Controller
 
         cntl = Controller()
@@ -193,7 +205,17 @@ class TensorClient:
         meta = getattr(cntl, "_response_rpc_meta", None)
         if meta is None:
             return cntl, None
-        arrays, _ = receive_tensors(meta, cntl.response_attachment)
+        arrays, seq = receive_tensors(meta, cntl.response_attachment,
+                                      device=device)
+        if seq:
+            # explicit ACK so the server frees its retained span/window
+            ack_cntl = Controller()
+            ack_cntl.timeout_ms = timeout_ms
+            self.channel.call_method(
+                "TensorStore.Ack", ack_cntl,
+                ts_pb2.TensorAckRequest(seq=seq),
+                ts_pb2.TensorAckResponse(),
+            )
         return cntl, arrays
 
 

@@ -67,6 +67,47 @@ def main():
                                np.asarray(arr)[:8])
     print("pull verified")
 
+    # cross-process lane: a subprocess server, payloads via the shared
+    # HostArena (descriptor-only wire — the rdma_performance shape)
+    import subprocess
+    import sys as _sys
+
+    script = (
+        "import sys; sys.path.insert(0, '.');\n"
+        "import _jaxenv; _jaxenv.apply()\n"
+        "from brpc_tpu import rpc\n"
+        "from brpc_tpu.rpc.tensor_service import TensorStoreService\n"
+        "srv = rpc.Server(rpc.ServerOptions(num_threads=2))\n"
+        "srv.add_service(TensorStoreService())\n"
+        "assert srv.start('127.0.0.1:0') == 0\n"
+        "print(srv.listen_endpoint.port, flush=True)\n"
+        "sys.stdin.readline()\n"
+        "srv.stop()\n"
+    )
+    proc = subprocess.Popen([_sys.executable, "-c", script],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            text=True, cwd=".",
+                            env={**__import__('os').environ,
+                                 "PYTHONPATH": "examples"})
+    xport = int(proc.stdout.readline())
+    xch = make_device_channel(f"127.0.0.1:{xport}")
+    xclient = TensorClient(xch)
+    cntl, _ = xclient.push("xwarm", [arr])
+    assert not cntl.failed(), cntl.error_text
+    ep = cntl._current_sock.app_state
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        cntl, _ = xclient.push(f"x{i}", [arr])
+        assert not cntl.failed(), cntl.error_text
+    dtx = time.perf_counter() - t0
+    print(f"cross-process pushed {args.iters} x {args.mb}MB in {dtx:.3f}s "
+          f"-> {nbytes * args.iters / dtx / 1e9:.2f} GB/s "
+          f"(shared-arena lane, same_host={ep.same_host}, "
+          f"same_process={ep.same_process})")
+    xch.close()
+    proc.stdin.close()
+    proc.wait(timeout=10)
+
     import jax
 
     if len(jax.devices()) >= 2:
