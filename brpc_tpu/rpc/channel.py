@@ -65,7 +65,18 @@ def get_client_messenger() -> InputMessenger:
 
 class Channel:
     def __init__(self, options: Optional[ChannelOptions] = None):
-        self.options = options or ChannelOptions()
+        import dataclasses
+
+        # private copy: option coercions must not alias back into a
+        # caller-shared ChannelOptions instance
+        self.options = (dataclasses.replace(options) if options is not None
+                        else ChannelOptions())
+        if (self.options.use_device_transport
+                and self.options.connection_type != "single"):
+            # The device lane's app-level ACKs (TensorStore.Ack) must ride
+            # the SAME connection whose endpoint retains the spans; pooled/
+            # short connections would route them to a different endpoint.
+            self.options.connection_type = "single"
         self._protocol = None
         self._server_ep: Optional[EndPoint] = None
         self._single_sid: Optional[int] = None
@@ -101,6 +112,12 @@ class Channel:
         self._protocol = find_protocol_by_name(self.options.protocol)
         if self._protocol is None:
             return errors.EPROTONOTSUP
+        supported = self._protocol.supported_connection_types
+        if self.options.connection_type not in supported:
+            # Protocols that can't share a connection (esp: one in-flight
+            # RPC per socket) get their first supported type, the
+            # reference's default-from-supported_connection_type behavior.
+            self.options.connection_type = supported[0]
         if "://" in str(target):
             from brpc_tpu.rpc.load_balancer import create_load_balancer
             from brpc_tpu.rpc.naming_service import start_naming_service
@@ -178,7 +195,15 @@ class Channel:
         rc = sock.connect(timeout_s=self.options.connect_timeout_ms / 1000.0)
         if rc != 0:
             return None
+        self._pin_protocol(sock)
         return sock
+
+    def _pin_protocol(self, sock: Socket):
+        """A client connection speaks exactly one protocol — pre-match it so
+        weak-magic response parsers (esp, nshead) can never misclaim bytes
+        meant for another channel's protocol."""
+        if sock.matched_protocol is None:
+            sock.matched_protocol = self._protocol
 
     def _select_socket(self, cntl: Controller):
         """Returns (Socket, rc). Applies LB if configured, then the
@@ -201,6 +226,7 @@ class Channel:
                 if main_sock.ensure_connected(
                         self.options.connect_timeout_ms / 1000.0) != 0:
                     return None, errors.EFAILEDSOCKET
+                self._pin_protocol(main_sock)
             return self._apply_connection_type(main_sock, cntl)
         if self._server_ep is None:
             return None, errors.EINVAL
@@ -242,6 +268,8 @@ class Channel:
             if self._single_sid is not None:
                 sock = Socket.address(self._single_sid)
                 if sock is not None and not sock.failed():
+                    # health-check revival resets matched_protocol
+                    self._pin_protocol(sock)
                     return sock, 0
             key = make_key(
                 ep,
@@ -264,6 +292,7 @@ class Channel:
             if sock.ensure_connected(
                     self.options.connect_timeout_ms / 1000.0) != 0:
                 return None, errors.EFAILEDSOCKET
+            self._pin_protocol(sock)
             self._single_sid = sock.socket_id
             self._mapped_key = key
             self._mapped_sid = sid

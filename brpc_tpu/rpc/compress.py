@@ -1,9 +1,12 @@
 """Compression registry — counterpart of brpc/compress.{h,cpp} +
-policy/gzip_compress.cpp (registered in global.cpp:379-391). gzip and zlib
-via the stdlib; the registry is pluggable like the reference's.
+policy/{gzip,snappy}_compress.cpp (registered in global.cpp:379-391). gzip
+and zlib via the stdlib; snappy is a self-contained block-format codec
+(the reference vendors snappy under butil/third_party/snappy); the
+registry is pluggable like the reference's.
 """
 from __future__ import annotations
 
+import struct
 import zlib
 from typing import Callable, Dict, Tuple
 
@@ -11,6 +14,7 @@ from typing import Callable, Dict, Tuple
 COMPRESS_NONE = 0
 COMPRESS_GZIP = 1
 COMPRESS_ZLIB = 2
+COMPRESS_SNAPPY = 3
 
 _handlers: Dict[int, Tuple[Callable[[bytes], bytes], Callable[[bytes], bytes]]] = {}
 
@@ -47,3 +51,136 @@ register_compress(
     lambda d: zlib.compress(d, 6),
     lambda d: zlib.decompress(d),
 )
+
+
+# -- snappy block format ----------------------------------------------------
+# Wire-compatible with google/snappy's format description: a varint32
+# uncompressed length, then literal elements (tag 00) and copy elements
+# (tags 01/10/11). The encoder emits literals and 2-byte-offset copies
+# found via a rolling 4-byte hash, like snappy's fast path.
+
+def _varint_encode(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def _varint_decode(data: bytes, pos: int) -> Tuple[int, int]:
+    shift = n = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+        if shift > 35:
+            raise ValueError("snappy: varint too long")
+
+
+def _emit_literal(out: bytearray, data, start: int, end: int):
+    length = end - start
+    while length > 0:
+        run = min(length, 1 << 32)
+        n = run - 1
+        if n < 60:
+            out.append(n << 2)
+        elif n < (1 << 8):
+            out.append(60 << 2)
+            out.append(n)
+        elif n < (1 << 16):
+            out.append(61 << 2)
+            out += struct.pack("<H", n)
+        elif n < (1 << 24):
+            out.append(62 << 2)
+            out += struct.pack("<I", n)[:3]
+        else:
+            out.append(63 << 2)
+            out += struct.pack("<I", n)
+        out += data[start:start + run]
+        start += run
+        length -= run
+
+
+def snappy_compress(data: bytes) -> bytes:
+    data = bytes(data)
+    n = len(data)
+    out = bytearray(_varint_encode(n))
+    if n < 4:
+        if n:
+            _emit_literal(out, data, 0, n)
+        return bytes(out)
+    table: Dict[bytes, int] = {}
+    pos = lit_start = 0
+    limit = n - 4
+    while pos <= limit:
+        key = data[pos:pos + 4]
+        cand = table.get(key)
+        table[key] = pos
+        if cand is None or pos - cand > 0xFFFF:
+            pos += 1
+            continue
+        # extend the match
+        mlen = 4
+        while (pos + mlen < n and mlen < 64
+               and data[cand + mlen] == data[pos + mlen]):
+            mlen += 1
+        if lit_start < pos:
+            _emit_literal(out, data, lit_start, pos)
+        offset = pos - cand
+        out.append(((mlen - 1) << 2) | 2)  # tag 10: 2-byte offset copy
+        out += struct.pack("<H", offset)
+        pos += mlen
+        lit_start = pos
+    if lit_start < n:
+        _emit_literal(out, data, lit_start, n)
+    return bytes(out)
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    total, pos = _varint_decode(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        elem = tag & 3
+        if elem == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                nbytes = length - 60
+                length = int.from_bytes(data[pos:pos + nbytes], "little") + 1
+                pos += nbytes
+            out += data[pos:pos + length]
+            pos += length
+        else:
+            if elem == 1:  # 1-byte offset, len 4-11
+                length = ((tag >> 2) & 0x7) + 4
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif elem == 2:  # 2-byte LE offset
+                length = (tag >> 2) + 1
+                offset = struct.unpack_from("<H", data, pos)[0]
+                pos += 2
+            else:  # 4-byte LE offset
+                length = (tag >> 2) + 1
+                offset = struct.unpack_from("<I", data, pos)[0]
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise ValueError("snappy: bad copy offset")
+            start = len(out) - offset
+            if offset >= length:  # disjoint: one slice copy
+                out += out[start:start + length]
+            else:
+                for i in range(length):  # self-overlapping (RLE-style)
+                    out.append(out[start + i])
+    if len(out) != total:
+        raise ValueError(
+            f"snappy: declared {total} bytes, decoded {len(out)}")
+    return bytes(out)
+
+
+register_compress(COMPRESS_SNAPPY, snappy_compress, snappy_decompress)

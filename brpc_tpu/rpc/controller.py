@@ -179,9 +179,16 @@ class Controller:
         self._accessed_sids.add(sock.socket_id)
         self.remote_side = sock.remote_side
         attempt_cid = self.current_attempt_id()
-        packet = channel._protocol.pack_request(
-            self._request_payload, self, attempt_cid
-        )
+        try:
+            packet = channel._protocol.pack_request(
+                self._request_payload, self, attempt_cid
+            )
+        except Exception as e:
+            # e.g. authenticator refused, or esp poisoning a socket with an
+            # unconsumed in-flight response — fail the RPC cleanly.
+            self.set_failed(errors.EREQUEST, f"fail to pack request: {e}")
+            self._end_rpc_locked_or_not(locked=False)
+            return
         # Pipelined-protocol correlation entries are pushed atomically with
         # the queue append (on_queued runs under the socket's write lock),
         # so concurrent callers on a shared connection cannot enqueue in

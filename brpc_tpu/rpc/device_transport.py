@@ -550,31 +550,47 @@ def receive_tensors(meta, attachment: IOBuf, device=None) -> Tuple[List, Optiona
         seq = int(parts[-1])
     if parts[0] == "inproc" and parts[1].isdigit():
         arrays = inproc_claim(int(parts[1]))
-        if arrays is not None:
-            return arrays, seq
+        if arrays is None:
+            # Ticket gone (already claimed / sender restarted). No payload
+            # rode the wire for this lane — falling through would misread
+            # an empty attachment, so fail loudly.
+            raise ValueError(f"device transport: in-process ticket "
+                             f"{parts[1]} is no longer claimable")
+        return arrays, seq
     if parts[0] == "shm" and len(parts) == 4:
         arena = attach_arena(parts[1])
-        if arena is not None:
-            import numpy as np
+        if arena is None:
+            raise ValueError(
+                f"device transport: cannot attach shared arena "
+                f"{parts[1]!r} (sender chose the same-host lane but the "
+                f"shm namespace is not shared)")
+        import numpy as np
 
-            arrays = []
-            pos = int(parts[2])
-            for t in meta.tensors:
-                dtype = _np_dtype(t.dtype)
-                view = np.frombuffer(arena.shm.buf, dtype=np.uint8,
-                                     count=t.nbytes, offset=pos)
-                pos += t.nbytes
-                if device is not None:
-                    import jax
+        arrays = []
+        pos = int(parts[2])
+        for t in meta.tensors:
+            dtype = _np_dtype(t.dtype)
+            view = np.frombuffer(arena.shm.buf, dtype=np.uint8,
+                                 count=t.nbytes, offset=pos)
+            pos += t.nbytes
+            if device is not None:
+                import jax
 
-                    # host->device DMA straight from the mapped arena
-                    arr = jax.device_put(
-                        view.view(dtype).reshape(tuple(t.shape)), device)
-                else:
-                    # own the bytes before ACK lets the sender reuse them
-                    arr = np.array(view.view(dtype).reshape(tuple(t.shape)))
-                arrays.append(arr)
-            return arrays, seq
+                # host->device DMA straight from the mapped arena
+                arr = jax.device_put(
+                    view.view(dtype).reshape(tuple(t.shape)), device)
+            else:
+                # own the bytes before ACK lets the sender reuse them
+                arr = np.array(view.view(dtype).reshape(tuple(t.shape)))
+            arrays.append(arr)
+        if device is not None:
+            import jax
+
+            # the async H2D copies must finish before the caller ACKs —
+            # the sender reuses the span after ACK (retention-until-ACK,
+            # rdma_endpoint.h:214)
+            jax.block_until_ready(arrays)
+        return arrays, seq
     # wire path: materialize from attachment bytes
     import numpy as np
 

@@ -1,0 +1,180 @@
+"""esp protocol — counterpart of /root/reference/src/brpc/policy/
+esp_protocol.cpp + esp_head.h: a 32-byte packed little-endian header
+`{from:u64, to:u64, msg:u32, msg_id:u64, body_len:i32}` then the body.
+
+The reference registers esp client-side only, on pooled/short connections,
+with the correlation id parked on the socket between request and response
+(esp_protocol.cpp:103,124 — esp frames carry no correlation of their own,
+so each pooled socket has at most one RPC in flight). We keep that client
+shape and add an optional server side gated on ServerOptions.esp_service —
+esp has no magic bytes, so like mongo it only claims bytes when the server
+opted in.
+"""
+from __future__ import annotations
+
+import struct
+import time
+from typing import Callable
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+
+_HEAD = struct.Struct("<QQIQi")  # EspHead, packed (esp_head.h:20-27)
+HEAD_SIZE = _HEAD.size  # 32
+MAX_BODY = 64 << 20
+
+
+class EspMessage:
+    """EspHead fields + body (esp_message.h:35-38)."""
+
+    __slots__ = ("from_addr", "to_addr", "msg", "msg_id", "body")
+
+    def __init__(self, body: bytes = b"", to_addr: int = 0, msg: int = 0,
+                 msg_id: int = 0, from_addr: int = 0):
+        self.from_addr = from_addr
+        self.to_addr = to_addr
+        self.msg = msg
+        self.msg_id = msg_id
+        self.body = body
+
+    def serialize(self) -> bytes:
+        return _HEAD.pack(self.from_addr, self.to_addr, self.msg,
+                          self.msg_id, len(self.body)) + self.body
+
+
+class EspInputMessage(InputMessageBase):
+    __slots__ = ("msg", "is_request")
+
+    def __init__(self, msg: EspMessage, is_request: bool):
+        super().__init__()
+        self.msg = msg
+        self.is_request = is_request
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    if arg is not None:  # server side: only when the server opted in
+        if getattr(getattr(arg, "options", None), "esp_service", None) is None:
+            return ParseResult.try_others()
+    elif not hasattr(sock, "esp_correlation_id"):
+        # Client side: esp has zero magic, so only claim bytes on sockets
+        # an esp pack_request has actually used — otherwise corrupt frames
+        # on other channels' sockets would be silently swallowed here.
+        return ParseResult.try_others()
+    if len(portal) < HEAD_SIZE:
+        return ParseResult.not_enough()
+    raw = portal.copy_to_bytes(HEAD_SIZE)
+    from_addr, to_addr, msg, msg_id, body_len = _HEAD.unpack(raw)
+    if body_len < 0 or body_len > MAX_BODY:
+        return ParseResult.error_()
+    if len(portal) < HEAD_SIZE + body_len:
+        return ParseResult.not_enough()
+    portal.pop_front(HEAD_SIZE)
+    body = portal.cutn_bytes(body_len)
+    return ParseResult.ok(EspInputMessage(
+        EspMessage(body, to_addr, msg, msg_id, from_addr),
+        is_request=arg is not None))
+
+
+def serialize_request(request, cntl: Controller):
+    if isinstance(request, EspMessage):
+        return request
+    raise TypeError("esp channel takes an EspMessage request")
+
+
+def pack_request(request: EspMessage, cntl: Controller,
+                 correlation_id: int) -> IOBuf:
+    # Correlation parks on the socket (esp_protocol.cpp:103): esp sockets
+    # are pooled/short, so one in-flight RPC per socket.
+    sock = cntl._current_sock
+    if getattr(sock, "esp_correlation_id", None) is not None:
+        # A previous RPC on this socket ended without its response being
+        # consumed (timeout/cancel); a late reply could complete the WRONG
+        # call. Poison the connection instead of risking mismatches.
+        sock.set_failed(errors.ECLOSE, "esp response outstanding on socket")
+        raise ValueError("esp socket has an unconsumed in-flight response")
+    sock.esp_correlation_id = correlation_id
+    return IOBuf(request.serialize())
+
+
+def process_response(msg: EspInputMessage):
+    sock = msg.socket
+    cid = getattr(sock, "esp_correlation_id", None)
+    if cid is None:
+        return
+    sock.esp_correlation_id = None
+    try:
+        cntl = bthread_id.lock(cid)
+    except (KeyError, TimeoutError):
+        return
+    if not isinstance(cntl, Controller):
+        try:
+            bthread_id.unlock(cid)
+        except Exception:
+            pass
+        return
+    resp = cntl._response
+    if isinstance(resp, EspMessage):
+        src = msg.msg
+        resp.from_addr = src.from_addr
+        resp.to_addr = src.to_addr
+        resp.msg = src.msg
+        resp.msg_id = src.msg_id
+        resp.body = src.body
+    cntl._end_rpc_locked_or_not(locked=True)
+
+
+class EspService:
+    """Server-side handler (our extension; the reference is client-only):
+    override process_esp_request(cntl, request, done)."""
+
+    def process_esp_request(self, cntl, request: EspMessage,
+                            done: Callable):
+        done(EspMessage(request.body, msg=request.msg,
+                        msg_id=request.msg_id))
+
+
+def process_request(msg: EspInputMessage):
+    server = msg.arg
+    sock = msg.socket
+    service = server.options.esp_service
+    cntl = Controller()
+    cntl.server = server
+    cntl.remote_side = sock.remote_side
+    cntl.server_start_time = time.monotonic()
+    responded = [False]
+
+    def done(response: EspMessage = None):
+        if responded[0]:
+            return
+        responded[0] = True
+        out = response or EspMessage(msg=msg.msg.msg, msg_id=msg.msg.msg_id)
+        out.msg_id = msg.msg.msg_id
+        sock.write(IOBuf(out.serialize()))
+
+    try:
+        service.process_esp_request(cntl, msg.msg, done)
+    except Exception as e:
+        if not responded[0]:
+            done(EspMessage(f"error: {e}".encode(), msg=msg.msg.msg))
+
+
+register_protocol(Protocol(
+    name="esp",
+    type=ProtocolType.ESP,
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+    supported_connection_types=("pooled", "short"),
+    process_inline=True,
+))

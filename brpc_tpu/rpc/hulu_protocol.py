@@ -1,0 +1,222 @@
+"""hulu_pbrpc — Baidu's legacy pb-rpc protocol, wire-compatible framing.
+
+Counterpart of /root/reference/src/brpc/policy/hulu_pbrpc_protocol.cpp:
+12-byte header `"HULU" + u32le(meta_size+payload_size) + u32le(meta_size)`
+(HuluRawPacker stores host order, hulu_pbrpc_protocol.cpp:100-149), then a
+HuluRpcRequestMeta / HuluRpcResponseMeta protobuf, then the payload.
+
+Dispatch: stock hulu addresses methods by (unqualified service name,
+descriptor method_index) and optionally method_name (hulu_pbrpc_meta.proto
+fields 1/2/14). We always send method_name and accept either on the server
+(method_index resolves against sorted method-name order — descriptor order
+for alphabetically-declared services); calling a stock hulu server that
+ignores method_name requires index agreement. Correlation rides in the
+meta, so hulu supports pooled connections like tpu_std.
+"""
+from __future__ import annotations
+
+import struct
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import compress as compress_mod
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.pb_dispatch import dispatch_pb_request
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+from brpc_tpu.rpc.proto import legacy_meta_pb2
+
+MAGIC = b"HULU"
+HEADER_LEN = 12
+MAX_BODY = 64 << 20
+
+# hulu compress enum (hulu_pbrpc_protocol.cpp:58-96) -> our registry codes
+_HULU_NONE, _HULU_SNAPPY, _HULU_GZIP, _HULU_ZLIB = 0, 1, 2, 3
+_FROM_HULU = {_HULU_NONE: compress_mod.COMPRESS_NONE,
+              _HULU_SNAPPY: compress_mod.COMPRESS_SNAPPY,
+              _HULU_GZIP: compress_mod.COMPRESS_GZIP,
+              _HULU_ZLIB: compress_mod.COMPRESS_ZLIB}
+_TO_HULU = {v: k for k, v in _FROM_HULU.items()}
+
+
+class HuluMessage(InputMessageBase):
+    __slots__ = ("meta", "payload", "is_request")
+
+    def __init__(self, meta, payload: bytes, is_request: bool):
+        super().__init__()
+        self.meta = meta
+        self.payload = payload
+        self.is_request = is_request
+
+
+def _pack_frame(meta, payload: bytes) -> IOBuf:
+    meta_bytes = meta.SerializeToString()
+    out = IOBuf()
+    out.append(MAGIC + struct.pack("<II", len(meta_bytes) + len(payload),
+                                   len(meta_bytes)))
+    out.append(meta_bytes)
+    if payload:
+        out.append(payload)
+    return out
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    if len(portal) < HEADER_LEN:
+        head = portal.copy_to_bytes(min(4, len(portal)))
+        if MAGIC.startswith(head):
+            return ParseResult.not_enough()
+        return ParseResult.try_others()
+    header = portal.copy_to_bytes(HEADER_LEN)
+    if header[:4] != MAGIC:
+        return ParseResult.try_others()
+    body_size, meta_size = struct.unpack("<II", header[4:12])
+    if body_size > MAX_BODY or meta_size > body_size:
+        return ParseResult.error_()
+    if len(portal) < HEADER_LEN + body_size:
+        return ParseResult.not_enough()
+    portal.pop_front(HEADER_LEN)
+    meta_bytes = portal.cutn_bytes(meta_size)
+    payload = portal.cutn_bytes(body_size - meta_size)
+    # Serving connections carry requests, client connections responses
+    # (the reference packs different metas per direction).
+    is_server_conn = arg is not None
+    meta_cls = (legacy_meta_pb2.HuluRpcRequestMeta if is_server_conn
+                else legacy_meta_pb2.HuluRpcResponseMeta)
+    meta = meta_cls()
+    try:
+        meta.ParseFromString(meta_bytes)
+    except Exception:
+        return ParseResult.error_()
+    return ParseResult.ok(HuluMessage(meta, payload, is_server_conn))
+
+
+def serialize_request(request, cntl: Controller):
+    if request is None:
+        return b""
+    if isinstance(request, (bytes, bytearray)):
+        return bytes(request)
+    return request.SerializeToString()
+
+
+def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf:
+    meta = legacy_meta_pb2.HuluRpcRequestMeta()
+    service, _, method = cntl._method_full_name.rpartition(".")
+    # Stock hulu uses the UNQUALIFIED service name (service->name(), not
+    # full_name — hulu_pbrpc_protocol.cpp:444); ours registers class names.
+    meta.service_name = service.rpartition(".")[2]
+    meta.method_index = 0
+    meta.method_name = method
+    meta.correlation_id = correlation_id
+    meta.log_id = cntl.log_id
+    if cntl.trace_id:
+        meta.trace_id = cntl.trace_id
+        meta.span_id = cntl.span_id
+    auth = cntl._channel.options.auth if cntl._channel is not None else None
+    if auth is not None:
+        cred = auth.generate_credential(cntl)
+        if cred is None:
+            raise ValueError("authenticator refused to generate credential")
+        meta.credential_data = cred
+    if cntl.compress_type:
+        meta.compress_type = _TO_HULU.get(cntl.compress_type, _HULU_NONE)
+    payload = compress_mod.compress(payload, cntl.compress_type)
+    return _pack_frame(meta, payload)
+
+
+def process_response(msg: HuluMessage):
+    meta = msg.meta
+    cid = meta.correlation_id
+    try:
+        cntl = bthread_id.lock(cid)
+    except (KeyError, TimeoutError):
+        return
+    if not isinstance(cntl, Controller):
+        try:
+            bthread_id.unlock(cid)
+        except Exception:
+            pass
+        return
+    try:
+        if meta.error_code:
+            cntl.set_failed(meta.error_code, meta.error_text or "hulu error")
+        else:
+            payload = compress_mod.decompress(
+                msg.payload, _FROM_HULU.get(meta.compress_type, 0))
+            resp = cntl._response
+            if resp is not None and payload:
+                resp.ParseFromString(payload)
+    except Exception as e:
+        cntl.set_failed(errors.ERESPONSE, f"fail to parse response: {e}")
+    cntl._end_rpc_locked_or_not(locked=True)
+
+
+def _send_response(sock, cid: int, cntl: Controller, response):
+    meta = legacy_meta_pb2.HuluRpcResponseMeta()
+    meta.correlation_id = cid
+    if cntl.failed():
+        meta.error_code = cntl.error_code_value
+        meta.error_text = cntl.error_text_value
+        payload = b""
+    else:
+        payload = (response.SerializeToString()
+                   if response is not None else b"")
+        if cntl.compress_type:
+            meta.compress_type = _TO_HULU.get(cntl.compress_type, 0)
+            payload = compress_mod.compress(payload, cntl.compress_type)
+    sock.write(_pack_frame(meta, payload))
+    if cntl.close_connection_flag:
+        sock.set_failed(errors.ECLOSE, "close_connection requested")
+
+
+def process_request(msg: HuluMessage):
+    """Server path (ProcessHuluRequest's role)."""
+    server = msg.arg
+    meta = msg.meta
+    cid = meta.correlation_id
+    sock = msg.socket
+    cntl = Controller()
+    cntl.log_id = meta.log_id
+    cntl.trace_id = meta.trace_id
+
+    def send_response(c, response):
+        _send_response(sock, cid, c, response)
+
+    if server is not None and server.auth is not None:
+        ok, ctx = False, None
+        try:
+            ok, ctx = server.auth.verify_credential(
+                meta.credential_data, sock.remote_side)
+        except Exception:
+            ok = False
+        if not ok:
+            cntl.set_failed(errors.EAUTH, "authentication failed")
+            return send_response(cntl, None)
+        cntl.auth_context = ctx
+
+    method_name = meta.method_name
+    if server is not None and not method_name:
+        service = server.find_service(meta.service_name)
+        if service is not None:
+            names = sorted(service.methods().keys())
+            if 0 <= meta.method_index < len(names):
+                method_name = names[meta.method_index]
+    dispatch_pb_request(server, sock, meta.service_name, method_name or "",
+                        msg.payload, _FROM_HULU.get(meta.compress_type, 0),
+                        send_response, cntl)
+
+
+register_protocol(Protocol(
+    name="hulu_pbrpc",
+    type=ProtocolType.HULU,
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+))

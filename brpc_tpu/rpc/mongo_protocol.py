@@ -1,0 +1,111 @@
+"""Mongo server-side protocol — counterpart of
+/root/reference/src/brpc/policy/mongo_protocol.cpp: lets a server speak the
+MongoDB wire protocol so mongo drivers can talk to it. Server-only, like
+the reference (global.cpp registers no mongo client path); gated on
+ServerOptions.mongo_service_adaptor the way ParseMongoMessage bails with
+TRY_OTHERS when the server has no adaptor (mongo_protocol.cpp:110-118).
+"""
+from __future__ import annotations
+
+import time
+
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.mongo import (
+    HEAD_SIZE,
+    MongoHead,
+    MongoRequest,
+    MongoResponse,
+    is_mongo_opcode,
+)
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+
+MAX_BODY = 48 << 20  # mongo's own wire limit
+
+
+class MongoInputMessage(InputMessageBase):
+    __slots__ = ("req",)
+
+    def __init__(self, req: MongoRequest):
+        super().__init__()
+        self.req = req
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    server = arg
+    adaptor = getattr(getattr(server, "options", None),
+                      "mongo_service_adaptor", None)
+    if adaptor is None:
+        return ParseResult.try_others()
+    if len(portal) < HEAD_SIZE:
+        return ParseResult.not_enough()
+    head = MongoHead.unpack(portal.copy_to_bytes(HEAD_SIZE))
+    if (not is_mongo_opcode(head.op_code)
+            or head.message_length < HEAD_SIZE
+            or head.message_length > MAX_BODY):
+        return ParseResult.try_others()
+    if len(portal) < head.message_length:
+        return ParseResult.not_enough()
+    portal.pop_front(HEAD_SIZE)
+    body = portal.cutn_bytes(head.message_length - HEAD_SIZE)
+    # First message on the connection: attach the adaptor's context
+    # (MongoContextMessage role, mongo_protocol.cpp:146-153).
+    if getattr(sock, "mongo_context", None) is None:
+        sock.mongo_context = adaptor.create_socket_context()
+    try:
+        req = MongoRequest(head, body)  # pre-parses OP_QUERY fields
+    except Exception:
+        return ParseResult.error_()  # malformed body: close the connection
+    return ParseResult.ok(MongoInputMessage(req))
+
+
+def process_request(msg: MongoInputMessage):
+    """ProcessMongoRequest analog (mongo_protocol.cpp:173)."""
+    server = msg.arg
+    sock = msg.socket
+    adaptor = server.options.mongo_service_adaptor
+    cntl = Controller()
+    cntl.server = server
+    cntl.remote_side = sock.remote_side
+    cntl._server_socket = sock
+    cntl.server_start_time = time.monotonic()
+    cntl.mongo_session_data = getattr(sock, "mongo_context", None)
+
+    response = MongoResponse()
+    responded = [False]
+
+    def done():
+        if responded[0]:
+            return
+        responded[0] = True
+        if cntl.failed():
+            out = adaptor.serialize_error(msg.req.head.request_id)
+        else:
+            out = response.pack(msg.req.head.request_id,
+                                msg.req.head.request_id)
+        sock.write(IOBuf(out))
+        if cntl.close_connection_flag:
+            sock.set_failed(errors.ECLOSE, "close_connection requested")
+
+    try:
+        adaptor.process_mongo_request(cntl, msg.req, response, done)
+    except Exception as e:
+        if not responded[0]:
+            cntl.set_failed(errors.EINVAL, f"mongo adaptor raised: {e}")
+            done()
+
+
+register_protocol(Protocol(
+    name="mongo",
+    type=ProtocolType.MONGO,
+    parse=parse,
+    process_request=process_request,
+    support_client=False,
+))

@@ -197,7 +197,7 @@ class NsheadPbServiceAdaptor(NsheadService):
 
 register_protocol(Protocol(
     name="nshead",
-    type=ProtocolType.ESP,  # reuse a free slot id for the legacy family
+    type=ProtocolType.NSHEAD,
     parse=parse,
     serialize_request=serialize_request,
     pack_request=pack_request,

@@ -1,0 +1,76 @@
+"""Shared server-side dispatch scaffold for pb-rpc protocols (tpu_std's
+richer path stays inline; hulu/sofa and future legacy framings reuse this):
+service/method lookup, concurrency gate, request decode, handler run with
+a once-only done, exception guard. The per-protocol send_response closure
+owns the wire format.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from brpc_tpu.rpc import compress as compress_mod
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+
+
+def dispatch_pb_request(server, sock, service_name: str, method_name: str,
+                        payload: bytes, compress_type: int,
+                        send_response: Callable,
+                        cntl: Optional[Controller] = None):
+    """Runs the common ProcessXxxRequest sequence; send_response(cntl,
+    response_pb_or_None) is called exactly once (possibly asynchronously,
+    if the handler defers done)."""
+    if cntl is None:
+        cntl = Controller()
+    cntl.server = server
+    cntl.remote_side = sock.remote_side
+    cntl.service_name = service_name
+    cntl.method_name = method_name
+    cntl._server_socket = sock
+    cntl.server_start_time = time.monotonic()
+
+    if server is None:
+        cntl.set_failed(errors.EINVAL, "no server bound to connection")
+        return send_response(cntl, None)
+
+    entry = server.find_method(service_name, method_name)
+    if entry is None:
+        missing_service = server.find_service(service_name) is None
+        cntl.set_failed(
+            errors.ENOSERVICE if missing_service else errors.ENOMETHOD,
+            f"unknown {service_name}.{method_name}")
+        return send_response(cntl, None)
+    service_obj, method_info, method_status = entry
+
+    if not method_status.on_requested():
+        cntl.set_failed(errors.ELIMIT, "reached max_concurrency")
+        return send_response(cntl, None)
+
+    request = method_info.request_class()
+    try:
+        payload = compress_mod.decompress(payload, compress_type)
+        if payload:
+            request.ParseFromString(payload)
+    except Exception as e:
+        method_status.on_response(errors.EREQUEST, cntl.server_start_time)
+        cntl.set_failed(errors.EREQUEST, f"fail to parse request: {e}")
+        return send_response(cntl, None)
+
+    response = method_info.response_class()
+    responded = [False]
+
+    def done():
+        if responded[0]:
+            return
+        responded[0] = True
+        method_status.on_response(cntl.error_code_value,
+                                  cntl.server_start_time)
+        send_response(cntl, response)
+
+    try:
+        method_info.handler(service_obj, cntl, request, response, done)
+    except Exception as e:
+        if not responded[0]:
+            cntl.set_failed(errors.EINVAL, f"method raised: {e}")
+            done()

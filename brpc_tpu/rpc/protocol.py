@@ -26,6 +26,10 @@ class ProtocolType(IntEnum):
     THRIFT = 7
     ESP = 8
     TENSOR = 9  # raw tensor-transport frames (ICI path)
+    NSHEAD = 10  # 36-byte-header legacy family
+    HULU = 11  # hulu_pbrpc
+    SOFA = 12  # sofa_pbrpc
+    MONGO = 13  # mongo wire protocol (server adaptor)
 
 
 class ParseError(IntEnum):
@@ -130,10 +134,14 @@ _PARSE_PRIORITY = {
     ProtocolType.TENSOR: 2,
     ProtocolType.HTTP: 3,
     ProtocolType.H2: 4,
-    ProtocolType.REDIS: 5,
-    ProtocolType.MEMCACHE: 6,
-    ProtocolType.THRIFT: 7,
-    ProtocolType.ESP: 8,  # nshead family — last: weakest magic
+    ProtocolType.HULU: 5,
+    ProtocolType.SOFA: 6,
+    ProtocolType.REDIS: 7,
+    ProtocolType.MEMCACHE: 8,
+    ProtocolType.THRIFT: 9,
+    ProtocolType.MONGO: 10,  # weak magic (length+opcode), adaptor-gated
+    ProtocolType.NSHEAD: 11,  # weak magic (checks 0xfb709394 at offset 24)
+    ProtocolType.ESP: 12,  # last — zero magic, only when server opted in
 }
 
 
@@ -161,3 +169,7 @@ def globally_initialize():
     from brpc_tpu.rpc import h2_protocol  # noqa: F401
     from brpc_tpu.rpc import thrift_protocol  # noqa: F401
     from brpc_tpu.rpc import nshead_protocol  # noqa: F401
+    from brpc_tpu.rpc import hulu_protocol  # noqa: F401
+    from brpc_tpu.rpc import sofa_protocol  # noqa: F401
+    from brpc_tpu.rpc import mongo_protocol  # noqa: F401
+    from brpc_tpu.rpc import esp_protocol  # noqa: F401

@@ -42,6 +42,11 @@ def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
         return ParseResult.not_enough()
     head = portal.copy_to_bytes(1)
     server_side = arg is not None and getattr(arg, "redis_service", None)
+    if arg is not None and not server_side:
+        # Serving port without a RedisService: don't claim bytes that may
+        # belong to a weak-magic protocol behind us (the reference's
+        # ParseRedisMessage also bails when redis_service is unset).
+        return ParseResult.try_others()
     if head not in (b"*", b"+", b"-", b":", b"$"):
         return ParseResult.try_others()
     data = portal.copy_to_bytes()

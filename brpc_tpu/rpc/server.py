@@ -51,6 +51,11 @@ class ServerOptions:
     thrift_service: Optional[object] = None
     # server speaks nshead when set (NsheadService adaptor role)
     nshead_service: Optional[object] = None
+    # server speaks mongo wire protocol when set (MongoServiceAdaptor role,
+    # mongo_service_adaptor.h:27)
+    mongo_service_adaptor: Optional[object] = None
+    # server speaks esp when set (our extension; reference is client-only)
+    esp_service: Optional[object] = None
     # TLS (ServerSSLOptions role): PEM paths; empty = plaintext
     ssl_certfile: str = ""
     ssl_keyfile: str = ""

@@ -1,0 +1,180 @@
+"""sofa_pbrpc — the sofa-pbrpc protocol, wire-compatible.
+
+Counterpart of /root/reference/src/brpc/policy/sofa_pbrpc_protocol.cpp:
+24-byte header `"SOFA" + u32le(meta_size) + u64le(body_size) +
+u64le(meta_size+body_size)` (PackSofaHeader, :132-138), then one
+SofaRpcMeta protobuf — shared by both directions and discriminated by its
+`type` field (sofa_pbrpc_meta.proto:43) — then the payload. Correlation is
+`sequence_id`; methods travel as full names ("pkg.Service.Method").
+"""
+from __future__ import annotations
+
+import struct
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import compress as compress_mod
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.pb_dispatch import dispatch_pb_request
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+from brpc_tpu.rpc.proto import legacy_meta_pb2
+
+MAGIC = b"SOFA"
+HEADER_LEN = 24
+MAX_BODY = 64 << 20
+MAX_META = 1 << 20
+
+_pb = legacy_meta_pb2
+
+# SofaCompressType (sofa_pbrpc_meta.proto:26-32) -> registry codes
+_FROM_SOFA = {_pb.SOFA_COMPRESS_TYPE_NONE: compress_mod.COMPRESS_NONE,
+              _pb.SOFA_COMPRESS_TYPE_GZIP: compress_mod.COMPRESS_GZIP,
+              _pb.SOFA_COMPRESS_TYPE_ZLIB: compress_mod.COMPRESS_ZLIB,
+              _pb.SOFA_COMPRESS_TYPE_SNAPPY: compress_mod.COMPRESS_SNAPPY}
+_TO_SOFA = {v: k for k, v in _FROM_SOFA.items()}
+
+
+class SofaMessage(InputMessageBase):
+    __slots__ = ("meta", "payload", "is_request")
+
+    def __init__(self, meta, payload: bytes):
+        super().__init__()
+        self.meta = meta
+        self.payload = payload
+        self.is_request = meta.type == _pb.SofaRpcMeta.REQUEST
+
+
+def _pack_frame(meta, payload: bytes) -> IOBuf:
+    meta_bytes = meta.SerializeToString()
+    out = IOBuf()
+    out.append(MAGIC + struct.pack("<IQQ", len(meta_bytes), len(payload),
+                                   len(meta_bytes) + len(payload)))
+    out.append(meta_bytes)
+    if payload:
+        out.append(payload)
+    return out
+
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    if len(portal) < HEADER_LEN:
+        head = portal.copy_to_bytes(min(4, len(portal)))
+        if MAGIC.startswith(head):
+            return ParseResult.not_enough()
+        return ParseResult.try_others()
+    header = portal.copy_to_bytes(HEADER_LEN)
+    if header[:4] != MAGIC:
+        return ParseResult.try_others()
+    meta_size, body_size, msg_size = struct.unpack("<IQQ", header[4:24])
+    if msg_size != meta_size + body_size:
+        return ParseResult.try_others()
+    if body_size > MAX_BODY or meta_size > MAX_META:
+        return ParseResult.error_()
+    if len(portal) < HEADER_LEN + msg_size:
+        return ParseResult.not_enough()
+    portal.pop_front(HEADER_LEN)
+    meta_bytes = portal.cutn_bytes(meta_size)
+    payload = portal.cutn_bytes(body_size)
+    meta = _pb.SofaRpcMeta()
+    try:
+        meta.ParseFromString(meta_bytes)
+    except Exception:
+        return ParseResult.error_()
+    return ParseResult.ok(SofaMessage(meta, payload))
+
+
+def serialize_request(request, cntl: Controller):
+    if request is None:
+        return b""
+    if isinstance(request, (bytes, bytearray)):
+        return bytes(request)
+    return request.SerializeToString()
+
+
+def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf:
+    meta = _pb.SofaRpcMeta()
+    meta.type = _pb.SofaRpcMeta.REQUEST
+    meta.sequence_id = correlation_id
+    meta.method = cntl._method_full_name
+    if cntl.compress_type:
+        meta.compress_type = _TO_SOFA.get(cntl.compress_type,
+                                          _pb.SOFA_COMPRESS_TYPE_NONE)
+    payload = compress_mod.compress(payload, cntl.compress_type)
+    return _pack_frame(meta, payload)
+
+
+def process_response(msg: SofaMessage):
+    meta = msg.meta
+    cid = meta.sequence_id
+    try:
+        cntl = bthread_id.lock(cid)
+    except (KeyError, TimeoutError):
+        return
+    if not isinstance(cntl, Controller):
+        try:
+            bthread_id.unlock(cid)
+        except Exception:
+            pass
+        return
+    try:
+        if meta.failed:
+            cntl.set_failed(meta.error_code or errors.EINVAL,
+                            meta.reason or "sofa rpc failed")
+        else:
+            payload = compress_mod.decompress(
+                msg.payload, _FROM_SOFA.get(meta.compress_type, 0))
+            resp = cntl._response
+            if resp is not None and payload:
+                resp.ParseFromString(payload)
+    except Exception as e:
+        cntl.set_failed(errors.ERESPONSE, f"fail to parse response: {e}")
+    cntl._end_rpc_locked_or_not(locked=True)
+
+
+def _send_response(sock, seq: int, cntl: Controller, response):
+    meta = _pb.SofaRpcMeta()
+    meta.type = _pb.SofaRpcMeta.RESPONSE
+    meta.sequence_id = seq
+    if cntl.failed():
+        meta.failed = True
+        meta.error_code = cntl.error_code_value
+        meta.reason = cntl.error_text_value
+        payload = b""
+    else:
+        payload = (response.SerializeToString()
+                   if response is not None else b"")
+        if cntl.compress_type:
+            meta.compress_type = _TO_SOFA.get(cntl.compress_type, 0)
+            payload = compress_mod.compress(payload, cntl.compress_type)
+    sock.write(_pack_frame(meta, payload))
+    if cntl.close_connection_flag:
+        sock.set_failed(errors.ECLOSE, "close_connection requested")
+
+
+def process_request(msg: SofaMessage):
+    server = msg.arg
+    meta = msg.meta
+    seq = meta.sequence_id
+    sock = msg.socket
+    service_name, _, method_name = meta.method.rpartition(".")
+    dispatch_pb_request(
+        server, sock, service_name, method_name, msg.payload,
+        _FROM_SOFA.get(meta.compress_type, 0),
+        lambda c, response: _send_response(sock, seq, c, response))
+
+
+register_protocol(Protocol(
+    name="sofa_pbrpc",
+    type=ProtocolType.SOFA,
+    parse=parse,
+    serialize_request=serialize_request,
+    pack_request=pack_request,
+    process_request=process_request,
+    process_response=process_response,
+))

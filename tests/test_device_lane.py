@@ -3,6 +3,7 @@ cross-machine semantics, exercised across a real process boundary):
 HostArena span accounting, the IOBuf blockmem seam, and a two-process
 push/pull where tensor payloads ride the shared arena — NOT the TCP wire —
 with retention-until-ACK observed on both sides."""
+import os
 import subprocess
 import sys
 import time
@@ -67,9 +68,10 @@ def test_iobuf_blockmem_seam():
 
 @pytest.fixture
 def remote_store():
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.Popen([sys.executable, "-c", SERVER_SCRIPT],
                             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                            text=True, cwd="/root/repo")
+                            text=True, cwd=repo_root)
     port = int(proc.stdout.readline())
     yield port
     proc.stdin.close()

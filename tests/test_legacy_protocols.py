@@ -1,0 +1,216 @@
+"""Legacy protocol family tests — hulu_pbrpc, sofa_pbrpc, mongo server
+adaptor, esp — loopback in one process, the brpc_*_protocol_unittest.cpp
+pattern. All four join the multi-protocol port alongside tpu_std.
+"""
+import socket as pysocket
+import struct
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.esp_protocol import EspMessage, EspService
+from brpc_tpu.rpc.mongo import (
+    HEAD_SIZE,
+    MongoHead,
+    MongoResponse,
+    MongoServiceAdaptor,
+    OP_QUERY,
+    OP_REPLY,
+    bson_decode,
+    bson_encode,
+)
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        if request.code:
+            cntl.set_failed(request.code, "requested failure")
+            done()
+            return
+        response.message = request.message
+        done()
+
+
+class PingAdaptor(MongoServiceAdaptor):
+    def __init__(self):
+        self.contexts_created = 0
+
+    def create_socket_context(self):
+        self.contexts_created += 1
+        return {"n": self.contexts_created}
+
+    def process_mongo_request(self, cntl, request, response, done):
+        if request.query and "ping" in request.query:
+            response.documents = [{"ok": 1.0}]
+        else:
+            response.documents = [{"you_said": request.collection, "ok": 1.0}]
+        done()
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = rpc.Server(rpc.ServerOptions(
+        num_threads=4,
+        mongo_service_adaptor=PingAdaptor(),
+        esp_service=EspService(),
+    ))
+    assert srv.add_service(EchoService()) == 0
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+    srv.join(1)
+
+
+def _echo_check(server, protocol, msg="hi legacy"):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol=protocol))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    cntl, resp = ch.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message=msg),
+                         echo_pb2.EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == msg
+    ch.close()
+
+
+def test_hulu_echo(server):
+    _echo_check(server, "hulu_pbrpc")
+
+
+def test_sofa_echo(server):
+    _echo_check(server, "sofa_pbrpc")
+
+
+def test_hulu_error_propagates(server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="hulu_pbrpc"))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    cntl, _ = ch.call("EchoService.Echo",
+                      echo_pb2.EchoRequest(message="x", code=42),
+                      echo_pb2.EchoResponse)
+    assert cntl.failed() and cntl.error_code_value == 42
+    # unknown method -> ENOMETHOD from the server
+    cntl2, _ = ch.call("EchoService.Nope",
+                       echo_pb2.EchoRequest(message="x"),
+                       echo_pb2.EchoResponse)
+    assert cntl2.failed() and cntl2.error_code_value == errors.ENOMETHOD
+    ch.close()
+
+
+def test_sofa_error_propagates(server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="sofa_pbrpc"))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    cntl, _ = ch.call("NoSuchService.Echo",
+                      echo_pb2.EchoRequest(message="x"),
+                      echo_pb2.EchoResponse)
+    assert cntl.failed() and cntl.error_code_value == errors.ENOSERVICE
+    ch.close()
+
+
+def test_hulu_many_pipelined(server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="hulu_pbrpc"))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    for i in range(30):
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message=f"m{i}"),
+                             echo_pb2.EchoResponse)
+        assert not cntl.failed() and resp.message == f"m{i}"
+    ch.close()
+
+
+def test_bson_roundtrip():
+    doc = {"s": "str", "i": 5, "big": 1 << 40, "f": 2.5, "b": True,
+           "n": None, "sub": {"k": "v"}, "arr": [1, "two", 3.0],
+           "bin": b"\x00\x01\x02"}
+    enc = bson_encode(doc)
+    dec, end = bson_decode(enc)
+    assert end == len(enc)
+    assert dec == doc
+
+
+def _mongo_query(port, collection, query_doc, request_id=7):
+    """A raw OP_QUERY client (what a mongo driver sends)."""
+    body = struct.pack("<i", 0) + collection.encode() + b"\x00"
+    body += struct.pack("<ii", 0, 1) + bson_encode(query_doc)
+    head = MongoHead(HEAD_SIZE + len(body), request_id, 0, OP_QUERY)
+    with pysocket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.sendall(head.pack() + body)
+        raw = b""
+        while len(raw) < HEAD_SIZE:
+            raw += s.recv(4096)
+        rhead = MongoHead.unpack(raw)
+        while len(raw) < rhead.message_length:
+            raw += s.recv(4096)
+    assert rhead.op_code == OP_REPLY
+    assert rhead.response_to == request_id
+    flags, cursor, start, nret = struct.unpack_from("<iqii", raw, HEAD_SIZE)
+    doc, _ = bson_decode(raw, HEAD_SIZE + 20)
+    return flags, nret, doc
+
+
+def test_mongo_ping(server):
+    port = server.listen_endpoint.port
+    flags, nret, doc = _mongo_query(port, "admin.$cmd", {"ping": 1})
+    assert flags == 0 and nret == 1
+    assert doc == {"ok": 1.0}
+
+
+def test_mongo_context_attached(server):
+    adaptor = server.options.mongo_service_adaptor
+    before = adaptor.contexts_created
+    _mongo_query(server.listen_endpoint.port, "db.c", {"find": "c"})
+    assert adaptor.contexts_created == before + 1  # one context per conn
+
+
+def test_esp_roundtrip(server):
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="esp",
+                                        connection_type="pooled"))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    req = EspMessage(b"esp payload", to_addr=9, msg=3, msg_id=77)
+    cntl, resp = ch.call("esp.msg", req, EspMessage)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.body == b"esp payload"
+    assert resp.msg_id == 77
+    ch.close()
+
+
+def test_legacy_protocols_share_port_with_tpu_std(server):
+    """hulu + sofa + tpu_std + mongo + esp all on ONE port."""
+    _echo_check(server, "hulu_pbrpc", "via hulu")
+    _echo_check(server, "sofa_pbrpc", "via sofa")
+    _echo_check(server, "tpu_std", "via std")
+    _, _, doc = _mongo_query(server.listen_endpoint.port, "x", {"ping": 1})
+    assert doc["ok"] == 1.0
+
+
+def test_snappy_codec():
+    from brpc_tpu.rpc import compress as c
+
+    for data in (b"", b"a", b"abc", b"x" * 100000,
+                 b"the quick brown fox " * 500,
+                 bytes(range(256)) * 40):
+        enc = c.snappy_compress(data)
+        assert c.snappy_decompress(enc) == data
+    # repetitive data actually compresses
+    rep = b"hello world, hello world! " * 1000
+    assert len(c.snappy_compress(rep)) < len(rep) // 4
+    # corrupt offsets rejected
+    with pytest.raises(ValueError):
+        c.snappy_decompress(b"\x05\x09\x00\x01")
+
+
+@pytest.mark.parametrize("protocol", ["hulu_pbrpc", "sofa_pbrpc", "tpu_std"])
+@pytest.mark.parametrize("ctype", [1, 2, 3])  # gzip, zlib, snappy
+def test_compression_negotiation(server, protocol, ctype):
+    """Per-protocol compression: request+response ride the negotiated
+    codec (hulu/sofa remap to their own enum values on the wire)."""
+    ch = rpc.Channel(rpc.ChannelOptions(protocol=protocol))
+    assert ch.init(str(server.listen_endpoint)) == 0
+    msg = "compress me " * 200
+    cntl, resp = ch.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message=msg),
+                         echo_pb2.EchoResponse, compress_type=ctype)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == msg
+    ch.close()
