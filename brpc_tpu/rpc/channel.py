@@ -209,8 +209,17 @@ class Channel:
         """Returns (Socket, rc). Applies LB if configured, then the
         connection type (controller.cpp:1048-1112)."""
         if self._lb is not None:
+            # Cluster-recover gate (load_balancer_with_naming wiring of
+            # cluster_recover_policy.h): while recovering, shed load in
+            # proportion to how much of the cluster is back.
+            policy = getattr(self._lb, "cluster_recover_policy", None)
+            if (policy is not None and policy.stop_recover_if_necessary()
+                    and policy.do_reject(self._lb.server_ids())):
+                return None, errors.EREJECT
             sid = self._lb.select_server(exclude=cntl._excluded_sids)
             if sid is None:
+                if policy is not None:
+                    policy.start_recover()
                 return None, errors.EFAILEDSOCKET
             cntl._lb = self._lb
             main_sock = Socket.address(sid)
@@ -306,8 +315,15 @@ class Channel:
             if not sock.failed():
                 sock.set_failed(errors.ECLOSE, "short connection done")
         elif sock.connection_type == "pooled" and not sock.failed():
-            with self._pool_lock:
-                self._socket_pool.append(sock)
+            can_repool = self._protocol.extra.get("can_repool")
+            if can_repool is not None and not can_repool(sock):
+                # e.g. esp after a timeout: a response is still owed on
+                # this connection and could complete the WRONG later RPC.
+                sock.set_failed(errors.ECLOSE,
+                                "unconsumed in-flight response")
+            else:
+                with self._pool_lock:
+                    self._socket_pool.append(sock)
         if self.options.enable_circuit_breaker:
             self._feed_circuit_breaker(sock, cntl)
 

@@ -269,35 +269,33 @@ class DynamicPartitionChannel:
             schemes = sorted(found)
         if not schemes:
             return errors.EINVAL
+        # Scheme selection rides the real _dynpart LB policy (the reference
+        # wires DynamicPartitionChannel through a SelectiveChannel whose LB
+        # is "_dynpart", partition_channel.cpp:462): members are scheme
+        # handles, weight = live server capacity of that scheme.
+        from brpc_tpu.rpc.load_balancer import create_load_balancer
+
+        self._dynlb = create_load_balancer("_dynpart")
+        self._dynlb.set_capacity_fn(self._scheme_capacity)
         for total in schemes:
             pc = PartitionChannel(self.fail_limit)
             rc = pc.init(total, naming_url, lb_name, self._parser, options)
             if rc != 0:
                 return rc
             self._schemes[total] = pc
+            self._dynlb.add_server(total)  # sid = scheme handle
         return 0
 
-    def _pick_scheme(self) -> Optional[PartitionChannel]:
-        import random
+    def _scheme_capacity(self, total: int) -> int:
+        pc = self._schemes.get(total)
+        if pc is None:
+            return 0
+        return sum(ch._lb.server_count() for ch, _, _ in pc._subs
+                   if ch._lb is not None)
 
-        with self._lock:
-            weighted = []
-            for total, pc in self._schemes.items():
-                capacity = sum(
-                    ch._lb.server_count() for ch, _, _ in pc._subs
-                    if ch._lb is not None
-                )
-                if capacity > 0:
-                    weighted.append((capacity, pc))
-            if not weighted:
-                return None
-            x = random.uniform(0, sum(w for w, _ in weighted))
-            acc = 0.0
-            for w, pc in weighted:
-                acc += w
-                if x <= acc:
-                    return pc
-            return weighted[-1][1]
+    def _pick_scheme(self) -> Optional[PartitionChannel]:
+        total = self._dynlb.select_server()
+        return self._schemes.get(total) if total is not None else None
 
     def call_method(self, method: str, cntl: Controller, request, response,
                     done: Optional[Callable] = None):

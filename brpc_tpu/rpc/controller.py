@@ -166,14 +166,16 @@ class Controller:
     def current_attempt_id(self) -> int:
         return self._call_id + 1 + self.retried_count
 
-    def issue_rpc(self):
+    def issue_rpc(self, locked: bool = False):
         """LB select → socket → pack → write → arm timers
-        (Controller::IssueRPC, controller.cpp:1010-1207)."""
+        (Controller::IssueRPC, controller.cpp:1010-1207). `locked` says
+        whether the caller already holds the CallId lock (the retry/backup
+        branches of _on_error do) so failure paths don't self-deadlock."""
         channel = self._channel
         sock, rc = channel._select_socket(self)
         if rc != 0 or sock is None:
             self.set_failed(rc or errors.EFAILEDSOCKET, "no usable server")
-            self._end_rpc_locked_or_not(locked=False)
+            self._end_rpc_locked_or_not(locked=locked)
             return
         self._current_sock = sock
         self._accessed_sids.add(sock.socket_id)
@@ -187,7 +189,7 @@ class Controller:
             # e.g. authenticator refused, or esp poisoning a socket with an
             # unconsumed in-flight response — fail the RPC cleanly.
             self.set_failed(errors.EREQUEST, f"fail to pack request: {e}")
-            self._end_rpc_locked_or_not(locked=False)
+            self._end_rpc_locked_or_not(locked=locked)
             return
         # Pipelined-protocol correlation entries are pushed atomically with
         # the queue append (on_queued runs under the socket's write lock),
@@ -230,8 +232,11 @@ class Controller:
                 self.retried_count += 1
                 self.has_backup_request = True
                 _backup_count.update(1)
-                self.issue_rpc()
-            bthread_id.unlock(idv)
+                self.issue_rpc(locked=True)
+            try:
+                bthread_id.unlock(idv)
+            except (KeyError, RuntimeError):
+                pass  # issue_rpc failed synchronously and ended the RPC
             return
         self.set_failed(error_code, error_text)
         if (error_code != errors.ERPCTIMEDOUT
@@ -245,8 +250,11 @@ class Controller:
                 self._excluded_sids.add(self._current_sock.socket_id)
             self.error_code_value = 0
             self.error_text_value = ""
-            self.issue_rpc()
-            bthread_id.unlock(idv)
+            self.issue_rpc(locked=True)
+            try:
+                bthread_id.unlock(idv)
+            except (KeyError, RuntimeError):
+                pass  # issue_rpc failed synchronously and ended the RPC
             return
         self._end_rpc_locked_or_not(locked=True)
 

@@ -26,6 +26,7 @@ EEOF = 1014  # stream EOF
 EUNUSED = 1015
 ESSL = 1016
 EPROTONOTSUP = 1017  # protocol not supported / mismatch
+EREJECT = 1018  # request rejected (cluster recovering, errno.proto:43)
 EOVERLOAD = 1019  # concurrency limit rejected the request
 ELIMIT = 2004  # reached max_concurrency
 ECLOSE = 2005  # connection closed by peer

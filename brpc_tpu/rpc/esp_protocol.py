@@ -177,4 +177,10 @@ register_protocol(Protocol(
     process_response=process_response,
     supported_connection_types=("pooled", "short"),
     process_inline=True,
+    extra={
+        # Don't return a socket to the pool while its response is still
+        # owed (RPC timed out / cancelled before process_response ran).
+        "can_repool":
+            lambda sock: getattr(sock, "esp_correlation_id", None) is None,
+    },
 ))

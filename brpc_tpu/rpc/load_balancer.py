@@ -255,6 +255,44 @@ class LocalityAwareLB(LoadBalancer):
             st[0] = (1 - self._EMA_ALPHA) * st[0] + self._EMA_ALPHA * sample
 
 
+class DynPartLB(LoadBalancer):
+    """_dynpart (policy/dynpart_load_balancer.cpp): selection weighted by
+    each member's DYNAMIC capacity — in the reference, the sub-channel
+    weight of the SelectiveChannel member (schan::GetSubChannelWeight);
+    here a capacity callback installed by DynamicPartitionChannel. Members
+    are scheme handles, not sockets, so liveness = capacity > 0."""
+
+    name = "_dynpart"
+
+    def __init__(self):
+        super().__init__()
+        self._capacity_fn = lambda sid: 1
+
+    def set_capacity_fn(self, fn):
+        self._capacity_fn = fn
+
+    def select_server(self, exclude=None, request_code: int = 0):
+        # weighted random by live capacity (dynpart_load_balancer.cpp:
+        # 104-157 total_weight walk + fast_rand_less_than); capacities are
+        # sampled ONCE so a concurrent NS update cannot skew the pick.
+        with self._dbd.read() as lst:
+            pairs = [(n.sid, self._capacity_fn(n.sid)) for n in lst]
+        pairs = [(sid, c) for sid, c in pairs if c > 0]
+        if exclude:
+            filtered = [(sid, c) for sid, c in pairs if sid not in exclude]
+            if filtered:
+                pairs = filtered
+        if not pairs:
+            return None
+        x = random.uniform(0, sum(c for _, c in pairs))
+        acc = 0.0
+        for sid, c in pairs:
+            acc += c
+            if x <= acc:
+                return sid
+        return pairs[-1][0]
+
+
 _registry = {
     "rr": RoundRobinLB,
     "wrr": WeightedRoundRobinLB,
@@ -263,6 +301,7 @@ _registry = {
     "c_murmurhash": ConsistentHashLB,
     "c_md5": ConsistentHashLB,
     "la": LocalityAwareLB,
+    "_dynpart": DynPartLB,
 }
 
 
@@ -272,5 +311,19 @@ def register_load_balancer(name: str, cls):
 
 
 def create_load_balancer(name: str) -> Optional[LoadBalancer]:
-    cls = _registry.get(name)
-    return cls() if cls else None
+    """'name' or 'name:params' — params currently carry the cluster
+    recover policy (load_balancer.h GetRecoverPolicyByParams wiring),
+    e.g. 'rr:min_working_instances=2 hold_seconds=3'."""
+    base, _, params = name.partition(":")
+    cls = _registry.get(base)
+    if cls is None:
+        return None
+    lb = cls()
+    lb.cluster_recover_policy = None
+    if params:
+        from brpc_tpu.rpc.cluster_recover import recover_policy_from_params
+
+        lb.cluster_recover_policy = recover_policy_from_params(params)
+        if lb.cluster_recover_policy is None:
+            return None  # malformed params reject init (reference behavior)
+    return lb

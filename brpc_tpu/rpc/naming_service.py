@@ -108,11 +108,146 @@ class DnsNamingService(NamingService):
         return out
 
 
+def _http_get_json(authority: str, path: str, timeout_s: float = 3.0):
+    """GET http://authority/path -> parsed JSON (None on any failure).
+    The HTTP-backed naming services (consul/discovery/nacos/remotefile)
+    poll registry endpoints this way."""
+    import json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(f"http://{authority}{path}",
+                                    timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+class ConsulNamingService(NamingService):
+    """consul://host:port/service-name — polls Consul's health endpoint
+    (policy/consul_naming_service.cpp: /v1/health/service/<name> with
+    passing+stale, addresses from Service.Address/Port, tags kept)."""
+
+    name = "consul"
+    refresh_interval_s = 2.0
+
+    def get_servers(self, service_path: str) -> List[NodeSpec]:
+        authority, _, service = service_path.partition("/")
+        data = _http_get_json(
+            authority, f"/v1/health/service/{service}?stale&passing")
+        out: List[NodeSpec] = []
+        if not isinstance(data, list):
+            return out
+        for entry in data:
+            try:
+                svc = entry["Service"]
+                ep = EndPoint(svc["Address"], int(svc["Port"]))
+                tags = svc.get("Tags") or []
+                out.append((ep, 1, tags[0] if tags else ""))
+            except (KeyError, TypeError, ValueError):
+                continue
+        return out
+
+
+class DiscoveryNamingService(NamingService):
+    """discovery://host:port/appid — the bilibili discovery shape
+    (policy/discovery_naming_service.cpp): /discovery/fetchs returns
+    zone->instances with addrs like 'grpc://ip:port'."""
+
+    name = "discovery"
+    refresh_interval_s = 2.0
+
+    def get_servers(self, service_path: str) -> List[NodeSpec]:
+        authority, _, appid = service_path.partition("/")
+        data = _http_get_json(
+            authority, f"/discovery/fetchs?appid={appid}&status=1")
+        out: List[NodeSpec] = []
+        try:
+            instances = data["data"][appid]["instances"]
+        except (KeyError, TypeError):
+            return out
+        for inst in instances:
+            for addr in inst.get("addrs", []):
+                _, _, hostport = addr.rpartition("://")
+                try:
+                    out.append((EndPoint.parse(hostport), 1, ""))
+                except ValueError:
+                    continue
+        return out
+
+
+class NacosNamingService(NamingService):
+    """nacos://host:port/serviceName — polls the Nacos instance list
+    (policy/nacos_naming_service.cpp: /nacos/v1/ns/instance/list, healthy
+    hosts with ip/port/weight)."""
+
+    name = "nacos"
+    refresh_interval_s = 2.0
+
+    def get_servers(self, service_path: str) -> List[NodeSpec]:
+        authority, _, service = service_path.partition("/")
+        data = _http_get_json(
+            authority,
+            f"/nacos/v1/ns/instance/list?serviceName={service}&healthyOnly=true")
+        out: List[NodeSpec] = []
+        if not isinstance(data, dict):
+            return out
+        for host in data.get("hosts", []):
+            try:
+                if not host.get("enabled", True):
+                    continue
+                out.append((EndPoint(host["ip"], int(host["port"])),
+                            max(1, int(float(host.get("weight", 1)))), ""))
+            except (KeyError, TypeError, ValueError):
+                continue
+        return out
+
+
+class RemoteFileNamingService(NamingService):
+    """remotefile://host:port/path — fetches a server-list file over HTTP
+    and parses it with the file NS grammar
+    (policy/remotefile_naming_service.cpp)."""
+
+    name = "remotefile"
+    refresh_interval_s = 2.0
+
+    def get_servers(self, service_path: str) -> List[NodeSpec]:
+        import urllib.request
+
+        authority, _, path = service_path.partition("/")
+        try:
+            with urllib.request.urlopen(f"http://{authority}/{path}",
+                                        timeout=3.0) as r:
+                text = r.read().decode()
+        except Exception:
+            return []
+        out: List[NodeSpec] = []
+        for line in text.splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            weight, tag = 1, ""
+            if " " in line:
+                line, _, tag = line.partition(" ")
+                tag = tag.strip()
+                if tag.isdigit():
+                    weight, tag = int(tag), ""
+            try:
+                out.append((EndPoint.parse(line), weight, tag))
+            except ValueError:
+                continue
+        return out
+
+
 _ns_registry: Dict[str, Callable[[], NamingService]] = {
     "list": ListNamingService,
     "file": FileNamingService,
     "dns": DnsNamingService,
     "http": DnsNamingService,
+    "consul": ConsulNamingService,
+    "discovery": DiscoveryNamingService,
+    "nacos": NacosNamingService,
+    "remotefile": RemoteFileNamingService,
 }
 
 

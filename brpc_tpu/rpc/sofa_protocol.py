@@ -163,6 +163,13 @@ def process_request(msg: SofaMessage):
     seq = meta.sequence_id
     sock = msg.socket
     service_name, _, method_name = meta.method.rpartition(".")
+    if (server is not None and service_name
+            and server.find_service(service_name) is None):
+        # Stock sofa clients send the package-qualified descriptor name
+        # ("pkg.EchoService.Echo"); our registry holds class names.
+        unqualified = service_name.rpartition(".")[2]
+        if server.find_service(unqualified) is not None:
+            service_name = unqualified
     dispatch_pb_request(
         server, sock, service_name, method_name, msg.payload,
         _FROM_SOFA.get(meta.compress_type, 0),

@@ -1,0 +1,185 @@
+"""Client-fabric breadth tests: HTTP-backed naming services
+(consul/discovery/nacos/remotefile), the _dynpart LB, and the cluster
+recover policy — the brpc_naming_service_unittest.cpp pattern with a local
+HTTP registry double.
+"""
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.cluster_recover import (
+    DefaultClusterRecoverPolicy,
+    recover_policy_from_params,
+)
+from brpc_tpu.rpc.load_balancer import create_load_balancer
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=2))
+    assert srv.add_service(EchoService()) == 0
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+    srv.join(1)
+
+
+@pytest.fixture(scope="module")
+def registry(echo_server):
+    """An HTTP registry double answering consul/discovery/nacos/remotefile
+    queries, all pointing at the echo server."""
+    ep = echo_server.listen_endpoint
+    addr, port = ep.ip, ep.port
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.startswith("/v1/health/service/"):
+                body = json.dumps([{ "Service": {
+                    "Address": addr, "Port": port, "Tags": ["0/1"]}}])
+            elif self.path.startswith("/discovery/fetchs"):
+                body = json.dumps({"data": {"echo.app": {"instances": [
+                    {"addrs": [f"grpc://{addr}:{port}"]}]}}})
+            elif self.path.startswith("/nacos/v1/ns/instance/list"):
+                body = json.dumps({"hosts": [
+                    {"ip": addr, "port": port, "weight": 2.0,
+                     "healthy": True, "enabled": True}]})
+            elif self.path.startswith("/files/"):
+                body = f"{addr}:{port}\n# comment line\n"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            raw = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield httpd.server_address[1]
+    httpd.shutdown()
+
+
+@pytest.mark.parametrize("url_fmt", [
+    "consul://127.0.0.1:{p}/echo",
+    "discovery://127.0.0.1:{p}/echo.app",
+    "nacos://127.0.0.1:{p}/echo",
+    "remotefile://127.0.0.1:{p}/files/servers.txt",
+])
+def test_http_naming_services(registry, url_fmt):
+    ch = rpc.Channel()
+    assert ch.init(url_fmt.format(p=registry), "rr") == 0
+    cntl, resp = ch.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message="via ns"),
+                         echo_pb2.EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == "via ns"
+    ch.close()
+
+
+def test_ns_parsers_reject_garbage():
+    """Unreachable registries / malformed replies resolve to empty lists,
+    never raise (the NS thread must survive registry outages)."""
+    from brpc_tpu.rpc import naming_service as ns
+
+    for cls, path in [(ns.ConsulNamingService, "127.0.0.1:1/none"),
+                      (ns.DiscoveryNamingService, "127.0.0.1:1/none"),
+                      (ns.NacosNamingService, "127.0.0.1:1/none"),
+                      (ns.RemoteFileNamingService, "127.0.0.1:1/none")]:
+        assert cls().get_servers(path) == []
+
+
+def test_dynpart_lb_weights_by_capacity():
+    lb = create_load_balancer("_dynpart")
+    caps = {10: 3, 20: 1, 30: 0}
+    lb.set_capacity_fn(lambda sid: caps[sid])
+    for sid in caps:
+        lb.add_server(sid)
+    picks = [lb.select_server() for _ in range(400)]
+    assert 30 not in picks  # capacity 0 never chosen
+    n10 = picks.count(10)
+    n20 = picks.count(20)
+    assert n10 + n20 == 400
+    assert n10 > n20  # 3:1 expected ratio, loosely checked
+    caps[10] = 0
+    caps[20] = 0
+    assert lb.select_server() is None
+
+
+def test_recover_policy_params():
+    p = recover_policy_from_params("min_working_instances=2 hold_seconds=3")
+    assert isinstance(p, DefaultClusterRecoverPolicy)
+    assert recover_policy_from_params("hold_seconds=3") is None
+    assert create_load_balancer("rr:bogus") is None
+    lb = create_load_balancer("rr:min_working_instances=2 hold_seconds=3")
+    assert lb is not None and lb.cluster_recover_policy is not None
+
+
+def test_recover_policy_rejects_then_heals(monkeypatch):
+    policy = DefaultClusterRecoverPolicy(min_working_instances=4,
+                                         hold_seconds=0.2)
+    # healthy: no rejects
+    assert not policy.do_reject([])
+    policy.start_recover()
+    assert policy.stop_recover_if_necessary()
+
+    # all servers down -> everything rejected (usable=0)
+    monkeypatch.setattr(policy, "_usable_count", lambda now, ids: 0)
+    assert all(policy.do_reject([1, 2]) for _ in range(50))
+
+    # half back -> some pass, some rejected
+    policy._usable_cache_t = 0.0
+    monkeypatch.setattr(policy, "_usable_count", lambda now, ids: 2)
+    results = [policy.do_reject([1, 2]) for _ in range(200)]
+    assert any(results) and not all(results)
+
+    # stable usable count for hold_seconds -> recovery ends
+    time.sleep(0.25)
+    assert not policy.stop_recover_if_necessary()
+    assert not policy.recovering
+    assert not policy.do_reject([1, 2])
+
+
+def test_channel_enters_recovery_when_cluster_down(echo_server):
+    """End-to-end: LB with recover params; all sockets failed -> select
+    triggers start_recover; subsequent calls see EREJECT or fail-fast."""
+    ep = echo_server.listen_endpoint
+    ch = rpc.Channel()
+    assert ch.init(f"list://{ep.ip}:{ep.port}",
+                   "rr:min_working_instances=1 hold_seconds=0.1") == 0
+    cntl, resp = ch.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message="ok"),
+                         echo_pb2.EchoResponse)
+    assert not cntl.failed()
+
+    policy = ch._lb.cluster_recover_policy
+    assert policy is not None and not policy.recovering
+    # kill every server socket the NS created
+    from brpc_tpu.rpc.socket import Socket
+
+    for sid in ch._lb.server_ids():
+        Socket.address(sid).set_failed(errors.EFAILEDSOCKET, "induced")
+    cntl2, _ = ch.call("EchoService.Echo",
+                       echo_pb2.EchoRequest(message="x"),
+                       echo_pb2.EchoResponse, timeout_ms=500)
+    assert cntl2.failed()
+    assert policy.recovering  # the dead cluster flipped it on
+    ch.close()
