@@ -129,9 +129,13 @@ def unlock(id_value: int):
         raise KeyError(f"invalid bthread_id {id_value:#x}")
     pending = None
     with slot.cond:
+        if not _valid(slot, version):
+            # A stale id (destroyed, possibly with the slot reused by a
+            # newer id) must NOT release the current holder's lock.
+            raise KeyError(f"destroyed bthread_id {id_value:#x}")
         if not slot.locked:
             raise RuntimeError(f"unlock of unlocked id {id_value:#x}")
-        if slot.pending_errors and _valid(slot, version):
+        if slot.pending_errors:
             pending = slot.pending_errors.popleft()
         else:
             slot.locked = False

@@ -166,6 +166,93 @@ def _list_handler(server, req):
     return 200, "application/json", json.dumps(out, indent=1) + "\n"
 
 
+def _vlog_handler(server, req):
+    """/vlog: logging sites and levels, live-editable with
+    ?setlevel=<logger>=<LEVEL> (builtin/vlog_service.cpp's role for the
+    Python logging tree)."""
+    import logging
+
+    setlevel = req.query.get("setlevel")
+    if setlevel:
+        name, sep, level = setlevel.partition("=")
+        if not sep:
+            return 400, "text/plain", "setlevel wants logger=LEVEL\n"
+        try:
+            logging.getLogger(name).setLevel(level.upper())
+        except ValueError as e:
+            return 400, "text/plain", f"{e}\n"
+        return 200, "text/plain", f"{name} set to {level.upper()}\n"
+    lines = ["logger                                   | effective level"]
+    root = logging.getLogger()
+    lines.append(f"{'<root>':41s}| "
+                 f"{logging.getLevelName(root.getEffectiveLevel())}")
+    for name in sorted(logging.root.manager.loggerDict):
+        logger = logging.getLogger(name)
+        lines.append(f"{name:41s}| "
+                     f"{logging.getLevelName(logger.getEffectiveLevel())}")
+    return 200, "text/plain", "\n".join(lines) + "\n"
+
+
+def _dir_handler(server, req):
+    """/dir/<path>: browse the server's filesystem
+    (builtin/dir_service.cpp — a debug console page, same trust model)."""
+    import os
+    import stat
+
+    rel = req.path[len("/dir"):] or "/"
+    path = rel if rel.startswith("/") else "/" + rel
+    if not os.path.exists(path):
+        return 404, "text/plain", f"no such path: {path}\n"
+    if os.path.isdir(path):
+        lines = [f"{path}:"]
+        try:
+            for name in sorted(os.listdir(path)):
+                full = os.path.join(path, name)
+                try:
+                    st = os.stat(full)
+                    kind = "d" if stat.S_ISDIR(st.st_mode) else "-"
+                    lines.append(f"{kind} {st.st_size:>12d}  {name}")
+                except OSError:
+                    lines.append(f"? {'?':>12s}  {name}")
+        except PermissionError:
+            return 403, "text/plain", f"permission denied: {path}\n"
+        return 200, "text/plain", "\n".join(lines) + "\n"
+    try:
+        with open(path, "rb") as f:
+            body = f.read(1 << 20)  # bounded, like the reference's page
+    except OSError as e:
+        return 403, "text/plain", f"{e}\n"
+    return 200, "application/octet-stream", body
+
+
+def _ids_handler(server, req):
+    """/ids?id=N: bthread_id introspection (builtin/ids_service.cpp)."""
+    from brpc_tpu.bthread import id as bthread_id
+
+    id_q = req.query.get("id")
+    if id_q:
+        try:
+            idv = int(id_q)
+        except ValueError:
+            return 400, "text/plain", "id must be an integer\n"
+        slot, version = bthread_id._resolve(idv)
+        if slot is None:
+            return 200, "text/plain", f"id {idv}: unknown slot\n"
+        valid = bthread_id._valid(slot, version)
+        return 200, "text/plain", (
+            f"id {idv}: version={version} first_version="
+            f"{slot.first_version} range={slot.range} "
+            f"locked={slot.locked} destroyed={slot.destroyed} "
+            f"valid={valid} pending_errors={len(slot.pending_errors)}\n")
+    with bthread_id._registry_lock:
+        total = len(bthread_id._slots)
+        live = sum(1 for s in bthread_id._slots.values() if not s.destroyed)
+        locked = sum(1 for s in bthread_id._slots.values() if s.locked)
+    return 200, "text/plain", (
+        f"id_slots: {total}\nlive: {live}\nlocked: {locked}\n"
+        "use /ids?id=N for one id\n")
+
+
 def _version():
     import brpc_tpu
 
@@ -196,5 +283,8 @@ def attach_console(server):
         "sockets": _sockets_handler,
         "rpcz": _rpcz_handler,
         "list": _list_handler,
+        "vlog": _vlog_handler,
+        "dir": _dir_handler,
+        "ids": _ids_handler,
     }
     bvar.expose_flags_as_bvars()

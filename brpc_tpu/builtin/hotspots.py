@@ -67,26 +67,43 @@ def thread_dump() -> str:
 
 
 def hotspots_handler(server, req):
+    """/hotspots/{cpu,heap,growth,contention,tpu} — the full profiler
+    surface of hotspots_service.h:38-68 (+ the XProf TPU translation)."""
+    from brpc_tpu.builtin import profilers
+
     parts = [p for p in req.path.split("/") if p]
     kind = parts[1] if len(parts) > 1 else "cpu"
+    seconds = float(req.query.get("seconds", "1") or 1)
     if kind == "cpu":
-        seconds = float(req.query.get("seconds", "1") or 1)
         return 200, "text/plain", sample_cpu(seconds)
-    if kind in ("contention", "heap", "growth"):
-        return 200, "text/plain", (
-            f"{kind} profiling: not instrumented in the Python runtime; "
-            "the native core exposes scheduler counters at /bthreads and "
-            "device memory at /vars (tpu_*).\n")
+    if kind == "heap":
+        return 200, "text/plain", profilers.heap_profile()
+    if kind == "growth":
+        return 200, "text/plain", profilers.growth_profile()
+    if kind == "contention":
+        return 200, "text/plain", profilers.contention_profile(seconds)
+    if kind == "tpu":
+        ctype, body = profilers.tpu_trace(seconds)
+        return 200, ctype, body
     return 404, "text/plain", f"unknown hotspots kind {kind}\n"
 
 
 def pprof_handler(server, req):
-    """/pprof/profile — same collapsed output (pprof_service.h slot)."""
+    """/pprof/{profile,heap,growth,symbol} — pprof_service.h:26-48 slots."""
+    from brpc_tpu.builtin import profilers
+
     parts = [p for p in req.path.split("/") if p]
     kind = parts[1] if len(parts) > 1 else "profile"
     if kind == "profile":
         seconds = float(req.query.get("seconds", "1") or 1)
         return 200, "text/plain", sample_cpu(seconds)
+    if kind == "heap":
+        return 200, "text/plain", profilers.heap_profile()
+    if kind == "growth":
+        return 200, "text/plain", profilers.growth_profile()
+    if kind == "contention":
+        seconds = float(req.query.get("seconds", "1") or 1)
+        return 200, "text/plain", profilers.contention_profile(seconds)
     if kind == "symbol":
         return 200, "text/plain", "python frames are pre-symbolized\n"
     return 404, "text/plain", f"unknown pprof endpoint {kind}\n"

@@ -56,32 +56,39 @@ class ListNamingService(NamingService):
         return out
 
 
+def _parse_server_lines(text: str) -> List[NodeSpec]:
+    """The server-list file grammar shared by file:// and remotefile://
+    (policy/file_naming_service.cpp): 'ip:port[ weight-or-tag]' per line,
+    '#' comments."""
+    out: List[NodeSpec] = []
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        weight, tag = 1, ""
+        if " " in line:
+            line, _, tag = line.partition(" ")
+            tag = tag.strip()
+            if tag.isdigit():
+                weight, tag = int(tag), ""
+        try:
+            out.append((EndPoint.parse(line), weight, tag))
+        except ValueError:
+            continue
+    return out
+
+
 class FileNamingService(NamingService):
     name = "file"
     refresh_interval_s = 2.0
 
     def get_servers(self, service_path: str) -> List[NodeSpec]:
-        out = []
         try:
             with open(service_path) as f:
-                lines = f.readlines()
+                text = f.read()
         except OSError:
-            return out
-        for line in lines:
-            line = line.split("#", 1)[0].strip()
-            if not line:
-                continue
-            weight, tag = 1, ""
-            if " " in line:
-                line, _, tag = line.partition(" ")
-                tag = tag.strip()
-                if tag.isdigit():
-                    weight, tag = int(tag), ""
-            try:
-                out.append((EndPoint.parse(line), weight, tag))
-            except ValueError:
-                continue
-        return out
+            return []
+        return _parse_server_lines(text)
 
 
 class DnsNamingService(NamingService):
@@ -221,22 +228,7 @@ class RemoteFileNamingService(NamingService):
                 text = r.read().decode()
         except Exception:
             return []
-        out: List[NodeSpec] = []
-        for line in text.splitlines():
-            line = line.split("#", 1)[0].strip()
-            if not line:
-                continue
-            weight, tag = 1, ""
-            if " " in line:
-                line, _, tag = line.partition(" ")
-                tag = tag.strip()
-                if tag.isdigit():
-                    weight, tag = int(tag), ""
-            try:
-                out.append((EndPoint.parse(line), weight, tag))
-            except ValueError:
-                continue
-        return out
+        return _parse_server_lines(text)
 
 
 _ns_registry: Dict[str, Callable[[], NamingService]] = {

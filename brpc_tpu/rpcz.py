@@ -23,6 +23,11 @@ flags.define_int("rpcz_max_spans", 4096,
                  "bounded span store (collector budget analog)")
 flags.define_int("rpcz_sample_every", 1,
                  "keep 1 of every N spans (sampling rate limit)")
+flags.define_string("rpcz_database_dir", "",
+                    "persist sampled spans on disk (the SpanDB of "
+                    "span.h:206-224); empty = in-memory only")
+flags.define_int("rpcz_database_max_spans", 200000,
+                 "rotate the on-disk SpanDB past this many spans")
 
 _tls = threading.local()
 
@@ -106,6 +111,190 @@ class parent_scope:
         set_parent(self._prev)
 
 
+# -- on-disk SpanDB (span.h:206-224) ----------------------------------------
+
+class SpanDB:
+    """Persists sampled spans to recordio files so traces survive the
+    in-memory window; rotated in two generations like the reference's
+    SpanDB keeps a bounded disk footprint."""
+
+    def __init__(self, directory: str, max_spans: int):
+        import os
+
+        os.makedirs(directory, exist_ok=True)
+        self._dir = directory
+        self._max = max(1000, max_spans)
+        self._lock = threading.Lock()
+        self._count = 0
+        self._writer = None
+        # Spans are handed off to a background writer (the reference feeds
+        # SpanDB from the Collector's thread) so RPC completion never
+        # touches the disk while holding the CallId lock.
+        self._queue: Deque[Span] = deque()
+        self._queue_cond = threading.Condition()
+        self._closed = False
+        self._open()
+        self._thread = threading.Thread(target=self._drain_loop,
+                                        name="rpcz-spandb", daemon=True)
+        self._thread.start()
+
+    def _path(self, gen: int) -> str:
+        import os
+
+        return os.path.join(self._dir, f"rpcz.{gen}.recordio")
+
+    def _open(self):
+        from brpc_tpu.butil.recordio import RecordWriter
+
+        self._writer = RecordWriter(self._path(0))
+
+    def append(self, span: "Span"):
+        """Non-blocking enqueue; the background thread persists it."""
+        with self._queue_cond:
+            if self._closed:
+                return
+            if len(self._queue) > 65536:  # backpressure: drop, don't stall
+                return
+            self._queue.append(span)
+            self._queue_cond.notify()
+
+    def _drain_loop(self):
+        while True:
+            with self._queue_cond:
+                while not self._queue and not self._closed:
+                    self._queue_cond.wait()
+                if self._closed and not self._queue:
+                    return
+                batch = list(self._queue)
+                self._queue.clear()
+            try:
+                self._write_batch(batch)
+            except Exception:
+                pass  # disk trouble must never kill the writer thread
+
+    def _write_batch(self, batch):
+        import json
+
+        with self._lock:
+            for span in batch:
+                payload = json.dumps({
+                    "trace_id": span.trace_id, "span_id": span.span_id,
+                    "parent_span_id": span.parent_span_id,
+                    "kind": span.kind,
+                    "full_method": span.full_method,
+                    "remote_side": span.remote_side,
+                    "start_time": span.start_time,
+                    "end_time": span.end_time,
+                    "error_code": span.error_code,
+                    "request_size": span.request_size,
+                    "response_size": span.response_size,
+                    "log_id": span.log_id,
+                    "annotations": span.annotations,
+                }).encode()
+                self._writer.write({"trace_id": f"{span.trace_id:016x}"},
+                                   payload)
+                self._count += 1
+                if self._count >= self._max // 2:
+                    self._rotate()
+            self._writer.flush()
+
+    def drain(self, timeout_s: float = 5.0):
+        """Wait for queued spans to reach disk (readers want fresh data)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._queue_cond:
+                if not self._queue:
+                    return
+            time.sleep(0.005)
+
+    def close(self):
+        with self._queue_cond:
+            self._closed = True
+            self._queue_cond.notify()
+        self._thread.join(5)
+        with self._lock:
+            self._writer.close()
+
+    def _rotate(self):
+        import os
+
+        self._writer.close()
+        try:
+            os.replace(self._path(0), self._path(1))
+        except OSError:
+            pass
+        self._count = 0
+        self._open()
+
+    def find_trace(self, trace_id: int) -> List["Span"]:
+        """Read back every span of a trace from both generations."""
+        import json
+        import os
+
+        from brpc_tpu.butil.recordio import RecordReader
+
+        needle = f"{trace_id:016x}"
+        out: List[Span] = []
+        self.drain(1.0)
+        with self._lock:
+            self._writer.flush()
+        for gen in (1, 0):
+            path = self._path(gen)
+            if not os.path.exists(path):
+                continue
+            reader = RecordReader(path)
+            while True:
+                rec = reader.read()
+                if rec is None:
+                    break
+                meta, payload = rec
+                if meta.get("trace_id") != needle:
+                    continue
+                d = json.loads(payload.decode())
+                span = Span(d["kind"], d["full_method"],
+                            trace_id=d["trace_id"],
+                            parent_span_id=d["parent_span_id"],
+                            log_id=d["log_id"])
+                span.span_id = d["span_id"]
+                span.remote_side = d["remote_side"]
+                span.start_time = d["start_time"]
+                span.end_time = d["end_time"]
+                span.error_code = d["error_code"]
+                span.request_size = d["request_size"]
+                span.response_size = d["response_size"]
+                span.annotations = [tuple(a) for a in d["annotations"]]
+                out.append(span)
+        return out
+
+
+_span_db: Optional[SpanDB] = None
+_span_db_lock = threading.Lock()
+
+
+def _get_span_db() -> Optional[SpanDB]:
+    directory = flags.get_flag("rpcz_database_dir")
+    global _span_db
+    if not directory:
+        with _span_db_lock:
+            if _span_db is not None:
+                try:
+                    _span_db.close()
+                except Exception:
+                    pass
+                _span_db = None
+        return None
+    with _span_db_lock:
+        if _span_db is None or _span_db._dir != directory:
+            if _span_db is not None:
+                try:
+                    _span_db.close()  # release the old writer's fd
+                except Exception:
+                    pass
+            _span_db = SpanDB(directory,
+                              flags.get_flag("rpcz_database_max_spans"))
+    return _span_db
+
+
 # -- collector --------------------------------------------------------------
 
 _spans: Deque[Span] = deque(maxlen=4096)
@@ -126,6 +315,12 @@ def _submit(span: Span):
                 _spans, maxlen=max(16, flags.get_flag("rpcz_max_spans")))
             globals()["_spans"] = resized
         _spans.append(span)
+    db = _get_span_db()
+    if db is not None:
+        try:
+            db.append(span)
+        except Exception:
+            pass  # disk trouble must never fail the RPC path
 
 
 def recent_spans(limit: int = 100) -> List[Span]:
@@ -135,7 +330,17 @@ def recent_spans(limit: int = 100) -> List[Span]:
 
 def find_trace(trace_id: int) -> List[Span]:
     with _spans_lock:
-        return [s for s in _spans if s.trace_id == trace_id]
+        found = [s for s in _spans if s.trace_id == trace_id]
+    if found:
+        return found
+    # Aged out of the memory window: consult the on-disk SpanDB.
+    db = _get_span_db()
+    if db is not None:
+        try:
+            return db.find_trace(trace_id)
+        except Exception:
+            pass
+    return []
 
 
 def clear_for_tests():
