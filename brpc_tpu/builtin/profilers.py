@@ -33,10 +33,15 @@ _baseline_lock = threading.Lock()
 def _ensure_tracemalloc(frames: int = 16) -> bool:
     """Start tracemalloc on first profile request. Returns False if it
     JUST started (no data yet)."""
+    global _growth_baseline
     if tracemalloc.is_tracing():
+        # Tracing was begun externally (PYTHONTRACEMALLOC / user code):
+        # adopt the current state as the growth baseline.
+        with _baseline_lock:
+            if _growth_baseline is None:
+                _growth_baseline = tracemalloc.take_snapshot()
         return True
     tracemalloc.start(frames)
-    global _growth_baseline
     with _baseline_lock:
         _growth_baseline = tracemalloc.take_snapshot()
     return False

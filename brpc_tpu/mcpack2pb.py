@@ -95,6 +95,76 @@ def dumps(obj: dict) -> bytes:
     return _encode_field("", obj)
 
 
+# -- typed serializer primitives (serializer.cpp:29-88's put_* surface) -----
+# Generated code (mcpack2pb_gen) calls these so each pb field gets its
+# EXACT mcpack wire type, like the reference's generated put_int32/put_str
+# calls — the reflective dict path above auto-sizes instead.
+
+def _enc_typed_int(name: str, value: int, ftype: int) -> bytes:
+    nbytes = name.encode() + b"\x00" if name else b""
+    return bytes([ftype, len(nbytes)]) + nbytes + struct.pack(
+        _INT_PACK[ftype], value)
+
+
+def enc_int32(name: str, v: int) -> bytes:
+    return _enc_typed_int(name, v, FIELD_INT32)
+
+
+def enc_int64(name: str, v: int) -> bytes:
+    return _enc_typed_int(name, v, FIELD_INT64)
+
+
+def enc_uint32(name: str, v: int) -> bytes:
+    return _enc_typed_int(name, v, FIELD_UINT32)
+
+
+def enc_uint64(name: str, v: int) -> bytes:
+    return _enc_typed_int(name, v, FIELD_UINT64)
+
+
+def enc_bool(name: str, v: bool) -> bytes:
+    nbytes = name.encode() + b"\x00" if name else b""
+    return bytes([FIELD_BOOL, len(nbytes)]) + nbytes + (
+        b"\x01" if v else b"\x00")
+
+
+def enc_float(name: str, v: float) -> bytes:
+    nbytes = name.encode() + b"\x00" if name else b""
+    return bytes([FIELD_FLOAT, len(nbytes)]) + nbytes + struct.pack("<f", v)
+
+
+def enc_double(name: str, v: float) -> bytes:
+    nbytes = name.encode() + b"\x00" if name else b""
+    return bytes([FIELD_DOUBLE, len(nbytes)]) + nbytes + struct.pack("<d", v)
+
+
+def enc_str(name: str, v: str) -> bytes:
+    return _encode_field(name, str(v))
+
+
+def enc_bytes(name: str, v: bytes) -> bytes:
+    return _encode_field(name, bytes(v))
+
+
+def enc_object(name: str, fields) -> bytes:
+    """fields: iterable of already-encoded member field bytes."""
+    fields = list(fields)
+    items = b"".join(fields)
+    nbytes = name.encode() + b"\x00" if name else b""
+    body = struct.pack("<I", len(fields)) + items
+    return bytes([FIELD_OBJECT, len(nbytes)]) + struct.pack(
+        "<I", len(body)) + nbytes + body
+
+
+def enc_array(name: str, items_encoded) -> bytes:
+    items_encoded = list(items_encoded)
+    items = b"".join(items_encoded)
+    nbytes = name.encode() + b"\x00" if name else b""
+    body = struct.pack("<I", len(items_encoded)) + items
+    return bytes([FIELD_ARRAY, len(nbytes)]) + struct.pack(
+        "<I", len(body)) + nbytes + body
+
+
 def _decode_field(data: bytes, pos: int) -> Tuple[str, object, int]:
     ftype = data[pos]
     short = bool(ftype & SHORT_MASK)

@@ -274,6 +274,8 @@ _span_db_lock = threading.Lock()
 def _get_span_db() -> Optional[SpanDB]:
     directory = flags.get_flag("rpcz_database_dir")
     global _span_db
+    if not directory and _span_db is None:
+        return None  # common case: feature off — skip the lock entirely
     if not directory:
         with _span_db_lock:
             if _span_db is not None:
@@ -315,12 +317,12 @@ def _submit(span: Span):
                 _spans, maxlen=max(16, flags.get_flag("rpcz_max_spans")))
             globals()["_spans"] = resized
         _spans.append(span)
-    db = _get_span_db()
-    if db is not None:
-        try:
+    try:
+        db = _get_span_db()
+        if db is not None:
             db.append(span)
-        except Exception:
-            pass  # disk trouble must never fail the RPC path
+    except Exception:
+        pass  # disk trouble must never fail the RPC path
 
 
 def recent_spans(limit: int = 100) -> List[Span]:
@@ -331,16 +333,20 @@ def recent_spans(limit: int = 100) -> List[Span]:
 def find_trace(trace_id: int) -> List[Span]:
     with _spans_lock:
         found = [s for s in _spans if s.trace_id == trace_id]
-    if found:
-        return found
-    # Aged out of the memory window: consult the on-disk SpanDB.
-    db = _get_span_db()
+    # Merge with the on-disk SpanDB: parts of the trace may have aged out
+    # of the bounded memory window while others are still in it.
+    try:
+        db = _get_span_db()
+    except Exception:
+        db = None
     if db is not None:
         try:
-            return db.find_trace(trace_id)
+            seen = {s.span_id for s in found}
+            found.extend(s for s in db.find_trace(trace_id)
+                         if s.span_id not in seen)
         except Exception:
             pass
-    return []
+    return found
 
 
 def clear_for_tests():

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""mcpack2pb code generator CLI — the mcpack2pb/generator.cpp front door.
+
+    python tools/mcpack2pb_gen.py brpc_tpu.rpc.proto.echo_pb2:EchoRequest \
+        brpc_tpu.rpc.proto.echo_pb2:EchoResponse -o echo_mcpack.py
+
+    python tools/mcpack2pb_gen.py --service mymod:EchoService -o adaptor.py
+"""
+import argparse
+import importlib
+import sys
+
+sys.path.insert(0, ".")
+
+
+def _resolve(spec: str):
+    module_name, _, attr = spec.partition(":")
+    module = importlib.import_module(module_name)
+    return getattr(module, attr)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("specs", nargs="+",
+                    help="module:MessageClass (or module:ServiceClass "
+                         "with --service)")
+    ap.add_argument("--service", action="store_true",
+                    help="generate an nshead-mcpack adaptor for an "
+                         "rpc.Service subclass")
+    ap.add_argument("-o", "--output", default="-",
+                    help="output file (default stdout)")
+    args = ap.parse_args(argv)
+
+    from brpc_tpu.mcpack2pb_gen import (
+        generate_codec_source,
+        generate_nshead_adaptor_source,
+    )
+
+    if args.service:
+        if len(args.specs) != 1:
+            ap.error("--service takes exactly one module:ServiceClass")
+        src = generate_nshead_adaptor_source(_resolve(args.specs[0]))
+    else:
+        src = generate_codec_source([_resolve(s) for s in args.specs])
+    if args.output == "-":
+        sys.stdout.write(src)
+    else:
+        with open(args.output, "w") as f:
+            f.write(src)
+        print(f"wrote {args.output}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
