@@ -239,9 +239,12 @@ def mcpack_to_pb(data: bytes, message_class):
 
 
 def _is_repeated(field) -> bool:
+    v = getattr(field, "is_repeated", None)
+    if isinstance(v, bool):
+        return v  # modern protobuf: a bool property
     try:
-        return field.is_repeated()
-    except (AttributeError, TypeError):
+        return bool(v())  # older protobuf: a method
+    except TypeError:
         return field.label == field.LABEL_REPEATED
 
 

@@ -53,19 +53,39 @@ def _snake(name: str) -> str:
     return s.lower()
 
 
-def _is_repeated(field) -> bool:
-    try:
-        return field.is_repeated()
-    except (AttributeError, TypeError):
-        return field.label == FD.LABEL_REPEATED
+from brpc_tpu.mcpack2pb import _is_repeated  # shared compat shim
 
 
 def _has_presence(field) -> bool:
     try:
         return field.has_presence
     except AttributeError:  # older protobuf
+        syntax = getattr(field.file, "syntax", None)
         return bool(field.label == FD.LABEL_OPTIONAL
-                    and field.containing_oneof is not None)
+                    and (syntax == "proto2"
+                         or field.containing_oneof is not None))
+
+
+def _is_map(field) -> bool:
+    return (field.type == FD.TYPE_MESSAGE
+            and field.message_type.GetOptions().map_entry)
+
+
+def _defining_module(cls) -> str:
+    """The importable module that registers a pb2 class's descriptors.
+    cls.__module__ on upb-generated classes is the bare file stem (e.g.
+    'echo_pb2'), which is often NOT importable — find the real sys.modules
+    entry exposing the class instead."""
+    import sys as _sys
+
+    name = getattr(cls, "__module__", None)
+    mod = _sys.modules.get(name) if name else None
+    if mod is not None and getattr(mod, cls.__name__, None) is cls:
+        return name
+    candidates = [n for n, m in list(_sys.modules.items())
+                  if m is not None
+                  and getattr(m, cls.__name__, None) is cls]
+    return min(candidates, key=len) if candidates else ""
 
 
 def _collect_and_name(message_classes):
@@ -79,7 +99,11 @@ def _collect_and_name(message_classes):
             return
         seen[desc.full_name] = desc
         for f in desc.fields:
-            if f.type == FD.TYPE_MESSAGE:
+            if _is_map(f):
+                value_field = f.message_type.fields_by_name["value"]
+                if value_field.type == FD.TYPE_MESSAGE:
+                    collect(value_field.message_type)
+            elif f.type == FD.TYPE_MESSAGE:
                 collect(f.message_type)
 
     for cls in message_classes:
@@ -102,6 +126,22 @@ def _emit_serializer(lines: List[str], desc, fn_name: str, names):
     lines.append("    fields = []")
     for field in desc.fields:
         name = field.name
+        if _is_map(field):
+            # map<K,V> -> an mcpack OBJECT keyed by str(K)
+            value_field = field.message_type.fields_by_name["value"]
+            if value_field.type == FD.TYPE_MESSAGE:
+                sub = (f"serialize_"
+                       f"{names[value_field.message_type.full_name]}"
+                       "_fields")
+                item = f"mp.enc_object(str(k), {sub}(v))"
+            else:
+                venc, _ = _TYPE_MAP[value_field.type]
+                item = f"mp.{venc}(str(k), v)"
+            lines.append(f"    if msg.{name}:")
+            lines.append(
+                f"        fields.append(mp.enc_object({name!r}, "
+                f"[{item} for k, v in msg.{name}.items()]))")
+            continue
         if field.type == FD.TYPE_MESSAGE:
             sub = (f"serialize_{names[field.message_type.full_name]}"
                    "_fields")
@@ -147,6 +187,22 @@ def _emit_parser(lines: List[str], desc, fn_name: str, cls_expr: str,
         name = field.name
         lines.append(f"    v = obj.get({name!r})")
         lines.append("    if v is not None:")
+        if _is_map(field):
+            key_field = field.message_type.fields_by_name["key"]
+            _, kcoerce = _TYPE_MAP[key_field.type]
+            value_field = field.message_type.fields_by_name["value"]
+            lines.append("        for k, item in v.items():")
+            if value_field.type == FD.TYPE_MESSAGE:
+                sub = (f"parse_{names[value_field.message_type.full_name]}"
+                       "_into")
+                lines.append(
+                    f"            {sub}(item, msg.{name}[{kcoerce}(k)])")
+            else:
+                _, vcoerce = _TYPE_MAP[value_field.type]
+                lines.append(
+                    f"            msg.{name}[{kcoerce}(k)] = "
+                    f"{vcoerce}(item)")
+            continue
         if field.type == FD.TYPE_MESSAGE:
             sub = f"parse_{names[field.message_type.full_name]}_into"
             if _is_repeated(field):
@@ -195,8 +251,11 @@ def generate_codec_source(message_classes) -> str:
     lines = [_PRELUDE]
     imports = sorted({d.file.name for d in seen.values()})
     lines.append(f"# sources: {', '.join(imports)}")
-    # message classes are resolved through the symbol database so the
-    # generated module needs no direct pb2 imports
+    # importing the defining pb2 modules registers the descriptors, so the
+    # generated module is importable in a fresh process
+    for module_name in sorted({m for m in map(_defining_module,
+                                              message_classes) if m}):
+        lines.append(f"import {module_name}  # noqa: F401 (registers pb2)")
     lines.append("from google.protobuf import symbol_database as _sdb")
     lines.append("_sym = _sdb.Default()")
     for full_name in seen:
@@ -230,7 +289,7 @@ def generate_nshead_adaptor_source(service_class) -> str:
         message_classes.extend([minfo.request_class, minfo.response_class])
     src = generate_codec_source(message_classes)
     _, names = _collect_and_name(message_classes)  # same stems as src
-    name = service_class.service_name()
+    name = re.sub(r"\W", "_", service_class.__name__)
     lines = [
         "",
         "",

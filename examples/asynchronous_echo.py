@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""asynchronous_echo — example/asynchronous_echo_c++ counterpart: issue
+the RPC with a done-callback and keep working; the callback runs on
+completion (client.cpp's HandleEchoResponse + NewCallback shape).
+
+  python examples/asynchronous_echo.py
+"""
+import sys
+import threading
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        with rpc.ClosureGuard(done):
+            response.message = request.message
+
+
+def main():
+    srv = rpc.Server()
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+
+    ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=1000))
+    assert ch.init(str(srv.listen_endpoint)) == 0
+
+    n = 8
+    finished = threading.Semaphore(0)
+    results = [None] * n
+
+    def make_done(i, cntl, resp):
+        def handle(c):  # HandleEchoResponse role — runs on completion
+            results[i] = (c.failed(), resp.message)
+            finished.release()
+        return handle
+
+    for i in range(n):
+        cntl = rpc.Controller()
+        resp = echo_pb2.EchoResponse()
+        ch.call_method("EchoService.Echo", cntl,
+                       echo_pb2.EchoRequest(message=f"async {i}"), resp,
+                       done=make_done(i, cntl, resp))
+        # control returned immediately; the RPC completes in background
+
+    for _ in range(n):
+        finished.acquire()
+    ok = all(not failed and msg == f"async {i}"
+             for i, (failed, msg) in enumerate(results))
+    print("async results:", "all ok" if ok else results)
+    ch.close()
+    srv.stop()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

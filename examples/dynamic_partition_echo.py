@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""dynamic_partition_echo — example/dynamic_partition_echo_c++
+counterpart: servers announce DIFFERENT partitioning schemes ("N/M" tags)
+in one naming service; DynamicPartitionChannel groups them per scheme and
+weights scheme choice by live capacity through the _dynpart LB.
+
+  python examples/dynamic_partition_echo.py
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.combo_channels import (  # noqa: E402
+    DynamicPartitionChannel,
+    PartitionParser,
+)
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class PartEcho(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self, part, total):
+        self.part, self.total = part, total
+
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        with rpc.ClosureGuard(done):
+            response.message = f"{self.part}/{self.total}:{request.message}"
+
+
+def main():
+    # one 2-way partitioned generation and one 3-way (the migration
+    # scenario dynamic partitioning exists for)
+    servers, nodes = [], []
+    for total in (2, 3):
+        for part in range(total):
+            srv = rpc.Server()
+            srv.add_service(PartEcho(part, total))
+            assert srv.start("127.0.0.1:0") == 0
+            servers.append(srv)
+            nodes.append(f"{srv.listen_endpoint} {part}/{total}")
+
+    dpc = DynamicPartitionChannel()
+    rc = dpc.init("list://" + ",".join(nodes), "rr",
+                  parser=PartitionParser(),
+                  options=rpc.ChannelOptions(timeout_ms=500))
+    assert rc == 0, rc
+
+    counts = {2: 0, 3: 0}
+    for i in range(20):
+        cntl, resp = dpc.call("EchoService.Echo",
+                              echo_pb2.EchoRequest(message=str(i)),
+                              echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        total = int(resp.message.split(":")[0].split("/")[1])
+        counts[total] += 1
+    print(f"scheme usage (2-way vs 3-way, capacity-weighted): {counts}")
+    dpc.stop()
+    for srv in servers:
+        srv.stop()
+    return 0 if counts[2] and counts[3] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

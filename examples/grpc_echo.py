@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""grpc_echo — example/grpc_c++ counterpart: the same service answers our
+native tpu_std protocol AND gRPC-over-h2 on one port.
+
+  python examples/grpc_echo.py
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        with rpc.ClosureGuard(done):
+            response.message = request.message
+
+
+def main():
+    srv = rpc.Server()
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    target = str(srv.listen_endpoint)
+
+    gch = rpc.Channel(rpc.ChannelOptions(protocol="h2:grpc",
+                                         timeout_ms=3000))
+    assert gch.init(target) == 0
+    cntl, resp = gch.call("EchoService.Echo",
+                          echo_pb2.EchoRequest(message="over grpc"),
+                          echo_pb2.EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    print(f"grpc reply: {resp.message!r} latency={cntl.latency_us:.0f}us")
+    gch.close()
+
+    nch = rpc.Channel(rpc.ChannelOptions(timeout_ms=1000))
+    assert nch.init(target) == 0
+    cntl2, resp2 = nch.call("EchoService.Echo",
+                            echo_pb2.EchoRequest(message="over tpu_std"),
+                            echo_pb2.EchoResponse)
+    assert not cntl2.failed(), cntl2.error_text
+    print(f"tpu_std reply on the same port: {resp2.message!r}")
+    nch.close()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""legacy_pbrpc_echo — the Baidu legacy pb-rpc family on one port: the
+same service answers hulu_pbrpc, sofa_pbrpc, nshead and tpu_std
+simultaneously (the multi-protocol port, server.cpp's protocol trying).
+
+  python examples/legacy_pbrpc_echo.py
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        with rpc.ClosureGuard(done):
+            response.message = request.message
+
+
+def main():
+    srv = rpc.Server()
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    target = str(srv.listen_endpoint)
+
+    rc = 0
+    for protocol in ("hulu_pbrpc", "sofa_pbrpc", "tpu_std"):
+        ch = rpc.Channel(rpc.ChannelOptions(protocol=protocol,
+                                            timeout_ms=1000))
+        assert ch.init(target) == 0
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message=f"via {protocol}"),
+                             echo_pb2.EchoResponse)
+        if cntl.failed():
+            print(f"{protocol}: FAILED {cntl.error_text}")
+            rc = 1
+        else:
+            print(f"{protocol}: {resp.message!r} "
+                  f"({cntl.latency_us:.0f}us)")
+        ch.close()
+    srv.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

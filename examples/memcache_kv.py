@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""memcache_kv — example/memcache_c++ counterpart: batched memcache
+binary-protocol operations through a memcache channel (memcache.h's
+MemcacheRequest/Response batching).
+
+  python examples/memcache_kv.py
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.memcache import (  # noqa: E402
+    MemcacheRequest,
+    MemcacheResponse,
+    MemcacheService,
+)
+
+
+def main():
+    srv = rpc.Server(rpc.ServerOptions(memcache_service=MemcacheService()))
+    assert srv.start("127.0.0.1:0") == 0
+
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="memcache",
+                                        timeout_ms=1000))
+    assert ch.init(str(srv.listen_endpoint)) == 0
+
+    req = MemcacheRequest().set("chip", "tpu-v5e").get("chip") \
+                           .incr("hits", 1, initial=1).incr("hits", 1)
+    resp = MemcacheResponse()
+    cntl = rpc.Controller()
+    ch.call_method("memcache", cntl, req, resp)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.pop_store()
+    ok, value = resp.pop_get()
+    print(f"get chip -> {value!r}")
+    _, first = resp.pop_counter()
+    _, second = resp.pop_counter()
+    print(f"hits counter: {first} then {second}")
+    ch.close()
+    srv.stop()
+    return 0 if ok and value == b"tpu-v5e" and second == first + 1 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""nshead_extension — example/nshead_{extension,pb_extension}_c++
+counterpart: a raw NsheadService AND a GENERATED pb front-end (the
+mcpack2pb codegen output) behind Baidu's 36-byte nshead framing.
+
+  python examples/nshead_extension.py
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import mcpack2pb as mp  # noqa: E402
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.mcpack2pb_gen import (  # noqa: E402
+    compile_codec,
+    generate_nshead_adaptor_source,
+)
+from brpc_tpu.rpc.nshead_protocol import NsheadMessage  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        with rpc.ClosureGuard(done):
+            response.message = request.message.swapcase()
+
+
+def main():
+    # generate the pb-over-mcpack adaptor from the service's descriptors —
+    # what mcpack2pb/generator.cpp does at build time in the reference
+    src = generate_nshead_adaptor_source(EchoService)
+    adaptor_cls = compile_codec(src, "echo_nshead").EchoServiceNsheadAdaptor
+    srv = rpc.Server(rpc.ServerOptions(
+        nshead_service=adaptor_cls(EchoService())))
+    assert srv.start("127.0.0.1:0") == 0
+
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="nshead",
+                                        timeout_ms=1000))
+    assert ch.init(str(srv.listen_endpoint)) == 0
+    body = mp.enc_object("", [mp.enc_str("method", "Echo"),
+                              mp.enc_str("message", "Hello NSHEAD")])
+    cntl, resp = ch.call("nshead", NsheadMessage(body), NsheadMessage)
+    assert not cntl.failed(), cntl.error_text
+    out = mp.loads(resp.body)
+    msg = out["message"]
+    if isinstance(msg, bytes):
+        msg = msg.decode()
+    print(f"nshead-mcpack reply: {msg!r}")
+    ch.close()
+    srv.stop()
+    return 0 if msg == "hELLO nshead" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""redis_kv — example/redis_c++ counterpart: the server SPEAKS redis (a
+RedisService with command handlers, redis.h's server side) and the client
+pipelines commands over a redis channel; vanilla redis-cli works too.
+
+  python examples/redis_kv.py
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.redis import (  # noqa: E402
+    DictRedisService,
+    RedisRequest,
+    RedisResponse,
+)
+
+
+def main():
+    srv = rpc.Server(rpc.ServerOptions(redis_service=DictRedisService()))
+    assert srv.start("127.0.0.1:0") == 0
+
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="redis", timeout_ms=1000))
+    assert ch.init(str(srv.listen_endpoint)) == 0
+
+    req = RedisRequest()
+    req.add_command("SET", "pod", "v5e-8")
+    req.add_command("GET", "pod")
+    req.add_command("DEL", "pod")
+    req.add_command("GET", "pod")
+    resp = RedisResponse()
+    cntl = rpc.Controller()
+    ch.call_method("redis", cntl, req, resp)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.reply_count == 4
+    print("SET ->", resp.reply(0))
+    print("GET ->", resp.reply(1))
+    print("DEL ->", resp.reply(2))
+    print("GET after DEL ->", resp.reply(3), "(nil)" if
+          resp.reply(3).is_nil() else "")
+    ch.close()
+    srv.stop()
+    return 0 if resp.reply(1).value == b"v5e-8" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""selective_echo — example/selective_echo_c++ counterpart: a
+SelectiveChannel load-balances whole sub-channels and fails over when a
+backend dies mid-run.
+
+  python examples/selective_echo.py
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc import errors  # noqa: E402
+from brpc_tpu.rpc.combo_channels import SelectiveChannel  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+
+class NamedEcho(rpc.Service):
+    SERVICE_NAME = "EchoService"
+
+    def __init__(self, tag):
+        self.tag = tag
+
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        with rpc.ClosureGuard(done):
+            response.message = f"{self.tag}:{request.message}"
+
+
+def main():
+    servers = []
+    schan = SelectiveChannel(max_retry=2)
+    for tag in ("a", "b", "c"):
+        srv = rpc.Server()
+        srv.add_service(NamedEcho(tag))
+        assert srv.start("127.0.0.1:0") == 0
+        servers.append(srv)
+        ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=500))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        schan.add_channel(ch)
+
+    seen = set()
+    for i in range(12):
+        cntl, resp = schan.call("EchoService.Echo",
+                                echo_pb2.EchoRequest(message=str(i)),
+                                echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        seen.add(resp.message.split(":")[0])
+    print(f"spread across backends: {sorted(seen)}")
+
+    # kill one backend: calls must fail over to the survivors
+    servers[0].stop()
+    ok = 0
+    for i in range(8):
+        cntl, resp = schan.call("EchoService.Echo",
+                                echo_pb2.EchoRequest(message=f"x{i}"),
+                                echo_pb2.EchoResponse)
+        if not cntl.failed():
+            ok += 1
+    print(f"after killing backend 'a': {ok}/8 succeeded via failover")
+    for srv in servers[1:]:
+        srv.stop()
+    return 0 if len(seen) > 1 and ok == 8 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""session_data — example/session_data_and_thread_local counterpart:
+session-local data borrowed from a SimpleDataPool per request and
+returned afterwards, so expensive per-request state is pooled.
+
+  python examples/session_data.py
+"""
+import sys
+import threading
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.data_pools import DataFactory  # noqa: E402
+from brpc_tpu.rpc.proto import echo_pb2  # noqa: E402
+
+_created = []
+
+
+def _make_session():
+    _created.append(1)
+    return {"uses": 0}
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        with rpc.ClosureGuard(done):
+            data = cntl.session_local_data  # borrowed from the pool
+            data["uses"] += 1
+            response.message = f"{request.message} (session uses="
+            response.message += f"{data['uses']})"
+
+
+def main():
+    srv = rpc.Server(rpc.ServerOptions(
+        num_threads=2,
+        session_local_data_factory=DataFactory(_make_session)))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+
+    ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=1000))
+    assert ch.init(str(srv.listen_endpoint)) == 0
+    for i in range(10):
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message=f"req{i}"),
+                             echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+    print(f"10 sequential requests reused "
+          f"{len(_created)} pooled session object(s)")
+    ch.close()
+    srv.stop()
+    # sequential calls should reuse a small pool, not create 10 objects
+    return 0 if len(_created) < 10 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""thrift_echo — example/thrift_extension_c++ counterpart: a ThriftService
+handler behind framed-binary thrift, called with a stub-style client.
+
+  python examples/thrift_echo.py
+"""
+import sys
+
+sys.path.insert(0, ".")
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc.thrift import (  # noqa: E402
+    T_STRING,
+    ThriftMessage,
+    ThriftService,
+)
+
+
+def make_service() -> ThriftService:
+    svc = ThriftService()
+
+    def echo(body):  # handler(body_struct) -> result_struct
+        data = body.get(1, (T_STRING, b""))[1]
+        return {0: (T_STRING, b"thrift says: " + data)}
+
+    svc.add_method("Echo", echo)
+    return svc
+
+
+def main():
+    srv = rpc.Server(rpc.ServerOptions(thrift_service=make_service()))
+    assert srv.start("127.0.0.1:0") == 0
+
+    ch = rpc.Channel(rpc.ChannelOptions(protocol="thrift",
+                                        timeout_ms=1000))
+    assert ch.init(str(srv.listen_endpoint)) == 0
+    cntl = rpc.Controller()
+    resp = ThriftMessage()
+    ch.call_method("thrift", cntl,
+                   ThriftMessage("Echo", {1: (T_STRING, b"hello")}), resp)
+    assert not cntl.failed(), cntl.error_text
+    _, data = resp.body.get(0, (T_STRING, b""))
+    print(f"thrift reply: {data!r}")
+    ch.close()
+    srv.stop()
+    return 0 if data == b"thrift says: hello" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

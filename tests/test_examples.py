@@ -15,6 +15,20 @@ EXAMPLES = [
     ("examples/auto_concurrency_limiter.py", []),
     ("examples/http_server.py", []),
     ("examples/tensor_transport.py", ["--mb", "1", "--iters", "3"]),
+    ("examples/multi_threaded_echo.py", ["--threads", "2",
+                                         "--seconds", "1"]),
+    ("examples/asynchronous_echo.py", []),
+    ("examples/selective_echo.py", []),
+    ("examples/dynamic_partition_echo.py", []),
+    ("examples/grpc_echo.py", []),
+    ("examples/redis_kv.py", []),
+    ("examples/memcache_kv.py", []),
+    ("examples/thrift_echo.py", []),
+    ("examples/nshead_extension.py", []),
+    ("examples/session_data.py", []),
+    ("examples/legacy_pbrpc_echo.py", []),
+    ("examples/device_performance.py", ["--threads", "2", "--mb", "1",
+                                        "--iters", "3"]),
 ]
 
 

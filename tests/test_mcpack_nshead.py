@@ -175,3 +175,46 @@ def test_codegen_cli(tmp_path):
     text = out.read_text()
     assert "serialize_echo_request" in text
     assert "parse_echo_response" in text
+
+
+def test_generated_map_fields():
+    from brpc_tpu.mcpack2pb_gen import compile_codec, generate_codec_source
+    from brpc_tpu.rpc.proto import mapdemo_pb2 as m
+
+    mod = compile_codec(generate_codec_source([m.MapDemo]), "mapdemo_codec")
+    d = m.MapDemo(tags=["a", "b"])
+    d.counts["x"] = 3
+    d.counts["y"] = 0  # map entries have no presence: must survive
+    d.shards[7].label = "seven"
+    d.shards[7].rank = 2
+    back = mod.parse_map_demo(mod.serialize_map_demo(d))
+    assert dict(back.counts) == {"x": 3, "y": 0}
+    assert back.shards[7].label == "seven" and back.shards[7].rank == 2
+    assert list(back.tags) == ["a", "b"]
+
+
+def test_codegen_output_imports_standalone(tmp_path):
+    """CLI output must be importable in a FRESH process (the generated
+    module imports its pb2 sources itself)."""
+    import subprocess
+    import sys as _sys
+
+    out = tmp_path / "standalone_codec.py"
+    rc = subprocess.run(
+        [_sys.executable, "tools/mcpack2pb_gen.py",
+         "brpc_tpu.rpc.proto.echo_pb2:EchoRequest", "-o", str(out)],
+        cwd="/root/repo", capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    check = subprocess.run(
+        [_sys.executable, "-c",
+         f"import sys; sys.path.insert(0, '.'); "
+         f"sys.path.insert(0, {str(tmp_path)!r}); "
+         "import standalone_codec as c; "
+         "from brpc_tpu.rpc.proto import echo_pb2; "
+         "w = c.serialize_echo_request("
+         "echo_pb2.EchoRequest(message='fresh')); "
+         "assert c.parse_echo_request(w).message == 'fresh'; "
+         "print('standalone ok')"],
+        cwd="/root/repo", capture_output=True, text=True)
+    assert check.returncode == 0, check.stderr
+    assert "standalone ok" in check.stdout
