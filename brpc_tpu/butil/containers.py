@@ -1,12 +1,9 @@
 """butil misc containers + utilities.
 
 Counterparts of the remaining §2.1 base pieces
-(/root/reference/src/butil/): FlatMap (containers/flat_map.h:110-132),
-fast_rand (fast_rand.cpp), crc32c (crc32c.cc), RawPacker/RawUnpacker
-(raw_pack.h), ThreadLocal (thread_local.h). CPython's dict is already an
-open-addressing hash table, so FlatMap keeps the reference's API
-(seek/insert/erase/init) over it rather than re-probing by hand —
-idiomatic, same capability.
+(/root/reference/src/butil/): FlatMap (containers/flat_map.h +
+flat_map_inl.h), fast_rand (fast_rand.cpp), crc32c (crc32c.cc),
+RawPacker/RawUnpacker (raw_pack.h), ThreadLocal (thread_local.h).
 """
 from __future__ import annotations
 
@@ -19,49 +16,136 @@ K = TypeVar("K")
 V = TypeVar("V")
 
 
+class _Node:
+    __slots__ = ("key", "value", "next")
+
+    def __init__(self, key, value, next_=None):
+        self.key = key
+        self.value = value
+        self.next = next_
+
+
 class FlatMap(Generic[K, V]):
-    """flat_map.h API surface over a native hash map."""
+    """The reference's "one-level hashing" map (flat_map_inl.h:342-530): a
+    bucket array whose slots EMBED the first entry, with collisions
+    chained off the embedded node; a resize doubles buckets whenever
+    size*100 >= nbucket*load_factor (flat_map.h:279-281). Most lookups hit
+    the embedded slot directly — the cache-friendliness the reference
+    builds the structure for."""
 
-    def __init__(self, nbucket: int = 32):
-        self._map: dict = {}
-        self._nbucket = nbucket  # kept for API parity; dict self-sizes
+    def __init__(self, nbucket: int = 32, load_factor: int = 80):
+        self._nbucket = max(1, nbucket)
+        self._load_factor = load_factor
+        self._buckets: list = [None] * self._nbucket
+        self._size = 0
 
-    def init(self, nbucket: int) -> bool:
-        self._nbucket = nbucket
+    def init(self, nbucket: int, load_factor: int = 80) -> bool:
+        if self._size:
+            return False  # init only before use, as the reference
+        self._nbucket = max(1, nbucket)
+        self._load_factor = load_factor
+        self._buckets = [None] * self._nbucket
         return True
 
+    def _index(self, key) -> int:
+        return hash(key) % self._nbucket  # flatmap_mod (flat_map_inl.h:72)
+
+    def _maybe_resize(self):
+        if (self._size + 1) * 100 >= self._nbucket * self._load_factor:
+            self.resize(self._nbucket * 2)
+
+    def resize(self, nbucket: int) -> bool:
+        old = self._buckets
+        self._nbucket = max(1, nbucket)
+        self._buckets = [None] * self._nbucket
+        for node in old:
+            while node is not None:
+                nxt = node.next
+                idx = self._index(node.key)
+                node.next = self._buckets[idx]
+                self._buckets[idx] = node
+                node = nxt
+        return True
+
+    def _find_node(self, key) -> Optional[_Node]:
+        node = self._buckets[self._index(key)]
+        while node is not None:
+            if node.key == key:
+                return node
+            node = node.next
+        return None
+
     def insert(self, key: K, value: V) -> V:
-        self._map[key] = value
+        node = self._find_node(key)
+        if node is not None:
+            node.value = value
+            return value
+        self._maybe_resize()
+        idx = self._index(key)
+        self._buckets[idx] = _Node(key, value, self._buckets[idx])
+        self._size += 1
         return value
 
     def seek(self, key: K) -> Optional[V]:
-        return self._map.get(key)
+        node = self._find_node(key)
+        return node.value if node is not None else None
 
     def __getitem__(self, key: K) -> V:
-        """operator[]: inserts default None if missing (flat_map semantic is
-        default-construct; here: None)."""
-        return self._map.setdefault(key, None)
+        """operator[]: inserts default None if missing (flat_map semantic
+        is default-construct; here: None)."""
+        node = self._find_node(key)
+        if node is not None:
+            return node.value
+        self._maybe_resize()
+        idx = self._index(key)
+        self._buckets[idx] = _Node(key, None, self._buckets[idx])
+        self._size += 1
+        return None
 
     def __setitem__(self, key: K, value: V):
-        self._map[key] = value
+        self.insert(key, value)
 
     def erase(self, key: K) -> int:
-        return 1 if self._map.pop(key, _MISSING) is not _MISSING else 0
+        idx = self._index(key)
+        node = self._buckets[idx]
+        prev = None
+        while node is not None:
+            if node.key == key:
+                if prev is None:
+                    self._buckets[idx] = node.next
+                else:
+                    prev.next = node.next
+                self._size -= 1
+                return 1
+            prev, node = node, node.next
+        return 0
 
     def __len__(self) -> int:
-        return len(self._map)
+        return self._size
 
     def __contains__(self, key: K) -> bool:
-        return key in self._map
+        return self._find_node(key) is not None
 
     def empty(self) -> bool:
-        return not self._map
+        return self._size == 0
 
     def clear(self):
-        self._map.clear()
+        self._buckets = [None] * self._nbucket
+        self._size = 0
+
+    @property
+    def nbucket(self) -> int:
+        return self._nbucket
+
+    @property
+    def load_factor(self) -> int:
+        return self._load_factor
 
     def __iter__(self) -> Iterator[Tuple[K, V]]:
-        return iter(self._map.items())
+        for node in self._buckets:
+            while node is not None:
+                yield node.key, node.value
+                node = node.next
 
 
 _MISSING = object()

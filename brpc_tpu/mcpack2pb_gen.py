@@ -61,7 +61,7 @@ def _has_presence(field) -> bool:
         return field.has_presence
     except AttributeError:  # older protobuf
         syntax = getattr(field.file, "syntax", None)
-        return bool(field.label == FD.LABEL_OPTIONAL
+        return bool(field.label in (FD.LABEL_OPTIONAL, FD.LABEL_REQUIRED)
                     and (syntax == "proto2"
                          or field.containing_oneof is not None))
 
@@ -78,14 +78,13 @@ def _defining_module(cls) -> str:
     entry exposing the class instead."""
     import sys as _sys
 
-    name = getattr(cls, "__module__", None)
-    mod = _sys.modules.get(name) if name else None
-    if mod is not None and getattr(mod, cls.__name__, None) is cls:
-        return name
     candidates = [n for n, m in list(_sys.modules.items())
                   if m is not None
                   and getattr(m, cls.__name__, None) is cls]
-    return min(candidates, key=len) if candidates else ""
+    # Prefer the fully-qualified (dotted) name: a bare stem like
+    # 'echo_pb2' only imports when the proto dir itself is on sys.path,
+    # which a fresh consumer process usually doesn't have.
+    return max(candidates, key=len) if candidates else ""
 
 
 def _collect_and_name(message_classes):
@@ -189,7 +188,10 @@ def _emit_parser(lines: List[str], desc, fn_name: str, cls_expr: str,
         lines.append("    if v is not None:")
         if _is_map(field):
             key_field = field.message_type.fields_by_name["key"]
-            _, kcoerce = _TYPE_MAP[key_field.type]
+            if key_field.type == FD.TYPE_BOOL:
+                kcoerce = "_bool_key"  # bool('False') is True; compare
+            else:
+                _, kcoerce = _TYPE_MAP[key_field.type]
             value_field = field.message_type.fields_by_name["value"]
             lines.append("        for k, item in v.items():")
             if value_field.type == FD.TYPE_MESSAGE:
@@ -238,6 +240,10 @@ def _to_str(v):
 
 def _to_bytes(v):
     return v.encode() if isinstance(v, str) else bytes(v)
+
+
+def _bool_key(v):
+    return v == "True" if isinstance(v, str) else bool(v)
 
 
 '''

@@ -313,6 +313,14 @@ _dev_shm = bvar.Adder("device_transport_shm_transfers")
 _dev_wire = bvar.Adder("device_transport_wire_transfers")
 
 
+def lane_counters() -> dict:
+    """Public per-lane transfer counts (also exposed as bvars under
+    device_transport_*): {'inproc': N, 'shm': N, 'wire': N}."""
+    return {"inproc": _dev_zero_copy.get_value(),
+            "shm": _dev_shm.get_value(),
+            "wire": _dev_wire.get_value()}
+
+
 def inproc_publish(arrays: List) -> int:
     """Register device arrays for same-process zero-copy pickup; returns a
     ticket riding the wire in their place. The DeviceBlockPool brackets the

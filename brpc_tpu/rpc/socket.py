@@ -142,10 +142,12 @@ class Socket:
 
     @classmethod
     def address(cls, sid: int) -> Optional["Socket"]:
-        sock = cls._get_pool().address(sid)
-        if sock is None or sock._failed:
-            return None if sock is None else sock
-        return sock
+        """Version-validated id lookup (socket_inl.h:28-185 Address): None
+        once the socket is recycled. A SetFailed socket is still
+        addressable — failure is a separate state callers check with
+        .failed(), exactly as in the reference (health check and error
+        reporting need to reach failed-but-live sockets)."""
+        return cls._get_pool().address(sid)
 
     @property
     def socket_id(self) -> int:

@@ -70,8 +70,8 @@ def main():
     wall = time.perf_counter() - t0
 
     total = bytes_moved.get_value()
-    lane = ("shm" if dt._dev_shm.get_value() else
-            "inproc" if dt._dev_zero_copy.get_value() else "wire")
+    counters = dt.lane_counters()
+    lane = max(counters, key=counters.get)
     print(f"lane={lane} pushes={recorder.count()} "
           f"errors={errors.get_value()}")
     print(f"avg={recorder.latency():.0f}us "

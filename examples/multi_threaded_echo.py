@@ -61,7 +61,7 @@ def main():
         t.start()
     deadline = time.monotonic() + args.seconds
     while time.monotonic() < deadline:
-        time.sleep(min(1.0, deadline - time.monotonic()) or 0.1)
+        time.sleep(max(0.05, min(1.0, deadline - time.monotonic())))
         print(f"qps={recorder.qps():.0f} avg={recorder.latency():.0f}us "
               f"p99={recorder.latency_percentile(0.99):.0f}us "
               f"max={recorder.max_latency():.0f}us "

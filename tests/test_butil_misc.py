@@ -98,3 +98,44 @@ def test_dbd_concurrent_read_modify():
     assert not errors
     with dbd.read() as servers:
         assert servers == list(range(200))
+
+
+def test_flatmap_one_level_hashing():
+    """FlatMap is a real bucket table (flat_map_inl.h shape): embedded
+    first slots + chained collisions + load-factor resize."""
+    from brpc_tpu.butil.containers import FlatMap
+
+    m = FlatMap(nbucket=4, load_factor=80)
+    for i in range(100):
+        m.insert(i, i * 10)
+    assert len(m) == 100
+    assert m.nbucket > 4  # resized as the load factor was crossed
+    for i in range(100):
+        assert m.seek(i) == i * 10
+        assert i in m
+    assert m.seek(1000) is None
+    # erase unlinks both embedded and chained nodes
+    for i in range(0, 100, 2):
+        assert m.erase(i) == 1
+    assert m.erase(0) == 0
+    assert len(m) == 50
+    assert sorted(k for k, _ in m) == list(range(1, 100, 2))
+    # operator[] default-constructs (None), and None values are contained
+    assert m[777] is None
+    assert 777 in m and len(m) == 51
+    m[777] = 7
+    assert m.seek(777) == 7
+    m.clear()
+    assert m.empty() and m.seek(1) is None
+
+
+def test_flatmap_collisions_chain():
+    from brpc_tpu.butil.containers import FlatMap
+
+    m = FlatMap(nbucket=1, load_factor=10**9)  # force one bucket: all chain
+    for i in range(32):
+        m.insert(f"k{i}", i)
+    assert m.nbucket == 1 and len(m) == 32
+    assert all(m.seek(f"k{i}") == i for i in range(32))
+    assert m.erase("k31") == 1 and m.erase("k0") == 1
+    assert m.seek("k30") == 30 and len(m) == 30
