@@ -117,6 +117,11 @@ def load() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_double, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)]
         lib.nat_rpc_client_bench.restype = ctypes.c_double
+        lib.nat_rpc_use_io_uring.argtypes = [ctypes.c_int]
+        lib.nat_rpc_use_io_uring.restype = ctypes.c_int
+        lib.nat_ring_counters.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_ring_counters.restype = None
         _lib = lib
         return lib
 
@@ -185,6 +190,20 @@ def rpc_server_start(ip: str = "127.0.0.1", port: int = 0,
     if rc <= 0:
         raise RuntimeError("native rpc server failed to start")
     return rc
+
+
+def use_io_uring(enable: bool = True) -> int:
+    """Toggle the RingListener datapath (the fork's -use_io_uring). Returns
+    1 = ring live, 0 = kernel refused (epoll stays), -1 = runtime error."""
+    return load().nat_rpc_use_io_uring(1 if enable else 0)
+
+
+def ring_counters():
+    """(recv_completions, send_completions) of the io_uring datapath."""
+    recv = ctypes.c_uint64()
+    send = ctypes.c_uint64()
+    load().nat_ring_counters(ctypes.byref(recv), ctypes.byref(send))
+    return recv.value, send.value
 
 
 def rpc_server_stop():

@@ -56,6 +56,7 @@
 #include <vector>
 
 #include "iobuf.h"
+#include "ring_listener.h"
 #include "rpc_meta.h"
 #include "scheduler.h"
 
@@ -114,6 +115,15 @@ struct NatSocket {
   // scheduled behind the currently-ready fibers drains everything they
   // appended in ONE writev. Throughput over per-call latency.
   bool defer_writes = false;
+
+  // io_uring datapath (RingListener): registered-file index when this
+  // socket's reads ride the provided-buffer ring, and the fixed-send
+  // state (one in-flight fixed-buffer send at a time keeps ordering;
+  // the fork's io_uring_write_req_, socket.h:632-636).
+  std::atomic<int> ring_fidx{-1};  // atomic: drain workers read it while
+                                   // accept/set_failed threads write it
+  bool ring_sending = false;   // under write_mu
+  size_t ring_inflight = 0;    // bytes submitted, awaiting completion
 
   void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
   void release();
@@ -384,13 +394,27 @@ void NatSocket::release() {
   }
 }
 
+static RingListener* g_ring = nullptr;
+static std::atomic<bool> g_use_ring{false};
+static std::mutex g_ring_retry_mu;
+static std::vector<uint64_t> g_ring_retry;  // sockets with unsubmitted sends
+static std::atomic<bool> g_ring_draining{false};
+
 void NatSocket::set_failed() {
   bool was = failed.exchange(true);
   if (was) return;
   {
+    int fidx = ring_fidx.exchange(-1, std::memory_order_acq_rel);
+    if (fidx >= 0 && g_ring != nullptr) {
+      g_ring->unregister_file(fidx);  // cancels the multishot recv
+    }
+  }
+  {
     std::lock_guard<std::mutex> g(write_mu);
     write_q.clear();
     writing = false;
+    ring_sending = false;
+    ring_inflight = 0;
   }
   if (fd >= 0) {
     epoll_ctl(disp->epfd, EPOLL_CTL_DEL, fd, nullptr);
@@ -472,8 +496,48 @@ static void keep_write_fiber(void* arg) {
   s->release();
 }
 
+// Submits the front of write_q as one fixed-buffer send. Requires
+// write_mu. Returns false when no buffer/SQE was free (retry later via
+// the drain loop's retry list).
+static bool ring_submit_locked(NatSocket* s) {
+  if (s->ring_sending || s->write_q.empty()
+      || s->failed.load(std::memory_order_acquire)) {
+    return true;
+  }
+  int fidx = s->ring_fidx.load(std::memory_order_acquire);
+  if (fidx < 0) return true;  // demoted/failed; bytes drain elsewhere
+  uint16_t buf;
+  char* dst = g_ring->acquire_send_buffer(&buf);
+  if (dst == nullptr) return false;
+  size_t n = s->write_q.length();
+  if (n > RingListener::kSendBufSize) n = RingListener::kSendBufSize;
+  s->write_q.copy_to(dst, n);  // straight into registered memory
+  if (!g_ring->submit_send(fidx, s->id, buf, n)) return false;
+  s->ring_sending = true;
+  s->ring_inflight = n;
+  return true;
+}
+
+static void ring_retry_later(uint64_t sock_id) {
+  std::lock_guard<std::mutex> g(g_ring_retry_mu);
+  g_ring_retry.push_back(sock_id);
+}
+
 int NatSocket::write(IOBuf&& frame) {
   if (failed.load(std::memory_order_acquire)) return -1;
+  if (ring_fidx.load(std::memory_order_acquire) >= 0) {
+    // io_uring lane: queue + submit from registered send memory; ordering
+    // is kept by the single-in-flight discipline.
+    bool need_retry;
+    {
+      std::lock_guard<std::mutex> g(write_mu);
+      if (failed.load(std::memory_order_acquire)) return -1;
+      write_q.append(std::move(frame));
+      need_retry = !ring_submit_locked(this);
+    }
+    if (need_retry) ring_retry_later(id);
+    return 0;
+  }
   bool become_writer = false;
   {
     std::lock_guard<std::mutex> g(write_mu);
@@ -667,6 +731,104 @@ static void reader_fiber(void* arg) {
   s->release();
 }
 
+// Moves a ring socket to the epoll lane (rearm impossible / multishot
+// unsupported); the CAS makes demotion and set_failed mutually exclusive.
+static void ring_demote_to_epoll(NatSocket* s, int fidx) {
+  if (s->ring_fidx.compare_exchange_strong(fidx, -1)) {
+    g_ring->unregister_file(fidx);
+    s->disp->add_consumer(s);
+  }
+}
+
+// Drains harvested ring completions — the wait_task drain of the fork
+// (task_group.cpp:158-169): recv bytes feed the SAME cut loop the epoll
+// readers use; send completions recycle fixed buffers and launch the next
+// chunk. Registered as a scheduler idle hook; one worker drains at a time
+// so per-socket completion order is preserved.
+static bool ring_drain() {
+  if (g_ring == nullptr) return false;
+  if (g_ring_draining.exchange(true, std::memory_order_acquire)) {
+    return false;
+  }
+  bool did = false;
+  RingCompletion c;
+  while (g_ring->pop_completion(&c)) {
+    did = true;
+    NatSocket* s = sock_address(c.tag);
+    if (c.kind == 0) {  // recv
+      if (c.res > 0) {
+        if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+          s->in_buf.append(g_ring->buffer_data(c.buf_id), (size_t)c.res);
+          g_ring->recycle_buffer(c.buf_id);
+          int fidx = s->ring_fidx.load(std::memory_order_acquire);
+          if (!process_input(s)) {
+            s->set_failed();
+          } else if (!c.more && fidx >= 0
+                     && !g_ring->rearm_recv(fidx, s->id)) {
+            ring_demote_to_epoll(s, fidx);  // SQ full: don't go deaf
+          }
+        } else {
+          g_ring->recycle_buffer(c.buf_id);  // owner gone: recycle only
+        }
+      } else if (s != nullptr) {
+        int fidx = s->ring_fidx.load(std::memory_order_acquire);
+        if (c.res == -ENOBUFS) {
+          // provided buffers were exhausted; they're recycled as we
+          // drain, so re-arm and keep going
+          if (fidx >= 0 && !g_ring->rearm_recv(fidx, s->id)) {
+            ring_demote_to_epoll(s, fidx);
+          }
+        } else if (c.res == -EINVAL && fidx >= 0) {
+          // kernel lacks multishot recv (pre-6.0): demote this
+          // connection to the epoll lane instead of killing it
+          ring_demote_to_epoll(s, fidx);
+        } else if (!c.more) {
+          s->set_failed();  // EOF (0) or hard error
+        }
+      }
+    } else {  // send
+      g_ring->recycle_send_buffer(c.send_buf);
+      if (s != nullptr) {
+        if (c.res < 0) {
+          s->set_failed();
+        } else {
+          bool need_retry;
+          {
+            std::lock_guard<std::mutex> g(s->write_mu);
+            size_t done = (size_t)c.res;
+            if (done > s->ring_inflight) done = s->ring_inflight;
+            s->write_q.pop_front(done);
+            s->ring_sending = false;
+            s->ring_inflight = 0;
+            need_retry = !ring_submit_locked(s);
+          }
+          if (need_retry) ring_retry_later(s->id);
+        }
+      }
+    }
+    if (s != nullptr) s->release();
+  }
+  // retry sends that couldn't get a buffer/SQE earlier
+  std::vector<uint64_t> retry;
+  {
+    std::lock_guard<std::mutex> g(g_ring_retry_mu);
+    retry.swap(g_ring_retry);
+  }
+  for (uint64_t sid : retry) {
+    NatSocket* s = sock_address(sid);
+    if (s == nullptr) continue;
+    bool again;
+    {
+      std::lock_guard<std::mutex> g(s->write_mu);
+      again = !ring_submit_locked(s);
+    }
+    if (again) ring_retry_later(sid);
+    s->release();
+  }
+  g_ring_draining.store(false, std::memory_order_release);
+  return did;
+}
+
 void Dispatcher::accept_loop(int lfd, NatServer* srv) {
   while (true) {
     int cfd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
@@ -679,6 +841,19 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
     s->server = srv;
     srv->connections.fetch_add(1);
     sock_register(s);  // the registry holds the initial reference
+    if (g_use_ring.load(std::memory_order_acquire) && g_ring != nullptr) {
+      // publish the file index BEFORE arming recv: the first completion
+      // can fire the instant the recv is armed
+      int fidx = g_ring->register_file(cfd);
+      if (fidx >= 0) {
+        s->ring_fidx.store(fidx, std::memory_order_release);
+        if (g_ring->rearm_recv(fidx, s->id)) {
+          continue;  // the ring owns this read path
+        }
+        s->ring_fidx.store(-1, std::memory_order_release);
+        g_ring->unregister_file(fidx);
+      }
+    }
     add_consumer(s);
   }
 }
@@ -1100,6 +1275,46 @@ double nat_rpc_client_bench(const char* ip, int port, int nconn,
   for (NatChannel* ch : channels) nat_channel_close(ch);
   if (out_requests) *out_requests = total.load();
   return dt > 0 ? (double)total.load() / dt : 0.0;
+}
+
+// -- io_uring datapath control (the fork's -use_io_uring runtime flag,
+// socket.cpp:62) ------------------------------------------------------------
+
+// Enables the RingListener datapath for subsequently-accepted server
+// connections. Returns 1 when the ring is live, 0 when the kernel/sandbox
+// refuses io_uring (the runtime stays on epoll), -1 on runtime failure.
+int nat_rpc_use_io_uring(int enable) {
+  if (!enable) {
+    g_use_ring.store(false, std::memory_order_release);
+    return 0;
+  }
+  if (ensure_runtime(0) != 0) return -1;
+  {
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    if (g_ring == nullptr) {
+      RingListener* ring = new RingListener();
+      // wake a parked worker per completion batch (ExtWakeup role);
+      // installed before init() so the poller never runs without it
+      ring->set_wake_fn([] { Scheduler::instance()->wake_one(); });
+      if (!ring->init()) {
+        delete ring;
+        return 0;  // io_uring unavailable here: keep epoll
+      }
+      g_ring = ring;
+      // the wait_task drain seam (task_group.cpp:158-169)
+      Scheduler::instance()->add_idle_hook(ring_drain);
+    }
+  }
+  g_use_ring.store(true, std::memory_order_release);
+  return 1;
+}
+
+// Ring observability for tests/bench: completion counts.
+void nat_ring_counters(uint64_t* recv_out, uint64_t* send_out) {
+  if (recv_out != nullptr)
+    *recv_out = g_ring != nullptr ? g_ring->recv_completions() : 0;
+  if (send_out != nullptr)
+    *send_out = g_ring != nullptr ? g_ring->send_completions() : 0;
 }
 
 }  // extern "C"

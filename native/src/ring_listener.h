@@ -1,0 +1,183 @@
+// RingListener — the io_uring datapath of the monographdb fork, rebuilt on
+// raw syscalls (no liburing in this image).
+//
+// Counterpart of bthread/ring_listener.h (/root/reference/src/bthread/
+// ring_listener.h:65-143) + inbound_ring_buf.h: one io_uring instance with
+//   * registered sparse FILES (sockets address the kernel by fixed index),
+//   * a PROVIDED BUFFER RING for receives — the kernel picks a
+//     pre-registered buffer per completion, so the hot read path does no
+//     allocation and no extra syscall,
+//   * multishot RECV per socket (one SQE, many completions),
+//   * fixed-buffer SENDs from registered memory (ring_write_buf_pool.h),
+//   * a poller thread harvesting CQEs into a completion queue that the
+//     FIBER SCHEDULER drains from its idle loop (task_group.cpp:158-169
+//     drains the SPSC into wait_task) — completions are processed by
+//     workers, not by the poller.
+//
+// The class is transport-generic: the RPC runtime (nat_rpc.cpp) owns
+// sockets and framing; completions come back tagged with the caller's id.
+#pragma once
+
+#include <linux/io_uring.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace brpc_tpu {
+
+// One harvested completion, handed from the poller to a worker
+// (InboundRingBuf's role, inbound_ring_buf.h:28-54).
+struct RingCompletion {
+  uint64_t tag = 0;     // caller-chosen id (socket id)
+  int kind = 0;         // 0 = recv, 1 = send
+  int32_t res = 0;      // CQE result (bytes or -errno)
+  uint16_t buf_id = 0;  // provided buffer carrying the bytes (recv)
+  uint16_t send_buf = 0;  // fixed buffer to recycle (send)
+  bool more = false;    // multishot still armed (IORING_CQE_F_MORE)
+};
+
+class RingListener {
+ public:
+  static constexpr unsigned kEntries = 256;     // SQ depth
+  static constexpr unsigned kNumBufs = 256;     // provided recv buffers
+  static constexpr unsigned kBufSize = 16384;   // each 16KB
+  static constexpr unsigned kNumSendBufs = 64;  // fixed send buffers
+  static constexpr unsigned kSendBufSize = 16384;
+  static constexpr unsigned kMaxFiles = 4096;   // registered-file table
+
+  ~RingListener() { shutdown(); }
+
+  bool available() const { return ring_fd_ >= 0; }
+
+  // Sets up the ring, provided-buffer ring, file table, send buffers and
+  // the poller thread. False when the kernel/sandbox refuses io_uring.
+  bool init(unsigned entries = kEntries);
+  void shutdown();
+
+  // Registers fd into the fixed-file table WITHOUT arming recv; the
+  // caller publishes the returned index on its socket first, then arms
+  // via rearm_recv — completions may fire the instant recv is armed, so
+  // the index must be visible before then. Returns -1 when the table is
+  // exhausted (indices are never reused: a recycled slot could receive a
+  // stale in-flight rearm from the drain path).
+  int register_file(int fd);
+  void unregister_file(int file_index);
+
+  // Re-arms multishot recv after the kernel dropped it (more==false).
+  bool rearm_recv(int file_index, uint64_t tag);
+
+  // Fixed-buffer send, zero intermediate copies: acquire a registered
+  // buffer, fill it directly, then submit. acquire_send_buffer returns
+  // the writable pointer or nullptr when the pool is empty;
+  // submit_send consumes the buffer (returns false when no SQE is free —
+  // the buffer is released back to the pool). `tag` and the buffer index
+  // come back in the send completion.
+  char* acquire_send_buffer(uint16_t* buf_out);
+  void release_send_buffer(uint16_t buf);
+  bool submit_send(int file_index, uint64_t tag, uint16_t buf, size_t len);
+
+  // Bytes of a recv completion; valid until recycle_buffer(buf_id).
+  const char* buffer_data(uint16_t buf_id) const {
+    return buf_base_ + (size_t)buf_id * kBufSize;
+  }
+  void recycle_buffer(uint16_t buf_id);
+  void recycle_send_buffer(uint16_t idx);
+
+  struct io_uring_buf* ring_entry(unsigned idx) {
+    return (struct io_uring_buf*)buf_ring_ + idx;
+  }
+  std::atomic<uint16_t>* ring_tail_atomic() {
+    // tail lives in entry 0's resv halfword (ring base + 14)
+    return (std::atomic<uint16_t>*)((char*)buf_ring_ + 14);
+  }
+
+  // Called by the poller after enqueuing completions — wires to the
+  // scheduler's wake (task_group ExtWakeup role) so completions don't
+  // wait out a park timeout.
+  void set_wake_fn(std::function<void()> fn) { wake_fn_ = std::move(fn); }
+
+  // Pops one harvested completion; the scheduler idle hook loops this
+  // (the wait_task drain, task_group.cpp:158-169).
+  bool pop_completion(RingCompletion* out) {
+    std::lock_guard<std::mutex> g(comp_mu_);
+    if (comp_q_.empty()) return false;
+    *out = comp_q_.front();
+    comp_q_.pop_front();
+    return true;
+  }
+
+  uint64_t recv_completions() const {
+    return n_recv_.load(std::memory_order_relaxed);
+  }
+  uint64_t send_completions() const {
+    return n_send_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  bool setup_rings(unsigned entries);
+  bool setup_buf_ring();
+  bool setup_files_and_sendbufs();
+  struct io_uring_sqe* get_sqe_locked();
+  void submit_locked();
+  void flush_unsubmitted_locked();
+  void poller_loop();
+
+  int ring_fd_ = -1;
+  // SQ mmap
+  void* sq_ring_ = nullptr;
+  size_t sq_ring_sz_ = 0;
+  std::atomic<unsigned>* sq_head_ = nullptr;
+  std::atomic<unsigned>* sq_tail_ = nullptr;
+  unsigned* sq_mask_ = nullptr;
+  unsigned* sq_array_ = nullptr;
+  struct io_uring_sqe* sqes_ = nullptr;
+  size_t sqes_sz_ = 0;
+  // CQ mmap
+  void* cq_ring_ = nullptr;
+  size_t cq_ring_sz_ = 0;
+  std::atomic<unsigned>* cq_head_ = nullptr;
+  std::atomic<unsigned>* cq_tail_ = nullptr;
+  unsigned* cq_mask_ = nullptr;
+  struct io_uring_cqe* cqes_ = nullptr;
+
+  // provided buffer ring (IORING_REGISTER_PBUF_RING, bgid 0).
+  // NOTE kernel ABI: ring entries start at the ring BASE (entry 0's tail
+  // halfword doubles as the ring tail) — the C++ expansion of
+  // io_uring_buf_ring's flex-array union puts `bufs` at offset 8, so we
+  // address entries manually instead of through that member.
+  void* buf_ring_ = nullptr;
+  size_t buf_ring_sz_ = 0;
+  char* buf_base_ = nullptr;  // kNumBufs * kBufSize payload arena
+  unsigned buf_mask_ = 0;
+  uint16_t buf_ring_tail_ = 0;
+  std::mutex buf_mu_;
+
+  // fixed send buffers (IORING_REGISTER_BUFFERS)
+  char* send_base_ = nullptr;
+  std::vector<uint16_t> send_free_;
+  std::vector<uint64_t> send_tag_;  // buf index -> in-flight tag
+  std::mutex send_mu_;
+
+  std::mutex sq_mu_;
+  std::mutex comp_mu_;
+  std::deque<RingCompletion> comp_q_;
+  std::thread poller_;
+  std::atomic<bool> stop_{false};
+  std::atomic<uint64_t> n_recv_{0};
+  std::atomic<uint64_t> n_send_{0};
+  std::mutex files_mu_;
+  unsigned next_file_ = 0;  // monotonic: file indices are never reused
+  std::function<void()> wake_fn_;
+  unsigned unsubmitted_ = 0;  // SQEs published but not yet accepted
+};
+
+}  // namespace brpc_tpu

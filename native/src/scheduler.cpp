@@ -111,6 +111,12 @@ void Worker::signal() {
   }
 }
 
+void Scheduler::wake_one() {
+  if (workers_.empty()) return;
+  uint32_t i = wake_rr_.fetch_add(1, std::memory_order_relaxed);
+  workers_[i % workers_.size()]->signal();
+}
+
 Scheduler* Scheduler::instance() {
   static Scheduler s;
   return &s;
@@ -348,13 +354,34 @@ void Scheduler::worker_loop(Worker* w) {
     Fiber* f = next_task(w);
     if (f != nullptr) {
       run_fiber(w, f);
+      // Task-boundary hook pass (the fork drains its ring queue in
+      // wait_task between tasks, task_group.cpp:158-169): under
+      // sustained fiber load a worker never goes idle, so completions
+      // would starve if hooks only ran on full idleness.
+      if ((++w->boundary_ticks & 63) == 0) {
+        std::shared_ptr<std::vector<std::function<bool()>>> hooks;
+        {
+          std::lock_guard<std::mutex> g(hooks_mu_);
+          hooks = idle_hooks_;
+        }
+        if (hooks) {
+          for (auto& h : *hooks) h();
+        }
+      }
       continue;
     }
-    // idle: run hooks (the libtpu/ext-processor seam), then park
+    // idle: run hooks (the libtpu/ext-processor seam), then park.
+    // The hook list is copy-on-write: grab the snapshot under the lock,
+    // run the hooks outside it so a slow hook never blocks other
+    // workers' idle paths.
     bool did_work = false;
+    std::shared_ptr<std::vector<std::function<bool()>>> hooks;
     {
       std::lock_guard<std::mutex> g(hooks_mu_);
-      for (auto& h : idle_hooks_) did_work |= h();
+      hooks = idle_hooks_;
+    }
+    if (hooks) {
+      for (auto& h : *hooks) did_work |= h();
     }
     if (did_work) continue;
     std::unique_lock<std::mutex> lk(w->park_mu);

@@ -15,6 +15,7 @@
 #include <cstdint>
 #include <deque>
 #include <functional>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <ucontext.h>
@@ -74,6 +75,7 @@ class Worker {
   std::condition_variable park_cv;
   std::atomic<uint32_t> park_signal{0};
   std::atomic<int> parked{0};  // gate: skip notify when nobody sleeps
+  uint32_t boundary_ticks = 0;  // task-boundary hook cadence (worker-local)
   std::thread thread;
   Scheduler* sched = nullptr;
   int id = 0;
@@ -131,8 +133,17 @@ class Scheduler {
 
   void add_idle_hook(std::function<bool()> hook) {
     std::lock_guard<std::mutex> g(hooks_mu_);
-    idle_hooks_.push_back(std::move(hook));
+    auto next = std::make_shared<std::vector<std::function<bool()>>>(
+        idle_hooks_ ? *idle_hooks_ : std::vector<std::function<bool()>>());
+    next->push_back(std::move(hook));
+    idle_hooks_ = std::move(next);  // copy-on-write: workers run hooks
+                                    // WITHOUT holding hooks_mu_
   }
+
+  // Wakes one parked worker — external completion sources (RingListener
+  // poller, libtpu callbacks) use this so completions don't wait out the
+  // park timeout (the ExtWakeup of ring_listener.h:42-63).
+  void wake_one();
 
   uint64_t total_switches() const;
 
@@ -146,7 +157,8 @@ class Scheduler {
   bool started_ = false;
   std::atomic<uint32_t> next_worker_{0};
   std::mutex hooks_mu_;
-  std::vector<std::function<bool()>> idle_hooks_;
+  std::shared_ptr<std::vector<std::function<bool()>>> idle_hooks_;
+  std::atomic<uint32_t> wake_rr_{0};
 
   Fiber* next_task(Worker* w);
   void run_fiber(Worker* w, Fiber* f);
