@@ -1,0 +1,425 @@
+"""nova_pbrpc / public_pbrpc / ubrpc — the remaining Baidu legacy pb-rpc
+variants, all riding nshead framing.
+
+Counterparts of /root/reference/src/brpc/policy/{nova_pbrpc_protocol.cpp,
+public_pbrpc_protocol.cpp, ubrpc2pb_protocol.cpp}. Like the reference
+(global.cpp:449,460,537 register NULL process_request), these are
+CLIENT-side protocols; servers answer them through NsheadService adaptors
+(the NovaServiceAdaptor shape, nova_pbrpc_protocol.cpp:52-111) installed
+as ServerOptions.nshead_service.
+
+Wire shapes:
+  nova   — nshead + pb body; method index rides nshead.reserved; the
+           snappy flag rides nshead.version (nova_pbrpc_protocol.cpp:
+           43-51); correlation parks on the socket (pooled/short).
+  public — nshead + PublicPbrpcRequest/Response envelope pb; correlation
+           is requestBody.id, so single connections work.
+  ubrpc  — nshead + mcpack object {method, params:[{request...}]}
+           (ubrpc2pb_protocol.cpp's compack/mcpack unboxing); correlation
+           parks on the socket.
+"""
+from __future__ import annotations
+
+from brpc_tpu.bthread import id as bthread_id
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import compress as compress_mod
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.nshead_protocol import (
+    NsheadInputMessage,
+    NsheadMessage,
+    NsheadService,
+    parse as nshead_parse,
+)
+from brpc_tpu.rpc.protocol import (
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+from brpc_tpu.rpc.proto import legacy_meta_pb2 as _pb
+
+_NOVA_SNAPPY_VERSION = 1  # nshead.version flag value for snappy bodies
+
+
+def _pb_serialize_request(request, cntl: Controller):
+    if isinstance(request, (bytes, bytearray)):
+        return bytes(request)
+    return request.SerializeToString()
+
+
+def _stale_guard(sock, attr: str, correlation_id: int):
+    """esp's socket-parked-correlation discipline: a previous RPC whose
+    response was never consumed poisons the connection — a late reply
+    could complete the WRONG call (esp_protocol.py pack_request)."""
+    if getattr(sock, attr, None) is not None:
+        sock.set_failed(errors.ECLOSE,
+                        f"{attr.split('_')[0]} response outstanding")
+        raise ValueError("socket has an unconsumed in-flight response")
+    setattr(sock, attr, correlation_id)
+
+
+def _client_parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    """These protocols never serve a port: claim frames only on client
+    connections (arg None), with nshead's own framing."""
+    if arg is not None:
+        return ParseResult.try_others()
+    res = nshead_parse(portal, sock, read_eof, arg)
+    if res.error == 0 and res.message is not None:
+        res.message.is_request = False  # responses on a client socket
+    return res
+
+
+def _lock_controller(cid: int):
+    try:
+        cntl = bthread_id.lock(cid)
+    except (KeyError, TimeoutError):
+        return None
+    if not isinstance(cntl, Controller):
+        try:
+            bthread_id.unlock(cid)
+        except Exception:
+            pass
+        return None
+    return cntl
+
+
+# -- nova_pbrpc --------------------------------------------------------------
+
+def _nova_pack_request(payload: bytes, cntl: Controller,
+                       correlation_id: int) -> IOBuf:
+    _stale_guard(cntl._current_sock, "nova_correlation_id", correlation_id)
+    version = 0
+    if cntl.compress_type == compress_mod.COMPRESS_SNAPPY:
+        payload = compress_mod.compress(payload, cntl.compress_type)
+        version = _NOVA_SNAPPY_VERSION
+    _, _, method = cntl._method_full_name.rpartition(".")
+    # The method NAME rides provider (our adaptor dispatches by it);
+    # stock nova servers dispatch by descriptor index in nshead.reserved,
+    # which a name-addressed client cannot derive — callers targeting a
+    # stock server must set cntl.nova_method_index explicitly.
+    idx = getattr(cntl, "nova_method_index", None)
+    msg = NsheadMessage(payload, version=version,
+                        log_id=cntl.log_id & 0xFFFFFFFF,
+                        provider=method.encode(),
+                        reserved=idx if idx is not None else 0)
+    return IOBuf(msg.serialize())
+
+
+def _nova_process_response(msg: NsheadInputMessage):
+    sock = msg.socket
+    cid = getattr(sock, "nova_correlation_id", None)
+    if cid is None:
+        return
+    sock.nova_correlation_id = None
+    cntl = _lock_controller(cid)
+    if cntl is None:
+        return
+    if msg.msg.id:
+        # our adaptor signals failure in the (otherwise unused) id field
+        cntl.set_failed(msg.msg.id, "nova server error")
+        cntl._end_rpc_locked_or_not(locked=True)
+        return
+    try:
+        body = msg.msg.body
+        if msg.msg.version == _NOVA_SNAPPY_VERSION:
+            body = compress_mod.decompress(body,
+                                           compress_mod.COMPRESS_SNAPPY)
+        resp = cntl._response
+        if resp is not None and body:
+            resp.ParseFromString(body)
+    except Exception as e:
+        cntl.set_failed(errors.ERESPONSE, f"fail to parse response: {e}")
+    cntl._end_rpc_locked_or_not(locked=True)
+
+
+class NovaServiceAdaptor(NsheadService):
+    """Server half (nova_pbrpc_protocol.cpp:52-111): resolve the method
+    from nshead.reserved (or the provider-field name our client sends),
+    body = pb, snappy via nshead.version."""
+
+    def __init__(self, service):
+        self.service = service
+        self._by_index = sorted(service.methods().keys())
+
+    def process_nshead_request(self, cntl, request: NsheadMessage, done):
+        methods = self.service.methods()
+        name = request.provider.rstrip(b"\x00").decode("utf-8", "replace")
+        minfo = methods.get(name)
+        if minfo is None:
+            # index dispatch only when the name is absent, or when the
+            # 16-byte provider field truncated it (prefix check) — an
+            # unknown name must FAIL, not run method 0
+            idx = request.reserved
+            if 0 <= idx < len(self._by_index):
+                cand = self._by_index[idx]
+                if not name or (len(name) == 16 and cand.startswith(name)):
+                    minfo = methods.get(cand)
+        if minfo is None:
+            done(NsheadMessage(b"", id_=errors.ENOMETHOD))
+            return
+        body = request.body
+        if request.version == _NOVA_SNAPPY_VERSION:
+            body = compress_mod.decompress(body,
+                                           compress_mod.COMPRESS_SNAPPY)
+        req = minfo.request_class()
+        req.ParseFromString(body)
+        resp = minfo.response_class()
+
+        def inner_done():
+            out = resp.SerializeToString()
+            version = 0
+            if request.version == _NOVA_SNAPPY_VERSION:
+                out = compress_mod.compress(out,
+                                            compress_mod.COMPRESS_SNAPPY)
+                version = _NOVA_SNAPPY_VERSION
+            done(NsheadMessage(out, version=version,
+                               log_id=request.log_id))
+
+        minfo.handler(self.service, cntl, req, resp, inner_done)
+
+
+register_protocol(Protocol(
+    name="nova_pbrpc",
+    type=ProtocolType.NOVA,
+    parse=_client_parse,
+    serialize_request=_pb_serialize_request,
+    pack_request=_nova_pack_request,
+    process_response=_nova_process_response,
+    support_server=False,
+    supported_connection_types=("pooled", "short"),
+    process_inline=True,
+    extra={"can_repool": lambda sock: getattr(
+        sock, "nova_correlation_id", None) is None},
+))
+
+
+# -- public_pbrpc ------------------------------------------------------------
+
+def _public_pack_request(payload: bytes, cntl: Controller,
+                         correlation_id: int) -> IOBuf:
+    env = _pb.PublicPbrpcRequest()
+    env.requestHead.log_id = cntl.log_id
+    env.requestHead.compress_type = 0
+    body = env.requestBody.add()
+    service, _, method = cntl._method_full_name.rpartition(".")
+    body.service = service.rpartition(".")[2]
+    body.method_id = 0
+    body.version = method  # name rides version for OUR peer
+    body.id = correlation_id
+    body.serialized_request = payload
+    msg = NsheadMessage(env.SerializeToString(),
+                        log_id=cntl.log_id & 0xFFFFFFFF)
+    return IOBuf(msg.serialize())
+
+
+def _public_process_response(msg: NsheadInputMessage):
+    env = _pb.PublicPbrpcResponse()
+    try:
+        env.ParseFromString(msg.msg.body)
+    except Exception:
+        return
+    for body in env.responseBody:
+        cntl = _lock_controller(body.id)
+        if cntl is None:
+            continue
+        if env.responseHead.code != 0 or body.error:
+            cntl.set_failed(body.error or env.responseHead.code,
+                            env.responseHead.text or "public_pbrpc error")
+        else:
+            resp = cntl._response
+            try:
+                if resp is not None and body.serialized_response:
+                    resp.ParseFromString(body.serialized_response)
+            except Exception as e:
+                cntl.set_failed(errors.ERESPONSE,
+                                f"fail to parse response: {e}")
+        cntl._end_rpc_locked_or_not(locked=True)
+
+
+class PublicPbrpcServiceAdaptor(NsheadService):
+    """Server half: unwrap PublicPbrpcRequest, dispatch each body, answer
+    with a PublicPbrpcResponse carrying matching ids."""
+
+    def __init__(self, service):
+        self.service = service
+        self._by_index = sorted(service.methods().keys())
+
+    def process_nshead_request(self, cntl, request: NsheadMessage, done):
+        env = _pb.PublicPbrpcRequest()
+        try:
+            env.ParseFromString(request.body)
+        except Exception as e:
+            done(NsheadMessage(f"bad envelope: {e}".encode()))
+            return
+        import threading
+
+        out = _pb.PublicPbrpcResponse()
+        out.responseHead.code = 0
+        methods = self.service.methods()
+        lock = threading.Lock()
+        pending = [len(env.requestBody)]
+
+        def finish():
+            done(NsheadMessage(out.SerializeToString(),
+                               log_id=request.log_id))
+
+        def dec():
+            with lock:
+                pending[0] -= 1
+                return pending[0] == 0
+
+        if not env.requestBody:
+            finish()
+            return
+        for body in env.requestBody:
+            # resolve strictly: the NAME our client sends (in .version),
+            # else the method_id index when no name is present — an
+            # unknown name fails with ENOMETHOD, never index fallback
+            name = body.version or ""
+            minfo = methods.get(name)
+            if minfo is None and not name and 0 <= body.method_id < len(
+                    self._by_index):
+                minfo = methods.get(self._by_index[body.method_id])
+            rb = out.responseBody.add()
+            rb.id = body.id
+            if minfo is None:
+                rb.error = errors.ENOMETHOD
+                if dec():
+                    finish()
+                continue
+            req = minfo.request_class()
+            try:
+                req.ParseFromString(body.serialized_request)
+            except Exception:
+                rb.error = errors.EREQUEST
+                if dec():
+                    finish()
+                continue
+            resp = minfo.response_class()
+
+            def inner_done(rb=rb, resp=resp):
+                rb.serialized_response = resp.SerializeToString()
+                if dec():
+                    finish()
+
+            minfo.handler(self.service, cntl, req, resp, inner_done)
+
+
+register_protocol(Protocol(
+    name="public_pbrpc",
+    type=ProtocolType.PUBLIC,
+    parse=_client_parse,
+    serialize_request=_pb_serialize_request,
+    pack_request=_public_pack_request,
+    process_response=_public_process_response,
+    support_server=False,
+    process_inline=True,
+))
+
+
+# -- ubrpc (over mcpack) ------------------------------------------------------
+
+def _ubrpc_serialize_request(request, cntl: Controller):
+    from brpc_tpu.mcpack2pb import _pb_to_dict
+
+    if isinstance(request, dict):
+        return request
+    return _pb_to_dict(request)
+
+
+def _ubrpc_pack_request(req_obj: dict, cntl: Controller,
+                        correlation_id: int) -> IOBuf:
+    from brpc_tpu import mcpack2pb as mp
+
+    _stale_guard(cntl._current_sock, "ubrpc_correlation_id",
+                 correlation_id)
+    _, _, method = cntl._method_full_name.rpartition(".")
+    obj = {"method": method, "params": [req_obj]}
+    msg = NsheadMessage(mp.dumps(obj), log_id=cntl.log_id & 0xFFFFFFFF)
+    return IOBuf(msg.serialize())
+
+
+def _ubrpc_process_response(msg: NsheadInputMessage):
+    from brpc_tpu import mcpack2pb as mp
+    from brpc_tpu.mcpack2pb import _dict_to_pb
+
+    sock = msg.socket
+    cid = getattr(sock, "ubrpc_correlation_id", None)
+    if cid is None:
+        return
+    sock.ubrpc_correlation_id = None
+    cntl = _lock_controller(cid)
+    if cntl is None:
+        return
+    try:
+        obj = mp.loads(msg.msg.body)
+        err = obj.get("error_code", 0)
+        if err:
+            cntl.set_failed(int(err), str(obj.get("error_text", "ubrpc")))
+        else:
+            result = obj.get("result")
+            resp = cntl._response
+            if resp is not None and isinstance(result, dict):
+                if isinstance(resp, dict):
+                    resp.update(result)
+                else:
+                    _dict_to_pb(result, resp)
+    except Exception as e:
+        cntl.set_failed(errors.ERESPONSE, f"fail to parse response: {e}")
+    cntl._end_rpc_locked_or_not(locked=True)
+
+
+class UbrpcServiceAdaptor(NsheadService):
+    """Server half (ubrpc2pb_protocol.cpp): body is an mcpack object with
+    'method' and a params array; reply is {error_code, result}."""
+
+    def __init__(self, service):
+        self.service = service
+
+    def process_nshead_request(self, cntl, request: NsheadMessage, done):
+        from brpc_tpu import mcpack2pb as mp
+        from brpc_tpu.mcpack2pb import _dict_to_pb, _pb_to_dict
+
+        try:
+            obj = mp.loads(request.body)
+            method = obj.get("method")
+            if isinstance(method, bytes):
+                method = method.decode()
+            params = obj.get("params") or [{}]
+        except Exception as e:
+            done(NsheadMessage(mp.dumps(
+                {"error_code": errors.EREQUEST,
+                 "error_text": f"bad mcpack: {e}"})))
+            return
+        minfo = self.service.methods().get(method or "")
+        if minfo is None:
+            done(NsheadMessage(mp.dumps(
+                {"error_code": errors.ENOMETHOD,
+                 "error_text": f"unknown method {method!r}"})))
+            return
+        req = minfo.request_class()
+        _dict_to_pb(params[0] if params else {}, req)
+        resp = minfo.response_class()
+
+        def inner_done():
+            done(NsheadMessage(mp.dumps(
+                {"error_code": 0, "result": _pb_to_dict(resp)}),
+                log_id=request.log_id))
+
+        minfo.handler(self.service, cntl, req, resp, inner_done)
+
+
+register_protocol(Protocol(
+    name="ubrpc",
+    type=ProtocolType.UBRPC,
+    parse=_client_parse,
+    serialize_request=_ubrpc_serialize_request,
+    pack_request=_ubrpc_pack_request,
+    process_response=_ubrpc_process_response,
+    support_server=False,
+    supported_connection_types=("pooled", "short"),
+    process_inline=True,
+    extra={"can_repool": lambda sock: getattr(
+        sock, "ubrpc_correlation_id", None) is None},
+))

@@ -9,9 +9,9 @@ handler sees (controller, NsheadMessage, done); the pb adaptor maps bodies
 to protobuf messages by content — here via the mcpack2pb front-end, the
 pairing the nshead_mcpack protocol uses.
 
-This single implementation carries the capability slot of the reference's
-Baidu legacy family (nshead/nshead_mcpack; hulu/sofa/nova/public/ubrpc are
-that company's internal pb-rpc variants of the same shape).
+The nshead-framed pb-rpc variants (nova_pbrpc, public_pbrpc, ubrpc) build
+on this module — see legacy_nshead_family.py; hulu/sofa have their own
+framings (hulu_protocol.py, sofa_protocol.py).
 """
 from __future__ import annotations
 
@@ -39,17 +39,20 @@ class NsheadMessage:
     """head fields + body bytes (nshead_message.h role)."""
 
     def __init__(self, body: bytes = b"", id_: int = 0, version: int = 0,
-                 log_id: int = 0, provider: bytes = b"brpc_tpu"):
+                 log_id: int = 0, provider: bytes = b"brpc_tpu",
+                 reserved: int = 0):
         self.id = id_
         self.version = version
         self.log_id = log_id
         self.provider = provider[:16]
+        self.reserved = reserved  # nova rides its method index here
         self.body = body
 
     def serialize(self) -> bytes:
         return _HEAD.pack(self.id, self.version, self.log_id,
                           self.provider.ljust(16, b"\x00"),
-                          NSHEAD_MAGICNUM, 0, len(self.body)) + self.body
+                          NSHEAD_MAGICNUM, self.reserved,
+                          len(self.body)) + self.body
 
 
 class NsheadInputMessage(InputMessageBase):
@@ -72,7 +75,7 @@ def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
         # cannot see the magic yet; only claim if it could still match
         return ParseResult.not_enough() if len(head) < 28 else ParseResult.try_others()
     raw = portal.copy_to_bytes(HEAD_SIZE)
-    id_, version, log_id, provider, magic, _res, body_len = _HEAD.unpack(raw)
+    id_, version, log_id, provider, magic, res, body_len = _HEAD.unpack(raw)
     if magic != NSHEAD_MAGICNUM:
         return ParseResult.try_others()
     if body_len > (64 << 20):
@@ -82,7 +85,7 @@ def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
     portal.pop_front(HEAD_SIZE)
     body = portal.cutn_bytes(body_len)
     msg = NsheadMessage(body, id_, version, log_id,
-                        provider.rstrip(b"\x00"))
+                        provider.rstrip(b"\x00"), reserved=res)
     return ParseResult.ok(NsheadInputMessage(msg))
 
 

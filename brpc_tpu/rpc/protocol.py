@@ -30,6 +30,9 @@ class ProtocolType(IntEnum):
     HULU = 11  # hulu_pbrpc
     SOFA = 12  # sofa_pbrpc
     MONGO = 13  # mongo wire protocol (server adaptor)
+    NOVA = 14  # nova_pbrpc (client; server via NovaServiceAdaptor)
+    PUBLIC = 15  # public_pbrpc (client; server via adaptor)
+    UBRPC = 16  # ubrpc over mcpack (client; server via adaptor)
 
 
 class ParseError(IntEnum):
@@ -173,3 +176,4 @@ def globally_initialize():
     from brpc_tpu.rpc import sofa_protocol  # noqa: F401
     from brpc_tpu.rpc import mongo_protocol  # noqa: F401
     from brpc_tpu.rpc import esp_protocol  # noqa: F401
+    from brpc_tpu.rpc import legacy_nshead_family  # noqa: F401

@@ -214,3 +214,121 @@ def test_compression_negotiation(server, protocol, ctype):
     assert not cntl.failed(), cntl.error_text
     assert resp.message == msg
     ch.close()
+
+
+# -- nshead-framed pb-rpc variants (nova/public/ubrpc) ----------------------
+
+def _variant_server(adaptor_cls):
+    from brpc_tpu.rpc import legacy_nshead_family as fam  # noqa: F401
+
+    class VEcho(rpc.Service):
+        SERVICE_NAME = "EchoService"
+
+        @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = request.message[::-1]
+            done()
+
+    srv = rpc.Server(rpc.ServerOptions(
+        num_threads=2, nshead_service=adaptor_cls(VEcho())))
+    assert srv.start("127.0.0.1:0") == 0
+    return srv
+
+
+def test_nova_pbrpc_roundtrip():
+    from brpc_tpu.rpc.legacy_nshead_family import NovaServiceAdaptor
+
+    srv = _variant_server(NovaServiceAdaptor)
+    try:
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="nova_pbrpc",
+                                            connection_type="pooled"))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message="nova"),
+                             echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "avon"
+        # snappy lane: nshead.version flag drives body compression
+        cntl2, resp2 = ch.call("EchoService.Echo",
+                               echo_pb2.EchoRequest(message="nova" * 100),
+                               echo_pb2.EchoResponse, compress_type=3)
+        assert not cntl2.failed(), cntl2.error_text
+        assert resp2.message == ("nova" * 100)[::-1]
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_public_pbrpc_roundtrip():
+    from brpc_tpu.rpc.legacy_nshead_family import PublicPbrpcServiceAdaptor
+
+    srv = _variant_server(PublicPbrpcServiceAdaptor)
+    try:
+        # correlation rides the envelope body.id: single connections work
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="public_pbrpc"))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        for i in range(5):
+            cntl, resp = ch.call("EchoService.Echo",
+                                 echo_pb2.EchoRequest(message=f"pub{i}"),
+                                 echo_pb2.EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == f"pub{i}"[::-1]
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_ubrpc_roundtrip():
+    from brpc_tpu.rpc.legacy_nshead_family import UbrpcServiceAdaptor
+
+    srv = _variant_server(UbrpcServiceAdaptor)
+    try:
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="ubrpc",
+                                            connection_type="pooled"))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message="ubrpc!"),
+                             echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "!cprbu"
+        # unknown method surfaces the mcpack error object
+        cntl2, _ = ch.call("EchoService.Nope",
+                           echo_pb2.EchoRequest(message="x"),
+                           echo_pb2.EchoResponse)
+        assert cntl2.failed() and cntl2.error_code_value == errors.ENOMETHOD
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_nova_unknown_method_fails():
+    from brpc_tpu.rpc.legacy_nshead_family import NovaServiceAdaptor
+
+    srv = _variant_server(NovaServiceAdaptor)
+    try:
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="nova_pbrpc",
+                                            connection_type="pooled"))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        cntl, _ = ch.call("EchoService.Nope",
+                          echo_pb2.EchoRequest(message="x"),
+                          echo_pb2.EchoResponse)
+        assert cntl.failed() and cntl.error_code_value == errors.ENOMETHOD
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_public_unknown_method_fails():
+    from brpc_tpu.rpc.legacy_nshead_family import PublicPbrpcServiceAdaptor
+
+    srv = _variant_server(PublicPbrpcServiceAdaptor)
+    try:
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="public_pbrpc"))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        cntl, _ = ch.call("EchoService.Nope",
+                          echo_pb2.EchoRequest(message="x"),
+                          echo_pb2.EchoResponse)
+        assert cntl.failed() and cntl.error_code_value == errors.ENOMETHOD
+        ch.close()
+    finally:
+        srv.stop()
