@@ -191,7 +191,8 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             "connections": nconn,
             "fibers_per_conn": fibers_per_conn,
             "payload_bytes": payload,
-            "requests": fw["requests"],
+            "requests": (ring["requests"] if ring_qps > fw["qps"]
+                         else fw["requests"]),
             "lane": "io_uring" if ring_qps > fw["qps"] else "epoll",
             "epoll_qps": round(fw["qps"], 1),
             "io_uring_qps": round(ring_qps, 1),

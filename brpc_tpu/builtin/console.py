@@ -31,10 +31,13 @@ def _status_handler(server, req):
 
 
 def _vars_handler(server, req):
-    """/vars: every exposed bvar; /vars/<name> filters
-    (builtin/vars_service.cpp)."""
+    """/vars: every exposed bvar; /vars/<name> filters; ?chart=1 renders
+    an SVG trend of a windowed var (the in-browser series charts of
+    builtin/vars_service.cpp + the flot bundle, dependency-free)."""
     parts = [p for p in req.path.split("/") if p]
     needle = parts[1] if len(parts) > 1 else None
+    if needle and req.query.get("chart"):
+        return _var_chart(needle, req)
     out = []
     for name, value in bvar.dump_exposed():
         if needle and needle not in name:
@@ -43,6 +46,51 @@ def _vars_handler(server, req):
             value = f"avg={value.average:.3f} num={value.num}"
         out.append(f"{name} : {value}")
     return 200, "text/plain", "\n".join(out) + "\n"
+
+
+def _var_chart(name: str, req):
+    """Inline-SVG sparkline of a Window/PerSecond var's per-second series;
+    ?format=json returns the raw points."""
+    from xml.sax.saxutils import escape
+
+    from brpc_tpu.bvar.variable import find_exposed
+
+    var = find_exposed(name)
+    if var is None:
+        return 404, "text/plain", f"no such var: {name}\n"
+    series_fn = getattr(var, "series", None)
+    if series_fn is None:
+        return 400, "text/plain", f"{name} is not a windowed var\n"
+    points = series_fn()
+    if req.query.get("format") == "json":
+        body = json.dumps({"var": name,
+                           "points": [[round(t, 3), v]
+                                      for t, v in points]})
+        return 200, "application/json", body + "\n"
+    w, h, pad = 480, 120, 6
+    if len(points) < 2:
+        svg = (f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" '
+               f'height="{h}"><text x="10" y="20">{escape(name)}: '
+               f'collecting samples...</text></svg>')
+        return 200, "image/svg+xml", svg
+    values = [v for _, v in points]
+    vmin, vmax = min(values), max(values)
+    spread = (vmax - vmin) or 1.0
+    t0, t1 = points[0][0], points[-1][0]
+    tspan = (t1 - t0) or 1.0
+    coords = " ".join(
+        f"{pad + (t - t0) / tspan * (w - 2 * pad):.1f},"
+        f"{h - pad - (v - vmin) / spread * (h - 2 * pad):.1f}"
+        for t, v in points)
+    svg = (
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" height="{h}">'
+        f'<rect width="{w}" height="{h}" fill="#fcfcfc" stroke="#ccc"/>'
+        f'<polyline points="{coords}" fill="none" stroke="#3366cc" '
+        f'stroke-width="1.5"/>'
+        f'<text x="8" y="14" font-size="11" fill="#333">{escape(name)} '
+        f'(last {len(points)}s: min={vmin:.6g} max={vmax:.6g})</text>'
+        f'</svg>')
+    return 200, "image/svg+xml", svg
 
 
 def _flags_handler(server, req):

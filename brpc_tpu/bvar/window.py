@@ -48,13 +48,51 @@ class Window(Variable):
             return 0.0
         return max(0.0, latest[0] - oldest[0])
 
+    def series(self):
+        """Per-second data points for charting (the trend the reference
+        plots in-browser with flot, vars_service.cpp ?series): list of
+        (ts, value) — consecutive deltas for invertible reducers, raw
+        samples otherwise."""
+        samples = self._sampler.samples_in(self._window_size)
+        if len(samples) < 2:
+            return []
+        if not getattr(self._reducer, "invertible", False):
+            return [(ts, _plain(v)) for ts, v in samples]
+        out = []
+        for (t0, v0), (t1, v1) in zip(samples, samples[1:]):
+            out.append((t1, _plain(v1 - v0)))
+        return out
+
     def destroy(self):
         self._sampler.destroy()
         self.hide()
 
 
+def _plain(v) -> float:
+    """Collapse reducer values (incl. IntRecorder stats) to one number."""
+    if hasattr(v, "average"):
+        return float(v.average)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return 0.0
+
+
 class PerSecond(Window):
     """Windowed delta divided by elapsed seconds (window.h:174-197)."""
+
+    def series(self):
+        samples = self._sampler.samples_in(self._window_size)
+        out = []
+        for (t0, v0), (t1, v1) in zip(samples, samples[1:]):
+            dt = t1 - t0
+            if dt <= 0:
+                continue
+            delta = v1 - v0
+            if hasattr(delta, "sum"):  # IntRecorder: rate of the SUM,
+                delta = delta.sum      # matching get_value's semantics
+            out.append((t1, _plain(delta) / dt))
+        return out
 
     def get_value(self):
         import time

@@ -162,3 +162,36 @@ def test_http_client_channel_error(server):
                       echo_pb2.EchoResponse, timeout_ms=3000)
     assert cntl.failed()
     assert cntl.error_code == errors.EPERM  # carried via x-error-code
+
+
+def test_vars_chart_svg(server):
+    """?chart=1 renders a windowed var's per-second trend as inline SVG
+    (the in-browser series charts of the reference's vars_service)."""
+    import json as _json
+    import time as _time
+
+    from brpc_tpu import bvar
+
+    adder = bvar.Adder("chart_demo_total")
+    win = bvar.PerSecond(adder, 5)
+    win.expose("chart_demo_qps")
+    try:
+        # feed the sampler a few 1s ticks
+        for _ in range(3):
+            adder.update(50)
+            win._sampler.take_sample()
+            _time.sleep(0.01)
+        status, ctype, body = _get(server, "/vars/chart_demo_qps?chart=1")
+        assert status == 200 and ctype.startswith("image/svg")
+        assert "<svg" in body and "chart_demo_qps" in body
+        status, ctype, body = _get(server,
+                                   "/vars/chart_demo_qps?chart=1&format=json")
+        assert status == 200
+        data = _json.loads(body)
+        assert data["var"] == "chart_demo_qps"
+        assert len(data["points"]) >= 1
+        status, _, _ = _get(server, "/vars/zz_missing?chart=1")
+        assert status == 404
+    finally:
+        win.destroy()
+        adder.hide()  # drop the registry reference (no /vars pollution)
