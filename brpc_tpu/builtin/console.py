@@ -57,6 +57,13 @@ def _var_chart(name: str, req):
 
     var = find_exposed(name)
     if var is None:
+        # the /vars listing matches substrings; accept a UNIQUE substring
+        # match here too so a listed name can be charted directly
+        matches = [n for n, _ in bvar.dump_exposed() if name in n]
+        if len(matches) == 1:
+            var = find_exposed(matches[0])
+            name = matches[0]
+    if var is None:
         return 404, "text/plain", f"no such var: {name}\n"
     series_fn = getattr(var, "series", None)
     if series_fn is None:

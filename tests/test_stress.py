@@ -1,0 +1,228 @@
+"""Stress tests — the reference's real-concurrency unittest style
+(bthread_ping_pong_unittest / brpc_socket_unittest fault-injection): a
+multi-protocol request storm on one port, and failure/revival churn under
+load. Bounded to a few seconds each.
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.proto import echo_pb2
+from brpc_tpu.rpc.redis import DictRedisService, RedisRequest, RedisResponse
+from brpc_tpu.rpc.thrift import T_STRING, ThriftMessage, ThriftService
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+def _make_server():
+    tsvc = ThriftService()
+    tsvc.add_method("Echo", lambda body: {
+        0: (T_STRING, body.get(1, (T_STRING, b""))[1])})
+    srv = rpc.Server(rpc.ServerOptions(
+        num_threads=4,
+        redis_service=DictRedisService(),
+        thrift_service=tsvc,
+    ))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    return srv
+
+
+def test_mixed_protocol_storm():
+    """Six protocols hammer ONE port concurrently for ~3s; every call must
+    succeed and the console must stay responsive afterwards."""
+    srv = _make_server()
+    target = str(srv.listen_endpoint)
+    stop = threading.Event()
+    stats = {}
+    thread_errors = []
+    lock = threading.Lock()
+
+    def record(kind, ok):
+        with lock:
+            good, bad = stats.get(kind, (0, 0))
+            stats[kind] = (good + ok, bad + (not ok))
+
+    def guarded(fn, *args):
+        # worker exceptions must FAIL the test, not die silently
+        def run():
+            try:
+                fn(*args)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    thread_errors.append(f"{fn.__name__}: {e!r}")
+        return run
+
+    def pb_loop(protocol):
+        ch = rpc.Channel(rpc.ChannelOptions(protocol=protocol,
+                                            timeout_ms=3000))
+        assert ch.init(target) == 0
+        i = 0
+        while not stop.is_set():
+            cntl, resp = ch.call("EchoService.Echo",
+                                 echo_pb2.EchoRequest(message=f"{protocol}{i}"),
+                                 echo_pb2.EchoResponse)
+            record(protocol, (not cntl.failed()
+                              and resp.message == f"{protocol}{i}"))
+            i += 1
+        ch.close()
+
+    def redis_loop():
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="redis",
+                                            timeout_ms=3000))
+        assert ch.init(target) == 0
+        i = 0
+        while not stop.is_set():
+            req = RedisRequest()
+            req.add_command("SET", f"k{i % 8}", f"v{i}")
+            req.add_command("GET", f"k{i % 8}")
+            resp = RedisResponse()
+            cntl = rpc.Controller()
+            ch.call_method("redis", cntl, req, resp)
+            record("redis", not cntl.failed() and resp.reply_count == 2)
+            i += 1
+        ch.close()
+
+    def thrift_loop():
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="thrift",
+                                            timeout_ms=3000))
+        assert ch.init(target) == 0
+        i = 0
+        while not stop.is_set():
+            resp = ThriftMessage()
+            cntl = rpc.Controller()
+            ch.call_method("thrift", cntl,
+                           ThriftMessage("Echo",
+                                         {1: (T_STRING, f"t{i}".encode())}),
+                           resp)
+            record("thrift", not cntl.failed())
+            i += 1
+        ch.close()
+
+    def http_loop():
+        import http.client
+
+        i = 0
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          srv.listen_endpoint.port,
+                                          timeout=3)
+        while not stop.is_set():
+            conn.request("POST", "/EchoService/Echo",
+                         body=json.dumps({"message": f"h{i}"}),
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            body = r.read()
+            record("http", r.status == 200
+                   and json.loads(body)["message"] == f"h{i}")
+            i += 1
+        conn.close()
+
+    threads = [threading.Thread(target=guarded(pb_loop, p))
+               for p in ("tpu_std", "hulu_pbrpc", "sofa_pbrpc")]
+    threads += [threading.Thread(target=guarded(redis_loop)),
+                threading.Thread(target=guarded(thrift_loop)),
+                threading.Thread(target=guarded(http_loop))]
+    for t in threads:
+        t.start()
+    time.sleep(3.0)
+    stop.set()
+    for t in threads:
+        t.join(10)
+
+    assert not thread_errors, f"worker threads raised: {thread_errors}"
+    total = sum(g + b for g, b in stats.values())
+    failures = {k: v for k, v in stats.items() if v[1]}
+    assert not failures, f"failures under storm: {failures} of {stats}"
+    assert total > 200, f"storm barely ran: {stats}"
+    assert len(stats) == 6
+
+    # console still healthy after the storm
+    import urllib.request
+
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.listen_endpoint.port}/status",
+        timeout=5).read()
+    assert b"connection_count" in body
+    srv.stop()
+
+
+def test_failure_revival_churn():
+    """Calls keep flowing while server sockets are repeatedly SetFailed
+    (the fault-injection-by-API style of brpc_socket_unittest); the health
+    check revives them and the final state is healthy."""
+    srv = _make_server()
+    ep = srv.listen_endpoint
+    ch = rpc.Channel(rpc.ChannelOptions(
+        timeout_ms=2000, health_check_interval_s=0.05))
+    assert ch.init(f"list://{ep.ip}:{ep.port}", "rr") == 0
+
+    stop = threading.Event()
+    outcomes = []
+
+    def caller():
+        i = 0
+        while not stop.is_set():
+            cntl, resp = ch.call("EchoService.Echo",
+                                 echo_pb2.EchoRequest(message=f"c{i}"),
+                                 echo_pb2.EchoResponse)
+            outcomes.append(not cntl.failed())
+            i += 1
+            time.sleep(0.002)
+
+    def chaos():
+        from brpc_tpu.rpc.socket import Socket
+
+        while not stop.is_set():
+            time.sleep(0.25)
+            for sid in ch._lb.server_ids():
+                s = Socket.address(sid)
+                if s is not None and not s.failed():
+                    s.set_failed(errors.EFAILEDSOCKET, "chaos monkey")
+
+    churn_errors = []
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                churn_errors.append(f"{fn.__name__}: {e!r}")
+        return run
+
+    t1 = threading.Thread(target=guard(caller))
+    t2 = threading.Thread(target=guard(chaos))
+    t1.start()
+    t2.start()
+    time.sleep(3.0)
+    stop.set()
+    t1.join(10)
+    t2.join(10)
+
+    assert not churn_errors, f"worker threads raised: {churn_errors}"
+    assert len(outcomes) > 50
+    # the system RECOVERS: after churn stops, calls succeed again
+    deadline = time.monotonic() + 5
+    final_ok = False
+    while time.monotonic() < deadline and not final_ok:
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message="final"),
+                             echo_pb2.EchoResponse)
+        final_ok = not cntl.failed() and resp.message == "final"
+        if not final_ok:
+            time.sleep(0.1)
+    assert final_ok, "cluster did not recover after churn"
+    # and most in-flight calls during churn still succeeded (health check
+    # revival keeps the window small)
+    ok_ratio = sum(outcomes) / len(outcomes)
+    assert ok_ratio > 0.5, f"ok ratio {ok_ratio:.2f} under churn"
+    ch.close()
+    srv.stop()
