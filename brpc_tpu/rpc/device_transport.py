@@ -63,6 +63,7 @@ def local_device_info() -> dict:
         "process": _process_uuid,
         "host": _boot_id,
         "arena": arena.name if arena is not None else "",
+        "xfer": _global_xfer_server() is not None,
     }
     try:
         import jax
@@ -311,14 +312,69 @@ _inproc_next = [1]
 _dev_zero_copy = bvar.Adder("device_transport_zero_copy_transfers")
 _dev_shm = bvar.Adder("device_transport_shm_transfers")
 _dev_wire = bvar.Adder("device_transport_wire_transfers")
+_dev_xfer = bvar.Adder("device_transport_xfer_transfers")
+
+from brpc_tpu.butil import flags as _flags  # noqa: E402
+
+_flags.define_bool(
+    "device_transport_prefer_xfer", False,
+    "use the jax transfer-server lane even for same-host peers (it is "
+    "always used for cross-host device peers when both sides support "
+    "it). CAUTION: the CPU backend's bulk transport is same-process-"
+    "only — forcing this across processes needs a real device backend")
 
 
 def lane_counters() -> dict:
     """Public per-lane transfer counts (also exposed as bvars under
-    device_transport_*): {'inproc': N, 'shm': N, 'wire': N}."""
+    device_transport_*): {'inproc': N, 'shm': N, 'wire': N, 'xfer': N}."""
     return {"inproc": _dev_zero_copy.get_value(),
             "shm": _dev_shm.get_value(),
-            "wire": _dev_wire.get_value()}
+            "wire": _dev_wire.get_value(),
+            "xfer": _dev_xfer.get_value()}
+
+
+# -- jax transfer-server lane (the DEVICE-to-DEVICE cross-host path: the
+# true ICI/DCN translation of the RDMA QP — rdma_endpoint.h:55-57's role
+# when peers live on different machines) ------------------------------------
+
+_xfer_server = None
+_xfer_server_lock = threading.Lock()
+_xfer_conns: Dict[str, object] = {}
+_xfer_conns_lock = threading.Lock()
+
+
+def _global_xfer_server():
+    """Lazy singleton jax.experimental.transfer server; None when the
+    backend/jax build lacks it (the capability is advertised in the
+    handshake so both sides agree)."""
+    global _xfer_server
+    if _xfer_server is False:
+        return None
+    if _xfer_server is not None:
+        return _xfer_server
+    with _xfer_server_lock:
+        if _xfer_server is None:
+            try:
+                import jax
+                from jax.experimental import transfer
+
+                _xfer_server = transfer.start_transfer_server(
+                    jax.devices()[0].client)
+            except Exception:
+                _xfer_server = False
+    return _xfer_server if _xfer_server is not False else None
+
+
+def _xfer_connect(addr: str):
+    with _xfer_conns_lock:
+        conn = _xfer_conns.get(addr)
+        if conn is None:
+            server = _global_xfer_server()
+            if server is None:
+                raise ValueError("no local transfer server to connect from")
+            conn = server.connect(addr)
+            _xfer_conns[addr] = conn
+    return conn
 
 
 def inproc_publish(arrays: List) -> int:
@@ -377,6 +433,25 @@ class DeviceEndpoint:
         self._retained: Dict[int, Tuple[List, int]] = {}
         self._next_seq = 1
         self._lock = threading.Lock()
+        # transfer-server lane: our address as reachable by THIS peer
+        # (wildcard host resolved against the handshake connection), and
+        # a per-endpoint uuid base so pull ids never collide
+        self._my_xfer_addr = ""
+        self._xfer_uuid_base = int(uuid.uuid4().int & ((1 << 62) - 1)
+                                   ) & ~0xFFFFF
+
+    def resolve_xfer_addr(self, local_ip: str):
+        """Called with the handshake connection's local IP: publishes the
+        transfer server's address with any wildcard host substituted, so
+        the peer can dial back over the same network path."""
+        server = _global_xfer_server()
+        if server is None or not local_ip:
+            return
+        addr = server.address()
+        host, _, port = addr.rpartition(":")
+        if host in ("[::]", "0.0.0.0", ""):
+            host = local_ip
+        self._my_xfer_addr = f"{host}:{port}"
 
     # ---- handshake over the TCP connection (GID/QPN exchange analog) ----
     def app_connect(self, sock) -> int:
@@ -412,6 +487,10 @@ class DeviceEndpoint:
             if (self.peer_info.get("device_count", 0) > 0
                     and mine["device_count"] > 0):
                 self.state = ESTABLISHED
+                try:
+                    self.resolve_xfer_addr(fd.getsockname()[0])
+                except OSError:
+                    pass
             else:
                 self.state = FALLBACK_TCP
             return 0
@@ -466,6 +545,27 @@ class DeviceEndpoint:
             meta.tensors[0].sharding_spec = f"inproc:{ticket}:{seq}"
             _dev_zero_copy.update(1)
             release = (lambda t=ticket: inproc_claim(t))
+        elif (self.state == ESTABLISHED and self._my_xfer_addr
+              and self.peer_info.get("xfer")
+              and (not self.same_host
+                   or _flags.get_flag("device_transport_prefer_xfer"))):
+            # device-to-device over the transfer fabric: publish on OUR
+            # transfer server; the peer pulls straight into its devices.
+            # No payload bytes on the RPC wire; jax releases the source
+            # buffers once the peer's pull completes.
+            import jax
+            import numpy as np
+
+            server = _global_xfer_server()
+            uid = self._xfer_uuid_base + seq
+            jarrays = [a if isinstance(a, jax.Array)
+                       else jax.device_put(np.ascontiguousarray(a))
+                       for a in arrays]
+            server.await_pull(uid, jarrays)
+            meta.tensors[0].sharding_spec = (
+                f"xfer|{self._my_xfer_addr}|{uid}|{seq}")
+            _dev_xfer.update(1)
+            release = (lambda: None)
         elif self.state == ESTABLISHED and self.same_host:
             arena = default_send_arena()
             offset = arena.alloc(total) if arena is not None else None
@@ -552,6 +652,22 @@ def receive_tensors(meta, attachment: IOBuf, device=None) -> Tuple[List, Optiona
     if not meta.tensors:
         return [], None
     spec = meta.tensors[0].sharding_spec or ""
+    if spec.startswith("xfer|"):
+        # pull device-to-device from the sender's transfer server
+        import jax
+
+        _, addr, uid_s, seq_s = spec.split("|")
+        conn = _xfer_connect(addr)
+        dev = device if device is not None else jax.devices()[0]
+        sharding = jax.sharding.SingleDeviceSharding(dev)
+        specs = [jax.ShapeDtypeStruct(tuple(t.shape), _np_dtype(t.dtype),
+                                      sharding=sharding)
+                 for t in meta.tensors]
+        arrays = conn.pull(int(uid_s), specs)
+        # the sender frees its buffers once our pull completes — finish
+        # it before the caller ACKs (retention-until-ACK discipline)
+        jax.block_until_ready(arrays)
+        return list(arrays), int(seq_s)
     parts = spec.split(":")
     seq = None
     if len(parts) >= 3 and parts[-1].isdigit():
