@@ -63,7 +63,7 @@ def local_device_info() -> dict:
         "process": _process_uuid,
         "host": _boot_id,
         "arena": arena.name if arena is not None else "",
-        "xfer": _global_xfer_server() is not None,
+        "xfer": _xfer_available(),
     }
     try:
         import jax
@@ -343,6 +343,17 @@ _xfer_conns: Dict[str, object] = {}
 _xfer_conns_lock = threading.Lock()
 
 
+def _xfer_available() -> bool:
+    """Capability probe WITHOUT starting a server (advertised in the
+    handshake; the server itself starts lazily on first use)."""
+    try:
+        from jax.experimental import transfer  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
 def _global_xfer_server():
     """Lazy singleton jax.experimental.transfer server; None when the
     backend/jax build lacks it (the capability is advertised in the
@@ -368,13 +379,21 @@ def _global_xfer_server():
 def _xfer_connect(addr: str):
     with _xfer_conns_lock:
         conn = _xfer_conns.get(addr)
-        if conn is None:
-            server = _global_xfer_server()
-            if server is None:
-                raise ValueError("no local transfer server to connect from")
-            conn = server.connect(addr)
-            _xfer_conns[addr] = conn
-    return conn
+    if conn is not None:
+        return conn
+    server = _global_xfer_server()
+    if server is None:
+        raise ValueError("no local transfer server to connect from")
+    conn = server.connect(addr)  # dial OUTSIDE the lock: a hung peer
+    with _xfer_conns_lock:       # must not block other peers' receives
+        return _xfer_conns.setdefault(addr, conn)
+
+
+def _xfer_evict(addr: str):
+    """Drop a cached connection (e.g. after a failed pull) so the next
+    receive redials — a restarted sender on the same address recovers."""
+    with _xfer_conns_lock:
+        _xfer_conns.pop(addr, None)
 
 
 def inproc_publish(arrays: List) -> int:
@@ -487,10 +506,11 @@ class DeviceEndpoint:
             if (self.peer_info.get("device_count", 0) > 0
                     and mine["device_count"] > 0):
                 self.state = ESTABLISHED
-                try:
-                    self.resolve_xfer_addr(fd.getsockname()[0])
-                except OSError:
-                    pass
+                if self.peer_info.get("xfer"):
+                    try:
+                        self.resolve_xfer_addr(fd.getsockname()[0])
+                    except OSError:
+                        pass
             else:
                 self.state = FALLBACK_TCP
             return 0
@@ -538,6 +558,18 @@ class DeviceEndpoint:
             t.shape.extend(int(d) for d in a.shape)
             t.nbytes = int(a.nbytes)
 
+        try:
+            release = self._fill_lane(arrays, meta, attachment, seq, total)
+        except Exception:
+            with self._window_cond:
+                self._inflight -= total
+                self._window_cond.notify_all()
+            raise
+        with self._lock:
+            self._retained[seq] = (release, total)
+        return True
+
+    def _fill_lane(self, arrays, meta, attachment, seq, total):
         release = None
         if self.state == ESTABLISHED and self.same_process:
             # zero-copy: ship a ticket instead of bytes
@@ -561,6 +593,11 @@ class DeviceEndpoint:
             jarrays = [a if isinstance(a, jax.Array)
                        else jax.device_put(np.ascontiguousarray(a))
                        for a in arrays]
+            # device_put canonicalizes dtypes (float64->float32 without
+            # x64): the meta must describe what was PUBLISHED
+            for t, ja in zip(meta.tensors, jarrays):
+                t.dtype = str(ja.dtype)
+                t.nbytes = int(ja.nbytes)
             server.await_pull(uid, jarrays)
             meta.tensors[0].sharding_spec = (
                 f"xfer|{self._my_xfer_addr}|{uid}|{seq}")
@@ -593,9 +630,7 @@ class DeviceEndpoint:
             for a in arrays:
                 attachment.append(np.asarray(a).tobytes())
             _dev_wire.update(1)
-        with self._lock:
-            self._retained[seq] = (release, total)
-        return True
+        return release
 
     def on_ack(self, seq: int):
         """Peer confirmed receipt: run the lane's release action (free the
@@ -663,10 +698,14 @@ def receive_tensors(meta, attachment: IOBuf, device=None) -> Tuple[List, Optiona
         specs = [jax.ShapeDtypeStruct(tuple(t.shape), _np_dtype(t.dtype),
                                       sharding=sharding)
                  for t in meta.tensors]
-        arrays = conn.pull(int(uid_s), specs)
-        # the sender frees its buffers once our pull completes — finish
-        # it before the caller ACKs (retention-until-ACK discipline)
-        jax.block_until_ready(arrays)
+        try:
+            arrays = conn.pull(int(uid_s), specs)
+            # the sender frees its buffers once our pull completes —
+            # finish it before the caller ACKs (retention-until-ACK)
+            jax.block_until_ready(arrays)
+        except Exception:
+            _xfer_evict(addr)  # redial next time (sender restarts)
+            raise
         return list(arrays), int(seq_s)
     parts = spec.split(":")
     seq = None

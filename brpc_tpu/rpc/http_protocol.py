@@ -122,6 +122,21 @@ def process_request(msg: HttpInputMessage):
             resp.status_code = status
             resp.set_body(body, ctype)
             return _respond(sock, resp, close)
+    # bad_method page (builtin/bad_method_service.cpp): a known service
+    # with a missing/wrong method lists what IS callable
+    svc = server.find_service(parts[0]) if parts else None
+    if svc is not None:
+        if len(parts) >= 2:
+            first = f"fail to find method={parts[1]} in service={parts[0]}."
+        else:
+            first = f"Missing method name for service={parts[0]}."
+        lines = [first, " Available methods are:", ""]
+        for mname, minfo in sorted(svc.methods().items()):
+            lines.append(f"rpc {mname} ({minfo.request_class.__name__}) "
+                         f"returns ({minfo.response_class.__name__});")
+        resp.status_code = 404
+        resp.set_body("\n".join(lines) + "\n")
+        return _respond(sock, resp, close)
     resp.status_code = 404
     resp.set_body(f"no such page or method: {req.path}\n")
     _respond(sock, resp, close)

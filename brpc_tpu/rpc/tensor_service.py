@@ -81,12 +81,13 @@ def _process_handshake(msg: _HandshakeMsg):
 
     if msg.info.get("device_count", 0) > 0 and mine["device_count"] > 0:
         ep.state = dt.ESTABLISHED
-        try:
-            fd = sock.fd()
-            if fd is not None:
-                ep.resolve_xfer_addr(fd.getsockname()[0])
-        except OSError:
-            pass
+        if msg.info.get("xfer"):
+            try:
+                fd = sock.fd()
+                if fd is not None:
+                    ep.resolve_xfer_addr(fd.getsockname()[0])
+            except OSError:
+                pass
     else:
         ep.state = dt.FALLBACK_TCP
     sock.app_state = ep

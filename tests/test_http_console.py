@@ -195,3 +195,14 @@ def test_vars_chart_svg(server):
     finally:
         win.destroy()
         adder.hide()  # drop the registry reference (no /vars pollution)
+
+
+def test_bad_method_page(server):
+    """/EchoService (no method) lists callable methods
+    (builtin/bad_method_service.cpp)."""
+    status, _, body = _get(server, "/EchoService")
+    assert status == 404
+    assert "Available methods" in body
+    assert "rpc Echo (EchoRequest) returns (EchoResponse);" in body
+    status, _, body = _get(server, "/NoSuchService")
+    assert status == 404 and "no such page" in body
