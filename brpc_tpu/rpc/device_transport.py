@@ -63,7 +63,10 @@ def local_device_info() -> dict:
         "process": _process_uuid,
         "host": _boot_id,
         "arena": arena.name if arena is not None else "",
-        "xfer": _xfer_available(),
+        # advertised ONLY when the server actually started: a peer that
+        # sees True may publish xfer-lane payloads with nothing on the
+        # wire, so import success alone is not proof enough
+        "xfer": _global_xfer_server() is not None,
     }
     try:
         import jax
@@ -341,17 +344,6 @@ _xfer_server = None
 _xfer_server_lock = threading.Lock()
 _xfer_conns: Dict[str, object] = {}
 _xfer_conns_lock = threading.Lock()
-
-
-def _xfer_available() -> bool:
-    """Capability probe WITHOUT starting a server (advertised in the
-    handshake; the server itself starts lazily on first use)."""
-    try:
-        from jax.experimental import transfer  # noqa: F401
-
-        return True
-    except Exception:
-        return False
 
 
 def _global_xfer_server():

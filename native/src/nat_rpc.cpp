@@ -612,6 +612,80 @@ static void build_request_frame(IOBuf* out, int64_t cid,
   if (att_len) out->append(att, att_len);
 }
 
+// Minimal HTTP console on the native port (the multi-protocol-port
+// discipline of server.cpp: one port tries every protocol): GET
+// /health /status /vars /version answer from native counters so the
+// native runtime is self-observable without the Python lane.
+// Returns 1 = handled a request, 2 = need more bytes, 0 = not HTTP.
+static int try_process_http(NatSocket* s, IOBuf* batch_out) {
+  char head[8] = {0};
+  size_t n = s->in_buf.length() < 8 ? s->in_buf.length() : 8;
+  s->in_buf.copy_to(head, n);
+  bool is_head = memcmp(head, "HEAD", 4) == 0;
+  if (memcmp(head, "GET ", 4) != 0 && !is_head) {
+    return 0;
+  }
+  if (s->server == nullptr) return 0;
+  std::string raw;
+  raw.resize(s->in_buf.length());
+  s->in_buf.copy_to(&raw[0], raw.size());
+  size_t end = raw.find("\r\n\r\n");
+  if (end == std::string::npos) {
+    return raw.size() > (64u << 10) ? 0 : 2;  // oversized header: bail
+  }
+  s->in_buf.pop_front(end + 4);
+  std::string headers = raw.substr(0, end);  // THIS request only, not any
+  for (char& c : headers) c = (char)tolower((unsigned char)c);
+  size_t p0 = raw.find(' ');
+  size_t p1 = raw.find(' ', p0 + 1);
+  std::string path = (p0 != std::string::npos && p1 != std::string::npos)
+                         ? raw.substr(p0 + 1, p1 - p0 - 1)
+                         : "/";
+  bool keep_alive = headers.find("connection: close") == std::string::npos;
+  std::string body;
+  int status = 200;
+  if (path == "/health") {
+    body = "OK\n";
+  } else if (path == "/version") {
+    body = "brpc_tpu_native/1\n";
+  } else if (path == "/status" || path == "/vars") {
+    char buf[512];
+    uint64_t ring_recv = g_ring != nullptr ? g_ring->recv_completions() : 0;
+    uint64_t ring_send = g_ring != nullptr ? g_ring->send_completions() : 0;
+    snprintf(buf, sizeof(buf),
+             "nat_server_requests : %llu\n"
+             "nat_server_connections : %llu\n"
+             "nat_scheduler_workers : %d\n"
+             "nat_scheduler_switches : %llu\n"
+             "nat_ring_recv_completions : %llu\n"
+             "nat_ring_send_completions : %llu\n",
+             (unsigned long long)s->server->requests.load(),
+             (unsigned long long)s->server->connections.load(),
+             Scheduler::instance()->nworkers(),
+             (unsigned long long)Scheduler::instance()->total_switches(),
+             (unsigned long long)ring_recv,
+             (unsigned long long)ring_send);
+    body = buf;
+  } else {
+    status = 404;
+    body = "no such page on the native port (try /status /vars /health)\n";
+  }
+  char hdr[256];
+  snprintf(hdr, sizeof(hdr),
+           "HTTP/1.1 %d %s\r\nServer: brpc_tpu_native\r\n"
+           "Content-Type: text/plain\r\nContent-Length: %zu\r\n"
+           "Connection: %s\r\n\r\n",
+           status, status == 200 ? "OK" : "Not Found", body.size(),
+           keep_alive ? "keep-alive" : "close");
+  batch_out->append(hdr, strlen(hdr));
+  if (!is_head) batch_out->append(body.data(), body.size());
+  // Even for Connection: close we answer and let the PEER close (EOF
+  // then fails the socket) — closing ourselves would race the
+  // asynchronous write lanes (KeepWrite fiber / io_uring send) and could
+  // drop the response bytes still queued.
+  return 1;
+}
+
 // Cut + process every complete frame in s->in_buf. Server requests run
 // inline (responses batched into ONE socket write per read burst); client
 // responses complete pending calls.
@@ -623,7 +697,10 @@ static bool process_input(NatSocket* s) {
     char header[12];
     s->in_buf.copy_to(header, 12);
     if (memcmp(header, kMagicRpc, 4) != 0) {
-      ok = false;  // protocol error: native port speaks tpu_std only
+      int hrc = try_process_http(s, &batch_out);
+      if (hrc == 1) continue;   // handled; keep cutting
+      if (hrc == 2) break;      // incomplete request: wait for bytes
+      ok = false;  // not tpu_std, not HTTP: protocol error
       break;
     }
     uint32_t body = rd_be32(header + 4);

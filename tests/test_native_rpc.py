@@ -158,3 +158,36 @@ def test_io_uring_datapath():
     finally:
         native.rpc_server_stop()
         native.use_io_uring(False)
+
+
+def test_native_port_http_console():
+    """The native port answers HTTP console GETs natively (the
+    multi-protocol-port discipline): /health /status /vars /version."""
+    import urllib.request
+
+    port = native.rpc_server_start("127.0.0.1", 0, nworkers=2,
+                                   native_echo=True)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        body = urllib.request.urlopen(f"{base}/health", timeout=5).read()
+        assert body == b"OK\n"
+        body = urllib.request.urlopen(f"{base}/status", timeout=5).read()
+        assert b"nat_server_requests" in body
+        assert b"nat_scheduler_workers" in body
+        body = urllib.request.urlopen(f"{base}/version", timeout=5).read()
+        assert body.startswith(b"brpc_tpu_native/")
+        try:
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # tpu_std still works on the same port after HTTP traffic
+        ch = rpc.Channel()
+        assert ch.init(f"127.0.0.1:{port}") == 0
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message="mixed"),
+                             echo_pb2.EchoResponse, timeout_ms=5000)
+        assert not cntl.failed() and resp.message == "mixed"
+        ch.close()
+    finally:
+        native.rpc_server_stop()
