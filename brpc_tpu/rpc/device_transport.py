@@ -348,11 +348,12 @@ _xfer_conns_lock = threading.Lock()
 
 def _global_xfer_server():
     """Lazy singleton jax.experimental.transfer server; None when the
-    backend/jax build lacks it (the capability is advertised in the
-    handshake so both sides agree)."""
+    backend/jax build lacks it. Start failures are NOT latched: an early
+    failure (e.g. before jax is fully configured) retries on the next
+    handshake rather than silently disabling the lane forever. Started
+    eagerly by device handshakes because the advertisement must be
+    truthful — a peer that sees True may put zero payload on the wire."""
     global _xfer_server
-    if _xfer_server is False:
-        return None
     if _xfer_server is not None:
         return _xfer_server
     with _xfer_server_lock:
@@ -364,8 +365,8 @@ def _global_xfer_server():
                 _xfer_server = transfer.start_transfer_server(
                     jax.devices()[0].client)
             except Exception:
-                _xfer_server = False
-    return _xfer_server if _xfer_server is not False else None
+                return None  # retry on a later call
+    return _xfer_server
 
 
 def _xfer_connect(addr: str):

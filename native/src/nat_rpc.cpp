@@ -633,9 +633,18 @@ static int try_process_http(NatSocket* s, IOBuf* batch_out) {
   if (end == std::string::npos) {
     return raw.size() > (64u << 10) ? 0 : 2;  // oversized header: bail
   }
-  s->in_buf.pop_front(end + 4);
   std::string headers = raw.substr(0, end);  // THIS request only, not any
   for (char& c : headers) c = (char)tolower((unsigned char)c);
+  // a body (Content-Length) must be consumed too, or its bytes would be
+  // parsed as the next frame and poison the stream
+  size_t body_len = 0;
+  size_t clpos = headers.find("content-length:");
+  if (clpos != std::string::npos) {
+    body_len = (size_t)strtoul(headers.c_str() + clpos + 15, nullptr, 10);
+    if (body_len > (64u << 10)) return 0;  // absurd for a console GET
+  }
+  if (raw.size() < end + 4 + body_len) return 2;  // body not buffered yet
+  s->in_buf.pop_front(end + 4 + body_len);
   size_t p0 = raw.find(' ');
   size_t p1 = raw.find(' ', p0 + 1);
   std::string path = (p0 != std::string::npos && p1 != std::string::npos)
