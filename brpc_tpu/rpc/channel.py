@@ -192,18 +192,28 @@ class Channel:
             app_connect=factory() if factory is not None else None,
         )
         sock = Socket.address(sid)
+        self._pin_protocol(sock)  # pre-connect: hook runs pre-registration
         rc = sock.connect(timeout_s=self.options.connect_timeout_ms / 1000.0)
         if rc != 0:
             return None
-        self._pin_protocol(sock)
         return sock
 
     def _pin_protocol(self, sock: Socket):
         """A client connection speaks exactly one protocol — pre-match it so
         weak-magic response parsers (esp, nshead) can never misclaim bytes
-        meant for another channel's protocol."""
+        meant for another channel's protocol. Call BEFORE connecting: the
+        on_pinned hook (h2 attaches its client connection + preface) must
+        run before the dispatcher can deliver a speaks-first peer's bytes,
+        so an unconnected socket defers it to connect()'s pre-registration
+        window via sock.on_connected."""
         if sock.matched_protocol is None:
             sock.matched_protocol = self._protocol
+            on_pinned = self._protocol.extra.get("on_pinned")
+            if on_pinned is not None:
+                if sock.fd() is not None:
+                    on_pinned(sock)
+                else:
+                    sock.on_connected = on_pinned
 
     def _select_socket(self, cntl: Controller):
         """Returns (Socket, rc). Applies LB if configured, then the
@@ -232,10 +242,10 @@ class Channel:
                 if (factory is not None and main_sock.app_connect is None
                         and main_sock.fd() is None):
                     main_sock.app_connect = factory()
+                self._pin_protocol(main_sock)
                 if main_sock.ensure_connected(
                         self.options.connect_timeout_ms / 1000.0) != 0:
                     return None, errors.EFAILEDSOCKET
-                self._pin_protocol(main_sock)
             return self._apply_connection_type(main_sock, cntl)
         if self._server_ep is None:
             return None, errors.EINVAL
@@ -298,10 +308,10 @@ class Channel:
             sock = Socket.address(sid) if sid is not None else None
             if sock is None:
                 return None, errors.EFAILEDSOCKET
+            self._pin_protocol(sock)  # pre-connect (see _pin_protocol)
             if sock.ensure_connected(
                     self.options.connect_timeout_ms / 1000.0) != 0:
                 return None, errors.EFAILEDSOCKET
-            self._pin_protocol(sock)
             self._single_sid = sock.socket_id
             self._mapped_key = key
             self._mapped_sid = sid

@@ -465,14 +465,35 @@ def serialize_request(request, cntl: Controller):
     return request.SerializeToString()
 
 
+_client_conn_lock = threading.Lock()  # guards ATTACHMENT only, not IO
+
+
+def ensure_client_conn(sock) -> "H2Connection":
+    """Attach the client H2Connection + send the preface. Called at
+    protocol-pin time (channel._pin_protocol): a speaks-first peer (grpcio
+    sends SETTINGS immediately) must find sock.h2_conn already attached,
+    or its frames race pack_request and fail protocol selection."""
+    conn = getattr(sock, "h2_conn", None)  # unlocked fast path (hot calls)
+    if conn is not None:
+        return conn
+    frames = None
+    with _client_conn_lock:
+        conn = getattr(sock, "h2_conn", None)
+        if conn is None:
+            conn = H2Connection(is_client=True)
+            sock.h2_conn = conn
+            frames = conn.initial_frames()
+    if frames is not None:
+        # the preface write happens OUTSIDE the lock: an inline flush to a
+        # slow peer must not stall other channels' first requests
+        sock.write(IOBuf(frames))
+    return conn
+
+
 def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf:
     sock = cntl._current_sock
-    conn: Optional[H2Connection] = getattr(sock, "h2_conn", None)
+    conn = ensure_client_conn(sock)  # preface sent at pin/first use
     out = IOBuf()
-    if conn is None:
-        conn = H2Connection(is_client=True)
-        sock.h2_conn = conn
-        out.append(conn.initial_frames())
     stream = conn.new_stream()
     stream.cid = correlation_id
     service, _, method = cntl._method_full_name.rpartition(".")
@@ -556,4 +577,5 @@ register_protocol(Protocol(
     process_request=process_message,
     process_response=process_message,
     process_inline=True,  # frame ordering is load-bearing
+    extra={"on_pinned": ensure_client_conn},
 ))

@@ -100,6 +100,7 @@ class Socket:
         self.connection_type = "single"
         self._conn_ready = False  # fd usable for RPC (post-handshake)
         self.app_connect = None  # AppConnect seam (device transport attaches)
+        self.on_connected = None  # protocol-pin hook, runs pre-registration
         self.app_state = None  # transport-private state (e.g. DeviceEndpoint)
         self.ssl_context = None  # client TLS context (ChannelSSLOptions)
         self.conn_data = None  # owner context (e.g. pooled-socket home)
@@ -197,6 +198,17 @@ class Socket:
             if rc != 0:
                 self.set_failed(rc, "app connect failed")
                 return rc
+        # Protocol-pinning hook, ALSO pre-registration: a speaks-first
+        # peer (h2 servers send SETTINGS immediately) must find the
+        # client-side protocol state attached before the dispatcher can
+        # deliver its first bytes. A hook failure is a failed connect.
+        if self.on_connected is not None:
+            try:
+                self.on_connected(self)
+            except Exception as e:
+                self.set_failed(errors.EFAILEDSOCKET,
+                                f"on_connected hook failed: {e}")
+                return errors.EFAILEDSOCKET
         self._register_with_dispatcher()
         self._conn_ready = True
         return 0

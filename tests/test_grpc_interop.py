@@ -73,3 +73,40 @@ def test_grpcio_unknown_method(server):
                                 grpc.StatusCode.NOT_FOUND,
                                 grpc.StatusCode.UNKNOWN)
     ch.close()
+
+
+def test_our_channel_against_grpcio_server():
+    """The reverse direction: OUR h2:grpc channel calling a stock grpcio
+    SERVER — client-side wire compatibility."""
+    from concurrent import futures
+
+    class Handler(grpc.GenericRpcHandler):
+        def service(self, handler_call_details):
+            if handler_call_details.method == "/EchoService/Echo":
+                def unary(request, context):
+                    resp = echo_pb2.EchoResponse()
+                    resp.message = request.message[::-1]
+                    return resp
+                return grpc.unary_unary_rpc_method_handler(
+                    unary,
+                    request_deserializer=echo_pb2.EchoRequest.FromString,
+                    response_serializer=(
+                        echo_pb2.EchoResponse.SerializeToString))
+            return None
+
+    gsrv = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    gsrv.add_generic_rpc_handlers((Handler(),))
+    port = gsrv.add_insecure_port("127.0.0.1:0")
+    gsrv.start()
+    try:
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="h2:grpc",
+                                            timeout_ms=5000))
+        assert ch.init(f"127.0.0.1:{port}") == 0
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message="ours->theirs"),
+                             echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "srieht>-sruo"
+        ch.close()
+    finally:
+        gsrv.stop(None)
