@@ -184,3 +184,8 @@ register_protocol(Protocol(
             lambda sock: getattr(sock, "esp_correlation_id", None) is None,
     },
 ))
+
+
+from brpc_tpu.rpc.socket import register_protocol_state_attr  # noqa: E402
+
+register_protocol_state_attr("esp_correlation_id")

@@ -476,17 +476,17 @@ def ensure_client_conn(sock) -> "H2Connection":
     conn = getattr(sock, "h2_conn", None)  # unlocked fast path (hot calls)
     if conn is not None:
         return conn
-    frames = None
     with _client_conn_lock:
         conn = getattr(sock, "h2_conn", None)
         if conn is None:
             conn = H2Connection(is_client=True)
+            # queue the preface BEFORE publishing the conn: a fast-path
+            # reader that sees h2_conn may immediately queue HEADERS, and
+            # the FIFO write queue must already hold the preface ahead of
+            # them. sock.write never blocks (non-blocking fd; leftovers go
+            # to the KeepWrite task), so holding the lock here is fine.
+            sock.write(IOBuf(conn.initial_frames()))
             sock.h2_conn = conn
-            frames = conn.initial_frames()
-    if frames is not None:
-        # the preface write happens OUTSIDE the lock: an inline flush to a
-        # slow peer must not stall other channels' first requests
-        sock.write(IOBuf(frames))
     return conn
 
 
@@ -579,3 +579,8 @@ register_protocol(Protocol(
     process_inline=True,  # frame ordering is load-bearing
     extra={"on_pinned": ensure_client_conn},
 ))
+
+
+from brpc_tpu.rpc.socket import register_protocol_state_attr  # noqa: E402
+
+register_protocol_state_attr("h2_conn")

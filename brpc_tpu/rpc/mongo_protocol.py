@@ -109,3 +109,8 @@ register_protocol(Protocol(
     process_request=process_request,
     support_client=False,
 ))
+
+
+from brpc_tpu.rpc.socket import register_protocol_state_attr  # noqa: E402
+
+register_protocol_state_attr("mongo_context")

@@ -67,6 +67,17 @@ class _WriteRequest:
         self.id_wait = id_wait
 
 
+# Protocol modules register the per-socket attribute names they attach
+# dynamically (h2 connections, pipelined-correlation queues, parked esp
+# cids, ...) so revive()/recycling can clear them — stale protocol state
+# on a fresh TCP connection corrupts the stream.
+_protocol_state_attrs: set = set()
+
+
+def register_protocol_state_attr(name: str):
+    _protocol_state_attrs.add(name)
+
+
 class Socket:
     _pool: ResourcePool = None
     _pool_lock = threading.Lock()
@@ -75,6 +86,7 @@ class Socket:
         self._reset()
 
     def _reset(self):
+        self._clear_protocol_state()  # recycled objects keep attributes
         self._fd: Optional[pysocket.socket] = None
         self._sid: int = 0
         self.remote_side: Optional[EndPoint] = None
@@ -429,6 +441,14 @@ class Socket:
         self._epollout = threading.Event()
         self._writing = False
         self._conn_ready = False
+        self._clear_protocol_state()
+
+    def _clear_protocol_state(self):
+        for name in _protocol_state_attrs:
+            try:
+                delattr(self, name)
+            except AttributeError:
+                pass
 
     def recycle(self):
         """Return to the pool — all outstanding SocketIds become stale."""

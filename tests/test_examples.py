@@ -29,6 +29,7 @@ EXAMPLES = [
     ("examples/legacy_pbrpc_echo.py", []),
     ("examples/device_performance.py", ["--threads", "2", "--mb", "1",
                                         "--iters", "3"]),
+    ("examples/io_uring_echo.py", ["--seconds", "1"]),
 ]
 
 

@@ -198,7 +198,14 @@ def test_rss_flat_under_sustained_load():
     sustained loopback run (TaskMeta reap + IOBuf block recycling + no
     per-request leaks on the native path)."""
     import ctypes
-    import resource
+    import os
+
+    def current_rss_mb() -> float:
+        # CURRENT rss, not ru_maxrss: the high-water mark passes vacuously
+        # when an earlier test already peaked higher
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / 1e6
 
     port = native.rpc_server_start("127.0.0.1", 0, nworkers=2,
                                    native_echo=True)
@@ -208,13 +215,11 @@ def test_rss_flat_under_sustained_load():
         # warmup builds steady-state pools/caches
         lib.nat_rpc_client_bench(b"127.0.0.1", port, 2, 32, 1.0, 16,
                                  ctypes.byref(out))
-        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        rss0 = current_rss_mb()
         for _ in range(3):
             lib.nat_rpc_client_bench(b"127.0.0.1", port, 2, 32, 1.0, 16,
                                      ctypes.byref(out))
-        rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-        # ru_maxrss is KB on Linux; allow modest growth (arenas, caches)
-        grown_mb = (rss1 - rss0) / 1024.0
+        grown_mb = current_rss_mb() - rss0
         assert grown_mb < 64, f"RSS grew {grown_mb:.1f}MB under load"
         assert out.value > 10000  # the run actually hammered the path
     finally:
