@@ -65,6 +65,19 @@ def main():
         # failures inside an existing echo bench must propagate, not be
         # silently replaced by a different headline metric.
         result = bench_model_fwd()
+    # device-side figure riding the extras (the rdma_performance north
+    # star): achieved allreduce bandwidth — only meaningful on a REAL
+    # multi-device mesh (one device moves zero inter-chip bytes)
+    try:
+        import jax
+
+        if len(jax.devices()) > 1:
+            from brpc_tpu.bench import collective_bench
+
+            coll = collective_bench(nbytes=1 << 24, iters=10)
+            result.setdefault("extra", {})["allreduce_GBps"] = coll["value"]
+    except Exception:
+        pass
     print(json.dumps(result))
 
 

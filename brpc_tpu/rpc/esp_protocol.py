@@ -185,7 +185,3 @@ register_protocol(Protocol(
     },
 ))
 
-
-from brpc_tpu.rpc.socket import register_protocol_state_attr  # noqa: E402
-
-register_protocol_state_attr("esp_correlation_id")

@@ -580,7 +580,3 @@ register_protocol(Protocol(
     extra={"on_pinned": ensure_client_conn},
 ))
 
-
-from brpc_tpu.rpc.socket import register_protocol_state_attr  # noqa: E402
-
-register_protocol_state_attr("h2_conn")

@@ -424,8 +424,3 @@ register_protocol(Protocol(
         sock, "ubrpc_correlation_id", None) is None},
 ))
 
-
-from brpc_tpu.rpc.socket import register_protocol_state_attr  # noqa: E402
-
-register_protocol_state_attr("nova_correlation_id")
-register_protocol_state_attr("ubrpc_correlation_id")

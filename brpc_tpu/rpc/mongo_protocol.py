@@ -110,7 +110,3 @@ register_protocol(Protocol(
     support_client=False,
 ))
 
-
-from brpc_tpu.rpc.socket import register_protocol_state_attr  # noqa: E402
-
-register_protocol_state_attr("mongo_context")

@@ -209,7 +209,3 @@ register_protocol(Protocol(
     process_inline=True,
 ))
 
-
-from brpc_tpu.rpc.socket import register_protocol_state_attr  # noqa: E402
-
-register_protocol_state_attr("_nshead_pipeline")

@@ -67,20 +67,16 @@ class _WriteRequest:
         self.id_wait = id_wait
 
 
-# Protocol modules register the per-socket attribute names they attach
-# dynamically (h2 connections, pipelined-correlation queues, parked esp
-# cids, ...) so revive()/recycling can clear them — stale protocol state
-# on a fresh TCP connection corrupts the stream.
-_protocol_state_attrs: set = set()
-
-
-def register_protocol_state_attr(name: str):
-    _protocol_state_attrs.add(name)
-
-
 class Socket:
     _pool: ResourcePool = None
     _pool_lock = threading.Lock()
+    # attribute names a freshly-reset Socket owns; anything beyond these
+    # is protocol-attached dynamic state (h2 connections, pipelined-
+    # correlation queues, parked esp/nova cids, mongo contexts, ...) and
+    # must be cleared on revive()/recycling — stale protocol state on a
+    # fresh TCP connection corrupts the stream. Captured automatically
+    # from the first reset, so protocols can never forget to register.
+    _core_attrs: "frozenset[str]" = None
 
     def __init__(self):
         self._reset()
@@ -117,6 +113,8 @@ class Socket:
         self.ssl_context = None  # client TLS context (ChannelSSLOptions)
         self.conn_data = None  # owner context (e.g. pooled-socket home)
         self.create_time = time.monotonic()
+        if Socket._core_attrs is None:
+            Socket._core_attrs = frozenset(self.__dict__.keys())
 
     # -- pool & id ---------------------------------------------------------
     @classmethod
@@ -444,11 +442,11 @@ class Socket:
         self._clear_protocol_state()
 
     def _clear_protocol_state(self):
-        for name in _protocol_state_attrs:
-            try:
-                delattr(self, name)
-            except AttributeError:
-                pass
+        core = Socket._core_attrs
+        if core is None:
+            return
+        for name in [n for n in self.__dict__ if n not in core]:
+            del self.__dict__[name]
 
     def recycle(self):
         """Return to the pool — all outstanding SocketIds become stale."""
