@@ -173,6 +173,23 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # async windowed lane: done-callback completions instead of parked
+    # fibers (the brpc async-call usage pattern)
+    async_qps = 0.0
+    try:
+        import ctypes
+
+        port3 = native.rpc_server_start(native_echo=True)
+        try:
+            out = ctypes.c_uint64(0)
+            async_qps = native.load().nat_rpc_client_bench_async(
+                b"127.0.0.1", port3, nconn, 256, max(1.0, seconds / 2), 
+                payload, ctypes.byref(out))
+        finally:
+            native.rpc_server_stop()
+    except Exception:
+        pass
+
     # the pure-Python framework figure, honestly reported
     python_qps = 0.0
     try:
@@ -196,6 +213,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             "lane": "io_uring" if ring_qps > fw["qps"] else "epoll",
             "epoll_qps": round(fw["qps"], 1),
             "io_uring_qps": round(ring_qps, 1),
+            "async_windowed_qps": round(async_qps, 1),
             "python_framework_qps": round(python_qps, 1),
             "bypass_ceiling_qps": round(bypass_qps, 1),
         },

@@ -44,8 +44,12 @@ def load() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
+        # incremental make keeps a cached .so in sync with newer sources
+        # (a stale library would miss newly-exported symbols); harmless
+        # no-op when up to date, ignored when only a prebuilt .so exists
+        built = _build()
         if not os.path.exists(_SO_PATH):
-            if not _build():
+            if not built:
                 raise NativeUnavailable(
                     "native core not built and toolchain unavailable")
         lib = ctypes.CDLL(_SO_PATH)
@@ -117,6 +121,10 @@ def load() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_double, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)]
         lib.nat_rpc_client_bench.restype = ctypes.c_double
+        lib.nat_rpc_client_bench_async.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_rpc_client_bench_async.restype = ctypes.c_double
         lib.nat_rpc_use_io_uring.argtypes = [ctypes.c_int]
         lib.nat_rpc_use_io_uring.restype = ctypes.c_int
         lib.nat_ring_counters.argtypes = [

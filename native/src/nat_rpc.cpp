@@ -325,6 +325,11 @@ struct PendingCall {
   std::string error_text;
   IOBuf response;
   IOBuf attachment;
+  // Asynchronous completion (brpc's done-closure, controller.h): when
+  // set, the response path invokes cb (which owns pc) instead of waking
+  // a parked caller — the async RPC surface sync calls are built on.
+  void (*cb)(PendingCall*, void*) = nullptr;
+  void* cb_arg = nullptr;
 };
 
 class NatChannel {
@@ -343,8 +348,15 @@ class NatChannel {
     if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
   }
 
-  PendingCall* begin_call(int64_t* cid_out) {
+  PendingCall* begin_call(int64_t* cid_out,
+                          void (*cb)(PendingCall*, void*) = nullptr,
+                          void* cb_arg = nullptr) {
     PendingCall* pc = new PendingCall();
+    // the callback must be installed BEFORE the call becomes visible in
+    // the pending table: a racing fail_all would otherwise take the
+    // parked-caller completion path and strand the async caller
+    pc->cb = cb;
+    pc->cb_arg = cb_arg;
     int64_t cid = next_cid.fetch_add(1, std::memory_order_relaxed);
     {
       std::lock_guard<std::mutex> g(mu);
@@ -373,6 +385,10 @@ class NatChannel {
     for (PendingCall* pc : all) {
       pc->error_code = code;
       pc->error_text = text;
+      if (pc->cb != nullptr) {
+        pc->cb(pc, pc->cb_arg);  // cb owns pc
+        continue;
+      }
       pc->done.value.store(1, std::memory_order_release);
       Scheduler::butex_wake(&pc->done, INT32_MAX);
     }
@@ -777,8 +793,12 @@ static bool process_input(NatSocket* s) {
         pc->error_text = meta.has_response ? meta.response.error_text : "";
         pc->response = std::move(payload);
         pc->attachment = std::move(attachment);
-        pc->done.value.store(1, std::memory_order_release);
-        Scheduler::butex_wake(&pc->done, INT32_MAX);
+        if (pc->cb != nullptr) {
+          pc->cb(pc, pc->cb_arg);  // async completion; cb owns pc
+        } else {
+          pc->done.value.store(1, std::memory_order_release);
+          Scheduler::butex_wake(&pc->done, INT32_MAX);
+        }
       }
     }
   }
@@ -1013,6 +1033,51 @@ static int ensure_runtime(int nworkers) {
   }
   return 0;
 }
+
+extern "C" {
+void* nat_channel_open(const char* ip, int port, int unused,
+                       int batch_writes);
+void nat_channel_close(void* h);
+}  // forward decls for the bench harness
+
+// Shared client-bench harness: channel open, timed run, stop broadcast,
+// fiber join via done_count, and the stack-Butex destruction handshake
+// (scheduler.cpp join(): once we hold/release the butex mutex, the last
+// waker is done touching it). spawn(ch, stop, total, done) returns the
+// number of fibers it started.
+template <typename SpawnFn, typename OnStopFn>
+static double run_client_bench(const char* ip, int port, int nconn,
+                               double seconds, uint64_t* out_requests,
+                               SpawnFn spawn, OnStopFn on_stop) {
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> total{0};
+  Butex done_count;
+  std::vector<NatChannel*> channels;
+  int nfibers = 0;
+  for (int c = 0; c < nconn; c++) {
+    NatChannel* ch = (NatChannel*)nat_channel_open(ip, port, 0, 1);
+    if (ch == nullptr) continue;
+    channels.push_back(ch);
+    nfibers += spawn(ch, &stop, &total, &done_count);
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((int64_t)(seconds * 1000)));
+  stop.store(true);
+  on_stop();
+  while (done_count.value.load(std::memory_order_acquire) < nfibers) {
+    Scheduler::butex_wait(&done_count,
+                          done_count.value.load(std::memory_order_acquire));
+  }
+  // destruction handshake: the last fiber may still be inside butex_wake
+  { std::lock_guard<std::mutex> g(done_count.mu); }
+  auto t1 = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(t1 - t0).count();
+  for (NatChannel* ch : channels) nat_channel_close(ch);
+  if (out_requests) *out_requests = total.load();
+  return dt > 0 ? (double)total.load() / dt : 0.0;
+}
+
 
 extern "C" {
 
@@ -1331,40 +1396,135 @@ static void bench_call_fiber(void* a) {
 double nat_rpc_client_bench(const char* ip, int port, int nconn,
                             int fibers_per_conn, double seconds,
                             int payload_size, uint64_t* out_requests) {
-  std::atomic<bool> stop{false};
-  std::atomic<uint64_t> total{0};
   std::string payload((size_t)payload_size, 'x');
-  Butex done_count;
-  std::vector<NatChannel*> channels;
-  int nfibers = 0;
-  for (int c = 0; c < nconn; c++) {
-    NatChannel* ch = (NatChannel*)nat_channel_open(ip, port, 0, 1);
-    if (ch == nullptr) continue;
-    channels.push_back(ch);
-    for (int f = 0; f < fibers_per_conn; f++) {
-      BenchFiberArg* arg = new BenchFiberArg{
-          ch, &stop, &total, &payload, &done_count};
-      Scheduler::instance()->spawn_detached(bench_call_fiber, arg);
-      nfibers++;
-    }
-  }
-  auto t0 = std::chrono::steady_clock::now();
-  std::this_thread::sleep_for(
-      std::chrono::milliseconds((int64_t)(seconds * 1000)));
-  stop.store(true);
-  while (done_count.value.load(std::memory_order_acquire) < nfibers) {
-    Scheduler::butex_wait(&done_count,
-                          done_count.value.load(std::memory_order_acquire));
-  }
-  auto t1 = std::chrono::steady_clock::now();
-  double dt = std::chrono::duration<double>(t1 - t0).count();
-  for (NatChannel* ch : channels) nat_channel_close(ch);
-  if (out_requests) *out_requests = total.load();
-  return dt > 0 ? (double)total.load() / dt : 0.0;
+  return run_client_bench(
+      ip, port, nconn, seconds, out_requests,
+      [&](NatChannel* ch, std::atomic<bool>* stop,
+          std::atomic<uint64_t>* total, Butex* done) {
+        for (int f = 0; f < fibers_per_conn; f++) {
+          BenchFiberArg* arg = new BenchFiberArg{
+              ch, stop, total, &payload, done};
+          Scheduler::instance()->spawn_detached(bench_call_fiber, arg);
+        }
+        return fibers_per_conn;
+      },
+      [] {});
 }
 
-// -- io_uring datapath control (the fork's -use_io_uring runtime flag,
-// socket.cpp:62) ------------------------------------------------------------
+// Async windowed bench: each connection keeps `window` requests in
+// flight through the REAL framework path (pending table -> Socket write
+// queue -> dispatcher/ring -> server dispatch -> response completion),
+// completing via PendingCall callbacks instead of parking a fiber per
+// call — the async-RPC usage pattern (brpc done-closures) at bench scale.
+struct AsyncBenchConn {
+  NatChannel* ch = nullptr;
+  std::atomic<bool>* stop = nullptr;
+  std::atomic<uint64_t>* total = nullptr;
+  std::string* payload = nullptr;
+  Butex* done_count = nullptr;
+  std::atomic<int> inflight{0};
+  Butex room;  // bumped when the window opens / on stop
+  int window = 64;
+  // lifetime: the sender fiber holds one ref, every in-flight call one
+  // more — the LAST completion callback may run after the fiber exited,
+  // so neither side can own the object outright
+  std::atomic<int> refs{1};
+
+  void add_ref() { refs.fetch_add(1, std::memory_order_relaxed); }
+  void release() {
+    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+  }
+};
+
+static void async_bench_cb(PendingCall* pc, void* arg) {
+  AsyncBenchConn* ab = (AsyncBenchConn*)arg;
+  if (pc->error_code == 0) {
+    ab->total->fetch_add(1, std::memory_order_relaxed);
+  }
+  delete pc;
+  ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
+  ab->room.value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(&ab->room, 1);
+  ab->release();  // the in-flight reference
+}
+
+static void async_bench_fiber(void* a) {
+  AsyncBenchConn* ab = (AsyncBenchConn*)a;
+  NatChannel* ch = ab->ch;
+  while (!ab->stop->load(std::memory_order_acquire)) {
+    if (ab->inflight.load(std::memory_order_acquire) >= ab->window) {
+      int32_t expected = ab->room.value.load(std::memory_order_acquire);
+      if (ab->inflight.load(std::memory_order_acquire) >= ab->window) {
+        Scheduler::butex_wait(&ab->room, expected);
+      }
+      continue;
+    }
+    NatSocket* s = sock_address(ch->sock_id);
+    if (s == nullptr) break;
+    int64_t cid = 0;
+    ab->inflight.fetch_add(1, std::memory_order_acq_rel);
+    ab->add_ref();  // released by async_bench_cb
+    PendingCall* pc = ch->begin_call(&cid, async_bench_cb, ab);
+    (void)pc;
+    IOBuf frame;
+    build_request_frame(&frame, cid, "EchoService", "Echo",
+                        ab->payload->data(), ab->payload->size(), nullptr,
+                        0);
+    int wrc = s->write(std::move(frame));
+    s->release();
+    if (wrc != 0) {
+      PendingCall* mine = ch->take_pending(cid);
+      if (mine != nullptr) {  // not yet consumed by fail_all's cb path
+        delete mine;
+        ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
+        ab->release();
+      }
+      break;
+    }
+  }
+  // drain the window before reporting done
+  while (ab->inflight.load(std::memory_order_acquire) > 0) {
+    int32_t expected = ab->room.value.load(std::memory_order_acquire);
+    if (ab->inflight.load(std::memory_order_acquire) == 0) break;
+    Scheduler::butex_wait(&ab->room, expected);
+  }
+  Butex* done = ab->done_count;
+  ab->release();  // the sender fiber's reference; cb refs may outlive us
+  done->value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(done, INT32_MAX);
+}
+
+
+double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
+                                  int window, double seconds,
+                                  int payload_size,
+                                  uint64_t* out_requests) {
+  std::string payload((size_t)payload_size, 'x');
+  std::vector<AsyncBenchConn*> conns;
+  double qps = run_client_bench(
+      ip, port, nconn, seconds, out_requests,
+      [&](NatChannel* ch, std::atomic<bool>* stop,
+          std::atomic<uint64_t>* total, Butex* done) {
+        AsyncBenchConn* ab = new AsyncBenchConn();
+        ab->ch = ch;
+        ab->stop = stop;
+        ab->total = total;
+        ab->payload = &payload;
+        ab->done_count = done;
+        ab->window = window > 0 ? window : 64;
+        conns.push_back(ab);
+        Scheduler::instance()->spawn_detached(async_bench_fiber, ab);
+        return 1;
+      },
+      [&] {
+        for (AsyncBenchConn* ab : conns) {  // unpark window-waiters
+          ab->room.value.fetch_add(1, std::memory_order_release);
+          Scheduler::butex_wake(&ab->room, INT32_MAX);
+        }
+      });
+  // conns are refcounted: fibers+callbacks released their refs by now
+  return qps;
+}
 
 // Enables the RingListener datapath for subsequently-accepted server
 // connections. Returns 1 when the ring is live, 0 when the kernel/sandbox

@@ -224,3 +224,21 @@ def test_rss_flat_under_sustained_load():
         assert out.value > 10000  # the run actually hammered the path
     finally:
         native.rpc_server_stop()
+
+
+def test_async_windowed_client():
+    """Done-callback completions (PendingCall.cb — the native async-RPC
+    surface): a windowed client keeps many requests in flight with no
+    parked fiber per call, and every request completes before return."""
+    import ctypes
+
+    port = native.rpc_server_start("127.0.0.1", 0, nworkers=2,
+                                   native_echo=True)
+    try:
+        out = ctypes.c_uint64(0)
+        qps = native.load().nat_rpc_client_bench_async(
+            b"127.0.0.1", port, 2, 128, 1.0, 16, ctypes.byref(out))
+        assert qps > 1000, qps
+        assert out.value > 1000
+    finally:
+        native.rpc_server_stop()
