@@ -31,8 +31,17 @@ def _build() -> bool:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                        capture_output=True, timeout=300)
         return True
-    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
-            FileNotFoundError):
+    except subprocess.CalledProcessError as e:
+        import sys
+
+        # a failed rebuild with a stale .so present would otherwise die
+        # later with a confusing missing-symbol AttributeError
+        sys.stderr.write(
+            "brpc_tpu.native: rebuild FAILED — a cached library may be "
+            "stale:\n" + (e.stderr or b"").decode(errors="replace")[-2000:]
+            + "\n")
+        return False
+    except (subprocess.TimeoutExpired, FileNotFoundError):
         return False
 
 

@@ -8,6 +8,9 @@ The environment's sitecustomize registers the axon TPU platform and sets
 jax_platforms via jax.config (which overrides the JAX_PLATFORMS env var), so
 we must override it back through jax.config before any backend initializes.
 """
+import faulthandler
+faulthandler.enable()
+
 import os
 
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -1512,6 +1512,9 @@ double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
         ab->payload = &payload;
         ab->done_count = done;
         ab->window = window > 0 ? window : 64;
+        ab->add_ref();  // the harness's own reference (released below) —
+                        // a conn whose fiber died early must outlive
+                        // on_stop's wakeup sweep
         conns.push_back(ab);
         Scheduler::instance()->spawn_detached(async_bench_fiber, ab);
         return 1;
@@ -1522,7 +1525,7 @@ double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
           Scheduler::butex_wake(&ab->room, INT32_MAX);
         }
       });
-  // conns are refcounted: fibers+callbacks released their refs by now
+  for (AsyncBenchConn* ab : conns) ab->release();
   return qps;
 }
 

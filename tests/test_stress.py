@@ -226,3 +226,68 @@ def test_failure_revival_churn():
     assert ok_ratio > 0.5, f"ok ratio {ok_ratio:.2f} under churn"
     ch.close()
     srv.stop()
+
+
+def test_ring_lane_storm():
+    """Concurrent Python channels + raw HTTP console GETs hammer a
+    ring-enabled native port: exercises ring drain concurrency, fixed-send
+    recycling, and the mixed tpu_std/HTTP cut loop under load."""
+    from brpc_tpu import native
+
+    if not native.available() or native.use_io_uring(True) != 1:
+        pytest.skip("io_uring unavailable")
+    try:
+        port = native.rpc_server_start("127.0.0.1", 0, nworkers=2,
+                                       native_echo=True)
+        stop = threading.Event()
+        errors_seen = []
+        counts = [0, 0]
+
+        def rpc_loop(slot):
+            try:
+                ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=5000))
+                assert ch.init(f"127.0.0.1:{port}") == 0
+                i = 0
+                while not stop.is_set():
+                    cntl, resp = ch.call(
+                        "EchoService.Echo",
+                        echo_pb2.EchoRequest(message=f"r{slot}.{i}"),
+                        echo_pb2.EchoResponse)
+                    if cntl.failed() or resp.message != f"r{slot}.{i}":
+                        errors_seen.append(cntl.error_text or "bad echo")
+                        return
+                    counts[slot] += 1
+                    i += 1
+                ch.close()
+            except Exception as e:  # noqa: BLE001
+                errors_seen.append(repr(e))
+
+        def http_loop():
+            import urllib.request
+
+            try:
+                while not stop.is_set():
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/status", timeout=5).read()
+                    if b"nat_server_requests" not in body:
+                        errors_seen.append("bad /status body")
+                        return
+            except Exception as e:  # noqa: BLE001
+                errors_seen.append(repr(e))
+
+        threads = [threading.Thread(target=rpc_loop, args=(0,)),
+                   threading.Thread(target=rpc_loop, args=(1,)),
+                   threading.Thread(target=http_loop)]
+        for t in threads:
+            t.start()
+        time.sleep(2.5)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors_seen, errors_seen[:3]
+        assert sum(counts) > 100
+        recv, send = native.ring_counters()
+        assert recv > 0 and send > 0  # traffic really rode the ring
+    finally:
+        native.rpc_server_stop()
+        native.use_io_uring(False)
