@@ -130,6 +130,11 @@ def load() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_double, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)]
         lib.nat_rpc_client_bench.restype = ctypes.c_double
+        lib.nat_channel_acall.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p,
+            ctypes.c_void_p]
+        lib.nat_channel_acall.restype = ctypes.c_int
         lib.nat_rpc_client_bench_async.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_double, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64)]
@@ -278,6 +283,29 @@ def channel_open(ip: str, port: int, batch_writes: bool = False):
 
 def channel_close(handle):
     load().nat_channel_close(handle)
+
+
+ACALL_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int32,
+                            ctypes.POINTER(ctypes.c_char), ctypes.c_size_t)
+
+
+def channel_acall(handle, service: str, method: str, payload: bytes,
+                  done):
+    """Asynchronous call: done(error_code, response_bytes) runs on a
+    framework FIBER (256KB stack) when the response arrives — keep it
+    lightweight and non-blocking, exactly like a brpc done closure with
+    usercode_in_pthread off; heavy work belongs on your own thread (hand
+    off via a queue). Returns (rc, cb): rc 0 means done WILL fire exactly
+    once (possibly already, with an error); keep a reference to cb until
+    then (ctypes does not). Failures before queueing also surface through
+    done, never as a second completion."""
+    def trampoline(_arg, code, resp, n):
+        done(code, ctypes.string_at(resp, n) if n else b"")
+
+    cb = ACALL_CB(trampoline)
+    rc = load().nat_channel_acall(handle, service.encode(), method.encode(),
+                                  payload, len(payload), cb, None)
+    return rc, cb
 
 
 def channel_call(handle, service: str, method: str,

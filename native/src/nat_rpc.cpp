@@ -1346,6 +1346,57 @@ int nat_channel_call(void* h, const char* service, const char* method,
 
 void nat_buf_free(char* p) { free(p); }
 
+// Asynchronous call for embedders (the done-closure surface): cb runs on
+// a framework thread/fiber when the response (or failure) arrives —
+// cb(user_arg, error_code, resp_bytes, resp_len). The response buffer is
+// only valid during the callback; copy it out if needed.
+typedef void (*nat_acall_cb)(void* arg, int32_t error_code,
+                             const char* resp, size_t resp_len);
+
+struct AcallCtx {
+  nat_acall_cb cb;
+  void* arg;
+};
+
+static void acall_complete(PendingCall* pc, void* raw) {
+  AcallCtx* ctx = (AcallCtx*)raw;
+  std::string resp = pc->response.to_string();
+  ctx->cb(ctx->arg, pc->error_code, resp.data(), resp.size());
+  delete pc;
+  delete ctx;
+}
+
+int nat_channel_acall(void* h, const char* service, const char* method,
+                      const char* payload, size_t payload_len,
+                      nat_acall_cb cb, void* arg) {
+  NatChannel* ch = (NatChannel*)h;
+  NatSocket* s = sock_address(ch->sock_id);
+  if (s == nullptr) return kEFAILEDSOCKET;
+  AcallCtx* ctx = new AcallCtx{cb, arg};
+  int64_t cid = 0;
+  ch->begin_call(&cid, acall_complete, ctx);
+  IOBuf frame;
+  build_request_frame(&frame, cid, service, method, payload, payload_len,
+                      nullptr, 0);
+  if (s->write(std::move(frame)) != 0) {
+    s->release();
+    PendingCall* mine = ch->take_pending(cid);
+    if (mine != nullptr) {
+      // not yet consumed: complete through the SAME callback path so the
+      // caller observes exactly ONE completion (returning an error here
+      // while fail_all might also fire cb would double-complete, and the
+      // caller would have no reason to keep the callback alive)
+      mine->error_code = kEFAILEDSOCKET;
+      mine->error_text = "socket failed before write";
+      acall_complete(mine, ctx);
+    }
+    // else: fail_all already delivered the failure through cb
+    return 0;
+  }
+  s->release();
+  return 0;
+}
+
 // ---- framework-path benchmark ----
 // F fibers per channel issue synchronous EchoService.Echo calls through the
 // FULL native stack (Channel pending table -> Socket write queue ->

@@ -242,3 +242,37 @@ def test_async_windowed_client():
         assert out.value > 1000
     finally:
         native.rpc_server_stop()
+
+
+def test_native_acall():
+    """nat_channel_acall — the exported done-closure call: completion runs
+    on a framework thread with the response bytes."""
+    import threading
+
+    port = native.rpc_server_start("127.0.0.1", 0, nworkers=2,
+                                   native_echo=True)
+    ch = None
+    try:
+        ch = native.channel_open("127.0.0.1", port)
+        results = []
+        done_evt = threading.Event()
+        keepalive = []
+
+        def done(code, resp):
+            results.append((code, resp))
+            if len(results) == 8:
+                done_evt.set()
+
+        for i in range(8):
+            rc, cb = native.channel_acall(ch, "EchoService", "Echo",
+                                          f"payload{i}".encode(), done)
+            assert rc == 0
+            keepalive.append(cb)
+        assert done_evt.wait(5)
+        assert all(code == 0 for code, _ in results)
+        assert sorted(r for _, r in results) == sorted(
+            f"payload{i}".encode() for i in range(8))
+    finally:
+        if ch is not None:
+            native.channel_close(ch)
+        native.rpc_server_stop()
