@@ -288,24 +288,39 @@ def channel_close(handle):
 ACALL_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int32,
                             ctypes.POINTER(ctypes.c_char), ctypes.c_size_t)
 
+_acall_live = {}  # id -> CFUNCTYPE thunk, alive until its done fires
+_acall_live_lock = threading.Lock()
+
 
 def channel_acall(handle, service: str, method: str, payload: bytes,
-                  done):
+                  done) -> int:
     """Asynchronous call: done(error_code, response_bytes) runs on a
     framework FIBER (256KB stack) when the response arrives — keep it
     lightweight and non-blocking, exactly like a brpc done closure with
     usercode_in_pthread off; heavy work belongs on your own thread (hand
-    off via a queue). Returns (rc, cb): rc 0 means done WILL fire exactly
-    once (possibly already, with an error); keep a reference to cb until
-    then (ctypes does not). Failures before queueing also surface through
-    done, never as a second completion."""
+    off via a queue). Returns 0 when done WILL fire exactly once
+    (possibly already, with an error code) — including failures detected
+    before queueing, which also surface through done. The wrapper owns
+    the callback thunk's lifetime."""
+    holder = []
+
     def trampoline(_arg, code, resp, n):
-        done(code, ctypes.string_at(resp, n) if n else b"")
+        try:
+            done(code, ctypes.string_at(resp, n) if n else b"")
+        finally:
+            with _acall_live_lock:
+                _acall_live.pop(holder[0], None)
 
     cb = ACALL_CB(trampoline)
+    holder.append(id(cb))
+    with _acall_live_lock:
+        _acall_live[id(cb)] = cb  # native side holds no GC-visible ref
     rc = load().nat_channel_acall(handle, service.encode(), method.encode(),
                                   payload, len(payload), cb, None)
-    return rc, cb
+    if rc != 0:  # never queued: done will not fire
+        with _acall_live_lock:
+            _acall_live.pop(id(cb), None)
+    return rc
 
 
 def channel_call(handle, service: str, method: str,

@@ -256,7 +256,6 @@ def test_native_acall():
         ch = native.channel_open("127.0.0.1", port)
         results = []
         done_evt = threading.Event()
-        keepalive = []
 
         def done(code, resp):
             results.append((code, resp))
@@ -264,10 +263,12 @@ def test_native_acall():
                 done_evt.set()
 
         for i in range(8):
-            rc, cb = native.channel_acall(ch, "EchoService", "Echo",
-                                          f"payload{i}".encode(), done)
+            rc = native.channel_acall(ch, "EchoService", "Echo",
+                                      f"payload{i}".encode(), done)
             assert rc == 0
-            keepalive.append(cb)
+        import gc
+
+        gc.collect()  # thunks must survive GC until done fires
         assert done_evt.wait(5)
         assert all(code == 0 for code, _ in results)
         assert sorted(r for _, r in results) == sorted(

@@ -180,3 +180,55 @@ def test_ids_page(server):
     finally:
         bthread_id.lock(idv)
         bthread_id.unlock_and_destroy(idv)
+
+
+def test_span_db_merges_across_eviction_boundary(tmp_path):
+    """A trace with spans BOTH still in memory and aged to disk returns
+    complete (the eviction-boundary merge in find_trace)."""
+    flags_mod.set_flag("rpcz_database_dir", str(tmp_path))
+    try:
+        s1 = rpcz.Span("server", "T.First", log_id=1)
+        s1.end(0)
+        trace_id = s1.trace_id
+        db = rpcz._get_span_db()
+        db.drain()
+        rpcz.clear_for_tests()  # s1 now lives only on disk
+        s2 = rpcz.Span("client", "T.Second", trace_id=trace_id)
+        s2.end(0)  # s2 in memory (and queued to disk)
+        found = rpcz.find_trace(trace_id)
+        methods = sorted(s.full_method for s in found)
+        assert methods == ["T.First", "T.Second"]
+        # no duplicate for s2 even though it is in memory AND on disk
+        assert len(found) == 2
+    finally:
+        flags_mod.set_flag("rpcz_database_dir", "")
+        rpcz.clear_for_tests()
+
+
+def test_per_second_series_matches_get_value_semantics():
+    """PerSecond.series plots the same quantity get_value reports (the
+    SUM rate for IntRecorder-backed windows, not the average)."""
+    from brpc_tpu import bvar
+
+    rec = bvar.IntRecorder()
+    win = bvar.PerSecond(rec, 5)
+    try:
+        import time as _t
+
+        win._sampler.take_sample()  # baseline
+        for v in (10, 20, 30):  # sum=60, num=3, avg=20
+            rec.update(v)
+        _t.sleep(0.05)
+        win._sampler.take_sample()
+        series = win.series()
+        assert series, "series empty"
+        samples = win._sampler.samples_in(5)
+        # integrate rate over each pair's own dt: immune to extra samples
+        # the background 1Hz collector may inject mid-test
+        total = sum(rate * (samples[i + 1][0] - samples[i][0])
+                    for i, (_, rate) in enumerate(series))
+        # must integrate back to the SUM delta (60), not the avg (20)
+        assert total == pytest.approx(60.0, rel=0.05), \
+            f"integrated {total}, sum semantics expect 60 (avg would be 20)"
+    finally:
+        win.destroy()
