@@ -176,6 +176,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     # async windowed lane: done-callback completions instead of parked
     # fibers (the brpc async-call usage pattern)
     async_qps = 0.0
+    async_requests = 0
     try:
         import ctypes
 
@@ -183,8 +184,9 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
         try:
             out = ctypes.c_uint64(0)
             async_qps = native.load().nat_rpc_client_bench_async(
-                b"127.0.0.1", port3, nconn, 256, max(1.0, seconds / 2), 
+                b"127.0.0.1", port3, nconn, 256, max(1.0, seconds / 2),
                 payload, ctypes.byref(out))
+            async_requests = out.value
         finally:
             native.rpc_server_stop()
     except Exception:
@@ -198,7 +200,18 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
-    qps = max(fw["qps"], ring_qps)
+    lanes = {"epoll": (fw["qps"], fw["requests"]),
+             "io_uring": (ring_qps,
+                          ring["requests"] if ring_qps > 0 else 0),
+             "async_windowed": (async_qps, async_requests)}
+    lane = max(lanes, key=lambda k: lanes[k][0])
+    qps, requests = lanes[lane]
+    # per-lane client shape, so the headline's config is reproducible
+    # (sync lanes park fibers_per_conn fibers; async keeps a 256-deep
+    # window per connection with no per-call fiber)
+    lane_config = {"epoll": f"{fibers_per_conn} sync fibers/conn",
+                   "io_uring": f"{fibers_per_conn} sync fibers/conn",
+                   "async_windowed": "window=256/conn, done-callbacks"}
     return {
         "metric": "echo_qps_framework_native",
         "value": round(qps, 1),
@@ -206,11 +219,10 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
         "vs_baseline": round(qps / BASELINE_QPS, 4),
         "extra": {
             "connections": nconn,
-            "fibers_per_conn": fibers_per_conn,
             "payload_bytes": payload,
-            "requests": (ring["requests"] if ring_qps > fw["qps"]
-                         else fw["requests"]),
-            "lane": "io_uring" if ring_qps > fw["qps"] else "epoll",
+            "requests": requests,
+            "lane": lane,
+            "lane_client_shape": lane_config[lane],
             "epoll_qps": round(fw["qps"], 1),
             "io_uring_qps": round(ring_qps, 1),
             "async_windowed_qps": round(async_qps, 1),
