@@ -1,0 +1,148 @@
+// Standalone framework-bench binary — profiling harness for the native
+// RPC hot path (the role example/multi_threaded_echo_c++ plays for the
+// reference).
+//
+// Usage: bench_native [seconds] [mode] [nconn] [depth]
+//   mode: sync | async | both (default both)
+// Prints qps per lane. PROF=samples.txt enables a SIGPROF-based flat
+// sampler (gprof's mcount corrupts state when code migrates across fiber
+// stacks; an ip-only sampler is signal-safe and fiber-proof) — the output
+// is "addr count" lines for addr2line, the PROFILE_r{N} artifact source.
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <execinfo.h>
+#include <sys/time.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <map>
+
+static void abort_handler(int sig) {
+  void* frames[64];
+  int n = backtrace(frames, 64);
+  backtrace_symbols_fd(frames, n, STDERR_FILENO);
+  signal(sig, SIG_DFL);
+  raise(sig);
+}
+
+// ---- flat profiler: SIGPROF ticks record the interrupted RIP ----
+static const size_t kMaxSamples = 1 << 22;
+static uint64_t* g_samples = nullptr;
+static std::atomic<size_t> g_nsamples{0};
+
+static void prof_handler(int, siginfo_t*, void* ucv) {
+  ucontext_t* uc = (ucontext_t*)ucv;
+  size_t i = g_nsamples.fetch_add(1, std::memory_order_relaxed);
+  if (i < kMaxSamples) {
+#if defined(__x86_64__)
+    g_samples[i] = (uint64_t)uc->uc_mcontext.gregs[REG_RIP];
+#else
+    g_samples[i] = 0;
+#endif
+  }
+}
+
+static void prof_start() {
+  g_samples = (uint64_t*)calloc(kMaxSamples, sizeof(uint64_t));
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_sigaction = prof_handler;
+  sa.sa_flags = SA_SIGINFO | SA_RESTART;
+  sigaction(SIGPROF, &sa, nullptr);
+  struct itimerval it;
+  it.it_interval.tv_sec = 0;
+  it.it_interval.tv_usec = 1000;  // 1kHz of process CPU time
+  it.it_value = it.it_interval;
+  setitimer(ITIMER_PROF, &it, nullptr);
+}
+
+static void prof_dump(const char* path) {
+  struct itimerval off;
+  memset(&off, 0, sizeof(off));
+  setitimer(ITIMER_PROF, &off, nullptr);
+  size_t n = std::min(g_nsamples.load(), kMaxSamples);
+  std::map<uint64_t, uint64_t> counts;
+  for (size_t i = 0; i < n; i++) counts[g_samples[i]]++;
+  FILE* f = fopen(path, "w");
+  if (f == nullptr) return;
+  // addresses are ASLR'd: emit the module base so addr2line can rebase
+  extern char __executable_start;
+  fprintf(f, "# base %p total %zu\n", (void*)&__executable_start, n);
+  for (auto& kv : counts) {
+    fprintf(f, "%llx %llu\n", (unsigned long long)kv.first,
+            (unsigned long long)kv.second);
+  }
+  // append the module map so library samples can be attributed offline
+  FILE* maps = fopen("/proc/self/maps", "r");
+  if (maps != nullptr) {
+    char line[512];
+    while (fgets(line, sizeof(line), maps) != nullptr) {
+      if (strstr(line, " r-xp ") != nullptr) fprintf(f, "#map %s", line);
+    }
+    fclose(maps);
+  }
+  fclose(f);
+}
+
+extern "C" {
+int nat_rpc_server_start(const char* ip, int port, int nworkers,
+                         int enable_native_echo);
+void nat_rpc_server_stop();
+double nat_rpc_client_bench(const char* ip, int port, int nconn,
+                            int fibers_per_conn, double seconds,
+                            int payload_size, uint64_t* out_requests);
+double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
+                                  int window, double seconds,
+                                  int payload_size, uint64_t* out_requests);
+void nat_io_counters(uint64_t* wc, uint64_t* wb, uint64_t* rc, uint64_t* rb);
+}
+
+static void print_io_stats(const char* lane, uint64_t reqs, uint64_t wc0,
+                           uint64_t rc0) {
+  uint64_t wc, wb, rc, rb;
+  nat_io_counters(&wc, &wb, &rc, &rb);
+  if (reqs == 0) return;
+  printf("%s io: %.2f writev/req %.2f read/req\n", lane,
+         (double)(wc - wc0) / reqs, (double)(rc - rc0) / reqs);
+}
+
+int main(int argc, char** argv) {
+  signal(SIGABRT, abort_handler);
+  signal(SIGSEGV, abort_handler);
+  double seconds = argc > 1 ? atof(argv[1]) : 2.0;
+  const char* mode = argc > 2 ? argv[2] : "both";
+  int nconn = argc > 3 ? atoi(argv[3]) : 4;
+  int depth = argc > 4 ? atoi(argv[4]) : 256;
+
+  const char* prof_path = getenv("PROF");
+  int port = nat_rpc_server_start("127.0.0.1", 0, 0, 1);
+  if (port <= 0) {
+    fprintf(stderr, "server start failed\n");
+    return 1;
+  }
+  if (prof_path != nullptr) prof_start();
+  uint64_t reqs = 0;
+  uint64_t wc0, rc0, u;
+  if (strcmp(mode, "sync") == 0 || strcmp(mode, "both") == 0) {
+    nat_io_counters(&wc0, &u, &rc0, &u);
+    double qps = nat_rpc_client_bench("127.0.0.1", port, nconn, 64, seconds,
+                                      16, &reqs);
+    printf("sync_qps %.0f requests %llu\n", qps, (unsigned long long)reqs);
+    print_io_stats("sync", reqs, wc0, rc0);
+  }
+  if (strcmp(mode, "async") == 0 || strcmp(mode, "both") == 0) {
+    nat_io_counters(&wc0, &u, &rc0, &u);
+    double qps = nat_rpc_client_bench_async("127.0.0.1", port, nconn, depth,
+                                            seconds, 16, &reqs);
+    printf("async_qps %.0f requests %llu\n", qps, (unsigned long long)reqs);
+    print_io_stats("async", reqs, wc0, rc0);
+  }
+  if (prof_path != nullptr) prof_dump(prof_path);
+  nat_rpc_server_stop();
+  return 0;
+}

@@ -1,10 +1,45 @@
 #include "iobuf.h"
 
 #include <errno.h>
+#include <stdlib.h>
 #include <unistd.h>
 #include <algorithm>
 
 namespace brpc_tpu {
+
+// Per-thread block cache (the share_tls_block/release_tls_block discipline,
+// reference iobuf.cpp:323-445): blocks freed on this thread are kept for
+// reuse instead of round-tripping the allocator. The destructor frees the
+// cache at thread exit.
+struct TlsBlockCache {
+  static const size_t kCap = 64;  // 512KB per thread, bounded
+  IOBlock* blocks[kCap];
+  size_t n = 0;
+  ~TlsBlockCache() {
+    for (size_t i = 0; i < n; i++) delete blocks[i];
+  }
+};
+static thread_local TlsBlockCache tls_cache;
+
+IOBlock* IOBlock::create() {
+  TlsBlockCache& c = tls_cache;
+  if (c.n > 0) {
+    IOBlock* b = c.blocks[--c.n];
+    b->ref.store(1, std::memory_order_relaxed);
+    b->size = 0;
+    return b;
+  }
+  return new IOBlock();
+}
+
+void IOBlock::recycle(IOBlock* b) {
+  TlsBlockCache& c = tls_cache;
+  if (c.n < TlsBlockCache::kCap) {
+    c.blocks[c.n++] = b;
+    return;
+  }
+  delete b;
+}
 
 static thread_local IOBlock* tls_block = nullptr;  // share_tls_block analog
 
@@ -16,10 +51,46 @@ static IOBlock* tls_share_block() {
   return tls_block;
 }
 
+void IOBuf::make_room() {
+  if (begin_ > 0) {  // compact: reuse the vacated front
+    memmove(refs_, refs_ + begin_, count_ * sizeof(BlockRef));
+    begin_ = 0;
+    return;
+  }
+  uint32_t ncap = cap_ * 2;
+  BlockRef* nrefs = (BlockRef*)::malloc(ncap * sizeof(BlockRef));
+  memcpy(nrefs, refs_ + begin_, count_ * sizeof(BlockRef));
+  if (refs_ != inline_) ::free(refs_);
+  refs_ = nrefs;
+  cap_ = ncap;
+  begin_ = 0;
+}
+
+void IOBuf::steal(IOBuf&& other) {
+  if (other.refs_ == other.inline_) {
+    memcpy(inline_, other.inline_ + other.begin_,
+           other.count_ * sizeof(BlockRef));
+    refs_ = inline_;
+    begin_ = 0;
+    cap_ = kInlineRefs;
+  } else {
+    refs_ = other.refs_;
+    begin_ = other.begin_;
+    cap_ = other.cap_;
+    other.refs_ = other.inline_;
+    other.cap_ = kInlineRefs;
+  }
+  count_ = other.count_;
+  length_ = other.length_;
+  other.begin_ = 0;
+  other.count_ = 0;
+  other.length_ = 0;
+}
+
 void IOBuf::push_ref(IOBlock* b, uint32_t off, uint32_t len) {
   if (len == 0) return;
-  if (!refs_.empty()) {
-    BlockRef& tail = refs_.back();
+  if (count_ > 0) {
+    BlockRef& tail = refs_[begin_ + count_ - 1];
     if (tail.block == b && tail.offset + tail.length == off) {
       tail.length += len;  // merge contiguous refs
       length_ += len;
@@ -27,7 +98,7 @@ void IOBuf::push_ref(IOBlock* b, uint32_t off, uint32_t len) {
     }
   }
   b->add_ref();
-  refs_.push_back({b, off, len});
+  push_back({b, off, len});
   length_ += len;
 }
 
@@ -45,23 +116,28 @@ void IOBuf::append(const void* data, size_t n) {
 }
 
 void IOBuf::append(const IOBuf& other) {
-  for (const auto& r : other.refs_) {
+  for (uint32_t i = 0; i < other.count_; i++) {
+    const BlockRef& r = other.at(i);
     r.block->add_ref();
-    refs_.push_back(r);
+    push_back(r);
     length_ += r.length;
   }
 }
 
 void IOBuf::append(IOBuf&& other) {
-  if (refs_.empty()) {
-    refs_.swap(other.refs_);
-    length_ = other.length_;
-    other.length_ = 0;
+  if (count_ == 0) {
+    if (refs_ != inline_) ::free(refs_);
+    refs_ = inline_;
+    cap_ = kInlineRefs;
+    steal(std::move(other));
     return;
   }
-  for (auto& r : other.refs_) refs_.push_back(r);  // refs transfer as-is
+  for (uint32_t i = 0; i < other.count_; i++) {
+    push_back(other.at(i));  // refs transfer as-is
+  }
   length_ += other.length_;
-  other.refs_.clear();
+  other.begin_ = 0;
+  other.count_ = 0;
   other.length_ = 0;
 }
 
@@ -69,16 +145,16 @@ size_t IOBuf::cut_into(IOBuf* out, size_t n) {
   n = std::min(n, length_);
   size_t remain = n;
   while (remain > 0) {
-    BlockRef& r = refs_.front();
+    BlockRef& r = front();
     if (r.length <= remain) {
-      out->refs_.push_back(r);  // transfer ref ownership
+      out->push_back(r);  // transfer ref ownership
       out->length_ += r.length;
       remain -= r.length;
       length_ -= r.length;
-      refs_.pop_front();
+      drop_front();
     } else {
       r.block->add_ref();
-      out->refs_.push_back({r.block, r.offset, (uint32_t)remain});
+      out->push_back({r.block, r.offset, (uint32_t)remain});
       out->length_ += remain;
       r.offset += remain;
       r.length -= remain;
@@ -93,12 +169,12 @@ size_t IOBuf::pop_front(size_t n) {
   n = std::min(n, length_);
   size_t remain = n;
   while (remain > 0) {
-    BlockRef& r = refs_.front();
+    BlockRef& r = front();
     if (r.length <= remain) {
       remain -= r.length;
       length_ -= r.length;
       r.block->release();
-      refs_.pop_front();
+      drop_front();
     } else {
       r.offset += remain;
       r.length -= remain;
@@ -112,7 +188,8 @@ size_t IOBuf::pop_front(size_t n) {
 size_t IOBuf::copy_to(void* out, size_t n, size_t pos) const {
   char* dst = (char*)out;
   size_t copied = 0, skip = pos;
-  for (const auto& r : refs_) {
+  for (uint32_t i = 0; i < count_; i++) {
+    const BlockRef& r = at(i);
     if (copied >= n) break;
     if (skip >= r.length) {
       skip -= r.length;
@@ -133,11 +210,27 @@ std::string IOBuf::to_string() const {
   return s;
 }
 
+// IO syscall counters (bvar-role observability for the native lane; read
+// via nat_io_counters): how well write batching amortizes syscalls.
+std::atomic<uint64_t> g_writev_calls{0};
+std::atomic<uint64_t> g_writev_bytes{0};
+std::atomic<uint64_t> g_read_calls{0};
+std::atomic<uint64_t> g_read_bytes{0};
+
+extern "C" void nat_io_counters(uint64_t* wc, uint64_t* wb, uint64_t* rc,
+                                uint64_t* rb) {
+  if (wc) *wc = g_writev_calls.load(std::memory_order_relaxed);
+  if (wb) *wb = g_writev_bytes.load(std::memory_order_relaxed);
+  if (rc) *rc = g_read_calls.load(std::memory_order_relaxed);
+  if (rb) *rb = g_read_bytes.load(std::memory_order_relaxed);
+}
+
 ssize_t IOBuf::cut_into_fd(int fd, size_t max_bytes) {
   struct iovec iov[64];
   int niov = 0;
   size_t queued = 0;
-  for (const auto& r : refs_) {
+  for (uint32_t i = 0; i < count_; i++) {
+    const BlockRef& r = at(i);
     if (niov >= 64 || queued >= max_bytes) break;
     size_t take = std::min((size_t)r.length, max_bytes - queued);
     iov[niov].iov_base = r.block->data + r.offset;
@@ -147,7 +240,11 @@ ssize_t IOBuf::cut_into_fd(int fd, size_t max_bytes) {
   }
   if (niov == 0) return 0;
   ssize_t nw = writev(fd, iov, niov);
-  if (nw > 0) pop_front((size_t)nw);
+  if (nw > 0) {
+    g_writev_calls.fetch_add(1, std::memory_order_relaxed);
+    g_writev_bytes.fetch_add((uint64_t)nw, std::memory_order_relaxed);
+    pop_front((size_t)nw);
+  }
   return nw;
 }
 
@@ -156,6 +253,8 @@ ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes) {
   size_t want = std::min(max_bytes, b->left());
   ssize_t n = read(fd, b->data + b->size, want);
   if (n > 0) {
+    g_read_calls.fetch_add(1, std::memory_order_relaxed);
+    g_read_bytes.fetch_add((uint64_t)n, std::memory_order_relaxed);
     push_ref(b, (uint32_t)b->size, (uint32_t)n);
     b->size += (size_t)n;
   }

@@ -2,12 +2,17 @@
 // butil::IOBuf (/root/reference/src/butil/iobuf.h:64): chains of
 // (block, offset, length) refs over 8KB refcounted blocks; append/cut move
 // refs, not bytes; scatter-gather fd IO via readv/writev.
+//
+// Perf discipline (iobuf.cpp:323-445 in the reference: TLS block cache;
+// iobuf.h:77-104: small-view union): the ref list is an INLINE array with
+// a heap spill-over, so constructing/destroying an IOBuf in the per-call
+// hot path costs zero allocations, and freed blocks go to a per-thread
+// cache instead of the allocator.
 #pragma once
 
 #include <atomic>
 #include <cstdint>
 #include <cstring>
-#include <deque>
 #include <string>
 #include <sys/uio.h>
 
@@ -19,10 +24,11 @@ struct IOBlock {
   size_t size = 0;  // filled prefix
   char data[kSize];
 
-  static IOBlock* create() { return new IOBlock(); }
+  static IOBlock* create();   // TLS-cached (share_tls_block discipline)
+  static void recycle(IOBlock* b);
   void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
   void release() {
-    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) recycle(this);
   }
   size_t left() const { return kSize - size; }
 };
@@ -36,7 +42,10 @@ struct BlockRef {
 class IOBuf {
  public:
   IOBuf() = default;
-  ~IOBuf() { clear(); }
+  ~IOBuf() {
+    clear();
+    if (refs_ != inline_) ::free(refs_);
+  }
   IOBuf(const IOBuf& other) { append(other); }
   IOBuf& operator=(const IOBuf& other) {
     if (this != &other) {
@@ -45,17 +54,14 @@ class IOBuf {
     }
     return *this;
   }
-  IOBuf(IOBuf&& other) noexcept
-      : refs_(std::move(other.refs_)), length_(other.length_) {
-    other.refs_.clear();
-    other.length_ = 0;
-  }
+  IOBuf(IOBuf&& other) noexcept { steal(std::move(other)); }
   IOBuf& operator=(IOBuf&& other) noexcept {
     if (this != &other) {
       clear();
-      refs_.swap(other.refs_);
-      length_ = other.length_;
-      other.length_ = 0;
+      if (refs_ != inline_) ::free(refs_);
+      refs_ = inline_;
+      cap_ = kInlineRefs;
+      steal(std::move(other));
     }
     return *this;
   }
@@ -64,8 +70,9 @@ class IOBuf {
   bool empty() const { return length_ == 0; }
 
   void clear() {
-    for (auto& r : refs_) r.block->release();
-    refs_.clear();
+    for (uint32_t i = 0; i < count_; i++) refs_[begin_ + i].block->release();
+    begin_ = 0;
+    count_ = 0;
     length_ = 0;
   }
 
@@ -80,13 +87,52 @@ class IOBuf {
   size_t copy_to(void* out, size_t n, size_t pos = 0) const;
   std::string to_string() const;
 
+  // Contiguous view of the first n bytes: returns a pointer into the first
+  // block when the range doesn't straddle blocks (the common case for
+  // headers/meta), else copies into scratch. n must be <= scratch capacity.
+  const char* fetch(char* scratch, size_t n) const {
+    if (count_ > 0) {
+      const BlockRef& r = refs_[begin_];
+      if (r.length >= n) return r.block->data + r.offset;
+    }
+    copy_to(scratch, n);
+    return scratch;
+  }
+
   // scatter-gather IO
   ssize_t cut_into_fd(int fd, size_t max_bytes = SIZE_MAX);
   ssize_t append_from_fd(int fd, size_t max_bytes = 65536);
 
+  uint32_t ref_count() const { return count_; }  // observability/tests
+
  private:
+  static const uint32_t kInlineRefs = 6;
+
   void push_ref(IOBlock* b, uint32_t off, uint32_t len);
-  std::deque<BlockRef> refs_;
+
+  BlockRef& front() { return refs_[begin_]; }
+  const BlockRef& at(uint32_t i) const { return refs_[begin_ + i]; }
+
+  void push_back(const BlockRef& r) {
+    if (begin_ + count_ == cap_) make_room();
+    refs_[begin_ + count_] = r;
+    count_++;
+  }
+
+  void drop_front() {  // caller already released the ref
+    begin_++;
+    count_--;
+    if (count_ == 0) begin_ = 0;
+  }
+
+  void make_room();  // compact to 0 or grow the heap array
+  void steal(IOBuf&& other);
+
+  BlockRef inline_[kInlineRefs];
+  BlockRef* refs_ = inline_;
+  uint32_t begin_ = 0;
+  uint32_t count_ = 0;
+  uint32_t cap_ = kInlineRefs;
   size_t length_ = 0;
 };
 

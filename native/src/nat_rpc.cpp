@@ -49,8 +49,10 @@
 #include <condition_variable>
 #include <deque>
 #include <functional>
+#include <map>
 #include <mutex>
 #include <string>
+#include <string_view>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -83,6 +85,7 @@ static void wr_be32(char* p, uint32_t v) {
 class Dispatcher;
 class NatServer;
 class NatChannel;
+static Dispatcher* pick_dispatcher();
 
 // ---------------------------------------------------------------------------
 // NatSocket + versioned-id registry (socket_inl.h:28-185 shape)
@@ -90,17 +93,23 @@ class NatChannel;
 
 struct NatSocket {
   int fd = -1;
-  uint64_t id = 0;
+  // atomic: the server-stop scan reads ids of slots that sock_create may
+  // concurrently be recycling (relaxed loads compile to plain loads here)
+  std::atomic<uint64_t> id{0};
   Dispatcher* disp = nullptr;
   NatServer* server = nullptr;    // set on accepted connections
   NatChannel* channel = nullptr;  // set on client connections
 
   std::atomic<bool> failed{false};
-  std::atomic<int> ref{1};
+  // (version<<32)|refcount in ONE atomic (the _versioned_ref of
+  // socket_inl.h:28-78): addressing CAS-increments the refcount only
+  // while the version matches, so a stale id can never revive a recycled
+  // socket, and no registry lock is needed on the per-event/per-call path.
+  std::atomic<uint64_t> versioned_ref{0};
+  uint32_t next_version = 1;  // owner-only; assigned at (re)creation
 
-  // read side (one reader fiber at a time; ET re-entry via read_pending)
-  std::atomic<bool> reading{false};
-  std::atomic<bool> read_pending{false};
+  // read side: drained inline by the owning dispatcher loop (single
+  // reader per socket by construction)
   IOBuf in_buf;
 
   // write side
@@ -125,8 +134,9 @@ struct NatSocket {
   bool ring_sending = false;   // under write_mu
   size_t ring_inflight = 0;    // bytes submitted, awaiting completion
 
-  void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
+  void add_ref() { versioned_ref.fetch_add(1, std::memory_order_relaxed); }
   void release();
+  void reset_for_reuse();
   int write(IOBuf&& frame);
   bool flush_some();  // true = drained/failed-and-drained, false = EAGAIN
   void set_failed();
@@ -134,51 +144,91 @@ struct NatSocket {
   void disarm_epollout();
 };
 
-struct SockSlot {
-  NatSocket* sock = nullptr;
-  uint32_t version = 0;
-};
+// Socket registry — ResourcePool discipline (butil/resource_pool.h +
+// socket_inl.h): NatSocket objects are slab-allocated and NEVER freed, so
+// a slot index is a permanently-valid pointer; liveness is governed solely
+// by the (version, refcount) atomic inside the socket. Lookups take no
+// lock; the mutex below only guards slab growth and the index freelist.
+static const uint32_t kSockSlabBits = 10;
+static const uint32_t kSockSlabSize = 1u << kSockSlabBits;  // 1024
+static const uint32_t kSockSlabs = 1024;                    // 1M sockets max
+static std::atomic<NatSocket**> g_sock_slab[kSockSlabs];
+static std::mutex g_sock_alloc_mu;
+static std::vector<uint32_t> g_sock_free;
+static uint32_t g_sock_next_idx = 0;
 
-static std::mutex g_reg_mu;
-static std::vector<SockSlot> g_reg;
-static std::vector<uint32_t> g_reg_free;
+static NatSocket* sock_at(uint32_t idx) {
+  NatSocket** slab =
+      g_sock_slab[idx >> kSockSlabBits].load(std::memory_order_acquire);
+  if (slab == nullptr) return nullptr;
+  return slab[idx & (kSockSlabSize - 1)];
+}
 
-static uint64_t sock_register(NatSocket* s) {
-  std::lock_guard<std::mutex> g(g_reg_mu);
+// Allocate (or reuse) a socket slot; the returned socket has refcount 1
+// (the registry/creator reference) and a fresh version in both its id and
+// its versioned_ref.
+static NatSocket* sock_create() {
   uint32_t idx;
-  if (!g_reg_free.empty()) {
-    idx = g_reg_free.back();
-    g_reg_free.pop_back();
-  } else {
-    idx = (uint32_t)g_reg.size();
-    g_reg.push_back(SockSlot());
+  NatSocket* s = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_sock_alloc_mu);
+    if (!g_sock_free.empty()) {
+      idx = g_sock_free.back();
+      g_sock_free.pop_back();
+      s = sock_at(idx);
+    } else {
+      idx = g_sock_next_idx++;
+      uint32_t slab_i = idx >> kSockSlabBits;
+      if (slab_i >= kSockSlabs) return nullptr;
+      if (g_sock_slab[slab_i].load(std::memory_order_relaxed) == nullptr) {
+        NatSocket** slab = new NatSocket*[kSockSlabSize]();
+        g_sock_slab[slab_i].store(slab, std::memory_order_release);
+      }
+    }
   }
-  g_reg[idx].sock = s;
-  g_reg[idx].version++;
-  uint64_t id = ((uint64_t)g_reg[idx].version << 32) | idx;
-  s->id = id;
-  return id;
+  if (s == nullptr) {
+    s = new NatSocket();  // lives forever in its slot
+    g_sock_slab[idx >> kSockSlabBits].load(std::memory_order_acquire)
+        [idx & (kSockSlabSize - 1)] = s;
+  } else {
+    s->reset_for_reuse();
+  }
+  uint32_t ver = s->next_version++;
+  if (ver == 0) ver = s->next_version++;  // version 0 reserved (= dead)
+  s->id = ((uint64_t)ver << 32) | idx;
+  s->versioned_ref.store(((uint64_t)ver << 32) | 1,
+                         std::memory_order_release);
+  return s;
 }
 
 // Address with a borrowed reference (caller must release()); nullptr once
-// the id generation is stale — use-after-free-proof addressing.
+// the id generation is stale — use-after-free-proof, lock-free.
 static NatSocket* sock_address(uint64_t id) {
-  std::lock_guard<std::mutex> g(g_reg_mu);
   uint32_t idx = (uint32_t)(id & 0xffffffffu);
   uint32_t ver = (uint32_t)(id >> 32);
-  if (idx >= g_reg.size()) return nullptr;
-  SockSlot& slot = g_reg[idx];
-  if (slot.version != ver || slot.sock == nullptr) return nullptr;
-  slot.sock->add_ref();
-  return slot.sock;
+  NatSocket* s = sock_at(idx);
+  if (s == nullptr) return nullptr;
+  uint64_t vr = s->versioned_ref.load(std::memory_order_acquire);
+  while ((uint32_t)(vr >> 32) == ver && (uint32_t)vr != 0) {
+    if (s->versioned_ref.compare_exchange_weak(vr, vr + 1,
+                                               std::memory_order_acq_rel)) {
+      return s;
+    }
+  }
+  return nullptr;
 }
 
+// Invalidate the id (bump the version, keeping the refcount) so future
+// sock_address calls fail; existing references stay valid until released.
 static void sock_unregister(NatSocket* s) {
-  std::lock_guard<std::mutex> g(g_reg_mu);
-  uint32_t idx = (uint32_t)(s->id & 0xffffffffu);
-  if (idx < g_reg.size() && g_reg[idx].sock == s) {
-    g_reg[idx].sock = nullptr;
-    g_reg_free.push_back(idx);
+  uint64_t vr = s->versioned_ref.load(std::memory_order_acquire);
+  while (true) {
+    uint64_t bumped = vr + (1ull << 32);
+    if (s->versioned_ref.compare_exchange_weak(vr, bumped,
+                                               std::memory_order_acq_rel)) {
+      s->next_version = (uint32_t)(bumped >> 32) + 1;
+      return;
+    }
   }
 }
 
@@ -186,7 +236,6 @@ static void sock_unregister(NatSocket* s) {
 // Dispatcher — one epoll loop feeding the fiber scheduler
 // ---------------------------------------------------------------------------
 
-static void reader_fiber(void* arg);
 
 class Dispatcher {
  public:
@@ -286,7 +335,8 @@ class NatServer {
   std::atomic<uint64_t> requests{0};
   std::atomic<uint64_t> connections{0};
 
-  std::unordered_map<std::string, NativeHandler> handlers;  // frozen at start
+  // frozen at start; std::less<> enables allocation-free string_view find
+  std::map<std::string, NativeHandler, std::less<>> handlers;
   bool py_lane_enabled = false;
 
   // Python lane MPSC queue
@@ -319,6 +369,8 @@ class NatServer {
 // NatChannel (client half)
 // ---------------------------------------------------------------------------
 
+class NatChannel;
+
 struct PendingCall {
   Butex done;  // 0 = in flight, 1 = complete
   int32_t error_code = 0;
@@ -330,52 +382,30 @@ struct PendingCall {
   // a parked caller — the async RPC surface sync calls are built on.
   void (*cb)(PendingCall*, void*) = nullptr;
   void* cb_arg = nullptr;
+  // Slot machinery (the versioned CallId discipline of bthread/id.h:38-60
+  // + controller.h:655-664): calls live in never-freed slabs owned by
+  // the channel; the correlation id packs (version, slot index), and a
+  // single atomic word (version<<1 | pending) arbitrates completion —
+  // whoever CASes the pending bit off owns the call. No lock, no map,
+  // no allocation on the per-call path, and a late/duplicate response
+  // (stale version) can never touch a recycled call.
+  NatChannel* owner = nullptr;
+  uint32_t slot_idx = 0;
+  uint32_t next_free = 0;  // freelist link, encoded idx+1
+  std::atomic<uint64_t> state{0};  // (version << 1) | pending_bit
 };
 
-// PendingCall freelist (the ObjectPool discipline butil applies to hot
-// per-call objects): one malloc/free pair per RPC shows on the profile
-// at 700k calls/s. Butex-bearing objects are NEVER returned to the
-// allocator — the completer's store(done)-then-butex_wake may still be
-// inside the wake when the caller recycles the object, and a wake on a
-// REUSED PendingCall is harmlessly spurious (butex_wait re-checks the
-// value) while a wake on a FREED one is UB. This never-free property is
-// the point of pooling butexes (butil ObjectPool usage in bthread/id).
-// One global mutex guards the list: measured ~equal to the allocator on
-// this host (a TLS-cached tier measured no better here; revisit on
-// many-core hosts where the shared lock would actually contend).
-static std::mutex g_pc_pool_mu;
-static std::vector<PendingCall*> g_pc_pool;
-
-static PendingCall* pc_alloc() {
-  {
-    std::lock_guard<std::mutex> g(g_pc_pool_mu);
-    if (!g_pc_pool.empty()) {
-      PendingCall* pc = g_pc_pool.back();
-      g_pc_pool.pop_back();
-      return pc;
-    }
-  }
-  return new PendingCall();
-}
-
-static void pc_free(PendingCall* pc) {
-  pc->done.value.store(0, std::memory_order_relaxed);
-  pc->error_code = 0;
-  pc->error_text.clear();
-  pc->response.clear();
-  pc->attachment.clear();
-  pc->cb = nullptr;
-  pc->cb_arg = nullptr;
-  std::lock_guard<std::mutex> g(g_pc_pool_mu);
-  g_pc_pool.push_back(pc);  // never deleted (see above)
-}
+static void pc_free(PendingCall* pc);  // returns the slot to its channel
 
 class NatChannel {
  public:
+  static const uint32_t kIdxBits = 20;  // 1M concurrent calls per channel
+  static const uint32_t kIdxMask = (1u << kIdxBits) - 1;
+  static const uint32_t kSlabBits = 8;  // 256 calls per slab
+  static const uint32_t kSlabSize = 1u << kSlabBits;
+  static const uint32_t kMaxSlabs = 1u << (kIdxBits - kSlabBits);
+
   uint64_t sock_id = 0;
-  std::mutex mu;
-  std::unordered_map<int64_t, PendingCall*> pending;
-  std::atomic<int64_t> next_cid{1};
   // Lifetime: the owning socket holds one reference (released in
   // ~NatSocket) and the opener holds one (released in nat_channel_close),
   // so a reader fiber mid-process_input can never see a freed channel.
@@ -386,41 +416,66 @@ class NatChannel {
     if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
   }
 
+  ~NatChannel() {
+    for (uint32_t i = 0; i < kMaxSlabs; i++) {
+      PendingCall* slab = slabs_[i].load(std::memory_order_acquire);
+      if (slab != nullptr) delete[] slab;
+    }
+  }
+
+  PendingCall* slot_at(uint32_t idx) {
+    return &slabs_[idx >> kSlabBits].load(std::memory_order_acquire)
+                [idx & (kSlabSize - 1)];
+  }
+
   PendingCall* begin_call(int64_t* cid_out,
                           void (*cb)(PendingCall*, void*) = nullptr,
                           void* cb_arg = nullptr) {
-    PendingCall* pc = pc_alloc();
-    // the callback must be installed BEFORE the call becomes visible in
-    // the pending table: a racing fail_all would otherwise take the
-    // parked-caller completion path and strand the async caller
+    uint32_t idx = pop_free();
+    if (idx == UINT32_MAX) return nullptr;  // slot space exhausted
+    PendingCall* pc = slot_at(idx);
+    uint64_t version =
+        (pc->state.load(std::memory_order_relaxed) >> 1) + 1;
+    pc->done.value.store(0, std::memory_order_relaxed);
+    pc->error_code = 0;
+    pc->error_text.clear();
+    pc->response.clear();
+    pc->attachment.clear();
     pc->cb = cb;
     pc->cb_arg = cb_arg;
-    int64_t cid = next_cid.fetch_add(1, std::memory_order_relaxed);
-    {
-      std::lock_guard<std::mutex> g(mu);
-      pending[cid] = pc;
-    }
-    *cid_out = cid;
+    pc->owner = this;
+    pc->slot_idx = idx;
+    // everything above must be visible before the pending bit: a racing
+    // fail_all completes through cb/butex the instant it sees the bit
+    pc->state.store((version << 1) | 1, std::memory_order_release);
+    *cid_out = (int64_t)((version << kIdxBits) | idx);
     return pc;
   }
 
+  // CAS the pending bit off; the winner owns the call. Stale cids (old
+  // version) and double-completions lose the CAS and get nullptr.
   PendingCall* take_pending(int64_t cid) {
-    std::lock_guard<std::mutex> g(mu);
-    auto it = pending.find(cid);
-    if (it == pending.end()) return nullptr;
-    PendingCall* pc = it->second;
-    pending.erase(it);
-    return pc;
+    uint32_t idx = (uint32_t)cid & kIdxMask;
+    if (idx >= nslots_.load(std::memory_order_acquire)) return nullptr;
+    PendingCall* pc = slot_at(idx);
+    uint64_t expected = (((uint64_t)cid >> kIdxBits) << 1) | 1;
+    if (pc->state.compare_exchange_strong(expected, expected & ~1ull,
+                                          std::memory_order_acq_rel)) {
+      return pc;
+    }
+    return nullptr;
   }
 
   void fail_all(int32_t code, const char* text) {
-    std::vector<PendingCall*> all;
-    {
-      std::lock_guard<std::mutex> g(mu);
-      for (auto& kv : pending) all.push_back(kv.second);
-      pending.clear();
-    }
-    for (PendingCall* pc : all) {
+    uint32_t n = nslots_.load(std::memory_order_acquire);
+    for (uint32_t idx = 0; idx < n; idx++) {
+      PendingCall* pc = slot_at(idx);
+      uint64_t st = pc->state.load(std::memory_order_acquire);
+      if (!(st & 1)) continue;
+      if (!pc->state.compare_exchange_strong(st, st & ~1ull,
+                                             std::memory_order_acq_rel)) {
+        continue;  // a response beat us to it
+      }
       pc->error_code = code;
       pc->error_text = text;
       if (pc->cb != nullptr) {
@@ -431,21 +486,117 @@ class NatChannel {
       Scheduler::butex_wake(&pc->done, INT32_MAX);
     }
   }
+
+  void release_slot(uint32_t idx) { push_free(idx); }
+
+ private:
+  std::atomic<PendingCall*> slabs_[kMaxSlabs] = {};
+  std::atomic<uint32_t> nslots_{0};
+  std::atomic<uint64_t> free_head_{0};  // (aba_tag<<32) | (idx+1)
+  std::mutex grow_mu_;
+
+  uint32_t pop_free() {
+    while (true) {
+      uint64_t head = free_head_.load(std::memory_order_acquire);
+      while ((uint32_t)head != 0) {
+        uint32_t idx = (uint32_t)head - 1;
+        uint32_t next = slot_at(idx)->next_free;
+        uint64_t nhead = ((head >> 32) + 1) << 32 | next;
+        if (free_head_.compare_exchange_weak(head, nhead,
+                                             std::memory_order_acq_rel)) {
+          return idx;
+        }
+      }
+      if (!grow()) return UINT32_MAX;
+    }
+  }
+
+  void push_free(uint32_t idx) {
+    PendingCall* pc = slot_at(idx);
+    uint64_t head = free_head_.load(std::memory_order_acquire);
+    while (true) {
+      pc->next_free = (uint32_t)head;
+      uint64_t nhead = ((head >> 32) + 1) << 32 | (idx + 1);
+      if (free_head_.compare_exchange_weak(head, nhead,
+                                           std::memory_order_acq_rel)) {
+        return;
+      }
+    }
+  }
+
+  bool grow() {
+    std::lock_guard<std::mutex> g(grow_mu_);
+    uint32_t n = nslots_.load(std::memory_order_acquire);
+    if ((uint32_t)free_head_.load(std::memory_order_acquire) != 0) {
+      return true;  // another thread grew while we waited
+    }
+    uint32_t slab_i = n >> kSlabBits;
+    if (slab_i >= kMaxSlabs) return false;
+    PendingCall* slab = new PendingCall[kSlabSize];
+    slabs_[slab_i].store(slab, std::memory_order_release);
+    nslots_.store(n + kSlabSize, std::memory_order_release);
+    // seed indices [n+1, n+kSlabSize) through the freelist; hand out n
+    // implicitly by pushing it too
+    for (uint32_t i = 0; i < kSlabSize; i++) push_free(n + i);
+    return true;
+  }
 };
+
+// Return the call slot to its owning channel. The slot memory is never
+// freed while the channel lives, so a straggling butex_wake on a recycled
+// slot is harmlessly spurious (waiters re-check the value) — the same
+// never-free property the old global pool provided, now per channel.
+static void pc_free(PendingCall* pc) {
+  pc->response.clear();
+  pc->attachment.clear();
+  pc->owner->release_slot(pc->slot_idx);
+}
 
 // ---------------------------------------------------------------------------
 // NatSocket implementation
 // ---------------------------------------------------------------------------
 
 void NatSocket::release() {
-  if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+  uint64_t prev = versioned_ref.fetch_sub(1, std::memory_order_acq_rel);
+  if ((uint32_t)prev == 1) {
     // Deferred close (brpc defers to refcount-zero too, socket.cpp): the
     // fd number is only recycled once no fiber can still syscall on it,
-    // so a stale writev can never land on a reused descriptor.
-    if (fd >= 0) ::close(fd);
-    if (channel != nullptr) channel->release();
-    delete this;
+    // so a stale writev can never land on a reused descriptor. The object
+    // itself is NEVER freed (ResourcePool discipline) — its slot goes
+    // back to the freelist for the next sock_create.
+    if (fd >= 0) {
+      ::close(fd);
+      fd = -1;
+    }
+    if (channel != nullptr) {
+      channel->release();
+      channel = nullptr;
+    }
+    server = nullptr;
+    in_buf.clear();
+    {
+      std::lock_guard<std::mutex> g(write_mu);
+      write_q.clear();
+    }
+    uint32_t idx = (uint32_t)(id & 0xffffffffu);
+    std::lock_guard<std::mutex> g(g_sock_alloc_mu);
+    g_sock_free.push_back(idx);
   }
+}
+
+void NatSocket::reset_for_reuse() {
+  fd = -1;
+  disp = nullptr;
+  server = nullptr;
+  channel = nullptr;
+  failed.store(false, std::memory_order_relaxed);
+  writing = false;
+  defer_writes = false;
+  epoll_events = 0;
+  epollout.value.store(0, std::memory_order_relaxed);
+  ring_fidx.store(-1, std::memory_order_relaxed);
+  ring_sending = false;
+  ring_inflight = 0;
 }
 
 static RingListener* g_ring = nullptr;
@@ -623,23 +774,24 @@ int NatSocket::write(IOBuf&& frame) {
 // Messenger — tpu_std cut loop + dispatch (InputMessenger role)
 // ---------------------------------------------------------------------------
 
+// Header + meta are encoded into ONE stack buffer and appended in a single
+// call (one memcpy into the TLS share block, zero allocations); oversized
+// error texts spill to a heap scratch, never truncate.
 static void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
                                  const std::string& error_text,
                                  IOBuf&& payload, IOBuf&& attachment) {
-  RpcMetaN meta;
-  meta.has_response = true;
-  meta.response.error_code = error_code;
-  meta.response.error_text = error_text;
-  meta.correlation_id = cid;
-  meta.attachment_size = (int64_t)attachment.length();
-  std::string mb = encode_response_meta(meta);
-  char header[12];
-  memcpy(header, kMagicRpc, 4);
-  wr_be32(header + 4,
-          (uint32_t)(mb.size() + payload.length() + attachment.length()));
-  wr_be32(header + 8, (uint32_t)mb.size());
-  out->append(header, 12);
-  out->append(mb);
+  size_t bound = 12 + response_meta_bound(error_text.size());
+  char stack_buf[320];
+  char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
+  size_t mlen = encode_response_meta_to(buf + 12, error_code,
+                                        error_text.data(), error_text.size(),
+                                        cid, (int64_t)attachment.length());
+  memcpy(buf, kMagicRpc, 4);
+  wr_be32(buf + 4,
+          (uint32_t)(mlen + payload.length() + attachment.length()));
+  wr_be32(buf + 8, (uint32_t)mlen);
+  out->append(buf, 12 + mlen);
+  if (buf != stack_buf) free(buf);
   out->append(std::move(payload));
   out->append(std::move(attachment));
 }
@@ -649,19 +801,17 @@ static void build_request_frame(IOBuf* out, int64_t cid,
                                 const std::string& method,
                                 const char* payload, size_t payload_len,
                                 const char* att, size_t att_len) {
-  RpcMetaN meta;
-  meta.has_request = true;
-  meta.request.service_name = service;
-  meta.request.method_name = method;
-  meta.correlation_id = cid;
-  meta.attachment_size = (int64_t)att_len;
-  std::string mb = encode_request_meta(meta);
-  char header[12];
-  memcpy(header, kMagicRpc, 4);
-  wr_be32(header + 4, (uint32_t)(mb.size() + payload_len + att_len));
-  wr_be32(header + 8, (uint32_t)mb.size());
-  out->append(header, 12);
-  out->append(mb);
+  size_t bound = 12 + request_meta_bound(service.size(), method.size());
+  char stack_buf[320];
+  char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
+  size_t mlen = encode_request_meta_to(buf + 12, service.data(),
+                                       service.size(), method.data(),
+                                       method.size(), cid, (int64_t)att_len);
+  memcpy(buf, kMagicRpc, 4);
+  wr_be32(buf + 4, (uint32_t)(mlen + payload_len + att_len));
+  wr_be32(buf + 8, (uint32_t)mlen);
+  out->append(buf, 12 + mlen);
+  if (buf != stack_buf) free(buf);
   if (payload_len) out->append(payload, payload_len);
   if (att_len) out->append(att, att_len);
 }
@@ -752,7 +902,11 @@ static int try_process_http(NatSocket* s, IOBuf* batch_out) {
 // Cut + process every complete frame in s->in_buf. Server requests run
 // inline (responses batched into ONE socket write per read burst); client
 // responses complete pending calls.
-static bool process_input(NatSocket* s) {
+// With defer_out != nullptr, response bytes are parked there instead of
+// being written per read burst — the epoll dispatcher passes its per-round
+// accumulator so one writev covers EVERY burst of the round (cross-burst
+// syscall batching; the client-side defer_writes twin of this discipline).
+static bool process_input(NatSocket* s, IOBuf* defer_out = nullptr) {
   IOBuf batch_out;
   bool ok = true;
   while (true) {
@@ -774,12 +928,20 @@ static bool process_input(NatSocket* s) {
     }
     if (s->in_buf.length() < 12 + (size_t)body) break;
     s->in_buf.pop_front(12);
-    std::string meta_bytes;
-    meta_bytes.resize(meta_size);
-    s->in_buf.copy_to(&meta_bytes[0], meta_size);
-    s->in_buf.pop_front(meta_size);
+    // decode straight from the buffer (fetch: contiguous view or stack
+    // copy; meta blobs are tens of bytes — no heap string per frame)
+    char meta_stack[512];
+    const char* meta_ptr;
+    std::string meta_heap;
+    if (meta_size <= sizeof(meta_stack)) {
+      meta_ptr = s->in_buf.fetch(meta_stack, meta_size);
+    } else {
+      meta_heap.resize(meta_size);
+      s->in_buf.copy_to(&meta_heap[0], meta_size);
+      meta_ptr = meta_heap.data();
+    }
     RpcMetaN meta;
-    if (!decode_meta(meta_bytes.data(), meta_bytes.size(), &meta)) {
+    if (!decode_meta(meta_ptr, meta_size, &meta)) {
       ok = false;
       break;
     }
@@ -788,18 +950,37 @@ static bool process_input(NatSocket* s) {
       ok = false;
       break;
     }
+    // handler lookup BEFORE the meta pop: the py lane needs a copy of the
+    // raw meta bytes, but only requests that actually go to the py lane
+    // should pay it — native-handled frames stay allocation-free
+    NatServer* srv =
+        (meta.has_request && s->server != nullptr) ? s->server : nullptr;
+    auto it = srv != nullptr ? srv->handlers.end()
+                             : decltype(srv->handlers.end())();
+    std::string meta_copy;
+    if (srv != nullptr) {
+      char keybuf[256];
+      const std::string& sn = meta.request.service_name;
+      const std::string& mn = meta.request.method_name;
+      if (sn.size() + mn.size() + 1 <= sizeof(keybuf)) {
+        memcpy(keybuf, sn.data(), sn.size());
+        keybuf[sn.size()] = '.';
+        memcpy(keybuf + sn.size() + 1, mn.data(), mn.size());
+        it = srv->handlers.find(
+            std::string_view(keybuf, sn.size() + 1 + mn.size()));
+      }
+      if (it == srv->handlers.end() && srv->py_lane_enabled) {
+        meta_copy.assign(meta_ptr, meta_size);  // py lane re-parses it
+      }
+    }
+    s->in_buf.pop_front(meta_size);
     size_t payload_size = body - meta_size - att_size;
     IOBuf payload, attachment;
     s->in_buf.cut_into(&payload, payload_size);
     s->in_buf.cut_into(&attachment, att_size);
 
-    if (meta.has_request && s->server != nullptr) {
-      NatServer* srv = s->server;
+    if (srv != nullptr) {
       srv->requests.fetch_add(1, std::memory_order_relaxed);
-      std::string key = meta.request.service_name;
-      key += '.';
-      key += meta.request.method_name;
-      auto it = srv->handlers.find(key);
       if (it != srv->handlers.end()) {
         NativeHandlerCtx ctx;
         ctx.req_payload = &payload;
@@ -817,7 +998,7 @@ static bool process_input(NatSocket* s) {
         r->method = meta.request.method_name;
         r->payload = payload.to_string();
         r->attachment = attachment.to_string();
-        r->meta_bytes = meta_bytes;
+        r->meta_bytes = std::move(meta_copy);
         srv->enqueue_py(r);
       } else {
         build_response_frame(&batch_out, meta.correlation_id, kENOSERVICE,
@@ -840,39 +1021,57 @@ static bool process_input(NatSocket* s) {
       }
     }
   }
-  if (!batch_out.empty()) s->write(std::move(batch_out));
+  if (!batch_out.empty()) {
+    if (defer_out != nullptr) {
+      defer_out->append(std::move(batch_out));
+    } else {
+      s->write(std::move(batch_out));
+    }
+  }
   return ok;
 }
 
-static void reader_fiber(void* arg) {
-  NatSocket* s = (NatSocket*)arg;
-  while (true) {
-    bool closed = false;
-    while (!s->failed.load(std::memory_order_acquire)) {
-      ssize_t n = s->in_buf.append_from_fd(s->fd, IOBlock::kSize);
-      if (n > 0) {
-        if (!process_input(s)) {
-          closed = true;
-          break;
-        }
-        continue;
+// Drain an fd to EAGAIN and process every complete frame, ON THE CALLING
+// THREAD. The epoll dispatcher calls this inline (the bypass-loop shape,
+// and the fork's wait_task ring-drain discipline, task_group.cpp:158-169):
+// every process_input consumer is non-blocking by contract — native
+// handlers must not block, py-lane delivery is a brief mutex push, and
+// client completions are a butex wake — so a reader-fiber handoff per
+// event burst (spawn + remote-queue + futex wake) only added latency.
+// Single-reader safety holds because a socket belongs to exactly one
+// dispatcher loop.
+// Returns true when response bytes were queued (the caller flushes them at
+// end of round).
+static bool drain_socket_inline(NatSocket* s) {
+  IOBuf acc;  // responses of EVERY burst in this drain, flushed as one
+  bool dead = false;
+  while (!s->failed.load(std::memory_order_acquire)) {
+    ssize_t n = s->in_buf.append_from_fd(s->fd, IOBlock::kSize);
+    if (n > 0) {
+      if (!process_input(s, &acc)) {
+        dead = true;
+        break;
       }
-      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
-      if (n < 0 && errno == EINTR) continue;
-      closed = true;  // EOF or hard error
-      break;
+      continue;
     }
-    if (closed || s->failed.load(std::memory_order_acquire)) {
-      s->set_failed();
-      break;
-    }
-    // ET re-entry check: clear reading, then re-take if an event landed
-    // while we were draining (the StartInputEvent re-arm discipline).
-    s->reading.store(false, std::memory_order_release);
-    if (!s->read_pending.exchange(false)) break;
-    if (s->reading.exchange(true)) break;  // another reader took over
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    if (n < 0 && errno == EINTR) continue;
+    dead = true;  // EOF or hard error
+    break;
   }
-  s->release();
+  bool queued = false;
+  if (!acc.empty() && !dead) {
+    std::lock_guard<std::mutex> g(s->write_mu);
+    if (!s->failed.load(std::memory_order_acquire)) {
+      s->write_q.append(std::move(acc));
+      queued = true;
+    }
+  }
+  if (dead || s->failed.load(std::memory_order_acquire)) {
+    s->set_failed();
+    return false;
+  }
+  return queued;
 }
 
 // Moves a ring socket to the epoll lane (rearm impossible / multishot
@@ -979,12 +1178,15 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
     if (cfd < 0) break;
     int one = 1;
     setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    NatSocket* s = new NatSocket();
+    NatSocket* s = sock_create();  // holds the initial reference
+    if (s == nullptr) {
+      ::close(cfd);
+      break;
+    }
     s->fd = cfd;
-    s->disp = this;
+    s->disp = pick_dispatcher();  // shard across the loop pool
     s->server = srv;
     srv->connections.fetch_add(1);
-    sock_register(s);  // the registry holds the initial reference
     if (g_use_ring.load(std::memory_order_acquire) && g_ring != nullptr) {
       // publish the file index BEFORE arming recv: the first completion
       // can fire the instant the recv is armed
@@ -998,12 +1200,13 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
         g_ring->unregister_file(fidx);
       }
     }
-    add_consumer(s);
+    s->disp->add_consumer(s);
   }
 }
 
 void Dispatcher::run() {
   std::vector<struct epoll_event> events(256);
+  std::vector<NatSocket*> flush_list;  // queued output; flushed per round
   while (!stop.load(std::memory_order_acquire)) {
     int n = epoll_wait(epfd, events.data(), (int)events.size(), 100);
     for (int i = 0; i < n; i++) {
@@ -1032,15 +1235,32 @@ void Dispatcher::run() {
         Scheduler::butex_wake(&s->epollout, INT32_MAX);
       }
       if (events[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) {
-        if (!s->reading.exchange(true)) {
-          s->add_ref();
-          Scheduler::instance()->spawn_detached(reader_fiber, s);
-        } else {
-          s->read_pending.store(true, std::memory_order_release);
+        if (drain_socket_inline(s)) {
+          flush_list.push_back(s);  // keep the ref until the flush below
+          continue;
         }
       }
       s->release();
     }
+    // End-of-round flush: one writev per socket covering every burst the
+    // round produced (cross-burst syscall batching).
+    for (NatSocket* s : flush_list) {
+      bool become_writer = false;
+      {
+        std::lock_guard<std::mutex> g(s->write_mu);
+        if (!s->write_q.empty() && !s->writing &&
+            !s->failed.load(std::memory_order_acquire)) {
+          s->writing = true;
+          become_writer = true;
+        }
+      }
+      if (become_writer && !s->flush_some()) {
+        s->add_ref();
+        Scheduler::instance()->spawn_detached(keep_write_fiber, s);
+      }
+      s->release();
+    }
+    flush_list.clear();
   }
 }
 
@@ -1048,9 +1268,22 @@ void Dispatcher::run() {
 // Server / channel lifecycle + C API
 // ---------------------------------------------------------------------------
 
-static Dispatcher* g_disp = nullptr;
+// Dispatcher pool (-event_dispatcher_num analog, event_dispatcher.cpp:30):
+// sockets are sharded round-robin across N independent epoll loops so the
+// inline read/process path scales past one core. Listeners live on
+// loop 0; accepted/connected sockets go to the next loop in turn.
+static std::vector<Dispatcher*> g_disps;
+static Dispatcher* g_disp = nullptr;  // g_disps[0]: listeners + console
+static std::atomic<uint32_t> g_disp_rr{0};
+static int g_disp_count = 0;  // 0 = auto (set before first runtime use)
 static NatServer* g_rpc_server = nullptr;
 static std::mutex g_rt_mu;
+
+static Dispatcher* pick_dispatcher() {
+  if (g_disps.size() == 1) return g_disps[0];
+  uint32_t i = g_disp_rr.fetch_add(1, std::memory_order_relaxed);
+  return g_disps[i % g_disps.size()];
+}
 
 static int ensure_runtime(int nworkers) {
   std::lock_guard<std::mutex> g(g_rt_mu);
@@ -1061,13 +1294,22 @@ static int ensure_runtime(int nworkers) {
     }
     Scheduler::instance()->start(nworkers);
   }
-  if (g_disp == nullptr) {
-    g_disp = new Dispatcher();
-    if (g_disp->start() != 0) {
-      delete g_disp;
-      g_disp = nullptr;
-      return -1;
+  if (g_disps.empty()) {
+    int n = g_disp_count;
+    if (n <= 0) {
+      unsigned hw = std::thread::hardware_concurrency();
+      n = hw >= 16 ? 4 : hw >= 4 ? 2 : 1;
     }
+    for (int i = 0; i < n; i++) {
+      Dispatcher* d = new Dispatcher();
+      if (d->start() != 0) {
+        delete d;
+        if (g_disps.empty()) return -1;
+        break;  // run with what we have
+      }
+      g_disps.push_back(d);
+    }
+    g_disp = g_disps[0];
   }
   return 0;
 }
@@ -1118,6 +1360,15 @@ static double run_client_bench(const char* ip, int port, int nconn,
 
 
 extern "C" {
+
+// -event_dispatcher_num analog: set the epoll-loop pool size BEFORE the
+// runtime starts (0 = auto from hardware_concurrency). Returns the count
+// in effect.
+int nat_rpc_set_dispatchers(int n) {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  if (g_disps.empty() && n >= 0) g_disp_count = n;
+  return g_disps.empty() ? g_disp_count : (int)g_disps.size();
+}
 
 // Start the native RPC server. enable_native_echo registers the built-in
 // EchoService.Echo handler (zero-copy: response payload/attachment share
@@ -1184,22 +1435,21 @@ void nat_rpc_server_stop() {
     srv->py_stopping = true;
   }
   srv->py_cv.notify_all();
-  // fail remaining server-side connections
-  std::vector<uint64_t> ids;
+  // fail remaining server-side connections: scan the slot space (bounded
+  // by the high-water mark) and take a safe reference before failing
+  uint32_t hwm;
   {
-    std::lock_guard<std::mutex> g(g_reg_mu);
-    for (auto& slot : g_reg) {
-      if (slot.sock != nullptr && slot.sock->server == srv) {
-        ids.push_back(slot.sock->id);
-      }
-    }
+    std::lock_guard<std::mutex> g(g_sock_alloc_mu);
+    hwm = g_sock_next_idx;
   }
-  for (uint64_t id : ids) {
+  for (uint32_t idx = 0; idx < hwm; idx++) {
+    NatSocket* cand = sock_at(idx);
+    if (cand == nullptr) continue;
+    uint64_t id = cand->id;  // racy snapshot; sock_address validates it
     NatSocket* s = sock_address(id);
-    if (s != nullptr) {
-      s->set_failed();
-      s->release();
-    }
+    if (s == nullptr) continue;
+    if (s->server == srv) s->set_failed();
+    s->release();
   }
   // drain queued python-lane requests under the lane lock
   {
@@ -1314,15 +1564,19 @@ void* nat_channel_open(const char* ip, int port, int nworkers,
   fcntl(fd, F_SETFL, fl | O_NONBLOCK);
 
   NatChannel* ch = new NatChannel();
-  NatSocket* s = new NatSocket();
+  NatSocket* s = sock_create();
+  if (s == nullptr) {
+    ::close(fd);
+    ch->release();
+    return nullptr;
+  }
   s->fd = fd;
-  s->disp = g_disp;
+  s->disp = pick_dispatcher();
   s->channel = ch;
   ch->add_ref();  // the socket's reference, dropped in NatSocket::release
   s->defer_writes = (batch_writes != 0);
-  sock_register(s);
   ch->sock_id = s->id;
-  g_disp->add_consumer(s);
+  s->disp->add_consumer(s);
   return ch;
 }
 
@@ -1347,11 +1601,18 @@ int nat_channel_call(void* h, const char* service, const char* method,
   if (s == nullptr) return kEFAILEDSOCKET;
   int64_t cid = 0;
   PendingCall* pc = ch->begin_call(&cid);
+  if (pc == nullptr) {
+    s->release();
+    return kEFAILEDSOCKET;  // 1M calls already in flight on this channel
+  }
   IOBuf frame;
   build_request_frame(&frame, cid, service, method, payload, payload_len,
                       nullptr, 0);
+  // NOTE: the socket reference is held until the call completes — it pins
+  // the channel (socket->channel ref), so a concurrent nat_channel_close
+  // can never delete the slot slabs while we're parked on pc->done or
+  // reading the completed slot (the never-freed-butex discipline).
   if (s->write(std::move(frame)) != 0) {
-    s->release();
     PendingCall* mine = ch->take_pending(cid);
     if (mine != nullptr) {
       pc_free(mine);
@@ -1363,9 +1624,9 @@ int nat_channel_call(void* h, const char* service, const char* method,
       }
       pc_free(pc);
     }
+    s->release();
     return kEFAILEDSOCKET;
   }
-  s->release();
   while (pc->done.value.load(std::memory_order_acquire) == 0) {
     Scheduler::butex_wait(&pc->done, 0);
   }
@@ -1388,6 +1649,7 @@ int nat_channel_call(void* h, const char* service, const char* method,
     }
   }
   pc_free(pc);
+  s->release();  // pinned the channel through the slot access above
   return rc;
 }
 
@@ -1421,13 +1683,16 @@ int nat_channel_acall(void* h, const char* service, const char* method,
   if (s == nullptr) return kEFAILEDSOCKET;
   AcallCtx* ctx = new AcallCtx{cb, arg};
   int64_t cid = 0;
-  ch->begin_call(&cid, acall_complete, ctx);
+  if (ch->begin_call(&cid, acall_complete, ctx) == nullptr) {
+    s->release();
+    delete ctx;
+    return kEFAILEDSOCKET;
+  }
   IOBuf frame;
   build_request_frame(&frame, cid, service, method, payload, payload_len,
                       nullptr, 0);
   if (s->write(std::move(frame)) != 0) {
-    s->release();
-    PendingCall* mine = ch->take_pending(cid);
+    PendingCall* mine = ch->take_pending(cid);  // s still pins the channel
     if (mine != nullptr) {
       // not yet consumed: complete through the SAME callback path so the
       // caller observes exactly ONE completion (returning an error here
@@ -1438,6 +1703,7 @@ int nat_channel_acall(void* h, const char* service, const char* method,
       acall_complete(mine, ctx);
     }
     // else: fail_all already delivered the failure through cb
+    s->release();
     return 0;
   }
   s->release();
@@ -1467,12 +1733,16 @@ static void bench_call_fiber(void* a) {
     if (s == nullptr) break;
     int64_t cid = 0;
     PendingCall* pc = ch->begin_call(&cid);
+    if (pc == nullptr) {
+      s->release();
+      break;
+    }
     IOBuf frame;
     build_request_frame(&frame, cid, "EchoService", "Echo",
                         arg->payload->data(), arg->payload->size(), nullptr,
                         0);
     int wrc = s->write(std::move(frame));
-    s->release();
+    // the socket ref pins the channel until the slot access is done
     if (wrc != 0) {
       PendingCall* mine = ch->take_pending(cid);
       if (mine != nullptr) {
@@ -1483,6 +1753,7 @@ static void bench_call_fiber(void* a) {
         }
         pc_free(pc);
       }
+      s->release();
       break;
     }
     while (pc->done.value.load(std::memory_order_acquire) == 0) {
@@ -1490,6 +1761,7 @@ static void bench_call_fiber(void* a) {
     }
     bool ok = (pc->error_code == 0);
     pc_free(pc);
+    s->release();
     if (!ok) break;
     arg->total->fetch_add(1, std::memory_order_relaxed);
   }
@@ -1570,22 +1842,28 @@ static void async_bench_fiber(void* a) {
     ab->inflight.fetch_add(1, std::memory_order_acq_rel);
     ab->add_ref();  // released by async_bench_cb
     PendingCall* pc = ch->begin_call(&cid, async_bench_cb, ab);
-    (void)pc;
+    if (pc == nullptr) {
+      ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
+      ab->release();
+      s->release();
+      break;
+    }
     IOBuf frame;
     build_request_frame(&frame, cid, "EchoService", "Echo",
                         ab->payload->data(), ab->payload->size(), nullptr,
                         0);
     int wrc = s->write(std::move(frame));
-    s->release();
     if (wrc != 0) {
-      PendingCall* mine = ch->take_pending(cid);
+      PendingCall* mine = ch->take_pending(cid);  // s pins the channel
       if (mine != nullptr) {  // not yet consumed by fail_all's cb path
         pc_free(mine);
         ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
         ab->release();
       }
+      s->release();
       break;
     }
+    s->release();
   }
   // drain the window before reporting done
   while (ab->inflight.load(std::memory_order_acquire) > 0) {

@@ -111,6 +111,102 @@ inline std::string encode_response_meta(const RpcMetaN& m) {
   return out;
 }
 
+// ---- allocation-free encoders (hot path) ----
+// The std::string encoders above stay for cold paths; the per-call path
+// writes into a caller-provided stack buffer instead (one malloc per
+// frame shows at M-qps rates). Callers size the buffer with the *_bound
+// helpers; the functions return the encoded length.
+
+inline char* raw_varint(char* p, uint64_t v) {
+  while (v >= 0x80) {
+    *p++ = (char)((v & 0x7F) | 0x80);
+    v >>= 7;
+  }
+  *p++ = (char)v;
+  return p;
+}
+
+inline size_t varint_len(uint64_t v) {
+  size_t n = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    n++;
+  }
+  return n;
+}
+
+inline size_t request_meta_bound(size_t slen, size_t mlen) {
+  return slen + mlen + 48;
+}
+
+inline size_t encode_request_meta_to(char* buf, const char* service,
+                                     size_t slen, const char* method,
+                                     size_t mlen, int64_t cid,
+                                     int64_t att_size) {
+  char* p = buf;
+  size_t sub = 0;
+  if (slen) sub += 1 + varint_len(slen) + slen;
+  if (mlen) sub += 1 + varint_len(mlen) + mlen;
+  *p++ = (char)(1 << 3 | 2);  // request submessage
+  p = raw_varint(p, sub);
+  if (slen) {
+    *p++ = (char)(1 << 3 | 2);
+    p = raw_varint(p, slen);
+    memcpy(p, service, slen);
+    p += slen;
+  }
+  if (mlen) {
+    *p++ = (char)(2 << 3 | 2);
+    p = raw_varint(p, mlen);
+    memcpy(p, method, mlen);
+    p += mlen;
+  }
+  if (cid != 0) {
+    *p++ = (char)(4 << 3 | 0);
+    p = raw_varint(p, (uint64_t)cid);
+  }
+  if (att_size != 0) {
+    *p++ = (char)(5 << 3 | 0);
+    p = raw_varint(p, (uint64_t)att_size);
+  }
+  return (size_t)(p - buf);
+}
+
+inline size_t response_meta_bound(size_t err_text_len) {
+  return err_text_len + 48;
+}
+
+inline size_t encode_response_meta_to(char* buf, int32_t error_code,
+                                      const char* err_text, size_t tlen,
+                                      int64_t cid, int64_t att_size) {
+  char* p = buf;
+  size_t sub = 0;
+  if (error_code != 0) sub += 1 + varint_len((uint64_t)error_code);
+  if (tlen) sub += 1 + varint_len(tlen) + tlen;
+  // field always present so proto3 parsers see HasField("response")
+  *p++ = (char)(2 << 3 | 2);
+  p = raw_varint(p, sub);
+  if (error_code != 0) {
+    *p++ = (char)(1 << 3 | 0);
+    p = raw_varint(p, (uint64_t)error_code);
+  }
+  if (tlen) {
+    *p++ = (char)(2 << 3 | 2);
+    p = raw_varint(p, tlen);
+    memcpy(p, err_text, tlen);
+    p += tlen;
+  }
+  if (cid != 0) {
+    *p++ = (char)(4 << 3 | 0);
+    p = raw_varint(p, (uint64_t)cid);
+  }
+  if (att_size != 0) {
+    *p++ = (char)(5 << 3 | 0);
+    p = raw_varint(p, (uint64_t)att_size);
+  }
+  return (size_t)(p - buf);
+}
+
 // ---- RpcMeta decode ----
 
 inline bool skip_field(const char*& p, const char* end, int wire) {
