@@ -103,6 +103,12 @@ def load() -> ctypes.CDLL:
         lib.nat_req_sock_id.restype = ctypes.c_uint64
         lib.nat_req_free.argtypes = [ctypes.c_void_p]
         lib.nat_req_free.restype = None
+        lib.nat_req_kind.argtypes = [ctypes.c_void_p]
+        lib.nat_req_kind.restype = ctypes.c_int32
+        lib.nat_rpc_server_enable_raw_fallback.argtypes = [ctypes.c_int]
+        lib.nat_rpc_server_enable_raw_fallback.restype = ctypes.c_int
+        lib.nat_rpc_set_dispatchers.argtypes = [ctypes.c_int]
+        lib.nat_rpc_set_dispatchers.restype = ctypes.c_int
         lib.nat_sock_write.argtypes = [
             ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t]
         lib.nat_sock_write.restype = ctypes.c_int
@@ -114,13 +120,14 @@ def load() -> ctypes.CDLL:
             ctypes.c_size_t]
         lib.nat_respond.restype = ctypes.c_int
         lib.nat_channel_open.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
         lib.nat_channel_open.restype = ctypes.c_void_p
         lib.nat_channel_close.argtypes = [ctypes.c_void_p]
         lib.nat_channel_close.restype = None
         lib.nat_channel_call.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
             ctypes.POINTER(ctypes.c_char_p)]
         lib.nat_channel_call.restype = ctypes.c_int
@@ -132,7 +139,7 @@ def load() -> ctypes.CDLL:
         lib.nat_rpc_client_bench.restype = ctypes.c_double
         lib.nat_channel_acall.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_void_p,
             ctypes.c_void_p]
         lib.nat_channel_acall.restype = ctypes.c_int
         lib.nat_rpc_client_bench_async.argtypes = [
@@ -237,8 +244,10 @@ def rpc_server_requests() -> int:
 
 
 def take_request(timeout_ms: int = 100):
-    """Python lane: pull one request handed off by the native runtime.
-    Returns (handle, meta_bytes, payload, attachment, sock_id) or None."""
+    """Python lane: pull one item handed off by the native runtime.
+    Returns (handle, kind, meta_bytes, payload, attachment, sock_id, seq)
+    or None. kind 0 = parsed tpu_std request; 1 = raw protocol bytes
+    (seq orders chunks per socket); 2 = connection closed."""
     lib = load()
     h = lib.nat_take_request(timeout_ms)
     if not h:
@@ -249,7 +258,19 @@ def take_request(timeout_ms: int = 100):
         p = lib.nat_req_field(h, which, ctypes.byref(n))
         out.append(ctypes.string_at(p, n.value) if p and n.value else b"")
     meta_bytes, payload, attachment = out
-    return (h, meta_bytes, payload, attachment, lib.nat_req_sock_id(h))
+    return (h, lib.nat_req_kind(h), meta_bytes, payload, attachment,
+            lib.nat_req_sock_id(h), lib.nat_req_cid(h))
+
+
+def rpc_server_enable_raw_fallback(enable: bool = True) -> int:
+    """Multi-protocol native port: unknown framing goes to the Python
+    protocol stack as ordered raw chunks instead of failing the socket."""
+    return load().nat_rpc_server_enable_raw_fallback(1 if enable else 0)
+
+
+def rpc_set_dispatchers(n: int) -> int:
+    """-event_dispatcher_num analog; call before the runtime starts."""
+    return load().nat_rpc_set_dispatchers(n)
 
 
 def req_free(handle):
@@ -273,9 +294,14 @@ def respond(handle, error_code: int = 0, error_text: str = "",
                               attachment, len(attachment))
 
 
-def channel_open(ip: str, port: int, batch_writes: bool = False):
+def channel_open(ip: str, port: int, batch_writes: bool = False,
+                 connect_timeout_ms: int = 0, health_check_ms: int = 0):
+    """Open a native client channel. connect_timeout_ms bounds the dial
+    (0 = 10s guard); health_check_ms > 0 revives a failed connection in
+    the background, and any call after failure re-dials on demand."""
     h = load().nat_channel_open(ip.encode(), port, 0,
-                                1 if batch_writes else 0)
+                                1 if batch_writes else 0,
+                                connect_timeout_ms, health_check_ms)
     if not h:
         raise RuntimeError("native channel connect failed")
     return h
@@ -293,7 +319,7 @@ _acall_live_lock = threading.Lock()
 
 
 def channel_acall(handle, service: str, method: str, payload: bytes,
-                  done) -> int:
+                  done, timeout_ms: int = 0) -> int:
     """Asynchronous call: done(error_code, response_bytes) runs on a
     framework FIBER (256KB stack) when the response arrives — keep it
     lightweight and non-blocking, exactly like a brpc done closure with
@@ -316,7 +342,8 @@ def channel_acall(handle, service: str, method: str, payload: bytes,
     with _acall_live_lock:
         _acall_live[id(cb)] = cb  # native side holds no GC-visible ref
     rc = load().nat_channel_acall(handle, service.encode(), method.encode(),
-                                  payload, len(payload), cb, None)
+                                  payload, len(payload), timeout_ms, cb,
+                                  None)
     if rc != 0:  # never queued: done will not fire
         with _acall_live_lock:
             _acall_live.pop(id(cb), None)
@@ -324,15 +351,17 @@ def channel_acall(handle, service: str, method: str, payload: bytes,
 
 
 def channel_call(handle, service: str, method: str,
-                 payload: bytes = b""):
-    """Synchronous call through the native client. Returns
+                 payload: bytes = b"", timeout_ms: int = 0):
+    """Synchronous call through the native client; timeout_ms > 0 arms a
+    native deadline (ERPCTIMEDOUT on expiry). Returns
     (error_code, response_bytes, error_text)."""
     lib = load()
     resp = ctypes.c_char_p()
     rlen = ctypes.c_size_t(0)
     err = ctypes.c_char_p()
     rc = lib.nat_channel_call(handle, service.encode(), method.encode(),
-                              payload, len(payload), ctypes.byref(resp),
+                              payload, len(payload), timeout_ms,
+                              ctypes.byref(resp),
                               ctypes.byref(rlen), ctypes.byref(err))
     body = b""
     if resp:

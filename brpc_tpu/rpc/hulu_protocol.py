@@ -44,6 +44,27 @@ _FROM_HULU = {_HULU_NONE: compress_mod.COMPRESS_NONE,
               _HULU_ZLIB: compress_mod.COMPRESS_ZLIB}
 _TO_HULU = {v: k for k, v in _FROM_HULU.items()}
 
+# method full name -> derived descriptor index (None = underivable);
+# computed once — the pool lookup (and its usual KeyError for services
+# registered under Python class names) must not run per call
+_method_index_cache = {}
+
+
+def _derive_method_index(service: str, method: str):
+    key = service + "." + method
+    if key in _method_index_cache:
+        return _method_index_cache[key]
+    idx = None
+    try:
+        from google.protobuf import descriptor_pool
+
+        sd = descriptor_pool.Default().FindServiceByName(service)
+        idx = sd.FindMethodByName(method).index
+    except Exception:
+        idx = None
+    _method_index_cache[key] = idx
+    return idx
+
 
 class HuluMessage(InputMessageBase):
     __slots__ = ("meta", "payload", "is_request")
@@ -110,7 +131,15 @@ def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf
     # Stock hulu uses the UNQUALIFIED service name (service->name(), not
     # full_name — hulu_pbrpc_protocol.cpp:444); ours registers class names.
     meta.service_name = service.rpartition(".")[2]
-    meta.method_index = 0
+    # Stock hulu servers dispatch by method_index and IGNORE method_name
+    # (FindMethodPropertyByNameAndIndex) — the reference client sends
+    # method->index(). Honor an explicit cntl.hulu_method_index (the
+    # nova_method_index discipline), else derive the descriptor index
+    # from the protobuf pool when the service is a real pb service.
+    idx = getattr(cntl, "hulu_method_index", None)
+    if idx is None:
+        idx = _derive_method_index(service, method)
+    meta.method_index = idx if idx is not None else 0
     meta.method_name = method
     meta.correlation_id = correlation_id
     meta.log_id = cntl.log_id
@@ -126,6 +155,12 @@ def pack_request(payload: bytes, cntl: Controller, correlation_id: int) -> IOBuf
     if cntl.compress_type:
         meta.compress_type = _TO_HULU.get(cntl.compress_type, _HULU_NONE)
     payload = compress_mod.compress(payload, cntl.compress_type)
+    if len(cntl.request_attachment):
+        # pb bytes + raw attachment share the payload; user_message_size
+        # marks the boundary (hulu_pbrpc_protocol.cpp:354-359)
+        meta.user_message_size = len(payload)
+        payload = payload + cntl.request_attachment.copy_to_bytes(
+            len(cntl.request_attachment))
     return _pack_frame(meta, payload)
 
 
@@ -146,8 +181,18 @@ def process_response(msg: HuluMessage):
         if meta.error_code:
             cntl.set_failed(meta.error_code, meta.error_text or "hulu error")
         else:
+            payload = msg.payload
+            # user_message_size splits (compressed) pb bytes from the raw
+            # trailing attachment (hulu_pbrpc_protocol.cpp:354-359); the
+            # split must happen BEFORE decompression — the sender appends
+            # the attachment after compressing the pb part
+            if (meta.HasField("user_message_size")
+                    and 0 <= meta.user_message_size <= len(payload)):
+                cntl.response_attachment.append(
+                    payload[meta.user_message_size:])
+                payload = payload[:meta.user_message_size]
             payload = compress_mod.decompress(
-                msg.payload, _FROM_HULU.get(meta.compress_type, 0))
+                payload, _FROM_HULU.get(meta.compress_type, 0))
             resp = cntl._response
             if resp is not None and payload:
                 resp.ParseFromString(payload)
@@ -169,6 +214,10 @@ def _send_response(sock, cid: int, cntl: Controller, response):
         if cntl.compress_type:
             meta.compress_type = _TO_HULU.get(cntl.compress_type, 0)
             payload = compress_mod.compress(payload, cntl.compress_type)
+        if len(cntl.response_attachment):
+            meta.user_message_size = len(payload)
+            payload = payload + cntl.response_attachment.copy_to_bytes(
+                len(cntl.response_attachment))
     sock.write(_pack_frame(meta, payload))
     if cntl.close_connection_flag:
         sock.set_failed(errors.ECLOSE, "close_connection requested")
@@ -183,6 +232,11 @@ def process_request(msg: HuluMessage):
     cntl = Controller()
     cntl.log_id = meta.log_id
     cntl.trace_id = meta.trace_id
+    payload = msg.payload
+    if (meta.HasField("user_message_size")
+            and 0 <= meta.user_message_size <= len(payload)):
+        cntl.request_attachment.append(payload[meta.user_message_size:])
+        payload = payload[:meta.user_message_size]
 
     def send_response(c, response):
         _send_response(sock, cid, c, response)
@@ -207,7 +261,7 @@ def process_request(msg: HuluMessage):
             if 0 <= meta.method_index < len(names):
                 method_name = names[meta.method_index]
     dispatch_pb_request(server, sock, meta.service_name, method_name or "",
-                        msg.payload, _FROM_HULU.get(meta.compress_type, 0),
+                        payload, _FROM_HULU.get(meta.compress_type, 0),
                         send_response, cntl)
 
 

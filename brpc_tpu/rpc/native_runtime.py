@@ -23,13 +23,21 @@ from brpc_tpu.rpc.proto import rpc_meta_pb2
 
 class NativeSocketShim:
     """Quacks like rpc.Socket for the server-side response path: write()
-    re-enters the native runtime's write queue for this connection."""
+    re-enters the native runtime's write queue for this connection. The
+    raw fallback lane also runs full protocol sessions over it, so it
+    carries the read portal / matched-protocol state the InputMessenger
+    expects (protocols attach their own per-connection attributes freely,
+    as they do on the real Socket)."""
 
     def __init__(self, sock_id: int):
+        from brpc_tpu.butil.iobuf import IOPortal
+
         self.sock_id = sock_id
         self.remote_side: Optional[EndPoint] = None
         self.app_state = None
         self._failed = False
+        self.read_portal = IOPortal()
+        self.matched_protocol = None
 
     def write(self, buf: IOBuf, id_wait=None) -> int:
         data = buf.copy_to_bytes(len(buf))
@@ -46,6 +54,50 @@ class NativeSocketShim:
         return None
 
 
+class _RawSession:
+    """Per-connection protocol session for the raw fallback lane (the
+    native port's multi-protocol capability, input_messenger.h:33-154):
+    the native runtime shovels ordered byte chunks; the Python
+    InputMessenger cuts and dispatches them exactly as it would from a
+    real socket. Chunks may arrive on any py-lane pthread — they are
+    reassembled by sequence number and processed by a single drainer at a
+    time (busy flag), preserving per-connection ordering."""
+
+    def __init__(self, messenger, sock_id: int):
+        self.messenger = messenger
+        self.sock = NativeSocketShim(sock_id)
+        self.lock = threading.Lock()
+        self.chunks = {}
+        self.next_seq = 1
+        self.busy = False
+
+    def feed(self, seq: int, data: bytes):
+        with self.lock:
+            self.chunks[seq] = data
+            if self.busy:
+                return  # the active drainer will pick it up
+            self.busy = True
+        while True:
+            with self.lock:
+                got = False
+                while self.next_seq in self.chunks:
+                    self.sock.read_portal.append(
+                        self.chunks.pop(self.next_seq))
+                    self.next_seq += 1
+                    got = True
+                if not got:
+                    self.busy = False
+                    return
+            try:
+                self.messenger._cut_and_process(self.sock, read_eof=False)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "raw-lane protocol session raised")
+                self.sock.set_failed()
+
+
 class NativeRuntimeMount:
     """Runs a Python Server's services on a native port."""
 
@@ -55,12 +107,26 @@ class NativeRuntimeMount:
         self._threads = []
         self._stopping = False
         self._num_threads = num_threads or max(2, server.options.num_threads)
+        self._messenger = None
+        self._raw_sessions = {}
+        self._raw_lock = threading.Lock()
 
     def start(self, ip: str = "127.0.0.1", port: int = 0,
               native_echo: bool = False) -> int:
+        from brpc_tpu.rpc.input_messenger import InputMessenger
+        from brpc_tpu.rpc.protocol import list_server_protocols
+
         self.port = native.rpc_server_start(ip, port,
                                             nworkers=0,
                                             native_echo=native_echo)
+        # full protocol registry for the raw fallback lane: the native
+        # port keeps the Python port's one-port-all-protocols capability
+        protocols = list_server_protocols()
+        if self.server.options.enabled_protocols:
+            protocols = [p for p in protocols
+                         if p.name in self.server.options.enabled_protocols]
+        self._messenger = InputMessenger(protocols, arg=self.server)
+        native.rpc_server_enable_raw_fallback(True)
         for i in range(self._num_threads):
             t = threading.Thread(target=self._worker,
                                  name=f"native_py_lane_{i}", daemon=True)
@@ -73,6 +139,8 @@ class NativeRuntimeMount:
         native.rpc_server_stop()
         for t in self._threads:
             t.join(timeout=2.0)
+        with self._raw_lock:
+            self._raw_sessions.clear()
 
     # -- the py lane --------------------------------------------------------
     def _worker(self):
@@ -82,7 +150,21 @@ class NativeRuntimeMount:
             item = native.take_request(100)
             if item is None:
                 continue
-            handle, meta_bytes, payload, attachment, sock_id = item
+            handle, kind, meta_bytes, payload, attachment, sock_id, seq = item
+            if kind == 1:  # raw protocol bytes
+                native.req_free(handle)
+                with self._raw_lock:
+                    sess = self._raw_sessions.get(sock_id)
+                    if sess is None:
+                        sess = _RawSession(self._messenger, sock_id)
+                        self._raw_sessions[sock_id] = sess
+                sess.feed(seq, payload)
+                continue
+            if kind == 2:  # connection closed: drop the session
+                native.req_free(handle)
+                with self._raw_lock:
+                    self._raw_sessions.pop(sock_id, None)
+                continue
             try:
                 meta = rpc_meta_pb2.RpcMeta()
                 meta.ParseFromString(meta_bytes)

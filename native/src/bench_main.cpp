@@ -100,6 +100,8 @@ double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
                                   int window, double seconds,
                                   int payload_size, uint64_t* out_requests);
 void nat_io_counters(uint64_t* wc, uint64_t* wb, uint64_t* rc, uint64_t* rb);
+double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
+                                 double seconds, uint64_t* out_bytes);
 }
 
 static void print_io_stats(const char* lane, uint64_t reqs, uint64_t wc0,
@@ -134,6 +136,13 @@ int main(int argc, char** argv) {
                                       16, &reqs);
     printf("sync_qps %.0f requests %llu\n", qps, (unsigned long long)reqs);
     print_io_stats("sync", reqs, wc0, rc0);
+  }
+  if (strcmp(mode, "bulk") == 0) {
+    uint64_t bytes = 0;
+    double gbps = nat_rpc_client_bench_bulk("127.0.0.1", port,
+                                            depth > 4096 ? depth : 1 << 20,
+                                            seconds, &bytes);
+    printf("bulk_GBps %.3f bytes %llu\n", gbps, (unsigned long long)bytes);
   }
   if (strcmp(mode, "async") == 0 || strcmp(mode, "both") == 0) {
     nat_io_counters(&wc0, &u, &rc0, &u);

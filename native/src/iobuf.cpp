@@ -249,14 +249,57 @@ ssize_t IOBuf::cut_into_fd(int fd, size_t max_bytes) {
 }
 
 ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes) {
+  // Scatter read: the TLS share block's tail plus spare blocks, so one
+  // syscall can move up to ~64KB (the IOPortal big-read discipline,
+  // iobuf.h:455-497) — bulk transfers would crawl at 8KB/syscall
+  // otherwise. Unused spares go straight back to the TLS cache.
   IOBlock* b = tls_share_block();
+  struct iovec iov[9];
+  IOBlock* spare[8];
+  int nspare = 0;
   size_t want = std::min(max_bytes, b->left());
-  ssize_t n = read(fd, b->data + b->size, want);
+  iov[0].iov_base = b->data + b->size;
+  iov[0].iov_len = want;
+  int niov = 1;
+  size_t capacity = want;
+  while (capacity < max_bytes && nspare < 8) {
+    IOBlock* sb = IOBlock::create();
+    spare[nspare++] = sb;
+    iov[niov].iov_base = sb->data;
+    iov[niov].iov_len = IOBlock::kSize;
+    niov++;
+    capacity += IOBlock::kSize;
+  }
+  ssize_t n = readv(fd, iov, niov);
   if (n > 0) {
     g_read_calls.fetch_add(1, std::memory_order_relaxed);
     g_read_bytes.fetch_add((uint64_t)n, std::memory_order_relaxed);
-    push_ref(b, (uint32_t)b->size, (uint32_t)n);
-    b->size += (size_t)n;
+    size_t remain = (size_t)n;
+    size_t take = std::min(remain, want);
+    push_ref(b, (uint32_t)b->size, (uint32_t)take);
+    b->size += take;
+    remain -= take;
+    for (int i = 0; i < nspare; i++) {
+      IOBlock* sb = spare[i];
+      if (remain == 0) {
+        sb->release();  // unused: back to the cache
+        continue;
+      }
+      take = std::min(remain, IOBlock::kSize);
+      sb->size = take;
+      push_ref(sb, 0, (uint32_t)take);
+      remain -= take;
+      if (sb->left() > 0) {
+        // partially-filled spare becomes the new share block so the
+        // next append continues filling it
+        if (tls_block != nullptr) tls_block->release();
+        tls_block = sb;  // transfers our creator reference
+      } else {
+        sb->release();  // full: only the IOBuf ref keeps it
+      }
+    }
+  } else {
+    for (int i = 0; i < nspare; i++) spare[i]->release();
   }
   return n;
 }

@@ -227,34 +227,53 @@ void RingListener::submit_locked() {
   flush_unsubmitted_locked();
 }
 
-int RingListener::register_file(int fd) {
+int RingListener::register_file(int fd, uint32_t* gen_out) {
+  // files_mu_ is held across the kernel update AND gen read so a stale
+  // rearm/send (which also takes files_mu_) can never interleave with
+  // re-registration of a recycled slot.
+  std::lock_guard<std::mutex> g(files_mu_);
   int idx;
-  {
-    std::lock_guard<std::mutex> g(files_mu_);
+  if (!free_files_.empty()) {
+    idx = free_files_.back();
+    free_files_.pop_back();
+  } else {
     if (next_file_ >= kMaxFiles) return -1;  // table spent: epoll lane
     idx = (int)next_file_++;
   }
+  if (file_gen_.size() <= (size_t)idx) file_gen_.resize(idx + 1, 0);
   struct io_uring_files_update upd;
   memset(&upd, 0, sizeof(upd));
   upd.offset = (unsigned)idx;
   upd.fds = (uint64_t)(uintptr_t)&fd;
   if (sys_register(ring_fd_, IORING_REGISTER_FILES_UPDATE, &upd, 1) < 0) {
+    free_files_.push_back(idx);
     return -1;
   }
+  if (gen_out != nullptr) *gen_out = file_gen_[idx];
   return idx;
 }
 
 void RingListener::unregister_file(int file_index) {
+  std::lock_guard<std::mutex> g(files_mu_);
   int minus_one = -1;
   struct io_uring_files_update upd;
   memset(&upd, 0, sizeof(upd));
   upd.offset = (unsigned)file_index;
   upd.fds = (uint64_t)(uintptr_t)&minus_one;
   sys_register(ring_fd_, IORING_REGISTER_FILES_UPDATE, &upd, 1);
-  // the slot is intentionally NOT recycled (see header)
+  if (file_gen_.size() <= (size_t)file_index) {
+    file_gen_.resize(file_index + 1, 0);
+  }
+  file_gen_[file_index]++;  // invalidates in-flight rearms/sends
+  free_files_.push_back(file_index);
 }
 
-bool RingListener::rearm_recv(int file_index, uint64_t tag) {
+bool RingListener::rearm_recv(int file_index, uint32_t gen, uint64_t tag) {
+  std::lock_guard<std::mutex> fg(files_mu_);
+  if ((size_t)file_index >= file_gen_.size() ||
+      file_gen_[file_index] != gen) {
+    return false;  // slot recycled under us: caller demotes
+  }
   std::lock_guard<std::mutex> g(sq_mu_);
   struct io_uring_sqe* sqe = get_sqe_locked();
   if (sqe == nullptr) return false;
@@ -282,8 +301,14 @@ void RingListener::release_send_buffer(uint16_t buf) {
   send_free_.push_back(buf);
 }
 
-bool RingListener::submit_send(int file_index, uint64_t tag, uint16_t buf,
-                               size_t len) {
+bool RingListener::submit_send(int file_index, uint32_t gen, uint64_t tag,
+                               uint16_t buf, size_t len) {
+  std::lock_guard<std::mutex> fg(files_mu_);
+  if ((size_t)file_index >= file_gen_.size() ||
+      file_gen_[file_index] != gen) {
+    release_send_buffer(buf);
+    return false;  // slot recycled under us: caller demotes
+  }
   {
     std::lock_guard<std::mutex> g(send_mu_);
     send_tag_[buf] = tag;  // full 64-bit id rides the tag table

@@ -50,8 +50,11 @@ class RingListener {
   static constexpr unsigned kEntries = 256;     // SQ depth
   static constexpr unsigned kNumBufs = 256;     // provided recv buffers
   static constexpr unsigned kBufSize = 16384;   // each 16KB
-  static constexpr unsigned kNumSendBufs = 64;  // fixed send buffers
-  static constexpr unsigned kSendBufSize = 16384;
+  // 64KB fixed send buffers (was 16KB): one-in-flight-per-socket keeps
+  // TCP ordering under short writes, so per-completion payload is the
+  // bandwidth lever for large responses (ring_write_buf_pool.h role).
+  static constexpr unsigned kNumSendBufs = 64;
+  static constexpr unsigned kSendBufSize = 65536;
   static constexpr unsigned kMaxFiles = 4096;   // registered-file table
 
   ~RingListener() { shutdown(); }
@@ -64,16 +67,21 @@ class RingListener {
   void shutdown();
 
   // Registers fd into the fixed-file table WITHOUT arming recv; the
-  // caller publishes the returned index on its socket first, then arms
-  // via rearm_recv — completions may fire the instant recv is armed, so
-  // the index must be visible before then. Returns -1 when the table is
-  // exhausted (indices are never reused: a recycled slot could receive a
-  // stale in-flight rearm from the drain path).
-  int register_file(int fd);
+  // caller publishes the returned index (and generation) on its socket
+  // first, then arms via rearm_recv — completions may fire the instant
+  // recv is armed, so the index must be visible before then. Returns -1
+  // when the table is exhausted. Slots ARE recycled: unregister_file
+  // bumps the slot's generation, and every rearm/send validates the
+  // caller's generation under the registration lock, so a stale in-flight
+  // rearm can never target a reused slot (connection churn no longer
+  // spends the table).
+  int register_file(int fd, uint32_t* gen_out);
   void unregister_file(int file_index);
 
   // Re-arms multishot recv after the kernel dropped it (more==false).
-  bool rearm_recv(int file_index, uint64_t tag);
+  // False when no SQE is free OR the slot generation moved (caller
+  // should demote to the epoll lane either way).
+  bool rearm_recv(int file_index, uint32_t gen, uint64_t tag);
 
   // Fixed-buffer send, zero intermediate copies: acquire a registered
   // buffer, fill it directly, then submit. acquire_send_buffer returns
@@ -83,7 +91,8 @@ class RingListener {
   // come back in the send completion.
   char* acquire_send_buffer(uint16_t* buf_out);
   void release_send_buffer(uint16_t buf);
-  bool submit_send(int file_index, uint64_t tag, uint16_t buf, size_t len);
+  bool submit_send(int file_index, uint32_t gen, uint64_t tag, uint16_t buf,
+                   size_t len);
 
   // Bytes of a recv completion; valid until recycle_buffer(buf_id).
   const char* buffer_data(uint16_t buf_id) const {
@@ -175,7 +184,9 @@ class RingListener {
   std::atomic<uint64_t> n_recv_{0};
   std::atomic<uint64_t> n_send_{0};
   std::mutex files_mu_;
-  unsigned next_file_ = 0;  // monotonic: file indices are never reused
+  unsigned next_file_ = 0;         // high-water mark
+  std::vector<int> free_files_;    // recycled slots
+  std::vector<uint32_t> file_gen_;  // slot generation (bumped on unregister)
   std::function<void()> wake_fn_;
   unsigned unsubmitted_ = 0;  // SQEs published but not yet accepted
 };

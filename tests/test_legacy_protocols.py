@@ -332,3 +332,86 @@ def test_public_unknown_method_fails():
         ch.close()
     finally:
         srv.stop()
+
+
+class AttachEchoService(rpc.Service):
+    SERVICE_NAME = "AttachEcho"
+
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        # bounce the request attachment back as the response attachment
+        att = cntl.request_attachment.copy_to_bytes(
+            len(cntl.request_attachment))
+        response.message = request.message
+        cntl.response_attachment.append(att.upper())
+        done()
+
+
+def test_hulu_attachment_roundtrip():
+    """user_message_size splits pb bytes from the attachment on BOTH
+    directions (hulu_pbrpc_protocol.cpp:354-359)."""
+    srv = rpc.Server(rpc.ServerOptions(num_threads=2))
+    assert srv.add_service(AttachEchoService()) == 0
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="hulu_pbrpc"))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        cntl = rpc.Controller()
+        cntl.request_attachment.append(b"raw-bytes")
+        resp = echo_pb2.EchoResponse()
+        ch.call_method("AttachEcho.Echo", cntl,
+                       echo_pb2.EchoRequest(message="att"), resp)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "att"
+        got = cntl.response_attachment.copy_to_bytes(
+            len(cntl.response_attachment))
+        assert got == b"RAW-BYTES"
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_hulu_method_index_honored():
+    """cntl.hulu_method_index rides the wire (the nova_method_index
+    discipline) so multi-method stock hulu services dispatch correctly."""
+    from brpc_tpu.rpc import hulu_protocol
+    from brpc_tpu.rpc.proto import legacy_meta_pb2
+
+    cntl = rpc.Controller()
+    cntl._method_full_name = "EchoService.Echo"
+    cntl.hulu_method_index = 3
+    buf = hulu_protocol.pack_request(b"", cntl, 7)
+    raw = buf.copy_to_bytes(len(buf))
+    meta = legacy_meta_pb2.HuluRpcRequestMeta()
+    import struct as _struct
+    _, meta_size = _struct.unpack("<II", raw[4:12])
+    meta.ParseFromString(raw[12:12 + meta_size])
+    assert meta.method_index == 3
+    assert meta.method_name == "Echo"
+
+
+def test_hulu_attachment_with_compression():
+    """The attachment split happens on COMPRESSED pb bytes: gzip + a raw
+    attachment must both survive the round trip."""
+    from brpc_tpu.rpc import compress as compress_mod
+
+    srv = rpc.Server(rpc.ServerOptions(num_threads=2))
+    assert srv.add_service(AttachEchoService()) == 0
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        ch = rpc.Channel(rpc.ChannelOptions(protocol="hulu_pbrpc"))
+        assert ch.init(str(srv.listen_endpoint)) == 0
+        cntl = rpc.Controller()
+        cntl.compress_type = compress_mod.COMPRESS_GZIP
+        cntl.request_attachment.append(b"zip-side-raw")
+        resp = echo_pb2.EchoResponse()
+        ch.call_method("AttachEcho.Echo", cntl,
+                       echo_pb2.EchoRequest(message="gz" * 300), resp)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == "gz" * 300
+        got = cntl.response_attachment.copy_to_bytes(
+            len(cntl.response_attachment))
+        assert got == b"ZIP-SIDE-RAW"
+        ch.close()
+    finally:
+        srv.stop()

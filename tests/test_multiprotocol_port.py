@@ -21,8 +21,18 @@ class EchoService(rpc.Service):
         done()
 
 
-@pytest.fixture(scope="module")
-def omni_server():
+@pytest.fixture(scope="module", params=[False, True],
+                ids=["python_port", "native_port"])
+def omni_server(request):
+    """Both runtimes must keep the one-port-all-protocols capability: the
+    Python port natively, the native port via its tpu_std fast path plus
+    the raw fallback lane feeding the Python protocol stack."""
+    use_native = request.param
+    if use_native:
+        from brpc_tpu import native
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
     tsvc = ThriftService()
     tsvc.add_method("Echo", lambda body: {0: body.get(1, (T_STRING, b""))})
     srv = rpc.Server(rpc.ServerOptions(
@@ -30,6 +40,7 @@ def omni_server():
         redis_service=DictRedisService(),
         memcache_service=MemcacheService(),
         thrift_service=tsvc,
+        use_native_runtime=use_native,
     ))
     srv.add_service(EchoService())
     assert srv.start("127.0.0.1:0") == 0
