@@ -200,6 +200,14 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # device-transport bandwidth (the rdma_performance analog): tracked
+    # round over round in the artifact
+    device_lanes = {}
+    try:
+        device_lanes = device_lane_bench()
+    except Exception:
+        pass
+
     lanes = {"epoll": (fw["qps"], fw["requests"]),
              "io_uring": (ring_qps,
                           ring["requests"] if ring_qps > 0 else 0),
@@ -228,8 +236,163 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             "async_windowed_qps": round(async_qps, 1),
             "python_framework_qps": round(python_qps, 1),
             "bypass_ceiling_qps": round(bypass_qps, 1),
+            "device_lanes": device_lanes,
         },
     }
+
+
+def device_lane_bench() -> dict:
+    """Device-transport bandwidth numbers — the rdma_performance analog
+    (example/rdma_performance/client.cpp:50-52,136-183 measures verbs
+    GB/s; here each lane of the device transport is measured on the real
+    chip): host<->device DMA, the in-process zero-copy lane, shm-arena
+    staging, a two-process shm push, and the native bulk data path."""
+    import time
+
+    import numpy as np
+
+    out = {}
+
+    # host <-> device DMA (the raw registered-memory bandwidth analog)
+    try:
+        import jax
+
+        nbytes = 64 << 20
+        host = np.random.randint(0, 255, nbytes, dtype=np.uint8)
+        dev = jax.device_put(host)
+        dev.block_until_ready()  # warm
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            jax.device_put(host).block_until_ready()
+        out["h2d_GBps"] = round(nbytes * iters / (time.perf_counter() - t0)
+                                / 1e9, 3)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            np.asarray(dev)
+        out["d2h_GBps"] = round(nbytes * iters / (time.perf_counter() - t0)
+                                / 1e9, 3)
+    except Exception:
+        pass
+
+    # in-process zero-copy lane: ticket round trips carrying a real array
+    try:
+        import jax
+
+        from brpc_tpu.rpc import device_transport as dt
+
+        arr = jax.device_put(np.zeros(16 << 20, dtype=np.uint8))
+        arr.block_until_ready()
+        rounds = 200
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            ticket = dt.inproc_publish([arr])
+            got = dt.inproc_claim(ticket)
+        dt_s = time.perf_counter() - t0
+        assert got is not None
+        out["inproc_GBps"] = round(int(arr.nbytes) * rounds / dt_s / 1e9, 3)
+    except Exception:
+        pass
+
+    # shm-arena staging: device bytes -> pinned shared memory -> back
+    # (the sender/receiver halves of the same-host lane, one process)
+    try:
+        from brpc_tpu.rpc import device_transport as dt
+
+        arena = dt.HostArena(size=96 << 20)
+        try:
+            n = 32 << 20
+            src = np.random.randint(0, 255, n, dtype=np.uint8)
+            off = arena.alloc(n)
+            rounds = 5
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                dst = np.frombuffer(arena.shm.buf, dtype=np.uint8,
+                                    count=n, offset=off)
+                dst[:] = src
+                back = np.frombuffer(arena.shm.buf, dtype=np.uint8,
+                                     count=n, offset=off).copy()
+            dt_s = time.perf_counter() - t0
+            assert back[-1] == src[-1]
+            # two copies per round; report one-direction bandwidth
+            out["shm_stage_GBps"] = round(2 * n * rounds / dt_s / 1e9, 3)
+        finally:
+            arena.close()
+    except Exception:
+        pass
+
+    # two-process shm push: full RPC + arena descriptor path
+    try:
+        import os
+        import subprocess
+        import sys
+
+        from brpc_tpu.rpc import device_transport as dt
+        from brpc_tpu.rpc.tensor_service import (TensorClient,
+                                                 make_device_channel)
+
+        script = (
+            "import sys; sys.path.insert(0, '.')\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from brpc_tpu import rpc\n"
+            "from brpc_tpu.rpc.tensor_service import TensorStoreService\n"
+            "srv = rpc.Server(rpc.ServerOptions(num_threads=2))\n"
+            "srv.add_service(TensorStoreService())\n"
+            "assert srv.start('127.0.0.1:0') == 0\n"
+            "print(srv.listen_endpoint.port, flush=True)\n"
+            "sys.stdin.readline()\n")
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        proc = subprocess.Popen([sys.executable, "-c", script],
+                                stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE, text=True,
+                                cwd=repo_root)
+        try:
+            port = int(proc.stdout.readline())
+            ch = make_device_channel(f"127.0.0.1:{port}")
+            client = TensorClient(ch)
+            arr = np.random.randint(0, 255, 8 << 20,
+                                    dtype=np.uint8)
+            client.push("warm", [arr])  # handshake + warm
+            rounds = 8
+            t0 = time.perf_counter()
+            for i in range(rounds):
+                cntl, resp = client.push(f"b{i}", [arr])
+                assert not cntl.failed(), cntl.error_text
+            dt_s = time.perf_counter() - t0
+            out["shm_push_GBps"] = round(arr.nbytes * rounds / dt_s / 1e9,
+                                         3)
+            ch.close()
+        finally:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+    except Exception:
+        pass
+
+    # native bulk data path: 1MB attachments echoed through the full
+    # native stack (socket write queue -> dispatcher -> native handler)
+    try:
+        import ctypes
+
+        from brpc_tpu import native
+
+        lib = native.load()
+        lib.nat_rpc_client_bench_bulk.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_rpc_client_bench_bulk.restype = ctypes.c_double
+        port = native.rpc_server_start(native_echo=True)
+        try:
+            got = ctypes.c_uint64(0)
+            gbps = lib.nat_rpc_client_bench_bulk(
+                b"127.0.0.1", port, 1 << 20, 1.5, ctypes.byref(got))
+            out["native_bulk_GBps"] = round(gbps, 3)
+        finally:
+            native.rpc_server_stop()
+    except Exception:
+        pass
+
+    return out
 
 
 def collective_bench(nbytes: int = 1 << 24, iters: int = 20) -> dict:

@@ -1,15 +1,38 @@
 """json2pb — JSON ⇄ protobuf conversion satellite.
 
-Counterpart of /root/reference/src/json2pb/ (json_to_pb.h, pb_to_json.h):
-the bridge the HTTP protocol uses to serve protobuf services as JSON REST
-endpoints. Backed by google.protobuf.json_format with brpc-compatible
-options (bytes as base64, enums as strings by default).
+Counterpart of /root/reference/src/json2pb/ (json_to_pb.{h,cpp},
+pb_to_json.{h,cpp}, ~1.7 kLoC on rapidjson): the bridge the HTTP protocol
+uses to serve protobuf services as JSON REST endpoints. This is a real
+descriptor-walking codec, not a delegate: field iteration, type dispatch,
+base64 bytes, map fields, enums by name or number, int64-as-string
+tolerance, required-field checking with field paths in errors, and the
+reference's Pb2JsonOptions/Json2PbOptions knobs.
+
+Reference semantics implemented (json_to_pb.cpp / pb_to_json.cpp):
+  * bytes ⇄ base64 (bytes_to_base64, default on)
+  * enums as names by default, numbers with enum_option_as_int; parse
+    accepts either form
+  * map<K,V> fields ⇄ JSON objects with stringified keys
+  * int64/uint64 parse from JSON numbers OR strings (JS precision escape)
+  * unknown JSON fields ignored (the reference's default tolerance)
+  * missing required proto2 fields fail with the field's path
+  * jsonify_empty_array prints [] for unset repeated fields;
+    always_print_primitive_fields prints proto3 defaults
 """
 from __future__ import annotations
 
+import base64
+import json
+import math
 from typing import Optional, Type
 
-from google.protobuf import json_format
+from google.protobuf import descriptor as _desc
+
+_FD = _desc.FieldDescriptor
+
+
+class ParseError(ValueError):
+    """Malformed JSON or JSON that cannot map onto the message."""
 
 
 class Pb2JsonOptions:
@@ -23,28 +46,297 @@ class Pb2JsonOptions:
         self.enum_option_as_int = enum_option_as_int
 
 
+class Json2PbOptions:
+    def __init__(self, base64_to_bytes: bool = True,
+                 allow_remaining_bytes_after_parsing: bool = False):
+        self.base64_to_bytes = base64_to_bytes
+        self.allow_remaining_bytes_after_parsing = (
+            allow_remaining_bytes_after_parsing)
+
+
+_INT_TYPES = {_FD.TYPE_INT32, _FD.TYPE_INT64, _FD.TYPE_UINT32,
+              _FD.TYPE_UINT64, _FD.TYPE_FIXED32, _FD.TYPE_FIXED64,
+              _FD.TYPE_SFIXED32, _FD.TYPE_SFIXED64, _FD.TYPE_SINT32,
+              _FD.TYPE_SINT64}
+_FLOAT_TYPES = {_FD.TYPE_DOUBLE, _FD.TYPE_FLOAT}
+_INT_RANGES = {
+    _FD.TYPE_INT32: (-(1 << 31), (1 << 31) - 1),
+    _FD.TYPE_SINT32: (-(1 << 31), (1 << 31) - 1),
+    _FD.TYPE_SFIXED32: (-(1 << 31), (1 << 31) - 1),
+    _FD.TYPE_UINT32: (0, (1 << 32) - 1),
+    _FD.TYPE_FIXED32: (0, (1 << 32) - 1),
+    _FD.TYPE_INT64: (-(1 << 63), (1 << 63) - 1),
+    _FD.TYPE_SINT64: (-(1 << 63), (1 << 63) - 1),
+    _FD.TYPE_SFIXED64: (-(1 << 63), (1 << 63) - 1),
+    _FD.TYPE_UINT64: (0, (1 << 64) - 1),
+    _FD.TYPE_FIXED64: (0, (1 << 64) - 1),
+}
+
+
+def _is_repeated(field) -> bool:
+    try:
+        return field.is_repeated  # protobuf >= 5 property (no deprecation)
+    except AttributeError:
+        return field.label == _FD.LABEL_REPEATED
+
+
+def _is_required(field) -> bool:
+    try:
+        return field.is_required
+    except AttributeError:
+        return field.label == _FD.LABEL_REQUIRED
+
+
+def _is_map_field(field) -> bool:
+    return (field.type == _FD.TYPE_MESSAGE and _is_repeated(field)
+            and field.message_type.GetOptions().map_entry)
+
+
+# ---------------------------------------------------------------------------
+# pb -> json  (ProtoMessageToJson, pb_to_json.cpp)
+# ---------------------------------------------------------------------------
+
+def _scalar_to_json(field, value, opts: Pb2JsonOptions):
+    if field.type == _FD.TYPE_BYTES:
+        if opts.bytes_to_base64:
+            return base64.b64encode(value).decode("ascii")
+        return value.decode("latin-1")
+    if field.type == _FD.TYPE_ENUM:
+        if opts.enum_option_as_int:
+            return int(value)
+        ev = field.enum_type.values_by_number.get(value)
+        return ev.name if ev is not None else int(value)
+    if field.type in _FLOAT_TYPES:
+        v = float(value)
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "Infinity" if v > 0 else "-Infinity"
+        return v
+    if field.type == _FD.TYPE_BOOL:
+        return bool(value)
+    if field.type in _INT_TYPES:
+        return int(value)
+    return value  # string
+
+
+def _value_to_json(field, value, opts: Pb2JsonOptions):
+    if field.type in (_FD.TYPE_MESSAGE, _FD.TYPE_GROUP):
+        return _message_to_obj(value, opts)
+    return _scalar_to_json(field, value, opts)
+
+
+def _message_to_obj(msg, opts: Pb2JsonOptions) -> dict:
+    out = {}
+    desc = msg.DESCRIPTOR
+    for field in desc.fields:
+        name = field.name  # the reference keeps proto field names
+        if _is_map_field(field):
+            m = getattr(msg, name)
+            if not m and not opts.jsonify_empty_array:
+                continue
+            vfield = field.message_type.fields_by_name["value"]
+            out[name] = {str(k): _value_to_json(vfield, m[k], opts)
+                         for k in m}
+        elif _is_repeated(field):
+            seq = getattr(msg, name)
+            if not seq and not opts.jsonify_empty_array:
+                continue
+            out[name] = [_value_to_json(field, v, opts) for v in seq]
+        elif field.type in (_FD.TYPE_MESSAGE, _FD.TYPE_GROUP):
+            if msg.HasField(name):
+                out[name] = _message_to_obj(getattr(msg, name), opts)
+        else:
+            has = (msg.HasField(name) if field.has_presence
+                   else bool(getattr(msg, name) != field.default_value))
+            if has or opts.always_print_primitive_fields:
+                out[name] = _scalar_to_json(field, getattr(msg, name), opts)
+    return out
+
+
 def pb_to_json(message, options: Optional[Pb2JsonOptions] = None) -> str:
     """ProtoMessageToJson (pb_to_json.h)."""
     options = options or Pb2JsonOptions()
-    return json_format.MessageToJson(
-        message,
-        preserving_proto_field_name=True,
-        use_integers_for_enums=options.enum_option_as_int,
-        always_print_fields_with_no_presence=options.always_print_primitive_fields,
-    )
+    return json.dumps(_message_to_obj(message, options))
 
 
-def json_to_pb(json_text: str, message_class: Type):
-    """JsonToProtoMessage (json_to_pb.h); raises json_format.ParseError on
-    malformed input."""
+# ---------------------------------------------------------------------------
+# json -> pb  (JsonToProtoMessage, json_to_pb.cpp)
+# ---------------------------------------------------------------------------
+
+def _parse_int(field, value, path: str) -> int:
+    if isinstance(value, bool):
+        raise ParseError(f"{path}: expected integer, got bool")
+    if isinstance(value, str):
+        try:
+            value = int(value, 0)  # int64-as-string tolerance
+        except ValueError:
+            raise ParseError(f"{path}: invalid integer string {value!r}")
+    if isinstance(value, float):
+        if not value.is_integer():
+            raise ParseError(f"{path}: expected integer, got {value}")
+        value = int(value)
+    if not isinstance(value, int):
+        raise ParseError(f"{path}: expected integer, got "
+                         f"{type(value).__name__}")
+    lo, hi = _INT_RANGES[field.type]
+    if not lo <= value <= hi:
+        raise ParseError(f"{path}: {value} out of range "
+                         f"[{lo}, {hi}]")
+    return value
+
+
+def _parse_scalar(field, value, opts: Json2PbOptions, path: str):
+    t = field.type
+    if t == _FD.TYPE_BOOL:
+        if isinstance(value, bool):
+            return value
+        if value in ("true", "True", 1):
+            return True
+        if value in ("false", "False", 0):
+            return False
+        raise ParseError(f"{path}: expected bool, got {value!r}")
+    if t in _INT_TYPES:
+        return _parse_int(field, value, path)
+    if t in _FLOAT_TYPES:
+        if isinstance(value, str):
+            if value in ("NaN",):
+                return float("nan")
+            if value in ("Infinity", "inf"):
+                return float("inf")
+            if value in ("-Infinity", "-inf"):
+                return float("-inf")
+            try:
+                return float(value)
+            except ValueError:
+                raise ParseError(f"{path}: invalid number {value!r}")
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise ParseError(f"{path}: expected number, got {value!r}")
+        return float(value)
+    if t == _FD.TYPE_STRING:
+        if not isinstance(value, str):
+            raise ParseError(f"{path}: expected string, got "
+                             f"{type(value).__name__}")
+        return value
+    if t == _FD.TYPE_BYTES:
+        if not isinstance(value, str):
+            raise ParseError(f"{path}: expected base64 string")
+        if opts.base64_to_bytes:
+            try:
+                return base64.b64decode(value)
+            except Exception:
+                raise ParseError(f"{path}: invalid base64")
+        return value.encode("latin-1")
+    if t == _FD.TYPE_ENUM:
+        if isinstance(value, bool):
+            raise ParseError(f"{path}: expected enum, got bool")
+        if isinstance(value, int):
+            # closed (proto2) enums reject unknown numbers at assignment;
+            # surface that as a ParseError with the path instead
+            if value not in field.enum_type.values_by_number:
+                try:
+                    closed = field.enum_type.is_closed()
+                except AttributeError:
+                    closed = True
+                if closed:
+                    raise ParseError(
+                        f"{path}: {value} is not a value of "
+                        f"{field.enum_type.full_name}")
+            return value
+        if isinstance(value, str):
+            ev = field.enum_type.values_by_name.get(value)
+            if ev is None:
+                raise ParseError(
+                    f"{path}: {value!r} is not a value of "
+                    f"{field.enum_type.full_name}")
+            return ev.number
+        raise ParseError(f"{path}: expected enum name or number")
+    raise ParseError(f"{path}: unsupported field type {t}")
+
+
+def _fill_message(obj, msg, opts: Json2PbOptions, path: str):
+    if not isinstance(obj, dict):
+        raise ParseError(f"{path or '<root>'}: expected JSON object, got "
+                         f"{type(obj).__name__}")
+    desc = msg.DESCRIPTOR
+    by_name = desc.fields_by_name
+    by_json = {f.json_name: f for f in desc.fields}
+    for key, value in obj.items():
+        field = by_name.get(key) or by_json.get(key)
+        if field is None:
+            continue  # unknown fields ignored (reference tolerance)
+        fpath = f"{path}.{field.name}" if path else field.name
+        if value is None:
+            continue  # JSON null clears nothing, like the reference
+        if _is_map_field(field):
+            if not isinstance(value, dict):
+                raise ParseError(f"{fpath}: map field expects an object")
+            kfield = field.message_type.fields_by_name["key"]
+            vfield = field.message_type.fields_by_name["value"]
+            target = getattr(msg, field.name)
+            for k, v in value.items():
+                if kfield.type == _FD.TYPE_BOOL:
+                    pk = k == "true"
+                elif kfield.type in _INT_TYPES:
+                    pk = _parse_int(kfield, k, f"{fpath}[{k}]")
+                else:
+                    pk = k
+                if vfield.type in (_FD.TYPE_MESSAGE, _FD.TYPE_GROUP):
+                    _fill_message(v, target[pk], opts, f"{fpath}[{k}]")
+                else:
+                    parsed = _parse_scalar(vfield, v, opts,
+                                           f"{fpath}[{k}]")
+                    try:
+                        target[pk] = parsed
+                    except (ValueError, TypeError) as e:
+                        raise ParseError(f"{fpath}[{k}]: {e}") from e
+        elif _is_repeated(field):
+            if not isinstance(value, list):
+                raise ParseError(f"{fpath}: repeated field expects an array")
+            target = getattr(msg, field.name)
+            for i, item in enumerate(value):
+                if field.type in (_FD.TYPE_MESSAGE, _FD.TYPE_GROUP):
+                    _fill_message(item, target.add(), opts, f"{fpath}[{i}]")
+                else:
+                    parsed = _parse_scalar(field, item, opts,
+                                           f"{fpath}[{i}]")
+                    try:
+                        target.append(parsed)
+                    except (ValueError, TypeError) as e:
+                        raise ParseError(f"{fpath}[{i}]: {e}") from e
+        elif field.type in (_FD.TYPE_MESSAGE, _FD.TYPE_GROUP):
+            _fill_message(value, getattr(msg, field.name), opts, fpath)
+        else:
+            parsed = _parse_scalar(field, value, opts, fpath)
+            try:
+                setattr(msg, field.name, parsed)
+            except (ValueError, TypeError) as e:
+                raise ParseError(f"{fpath}: {e}") from e
+    # required-field check (proto2): the reference fails with the path
+    for field in desc.fields:
+        if _is_required(field) and not msg.HasField(field.name):
+            fpath = f"{path}.{field.name}" if path else field.name
+            raise ParseError(f"missing required field {fpath}")
+
+
+def json_to_pb(json_text: str, message_class: Type,
+               options: Optional[Json2PbOptions] = None):
+    """JsonToProtoMessage (json_to_pb.h); raises ParseError on malformed
+    input."""
+    try:
+        obj = json.loads(json_text)
+    except json.JSONDecodeError as e:
+        raise ParseError(f"invalid JSON: {e}") from e
     msg = message_class()
-    json_format.Parse(json_text, msg, ignore_unknown_fields=True)
+    _fill_message(obj, msg, options or Json2PbOptions(), "")
     return msg
 
 
-def json_to_pb_inplace(json_text: str, message) -> bool:
+def json_to_pb_inplace(json_text: str, message,
+                       options: Optional[Json2PbOptions] = None) -> bool:
     try:
-        json_format.Parse(json_text, message, ignore_unknown_fields=True)
+        obj = json.loads(json_text)
+        _fill_message(obj, message, options or Json2PbOptions(), "")
         return True
-    except json_format.ParseError:
+    except (ParseError, json.JSONDecodeError):
         return False

@@ -87,9 +87,15 @@ _pool_released = bvar.Adder("device_block_pool_released")
 
 
 class DeviceBlockPool:
-    """Pre-allocated HBM byte-buffers by size class. acquire() hands out a
-    registered buffer >= nbytes; release() returns it. The reference carves
-    8KB/64KB/2MB blocks out of ibv_reg_mr'd arenas (block_pool.h:29-94)."""
+    """Pre-allocated HBM byte-buffers by size class — the role of the
+    reference's registered-memory pool (block_pool.h:29-94: arenas carved
+    into 8KB/64KB/2MB blocks that ALL transfer traffic flows through).
+
+    The jax-idiomatic rendition: incoming transfer bytes are written into
+    a pooled buffer with a DONATING jitted update, so the pooled HBM is
+    genuinely the memory the bytes land in (no per-transfer allocation),
+    then bitcast/sliced into the typed array handed to the application.
+    acquire()/release() remain available for raw leases."""
 
     SIZE_CLASSES = (8 << 10, 64 << 10, 2 << 20)  # block_pool's classes
 
@@ -100,6 +106,7 @@ class DeviceBlockPool:
         self._device = device or jax.devices()[0]
         self._free: Dict[int, List] = {}
         self._lock = threading.Lock()
+        self._fill_fns = {}  # (size_class, nbytes) -> donating writer
         for size in self.SIZE_CLASSES:
             buffers = []
             for _ in range(blocks_per_class):
@@ -127,6 +134,80 @@ class DeviceBlockPool:
     def stats(self) -> Dict[int, int]:
         with self._lock:
             return {k: len(v) for k, v in self._free.items()}
+
+    def _fill_fn(self, size_class: int, padded: int):
+        import jax
+
+        key = (size_class, padded)
+        with self._lock:
+            fn = self._fill_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda b, x: jax.lax.dynamic_update_slice(b, x, (0,)),
+                donate_argnums=(0,))
+            with self._lock:
+                self._fill_fns.setdefault(key, fn)
+        return fn
+
+    @staticmethod
+    def _pad_quantum(nbytes: int) -> int:
+        # quantize the host-side staging length to powers of two so the
+        # jit cache stays bounded (~10 entries per class) instead of one
+        # compiled fill per distinct payload size
+        q = 4096
+        while q < nbytes:
+            q <<= 1
+        return q
+
+    def put_via_pool(self, host_u8, np_dtype, shape, device=None):
+        """Host->device put of raw bytes THROUGH pooled memory: the bytes
+        land in a pooled buffer (donated update — same HBM each time),
+        then a device-side slice+bitcast produces the typed array. Falls
+        back to a plain device_put when the pool is exhausted, the
+        payload is oversized, or a different target device is asked for.
+        Returns a jax.Array of `np_dtype`/`shape`."""
+        import jax
+        import numpy as np
+
+        nbytes = int(host_u8.size)
+        target = device or self._device
+        got = self.acquire(nbytes) if target == self._device else None
+        if got is None:
+            return jax.device_put(
+                host_u8.view(np_dtype).reshape(shape), target)
+        size_class, buf = got
+        filled = None
+        try:
+            padded = min(self._pad_quantum(nbytes), size_class)
+            if padded != nbytes:
+                staged = np.zeros(padded, dtype=np.uint8)
+                staged[:nbytes] = host_u8
+            else:
+                staged = host_u8
+            filled = self._fill_fn(size_class, padded)(buf, staged)
+            itemsize = np.dtype(np_dtype).itemsize
+            head = filled[:nbytes]
+            if itemsize > 1:
+                head = jax.lax.bitcast_convert_type(
+                    head.reshape(-1, itemsize), np_dtype)
+            arr = head.reshape(shape)
+            # the pooled buffer may be re-donated the moment it returns
+            # to the freelist: the slice/bitcast read must be complete
+            arr.block_until_ready()
+            return arr
+        finally:
+            if filled is not None:
+                # `filled` aliases the donated memory; it IS the pool
+                # buffer from here on
+                self.release(size_class, filled)
+            else:
+                # the fill failed mid-donation: buf may be dead — refill
+                # the class with a fresh buffer instead of a poisoned one
+                import jax.numpy as jnp
+
+                self.release(size_class, jax.device_put(
+                    jnp.zeros((size_class,), dtype=jnp.uint8),
+                    self._device))
 
 
 _default_pool: Optional[DeviceBlockPool] = None
@@ -245,10 +326,23 @@ class HostArena:
         try:
             if self.owner:
                 self.shm.unlink()
-            # Live memoryviews (IOBuf blocks carved from the arena) keep
-            # the mapping pinned; unmapping then happens at process exit.
+        except OSError:
+            pass
+        try:
             self.shm.close()
-        except (OSError, BufferError):
+        except BufferError:
+            # Live memoryviews (IOBuf blocks / transfer views carved from
+            # the arena) still export the mapping. DETACH instead of
+            # retrying: null the SharedMemory's buf/mmap so its __del__
+            # cannot re-raise (the round-2 unraisable-BufferError leak
+            # seam); the orphaned mmap object unmaps itself once the last
+            # exported view dies — no leak, no warning.
+            try:
+                self.shm._buf = None
+                self.shm._mmap = None
+            except Exception:
+                pass
+        except OSError:
             pass
 
 
@@ -308,7 +402,7 @@ def attach_arena(name: str) -> Optional[HostArena]:
 
 # -- in-process tensor exchange (the loopback "ICI") ------------------------
 
-_inproc_registry: Dict[int, Tuple[List, Optional[Tuple[int, object]]]] = {}
+_inproc_registry: Dict[int, List] = {}
 _inproc_lock = threading.Lock()
 _inproc_next = [1]
 
@@ -358,6 +452,16 @@ def _global_xfer_server():
         return _xfer_server
     with _xfer_server_lock:
         if _xfer_server is None:
+            import os
+
+            if os.environ.get("BRPC_TPU_FAKE_XFER"):
+                # test transport seam: a cross-process TCP fake of the
+                # transfer fabric (the CPU backend's real bulk transport
+                # is same-process-only)
+                from brpc_tpu.rpc.fake_transfer import FakeTransferServer
+
+                _xfer_server = FakeTransferServer()
+                return _xfer_server
             try:
                 import jax
                 from jax.experimental import transfer
@@ -391,32 +495,21 @@ def _xfer_evict(addr: str):
 
 def inproc_publish(arrays: List) -> int:
     """Register device arrays for same-process zero-copy pickup; returns a
-    ticket riding the wire in their place. The DeviceBlockPool brackets the
-    lane: a reservation is acquired per ticket (and released on claim), so
-    in-flight HBM handoffs are bounded by the pool — the role the
-    pre-registered block inventory plays in block_pool.h."""
-    reservation = None
-    try:
-        total = sum(int(a.nbytes) for a in arrays)
-        reservation = default_block_pool().acquire(total)
-    except Exception:
-        reservation = None
+    ticket riding the wire in their place. No staging memory is needed —
+    the arrays themselves are the transfer (strictly better than the
+    reference's registered-block copy for this lane); the DeviceBlockPool
+    serves the lanes that DO materialize bytes (shm/wire receives route
+    through put_via_pool)."""
     with _inproc_lock:
         ticket = _inproc_next[0]
         _inproc_next[0] += 1
-        _inproc_registry[ticket] = (arrays, reservation)
+        _inproc_registry[ticket] = arrays
     return ticket
 
 
 def inproc_claim(ticket: int) -> Optional[List]:
     with _inproc_lock:
-        entry = _inproc_registry.pop(ticket, None)
-    if entry is None:
-        return None
-    arrays, reservation = entry
-    if reservation is not None:
-        default_block_pool().release(*reservation)
-    return arrays
+        return _inproc_registry.pop(ticket, None)
 
 
 # -- DeviceEndpoint (RdmaEndpoint analog) -----------------------------------
@@ -730,11 +823,11 @@ def receive_tensors(meta, attachment: IOBuf, device=None) -> Tuple[List, Optiona
                                  count=t.nbytes, offset=pos)
             pos += t.nbytes
             if device is not None:
-                import jax
-
-                # host->device DMA straight from the mapped arena
-                arr = jax.device_put(
-                    view.view(dtype).reshape(tuple(t.shape)), device)
+                # host->device DMA from the mapped arena THROUGH the
+                # device block pool (block_pool.h role: transfer bytes
+                # land in pooled, pre-allocated HBM)
+                arr = default_block_pool().put_via_pool(
+                    view, dtype, tuple(t.shape), device)
             else:
                 # own the bytes before ACK lets the sender reuse them
                 arr = np.array(view.view(dtype).reshape(tuple(t.shape)))
@@ -753,11 +846,12 @@ def receive_tensors(meta, attachment: IOBuf, device=None) -> Tuple[List, Optiona
     arrays = []
     for t in meta.tensors:
         raw = attachment.cutn_bytes(t.nbytes)
-        arr = np.frombuffer(raw, dtype=_np_dtype(t.dtype)).reshape(
-            tuple(t.shape))
         if device is not None:
-            import jax
-
-            arr = jax.device_put(arr, device)
+            arr = default_block_pool().put_via_pool(
+                np.frombuffer(raw, dtype=np.uint8), _np_dtype(t.dtype),
+                tuple(t.shape), device)
+        else:
+            arr = np.frombuffer(raw, dtype=_np_dtype(t.dtype)).reshape(
+                tuple(t.shape))
         arrays.append(arr)
     return arrays, seq

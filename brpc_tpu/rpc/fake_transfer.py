@@ -1,0 +1,142 @@
+"""Cross-process fake of the jax transfer fabric — the xfer-lane test
+transport.
+
+The real lane rides `jax.experimental.transfer` (the ICI/DCN bulk fabric;
+rdma_endpoint.h:55-57's role cross-host), but the CPU backend's bulk
+transport is same-process-only, so the FULL pull path could not run in a
+two-process test. This fake implements the same server surface over plain
+TCP: `await_pull` parks published arrays, a peer's `connect(addr)` /
+`pull(uid, specs)` dials back and streams the bytes, and serving a pull
+releases the retained publication (the pull-completes-then-free retention
+semantics). It is a test fixture in the package by design — the same
+discipline as the file/list naming services doubling as fixtures
+(SURVEY.md §4).
+
+Enable with BRPC_TPU_FAKE_XFER=1 (picked up by
+device_transport._global_xfer_server) or install directly.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+
+def _recv_exact(conn: socket.socket, n: int):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class FakeTransferConnection:
+    def __init__(self, addr: str):
+        self.addr = addr
+
+    def pull(self, uid: int, specs):
+        """Dial the publisher and stream each array's bytes; materialize
+        per the ShapeDtypeStructs (device placement from the sharding)."""
+        import jax
+        import numpy as np
+
+        host, _, port = self.addr.rpartition(":")
+        with socket.create_connection((host, int(port)), timeout=10) as c:
+            c.sendall(struct.pack(">Q", uid))
+            head = _recv_exact(c, 4)
+            if head is None:
+                raise ConnectionError("fake transfer: publisher hung up")
+            (count,) = struct.unpack(">I", head)
+            if count != len(specs):
+                raise ValueError(
+                    f"fake transfer: {count} arrays published, "
+                    f"{len(specs)} requested")
+            arrays = []
+            for spec in specs:
+                (nbytes,) = struct.unpack(">Q", _recv_exact(c, 8))
+                raw = _recv_exact(c, nbytes)
+                arr = np.frombuffer(raw, dtype=spec.dtype).reshape(
+                    spec.shape)
+                device = None
+                if spec.sharding is not None:
+                    device = next(iter(spec.sharding.device_set))
+                arrays.append(jax.device_put(arr, device))
+            return arrays
+
+
+class FakeTransferServer:
+    """Quacks like jax.experimental.transfer's server: address(),
+    await_pull(uid, arrays), connect(addr)."""
+
+    def __init__(self, ip: str = "127.0.0.1"):
+        self._published = {}
+        self._cv = threading.Condition()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((ip, 0))
+        self._listener.listen(16)
+        self._port = self._listener.getsockname()[1]
+        self._stopping = False
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="fake_xfer_server")
+        t.start()
+
+    # wildcard on purpose: exercises the peer-facing address resolution
+    # (resolve_xfer_addr substitutes the handshake connection's IP)
+    def address(self) -> str:
+        return f"0.0.0.0:{self._port}"
+
+    def await_pull(self, uid: int, arrays):
+        with self._cv:
+            self._published[uid] = list(arrays)
+            self._cv.notify_all()
+
+    def connect(self, addr: str) -> FakeTransferConnection:
+        return FakeTransferConnection(addr)
+
+    def published_count(self) -> int:
+        with self._cv:
+            return len(self._published)
+
+    # -- server side --------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stopping:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        import numpy as np
+
+        with conn:
+            head = _recv_exact(conn, 8)
+            if head is None:
+                return
+            (uid,) = struct.unpack(">Q", head)
+            deadline = 10.0
+            with self._cv:
+                while uid not in self._published and deadline > 0:
+                    self._cv.wait(0.2)
+                    deadline -= 0.2
+                # serving the pull RELEASES the publication (the sender's
+                # buffers are free once the peer's pull completes)
+                arrays = self._published.pop(uid, None)
+            if arrays is None:
+                conn.sendall(struct.pack(">I", 0))
+                return
+            conn.sendall(struct.pack(">I", len(arrays)))
+            for a in arrays:
+                raw = np.ascontiguousarray(np.asarray(a)).tobytes()
+                conn.sendall(struct.pack(">Q", len(raw)) + raw)
+
+    def stop(self):
+        self._stopping = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass

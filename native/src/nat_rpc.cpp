@@ -1375,8 +1375,15 @@ void Dispatcher::run() {
           std::lock_guard<std::mutex> g(listen_mu);
           auto it = listeners.find(lfd);
           srv = (it == listeners.end()) ? nullptr : it->second;
+          // ref taken UNDER the lock: a racing server_stop erases the
+          // listener then releases its registration reference — without
+          // this, accept_loop could run on a freed server
+          if (srv != nullptr) srv->add_ref();
         }
-        if (srv != nullptr) accept_loop(lfd, srv);
+        if (srv != nullptr) {
+          accept_loop(lfd, srv);
+          srv->release();
+        }
         continue;
       }
       NatSocket* s = sock_address(data);
@@ -1527,7 +1534,10 @@ int nat_rpc_set_dispatchers(int n) {
 // the request's IOBuf blocks). Python services ride the py lane.
 int nat_rpc_server_start(const char* ip, int port, int nworkers,
                          int enable_native_echo) {
-  if (g_rpc_server != nullptr) return -1;
+  {
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    if (g_rpc_server != nullptr) return -1;
+  }
   if (ensure_runtime(nworkers) != 0) return -1;
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -1;
@@ -1558,7 +1568,15 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
       ctx.resp_attachment.append(std::move(*ctx.req_attachment));
     };
   }
-  g_rpc_server = srv;
+  {
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    if (g_rpc_server != nullptr) {  // lost a concurrent-start race
+      ::close(fd);
+      srv->release();
+      return -1;
+    }
+    g_rpc_server = srv;
+  }
   g_disp->add_listener(fd, srv);
   return srv->port;
 }
@@ -1764,12 +1782,20 @@ static NatSocket* channel_socket(NatChannel* ch) {
       ch->peer_port == 0) {
     return s;
   }
+  // Dial OUTSIDE reconnect_mu — poll() can block up to the connect
+  // timeout, and close()/other callers must not wait behind it. The
+  // publish step below re-checks under the lock; a losing racer just
+  // closes its dial. Re-dials default to a 1s guard (not the 10s
+  // first-open guard) so a blackholed peer doesn't pin a worker long.
+  int t_ms = ch->connect_timeout_ms > 0 ? ch->connect_timeout_ms : 1000;
+  int fd = dial_nonblocking(ch->peer_ip.c_str(), ch->peer_port, t_ms);
+  if (fd < 0) return nullptr;
   std::lock_guard<std::mutex> g(ch->reconnect_mu);
   s = sock_address(ch->sock_id.load(std::memory_order_acquire));
-  if (s != nullptr || ch->closed.load(std::memory_order_acquire)) return s;
-  int fd = dial_nonblocking(ch->peer_ip.c_str(), ch->peer_port,
-                            ch->connect_timeout_ms);
-  if (fd < 0) return nullptr;
+  if (s != nullptr || ch->closed.load(std::memory_order_acquire)) {
+    ::close(fd);  // lost the race (or the channel closed mid-dial)
+    return s;
+  }
   NatSocket* ns = sock_create();
   if (ns == nullptr) {
     ::close(fd);
@@ -1825,7 +1851,7 @@ struct CallTimeout {
   int64_t cid;
 };
 
-static void call_timeout_fire(void* raw) {
+static void call_timeout_work(void* raw) {
   CallTimeout* t = (CallTimeout*)raw;
   PendingCall* pc = t->ch->take_pending(t->cid);
   if (pc != nullptr) {
@@ -1840,6 +1866,13 @@ static void call_timeout_fire(void* raw) {
   }
   t->ch->release();
   delete t;
+}
+
+// The completion callback may run arbitrary embedder code (the Python
+// acall trampoline takes the GIL): run it on a scheduler fiber — timer
+// callbacks must not block or every later deadline fires late.
+static void call_timeout_fire(void* raw) {
+  Scheduler::instance()->spawn_detached(call_timeout_work, raw);
 }
 
 static void arm_call_timeout(NatChannel* ch, int64_t cid, int timeout_ms) {

@@ -191,3 +191,75 @@ def test_transfer_server_lane_plumbing():
     np.testing.assert_array_equal(np.asarray(out[0]), arr)
     ep.on_ack(seq)
     assert ep.retained_count == 0 and ep.inflight_bytes == 0
+
+
+XFER_SERVER_SCRIPT = r"""
+import os, sys
+os.environ["BRPC_TPU_FAKE_XFER"] = "1"
+sys.path.insert(0, ".")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.tensor_service import TensorStoreService
+
+svc = TensorStoreService()
+srv = rpc.Server(rpc.ServerOptions(num_threads=2))
+srv.add_service(svc)
+assert srv.start("127.0.0.1:0") == 0
+print(srv.listen_endpoint.port, flush=True)
+sys.stdin.readline()
+srv.stop()
+"""
+
+
+def test_two_process_xfer_transfer():
+    """The FULL xfer-lane pull path across a real process boundary via
+    the in-repo fake transfer fabric (fake_transfer.py): publish on the
+    sender's transfer server, wildcard dial-back address resolution,
+    zero payload bytes on the RPC wire, retention released when the
+    peer's pull completes, and the xfer counter incrementing."""
+    from brpc_tpu.butil import flags as _flags
+    from brpc_tpu.rpc.fake_transfer import FakeTransferServer
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, BRPC_TPU_FAKE_XFER="1")
+    proc = subprocess.Popen([sys.executable, "-c", XFER_SERVER_SCRIPT],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            text=True, cwd=repo_root, env=env)
+    saved_server = dt._xfer_server
+    fake = FakeTransferServer()
+    dt._xfer_server = fake
+    _flags.set_flag("device_transport_prefer_xfer", True)
+    try:
+        port = int(proc.stdout.readline())
+        ch = make_device_channel(f"127.0.0.1:{port}")
+        client = TensorClient(ch)
+
+        xfer0 = dt.lane_counters()["xfer"]
+        arr = np.arange(3000, dtype=np.float32).reshape(60, 50) * 1.5
+        cntl, resp = client.push("xw", [arr])
+        assert not cntl.failed(), cntl.error_text
+        assert resp.ok
+
+        ep = cntl._current_sock.app_state
+        assert isinstance(ep, dt.DeviceEndpoint)
+        assert ep.state == dt.ESTABLISHED
+        assert ep._my_xfer_addr.startswith("127.0.0.1:")  # wildcard resolved
+        # the lane fired: counter moved, nothing rode the RPC wire
+        assert dt.lane_counters()["xfer"] == xfer0 + 1
+        assert len(cntl.request_attachment) == 0
+        # the peer's pull released the publication (retention-until-pull)
+        assert fake.published_count() == 0
+        assert ep.retained_count == 0 and ep.inflight_bytes == 0
+
+        # and the values survived the fabric: pull them back over RPC
+        cntl2, pulled = client.pull("xw")
+        assert not cntl2.failed(), cntl2.error_text
+        np.testing.assert_array_equal(np.asarray(pulled[0]), arr)
+        ch.close()
+    finally:
+        _flags.set_flag("device_transport_prefer_xfer", False)
+        dt._xfer_server = saved_server
+        proc.stdin.close()
+        proc.wait(timeout=10)
