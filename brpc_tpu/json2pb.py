@@ -136,8 +136,14 @@ def _message_to_obj(msg, opts: Pb2JsonOptions) -> dict:
             if not m and not opts.jsonify_empty_array:
                 continue
             vfield = field.message_type.fields_by_name["value"]
-            out[name] = {str(k): _value_to_json(vfield, m[k], opts)
-                         for k in m}
+            kfield = field.message_type.fields_by_name["key"]
+            if kfield.type == _FD.TYPE_BOOL:
+                # JSON bool map keys are lowercase (reference/JS form)
+                out[name] = {("true" if k else "false"):
+                             _value_to_json(vfield, m[k], opts) for k in m}
+            else:
+                out[name] = {str(k): _value_to_json(vfield, m[k], opts)
+                             for k in m}
         elif _is_repeated(field):
             seq = getattr(msg, name)
             if not seq and not opts.jsonify_empty_array:
@@ -169,7 +175,7 @@ def _parse_int(field, value, path: str) -> int:
         raise ParseError(f"{path}: expected integer, got bool")
     if isinstance(value, str):
         try:
-            value = int(value, 0)  # int64-as-string tolerance
+            value = int(value, 10)  # decimal only, like the reference
         except ValueError:
             raise ParseError(f"{path}: invalid integer string {value!r}")
     if isinstance(value, float):
@@ -276,7 +282,7 @@ def _fill_message(obj, msg, opts: Json2PbOptions, path: str):
             target = getattr(msg, field.name)
             for k, v in value.items():
                 if kfield.type == _FD.TYPE_BOOL:
-                    pk = k == "true"
+                    pk = k.lower() == "true"
                 elif kfield.type in _INT_TYPES:
                     pk = _parse_int(kfield, k, f"{fpath}[{k}]")
                 else:

@@ -180,8 +180,11 @@ class DeviceBlockPool:
         try:
             padded = min(self._pad_quantum(nbytes), size_class)
             if padded != nbytes:
-                staged = np.zeros(padded, dtype=np.uint8)
+                # np.empty + tail zero: one nbytes memcpy plus a small
+                # tail clear, not a full padded zero-fill + copy
+                staged = np.empty(padded, dtype=np.uint8)
                 staged[:nbytes] = host_u8
+                staged[nbytes:] = 0
             else:
                 staged = host_u8
             filled = self._fill_fn(size_class, padded)(buf, staged)

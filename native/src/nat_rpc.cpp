@@ -1358,8 +1358,12 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
 void Dispatcher::run() {
   std::vector<struct epoll_event> events(256);
   std::vector<NatSocket*> flush_list;  // queued output; flushed per round
+  std::vector<Fiber*> wake_batch;      // fibers readied this round
   while (!stop.load(std::memory_order_acquire)) {
     int n = epoll_wait(epfd, events.data(), (int)events.size(), 100);
+    // every butex wake / spawn from this round coalesces into one
+    // remote-queue push + one signal per worker (not per completion)
+    Scheduler::instance()->arm_wake_batch(&wake_batch);
     for (int i = 0; i < n; i++) {
       uint64_t data = events[i].data.u64;
       if (data == (uint64_t)-1) {  // wake eventfd
@@ -1419,6 +1423,7 @@ void Dispatcher::run() {
       s->release();
     }
     flush_list.clear();
+    Scheduler::instance()->flush_wake_batch();
   }
 }
 
@@ -1449,6 +1454,8 @@ static int ensure_runtime(int nworkers) {
     if (nworkers <= 0) {
       unsigned hw = std::thread::hardware_concurrency();
       nworkers = hw > 1 ? (int)hw : 1;
+      if (nworkers > 16) nworkers = 16;  // brpc-class default; beyond
+      // this the random-steal idle loops cost more than they serve
     }
     Scheduler::instance()->start(nworkers);
   }

@@ -52,6 +52,7 @@ static void* fctx_make(char* stack_top, void (*entry)()) {
 #endif
 
 static thread_local Worker* tls_worker = nullptr;
+static thread_local std::vector<Fiber*>* tls_wake_batch = nullptr;
 
 // Fiber bodies migrate threads across swapcontext, but -O2 CSEs the TLS
 // address within a function (it assumes one thread per activation). Every
@@ -223,8 +224,39 @@ void Scheduler::spawn_detached_back(FiberFn fn, void* arg) {
   target->signal();
 }
 
+void Scheduler::arm_wake_batch(std::vector<Fiber*>* batch) {
+  tls_wake_batch = batch;
+}
+
+void Scheduler::flush_wake_batch() {
+  std::vector<Fiber*>* batch = tls_wake_batch;
+  tls_wake_batch = nullptr;
+  if (batch == nullptr || batch->empty()) return;
+  size_t n = batch->size();
+  size_t nw = workers_.size();
+  size_t chunks = n < nw ? n : nw;
+  uint32_t base = next_worker_.fetch_add((uint32_t)chunks);
+  size_t idx = 0;
+  for (size_t c = 0; c < chunks; c++) {
+    size_t take = n / chunks + (c < n % chunks ? 1 : 0);
+    Worker* t = workers_[(base + c) % nw];
+    {
+      std::lock_guard<std::mutex> g(t->remote_mu);
+      for (size_t i = 0; i < take; i++) {
+        t->remote_rq.push_back((*batch)[idx++]);
+      }
+    }
+    t->signal();
+  }
+  batch->clear();
+}
+
 void Scheduler::ready_fiber(Fiber* f) {
   f->state.store(FiberState::READY, std::memory_order_release);
+  if (tls_wake_batch != nullptr) {
+    tls_wake_batch->push_back(f);
+    return;
+  }
   Worker* w = current_worker();
   if (w != nullptr) {
     if (w->rq.push(f)) {
@@ -319,7 +351,13 @@ void Scheduler::run_fiber(Worker* w, Fiber* f) {
       int32_t expected = w->remained_expected;
       w->remained_op = Worker::RemainedOp::NONE;
       std::unique_lock<std::mutex> g(b->mu);
+      // publish-then-check (Dekker): the RMW increment is a full barrier
+      // that pairs with butex_wake's fence-then-load — incrementing
+      // AFTER the value check would let a concurrent waker miss both
+      // the waiter and the waiter miss the new value
+      b->nwaiters.fetch_add(1, std::memory_order_seq_cst);
       if (b->value.load(std::memory_order_acquire) != expected) {
+        b->nwaiters.fetch_sub(1, std::memory_order_relaxed);
         g.unlock();
         ready_fiber(rf);  // value already moved: spurious-wake ourselves
       } else {
@@ -422,11 +460,16 @@ bool Scheduler::butex_wait(Butex* b, int32_t expected) {
     // the butex's condvar; butex_wake notifies it. Recheck under the lock
     // so a change-then-wake between the load and the wait is never missed.
     std::unique_lock<std::mutex> g(b->mu);
+    // publish the waiter BEFORE checking the value (the RMW is a full
+    // barrier): pairs with butex_wake's fence-then-load so at least one
+    // side observes the other — no missed pthread wake
+    b->nwaiters.fetch_add(1, std::memory_order_seq_cst);
     while (b->value.load(std::memory_order_acquire) == expected) {
       ++b->pthread_waiters;
       b->pthread_cv.wait_for(g, std::chrono::milliseconds(100));
       --b->pthread_waiters;
     }
+    b->nwaiters.fetch_sub(1, std::memory_order_relaxed);
     return true;
   }
   Fiber* f = w->current;
@@ -444,12 +487,20 @@ bool Scheduler::butex_wait(Butex* b, int32_t expected) {
 }
 
 int Scheduler::butex_wake(Butex* b, int n) {
+  // Lock-free fast path: no waiter was parked when we looked. The fence
+  // pairs with the waiter-side RMW increment (classic store-buffer
+  // pairing): either we see the waiter and take the lock, or the waiter
+  // sees our caller's already-stored value when it rechecks under mu and
+  // self-wakes — no missed wake either way.
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  if (b->nwaiters.load(std::memory_order_relaxed) == 0) return 0;
   std::deque<Fiber*> woken;
   {
     std::lock_guard<std::mutex> g(b->mu);
     while (!b->waiters.empty() && n-- > 0) {
       woken.push_back(b->waiters.front());
       b->waiters.pop_front();
+      b->nwaiters.fetch_sub(1, std::memory_order_relaxed);
     }
     if (b->pthread_waiters > 0) b->pthread_cv.notify_all();
   }

@@ -38,6 +38,12 @@ struct Butex {
   // instead of spinning; butex_wake notifies when any are parked.
   std::condition_variable pthread_cv;
   int pthread_waiters = 0;
+  // fiber + pthread waiter count, maintained under mu but READABLE
+  // without it: wakers store the value BEFORE waking and enqueuers
+  // recheck the value under mu, so a zero snapshot lets butex_wake skip
+  // the lock entirely (the common nobody-parked case — e.g. every
+  // EPOLLOUT edge and most async window updates).
+  std::atomic<int> nwaiters{0};
 };
 
 enum class FiberState : uint8_t { READY, RUNNING, BLOCKED, DONE };
@@ -144,6 +150,14 @@ class Scheduler {
   // poller, libtpu callbacks) use this so completions don't wait out the
   // park timeout (the ExtWakeup of ring_listener.h:42-63).
   void wake_one();
+
+  // Wake batching for event-loop threads: between arm and flush, every
+  // ready_fiber()/spawn from THIS thread collects into `batch` instead
+  // of remote-queue+futex per fiber; flush distributes the batch across
+  // workers with one lock+signal per worker (amortizing the per-
+  // completion futex wake that dominates dispatcher rounds).
+  void arm_wake_batch(std::vector<Fiber*>* batch);
+  void flush_wake_batch();
 
   uint64_t total_switches() const;
 

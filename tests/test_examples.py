@@ -30,6 +30,7 @@ EXAMPLES = [
     ("examples/device_performance.py", ["--threads", "2", "--mb", "1",
                                         "--iters", "3"]),
     ("examples/io_uring_echo.py", ["--seconds", "1"]),
+    ("examples/native_client.py", []),
 ]
 
 
