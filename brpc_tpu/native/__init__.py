@@ -131,6 +131,13 @@ def load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
             ctypes.POINTER(ctypes.c_char_p)]
         lib.nat_channel_call.restype = ctypes.c_int
+        lib.nat_channel_call_full.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_char_p)]
+        lib.nat_channel_call_full.restype = ctypes.c_int
         lib.nat_buf_free.argtypes = [ctypes.c_char_p]
         lib.nat_buf_free.restype = None
         lib.nat_rpc_client_bench.argtypes = [
@@ -351,18 +358,24 @@ def channel_acall(handle, service: str, method: str, payload: bytes,
 
 
 def channel_call(handle, service: str, method: str,
-                 payload: bytes = b"", timeout_ms: int = 0):
-    """Synchronous call through the native client; timeout_ms > 0 arms a
-    native deadline (ERPCTIMEDOUT on expiry). Returns
+                 payload: bytes = b"", timeout_ms: int = 0,
+                 max_retry: int = 0, backup_ms: int = 0):
+    """Synchronous call through the native client. timeout_ms > 0 arms a
+    native deadline covering ALL attempts (ERPCTIMEDOUT on expiry);
+    max_retry re-attempts failed-socket calls with on-demand re-dial;
+    backup_ms > 0 re-sends the request if no response arrived in time
+    (same correlation id — first response wins). Returns
     (error_code, response_bytes, error_text)."""
     lib = load()
     resp = ctypes.c_char_p()
     rlen = ctypes.c_size_t(0)
     err = ctypes.c_char_p()
-    rc = lib.nat_channel_call(handle, service.encode(), method.encode(),
-                              payload, len(payload), timeout_ms,
-                              ctypes.byref(resp),
-                              ctypes.byref(rlen), ctypes.byref(err))
+    rc = lib.nat_channel_call_full(handle, service.encode(),
+                                   method.encode(),
+                                   payload, len(payload), timeout_ms,
+                                   max_retry, backup_ms,
+                                   ctypes.byref(resp),
+                                   ctypes.byref(rlen), ctypes.byref(err))
     body = b""
     if resp:
         body = ctypes.string_at(resp, rlen.value)

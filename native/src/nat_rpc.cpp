@@ -510,6 +510,16 @@ class NatChannel {
 
   // CAS the pending bit off; the winner owns the call. Stale cids (old
   // version) and double-completions lose the CAS and get nullptr.
+  // Non-consuming peek: true while the call is still awaiting its first
+  // completion (used by the backup-request timer to decide whether a
+  // duplicate send is still useful).
+  bool is_pending(int64_t cid) {
+    uint32_t idx = (uint32_t)cid & kIdxMask;
+    if (idx >= nslots_.load(std::memory_order_acquire)) return false;
+    uint64_t expected = (((uint64_t)cid >> kIdxBits) << 1) | 1;
+    return slot_at(idx)->state.load(std::memory_order_acquire) == expected;
+  }
+
   PendingCall* take_pending(int64_t cid) {
     uint32_t idx = (uint32_t)cid & kIdxMask;
     if (idx >= nslots_.load(std::memory_order_acquire)) return nullptr;
@@ -1783,7 +1793,7 @@ static int dial_nonblocking(const char* ip, int port, int timeout_ms) {
 // Borrow the channel's socket, re-dialing a failed single connection on
 // demand (Channel reuse-after-failure semantics). Returns a referenced
 // socket or nullptr (closed channel / peer unreachable).
-static NatSocket* channel_socket(NatChannel* ch) {
+static NatSocket* channel_socket(NatChannel* ch, int max_dial_ms = 0) {
   NatSocket* s = sock_address(ch->sock_id.load(std::memory_order_acquire));
   if (s != nullptr || ch->closed.load(std::memory_order_acquire) ||
       ch->peer_port == 0) {
@@ -1793,8 +1803,10 @@ static NatSocket* channel_socket(NatChannel* ch) {
   // timeout, and close()/other callers must not wait behind it. The
   // publish step below re-checks under the lock; a losing racer just
   // closes its dial. Re-dials default to a 1s guard (not the 10s
-  // first-open guard) so a blackholed peer doesn't pin a worker long.
+  // first-open guard) so a blackholed peer doesn't pin a worker long;
+  // callers with a deadline pass max_dial_ms to clamp further.
   int t_ms = ch->connect_timeout_ms > 0 ? ch->connect_timeout_ms : 1000;
+  if (max_dial_ms > 0 && max_dial_ms < t_ms) t_ms = max_dial_ms;
   int fd = dial_nonblocking(ch->peer_ip.c_str(), ch->peer_port, t_ms);
   if (fd < 0) return nullptr;
   std::lock_guard<std::mutex> g(ch->reconnect_mu);
@@ -1935,30 +1947,58 @@ void nat_channel_close(void* h) {
   ch->release();  // opener's reference; the socket may still hold one
 }
 
-// Synchronous call. Returns 0 on success (out buffers malloc'd, caller
-// frees with nat_buf_free), else an error code. timeout_ms > 0 arms a
-// deadline: the call completes with ERPCTIMEDOUT when it expires first.
-int nat_channel_call(void* h, const char* service, const char* method,
-                     const char* payload, size_t payload_len, int timeout_ms,
-                     char** resp_out, size_t* resp_len,
-                     char** err_text_out) {
-  NatChannel* ch = (NatChannel*)h;
-  NatSocket* s = channel_socket(ch);
-  if (s == nullptr) return kEFAILEDSOCKET;
+// Backup request (the controller.cpp:1256 backup timer): when the timer
+// fires and the call is STILL pending, the SAME frame (same correlation
+// id) is re-sent on the channel's current socket — the pending-bit CAS
+// makes whichever response lands first win and the loser a no-op, which
+// is exactly the reference's duplicate-response discipline.
+struct BackupCtx {
+  NatChannel* ch;  // holds a reference until fired
+  int64_t cid;
+  std::string frame;
+};
+
+static void backup_fire_work(void* raw) {
+  BackupCtx* b = (BackupCtx*)raw;
+  if (b->ch->is_pending(b->cid) &&
+      !b->ch->closed.load(std::memory_order_acquire)) {
+    NatSocket* s = sock_address(b->ch->sock_id);
+    if (s != nullptr) {
+      IOBuf f;
+      f.append(b->frame.data(), b->frame.size());
+      s->write(std::move(f));
+      s->release();
+    }
+  }
+  b->ch->release();
+  delete b;
+}
+
+static void backup_fire(void* raw) {
+  Scheduler::instance()->spawn_detached(backup_fire_work, raw);
+}
+
+// One wire attempt: build, (optionally) arm deadline + backup, write,
+// park, harvest. Returns the RPC error code.
+static int call_attempt(NatChannel* ch, NatSocket* s, const char* service,
+                        const char* method, const char* payload,
+                        size_t payload_len, int timeout_ms, int backup_ms,
+                        char** resp_out, size_t* resp_len,
+                        char** err_text_out) {
   int64_t cid = 0;
   PendingCall* pc = ch->begin_call(&cid);
   if (pc == nullptr) {
-    s->release();
     return kEFAILEDSOCKET;  // 1M calls already in flight on this channel
   }
   if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
   IOBuf frame;
   build_request_frame(&frame, cid, service, method, payload, payload_len,
                       nullptr, 0);
-  // NOTE: the socket reference is held until the call completes — it pins
-  // the channel (socket->channel ref), so a concurrent nat_channel_close
-  // can never delete the slot slabs while we're parked on pc->done or
-  // reading the completed slot (the never-freed-butex discipline).
+  if (backup_ms > 0 && (timeout_ms <= 0 || backup_ms < timeout_ms)) {
+    ch->add_ref();
+    BackupCtx* b = new BackupCtx{ch, cid, frame.to_string()};
+    TimerThread::instance()->schedule(backup_fire, b, backup_ms);
+  }
   if (s->write(std::move(frame)) != 0) {
     PendingCall* mine = ch->take_pending(cid);
     if (mine != nullptr) {
@@ -1971,7 +2011,6 @@ int nat_channel_call(void* h, const char* service, const char* method,
       }
       pc_free(pc);
     }
-    s->release();
     return kEFAILEDSOCKET;
   }
   while (pc->done.value.load(std::memory_order_acquire) == 0) {
@@ -1996,8 +2035,92 @@ int nat_channel_call(void* h, const char* service, const char* method,
     }
   }
   pc_free(pc);
-  s->release();  // pinned the channel through the slot access above
   return rc;
+}
+
+// Synchronous call. Returns 0 on success (out buffers malloc'd, caller
+// frees with nat_buf_free), else an error code. timeout_ms > 0 arms a
+// deadline covering ALL attempts (reference semantics); failed-socket
+// attempts retry up to max_retry times with on-demand re-dial;
+// backup_ms > 0 re-sends the request if no response arrived in time.
+int nat_channel_call_full(void* h, const char* service, const char* method,
+                          const char* payload, size_t payload_len,
+                          int timeout_ms, int max_retry, int backup_ms,
+                          char** resp_out, size_t* resp_len,
+                          char** err_text_out) {
+  NatChannel* ch = (NatChannel*)h;
+  // out-params are read (and freed) by the retry loop below: they must
+  // be defined regardless of which early path an attempt takes
+  if (resp_out != nullptr) {
+    *resp_out = nullptr;
+    *resp_len = 0;
+  }
+  if (err_text_out != nullptr) *err_text_out = nullptr;
+  int64_t deadline_us =
+      timeout_ms > 0
+          ? std::chrono::duration_cast<std::chrono::microseconds>(
+                std::chrono::steady_clock::now().time_since_epoch())
+                    .count() +
+                (int64_t)timeout_ms * 1000
+          : 0;
+  int attempt = 0;
+  while (true) {
+    int remaining_ms = timeout_ms;
+    if (deadline_us != 0) {
+      int64_t now_us =
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              std::chrono::steady_clock::now().time_since_epoch())
+              .count();
+      remaining_ms = (int)((deadline_us - now_us) / 1000);
+      if (remaining_ms <= 0) return kERPCTIMEDOUT;
+    }
+    // NOTE: the socket reference is held until the attempt completes —
+    // it pins the channel (socket->channel ref), so a concurrent close
+    // can never delete the slot slabs under a parked caller (the
+    // never-freed-butex discipline). The re-dial is clamped to the
+    // remaining budget, and the budget is recomputed after it, so a
+    // slow dial can't stretch the overall deadline.
+    NatSocket* s = channel_socket(ch, remaining_ms);
+    if (s == nullptr) {
+      if (attempt++ < max_retry &&
+          !ch->closed.load(std::memory_order_acquire)) {
+        continue;  // the next channel_socket re-dials
+      }
+      return kEFAILEDSOCKET;
+    }
+    if (deadline_us != 0) {  // the dial may have consumed budget
+      int64_t now_us =
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              std::chrono::steady_clock::now().time_since_epoch())
+              .count();
+      remaining_ms = (int)((deadline_us - now_us) / 1000);
+      if (remaining_ms <= 0) {
+        s->release();
+        return kERPCTIMEDOUT;
+      }
+    }
+    int rc = call_attempt(ch, s, service, method, payload, payload_len,
+                          remaining_ms, backup_ms, resp_out, resp_len,
+                          err_text_out);
+    s->release();
+    if (rc != kEFAILEDSOCKET || attempt++ >= max_retry ||
+        ch->closed.load(std::memory_order_acquire)) {
+      return rc;
+    }
+    if (err_text_out != nullptr && *err_text_out != nullptr) {
+      free(*err_text_out);  // superseded by the retry
+      *err_text_out = nullptr;
+    }
+  }
+}
+
+int nat_channel_call(void* h, const char* service, const char* method,
+                     const char* payload, size_t payload_len, int timeout_ms,
+                     char** resp_out, size_t* resp_len,
+                     char** err_text_out) {
+  return nat_channel_call_full(h, service, method, payload, payload_len,
+                               timeout_ms, 0, 0, resp_out, resp_len,
+                               err_text_out);
 }
 
 void nat_buf_free(char* p) { free(p); }
