@@ -80,6 +80,35 @@ def main():
     print(f"throughput={total / wall / 1e9:.2f} GB/s "
           f"({total / 1e6:.0f} MB in {wall:.2f}s)")
     srv.stop()
+
+    # The NATIVE bulk data path (streamed attachments through the C++
+    # runtime: socket write queue -> dispatcher -> zero-copy echo): the
+    # large-payload throughput of the native port, reported alongside
+    # the Python-lane number above.
+    try:
+        import ctypes
+
+        from brpc_tpu import native
+
+        if native.available():
+            lib = native.load()
+            lib.nat_rpc_client_bench_bulk.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_double, ctypes.POINTER(ctypes.c_uint64)]
+            lib.nat_rpc_client_bench_bulk.restype = ctypes.c_double
+            port = native.rpc_server_start(native_echo=True)
+            try:
+                moved = ctypes.c_uint64(0)
+                gbps = lib.nat_rpc_client_bench_bulk(
+                    b"127.0.0.1", port, args.mb << 20, 1.5,
+                    ctypes.byref(moved))
+                print(f"native_bulk={gbps:.2f} GB/s "
+                      f"({moved.value / 1e6:.0f} MB echoed, "
+                      f"{args.mb}MB attachments)")
+            finally:
+                native.rpc_server_stop()
+    except Exception as e:
+        print(f"native bulk lane unavailable: {e}")
     return 0 if recorder.count() > 0 and errors.get_value() == 0 else 1
 
 
