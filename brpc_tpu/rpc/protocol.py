@@ -33,6 +33,7 @@ class ProtocolType(IntEnum):
     NOVA = 14  # nova_pbrpc (client; server via NovaServiceAdaptor)
     PUBLIC = 15  # public_pbrpc (client; server via adaptor)
     UBRPC = 16  # ubrpc over mcpack (client; server via adaptor)
+    RTMP = 17  # RTMP media streaming (server; gated on rtmp_service)
 
 
 class ParseError(IntEnum):
@@ -177,3 +178,7 @@ def globally_initialize():
     from brpc_tpu.rpc import mongo_protocol  # noqa: F401
     from brpc_tpu.rpc import esp_protocol  # noqa: F401
     from brpc_tpu.rpc import legacy_nshead_family  # noqa: F401
+    # registered LAST: its 0x03 first-byte sniff must lose to every
+    # protocol with a real magic, and it only claims bytes on servers
+    # that opted in via ServerOptions.rtmp_service
+    from brpc_tpu.rpc import rtmp_protocol  # noqa: F401

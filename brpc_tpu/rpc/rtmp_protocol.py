@@ -1,0 +1,598 @@
+"""RTMP — the media-streaming protocol family, server side.
+
+Counterpart of /root/reference/src/brpc/policy/rtmp_protocol.cpp (+
+rtmp.{h,cpp}, amf.{h,cpp}): the simple (non-digest) handshake
+(C0C1/S0S1S2/C2, rtmp_protocol.cpp's HandshakeState role), the chunk
+stream layer (basic header fmt 0-3, per-csid message assembly, extended
+timestamps, SetChunkSize both directions), protocol control messages
+(WindowAckSize/SetPeerBW/Ack/UserControl ping-pong), AMF0 command
+dispatch (connect, createStream, releaseStream/FCPublish tolerance,
+publish, play, deleteStream), and a publish->play relay service
+(RtmpService role) that caches metadata + AVC/AAC sequence headers for
+late-joining players, exactly what a stock player needs to start
+rendering mid-stream.
+
+Server-only and gated on ServerOptions.rtmp_service (the ParseRtmpMessage
+TRY_OTHERS-when-no-service discipline) — on an opted-in server the same
+port keeps answering every other protocol. FLV muxing lives in
+brpc_tpu/rpc/flv.py (tags are these messages' payloads verbatim).
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, List, Optional
+
+from brpc_tpu import bvar
+from brpc_tpu.butil.iobuf import IOBuf
+from brpc_tpu.rpc import amf
+from brpc_tpu.rpc.protocol import (
+    InputMessageBase,
+    ParseResult,
+    Protocol,
+    ProtocolType,
+    register_protocol,
+)
+
+HANDSHAKE_SIZE = 1536
+DEFAULT_IN_CHUNK = 128   # spec default until the peer says otherwise
+OUT_CHUNK = 4096
+
+MSG_SET_CHUNK_SIZE = 1
+MSG_ABORT = 2
+MSG_ACK = 3
+MSG_USER_CONTROL = 4
+MSG_WINDOW_ACK_SIZE = 5
+MSG_SET_PEER_BW = 6
+MSG_AUDIO = 8
+MSG_VIDEO = 9
+MSG_DATA_AMF0 = 18
+MSG_COMMAND_AMF0 = 20
+
+UC_STREAM_BEGIN = 0
+UC_PING = 6
+UC_PONG = 7
+
+_rtmp_sessions = bvar.Adder("rtmp_sessions")
+_rtmp_messages = bvar.Adder("rtmp_messages")
+_rtmp_relayed = bvar.Adder("rtmp_relayed_messages")
+
+
+class RtmpMessage(InputMessageBase):
+    """Placeholder message: RTMP is handled inside parse (the protocol is
+    stateful and conversational); the cut loop only counts progress."""
+    __slots__ = ("is_request",)
+
+    def __init__(self):
+        super().__init__()
+        self.is_request = True
+
+
+# ---------------------------------------------------------------------------
+# Relay service (the RtmpService / default server role)
+# ---------------------------------------------------------------------------
+
+class _LiveStream:
+    def __init__(self, name: str):
+        self.name = name
+        self.publisher: Optional["RtmpSession"] = None
+        self.players: List[RtmpSession] = []
+        self.metadata: Optional[bytes] = None       # AMF0 onMetaData
+        self.avc_seq_header: Optional[bytes] = None  # video config tag
+        self.aac_seq_header: Optional[bytes] = None  # audio config tag
+
+
+class RtmpService:
+    """In-memory publish->play relay hub (the DefaultRtmpServer shape):
+    one publisher per stream name, any number of players; metadata and
+    codec sequence headers are cached and replayed to late joiners."""
+
+    def __init__(self):
+        self._streams: Dict[str, _LiveStream] = {}
+        self._lock = threading.Lock()
+
+    def _stream(self, name: str) -> _LiveStream:
+        with self._lock:
+            st = self._streams.get(name)
+            if st is None:
+                st = _LiveStream(name)
+                self._streams[name] = st
+            return st
+
+    def on_publish(self, name: str, sess: "RtmpSession") -> bool:
+        st = self._stream(name)
+        with self._lock:
+            cur = st.publisher
+            if cur is not None and cur is not sess:
+                # a dead publisher's socket releases the name (the
+                # health-of-the-holder check brpc's RtmpService does)
+                alive = not getattr(cur.sock, "failed", lambda: False)()
+                if alive:
+                    return False  # one LIVE publisher per name
+            st.publisher = sess
+        return True
+
+    def on_play(self, name: str, sess: "RtmpSession") -> List[tuple]:
+        """Registers the player; returns cached priming messages
+        [(type, payload), ...] to send before live data. A re-issued
+        play (reconnects/seeks do this) moves the player, never
+        duplicates it."""
+        st = self._stream(name)
+        prime = []
+        with self._lock:
+            for other in self._streams.values():
+                if other is not st and sess in other.players:
+                    other.players.remove(sess)
+            if sess not in st.players:
+                st.players.append(sess)
+            if st.metadata is not None:
+                prime.append((MSG_DATA_AMF0, st.metadata))
+            if st.avc_seq_header is not None:
+                prime.append((MSG_VIDEO, st.avc_seq_header))
+            if st.aac_seq_header is not None:
+                prime.append((MSG_AUDIO, st.aac_seq_header))
+        return prime
+
+    def on_media(self, name: str, msg_type: int, ts: int, payload: bytes):
+        st = self._stream(name)
+        with self._lock:
+            # cache what a late joiner needs (rtmp.cpp's header caching)
+            if msg_type == MSG_DATA_AMF0:
+                st.metadata = payload
+            elif (msg_type == MSG_VIDEO and len(payload) >= 2
+                    and (payload[0] & 0x0F) == 7 and payload[1] == 0):
+                st.avc_seq_header = payload  # AVC sequence header
+            elif (msg_type == MSG_AUDIO and len(payload) >= 2
+                    and (payload[0] >> 4) == 10 and payload[1] == 0):
+                st.aac_seq_header = payload  # AAC sequence header
+            players = list(st.players)
+        for p in players:
+            try:
+                if getattr(p.sock, "failed", lambda: False)():
+                    self.drop(p)  # EOF'd player: sockets report failure
+                    continue      # by flag, not by raising
+                p.send_message(msg_type, ts, payload, stream_id=1)
+                _rtmp_relayed.update(1)
+            except Exception:
+                self.drop(p)
+
+    def drop(self, sess: "RtmpSession"):
+        with self._lock:
+            dead = []
+            for name, st in self._streams.items():
+                if st.publisher is sess:
+                    st.publisher = None
+                if sess in st.players:
+                    st.players.remove(sess)
+                if st.publisher is None and not st.players:
+                    dead.append(name)  # reap: unbounded-name hygiene
+            for name in dead:
+                del self._streams[name]
+
+    def stream_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._streams)
+
+
+# ---------------------------------------------------------------------------
+# Per-connection session: handshake + chunk stream state machine
+# ---------------------------------------------------------------------------
+
+class _CsidState:
+    __slots__ = ("timestamp", "length", "msg_type", "stream_id", "delta",
+                 "has_ext_ts", "buf")
+
+    def __init__(self):
+        self.timestamp = 0
+        self.length = 0
+        self.msg_type = 0
+        self.stream_id = 0
+        self.delta = 0
+        self.has_ext_ts = False  # fmt3 chunks re-read the ext timestamp
+        self.buf = bytearray()
+
+
+class RtmpSession:
+    ST_WAIT_C0C1 = 0
+    ST_WAIT_C2 = 1
+    ST_ESTABLISHED = 2
+
+    def __init__(self, sock, service: RtmpService):
+        self.sock = sock
+        self.service = service
+        self.state = self.ST_WAIT_C0C1
+        self.in_chunk = DEFAULT_IN_CHUNK
+        self.out_chunk = OUT_CHUNK
+        self.csid_state: Dict[int, _CsidState] = {}
+        self.publishing: Optional[str] = None
+        self.playing: Optional[str] = None
+        self._wlock = threading.Lock()  # relay writers vs command replies
+        _rtmp_sessions.update(1)
+
+    # -- outbound ----------------------------------------------------------
+    def _write(self, data: bytes):
+        buf = IOBuf()
+        buf.append(data)
+        self.sock.write(buf)
+
+    def send_message(self, msg_type: int, ts: int, payload: bytes,
+                     stream_id: int = 0, csid: int = 3):
+        """Chunk one message: fmt0 first, fmt3 continuations."""
+        ts = ts & 0xFFFFFFFF
+        out = bytearray()
+        header_ts = min(ts, 0xFFFFFF)
+        out.append((0 << 6) | csid)  # fmt0, one-byte basic header (csid<64)
+        out += struct.pack(">I", header_ts)[1:]
+        out += struct.pack(">I", len(payload))[1:]
+        out.append(msg_type)
+        out += struct.pack("<I", stream_id)
+        if header_ts == 0xFFFFFF:
+            out += struct.pack(">I", ts)
+        pos = 0
+        first = True
+        while pos < len(payload) or first:
+            if not first:
+                out.append((3 << 6) | csid)  # fmt3 continuation
+                if header_ts == 0xFFFFFF:
+                    out += struct.pack(">I", ts)
+            take = min(self.out_chunk, len(payload) - pos)
+            out += payload[pos:pos + take]
+            pos += take
+            first = False
+        with self._wlock:
+            self._write(bytes(out))
+
+    def send_command(self, *values, stream_id: int = 0, csid: int = 3):
+        self.send_message(MSG_COMMAND_AMF0, 0, amf.encode_many(*values),
+                          stream_id=stream_id, csid=csid)
+
+    def _send_control(self, msg_type: int, payload: bytes):
+        self.send_message(msg_type, 0, payload, stream_id=0, csid=2)
+
+    def send_onstatus(self, code: str, level: str = "status",
+                      stream_id: int = 1):
+        self.send_command("onStatus", 0.0, None,
+                          {"level": level, "code": code,
+                           "description": code},
+                          stream_id=stream_id, csid=5)
+
+    # -- inbound -----------------------------------------------------------
+    def consume(self, data: bytearray) -> int:
+        """Eats as many complete handshake/chunk units as possible from
+        the front of `data`; returns bytes consumed. Raises on protocol
+        error (caller fails the connection)."""
+        used = 0
+        while True:
+            n = self._consume_one(data, used)
+            if n == 0:
+                return used
+            used += n
+
+    def _consume_one(self, data: bytearray, pos: int) -> int:
+        avail = len(data) - pos
+        if self.state == self.ST_WAIT_C0C1:
+            if avail < 1 + HANDSHAKE_SIZE:
+                return 0
+            if data[pos] != 3:
+                raise ValueError("rtmp: unsupported handshake version")
+            c1 = bytes(data[pos + 1:pos + 1 + HANDSHAKE_SIZE])
+            s1 = c1[:8] + os.urandom(HANDSHAKE_SIZE - 8)
+            # S0 + S1 + S2(echo of C1) in one write
+            self._write(bytes([3]) + s1 + c1)
+            self.state = self.ST_WAIT_C2
+            return 1 + HANDSHAKE_SIZE
+        if self.state == self.ST_WAIT_C2:
+            if avail < HANDSHAKE_SIZE:
+                return 0
+            self.state = self.ST_ESTABLISHED
+            return HANDSHAKE_SIZE
+        return self._consume_chunk(data, pos)
+
+    def _consume_chunk(self, data: bytearray, pos: int) -> int:
+        start = pos
+        avail = len(data)
+        if pos >= avail:
+            return 0
+        b0 = data[pos]
+        fmt = b0 >> 6
+        csid = b0 & 0x3F
+        pos += 1
+        if csid == 0:
+            if pos >= avail:
+                return 0
+            csid = 64 + data[pos]
+            pos += 1
+        elif csid == 1:
+            if pos + 2 > avail:
+                return 0
+            csid = 64 + data[pos] + (data[pos + 1] << 8)
+            pos += 2
+        st = self.csid_state.get(csid)
+        if st is None:
+            st = self.csid_state[csid] = _CsidState()
+        need = (11, 7, 3, 0)[fmt]
+        if pos + need > avail:
+            return 0
+        ts_field = None
+        if fmt == 0:
+            ts_field = int.from_bytes(data[pos:pos + 3], "big")
+            st.length = int.from_bytes(data[pos + 3:pos + 6], "big")
+            st.msg_type = data[pos + 6]
+            st.stream_id = int.from_bytes(data[pos + 7:pos + 11], "little")
+            st.delta = 0
+            pos += 11
+        elif fmt == 1:
+            ts_field = int.from_bytes(data[pos:pos + 3], "big")
+            st.length = int.from_bytes(data[pos + 3:pos + 6], "big")
+            st.msg_type = data[pos + 6]
+            st.delta = ts_field
+            pos += 7
+        elif fmt == 2:
+            ts_field = int.from_bytes(data[pos:pos + 3], "big")
+            st.delta = ts_field
+            pos += 3
+        if ts_field is not None:
+            st.has_ext_ts = ts_field == 0xFFFFFF
+        # fmt3 chunks of a message whose header used the extended
+        # timestamp carry the 4-byte ext field again (spec §5.3.1.3)
+        ext = 0
+        if st.has_ext_ts:
+            if pos + 4 > avail:
+                return 0
+            ext = int.from_bytes(data[pos:pos + 4], "big")
+            pos += 4
+        if st.length > (64 << 20):
+            raise ValueError("rtmp: message too large")
+        continuation = fmt == 3 and len(st.buf) > 0
+        if not continuation and len(st.buf) > 0:
+            # a fresh header on a csid with an unfinished message is a
+            # protocol violation (and would drive `remaining` negative)
+            raise ValueError("rtmp: new message before finishing the "
+                             "previous one on this chunk stream")
+        new_ts = st.timestamp
+        if not continuation:
+            # a fresh chunk advances the timestamp (fmt3 repeats the
+            # previous header: same delta applies again)
+            if fmt == 0:
+                new_ts = ext if st.has_ext_ts else ts_field
+            else:
+                new_ts = st.timestamp + (ext if st.has_ext_ts else st.delta)
+        remaining = st.length - len(st.buf)
+        take = min(self.in_chunk, remaining)
+        if pos + take > avail:
+            return 0  # incomplete: NO state committed — a reparse after
+                      # more bytes arrive must not double-advance the ts
+        st.timestamp = new_ts
+        st.buf += data[pos:pos + take]
+        pos += take
+        if len(st.buf) >= st.length:
+            body = bytes(st.buf)
+            st.buf = bytearray()
+            self._on_message(st.msg_type, st.stream_id, st.timestamp, body)
+        return pos - start
+
+    # -- message dispatch --------------------------------------------------
+    def _on_message(self, msg_type: int, stream_id: int, ts: int,
+                    payload: bytes):
+        _rtmp_messages.update(1)
+        if msg_type == MSG_SET_CHUNK_SIZE and len(payload) >= 4:
+            size = struct.unpack(">I", payload[:4])[0] & 0x7FFFFFFF
+            if not 1 <= size <= (16 << 20):
+                raise ValueError("rtmp: bad chunk size")
+            self.in_chunk = size
+        elif msg_type == MSG_USER_CONTROL and len(payload) >= 2:
+            event = struct.unpack(">H", payload[:2])[0]
+            if event == UC_PING:
+                self._send_control(MSG_USER_CONTROL,
+                                   struct.pack(">H", UC_PONG) + payload[2:])
+        elif msg_type == MSG_ABORT and len(payload) >= 4:
+            # spec 5.4.2: discard the partially-assembled message
+            csid = struct.unpack(">I", payload[:4])[0]
+            stx = self.csid_state.get(csid)
+            if stx is not None:
+                stx.buf = bytearray()
+        elif msg_type in (MSG_WINDOW_ACK_SIZE, MSG_SET_PEER_BW, MSG_ACK):
+            pass  # flow-control bookkeeping we don't need to act on
+        elif msg_type == MSG_COMMAND_AMF0:
+            self._on_command(stream_id, payload)
+        elif msg_type in (MSG_AUDIO, MSG_VIDEO, MSG_DATA_AMF0):
+            if self.publishing is not None:
+                self.service.on_media(self.publishing, msg_type, ts,
+                                      payload)
+
+    def _on_command(self, stream_id: int, payload: bytes):
+        try:
+            values = amf.decode_all(payload)
+        except amf.AmfError as e:
+            raise ValueError(f"rtmp: bad AMF0 command: {e}")
+        if not values or not isinstance(values[0], str):
+            return
+        cmd = values[0]
+        txn = values[1] if len(values) > 1 else 0.0
+        if cmd == "connect":
+            self._send_control(MSG_WINDOW_ACK_SIZE,
+                               struct.pack(">I", 2500000))
+            self._send_control(MSG_SET_PEER_BW,
+                               struct.pack(">IB", 2500000, 2))
+            self._send_control(MSG_SET_CHUNK_SIZE,
+                               struct.pack(">I", self.out_chunk))
+            self.send_command(
+                "_result", txn,
+                {"fmsVer": "FMS/3,5,3,888", "capabilities": 127.0},
+                {"level": "status", "code": "NetConnection.Connect.Success",
+                 "description": "Connection succeeded.",
+                 "objectEncoding": 0.0})
+        elif cmd == "createStream":
+            self.send_command("_result", txn, None, 1.0)
+        elif cmd in ("releaseStream", "FCPublish", "FCUnpublish",
+                     "getStreamLength"):
+            self.send_command("_result", txn, None, None)
+        elif cmd == "publish":
+            name = values[3] if len(values) > 3 else ""
+            if not isinstance(name, str) or not name:
+                raise ValueError("rtmp: publish without a stream name")
+            name = name.split("?")[0]
+            if not self.service.on_publish(name, self):
+                self.send_onstatus("NetStream.Publish.BadName",
+                                   level="error")
+                return
+            self.publishing = name
+            self.send_onstatus("NetStream.Publish.Start")
+        elif cmd == "play":
+            name = values[3] if len(values) > 3 else ""
+            if not isinstance(name, str) or not name:
+                raise ValueError("rtmp: play without a stream name")
+            name = name.split("?")[0]
+            self._send_control(
+                MSG_USER_CONTROL,
+                struct.pack(">HI", UC_STREAM_BEGIN, 1))
+            self.send_onstatus("NetStream.Play.Reset")
+            self.send_onstatus("NetStream.Play.Start")
+            self.playing = name
+            for mtype, cached in self.service.on_play(name, self):
+                self.send_message(mtype, 0, cached, stream_id=1)
+        elif cmd in ("deleteStream", "closeStream"):
+            self.close()
+
+    def close(self):
+        self.service.drop(self)
+        self.publishing = None
+        self.playing = None
+
+
+# ---------------------------------------------------------------------------
+# Client-mode session (the minimal librtmp role: tests/examples use it as
+# their publisher/player stand-in)
+# ---------------------------------------------------------------------------
+
+class _ClientWire:
+    def __init__(self, conn):
+        self.conn = conn
+
+    def write(self, buf, id_wait=None):
+        self.conn.sendall(buf.copy_to_bytes(len(buf)))
+        return 0
+
+    def failed(self):
+        return False
+
+
+class RtmpClientSession(RtmpSession):
+    """The same chunk machinery in client mode: inbound messages are
+    collected in `inbox` instead of being dispatched as server commands;
+    the peer's SetChunkSize is honored automatically."""
+
+    def __init__(self, conn):
+        super().__init__(_ClientWire(conn), RtmpService())
+        self.conn = conn
+        self.state = self.ST_ESTABLISHED
+        self.inbox: List[tuple] = []
+        self._pending = bytearray()
+
+    def _on_message(self, msg_type, stream_id, ts, payload):
+        if msg_type == MSG_SET_CHUNK_SIZE and len(payload) >= 4:
+            self.in_chunk = struct.unpack(">I", payload[:4])[0]
+            return
+        self.inbox.append((msg_type, ts, payload))
+
+    def feed(self, data: bytes):
+        self._pending += data
+        used = self.consume(self._pending)
+        del self._pending[:used]
+
+    def pump(self, want: int = 1, timeout: float = 5.0):
+        """Reads the socket until `want` messages are buffered."""
+        import socket as pysocket
+        import time as _time
+
+        self.conn.settimeout(0.2)
+        deadline = _time.monotonic() + timeout
+        while len(self.inbox) < want and _time.monotonic() < deadline:
+            try:
+                data = self.conn.recv(65536)
+            except (TimeoutError, pysocket.timeout):
+                continue
+            if not data:
+                break
+            self.feed(data)
+        return self.inbox
+
+    def commands(self):
+        return [amf.decode_all(p) for t, _, p in self.inbox
+                if t == MSG_COMMAND_AMF0]
+
+
+def rtmp_client_connect(host: str, port: int, app: str = "live"):
+    """Dial + simple handshake + connect; returns
+    (socket, RtmpClientSession) ready for createStream/publish/play."""
+    import socket as pysocket
+    import time as _time
+
+    conn = pysocket.create_connection((host, port), timeout=5)
+    c1 = struct.pack(">II", 0, 0) + os.urandom(HANDSHAKE_SIZE - 8)
+    conn.sendall(bytes([3]) + c1)
+    buf = b""
+    while len(buf) < 1 + 2 * HANDSHAKE_SIZE:
+        chunk = conn.recv(65536)
+        if not chunk:
+            raise ConnectionError("rtmp: server hung up in handshake")
+        buf += chunk
+    if buf[0] != 3 or buf[1 + HANDSHAKE_SIZE:1 + 2 * HANDSHAKE_SIZE] != c1:
+        raise ConnectionError("rtmp: bad handshake reply")
+    conn.sendall(buf[1:1 + HANDSHAKE_SIZE])  # C2 echoes S1
+    sess = RtmpClientSession(conn)
+    sess.feed(buf[1 + 2 * HANDSHAKE_SIZE:])
+    sess.send_command("connect", 1.0, {"app": app, "flashVer": "brpc_tpu"})
+    deadline = _time.monotonic() + 5
+    while _time.monotonic() < deadline:
+        if any(c and c[0] == "_result" for c in sess.commands()):
+            break
+        sess.pump(want=len(sess.inbox) + 1, timeout=0.5)
+    results = [c for c in sess.commands() if c[0] == "_result"]
+    if not results or results[0][3].get("code") != \
+            "NetConnection.Connect.Success":
+        raise ConnectionError("rtmp: connect refused")
+    sess.inbox.clear()
+    # chunk sizes are per-direction: announce ours before big sends
+    sess._send_control(MSG_SET_CHUNK_SIZE, struct.pack(">I", OUT_CHUNK))
+    return conn, sess
+
+
+# ---------------------------------------------------------------------------
+# Protocol registration
+# ---------------------------------------------------------------------------
+
+def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
+    service = getattr(getattr(arg, "options", None), "rtmp_service", None)
+    if service is None:
+        return ParseResult.try_others()
+    sess: Optional[RtmpSession] = getattr(sock, "rtmp_session", None)
+    if sess is None:
+        if len(portal) < 1:
+            return ParseResult.not_enough()
+        if portal.copy_to_bytes(1)[0] != 3:
+            return ParseResult.try_others()
+        # claim the connection: RTMP speaks first with exactly 0x03
+        sess = RtmpSession(sock, service)
+        sock.rtmp_session = sess
+    data = bytearray(portal.copy_to_bytes(len(portal)))
+    try:
+        used = sess.consume(data)
+    except ValueError:
+        sess.close()
+        return ParseResult.error_()
+    if used == 0:
+        return ParseResult.not_enough()
+    portal.pop_front(used)
+    return ParseResult.ok(RtmpMessage())
+
+
+register_protocol(Protocol(
+    name="rtmp",
+    type=ProtocolType.RTMP,
+    parse=parse,
+    process_request=None,  # conversation handled inside parse
+    process_response=None,
+    process_inline=True,
+))

@@ -54,6 +54,9 @@ class ServerOptions:
     # server speaks mongo wire protocol when set (MongoServiceAdaptor role,
     # mongo_service_adaptor.h:27)
     mongo_service_adaptor: Optional[object] = None
+    # server speaks RTMP when set (the RtmpService gate; use
+    # rpc.rtmp_protocol.RtmpService() for the publish->play relay hub)
+    rtmp_service: Optional[object] = None
     # server speaks esp when set (our extension; reference is client-only)
     esp_service: Optional[object] = None
     # TLS (ServerSSLOptions role): PEM paths; empty = plaintext

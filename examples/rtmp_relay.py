@@ -1,0 +1,75 @@
+"""rtmp_relay — a live RTMP relay server (publish -> play) with an FLV
+dump, the example/rtmp-family twin: one port accepts RTMP publishers and
+players (and still answers RPC/HTTP/redis/... beside them); media pushed
+by the publisher is relayed live and muxed into an FLV file.
+
+Run: python examples/rtmp_relay.py
+"""
+import io
+import os
+import struct
+import sys
+import time
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+
+from brpc_tpu import rpc  # noqa: E402
+from brpc_tpu.rpc import amf, flv  # noqa: E402
+from brpc_tpu.rpc import rtmp_protocol as rtmp  # noqa: E402
+
+
+def main():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                       rtmp_service=rtmp.RtmpService()))
+    assert srv.start("127.0.0.1:0") == 0
+    port = srv.listen_endpoint.port
+    print(f"rtmp server on rtmp://127.0.0.1:{port}/live")
+
+    # in-process publisher + player on the public client-session API
+    # (a stand-in for OBS + a video player)
+    pconn, pub = rtmp.rtmp_client_connect("127.0.0.1", port)
+    pub.send_command("createStream", 2.0, None)
+    pub.send_command("publish", 3.0, None, "demo", "live", stream_id=1)
+    pub.pump(want=2)
+
+    vconn, ply = rtmp.rtmp_client_connect("127.0.0.1", port)
+    ply.send_command("createStream", 2.0, None)
+    ply.send_command("play", 4.0, None, "demo", stream_id=1)
+    ply.pump(want=1)
+    ply.inbox.clear()
+
+    # publish a tiny synthetic stream
+    pub.send_message(rtmp.MSG_DATA_AMF0, 0,
+                     amf.encode_many("onMetaData",
+                                     {"width": 64.0, "height": 48.0}),
+                     stream_id=1)
+    for i in range(5):
+        payload = b"\x27\x01" + struct.pack(">I", i) + b"frame" * 20
+        pub.send_message(rtmp.MSG_VIDEO, i * 33, payload, stream_id=1)
+
+    ply.pump(want=6)
+    out = io.BytesIO()
+    w = flv.FlvWriter(out, has_audio=False)
+    frames = 0
+    for msg_type, ts, payload in ply.inbox:
+        if msg_type == rtmp.MSG_VIDEO:
+            w.write_video(ts, payload)
+            frames += 1
+        elif msg_type == rtmp.MSG_DATA_AMF0:
+            w.write_metadata(ts, payload)
+    tags = list(flv.read_tags(out.getvalue()))
+    print(f"relayed {frames} video frames; FLV dump = {len(out.getvalue())}"
+          f" bytes, {len(tags)} tags")
+    assert frames >= 5, "relay dropped frames"
+
+    pconn.close()
+    vconn.close()
+    time.sleep(0.1)
+    srv.stop()
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

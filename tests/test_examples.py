@@ -31,6 +31,7 @@ EXAMPLES = [
                                         "--iters", "3"]),
     ("examples/io_uring_echo.py", ["--seconds", "1"]),
     ("examples/native_client.py", []),
+    ("examples/rtmp_relay.py", []),
 ]
 
 

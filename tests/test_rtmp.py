@@ -1,0 +1,295 @@
+"""RTMP family tests — AMF0 codec, FLV muxer, chunk layer, and an
+end-to-end publish->play relay over a real multi-protocol server port
+(the rtmp_protocol.cpp + amf.cpp + rtmp.cpp coverage slots)."""
+import json
+import socket as pysocket
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc import amf, flv
+from brpc_tpu.rpc import rtmp_protocol as rtmp
+from brpc_tpu.rpc.proto import echo_pb2
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+# ---------------------------------------------------------------------------
+# AMF0
+# ---------------------------------------------------------------------------
+
+def test_amf0_roundtrip():
+    values = ["connect", 1.0, {"app": "live", "flashVer": "v1",
+                               "nested": {"a": 2.5, "b": True}},
+              None, [1.0, "two", False], "x" * 70000]
+    blob = amf.encode_many(*values)
+    back = amf.decode_all(blob)
+    assert back == values
+
+
+def test_amf0_ecma_array_and_errors():
+    # ECMA array decodes as a dict (count hint + end marker)
+    blob = bytes([amf.AMF0_ECMA_ARRAY]) + struct.pack(">I", 1)
+    blob += struct.pack(">H", 3) + b"key" + amf.encode(5.0)
+    blob += struct.pack(">H", 0) + bytes([amf.AMF0_OBJECT_END])
+    v, pos = amf.decode(blob)
+    assert v == {"key": 5.0} and pos == len(blob)
+    with pytest.raises(amf.AmfError):
+        amf.decode(b"\x00\x01")  # truncated number
+    with pytest.raises(amf.AmfError):
+        amf.decode(b"\x42")  # unknown marker
+
+
+# ---------------------------------------------------------------------------
+# FLV
+# ---------------------------------------------------------------------------
+
+def test_flv_roundtrip(tmp_path):
+    path = tmp_path / "t.flv"
+    with open(path, "wb") as fp:
+        w = flv.FlvWriter(fp)
+        w.write_metadata(0, amf.encode_many("onMetaData", {"fps": 30.0}))
+        w.write_video(10, b"\x17\x00cfg")
+        w.write_audio(12, b"\xaf\x00cfg")
+        w.write_video(40, b"\x27\x01frame" * 3)
+    data = open(path, "rb").read()
+    assert flv.probe(data) == {"version": 1, "has_audio": True,
+                               "has_video": True}
+    tags = list(flv.read_tags(data))
+    assert [t[0] for t in tags] == [flv.FLV_TAG_SCRIPT, flv.FLV_TAG_VIDEO,
+                                    flv.FLV_TAG_AUDIO, flv.FLV_TAG_VIDEO]
+    assert tags[3][1] == 40 and tags[3][2] == b"\x27\x01frame" * 3
+
+
+# ---------------------------------------------------------------------------
+# live client (the public client-session API doubles as the test client)
+# ---------------------------------------------------------------------------
+
+def _rtmp_connect(port, app="live"):
+    return rtmp.rtmp_client_connect("127.0.0.1", port, app)
+
+
+@pytest.fixture(scope="module")
+def rtmp_server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                       rtmp_service=rtmp.RtmpService()))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def test_rtmp_publish_play_relay(rtmp_server):
+    port = rtmp_server.listen_endpoint.port
+
+    # publisher
+    pconn, pub = _rtmp_connect(port)
+    pub.send_command("createStream", 2.0, None)
+    pub.pump(want=1)
+    assert any(c[0] == "_result" for c in pub.commands())
+    pub.inbox.clear()
+    pub.send_command("publish", 3.0, None, "cam1", "live", stream_id=1)
+    pub.pump(want=1)
+    codes = [c[3]["code"] for c in pub.commands() if c[0] == "onStatus"]
+    assert "NetStream.Publish.Start" in codes
+
+    # publish metadata + an AVC sequence header + a frame BEFORE the
+    # player joins (late-joiner priming must replay them)
+    meta = amf.encode_many("onMetaData", {"width": 64.0, "height": 48.0})
+    pub.send_message(rtmp.MSG_DATA_AMF0, 0, meta, stream_id=1)
+    avc_cfg = b"\x17\x00\x00\x00\x00cfg-bytes"
+    pub.send_message(rtmp.MSG_VIDEO, 0, avc_cfg, stream_id=1)
+    pub.send_message(rtmp.MSG_VIDEO, 33, b"\x27\x01frame-early",
+                     stream_id=1)
+    time.sleep(0.3)  # let the relay ingest before the player joins
+
+    # player joins late
+    vconn, ply = _rtmp_connect(port)
+    ply.send_command("createStream", 2.0, None)
+    ply.send_command("play", 4.0, None, "cam1", stream_id=1)
+    ply.pump(want=4)
+    # priming: cached metadata + AVC header arrive before live frames
+    got_types = [t for t, _, _ in ply.inbox]
+    assert rtmp.MSG_DATA_AMF0 in got_types
+    assert rtmp.MSG_VIDEO in got_types
+    cached_video = [p for t, _, p in ply.inbox if t == rtmp.MSG_VIDEO]
+    assert avc_cfg in cached_video
+    ply.inbox.clear()
+
+    # live frames flow publisher -> player, timestamps preserved
+    frame = b"\x27\x01live-frame-payload" * 40  # multi-chunk (>128B)
+    pub.send_message(rtmp.MSG_VIDEO, 1000, frame, stream_id=1)
+    pub.send_message(rtmp.MSG_AUDIO, 1010, b"\xaf\x01audio", stream_id=1)
+    ply.pump(want=2)
+    vids = [(ts, p) for t, ts, p in ply.inbox if t == rtmp.MSG_VIDEO]
+    auds = [(ts, p) for t, ts, p in ply.inbox if t == rtmp.MSG_AUDIO]
+    assert (1000, frame) in vids
+    assert (1010, b"\xaf\x01audio") in auds
+
+    # FLV interop: the relayed payloads mux straight into FLV tags
+    blob = flv.file_header() + flv.encode_tag(flv.FLV_TAG_VIDEO, 1000,
+                                              frame)
+    tags = list(flv.read_tags(blob))
+    assert tags == [(flv.FLV_TAG_VIDEO, 1000, frame)]
+
+    pconn.close()
+    vconn.close()
+
+
+def test_rtmp_shares_the_port(rtmp_server):
+    """The multi-protocol port keeps answering RPC + HTTP while RTMP
+    sessions run (one-port-all-protocols with rtmp enabled)."""
+    ep = str(rtmp_server.listen_endpoint)
+    ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=5000))
+    assert ch.init(ep) == 0
+    cntl, resp = ch.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message="beside-rtmp"),
+                         echo_pb2.EchoResponse)
+    assert not cntl.failed() and resp.message == "beside-rtmp"
+    ch.close()
+
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1",
+                                      rtmp_server.listen_endpoint.port,
+                                      timeout=5)
+    conn.request("POST", "/EchoService/Echo",
+                 body=json.dumps({"message": "http-beside-rtmp"}),
+                 headers={"Content-Type": "application/json"})
+    assert json.loads(conn.getresponse().read())[
+        "message"] == "http-beside-rtmp"
+    conn.close()
+
+
+def test_rtmp_bad_second_publisher(rtmp_server):
+    port = rtmp_server.listen_endpoint.port
+    c1, s1 = _rtmp_connect(port)
+    s1.send_command("createStream", 2.0, None)
+    s1.send_command("publish", 3.0, None, "solo", "live", stream_id=1)
+    s1.pump(want=2)
+    assert any(c[0] == "onStatus"
+               and c[3]["code"] == "NetStream.Publish.Start"
+               for c in s1.commands())
+    c2, s2 = _rtmp_connect(port)
+    s2.send_command("createStream", 2.0, None)
+    s2.send_command("publish", 3.0, None, "solo", "live", stream_id=1)
+    s2.pump(want=2)
+    codes = [c[3]["code"] for c in s2.commands() if c[0] == "onStatus"]
+    assert "NetStream.Publish.BadName" in codes
+    c1.close()
+    c2.close()
+
+
+def test_rtmp_not_claimed_without_service():
+    """A server WITHOUT rtmp_service must not claim 0x03 bytes — the
+    connection fails as an unknown protocol instead of hanging."""
+    srv = rpc.Server(rpc.ServerOptions(num_threads=2))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        conn = pysocket.create_connection(
+            ("127.0.0.1", srv.listen_endpoint.port), timeout=5)
+        conn.sendall(bytes([3]) + b"\x00" * rtmp.HANDSHAKE_SIZE)
+        conn.settimeout(3)
+        try:
+            data = conn.recv(64)
+        except (TimeoutError, pysocket.timeout):
+            data = b"none"
+        assert data == b"", "connection should be closed, not answered"
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_rtmp_on_native_port():
+    """RTMP rides the native port's raw fallback lane like every other
+    non-tpu_std protocol: the C++ runtime owns the socket, the Python
+    protocol stack runs the session."""
+    from brpc_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                       rtmp_service=rtmp.RtmpService(),
+                                       use_native_runtime=True))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        port = srv.listen_endpoint.port
+        pconn, pub = _rtmp_connect(port)
+        pub.send_command("createStream", 2.0, None)
+        pub.send_command("publish", 3.0, None, "ncam", "live", stream_id=1)
+        pub.pump(want=2)
+        codes = [c[3]["code"] for c in pub.commands()
+                 if c[0] == "onStatus"]
+        assert "NetStream.Publish.Start" in codes
+        vconn, ply = _rtmp_connect(port)
+        ply.send_command("createStream", 2.0, None)
+        ply.send_command("play", 4.0, None, "ncam", stream_id=1)
+        ply.pump(want=1)
+        ply.inbox.clear()
+        pub.send_message(rtmp.MSG_VIDEO, 500, b"\x27\x01native-frame",
+                         stream_id=1)
+        ply.pump(want=1)
+        assert (rtmp.MSG_VIDEO, 500, b"\x27\x01native-frame") in ply.inbox
+        pconn.close()
+        vconn.close()
+    finally:
+        srv.stop()
+
+
+def test_chunk_split_reparse_and_abort():
+    """Regression: a chunk whose header and body arrive in different TCP
+    reads must not double-advance the timestamp on reparse; ABORT must
+    discard its csid's partial message (spec 5.4.2)."""
+    class _Sink:
+        def write(self, buf, id_wait=None):
+            return 0
+
+        def failed(self):
+            return False
+
+    got = []
+
+    class _Collect(rtmp.RtmpSession):
+        def _on_message(self, t, sid, ts, payload):
+            if t in (rtmp.MSG_AUDIO, rtmp.MSG_VIDEO, rtmp.MSG_DATA_AMF0):
+                got.append((t, ts, payload))
+            else:  # control messages (ABORT!) keep their semantics
+                super()._on_message(t, sid, ts, payload)
+
+    sess = _Collect(_Sink(), rtmp.RtmpService())
+    sess.state = sess.ST_ESTABLISHED
+
+    m0 = (bytes([3]) + (1000).to_bytes(3, "big") + (4).to_bytes(3, "big")
+          + bytes([9]) + (1).to_bytes(4, "little") + b"AAAA")
+    m1 = (bytes([(1 << 6) | 3]) + (33).to_bytes(3, "big")
+          + (4).to_bytes(3, "big") + bytes([9]) + b"BBBB")
+    data = bytearray(m0 + m1[:9])  # m1's header arrives; body later
+    used = sess.consume(data)
+    assert used == len(m0)
+    del data[:used]
+    data += m1[9:]
+    sess.consume(data)
+    assert got == [(9, 1000, b"AAAA"), (9, 1033, b"BBBB")], got
+
+    # partial 300-byte message (one 128B chunk lands), then ABORT(csid=3)
+    part = (bytes([3]) + (10).to_bytes(3, "big") + (300).to_bytes(3, "big")
+            + bytes([9]) + (1).to_bytes(4, "little") + b"x" * 128)
+    assert sess.consume(bytearray(part)) == len(part)
+    abort = (bytes([2]) + (0).to_bytes(3, "big") + (4).to_bytes(3, "big")
+             + bytes([2]) + (0).to_bytes(4, "little")
+             + (3).to_bytes(4, "big"))
+    sess.consume(bytearray(abort))
+    fresh = (bytes([3]) + (2000).to_bytes(3, "big") + (2).to_bytes(3, "big")
+             + bytes([9]) + (1).to_bytes(4, "little") + b"ZZ")
+    sess.consume(bytearray(fresh))
+    assert got[-1] == (9, 2000, b"ZZ")
