@@ -92,17 +92,18 @@ class RtmpService:
         self._streams: Dict[str, _LiveStream] = {}
         self._lock = threading.Lock()
 
-    def _stream(self, name: str) -> _LiveStream:
-        with self._lock:
-            st = self._streams.get(name)
-            if st is None:
-                st = _LiveStream(name)
-                self._streams[name] = st
-            return st
+    def _stream_locked(self, name: str) -> _LiveStream:
+        # caller holds self._lock (get-or-create and mutation must share
+        # ONE acquisition, or drop()'s reaping can orphan the object)
+        st = self._streams.get(name)
+        if st is None:
+            st = _LiveStream(name)
+            self._streams[name] = st
+        return st
 
     def on_publish(self, name: str, sess: "RtmpSession") -> bool:
-        st = self._stream(name)
         with self._lock:
+            st = self._stream_locked(name)
             cur = st.publisher
             if cur is not None and cur is not sess:
                 # a dead publisher's socket releases the name (the
@@ -113,30 +114,42 @@ class RtmpService:
             st.publisher = sess
         return True
 
-    def on_play(self, name: str, sess: "RtmpSession") -> List[tuple]:
-        """Registers the player; returns cached priming messages
-        [(type, payload), ...] to send before live data. A re-issued
-        play (reconnects/seeks do this) moves the player, never
-        duplicates it."""
-        st = self._stream(name)
-        prime = []
+    def release_publisher(self, name: str, sess: "RtmpSession"):
+        """Frees the name (FCUnpublish / re-publish of another name) so
+        other publishers can take it while this session lives."""
         with self._lock:
+            st = self._streams.get(name)
+            if st is not None and st.publisher is sess:
+                st.publisher = None
+                if not st.players:
+                    del self._streams[name]
+
+    def on_play(self, name: str, sess: "RtmpSession"):
+        """Registers the player AND sends the cached priming messages
+        (metadata + codec sequence headers) inside the same critical
+        section — a concurrent relay can therefore never deliver a live
+        frame ahead of the headers a decoder needs. A re-issued play
+        moves the player, never duplicates it."""
+        with self._lock:
+            st = self._stream_locked(name)
             for other in self._streams.values():
                 if other is not st and sess in other.players:
                     other.players.remove(sess)
             if sess not in st.players:
                 st.players.append(sess)
             if st.metadata is not None:
-                prime.append((MSG_DATA_AMF0, st.metadata))
+                sess.send_message(MSG_DATA_AMF0, 0, st.metadata,
+                                  stream_id=1)
             if st.avc_seq_header is not None:
-                prime.append((MSG_VIDEO, st.avc_seq_header))
+                sess.send_message(MSG_VIDEO, 0, st.avc_seq_header,
+                                  stream_id=1)
             if st.aac_seq_header is not None:
-                prime.append((MSG_AUDIO, st.aac_seq_header))
-        return prime
+                sess.send_message(MSG_AUDIO, 0, st.aac_seq_header,
+                                  stream_id=1)
 
     def on_media(self, name: str, msg_type: int, ts: int, payload: bytes):
-        st = self._stream(name)
         with self._lock:
+            st = self._stream_locked(name)
             # cache what a late joiner needs (rtmp.cpp's header caching)
             if msg_type == MSG_DATA_AMF0:
                 st.metadata = payload
@@ -320,7 +333,6 @@ class RtmpSession:
             st.length = int.from_bytes(data[pos + 3:pos + 6], "big")
             st.msg_type = data[pos + 6]
             st.stream_id = int.from_bytes(data[pos + 7:pos + 11], "little")
-            st.delta = 0
             pos += 11
         elif fmt == 1:
             ts_field = int.from_bytes(data[pos:pos + 3], "big")
@@ -364,6 +376,11 @@ class RtmpSession:
             return 0  # incomplete: NO state committed — a reparse after
                       # more bytes arrive must not double-advance the ts
         st.timestamp = new_ts
+        if fmt == 0 and not continuation:
+            # spec 5.3.1.2.4 / reference rtmp_protocol.cpp:1457: fmt0's
+            # absolute timestamp becomes the delta a following fmt3
+            # NEW message advances by
+            st.delta = ext if st.has_ext_ts else ts_field
         st.buf += data[pos:pos + take]
         pos += take
         if len(st.buf) >= st.length:
@@ -427,12 +444,17 @@ class RtmpSession:
             self.send_command("_result", txn, None, 1.0)
         elif cmd in ("releaseStream", "FCPublish", "FCUnpublish",
                      "getStreamLength"):
+            if cmd == "FCUnpublish" and self.publishing is not None:
+                self.service.release_publisher(self.publishing, self)
+                self.publishing = None
             self.send_command("_result", txn, None, None)
         elif cmd == "publish":
             name = values[3] if len(values) > 3 else ""
             if not isinstance(name, str) or not name:
                 raise ValueError("rtmp: publish without a stream name")
             name = name.split("?")[0]
+            if self.publishing is not None and self.publishing != name:
+                self.service.release_publisher(self.publishing, self)
             if not self.service.on_publish(name, self):
                 self.send_onstatus("NetStream.Publish.BadName",
                                    level="error")
@@ -450,8 +472,7 @@ class RtmpSession:
             self.send_onstatus("NetStream.Play.Reset")
             self.send_onstatus("NetStream.Play.Start")
             self.playing = name
-            for mtype, cached in self.service.on_play(name, self):
-                self.send_message(mtype, 0, cached, stream_id=1)
+            self.service.on_play(name, self)
         elif cmd in ("deleteStream", "closeStream"):
             self.close()
 
@@ -518,6 +539,16 @@ class RtmpClientSession(RtmpSession):
             self.feed(data)
         return self.inbox
 
+    def pump_until(self, pred, timeout: float = 5.0):
+        """Reads until pred(self) is true (robust against arbitrary
+        recv segmentation, unlike fixed message counts)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while not pred(self) and _time.monotonic() < deadline:
+            self.pump(want=len(self.inbox) + 1, timeout=0.3)
+        return pred(self)
+
     def commands(self):
         return [amf.decode_all(p) for t, _, p in self.inbox
                 if t == MSG_COMMAND_AMF0]
@@ -575,16 +606,23 @@ def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
             return ParseResult.try_others()
         # claim the connection: RTMP speaks first with exactly 0x03
         sess = RtmpSession(sock, service)
+        sess.pending = bytearray()
         sock.rtmp_session = sess
-    data = bytearray(portal.copy_to_bytes(len(portal)))
+    # drain the portal into the session ONCE per byte (re-copying the
+    # whole accumulating buffer per parse would be quadratic on large
+    # messages); leftovers persist in sess.pending between reads
+    n = len(portal)
+    if n:
+        sess.pending += portal.copy_to_bytes(n)
+        portal.pop_front(n)
     try:
-        used = sess.consume(data)
+        used = sess.consume(sess.pending)
     except ValueError:
         sess.close()
         return ParseResult.error_()
     if used == 0:
         return ParseResult.not_enough()
-    portal.pop_front(used)
+    del sess.pending[:used]
     return ParseResult.ok(RtmpMessage())
 
 

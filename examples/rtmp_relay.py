@@ -48,7 +48,9 @@ def main():
         payload = b"\x27\x01" + struct.pack(">I", i) + b"frame" * 20
         pub.send_message(rtmp.MSG_VIDEO, i * 33, payload, stream_id=1)
 
-    ply.pump(want=6)
+    ply.pump_until(
+        lambda s: sum(1 for t, _, _ in s.inbox
+                      if t == rtmp.MSG_VIDEO) >= 5)
     out = io.BytesIO()
     w = flv.FlvWriter(out, has_audio=False)
     frames = 0

@@ -115,20 +115,20 @@ def test_rtmp_publish_play_relay(rtmp_server):
     vconn, ply = _rtmp_connect(port)
     ply.send_command("createStream", 2.0, None)
     ply.send_command("play", 4.0, None, "cam1", stream_id=1)
-    ply.pump(want=4)
     # priming: cached metadata + AVC header arrive before live frames
-    got_types = [t for t, _, _ in ply.inbox]
-    assert rtmp.MSG_DATA_AMF0 in got_types
-    assert rtmp.MSG_VIDEO in got_types
-    cached_video = [p for t, _, p in ply.inbox if t == rtmp.MSG_VIDEO]
-    assert avc_cfg in cached_video
+    assert ply.pump_until(
+        lambda s: any(t == rtmp.MSG_DATA_AMF0 for t, _, _ in s.inbox)
+        and any(p == avc_cfg for t, _, p in s.inbox
+                if t == rtmp.MSG_VIDEO)), ply.inbox
     ply.inbox.clear()
 
     # live frames flow publisher -> player, timestamps preserved
     frame = b"\x27\x01live-frame-payload" * 40  # multi-chunk (>128B)
     pub.send_message(rtmp.MSG_VIDEO, 1000, frame, stream_id=1)
     pub.send_message(rtmp.MSG_AUDIO, 1010, b"\xaf\x01audio", stream_id=1)
-    ply.pump(want=2)
+    assert ply.pump_until(
+        lambda s: any(t == rtmp.MSG_VIDEO for t, _, _ in s.inbox)
+        and any(t == rtmp.MSG_AUDIO for t, _, _ in s.inbox)), ply.inbox
     vids = [(ts, p) for t, ts, p in ply.inbox if t == rtmp.MSG_VIDEO]
     auds = [(ts, p) for t, ts, p in ply.inbox if t == rtmp.MSG_AUDIO]
     assert (1000, frame) in vids
@@ -174,16 +174,17 @@ def test_rtmp_bad_second_publisher(rtmp_server):
     c1, s1 = _rtmp_connect(port)
     s1.send_command("createStream", 2.0, None)
     s1.send_command("publish", 3.0, None, "solo", "live", stream_id=1)
-    s1.pump(want=2)
-    assert any(c[0] == "onStatus"
-               and c[3]["code"] == "NetStream.Publish.Start"
-               for c in s1.commands())
+    assert s1.pump_until(
+        lambda s: any(c[0] == "onStatus"
+                      and c[3]["code"] == "NetStream.Publish.Start"
+                      for c in s.commands()))
     c2, s2 = _rtmp_connect(port)
     s2.send_command("createStream", 2.0, None)
     s2.send_command("publish", 3.0, None, "solo", "live", stream_id=1)
-    s2.pump(want=2)
-    codes = [c[3]["code"] for c in s2.commands() if c[0] == "onStatus"]
-    assert "NetStream.Publish.BadName" in codes
+    assert s2.pump_until(
+        lambda s: any(c[0] == "onStatus"
+                      and c[3]["code"] == "NetStream.Publish.BadName"
+                      for c in s.commands()))
     c1.close()
     c2.close()
 
@@ -227,10 +228,10 @@ def test_rtmp_on_native_port():
         pconn, pub = _rtmp_connect(port)
         pub.send_command("createStream", 2.0, None)
         pub.send_command("publish", 3.0, None, "ncam", "live", stream_id=1)
-        pub.pump(want=2)
-        codes = [c[3]["code"] for c in pub.commands()
-                 if c[0] == "onStatus"]
-        assert "NetStream.Publish.Start" in codes
+        assert pub.pump_until(
+            lambda s: any(c[0] == "onStatus" and
+                          c[3]["code"] == "NetStream.Publish.Start"
+                          for c in s.commands()))
         vconn, ply = _rtmp_connect(port)
         ply.send_command("createStream", 2.0, None)
         ply.send_command("play", 4.0, None, "ncam", stream_id=1)
@@ -238,8 +239,9 @@ def test_rtmp_on_native_port():
         ply.inbox.clear()
         pub.send_message(rtmp.MSG_VIDEO, 500, b"\x27\x01native-frame",
                          stream_id=1)
-        ply.pump(want=1)
-        assert (rtmp.MSG_VIDEO, 500, b"\x27\x01native-frame") in ply.inbox
+        assert ply.pump_until(
+            lambda s: (rtmp.MSG_VIDEO, 500, b"\x27\x01native-frame")
+            in s.inbox), ply.inbox
         pconn.close()
         vconn.close()
     finally:

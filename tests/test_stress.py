@@ -116,11 +116,20 @@ def test_mixed_protocol_storm():
                                           srv.listen_endpoint.port,
                                           timeout=10)
         while not stop.is_set():
-            conn.request("POST", "/EchoService/Echo",
-                         body=json.dumps({"message": f"h{i}"}),
-                         headers={"Content-Type": "application/json"})
-            r = conn.getresponse()
-            body = r.read()
+            try:
+                conn.request("POST", "/EchoService/Echo",
+                             body=json.dumps({"message": f"h{i}"}),
+                             headers={"Content-Type": "application/json"})
+                r = conn.getresponse()
+                body = r.read()
+            except (http.client.RemoteDisconnected, ConnectionError,
+                    TimeoutError):
+                # a storm harness reconnects (keep-alive may drop under
+                # contention); liveness is asserted by the ok counts
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.listen_endpoint.port, timeout=10)
+                continue
             record("http", r.status == 200
                    and json.loads(body)["message"] == f"h{i}")
             i += 1
