@@ -138,8 +138,12 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
         native.rpc_server_stop()
 
     # the io_uring lane (RingListener: provided-buffer recvs +
-    # fixed-buffer sends), when the kernel allows it
+    # fixed-buffer sends, poller-inline drains), when the kernel allows
+    # it — measured with both client shapes (sync fibers and the async
+    # window)
     ring_qps = 0.0
+    ring_async_qps = 0.0
+    ring_async_requests = 0
     try:
         if native.use_io_uring(True) == 1:
             port_r = native.rpc_server_start(native_echo=True)
@@ -149,6 +153,13 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
                     fibers_per_conn=fibers_per_conn,
                     seconds=seconds, payload=payload)
                 ring_qps = ring["qps"]
+                import ctypes
+
+                out_r = ctypes.c_uint64(0)
+                ring_async_qps = native.load().nat_rpc_client_bench_async(
+                    b"127.0.0.1", port_r, nconn, 256,
+                    max(1.0, seconds / 2), payload, ctypes.byref(out_r))
+                ring_async_requests = out_r.value
             finally:
                 native.rpc_server_stop()
     except Exception:
@@ -218,6 +229,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     lanes = {"epoll": (fw["qps"], fw["requests"]),
              "io_uring": (ring_qps,
                           ring["requests"] if ring_qps > 0 else 0),
+             "io_uring_async": (ring_async_qps, ring_async_requests),
              "async_windowed": (async_qps, async_requests)}
     lane = max(lanes, key=lambda k: lanes[k][0])
     qps, requests = lanes[lane]
@@ -226,6 +238,8 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     # window per connection with no per-call fiber)
     lane_config = {"epoll": f"{fibers_per_conn} sync fibers/conn",
                    "io_uring": f"{fibers_per_conn} sync fibers/conn",
+                   "io_uring_async":
+                       f"{nconn}conn, window=256/conn, done-callbacks",
                    "async_windowed":
                        f"{async_shape}, window=256/conn, done-callbacks"}
     return {
@@ -241,6 +255,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             "lane_client_shape": lane_config[lane],
             "epoll_qps": round(fw["qps"], 1),
             "io_uring_qps": round(ring_qps, 1),
+            "io_uring_async_qps": round(ring_async_qps, 1),
             "async_windowed_qps": round(async_qps, 1),
             "python_framework_qps": round(python_qps, 1),
             "bypass_ceiling_qps": round(bypass_qps, 1),

@@ -220,6 +220,9 @@ class RtmpSession:
         self.csid_state: Dict[int, _CsidState] = {}
         self.publishing: Optional[str] = None
         self.playing: Optional[str] = None
+        # accumulate-consume-trim input buffer (server parse + client
+        # feed share it): bytes enter exactly once, leftovers persist
+        self.pending = bytearray()
         self._wlock = threading.Lock()  # relay writers vs command replies
         _rtmp_sessions.update(1)
 
@@ -271,6 +274,15 @@ class RtmpSession:
                           stream_id=stream_id, csid=5)
 
     # -- inbound -----------------------------------------------------------
+    def feed_bytes(self, data: bytes) -> bool:
+        """Append new bytes and consume what's complete; True when any
+        handshake/chunk unit was processed."""
+        self.pending += data
+        used = self.consume(self.pending)
+        if used:
+            del self.pending[:used]
+        return used > 0
+
     def consume(self, data: bytearray) -> int:
         """Eats as many complete handshake/chunk units as possible from
         the front of `data`; returns bytes consumed. Raises on protocol
@@ -444,7 +456,13 @@ class RtmpSession:
             self.send_command("_result", txn, None, 1.0)
         elif cmd in ("releaseStream", "FCPublish", "FCUnpublish",
                      "getStreamLength"):
-            if cmd == "FCUnpublish" and self.publishing is not None:
+            uname = values[3] if len(values) > 3 else None
+            if isinstance(uname, str):
+                uname = uname.split("?")[0]
+            if (cmd == "FCUnpublish" and self.publishing is not None
+                    and (uname is None or uname == self.publishing)):
+                # a mismatched name (stale FCUnpublish mid-switch) must
+                # NOT tear down the live stream
                 self.service.release_publisher(self.publishing, self)
                 self.publishing = None
             self.send_command("_result", txn, None, None)
@@ -453,12 +471,14 @@ class RtmpSession:
             if not isinstance(name, str) or not name:
                 raise ValueError("rtmp: publish without a stream name")
             name = name.split("?")[0]
-            if self.publishing is not None and self.publishing != name:
-                self.service.release_publisher(self.publishing, self)
             if not self.service.on_publish(name, self):
                 self.send_onstatus("NetStream.Publish.BadName",
                                    level="error")
-                return
+                return  # keep publishing the OLD name; nothing released
+            if self.publishing is not None and self.publishing != name:
+                # release only after the new claim succeeded, and forget
+                # the old name so media can't route to a freed stream
+                self.service.release_publisher(self.publishing, self)
             self.publishing = name
             self.send_onstatus("NetStream.Publish.Start")
         elif cmd == "play":
@@ -509,7 +529,6 @@ class RtmpClientSession(RtmpSession):
         self.conn = conn
         self.state = self.ST_ESTABLISHED
         self.inbox: List[tuple] = []
-        self._pending = bytearray()
 
     def _on_message(self, msg_type, stream_id, ts, payload):
         if msg_type == MSG_SET_CHUNK_SIZE and len(payload) >= 4:
@@ -518,9 +537,7 @@ class RtmpClientSession(RtmpSession):
         self.inbox.append((msg_type, ts, payload))
 
     def feed(self, data: bytes):
-        self._pending += data
-        used = self.consume(self._pending)
-        del self._pending[:used]
+        self.feed_bytes(data)
 
     def pump(self, want: int = 1, timeout: float = 5.0):
         """Reads the socket until `want` messages are buffered."""
@@ -606,23 +623,21 @@ def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
             return ParseResult.try_others()
         # claim the connection: RTMP speaks first with exactly 0x03
         sess = RtmpSession(sock, service)
-        sess.pending = bytearray()
         sock.rtmp_session = sess
     # drain the portal into the session ONCE per byte (re-copying the
     # whole accumulating buffer per parse would be quadratic on large
     # messages); leftovers persist in sess.pending between reads
     n = len(portal)
+    data = portal.copy_to_bytes(n) if n else b""
     if n:
-        sess.pending += portal.copy_to_bytes(n)
         portal.pop_front(n)
     try:
-        used = sess.consume(sess.pending)
+        progressed = sess.feed_bytes(data)
     except ValueError:
         sess.close()
         return ParseResult.error_()
-    if used == 0:
+    if not progressed:
         return ParseResult.not_enough()
-    del sess.pending[:used]
     return ParseResult.ok(RtmpMessage())
 
 

@@ -2476,6 +2476,19 @@ int nat_rpc_use_io_uring(int enable) {
       // wake a parked worker per completion batch (ExtWakeup role);
       // installed before init() so the poller never runs without it
       ring->set_wake_fn([] { Scheduler::instance()->wake_one(); });
+      // the poller drains its own harvest inline (every completion
+      // consumer is non-blocking), with butex wakes batched per drain —
+      // the worker idle hook below stays as a backup drain path
+      ring->set_drain_fn([]() -> bool {
+        static thread_local std::vector<Fiber*> batch;
+        if (g_ring_draining.load(std::memory_order_acquire)) {
+          return false;  // a worker holds the baton: let the poller
+        }                // wake one instead of silently dropping
+        Scheduler::instance()->arm_wake_batch(&batch);
+        bool did = ring_drain();
+        Scheduler::instance()->flush_wake_batch();
+        return did;
+      });
       if (!ring->init()) {
         delete ring;
         return 0;  // io_uring unavailable here: keep epoll

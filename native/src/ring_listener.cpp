@@ -393,7 +393,15 @@ void RingListener::poller_loop() {
       }
     }
     cq_head_->store(head, std::memory_order_release);
-    if (got && wake_fn_) wake_fn_();  // unpark a worker to drain
+    if (got) {
+      bool drained = false;
+      if (drain_fn_) {
+        drained = drain_fn_();  // inline on the poller (no handoff)
+      }
+      if (!drained && wake_fn_) {
+        wake_fn_();  // skipped/unset: unpark a worker to drain
+      }
+    }
   }
 }
 

@@ -114,6 +114,15 @@ class RingListener {
   // wait out a park timeout.
   void set_wake_fn(std::function<void()> fn) { wake_fn_ = std::move(fn); }
 
+  // When set, the POLLER ITSELF runs this after harvesting completions
+  // (the inline-drain discipline the epoll dispatcher uses: every
+  // consumer of a completion is non-blocking, so handing the batch to a
+  // parked worker only added wake latency). Must return false when the
+  // drain was SKIPPED (another drainer holds the baton) — the poller
+  // then falls back to waking a worker so the harvest can't stall out a
+  // full park timeout. Worker idle hooks still drain as a backup.
+  void set_drain_fn(std::function<bool()> fn) { drain_fn_ = std::move(fn); }
+
   // Pops one harvested completion; the scheduler idle hook loops this
   // (the wait_task drain, task_group.cpp:158-169).
   bool pop_completion(RingCompletion* out) {
@@ -188,6 +197,7 @@ class RingListener {
   std::vector<int> free_files_;    // recycled slots
   std::vector<uint32_t> file_gen_;  // slot generation (bumped on unregister)
   std::function<void()> wake_fn_;
+  std::function<bool()> drain_fn_;
   unsigned unsubmitted_ = 0;  // SQEs published but not yet accepted
 };
 
