@@ -127,7 +127,17 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
 
     Extra fields report the pure-Python stack and the raw epoll bypass
     (ceiling probe, echo_runtime.cpp) honestly alongside."""
+    import ctypes
+
     from brpc_tpu import native
+
+    def _async_lane(port_, conns, window=256):
+        """One async-windowed measurement; (qps, requests)."""
+        out = ctypes.c_uint64(0)
+        q = native.load().nat_rpc_client_bench_async(
+            b"127.0.0.1", port_, conns, window, max(1.0, seconds / 2),
+            payload, ctypes.byref(out))
+        return q, out.value
 
     port = native.rpc_server_start(native_echo=True)
     try:
@@ -153,13 +163,8 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
                     fibers_per_conn=fibers_per_conn,
                     seconds=seconds, payload=payload)
                 ring_qps = ring["qps"]
-                import ctypes
-
-                out_r = ctypes.c_uint64(0)
-                ring_async_qps = native.load().nat_rpc_client_bench_async(
-                    b"127.0.0.1", port_r, nconn, 256,
-                    max(1.0, seconds / 2), payload, ctypes.byref(out_r))
-                ring_async_requests = out_r.value
+                ring_async_qps, ring_async_requests = _async_lane(
+                    port_r, nconn)
             finally:
                 native.rpc_server_stop()
     except Exception:
@@ -192,18 +197,13 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     async_requests = 0
     async_shape = f"{nconn}conn"
     try:
-        import ctypes
-
         port3 = native.rpc_server_start(native_echo=True)
         try:
             for shape_conns in (nconn, nconn * 2):
-                out = ctypes.c_uint64(0)
-                q = native.load().nat_rpc_client_bench_async(
-                    b"127.0.0.1", port3, shape_conns, 256,
-                    max(1.0, seconds / 2), payload, ctypes.byref(out))
+                q, reqs = _async_lane(port3, shape_conns)
                 if q > async_qps:
                     async_qps = q
-                    async_requests = out.value
+                    async_requests = reqs
                     async_shape = f"{shape_conns}conn"
         finally:
             native.rpc_server_stop()

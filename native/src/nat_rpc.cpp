@@ -1330,6 +1330,25 @@ static bool ring_drain() {
   return did;
 }
 
+
+// Put a freshly-connected fd on the ring lane when it is enabled (both
+// directions then ride io_uring and drain on the poller — the accept
+// path's twin). Returns true when the ring owns the socket's reads.
+static bool try_ring_adopt(NatSocket* s) {
+  if (!g_use_ring.load(std::memory_order_acquire) || g_ring == nullptr) {
+    return false;
+  }
+  uint32_t gen = 0;
+  int fidx = g_ring->register_file(s->fd, &gen);
+  if (fidx < 0) return false;
+  int64_t rr = ((int64_t)gen << 32) | (uint32_t)fidx;
+  s->ring_ref.store(rr, std::memory_order_release);
+  if (g_ring->rearm_recv(fidx, gen, s->id)) return true;
+  s->ring_ref.store(-1, std::memory_order_release);
+  g_ring->unregister_file(fidx);
+  return false;
+}
+
 void Dispatcher::accept_loop(int lfd, NatServer* srv) {
   while (true) {
     int cfd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
@@ -1346,21 +1365,7 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
     s->server = srv;
     srv->add_ref();  // released when the socket slot is recycled
     srv->connections.fetch_add(1);
-    if (g_use_ring.load(std::memory_order_acquire) && g_ring != nullptr) {
-      // publish the file index BEFORE arming recv: the first completion
-      // can fire the instant the recv is armed
-      uint32_t gen = 0;
-      int fidx = g_ring->register_file(cfd, &gen);
-      if (fidx >= 0) {
-        int64_t rr = ((int64_t)gen << 32) | (uint32_t)fidx;
-        s->ring_ref.store(rr, std::memory_order_release);
-        if (g_ring->rearm_recv(fidx, gen, s->id)) {
-          continue;  // the ring owns this read path
-        }
-        s->ring_ref.store(-1, std::memory_order_release);
-        g_ring->unregister_file(fidx);
-      }
-    }
+    if (try_ring_adopt(s)) continue;  // the ring owns this read path
     s->disp->add_consumer(s);
   }
 }
@@ -1828,7 +1833,7 @@ static NatSocket* channel_socket(NatChannel* ch, int max_dial_ms = 0) {
   ch->sock_id.store(ns->id, std::memory_order_release);
   ns->add_ref();  // the caller's borrowed reference, taken BEFORE epoll
                   // can fail the socket
-  ns->disp->add_consumer(ns);
+  ns->disp->add_consumer(ns);  // client sockets stay on epoll (above)
   return ns;
 }
 
@@ -1925,6 +1930,9 @@ void* nat_channel_open(const char* ip, int port, int nworkers,
   ch->add_ref();  // the socket's reference, dropped in NatSocket::release
   s->defer_writes = (batch_writes != 0);
   ch->sock_id.store(s->id, std::memory_order_release);
+  // NOT ring-adopted: measured slower for clients — the one-in-flight
+  // fixed-send discipline throttles request pipelining, while the epoll
+  // lane's writer fiber flushes the whole queue per writev
   s->disp->add_consumer(s);
   return ch;
 }
