@@ -295,3 +295,57 @@ def test_chunk_split_reparse_and_abort():
              + bytes([9]) + (1).to_bytes(4, "little") + b"ZZ")
     sess.consume(bytearray(fresh))
     assert got[-1] == (9, 2000, b"ZZ")
+
+
+def test_mpegts_roundtrip():
+    """TS muxer/demuxer (the ts.h role): PES packetization with PTS,
+    multi-packet payloads, adaptation-field stuffing, PSI tables with
+    valid MPEG CRC32 — and the RTMP->FLV->TS pipeline shape."""
+    from brpc_tpu.rpc import mpegts
+
+    mux = mpegts.TsMuxer(has_audio=True)
+    video1 = b"\x00\x00\x00\x01\x65" + bytes(range(256)) * 3  # ~770B, 5 pkts
+    video2 = b"\x00\x00\x00\x01\x41" + b"delta-frame"
+    audio1 = b"\xff\xf1AAC-frame-bytes"
+    mux.write_video(0, video1, keyframe=True)
+    mux.write_audio(23, audio1)
+    mux.write_video(33, video2)
+    data = mux.packets()
+    assert len(data) % mpegts.TS_PACKET == 0
+    assert all(data[i] == mpegts.SYNC
+               for i in range(0, len(data), mpegts.TS_PACKET))
+
+    got = list(mpegts.demux(data))
+    vids = [(pts, es) for pid, pts, es in got if pid == mpegts.PID_VIDEO]
+    auds = [(pts, es) for pid, pts, es in got if pid == mpegts.PID_AUDIO]
+    assert vids == [(0, video1), (33, video2)]
+    assert auds == [(23, audio1)]
+
+    # the PSI tables carry valid MPEG CRCs (a set-top demuxer rejects
+    # tables whose CRC fails — CRC over table_id..body must equal the
+    # trailing 4 bytes)
+    pat = mpegts._pat_table()
+    assert mpegts._crc32_mpeg(pat[:-4]) == int.from_bytes(pat[-4:], "big")
+    pmt = mpegts._pmt_table(True)
+    assert mpegts._crc32_mpeg(pmt[:-4]) == int.from_bytes(pmt[-4:], "big")
+
+    # sync loss raises rather than desyncing silently
+    with pytest.raises(ValueError):
+        list(mpegts.demux(b"\x00" * mpegts.TS_PACKET))
+
+
+def test_mpegts_error_contract():
+    """PAT layout is the 4-byte program-entry form; oversized audio and
+    truncated streams fail with ValueError, never struct/Index errors."""
+    from brpc_tpu.rpc import mpegts
+
+    pat = mpegts._pat_table()
+    # program entry = program_number(2) + reserved|PMT PID(2)
+    assert pat[8:12] == bytes([0, 1]) + bytes([0xF0, 0x00])
+    mux = mpegts.TsMuxer()
+    with pytest.raises(ValueError, match="audio"):
+        mux.write_audio(0, b"a" * 70000)
+    mux.write_video(0, b"v" * 70000)  # unbounded video PES is legal
+    data = mux.packets()
+    with pytest.raises(ValueError, match="truncated"):
+        list(mpegts.demux(data[:-7]))
