@@ -110,7 +110,8 @@ class TsMuxer:
                                     PID_VIDEO: 0, PID_AUDIO: 0}
         self._out: List[bytes] = []
         self.has_audio = has_audio
-        self.write_psi()
+        self._pcr_sent = False  # PMT advertises PCR on the video PID:
+        self.write_psi()        # at least one PCR must actually appear
 
     def write_psi(self):
         self._out.append(_psi_packet(PID_PAT, _pat_table(),
@@ -162,7 +163,12 @@ class TsMuxer:
             first = False
 
     def write_video(self, pts_ms: int, es: bytes, keyframe: bool = False):
-        self._emit_pes(PID_VIDEO, PES_SID_VIDEO, pts_ms, es, pcr=keyframe)
+        # the first access unit always carries a PCR (consumers cannot
+        # establish a clock from a PCR-less stream, TR 101 290), then
+        # keyframes refresh it
+        pcr = keyframe or not self._pcr_sent
+        self._pcr_sent = True
+        self._emit_pes(PID_VIDEO, PES_SID_VIDEO, pts_ms, es, pcr=pcr)
 
     def write_audio(self, pts_ms: int, es: bytes):
         if not self.has_audio:
@@ -215,6 +221,8 @@ def _finish_pes(pid: int, pes: bytes) -> Tuple[int, Optional[int], bytes]:
         raise ValueError("ts: bad PES start code")
     flags = pes[7]
     hlen = pes[8]
+    if len(pes) < 9 + hlen or (flags & 0x80 and hlen < 5):
+        raise ValueError("ts: truncated PES optional header")
     pts_ms = None
     if flags & 0x80:
         p = pes[9:14]

@@ -98,3 +98,36 @@ def test_repeated_requires_array():
     with pytest.raises(json2pb.ParseError, match="array"):
         json2pb.json_to_pb('{"tensors": {"nbytes": 1}}',
                            rpc_meta_pb2.RpcMeta)
+
+
+def test_fuzz_never_escapes_parse_error():
+    """Adversarial JSON shapes must surface as ParseError (HTTP answers
+    400), never as raw TypeError/ValueError/struct errors."""
+    import random
+
+    rng = random.Random(7)
+    shapes = [
+        '{"code": {}}', '{"code": []}', '{"code": [1]}',
+        '{"message": 5}', '{"message": {}}', '{"message": null, "code": null}',
+        '{"code": 1e999}', '{"code": -1e999}', '{"code": "0x10"}',
+        '{"code": true}', '[1,2,3]', '"just a string"', '5', 'true',
+        '{"tensors": [null]}', '{"tensors": [[]]}',
+        '{"request": []}', '{"request": 5}',
+        '{"correlation_id": 1.5}', '{"correlation_id": "1.5"}',
+        '{"correlation_id": ' + "9" * 40 + '}',
+    ]
+    for text in shapes:
+        msg = rpc_meta_pb2.RpcMeta()
+        try:
+            json2pb.json_to_pb_inplace(text, msg)
+        except json2pb.ParseError:
+            pass  # also acceptable from the raising variant
+        try:
+            json2pb.json_to_pb(text, echo_pb2.EchoRequest)
+        except json2pb.ParseError:
+            pass
+    # random byte soup through the tolerant entry point
+    for _ in range(200):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+        msg = echo_pb2.EchoRequest()
+        json2pb.json_to_pb_inplace(blob.decode("latin-1"), msg)

@@ -349,3 +349,59 @@ def test_mpegts_error_contract():
     data = mux.packets()
     with pytest.raises(ValueError, match="truncated"):
         list(mpegts.demux(data[:-7]))
+
+
+def test_amf0_fuzz_never_crashes():
+    """Random bytes through the AMF0 decoder: AmfError or a value, never
+    an uncontrolled exception (the command path feeds it wire bytes)."""
+    import random
+
+    rng = random.Random(11)
+    for _ in range(500):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randrange(48)))
+        try:
+            amf.decode_all(blob)
+        except amf.AmfError:
+            pass
+
+
+def test_mpegts_demux_fuzz():
+    """Packet-aligned random bytes: ValueError or clean output, never
+    Index/struct errors."""
+    import random
+
+    from brpc_tpu.rpc import mpegts
+
+    rng = random.Random(13)
+    for _ in range(100):
+        npkts = rng.randrange(1, 5)
+        blob = bytearray(rng.randrange(256)
+                         for _ in range(npkts * mpegts.TS_PACKET))
+        if rng.random() < 0.7:
+            for i in range(npkts):  # valid sync most of the time
+                blob[i * mpegts.TS_PACKET] = mpegts.SYNC
+        try:
+            list(mpegts.demux(bytes(blob)))
+        except ValueError:
+            pass
+
+
+def test_mpegts_pcr_and_truncated_pes():
+    from brpc_tpu.rpc import mpegts
+
+    # a stream written WITHOUT keyframe flags still carries a PCR
+    mux = mpegts.TsMuxer(has_audio=False)
+    mux.write_video(0, b"frame-a")
+    mux.write_video(33, b"frame-b")
+    data = mux.packets()
+    pcr_seen = False
+    for off in range(0, len(data), mpegts.TS_PACKET):
+        pkt = data[off:off + mpegts.TS_PACKET]
+        if (pkt[3] >> 4) & 0x2 and pkt[4] > 0 and pkt[5] & 0x10:
+            pcr_seen = True
+    assert pcr_seen, "PMT advertises PCR but none was emitted"
+
+    # PTS flag set with the PTS bytes missing -> ValueError, not IndexError
+    with pytest.raises(ValueError, match="truncated"):
+        mpegts._finish_pes(mpegts.PID_VIDEO,
+                           b"\x00\x00\x01\xe0\x00\x00\x80\x80\x05")
