@@ -65,6 +65,18 @@ def main():
           f" bytes, {len(tags)} tags")
     assert frames >= 5, "relay dropped frames"
 
+    # ...and the same payloads packetize into an HLS-style TS segment
+    from brpc_tpu.rpc import mpegts
+
+    ts = mpegts.TsMuxer(has_audio=False)
+    for msg_type, t, payload in ply.inbox:
+        if msg_type == rtmp.MSG_VIDEO:
+            ts.write_video(t, payload)
+    seg = ts.packets()
+    demuxed = sum(1 for _ in mpegts.demux(seg))
+    print(f"TS segment = {len(seg) // mpegts.TS_PACKET} packets, "
+          f"{demuxed} PES demuxed")
+
     pconn.close()
     vconn.close()
     time.sleep(0.1)
