@@ -1,0 +1,326 @@
+// Client-side bench harnesses over the FULL native stack (Channel pending
+// table -> Socket write queue -> dispatcher/ring -> server dispatch ->
+// response completion) — the multi_threaded_echo_c++ shapes on fibers.
+#include "nat_internal.h"
+
+namespace brpc_tpu {
+
+// Shared client-bench harness: channel open, timed run, stop broadcast,
+// fiber join via done_count, and the stack-Butex destruction handshake
+// (scheduler.cpp join(): once we hold/release the butex mutex, the last
+// waker is done touching it). spawn(ch, stop, total, done) returns the
+// number of fibers it started.
+template <typename SpawnFn, typename OnStopFn>
+static double run_client_bench(const char* ip, int port, int nconn,
+                               double seconds, uint64_t* out_requests,
+                               SpawnFn spawn, OnStopFn on_stop) {
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> total{0};
+  Butex done_count;
+  std::vector<NatChannel*> channels;
+  int nfibers = 0;
+  for (int c = 0; c < nconn; c++) {
+    NatChannel* ch = (NatChannel*)nat_channel_open(ip, port, 0, 1, 0, 0);
+    if (ch == nullptr) continue;
+    channels.push_back(ch);
+    nfibers += spawn(ch, &stop, &total, &done_count);
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((int64_t)(seconds * 1000)));
+  stop.store(true);
+  on_stop();
+  while (done_count.value.load(std::memory_order_acquire) < nfibers) {
+    Scheduler::butex_wait(&done_count,
+                          done_count.value.load(std::memory_order_acquire));
+  }
+  // destruction handshake: the last fiber may still be inside butex_wake
+  { std::lock_guard<std::mutex> g(done_count.mu); }
+  auto t1 = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(t1 - t0).count();
+  for (NatChannel* ch : channels) nat_channel_close(ch);
+  if (out_requests) *out_requests = total.load();
+  return dt > 0 ? (double)total.load() / dt : 0.0;
+}
+
+// F fibers per channel issue synchronous EchoService.Echo calls; the
+// shared connection's write queue gives natural syscall batching.
+struct BenchFiberArg {
+  NatChannel* ch;
+  std::atomic<bool>* stop;
+  std::atomic<uint64_t>* total;
+  const std::string* payload;
+  Butex* done_count;  // incremented as each fiber exits
+};
+
+static void bench_call_fiber(void* a) {
+  BenchFiberArg* arg = (BenchFiberArg*)a;
+  NatChannel* ch = arg->ch;
+  while (!arg->stop->load(std::memory_order_relaxed)) {
+    NatSocket* s = sock_address(ch->sock_id);
+    if (s == nullptr) break;
+    int64_t cid = 0;
+    PendingCall* pc = ch->begin_call(&cid);
+    if (pc == nullptr) {
+      s->release();
+      break;
+    }
+    IOBuf frame;
+    build_request_frame(&frame, cid, "EchoService", "Echo",
+                        arg->payload->data(), arg->payload->size(), nullptr,
+                        0);
+    int wrc = s->write(std::move(frame));
+    // the socket ref pins the channel until the slot access is done
+    if (wrc != 0) {
+      PendingCall* mine = ch->take_pending(cid);
+      if (mine != nullptr) {
+        pc_free(mine);
+      } else {  // fail_all owns the completion; wait, then recycle
+        while (pc->done.value.load(std::memory_order_acquire) == 0) {
+          Scheduler::butex_wait(&pc->done, 0);
+        }
+        pc_free(pc);
+      }
+      s->release();
+      break;
+    }
+    while (pc->done.value.load(std::memory_order_acquire) == 0) {
+      Scheduler::butex_wait(&pc->done, 0);
+    }
+    bool ok = (pc->error_code == 0);
+    pc_free(pc);
+    s->release();
+    if (!ok) break;
+    arg->total->fetch_add(1, std::memory_order_relaxed);
+  }
+  arg->done_count->value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(arg->done_count, 1);
+  delete arg;
+}
+
+extern "C" {
+
+double nat_rpc_client_bench(const char* ip, int port, int nconn,
+                            int fibers_per_conn, double seconds,
+                            int payload_size, uint64_t* out_requests) {
+  std::string payload((size_t)payload_size, 'x');
+  return run_client_bench(
+      ip, port, nconn, seconds, out_requests,
+      [&](NatChannel* ch, std::atomic<bool>* stop,
+          std::atomic<uint64_t>* total, Butex* done) {
+        for (int f = 0; f < fibers_per_conn; f++) {
+          BenchFiberArg* arg = new BenchFiberArg{
+              ch, stop, total, &payload, done};
+          Scheduler::instance()->spawn_detached(bench_call_fiber, arg);
+        }
+        return fibers_per_conn;
+      },
+      [] {});
+}
+
+}  // extern "C"
+
+// Async windowed bench: each connection keeps `window` requests in
+// flight through the REAL framework path, completing via PendingCall
+// callbacks instead of parking a fiber per call — the async-RPC usage
+// pattern (brpc done-closures) at bench scale.
+struct AsyncBenchConn {
+  NatChannel* ch = nullptr;
+  std::atomic<bool>* stop = nullptr;
+  std::atomic<uint64_t>* total = nullptr;
+  std::string* payload = nullptr;
+  Butex* done_count = nullptr;
+  std::atomic<int> inflight{0};
+  Butex room;  // bumped when the window opens / on stop
+  int window = 64;
+  // lifetime: the sender fiber holds one ref, every in-flight call one
+  // more — the LAST completion callback may run after the fiber exited,
+  // so neither side can own the object outright
+  std::atomic<int> refs{1};
+
+  void add_ref() { refs.fetch_add(1, std::memory_order_relaxed); }
+  void release() {
+    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+  }
+};
+
+static void async_bench_cb(PendingCall* pc, void* arg) {
+  AsyncBenchConn* ab = (AsyncBenchConn*)arg;
+  if (pc->error_code == 0) {
+    ab->total->fetch_add(1, std::memory_order_relaxed);
+  }
+  pc_free(pc);
+  ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
+  ab->room.value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(&ab->room, 1);
+  ab->release();  // the in-flight reference
+}
+
+static void async_bench_fiber(void* a) {
+  AsyncBenchConn* ab = (AsyncBenchConn*)a;
+  NatChannel* ch = ab->ch;
+  while (!ab->stop->load(std::memory_order_acquire)) {
+    if (ab->inflight.load(std::memory_order_acquire) >= ab->window) {
+      int32_t expected = ab->room.value.load(std::memory_order_acquire);
+      if (ab->inflight.load(std::memory_order_acquire) >= ab->window) {
+        Scheduler::butex_wait(&ab->room, expected);
+      }
+      continue;
+    }
+    NatSocket* s = sock_address(ch->sock_id);
+    if (s == nullptr) break;
+    int64_t cid = 0;
+    ab->inflight.fetch_add(1, std::memory_order_acq_rel);
+    ab->add_ref();  // released by async_bench_cb
+    PendingCall* pc = ch->begin_call(&cid, async_bench_cb, ab);
+    if (pc == nullptr) {
+      ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
+      ab->release();
+      s->release();
+      break;
+    }
+    IOBuf frame;
+    build_request_frame(&frame, cid, "EchoService", "Echo",
+                        ab->payload->data(), ab->payload->size(), nullptr,
+                        0);
+    int wrc = s->write(std::move(frame));
+    if (wrc != 0) {
+      PendingCall* mine = ch->take_pending(cid);  // s pins the channel
+      if (mine != nullptr) {  // not yet consumed by fail_all's cb path
+        pc_free(mine);
+        ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
+        ab->release();
+      }
+      s->release();
+      break;
+    }
+    s->release();
+  }
+  // drain the window before reporting done
+  while (ab->inflight.load(std::memory_order_acquire) > 0) {
+    int32_t expected = ab->room.value.load(std::memory_order_acquire);
+    if (ab->inflight.load(std::memory_order_acquire) == 0) break;
+    Scheduler::butex_wait(&ab->room, expected);
+  }
+  Butex* done = ab->done_count;
+  ab->release();  // the sender fiber's reference; cb refs may outlive us
+  done->value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(done, INT32_MAX);
+}
+
+extern "C" {
+
+double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
+                                  int window, double seconds,
+                                  int payload_size,
+                                  uint64_t* out_requests) {
+  std::string payload((size_t)payload_size, 'x');
+  std::vector<AsyncBenchConn*> conns;
+  double qps = run_client_bench(
+      ip, port, nconn, seconds, out_requests,
+      [&](NatChannel* ch, std::atomic<bool>* stop,
+          std::atomic<uint64_t>* total, Butex* done) {
+        AsyncBenchConn* ab = new AsyncBenchConn();
+        ab->ch = ch;
+        ab->stop = stop;
+        ab->total = total;
+        ab->payload = &payload;
+        ab->done_count = done;
+        ab->window = window > 0 ? window : 64;
+        ab->add_ref();  // the harness's own reference (released below) —
+                        // a conn whose fiber died early must outlive
+                        // on_stop's wakeup sweep
+        conns.push_back(ab);
+        Scheduler::instance()->spawn_detached(async_bench_fiber, ab);
+        return 1;
+      },
+      [&] {
+        for (AsyncBenchConn* ab : conns) {  // unpark window-waiters
+          ab->room.value.fetch_add(1, std::memory_order_release);
+          Scheduler::butex_wake(&ab->room, INT32_MAX);
+        }
+      });
+  for (AsyncBenchConn* ab : conns) ab->release();
+  return qps;
+}
+
+// Bulk data-path bench (the streamed-attachment / device-push shape,
+// VERDICT r2 #4): one sync caller pushes frames carrying `att_bytes` of
+// attachment through the FULL native stack; the native echo handler
+// bounces the blocks back zero-copy. Returns GB/s of echoed attachment
+// payload (each byte crosses the wire twice; we count one direction).
+double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
+                                 double seconds, uint64_t* out_bytes) {
+  std::string att((size_t)att_bytes, 'b');
+  uint64_t total_calls = 0;
+  struct BulkArg {
+    NatChannel* ch;
+    std::atomic<bool>* stop;
+    std::atomic<uint64_t>* total;
+    const std::string* att;
+    Butex* done_count;
+  };
+  double dt_qps = run_client_bench(
+      ip, port, 1, seconds, &total_calls,
+      [&](NatChannel* ch, std::atomic<bool>* stop,
+          std::atomic<uint64_t>* total, Butex* done) {
+        BulkArg* arg = new BulkArg{ch, stop, total, &att, done};
+        Scheduler::instance()->spawn_detached(
+            [](void* a) {
+              BulkArg* arg = (BulkArg*)a;
+              NatChannel* ch = arg->ch;
+              while (!arg->stop->load(std::memory_order_relaxed)) {
+                NatSocket* s = sock_address(ch->sock_id);
+                if (s == nullptr) break;
+                int64_t cid = 0;
+                PendingCall* pc = ch->begin_call(&cid);
+                if (pc == nullptr) {
+                  s->release();
+                  break;
+                }
+                IOBuf frame;
+                build_request_frame(&frame, cid, "EchoService", "Echo",
+                                    nullptr, 0, arg->att->data(),
+                                    arg->att->size());
+                int wrc = s->write(std::move(frame));
+                if (wrc != 0) {
+                  PendingCall* mine = ch->take_pending(cid);
+                  if (mine != nullptr) {
+                    pc_free(mine);
+                  } else {
+                    while (pc->done.value.load(std::memory_order_acquire) ==
+                           0) {
+                      Scheduler::butex_wait(&pc->done, 0);
+                    }
+                    pc_free(pc);
+                  }
+                  s->release();
+                  break;
+                }
+                while (pc->done.value.load(std::memory_order_acquire) == 0) {
+                  Scheduler::butex_wait(&pc->done, 0);
+                }
+                bool ok = (pc->error_code == 0 &&
+                           pc->attachment.length() == arg->att->size());
+                pc_free(pc);
+                s->release();
+                if (!ok) break;
+                arg->total->fetch_add(1, std::memory_order_relaxed);
+              }
+              arg->done_count->value.fetch_add(1, std::memory_order_release);
+              Scheduler::butex_wake(arg->done_count, 1);
+              delete arg;
+            },
+            arg);
+        return 1;
+      },
+      [] {});
+  uint64_t bytes = total_calls * (uint64_t)att_bytes;
+  if (out_bytes != nullptr) *out_bytes = bytes;
+  // run_client_bench returns calls/sec; scale to GB/s of attachment
+  return dt_qps * (double)att_bytes / 1e9;
+}
+
+}  // extern "C"
+
+}  // namespace brpc_tpu

@@ -1,0 +1,457 @@
+// NatChannel — the client half (brpc::Channel/Controller): correlation-id
+// pending table (versioned slots, nat_internal.h), synchronous calls
+// parking on a butex, per-call deadlines via the native TimerThread,
+// retry-over-reconnect with a budget clamp, backup requests, and the
+// background health-check revival chain (health_check.cpp:146-237).
+#include "nat_internal.h"
+
+namespace brpc_tpu {
+
+// Return the call slot to its owning channel. The slot memory is never
+// freed while the channel lives, so a straggling butex_wake on a recycled
+// slot is harmlessly spurious (waiters re-check the value) — the same
+// never-free property the old global pool provided, now per channel.
+void pc_free(PendingCall* pc) {
+  pc->response.clear();
+  pc->attachment.clear();
+  pc->owner->release_slot(pc->slot_idx);
+}
+
+// Non-blocking connect with a deadline — the bthread_connect discipline
+// (bthread/fd.cpp:119-170): EINPROGRESS, poll for writability, then
+// SO_ERROR. Returns a connected nonblocking fd (TCP_NODELAY set) or -1.
+int dial_nonblocking(const char* ip, int port, int timeout_ms) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -1;
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, ip, &addr.sin_addr);
+  int rc = connect(fd, (struct sockaddr*)&addr, sizeof(addr));
+  if (rc != 0) {
+    if (errno != EINPROGRESS) {
+      ::close(fd);
+      return -1;
+    }
+    struct pollfd p;
+    p.fd = fd;
+    p.events = POLLOUT;
+    p.revents = 0;
+    int t = timeout_ms > 0 ? timeout_ms : 10000;  // sane default guard
+    if (poll(&p, 1, t) != 1) {
+      ::close(fd);  // timed out (no blocking connect with no deadline:
+      return -1;    // the round-2 nat_channel_open gap)
+    }
+    int err = 0;
+    socklen_t l = sizeof(err);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &l);
+    if (err != 0) {
+      ::close(fd);
+      return -1;
+    }
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+// Borrow the channel's socket, re-dialing a failed single connection on
+// demand (Channel reuse-after-failure semantics). Returns a referenced
+// socket or nullptr (closed channel / peer unreachable).
+NatSocket* channel_socket(NatChannel* ch, int max_dial_ms) {
+  NatSocket* s = sock_address(ch->sock_id.load(std::memory_order_acquire));
+  if (s != nullptr || ch->closed.load(std::memory_order_acquire) ||
+      ch->peer_port == 0) {
+    return s;
+  }
+  // Dial OUTSIDE reconnect_mu — poll() can block up to the connect
+  // timeout, and close()/other callers must not wait behind it. The
+  // publish step below re-checks under the lock; a losing racer just
+  // closes its dial. Re-dials default to a 1s guard (not the 10s
+  // first-open guard) so a blackholed peer doesn't pin a worker long;
+  // callers with a deadline pass max_dial_ms to clamp further.
+  int t_ms = ch->connect_timeout_ms > 0 ? ch->connect_timeout_ms : 1000;
+  if (max_dial_ms > 0 && max_dial_ms < t_ms) t_ms = max_dial_ms;
+  int fd = dial_nonblocking(ch->peer_ip.c_str(), ch->peer_port, t_ms);
+  if (fd < 0) return nullptr;
+  std::lock_guard<std::mutex> g(ch->reconnect_mu);
+  s = sock_address(ch->sock_id.load(std::memory_order_acquire));
+  if (s != nullptr || ch->closed.load(std::memory_order_acquire)) {
+    ::close(fd);  // lost the race (or the channel closed mid-dial)
+    return s;
+  }
+  NatSocket* ns = sock_create();
+  if (ns == nullptr) {
+    ::close(fd);
+    return nullptr;
+  }
+  ns->fd = fd;
+  ns->disp = pick_dispatcher();
+  ns->channel = ch;
+  ch->add_ref();  // the socket's channel reference
+  ns->defer_writes = ch->defer_writes_flag;
+  ch->sock_id.store(ns->id, std::memory_order_release);
+  ns->add_ref();  // the caller's borrowed reference, taken BEFORE epoll
+                  // can fail the socket
+  ns->disp->add_consumer(ns);  // client sockets stay on epoll (measured
+                               // slower on the ring: one-in-flight sends
+                               // throttle request pipelining)
+  return ns;
+}
+
+// Background revival of a failed channel connection (the health-check
+// thread role, health_check.cpp:146-237): re-dial every interval until
+// the channel closes or the connection is back. The dial can block up to
+// connect_timeout_ms, so it runs on a scheduler FIBER — timer callbacks
+// must not block (a blackholed peer would stall every armed deadline).
+static void health_check_dial_fiber(void* raw) {
+  NatChannel* ch = (NatChannel*)raw;
+  if (ch->closed.load(std::memory_order_acquire)) {
+    ch->hc_pending.store(false, std::memory_order_release);
+    ch->release();
+    return;
+  }
+  NatSocket* s = channel_socket(ch);
+  if (s != nullptr) {  // revived (or never died)
+    s->release();
+    ch->hc_pending.store(false, std::memory_order_release);
+    ch->release();
+    return;
+  }
+  TimerThread::instance()->schedule(health_check_fire, ch,
+                                    ch->health_check_interval_ms);
+}
+
+void health_check_fire(void* raw) {
+  Scheduler::instance()->spawn_detached(health_check_dial_fiber, raw);
+}
+
+// Per-call deadline (the bthread_timer_add arming of controller.cpp:605):
+// the timer races the response through the SAME pending-bit CAS — whoever
+// wins owns the completion, so a late reply after a timeout (or a timeout
+// firing after completion) is a harmless no-op. No unschedule needed.
+struct CallTimeout {
+  NatChannel* ch;  // holds a reference until the timer fires
+  int64_t cid;
+};
+
+static void call_timeout_work(void* raw) {
+  CallTimeout* t = (CallTimeout*)raw;
+  PendingCall* pc = t->ch->take_pending(t->cid);
+  if (pc != nullptr) {
+    pc->error_code = kERPCTIMEDOUT;
+    pc->error_text = "rpc timed out";
+    if (pc->cb != nullptr) {
+      pc->cb(pc, pc->cb_arg);  // cb owns pc
+    } else {
+      pc->done.value.store(1, std::memory_order_release);
+      Scheduler::butex_wake(&pc->done, INT32_MAX);
+    }
+  }
+  t->ch->release();
+  delete t;
+}
+
+// The completion callback may run arbitrary embedder code (the Python
+// acall trampoline takes the GIL): run it on a scheduler fiber — timer
+// callbacks must not block or every later deadline fires late.
+static void call_timeout_fire(void* raw) {
+  Scheduler::instance()->spawn_detached(call_timeout_work, raw);
+}
+
+static void arm_call_timeout(NatChannel* ch, int64_t cid, int timeout_ms) {
+  ch->add_ref();
+  TimerThread::instance()->schedule(call_timeout_fire,
+                                    new CallTimeout{ch, cid}, timeout_ms);
+}
+
+extern "C" {
+
+void* nat_channel_open(const char* ip, int port, int nworkers,
+                       int batch_writes, int connect_timeout_ms,
+                       int health_check_ms) {
+  if (ensure_runtime(nworkers) != 0) return nullptr;
+  int fd = dial_nonblocking(ip, port, connect_timeout_ms);
+  if (fd < 0) return nullptr;
+
+  NatChannel* ch = new NatChannel();
+  ch->peer_ip = ip;
+  ch->peer_port = port;
+  ch->connect_timeout_ms = connect_timeout_ms;
+  ch->health_check_interval_ms = health_check_ms;
+  ch->defer_writes_flag = (batch_writes != 0);
+  NatSocket* s = sock_create();
+  if (s == nullptr) {
+    ::close(fd);
+    ch->release();
+    return nullptr;
+  }
+  s->fd = fd;
+  s->disp = pick_dispatcher();
+  s->channel = ch;
+  ch->add_ref();  // the socket's reference, dropped in NatSocket::release
+  s->defer_writes = (batch_writes != 0);
+  ch->sock_id.store(s->id, std::memory_order_release);
+  // NOT ring-adopted: measured slower for clients — the one-in-flight
+  // fixed-send discipline throttles request pipelining, while the epoll
+  // lane's writer fiber flushes the whole queue per writev
+  s->disp->add_consumer(s);
+  return ch;
+}
+
+void nat_channel_close(void* h) {
+  NatChannel* ch = (NatChannel*)h;
+  {
+    // serialize against an in-flight reconnect: once we hold
+    // reconnect_mu, any racing channel_socket has either published its
+    // new socket (we fail it below) or will see closed and not dial
+    std::lock_guard<std::mutex> g(ch->reconnect_mu);
+    ch->closed.store(true, std::memory_order_release);
+  }
+  NatSocket* s = sock_address(ch->sock_id);
+  if (s != nullptr) {
+    s->set_failed();  // fails pending calls via channel->fail_all
+    s->release();
+  }
+  ch->fail_all(kEFAILEDSOCKET, "channel closed");
+  ch->release();  // opener's reference; the socket may still hold one
+}
+
+// Backup request (the controller.cpp:1256 backup timer): when the timer
+// fires and the call is STILL pending, the SAME frame (same correlation
+// id) is re-sent on the channel's current socket — the pending-bit CAS
+// makes whichever response lands first win and the loser a no-op, which
+// is exactly the reference's duplicate-response discipline.
+struct BackupCtx {
+  NatChannel* ch;  // holds a reference until fired
+  int64_t cid;
+  std::string frame;
+};
+
+static void backup_fire_work(void* raw) {
+  BackupCtx* b = (BackupCtx*)raw;
+  if (b->ch->is_pending(b->cid) &&
+      !b->ch->closed.load(std::memory_order_acquire)) {
+    NatSocket* s = sock_address(b->ch->sock_id);
+    if (s != nullptr) {
+      IOBuf f;
+      f.append(b->frame.data(), b->frame.size());
+      s->write(std::move(f));
+      s->release();
+    }
+  }
+  b->ch->release();
+  delete b;
+}
+
+static void backup_fire(void* raw) {
+  Scheduler::instance()->spawn_detached(backup_fire_work, raw);
+}
+
+// One wire attempt: build, (optionally) arm deadline + backup, write,
+// park, harvest. Returns the RPC error code.
+static int call_attempt(NatChannel* ch, NatSocket* s, const char* service,
+                        const char* method, const char* payload,
+                        size_t payload_len, int timeout_ms, int backup_ms,
+                        char** resp_out, size_t* resp_len,
+                        char** err_text_out) {
+  int64_t cid = 0;
+  PendingCall* pc = ch->begin_call(&cid);
+  if (pc == nullptr) {
+    return kEFAILEDSOCKET;  // 1M calls already in flight on this channel
+  }
+  if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
+  IOBuf frame;
+  build_request_frame(&frame, cid, service, method, payload, payload_len,
+                      nullptr, 0);
+  if (backup_ms > 0 && (timeout_ms <= 0 || backup_ms < timeout_ms)) {
+    ch->add_ref();
+    BackupCtx* b = new BackupCtx{ch, cid, frame.to_string()};
+    TimerThread::instance()->schedule(backup_fire, b, backup_ms);
+  }
+  if (s->write(std::move(frame)) != 0) {
+    PendingCall* mine = ch->take_pending(cid);
+    if (mine != nullptr) {
+      pc_free(mine);
+    } else {
+      // fail_all consumed it and is completing through the wake path;
+      // wait for that completion so the object isn't leaked
+      while (pc->done.value.load(std::memory_order_acquire) == 0) {
+        Scheduler::butex_wait(&pc->done, 0);
+      }
+      pc_free(pc);
+    }
+    return kEFAILEDSOCKET;
+  }
+  while (pc->done.value.load(std::memory_order_acquire) == 0) {
+    Scheduler::butex_wait(&pc->done, 0);
+  }
+  int rc = pc->error_code;
+  if (rc == 0 && resp_out != nullptr) {
+    *resp_len = pc->response.length();
+    *resp_out = (char*)malloc(*resp_len ? *resp_len : 1);
+    pc->response.copy_to(*resp_out, *resp_len);
+  } else if (resp_out != nullptr) {
+    *resp_out = nullptr;
+    *resp_len = 0;
+  }
+  if (err_text_out != nullptr) {
+    if (rc != 0 && !pc->error_text.empty()) {
+      *err_text_out = (char*)malloc(pc->error_text.size() + 1);
+      memcpy(*err_text_out, pc->error_text.c_str(),
+             pc->error_text.size() + 1);
+    } else {
+      *err_text_out = nullptr;
+    }
+  }
+  pc_free(pc);
+  return rc;
+}
+
+// Synchronous call. Returns 0 on success (out buffers malloc'd, caller
+// frees with nat_buf_free), else an error code. timeout_ms > 0 arms a
+// deadline covering ALL attempts (reference semantics); failed-socket
+// attempts retry up to max_retry times with on-demand re-dial;
+// backup_ms > 0 re-sends the request if no response arrived in time.
+int nat_channel_call_full(void* h, const char* service, const char* method,
+                          const char* payload, size_t payload_len,
+                          int timeout_ms, int max_retry, int backup_ms,
+                          char** resp_out, size_t* resp_len,
+                          char** err_text_out) {
+  NatChannel* ch = (NatChannel*)h;
+  // out-params are read (and freed) by the retry loop below: they must
+  // be defined regardless of which early path an attempt takes
+  if (resp_out != nullptr) {
+    *resp_out = nullptr;
+    *resp_len = 0;
+  }
+  if (err_text_out != nullptr) *err_text_out = nullptr;
+  int64_t deadline_us =
+      timeout_ms > 0
+          ? std::chrono::duration_cast<std::chrono::microseconds>(
+                std::chrono::steady_clock::now().time_since_epoch())
+                    .count() +
+                (int64_t)timeout_ms * 1000
+          : 0;
+  int attempt = 0;
+  while (true) {
+    int remaining_ms = timeout_ms;
+    if (deadline_us != 0) {
+      int64_t now_us =
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              std::chrono::steady_clock::now().time_since_epoch())
+              .count();
+      remaining_ms = (int)((deadline_us - now_us) / 1000);
+      if (remaining_ms <= 0) return kERPCTIMEDOUT;
+    }
+    // NOTE: the socket reference is held until the attempt completes —
+    // it pins the channel (socket->channel ref), so a concurrent close
+    // can never delete the slot slabs under a parked caller (the
+    // never-freed-butex discipline). The re-dial is clamped to the
+    // remaining budget, and the budget is recomputed after it, so a
+    // slow dial can't stretch the overall deadline.
+    NatSocket* s = channel_socket(ch, remaining_ms);
+    if (s == nullptr) {
+      if (attempt++ < max_retry &&
+          !ch->closed.load(std::memory_order_acquire)) {
+        continue;  // the next channel_socket re-dials
+      }
+      return kEFAILEDSOCKET;
+    }
+    if (deadline_us != 0) {  // the dial may have consumed budget
+      int64_t now_us =
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              std::chrono::steady_clock::now().time_since_epoch())
+              .count();
+      remaining_ms = (int)((deadline_us - now_us) / 1000);
+      if (remaining_ms <= 0) {
+        s->release();
+        return kERPCTIMEDOUT;
+      }
+    }
+    int rc = call_attempt(ch, s, service, method, payload, payload_len,
+                          remaining_ms, backup_ms, resp_out, resp_len,
+                          err_text_out);
+    s->release();
+    if (rc != kEFAILEDSOCKET || attempt++ >= max_retry ||
+        ch->closed.load(std::memory_order_acquire)) {
+      return rc;
+    }
+    if (err_text_out != nullptr && *err_text_out != nullptr) {
+      free(*err_text_out);  // superseded by the retry
+      *err_text_out = nullptr;
+    }
+  }
+}
+
+int nat_channel_call(void* h, const char* service, const char* method,
+                     const char* payload, size_t payload_len, int timeout_ms,
+                     char** resp_out, size_t* resp_len,
+                     char** err_text_out) {
+  return nat_channel_call_full(h, service, method, payload, payload_len,
+                               timeout_ms, 0, 0, resp_out, resp_len,
+                               err_text_out);
+}
+
+void nat_buf_free(char* p) { free(p); }
+
+// Asynchronous call for embedders (the done-closure surface): cb runs on
+// a framework thread/fiber when the response (or failure) arrives —
+// cb(user_arg, error_code, resp_bytes, resp_len). The response buffer is
+// only valid during the callback; copy it out if needed.
+typedef void (*nat_acall_cb)(void* arg, int32_t error_code,
+                             const char* resp, size_t resp_len);
+
+struct AcallCtx {
+  nat_acall_cb cb;
+  void* arg;
+};
+
+static void acall_complete(PendingCall* pc, void* raw) {
+  AcallCtx* ctx = (AcallCtx*)raw;
+  std::string resp = pc->response.to_string();
+  ctx->cb(ctx->arg, pc->error_code, resp.data(), resp.size());
+  pc_free(pc);
+  delete ctx;
+}
+
+int nat_channel_acall(void* h, const char* service, const char* method,
+                      const char* payload, size_t payload_len,
+                      int timeout_ms, nat_acall_cb cb, void* arg) {
+  NatChannel* ch = (NatChannel*)h;
+  NatSocket* s = channel_socket(ch);
+  if (s == nullptr) return kEFAILEDSOCKET;
+  AcallCtx* ctx = new AcallCtx{cb, arg};
+  int64_t cid = 0;
+  if (ch->begin_call(&cid, acall_complete, ctx) == nullptr) {
+    s->release();
+    delete ctx;
+    return kEFAILEDSOCKET;
+  }
+  if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
+  IOBuf frame;
+  build_request_frame(&frame, cid, service, method, payload, payload_len,
+                      nullptr, 0);
+  if (s->write(std::move(frame)) != 0) {
+    PendingCall* mine = ch->take_pending(cid);  // s still pins the channel
+    if (mine != nullptr) {
+      // not yet consumed: complete through the SAME callback path so the
+      // caller observes exactly ONE completion (returning an error here
+      // while fail_all might also fire cb would double-complete, and the
+      // caller would have no reason to keep the callback alive)
+      mine->error_code = kEFAILEDSOCKET;
+      mine->error_text = "socket failed before write";
+      acall_complete(mine, ctx);
+    }
+    // else: fail_all already delivered the failure through cb
+    s->release();
+    return 0;
+  }
+  s->release();
+  return 0;
+}
+
+}  // extern "C"
+
+}  // namespace brpc_tpu

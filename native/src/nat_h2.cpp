@@ -1,0 +1,22 @@
+// Native h2/gRPC server-side session — h2 framing + HPACK decode in the
+// native cut loop, gRPC messages de-framed and handed to Python usercode
+// (kind-4 py-lane requests), responses framed natively.
+// Reference shape: policy/http2_rpc_protocol.cpp + details/hpack.cpp.
+#include "nat_internal.h"
+
+namespace brpc_tpu {
+
+struct H2SessionN {
+  // stub; replaced by the real session in this round's h2 lane work
+  int unused = 0;
+};
+
+int h2_try_process(NatSocket* s, IOBuf* batch_out) {
+  (void)s;
+  (void)batch_out;
+  return 0;  // not h2 (stub)
+}
+
+void h2_session_free(H2SessionN* h) { delete h; }
+
+}  // namespace brpc_tpu

@@ -1,0 +1,25 @@
+// Native HTTP/1.1 server-side session — parse in the native cut loop,
+// execute usercode in Python (kind-3 py-lane requests), answer through
+// the native Socket write queue with pipelining-order preservation.
+// Reference shape: brpc's http parser + http_rpc_protocol
+// (details/http_parser.cpp, policy/http_rpc_protocol.cpp) — the parse
+// lives beside the socket, usercode elsewhere.
+#include "nat_internal.h"
+
+namespace brpc_tpu {
+
+struct HttpSessionN {
+  // stub (sniff never latches until nat_rpc_server_native_http wiring
+  // lands); replaced by the real parser in this round's HTTP lane work
+  int unused = 0;
+};
+
+int http_try_process(NatSocket* s, IOBuf* batch_out) {
+  (void)s;
+  (void)batch_out;
+  return 0;  // not HTTP (stub)
+}
+
+void http_session_free(HttpSessionN* h) { delete h; }
+
+}  // namespace brpc_tpu

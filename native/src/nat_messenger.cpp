@@ -1,0 +1,368 @@
+// Messenger — the tpu_std cut loop + dispatch (InputMessenger role,
+// input_messenger.cpp:331): drain an fd / ring completion into the
+// socket's native IOBuf, cut frames, process requests inline in the
+// reading thread (native handlers / py-lane handoff), route responses to
+// the owning channel's pending-call table. Also the frame builders and
+// the native console HTTP answering GETs from native counters.
+#include "nat_internal.h"
+
+namespace brpc_tpu {
+
+// Header + meta are encoded into ONE stack buffer and appended in a single
+// call (one memcpy into the TLS share block, zero allocations); oversized
+// error texts spill to a heap scratch, never truncate.
+void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
+                          const std::string& error_text, IOBuf&& payload,
+                          IOBuf&& attachment) {
+  size_t bound = 12 + response_meta_bound(error_text.size());
+  char stack_buf[320];
+  char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
+  size_t mlen = encode_response_meta_to(buf + 12, error_code,
+                                        error_text.data(), error_text.size(),
+                                        cid, (int64_t)attachment.length());
+  memcpy(buf, kMagicRpc, 4);
+  wr_be32(buf + 4,
+          (uint32_t)(mlen + payload.length() + attachment.length()));
+  wr_be32(buf + 8, (uint32_t)mlen);
+  out->append(buf, 12 + mlen);
+  if (buf != stack_buf) free(buf);
+  out->append(std::move(payload));
+  out->append(std::move(attachment));
+}
+
+void build_request_frame(IOBuf* out, int64_t cid, const std::string& service,
+                         const std::string& method, const char* payload,
+                         size_t payload_len, const char* att,
+                         size_t att_len) {
+  size_t bound = 12 + request_meta_bound(service.size(), method.size());
+  char stack_buf[320];
+  char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
+  size_t mlen = encode_request_meta_to(buf + 12, service.data(),
+                                       service.size(), method.data(),
+                                       method.size(), cid, (int64_t)att_len);
+  memcpy(buf, kMagicRpc, 4);
+  wr_be32(buf + 4, (uint32_t)(mlen + payload_len + att_len));
+  wr_be32(buf + 8, (uint32_t)mlen);
+  out->append(buf, 12 + mlen);
+  if (buf != stack_buf) free(buf);
+  if (payload_len) out->append(payload, payload_len);
+  if (att_len) out->append(att, att_len);
+}
+
+// Minimal HTTP console on the native port (the multi-protocol-port
+// discipline of server.cpp: one port tries every protocol): GET
+// /health /status /vars /version answer from native counters so the
+// native runtime is self-observable without the Python lane.
+// Returns 1 = handled a request, 2 = need more bytes, 0 = not HTTP.
+static int try_process_http(NatSocket* s, IOBuf* batch_out) {
+  char head[8] = {0};
+  size_t n = s->in_buf.length() < 8 ? s->in_buf.length() : 8;
+  s->in_buf.copy_to(head, n);
+  bool is_head = memcmp(head, "HEAD", 4) == 0;
+  if (memcmp(head, "GET ", 4) != 0 && !is_head) {
+    return 0;
+  }
+  if (s->server == nullptr) return 0;
+  std::string raw;
+  raw.resize(s->in_buf.length());
+  s->in_buf.copy_to(&raw[0], raw.size());
+  size_t end = raw.find("\r\n\r\n");
+  if (end == std::string::npos) {
+    return raw.size() > (64u << 10) ? 0 : 2;  // oversized header: bail
+  }
+  std::string headers = raw.substr(0, end);  // THIS request only, not any
+  for (char& c : headers) c = (char)tolower((unsigned char)c);
+  // a body (Content-Length) must be consumed too, or its bytes would be
+  // parsed as the next frame and poison the stream
+  size_t body_len = 0;
+  size_t clpos = headers.find("content-length:");
+  if (clpos != std::string::npos) {
+    body_len = (size_t)strtoul(headers.c_str() + clpos + 15, nullptr, 10);
+    if (body_len > (64u << 10)) return 0;  // absurd for a console GET
+  }
+  if (raw.size() < end + 4 + body_len) return 2;  // body not buffered yet
+  s->in_buf.pop_front(end + 4 + body_len);
+  size_t p0 = raw.find(' ');
+  size_t p1 = raw.find(' ', p0 + 1);
+  std::string path = (p0 != std::string::npos && p1 != std::string::npos)
+                         ? raw.substr(p0 + 1, p1 - p0 - 1)
+                         : "/";
+  bool keep_alive = headers.find("connection: close") == std::string::npos;
+  std::string body;
+  int status = 200;
+  if (path == "/health") {
+    body = "OK\n";
+  } else if (path == "/version") {
+    body = "brpc_tpu_native/1\n";
+  } else if (path == "/status" || path == "/vars") {
+    char buf[512];
+    uint64_t ring_recv = g_ring != nullptr ? g_ring->recv_completions() : 0;
+    uint64_t ring_send = g_ring != nullptr ? g_ring->send_completions() : 0;
+    snprintf(buf, sizeof(buf),
+             "nat_server_requests : %llu\n"
+             "nat_server_connections : %llu\n"
+             "nat_scheduler_workers : %d\n"
+             "nat_scheduler_switches : %llu\n"
+             "nat_ring_recv_completions : %llu\n"
+             "nat_ring_send_completions : %llu\n",
+             (unsigned long long)s->server->requests.load(),
+             (unsigned long long)s->server->connections.load(),
+             Scheduler::instance()->nworkers(),
+             (unsigned long long)Scheduler::instance()->total_switches(),
+             (unsigned long long)ring_recv,
+             (unsigned long long)ring_send);
+    body = buf;
+  } else {
+    status = 404;
+    body = "no such page on the native port (try /status /vars /health)\n";
+  }
+  char hdr[256];
+  snprintf(hdr, sizeof(hdr),
+           "HTTP/1.1 %d %s\r\nServer: brpc_tpu_native\r\n"
+           "Content-Type: text/plain\r\nContent-Length: %zu\r\n"
+           "Connection: %s\r\n\r\n",
+           status, status == 200 ? "OK" : "Not Found", body.size(),
+           keep_alive ? "keep-alive" : "close");
+  batch_out->append(hdr, strlen(hdr));
+  if (!is_head) batch_out->append(body.data(), body.size());
+  // Even for Connection: close we answer and let the PEER close (EOF
+  // then fails the socket) — closing ourselves would race the
+  // asynchronous write lanes (KeepWrite fiber / io_uring send) and could
+  // drop the response bytes still queued.
+  return 1;
+}
+
+// Forward everything buffered on a raw-mode socket to the py lane as one
+// ordered chunk.
+static void forward_raw_chunk(NatSocket* s) {
+  if (s->in_buf.empty()) return;
+  PyRequest* r = new PyRequest();
+  r->kind = 1;
+  r->sock_id = s->id;
+  r->cid = (int64_t)(++s->py_raw_seq);
+  r->payload = s->in_buf.to_string();
+  s->in_buf.clear();
+  s->server->enqueue_py(r);
+}
+
+// Cut + process every complete frame in s->in_buf. Server requests run
+// inline (responses batched into ONE socket write per read burst); client
+// responses complete pending calls.
+// With defer_out != nullptr, response bytes are parked there instead of
+// being written per read burst — the epoll dispatcher passes its per-round
+// accumulator so one writev covers EVERY burst of the round (cross-burst
+// syscall batching; the client-side defer_writes twin of this discipline).
+bool process_input(NatSocket* s, IOBuf* defer_out) {
+  if (s->py_raw.load(std::memory_order_relaxed)) {
+    forward_raw_chunk(s);
+    return true;
+  }
+  IOBuf batch_out;
+  bool ok = true;
+  // native protocol sessions take over the whole connection once sniffed
+  if (s->http != nullptr || s->h2 != nullptr) {
+    int prc = s->h2 != nullptr ? h2_try_process(s, &batch_out)
+                               : http_try_process(s, &batch_out);
+    if (prc == 0) ok = false;
+    goto flush;
+  }
+  while (true) {
+    if (s->in_buf.length() < 12) {
+      // Short first message (e.g. inline redis "PING\r\n"): if the bytes
+      // already rule out the tpu_std magic, hand off to raw mode now
+      // rather than deadlocking on a 12-byte header that never comes.
+      if (!s->in_buf.empty() && s->server != nullptr &&
+          s->server->raw_fallback && s->server->py_lane_enabled) {
+        char pfx[4];
+        size_t n = s->in_buf.length() < 4 ? s->in_buf.length() : 4;
+        s->in_buf.copy_to(pfx, n);
+        if (memcmp(pfx, kMagicRpc, n) != 0) {
+          s->py_raw.store(true, std::memory_order_release);
+          forward_raw_chunk(s);
+        }
+      }
+      break;
+    }
+    char header[12];
+    s->in_buf.copy_to(header, 12);
+    if (memcmp(header, kMagicRpc, 4) != 0) {
+      // Not tpu_std. Native HTTP/h2 sessions (sniff once, remember) take
+      // precedence when enabled; then the raw-fallback py lane; then the
+      // native console; else protocol error.
+      if (s->server != nullptr && s->server->native_http &&
+          s->server->py_lane_enabled) {
+        int prc = h2_try_process(s, &batch_out);
+        if (prc == 1 || prc == 2) break;  // h2 session latched (or needs
+                                          // more preface bytes)
+        prc = http_try_process(s, &batch_out);
+        if (prc == 1 || prc == 2) break;  // http session latched
+        // fall through: not HTTP-shaped either
+      }
+      if (s->server != nullptr && s->server->raw_fallback &&
+          s->server->py_lane_enabled) {
+        s->py_raw.store(true, std::memory_order_release);
+        forward_raw_chunk(s);
+        break;
+      }
+      int hrc = try_process_http(s, &batch_out);
+      if (hrc == 1) continue;   // handled; keep cutting
+      if (hrc == 2) break;      // incomplete request: wait for bytes
+      ok = false;  // not tpu_std, not HTTP: protocol error
+      break;
+    }
+    uint32_t body = rd_be32(header + 4);
+    uint32_t meta_size = rd_be32(header + 8);
+    if (meta_size > body || body > (512u << 20)) {
+      ok = false;
+      break;
+    }
+    if (s->in_buf.length() < 12 + (size_t)body) break;
+    s->in_buf.pop_front(12);
+    // decode straight from the buffer (fetch: contiguous view or stack
+    // copy; meta blobs are tens of bytes — no heap string per frame)
+    char meta_stack[512];
+    const char* meta_ptr;
+    std::string meta_heap;
+    if (meta_size <= sizeof(meta_stack)) {
+      meta_ptr = s->in_buf.fetch(meta_stack, meta_size);
+    } else {
+      meta_heap.resize(meta_size);
+      s->in_buf.copy_to(&meta_heap[0], meta_size);
+      meta_ptr = meta_heap.data();
+    }
+    RpcMetaN meta;
+    if (!decode_meta(meta_ptr, meta_size, &meta)) {
+      ok = false;
+      break;
+    }
+    size_t att_size = (size_t)meta.attachment_size;
+    if (att_size > body - meta_size) {
+      ok = false;
+      break;
+    }
+    // handler lookup BEFORE the meta pop: the py lane needs a copy of the
+    // raw meta bytes, but only requests that actually go to the py lane
+    // should pay it — native-handled frames stay allocation-free
+    NatServer* srv =
+        (meta.has_request && s->server != nullptr) ? s->server : nullptr;
+    auto it = srv != nullptr ? srv->handlers.end()
+                             : decltype(srv->handlers.end())();
+    std::string meta_copy;
+    if (srv != nullptr) {
+      char keybuf[256];
+      const std::string& sn = meta.request.service_name;
+      const std::string& mn = meta.request.method_name;
+      if (sn.size() + mn.size() + 1 <= sizeof(keybuf)) {
+        memcpy(keybuf, sn.data(), sn.size());
+        keybuf[sn.size()] = '.';
+        memcpy(keybuf + sn.size() + 1, mn.data(), mn.size());
+        it = srv->handlers.find(
+            std::string_view(keybuf, sn.size() + 1 + mn.size()));
+      }
+      if (it == srv->handlers.end() && srv->py_lane_enabled) {
+        meta_copy.assign(meta_ptr, meta_size);  // py lane re-parses it
+      }
+    }
+    s->in_buf.pop_front(meta_size);
+    size_t payload_size = body - meta_size - att_size;
+    IOBuf payload, attachment;
+    s->in_buf.cut_into(&payload, payload_size);
+    s->in_buf.cut_into(&attachment, att_size);
+
+    if (srv != nullptr) {
+      srv->requests.fetch_add(1, std::memory_order_relaxed);
+      if (it != srv->handlers.end()) {
+        NativeHandlerCtx ctx;
+        ctx.req_payload = &payload;
+        ctx.req_attachment = &attachment;
+        it->second(ctx);
+        build_response_frame(&batch_out, meta.correlation_id, ctx.error_code,
+                             ctx.error_text, std::move(ctx.resp_payload),
+                             std::move(ctx.resp_attachment));
+      } else if (srv->py_lane_enabled) {
+        PyRequest* r = new PyRequest();
+        r->sock_id = s->id;
+        r->cid = meta.correlation_id;
+        r->compress_type = meta.compress_type;
+        r->service = meta.request.service_name;
+        r->method = meta.request.method_name;
+        r->payload = payload.to_string();
+        r->attachment = attachment.to_string();
+        r->meta_bytes = std::move(meta_copy);
+        srv->enqueue_py(r);
+      } else {
+        build_response_frame(&batch_out, meta.correlation_id, kENOSERVICE,
+                             "no such service/method on native port",
+                             IOBuf(), IOBuf());
+      }
+    } else if (s->channel != nullptr) {
+      PendingCall* pc = s->channel->take_pending(meta.correlation_id);
+      if (pc != nullptr) {
+        pc->error_code = meta.has_response ? meta.response.error_code : 0;
+        pc->error_text = meta.has_response ? meta.response.error_text : "";
+        pc->response = std::move(payload);
+        pc->attachment = std::move(attachment);
+        if (pc->cb != nullptr) {
+          pc->cb(pc, pc->cb_arg);  // async completion; cb owns pc
+        } else {
+          pc->done.value.store(1, std::memory_order_release);
+          Scheduler::butex_wake(&pc->done, INT32_MAX);
+        }
+      }
+    }
+  }
+flush:
+  if (!batch_out.empty()) {
+    if (defer_out != nullptr) {
+      defer_out->append(std::move(batch_out));
+    } else {
+      s->write(std::move(batch_out));
+    }
+  }
+  return ok;
+}
+
+// Drain an fd to EAGAIN and process every complete frame, ON THE CALLING
+// THREAD. The epoll dispatcher calls this inline (the bypass-loop shape,
+// and the fork's wait_task ring-drain discipline, task_group.cpp:158-169):
+// every process_input consumer is non-blocking by contract — native
+// handlers must not block, py-lane delivery is a brief mutex push, and
+// client completions are a butex wake — so a reader-fiber handoff per
+// event burst (spawn + remote-queue + futex wake) only added latency.
+// Single-reader safety holds because a socket belongs to exactly one
+// dispatcher loop.
+// Returns true when response bytes were queued (the caller flushes them at
+// end of round).
+bool drain_socket_inline(NatSocket* s) {
+  IOBuf acc;  // responses of EVERY burst in this drain, flushed as one
+  bool dead = false;
+  while (!s->failed.load(std::memory_order_acquire)) {
+    ssize_t n = s->in_buf.append_from_fd(s->fd, 65536);
+    if (n > 0) {
+      if (!process_input(s, &acc)) {
+        dead = true;
+        break;
+      }
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    if (n < 0 && errno == EINTR) continue;
+    dead = true;  // EOF or hard error
+    break;
+  }
+  bool queued = false;
+  if (!acc.empty() && !dead) {
+    std::lock_guard<std::mutex> g(s->write_mu);
+    if (!s->failed.load(std::memory_order_acquire)) {
+      s->write_q.append(std::move(acc));
+      queued = true;
+    }
+  }
+  if (dead || s->failed.load(std::memory_order_acquire)) {
+    s->set_failed();
+    return false;
+  }
+  return queued;
+}
+
+}  // namespace brpc_tpu

@@ -1,0 +1,492 @@
+// Dispatcher loops + NatServer lifecycle + the Python-lane C API.
+//
+// Dispatcher ⇔ EventDispatcher (event_dispatcher_epoll.cpp:249): one epoll
+// loop, edge-triggered; reads are drained INLINE on the loop (see
+// nat_messenger.cpp); EPOLLOUT wakes the socket's KeepWrite butex.
+// NatServer ⇔ brpc::Server + Acceptor (server.cpp): native method registry
+// dispatched on fibers/IOBuf, plus a Python lane — a condvar MPSC queue
+// Python worker threads drain via ctypes (nat_take_request/nat_respond),
+// so arbitrary Python services mount the native port while Python user
+// code runs on pthreads, never on fiber stacks.
+#include "nat_internal.h"
+
+namespace brpc_tpu {
+
+NatServer::~NatServer() {
+  // stop() drains py_q, but a raw-mode socket failing AFTER stop still
+  // enqueues its kind-2 close notice; free whatever is left.
+  for (PyRequest* r : py_q) delete r;
+}
+
+// ---------------------------------------------------------------------------
+// Dispatcher
+// ---------------------------------------------------------------------------
+
+int Dispatcher::start() {
+  epfd = epoll_create1(0);
+  if (epfd < 0) return -1;
+  wake_fd = eventfd(0, EFD_NONBLOCK);
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = (uint64_t)-1;
+  epoll_ctl(epfd, EPOLL_CTL_ADD, wake_fd, &ev);
+  thread = std::thread([this] { run(); });
+  return 0;
+}
+
+void Dispatcher::shutdown() {
+  stop = true;
+  uint64_t one = 1;
+  ssize_t rc = ::write(wake_fd, &one, 8);
+  (void)rc;
+  if (thread.joinable()) thread.join();
+  ::close(wake_fd);
+  ::close(epfd);
+}
+
+void Dispatcher::add_consumer(NatSocket* s) {
+  struct epoll_event ev;
+  ev.events = EPOLLIN | EPOLLET;
+  ev.data.u64 = s->id;
+  s->epoll_events = ev.events;
+  epoll_ctl(epfd, EPOLL_CTL_ADD, s->fd, &ev);
+}
+
+void Dispatcher::add_listener(int fd, NatServer* srv) {
+  {
+    std::lock_guard<std::mutex> g(listen_mu);
+    listeners[fd] = srv;
+  }
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  // Listener tags stay below 2^32; socket ids are version<<32|idx with
+  // version >= 1, so the two ranges can never collide.
+  ev.data.u64 = (uint64_t)fd;
+  epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+}
+
+void Dispatcher::accept_loop(int lfd, NatServer* srv) {
+  while (true) {
+    int cfd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+    if (cfd < 0) break;
+    int one = 1;
+    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    NatSocket* s = sock_create();  // holds the initial reference
+    if (s == nullptr) {
+      ::close(cfd);
+      break;
+    }
+    s->fd = cfd;
+    s->disp = pick_dispatcher();  // shard across the loop pool
+    s->server = srv;
+    srv->add_ref();  // released when the socket slot is recycled
+    srv->connections.fetch_add(1);
+    if (try_ring_adopt(s)) continue;  // the ring owns this read path
+    s->disp->add_consumer(s);
+  }
+}
+
+void Dispatcher::run() {
+  std::vector<struct epoll_event> events(256);
+  std::vector<NatSocket*> flush_list;  // queued output; flushed per round
+  std::vector<Fiber*> wake_batch;      // fibers readied this round
+  while (!stop.load(std::memory_order_acquire)) {
+    int n = epoll_wait(epfd, events.data(), (int)events.size(), 100);
+    // every butex wake / spawn from this round coalesces into one
+    // remote-queue push + one signal per worker (not per completion)
+    Scheduler::instance()->arm_wake_batch(&wake_batch);
+    for (int i = 0; i < n; i++) {
+      uint64_t data = events[i].data.u64;
+      if (data == (uint64_t)-1) {  // wake eventfd
+        uint64_t drain;
+        ssize_t rc = ::read(wake_fd, &drain, 8);
+        (void)rc;
+        continue;
+      }
+      if (data < (1ull << 32)) {  // listener (socket ids are >= 2^32)
+        int lfd = (int)data;
+        NatServer* srv;
+        {
+          std::lock_guard<std::mutex> g(listen_mu);
+          auto it = listeners.find(lfd);
+          srv = (it == listeners.end()) ? nullptr : it->second;
+          // ref taken UNDER the lock: a racing server_stop erases the
+          // listener then releases its registration reference — without
+          // this, accept_loop could run on a freed server
+          if (srv != nullptr) srv->add_ref();
+        }
+        if (srv != nullptr) {
+          accept_loop(lfd, srv);
+          srv->release();
+        }
+        continue;
+      }
+      NatSocket* s = sock_address(data);
+      if (s == nullptr) continue;
+      if (events[i].events & EPOLLOUT) {
+        s->epollout.value.fetch_add(1, std::memory_order_release);
+        Scheduler::butex_wake(&s->epollout, INT32_MAX);
+      }
+      if (events[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) {
+        if (drain_socket_inline(s)) {
+          flush_list.push_back(s);  // keep the ref until the flush below
+          continue;
+        }
+      }
+      s->release();
+    }
+    // End-of-round flush: one writev per socket covering every burst the
+    // round produced (cross-burst syscall batching).
+    for (NatSocket* s : flush_list) {
+      bool become_writer = false;
+      {
+        std::lock_guard<std::mutex> g(s->write_mu);
+        if (!s->write_q.empty() && !s->writing &&
+            !s->failed.load(std::memory_order_acquire)) {
+          s->writing = true;
+          become_writer = true;
+        }
+      }
+      if (become_writer && !s->flush_some()) {
+        s->add_ref();
+        Scheduler::instance()->spawn_detached(keep_write_fiber, s);
+      }
+      s->release();
+    }
+    flush_list.clear();
+    Scheduler::instance()->flush_wake_batch();
+  }
+}
+
+// ---------------------------------------------------------------------------
+// runtime bring-up + server lifecycle C API
+// ---------------------------------------------------------------------------
+
+// Dispatcher pool (-event_dispatcher_num analog, event_dispatcher.cpp:30):
+// sockets are sharded round-robin across N independent epoll loops so the
+// inline read/process path scales past one core. Listeners live on
+// loop 0; accepted/connected sockets go to the next loop in turn.
+std::vector<Dispatcher*> g_disps;
+Dispatcher* g_disp = nullptr;  // g_disps[0]: listeners + console
+NatServer* g_rpc_server = nullptr;
+std::mutex g_rt_mu;
+static std::atomic<uint32_t> g_disp_rr{0};
+static int g_disp_count = 0;  // 0 = auto (set before first runtime use)
+
+Dispatcher* pick_dispatcher() {
+  if (g_disps.size() == 1) return g_disps[0];
+  uint32_t i = g_disp_rr.fetch_add(1, std::memory_order_relaxed);
+  return g_disps[i % g_disps.size()];
+}
+
+int ensure_runtime(int nworkers) {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  if (!Scheduler::instance()->started()) {
+    if (nworkers <= 0) {
+      unsigned hw = std::thread::hardware_concurrency();
+      nworkers = hw > 1 ? (int)hw : 1;
+      if (nworkers > 16) nworkers = 16;  // brpc-class default; beyond
+      // this the random-steal idle loops cost more than they serve
+    }
+    Scheduler::instance()->start(nworkers);
+  }
+  if (g_disps.empty()) {
+    int n = g_disp_count;
+    if (n <= 0) {
+      unsigned hw = std::thread::hardware_concurrency();
+      n = hw >= 16 ? 4 : hw >= 4 ? 2 : 1;
+    }
+    for (int i = 0; i < n; i++) {
+      Dispatcher* d = new Dispatcher();
+      if (d->start() != 0) {
+        delete d;
+        if (g_disps.empty()) return -1;
+        break;  // run with what we have
+      }
+      g_disps.push_back(d);
+    }
+    g_disp = g_disps[0];
+  }
+  return 0;
+}
+
+extern "C" {
+
+// -event_dispatcher_num analog: set the epoll-loop pool size BEFORE the
+// runtime starts (0 = auto from hardware_concurrency). Returns the count
+// in effect.
+int nat_rpc_set_dispatchers(int n) {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  if (g_disps.empty() && n >= 0) g_disp_count = n;
+  return g_disps.empty() ? g_disp_count : (int)g_disps.size();
+}
+
+// Start the native RPC server. enable_native_echo registers the built-in
+// EchoService.Echo handler (zero-copy: response payload/attachment share
+// the request's IOBuf blocks). Python services ride the py lane.
+int nat_rpc_server_start(const char* ip, int port, int nworkers,
+                         int enable_native_echo) {
+  {
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    if (g_rpc_server != nullptr) return -1;
+  }
+  if (ensure_runtime(nworkers) != 0) return -1;
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, ip, &addr.sin_addr);
+  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 1024) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (struct sockaddr*)&addr, &alen);
+
+  NatServer* srv = new NatServer();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  srv->disp = g_disp;
+  srv->py_lane_enabled = true;
+  if (enable_native_echo) {
+    srv->handlers["EchoService.Echo"] = [](NativeHandlerCtx& ctx) {
+      // echo: hand the request blocks straight back (no copy)
+      ctx.resp_payload.append(std::move(*ctx.req_payload));
+      ctx.resp_attachment.append(std::move(*ctx.req_attachment));
+    };
+  }
+  {
+    // publish AND register the listener in ONE critical section: a
+    // concurrent stop can then never observe the published server while
+    // missing its listener registration (ADVICE r3 #2)
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    if (g_rpc_server != nullptr) {  // lost a concurrent-start race
+      ::close(fd);
+      srv->release();
+      return -1;
+    }
+    g_rpc_server = srv;
+    g_disp->add_listener(fd, srv);
+  }
+  return srv->port;
+}
+
+void nat_rpc_server_stop() {
+  NatServer* srv;
+  {
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    srv = g_rpc_server;
+    if (srv == nullptr) return;
+    g_rpc_server = nullptr;
+    // remove the listener in the same critical section that unpublishes
+    // (the start path registers under g_rt_mu too, so no listener of a
+    // published server can be missed here)
+    epoll_ctl(g_disp->epfd, EPOLL_CTL_DEL, srv->listen_fd, nullptr);
+    std::lock_guard<std::mutex> lg(g_disp->listen_mu);
+    g_disp->listeners.erase(srv->listen_fd);
+  }
+  ::close(srv->listen_fd);
+  // stop the python lane (wakes all waiters empty-handed)
+  {
+    std::lock_guard<std::mutex> g(srv->py_mu);
+    srv->py_stopping = true;
+  }
+  srv->py_cv.notify_all();
+  // fail remaining server-side connections: scan the slot space (bounded
+  // by the high-water mark) and take a safe reference before failing
+  uint32_t hwm;
+  {
+    std::lock_guard<std::mutex> g(g_sock_alloc_mu);
+    hwm = g_sock_next_idx;
+  }
+  for (uint32_t idx = 0; idx < hwm; idx++) {
+    NatSocket* cand = sock_at(idx);
+    if (cand == nullptr) continue;
+    uint64_t id = cand->id;  // racy snapshot; sock_address validates it
+    NatSocket* s = sock_address(id);
+    if (s == nullptr) continue;
+    if (s->server == srv) s->set_failed();
+    s->release();
+  }
+  // drain queued python-lane requests under the lane lock
+  {
+    std::lock_guard<std::mutex> g(srv->py_mu);
+    for (PyRequest* r : srv->py_q) delete r;
+    srv->py_q.clear();
+  }
+  srv->release();  // the registration reference; sockets/takers may
+                   // still hold theirs — the last one deletes
+}
+
+// Enable the multi-protocol raw fallback on the running server: framing
+// the native cut loop doesn't recognize is handed to the Python protocol
+// stack as ordered raw chunks instead of failing the socket. Call right
+// after nat_rpc_server_start, before clients connect.
+int nat_rpc_server_enable_raw_fallback(int enable) {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  NatServer* srv = g_rpc_server;
+  if (srv == nullptr) return -1;
+  srv->raw_fallback = (enable != 0);
+  return 0;
+}
+
+// Enable native HTTP/1.1 + h2/gRPC parsing on the running server:
+// HTTP-shaped connections are parsed in the native cut loop and delivered
+// to the py lane as kind-3/kind-4 requests (parse native, execute Python)
+// instead of riding the raw chunk lane. Call right after start.
+int nat_rpc_server_native_http(int enable) {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  NatServer* srv = g_rpc_server;
+  if (srv == nullptr) return -1;
+  srv->native_http = (enable != 0);
+  return 0;
+}
+
+int32_t nat_req_kind(void* h) { return ((PyRequest*)h)->kind; }
+
+uint64_t nat_rpc_server_requests() {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  return g_rpc_server ? g_rpc_server->requests.load() : 0;
+}
+
+uint64_t nat_rpc_server_connections() {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  return g_rpc_server ? g_rpc_server->connections.load() : 0;
+}
+
+// ---- Python lane (usercode on pthreads) ----
+
+void* nat_take_request(int timeout_ms) {
+  NatServer* srv;
+  {
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    srv = g_rpc_server;
+    if (srv == nullptr) return nullptr;
+    srv->add_ref();  // keeps the server alive across the blocking wait
+  }
+  void* r = srv->take_py(timeout_ms);
+  srv->release();
+  return r;
+}
+
+const char* nat_req_field(void* h, int which, size_t* len) {
+  PyRequest* r = (PyRequest*)h;
+  const std::string* s = nullptr;
+  switch (which) {
+    case 0: s = &r->service; break;
+    case 1: s = &r->method; break;
+    case 2: s = &r->payload; break;
+    case 3: s = &r->attachment; break;
+    case 4: s = &r->meta_bytes; break;
+    default: *len = 0; return nullptr;
+  }
+  *len = s->size();
+  return s->data();
+}
+
+int64_t nat_req_cid(void* h) { return ((PyRequest*)h)->cid; }
+int32_t nat_req_compress(void* h) { return ((PyRequest*)h)->compress_type; }
+uint64_t nat_req_sock_id(void* h) { return ((PyRequest*)h)->sock_id; }
+void nat_req_free(void* h) { delete (PyRequest*)h; }
+
+// Raw write of pre-framed bytes onto a live connection — lets the Python
+// protocol layer (send_rpc_response with its full feature set) answer
+// py-lane requests through the native Socket write queue.
+int nat_sock_write(uint64_t sock_id, const char* data, size_t len) {
+  NatSocket* s = sock_address(sock_id);
+  if (s == nullptr) return -1;
+  IOBuf out;
+  out.append(data, len);
+  int rc = s->write(std::move(out));
+  s->release();
+  return rc;
+}
+
+int nat_sock_set_failed(uint64_t sock_id) {
+  NatSocket* s = sock_address(sock_id);
+  if (s == nullptr) return -1;
+  s->set_failed();
+  s->release();
+  return 0;
+}
+
+// Respond to a py-lane request and free it. Returns 0, or -1 if the
+// connection is gone.
+int nat_respond(void* h, int32_t error_code, const char* error_text,
+                const char* payload, size_t payload_len, const char* att,
+                size_t att_len) {
+  PyRequest* r = (PyRequest*)h;
+  NatSocket* s = sock_address(r->sock_id);
+  int rc = -1;
+  if (s != nullptr) {
+    IOBuf out, pay, attach;
+    if (payload_len) pay.append(payload, payload_len);
+    if (att_len) attach.append(att, att_len);
+    build_response_frame(&out, r->cid, error_code,
+                         error_text ? error_text : "", std::move(pay),
+                         std::move(attach));
+    rc = s->write(std::move(out));
+    s->release();
+  }
+  delete r;
+  return rc;
+}
+
+// Enables the RingListener datapath for subsequently-accepted server
+// connections. Returns 1 when the ring is live, 0 when the kernel/sandbox
+// refuses io_uring (the runtime stays on epoll), -1 on runtime failure.
+int nat_rpc_use_io_uring(int enable) {
+  if (!enable) {
+    g_use_ring.store(false, std::memory_order_release);
+    return 0;
+  }
+  if (ensure_runtime(0) != 0) return -1;
+  {
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    if (g_ring == nullptr) {
+      RingListener* ring = new RingListener();
+      // wake a parked worker per completion batch (ExtWakeup role);
+      // installed before init() so the poller never runs without it
+      ring->set_wake_fn([] { Scheduler::instance()->wake_one(); });
+      // the poller drains its own harvest inline (every completion
+      // consumer is non-blocking), with butex wakes batched per drain —
+      // the worker idle hook below stays as a backup drain path
+      ring->set_drain_fn([]() -> bool {
+        static thread_local std::vector<Fiber*> batch;
+        if (g_ring_draining.load(std::memory_order_acquire)) {
+          return false;  // a worker holds the baton: let the poller
+        }                // wake one instead of silently dropping
+        Scheduler::instance()->arm_wake_batch(&batch);
+        bool did = ring_drain();
+        Scheduler::instance()->flush_wake_batch();
+        return did;
+      });
+      if (!ring->init()) {
+        delete ring;
+        return 0;  // io_uring unavailable here: keep epoll
+      }
+      g_ring = ring;
+      // the wait_task drain seam (task_group.cpp:158-169)
+      Scheduler::instance()->add_idle_hook(ring_drain);
+    }
+  }
+  g_use_ring.store(true, std::memory_order_release);
+  return 1;
+}
+
+// Ring observability for tests/bench: completion counts.
+void nat_ring_counters(uint64_t* recv_out, uint64_t* send_out) {
+  if (recv_out != nullptr)
+    *recv_out = g_ring != nullptr ? g_ring->recv_completions() : 0;
+  if (send_out != nullptr)
+    *send_out = g_ring != nullptr ? g_ring->send_completions() : 0;
+}
+
+}  // extern "C"
+
+}  // namespace brpc_tpu

@@ -1,0 +1,495 @@
+// NatSocket + versioned-id registry + the io_uring datapath seam.
+//
+// This is the native counterpart of brpc::Socket (socket.cpp): a
+// versioned-id registry (socket_inl.h:28-185), a single-writer write queue
+// with inline first attempt + KeepWrite fiber on partial writes (the
+// lock+deque rendition of the wait-free design, socket.h:293-333),
+// SetFailed draining queued writes, and the RingListener fixed-buffer send
+// lane (the fork's io_uring discipline).
+#include "nat_internal.h"
+
+namespace brpc_tpu {
+
+// ---------------------------------------------------------------------------
+// registry
+// ---------------------------------------------------------------------------
+
+std::atomic<std::atomic<NatSocket*>*> g_sock_slab[kSockSlabs];
+std::mutex g_sock_alloc_mu;
+std::vector<uint32_t> g_sock_free;
+uint32_t g_sock_next_idx = 0;
+
+// Allocate (or reuse) a socket slot; the returned socket has refcount 1
+// (the registry/creator reference) and a fresh version in both its id and
+// its versioned_ref.
+NatSocket* sock_create() {
+  uint32_t idx;
+  NatSocket* s = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_sock_alloc_mu);
+    if (!g_sock_free.empty()) {
+      idx = g_sock_free.back();
+      g_sock_free.pop_back();
+      s = sock_at(idx);
+    } else {
+      idx = g_sock_next_idx++;
+      uint32_t slab_i = idx >> kSockSlabBits;
+      if (slab_i >= kSockSlabs) return nullptr;
+      if (g_sock_slab[slab_i].load(std::memory_order_relaxed) == nullptr) {
+        auto* slab = new std::atomic<NatSocket*>[kSockSlabSize]();
+        g_sock_slab[slab_i].store(slab, std::memory_order_release);
+      }
+      // construct + publish while still holding the alloc lock so the
+      // hwm-bounded server-stop scan can never see a half-built socket
+      // (the slot store is release; sock_at loads acquire)
+      s = new NatSocket();  // lives forever in its slot
+      g_sock_slab[slab_i].load(std::memory_order_acquire)
+          [idx & (kSockSlabSize - 1)]
+              .store(s, std::memory_order_release);
+      s = nullptr;  // fall through to the common init below
+    }
+  }
+  if (s == nullptr) {
+    s = sock_at(idx);
+  } else {
+    s->reset_for_reuse();
+  }
+  uint32_t ver = s->next_version++;
+  if (ver == 0) ver = s->next_version++;  // version 0 reserved (= dead)
+  s->id = ((uint64_t)ver << 32) | idx;
+  s->versioned_ref.store(((uint64_t)ver << 32) | 1,
+                         std::memory_order_release);
+  return s;
+}
+
+// Address with a borrowed reference (caller must release()); nullptr once
+// the id generation is stale — use-after-free-proof, lock-free.
+NatSocket* sock_address(uint64_t id) {
+  uint32_t idx = (uint32_t)(id & 0xffffffffu);
+  uint32_t ver = (uint32_t)(id >> 32);
+  NatSocket* s = sock_at(idx);
+  if (s == nullptr) return nullptr;
+  uint64_t vr = s->versioned_ref.load(std::memory_order_acquire);
+  while ((uint32_t)(vr >> 32) == ver && (uint32_t)vr != 0) {
+    if (s->versioned_ref.compare_exchange_weak(vr, vr + 1,
+                                               std::memory_order_acq_rel)) {
+      return s;
+    }
+  }
+  return nullptr;
+}
+
+// Invalidate the id (bump the version, keeping the refcount) so future
+// sock_address calls fail; existing references stay valid until released.
+void sock_unregister(NatSocket* s) {
+  uint64_t vr = s->versioned_ref.load(std::memory_order_acquire);
+  while (true) {
+    uint64_t bumped = vr + (1ull << 32);
+    if (s->versioned_ref.compare_exchange_weak(vr, bumped,
+                                               std::memory_order_acq_rel)) {
+      s->next_version = (uint32_t)(bumped >> 32) + 1;
+      return;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// NatSocket
+// ---------------------------------------------------------------------------
+
+RingListener* g_ring = nullptr;
+std::atomic<bool> g_use_ring{false};
+std::atomic<bool> g_ring_draining{false};
+static std::mutex g_ring_retry_mu;
+static std::vector<uint64_t> g_ring_retry;  // sockets w/ unsubmitted sends
+
+void NatSocket::release() {
+  uint64_t prev = versioned_ref.fetch_sub(1, std::memory_order_acq_rel);
+  if ((uint32_t)prev == 1) {
+    // Deferred close (brpc defers to refcount-zero too, socket.cpp): the
+    // fd number is only recycled once no fiber can still syscall on it,
+    // so a stale writev can never land on a reused descriptor. The object
+    // itself is NEVER freed (ResourcePool discipline) — its slot goes
+    // back to the freelist for the next sock_create.
+    if (fd >= 0) {
+      ::close(fd);
+      fd = -1;
+    }
+    if (channel != nullptr) {
+      channel->release();
+      channel = nullptr;
+    }
+    if (server != nullptr) {
+      server->release();
+      server = nullptr;
+    }
+    if (http != nullptr) {
+      http_session_free(http);
+      http = nullptr;
+    }
+    if (h2 != nullptr) {
+      h2_session_free(h2);
+      h2 = nullptr;
+    }
+    in_buf.clear();
+    {
+      std::lock_guard<std::mutex> g(write_mu);
+      write_q.clear();
+    }
+    uint32_t idx = (uint32_t)(id & 0xffffffffu);
+    std::lock_guard<std::mutex> g(g_sock_alloc_mu);
+    g_sock_free.push_back(idx);
+  }
+}
+
+void NatSocket::reset_for_reuse() {
+  fd = -1;
+  disp = nullptr;
+  server = nullptr;
+  channel = nullptr;
+  failed.store(false, std::memory_order_relaxed);
+  writing = false;
+  defer_writes = false;
+  epoll_events = 0;
+  epollout.value.store(0, std::memory_order_relaxed);
+  ring_ref.store(-1, std::memory_order_relaxed);
+  ring_sending = false;
+  ring_inflight = 0;
+  py_raw.store(false, std::memory_order_relaxed);
+  py_raw_seq = 0;
+  http = nullptr;
+  h2 = nullptr;
+}
+
+void NatSocket::set_failed() {
+  bool was = failed.exchange(true);
+  if (was) return;
+  {
+    int64_t rr = ring_ref.exchange(-1, std::memory_order_acq_rel);
+    if (rr >= 0 && g_ring != nullptr) {
+      g_ring->unregister_file((int)(rr & 0xffffffff));  // cancels recv
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(write_mu);
+    write_q.clear();
+    writing = false;
+    ring_sending = false;
+    ring_inflight = 0;
+  }
+  if (fd >= 0) {
+    epoll_ctl(disp->epfd, EPOLL_CTL_DEL, fd, nullptr);
+    // shutdown (not close): in-flight reader/KeepWrite syscalls return
+    // with EOF/EPIPE instead of racing a recycled fd number.
+    ::shutdown(fd, SHUT_RDWR);
+  }
+  // wake any KeepWrite parked on EPOLLOUT
+  epollout.value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(&epollout, INT32_MAX);
+  if (py_raw.load(std::memory_order_acquire) && server != nullptr) {
+    // tell the Python protocol stack to drop this connection's session
+    PyRequest* r = new PyRequest();
+    r->kind = 2;
+    r->sock_id = id;
+    server->enqueue_py(r);
+  }
+  if (channel != nullptr) {
+    channel->fail_all(kEFAILEDSOCKET, "socket failed");
+    if (channel->health_check_interval_ms > 0 &&
+        !channel->closed.load(std::memory_order_acquire) &&
+        !channel->hc_pending.exchange(true, std::memory_order_acq_rel)) {
+      channel->add_ref();  // held by the revival chain
+      TimerThread::instance()->schedule(health_check_fire, channel,
+                                        channel->health_check_interval_ms);
+    }
+  }
+  if (server != nullptr) server->connections.fetch_sub(1);
+  sock_unregister(this);
+  release();  // drop the registry's reference
+}
+
+void NatSocket::arm_epollout() {
+  std::lock_guard<std::mutex> g(write_mu);
+  if (failed.load(std::memory_order_acquire)) return;
+  uint32_t want = EPOLLIN | EPOLLET | EPOLLOUT;
+  if (epoll_events == want) return;
+  struct epoll_event ev;
+  ev.events = want;
+  ev.data.u64 = id;
+  if (epoll_ctl(disp->epfd, EPOLL_CTL_MOD, fd, &ev) == 0) epoll_events = want;
+}
+
+void NatSocket::disarm_epollout() {
+  std::lock_guard<std::mutex> g(write_mu);
+  if (failed.load(std::memory_order_acquire)) return;
+  uint32_t want = EPOLLIN | EPOLLET;
+  if (epoll_events == want) return;
+  struct epoll_event ev;
+  ev.events = want;
+  ev.data.u64 = id;
+  if (epoll_ctl(disp->epfd, EPOLL_CTL_MOD, fd, &ev) == 0) epoll_events = want;
+}
+
+bool NatSocket::flush_some() {
+  while (true) {
+    IOBuf batch;
+    {
+      std::lock_guard<std::mutex> g(write_mu);
+      if (write_q.empty()) {
+        writing = false;
+        return true;
+      }
+      batch.append(std::move(write_q));  // take the whole queue: syscall
+                                         // batching across responses
+    }
+    while (!batch.empty()) {
+      ssize_t n = batch.cut_into_fd(fd);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+          // put leftovers back at the FRONT (later writes are behind us)
+          std::lock_guard<std::mutex> g(write_mu);
+          batch.append(std::move(write_q));
+          write_q = std::move(batch);
+          return false;
+        }
+        set_failed();
+        return true;
+      }
+    }
+  }
+}
+
+void keep_write_fiber(void* arg) {
+  NatSocket* s = (NatSocket*)arg;
+  while (!s->failed.load(std::memory_order_acquire)) {
+    if (s->flush_some()) break;  // common case: drained, no epoll_ctl
+    int32_t expected = s->epollout.value.load(std::memory_order_acquire);
+    s->arm_epollout();
+    // second attempt covers a became-writable-before-arm race
+    if (s->flush_some()) break;
+    Scheduler::butex_wait(&s->epollout, expected);
+  }
+  s->disarm_epollout();
+  s->release();
+}
+
+// Submits the front of write_q as one fixed-buffer send. Requires
+// write_mu. Returns false when no buffer/SQE was free (retry later via
+// the drain loop's retry list).
+static bool ring_submit_locked(NatSocket* s) {
+  if (s->ring_sending || s->write_q.empty()
+      || s->failed.load(std::memory_order_acquire)) {
+    return true;
+  }
+  int64_t rr = s->ring_ref.load(std::memory_order_acquire);
+  if (rr < 0) return true;  // demoted/failed; bytes drain elsewhere
+  uint16_t buf;
+  char* dst = g_ring->acquire_send_buffer(&buf);
+  if (dst == nullptr) return false;
+  size_t n = s->write_q.length();
+  if (n > RingListener::kSendBufSize) n = RingListener::kSendBufSize;
+  s->write_q.copy_to(dst, n);  // straight into registered memory
+  if (!g_ring->submit_send((int)(rr & 0xffffffff), (uint32_t)(rr >> 32),
+                           s->id, buf, n)) {
+    return false;
+  }
+  s->ring_sending = true;
+  s->ring_inflight = n;
+  return true;
+}
+
+static void ring_retry_later(uint64_t sock_id) {
+  std::lock_guard<std::mutex> g(g_ring_retry_mu);
+  g_ring_retry.push_back(sock_id);
+}
+
+int NatSocket::write(IOBuf&& frame) {
+  if (failed.load(std::memory_order_acquire)) return -1;
+  if (ring_ref.load(std::memory_order_acquire) >= 0) {
+    // io_uring lane: queue + submit from registered send memory; ordering
+    // is kept by the single-in-flight discipline.
+    bool need_retry;
+    {
+      std::lock_guard<std::mutex> g(write_mu);
+      if (failed.load(std::memory_order_acquire)) return -1;
+      write_q.append(std::move(frame));
+      need_retry = !ring_submit_locked(this);
+    }
+    if (need_retry) ring_retry_later(id);
+    return 0;
+  }
+  bool become_writer = false;
+  {
+    std::lock_guard<std::mutex> g(write_mu);
+    if (failed.load(std::memory_order_acquire)) return -1;
+    write_q.append(std::move(frame));
+    if (!writing) {
+      writing = true;
+      become_writer = true;
+    }
+  }
+  if (!become_writer) return 0;  // active writer will drain us
+  if (defer_writes) {
+    // Batch mode: the writer fiber runs AFTER the currently-ready fibers,
+    // so their appends coalesce into one writev.
+    add_ref();
+    Scheduler::instance()->spawn_detached_back(keep_write_fiber, this);
+    return 0;
+  }
+  // Inline first attempt on the caller's thread/fiber (socket.cpp:1287);
+  // leftovers go to a KeepWrite fiber waiting on EPOLLOUT.
+  if (!flush_some()) {
+    add_ref();
+    Scheduler::instance()->spawn_detached(keep_write_fiber, this);
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// ring lane (completion drain, demotion, adoption)
+// ---------------------------------------------------------------------------
+
+// After a socket leaves the ring lane with bytes still queued, no sender
+// owns them (ring_submit_locked no-ops on demoted sockets): hand them to
+// the epoll KeepWrite lane or the peer hangs waiting for a response.
+void kick_epoll_writer_if_stranded(NatSocket* s) {
+  bool kick = false;
+  {
+    std::lock_guard<std::mutex> g(s->write_mu);
+    if (s->ring_ref.load(std::memory_order_acquire) < 0 &&
+        !s->write_q.empty() && !s->writing && !s->ring_sending &&
+        !s->failed.load(std::memory_order_acquire)) {
+      s->writing = true;
+      kick = true;
+    }
+  }
+  if (kick) {
+    s->add_ref();
+    Scheduler::instance()->spawn_detached(keep_write_fiber, s);
+  }
+}
+
+// Moves a ring socket to the epoll lane (rearm impossible / multishot
+// unsupported); the CAS makes demotion and set_failed mutually exclusive.
+static void ring_demote_to_epoll(NatSocket* s, int64_t rr) {
+  if (s->ring_ref.compare_exchange_strong(rr, -1)) {
+    g_ring->unregister_file((int)(rr & 0xffffffff));
+    s->disp->add_consumer(s);
+    kick_epoll_writer_if_stranded(s);
+  }
+}
+
+// Drains harvested ring completions — the wait_task drain of the fork
+// (task_group.cpp:158-169): recv bytes feed the SAME cut loop the epoll
+// readers use; send completions recycle fixed buffers and launch the next
+// chunk. Registered as a scheduler idle hook; one worker drains at a time
+// so per-socket completion order is preserved.
+bool ring_drain() {
+  if (g_ring == nullptr) return false;
+  if (g_ring_draining.exchange(true, std::memory_order_acquire)) {
+    return false;
+  }
+  bool did = false;
+  RingCompletion c;
+  while (g_ring->pop_completion(&c)) {
+    did = true;
+    NatSocket* s = sock_address(c.tag);
+    if (c.kind == 0) {  // recv
+      if (c.res > 0) {
+        if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+          s->in_buf.append(g_ring->buffer_data(c.buf_id), (size_t)c.res);
+          g_ring->recycle_buffer(c.buf_id);
+          int64_t rr = s->ring_ref.load(std::memory_order_acquire);
+          if (!process_input(s)) {
+            s->set_failed();
+          } else if (!c.more && rr >= 0 &&
+                     !g_ring->rearm_recv((int)(rr & 0xffffffff),
+                                         (uint32_t)(rr >> 32), s->id)) {
+            ring_demote_to_epoll(s, rr);  // SQ full: don't go deaf
+          }
+        } else {
+          g_ring->recycle_buffer(c.buf_id);  // owner gone: recycle only
+        }
+      } else if (s != nullptr) {
+        int64_t rr = s->ring_ref.load(std::memory_order_acquire);
+        if (c.res == -ENOBUFS) {
+          // provided buffers were exhausted; they're recycled as we
+          // drain, so re-arm and keep going
+          if (rr >= 0 && !g_ring->rearm_recv((int)(rr & 0xffffffff),
+                                             (uint32_t)(rr >> 32), s->id)) {
+            ring_demote_to_epoll(s, rr);
+          }
+        } else if (c.res == -EINVAL && rr >= 0) {
+          // kernel lacks multishot recv (pre-6.0): demote this
+          // connection to the epoll lane instead of killing it
+          ring_demote_to_epoll(s, rr);
+        } else if (!c.more) {
+          s->set_failed();  // EOF (0) or hard error
+        }
+      }
+    } else {  // send
+      g_ring->recycle_send_buffer(c.send_buf);
+      if (s != nullptr) {
+        if (c.res < 0) {
+          s->set_failed();
+        } else {
+          bool need_retry;
+          {
+            std::lock_guard<std::mutex> g(s->write_mu);
+            size_t done = (size_t)c.res;
+            if (done > s->ring_inflight) done = s->ring_inflight;
+            s->write_q.pop_front(done);
+            s->ring_sending = false;
+            s->ring_inflight = 0;
+            need_retry = !ring_submit_locked(s);
+          }
+          if (need_retry) ring_retry_later(s->id);
+          // a demotion landing between completions leaves queued bytes
+          // with no sender: hand them to the epoll write lane
+          kick_epoll_writer_if_stranded(s);
+        }
+      }
+    }
+    if (s != nullptr) s->release();
+  }
+  // retry sends that couldn't get a buffer/SQE earlier
+  std::vector<uint64_t> retry;
+  {
+    std::lock_guard<std::mutex> g(g_ring_retry_mu);
+    retry.swap(g_ring_retry);
+  }
+  for (uint64_t sid : retry) {
+    NatSocket* s = sock_address(sid);
+    if (s == nullptr) continue;
+    bool again;
+    {
+      std::lock_guard<std::mutex> g(s->write_mu);
+      again = !ring_submit_locked(s);
+    }
+    if (again) ring_retry_later(sid);
+    kick_epoll_writer_if_stranded(s);
+    s->release();
+  }
+  g_ring_draining.store(false, std::memory_order_release);
+  return did;
+}
+
+// Put a freshly-connected fd on the ring lane when it is enabled (both
+// directions then ride io_uring and drain on the poller — the accept
+// path's twin). Returns true when the ring owns the socket's reads.
+bool try_ring_adopt(NatSocket* s) {
+  if (!g_use_ring.load(std::memory_order_acquire) || g_ring == nullptr) {
+    return false;
+  }
+  uint32_t gen = 0;
+  int fidx = g_ring->register_file(s->fd, &gen);
+  if (fidx < 0) return false;
+  int64_t rr = ((int64_t)gen << 32) | (uint32_t)fidx;
+  s->ring_ref.store(rr, std::memory_order_release);
+  if (g_ring->rearm_recv(fidx, gen, s->id)) return true;
+  s->ring_ref.store(-1, std::memory_order_release);
+  g_ring->unregister_file(fidx);
+  return false;
+}
+
+}  // namespace brpc_tpu
