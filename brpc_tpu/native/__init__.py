@@ -158,6 +158,21 @@ def load() -> ctypes.CDLL:
         lib.nat_ring_counters.argtypes = [
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
         lib.nat_ring_counters.restype = None
+        # -- native HTTP/1.1 lane --
+        lib.nat_rpc_server_native_http.argtypes = [ctypes.c_int]
+        lib.nat_rpc_server_native_http.restype = ctypes.c_int
+        lib.nat_http_respond.argtypes = [
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_int]
+        lib.nat_http_respond.restype = ctypes.c_int
+        lib.nat_sock_graceful_close.argtypes = [ctypes.c_uint64]
+        lib.nat_sock_graceful_close.restype = ctypes.c_int
+        lib.nat_http_client_bench.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_http_client_bench.restype = ctypes.c_double
         _lib = lib
         return lib
 
@@ -252,21 +267,27 @@ def rpc_server_requests() -> int:
 
 def take_request(timeout_ms: int = 100):
     """Python lane: pull one item handed off by the native runtime.
-    Returns (handle, kind, meta_bytes, payload, attachment, sock_id, seq)
-    or None. kind 0 = parsed tpu_std request; 1 = raw protocol bytes
-    (seq orders chunks per socket); 2 = connection closed."""
+    Returns (handle, kind, meta_bytes, payload, attachment, sock_id, seq,
+    f0, f1) or None. kind 0 = parsed tpu_std request; 1 = raw protocol
+    bytes (seq orders chunks per socket); 2 = connection closed; 3 =
+    native-parsed HTTP request (f0 = verb, f1 = uri, meta_bytes =
+    lowercased "key: value\\n" header lines, payload = body, seq = the
+    connection-ordered response token for http_respond)."""
     lib = load()
     h = lib.nat_take_request(timeout_ms)
     if not h:
         return None
-    out = []
-    for which in (4, 2, 3):
+    kind = lib.nat_req_kind(h)
+    def field(which):
         n = ctypes.c_size_t(0)
         p = lib.nat_req_field(h, which, ctypes.byref(n))
-        out.append(ctypes.string_at(p, n.value) if p and n.value else b"")
-    meta_bytes, payload, attachment = out
-    return (h, lib.nat_req_kind(h), meta_bytes, payload, attachment,
-            lib.nat_req_sock_id(h), lib.nat_req_cid(h))
+        return ctypes.string_at(p, n.value) if p and n.value else b""
+    if kind == 3:
+        return (h, kind, field(4), field(2), b"",
+                lib.nat_req_sock_id(h), lib.nat_req_cid(h),
+                field(0), field(1))
+    return (h, kind, field(4), field(2), field(3),
+            lib.nat_req_sock_id(h), lib.nat_req_cid(h), b"", b"")
 
 
 def rpc_server_enable_raw_fallback(enable: bool = True) -> int:
@@ -290,6 +311,44 @@ def sock_write(sock_id: int, data: bytes) -> int:
 
 def sock_set_failed(sock_id: int) -> int:
     return load().nat_sock_set_failed(sock_id)
+
+
+def sock_graceful_close(sock_id: int) -> int:
+    """Fail the socket once queued writes drain (FIN after the last
+    response byte) — Connection: close semantics."""
+    return load().nat_sock_graceful_close(sock_id)
+
+
+def rpc_server_native_http(enable: bool = True) -> int:
+    """Native HTTP/1.1 lane: HTTP-shaped connections parse in the native
+    cut loop and surface as kind-3 py-lane requests."""
+    return load().nat_rpc_server_native_http(1 if enable else 0)
+
+
+def http_respond(sock_id: int, seq: int, data: bytes,
+                 close_after: bool = False) -> int:
+    """Answer a kind-3 request: data is the complete serialized HTTP
+    response; ordering across pipelined requests is enforced natively."""
+    return load().nat_http_respond(sock_id, seq, data, len(data),
+                                   1 if close_after else 0)
+
+
+def http_client_bench(ip: str, port: int, nconn: int = 4,
+                      pipeline: int = 32, seconds: float = 2.0,
+                      path: str = "/echo", post_body: bytes = b"",
+                      content_type: str = "application/octet-stream"
+                      ) -> dict:
+    """HTTP bench client (blocking sockets, pipelined keep-alive).
+    Empty post_body = GET, else POST with that body."""
+    if isinstance(post_body, int):  # tolerate the byte-count shorthand
+        post_body = b"x" * post_body
+    out_requests = ctypes.c_uint64(0)
+    qps = load().nat_http_client_bench(ip.encode(), port, nconn, pipeline,
+                                       seconds, path.encode(), post_body,
+                                       len(post_body),
+                                       content_type.encode(),
+                                       ctypes.byref(out_requests))
+    return {"qps": qps, "requests": out_requests.value}
 
 
 def respond(handle, error_code: int = 0, error_text: str = "",

@@ -54,6 +54,31 @@ class NativeSocketShim:
         return None
 
 
+class _NativeHttpShim(NativeSocketShim):
+    """Response path for a native-parsed HTTP request (kind 3): the
+    serialized response rides nat_http_respond, which preserves pipelined
+    request order via the native session's reorder window. Connection:
+    close is honored natively (the parse records close-requesting seqs),
+    so the ECLOSE set_failed from http_protocol._respond is a no-op here
+    — a hard set_failed would race earlier pipelined responses."""
+
+    def __init__(self, sock_id: int, seq: int):
+        super().__init__(sock_id)
+        self.seq = seq
+
+    def write(self, buf, id_wait=None) -> int:
+        data = buf.copy_to_bytes(len(buf))
+        return native.http_respond(self.sock_id, self.seq, data)
+
+    def set_failed(self, error_code=0, error_text: str = ""):
+        from brpc_tpu.rpc import errors
+
+        self._failed = True
+        if error_code == errors.ECLOSE:
+            return  # native close_seqs closes after this response flushes
+        native.sock_set_failed(self.sock_id)
+
+
 class _RawSession:
     """Per-connection protocol session for the raw fallback lane (the
     native port's multi-protocol capability, input_messenger.h:33-154):
@@ -127,6 +152,13 @@ class NativeRuntimeMount:
                          if p.name in self.server.options.enabled_protocols]
         self._messenger = InputMessenger(protocols, arg=self.server)
         native.rpc_server_enable_raw_fallback(True)
+        # native HTTP/1.1 parse lane (kind-3 requests): parse native,
+        # execute Python — only when the http protocol is mounted
+        if any(p.name == "http" for p in protocols):
+            try:
+                native.rpc_server_native_http(True)
+            except AttributeError:
+                pass  # older .so without the lane
         for i in range(self._num_threads):
             t = threading.Thread(target=self._worker,
                                  name=f"native_py_lane_{i}", daemon=True)
@@ -150,7 +182,12 @@ class NativeRuntimeMount:
             item = native.take_request(100)
             if item is None:
                 continue
-            handle, kind, meta_bytes, payload, attachment, sock_id, seq = item
+            (handle, kind, meta_bytes, payload, attachment, sock_id, seq,
+             f0, f1) = item
+            if kind == 3:  # native-parsed HTTP request
+                native.req_free(handle)
+                self._handle_http(f0, f1, meta_bytes, payload, sock_id, seq)
+                continue
             if kind == 1:  # raw protocol bytes
                 native.req_free(handle)
                 with self._raw_lock:
@@ -184,3 +221,39 @@ class NativeRuntimeMount:
             finally:
                 if handle is not None:
                     native.req_free(handle)
+
+    def _handle_http(self, verb: bytes, uri: bytes, flat_headers: bytes,
+                     body: bytes, sock_id: int, seq: int):
+        """kind-3 dispatch: rebuild the HttpRequest from natively-parsed
+        fields and run the unchanged Python HTTP server path (routing,
+        RESTful map, builtin console, RPC-over-HTTP). Ordering across
+        pipelined requests is native-side, so workers may process
+        same-connection requests concurrently."""
+        from brpc_tpu.butil.iobuf import IOBuf as _IOBuf
+        from brpc_tpu.rpc.http_message import HttpRequest
+        from brpc_tpu.rpc.http_protocol import (
+            HttpInputMessage,
+            process_request as http_process_request,
+        )
+
+        try:
+            req = HttpRequest(verb.decode("latin-1"), uri.decode("latin-1"))
+            hd = req.headers._headers
+            for line in flat_headers.decode("latin-1").split("\n"):
+                if line:
+                    k, _, v = line.partition(": ")
+                    hd[k] = v  # keys pre-lowercased natively
+            if body:
+                req.body = _IOBuf(body)
+            msg = HttpInputMessage(req)
+            msg.socket = _NativeHttpShim(sock_id, seq)
+            msg.arg = self.server
+            http_process_request(msg)
+        except Exception as e:
+            resp = (f"HTTP/1.1 500 Internal Server Error\r\n"
+                    f"Content-Length: {len(str(e)) + 1}\r\n\r\n"
+                    f"{e}\n").encode()
+            try:
+                native.http_respond(sock_id, seq, resp)
+            except Exception:
+                pass

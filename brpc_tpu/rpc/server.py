@@ -64,10 +64,16 @@ class ServerOptions:
     ssl_keyfile: str = ""
     # Mount the port on the native C++ runtime (nat_rpc.cpp): accept/epoll/
     # framing/writes run on fibers + native IOBuf; Python services execute
-    # on the py lane (usercode_backup_pool discipline). tpu_std only —
-    # other protocols and the HTTP console need a Python-port server — and
-    # at most ONE native-runtime server may be live per process.
+    # on the py lane (usercode_backup_pool discipline). tpu_std and HTTP
+    # parse natively; other protocols ride the raw fallback lane to the
+    # Python protocol stack. At most ONE native-runtime server may be
+    # live per process.
     use_native_runtime: bool = False
+    # With use_native_runtime: also register the built-in NATIVE echo
+    # usercode (tpu_std EchoService.Echo + HTTP POST /echo) — C++ handlers
+    # that shadow same-named Python services, the builtin-native-service
+    # discipline of server.cpp:468-563. Bench/diagnostic lanes.
+    native_builtin_echo: bool = False
 
 
 class Server:
@@ -180,7 +186,9 @@ class Server:
                 self._native_mount = NativeRuntimeMount(
                     self, self.options.num_threads)
                 try:
-                    port = self._native_mount.start(ep.ip, ep.port)
+                    port = self._native_mount.start(
+                        ep.ip, ep.port,
+                        native_echo=self.options.native_builtin_echo)
                 except Exception:
                     # bind conflict, toolchain missing, or a second native
                     # server (the runtime mounts ONE per process)

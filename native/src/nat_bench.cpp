@@ -321,6 +321,114 @@ double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
   return dt_qps * (double)att_bytes / 1e9;
 }
 
+// HTTP/1.1 bench client: plain blocking sockets on pthreads issuing
+// `pipeline` keep-alive requests per write, counting parsed responses —
+// the benchmark_http example shape. Measures the server-side native HTTP
+// lane (native parse + native or py usercode); the client is deliberately
+// protocol-minimal so the server is the bottleneck.
+double nat_http_client_bench(const char* ip, int port, int nconn,
+                             int pipeline, double seconds, const char* path,
+                             const char* body, size_t body_len,
+                             const char* content_type,
+                             uint64_t* out_requests) {
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> total{0};
+  std::string req;
+  if (body_len > 0) {
+    char hdr[320];
+    snprintf(hdr, sizeof(hdr),
+             "POST %s HTTP/1.1\r\nHost: bench\r\n"
+             "Content-Type: %s\r\n"
+             "Content-Length: %zu\r\n\r\n",
+             path,
+             content_type != nullptr ? content_type
+                                     : "application/octet-stream",
+             body_len);
+    req = hdr;
+    req.append(body, body_len);
+  } else {
+    char hdr[256];
+    snprintf(hdr, sizeof(hdr), "GET %s HTTP/1.1\r\nHost: bench\r\n\r\n",
+             path);
+    req = hdr;
+  }
+  std::string batch;
+  for (int i = 0; i < (pipeline > 0 ? pipeline : 1); i++) batch += req;
+  std::vector<std::thread> threads;
+  for (int c = 0; c < nconn; c++) {
+    threads.emplace_back([&, c] {
+      int fd = dial_nonblocking(ip, port, 5000);
+      if (fd < 0) return;
+      int fl = fcntl(fd, F_GETFL, 0);
+      fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);  // blocking I/O for the bench
+      struct timeval tv = {0, 200000};       // stop stays responsive
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      std::string rbuf;
+      char tmp[65536];
+      size_t scanned = 0;  // rbuf prefix already known headerless
+      while (!stop.load(std::memory_order_relaxed)) {
+        size_t off = 0;
+        while (off < batch.size()) {
+          ssize_t w = ::send(fd, batch.data() + off, batch.size() - off, 0);
+          if (w <= 0) goto out;
+          off += (size_t)w;
+        }
+        int need = pipeline > 0 ? pipeline : 1;
+        while (need > 0 && !stop.load(std::memory_order_relaxed)) {
+          // parse complete responses at the front of rbuf
+          bool progressed = true;
+          while (need > 0 && progressed) {
+            progressed = false;
+            size_t he = rbuf.find("\r\n\r\n", scanned);
+            if (he == std::string::npos) {
+              scanned = rbuf.size() > 3 ? rbuf.size() - 3 : 0;
+              break;
+            }
+            size_t cl = 0;
+            for (size_t i = 0; i + 15 < he; i++) {
+              if ((rbuf[i] == 'c' || rbuf[i] == 'C') &&
+                  strncasecmp(rbuf.c_str() + i, "content-length:", 15) ==
+                      0) {
+                cl = (size_t)strtoull(rbuf.c_str() + i + 15, nullptr, 10);
+                break;
+              }
+            }
+            if (rbuf.size() < he + 4 + cl) break;  // body incomplete
+            // only 2xx responses count — a lane answering 400s is broken
+            bool ok2xx = rbuf.size() > 9 && rbuf[9] == '2';
+            rbuf.erase(0, he + 4 + cl);
+            scanned = 0;
+            if (ok2xx) total.fetch_add(1, std::memory_order_relaxed);
+            need--;
+            progressed = true;
+          }
+          if (need == 0) break;
+          ssize_t r = ::recv(fd, tmp, sizeof(tmp), 0);
+          if (r <= 0) {
+            if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK) &&
+                !stop.load(std::memory_order_relaxed)) {
+              continue;  // rcv timeout while the server warms up
+            }
+            goto out;
+          }
+          rbuf.append(tmp, (size_t)r);
+        }
+      }
+    out:
+      ::close(fd);
+    });
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((int64_t)(seconds * 1000)));
+  stop.store(true);
+  for (auto& t : threads) t.join();
+  auto t1 = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(t1 - t0).count();
+  if (out_requests != nullptr) *out_requests = total.load();
+  return dt > 0 ? (double)total.load() / dt : 0.0;
+}
+
 }  // extern "C"
 
 }  // namespace brpc_tpu

@@ -11,6 +11,12 @@ struct H2SessionN {
   int unused = 0;
 };
 
+int h2_sniff(const char* p, size_t n) {
+  (void)p;
+  (void)n;
+  return 0;  // stub: h2 preface never claimed (rides the raw lane)
+}
+
 int h2_try_process(NatSocket* s, IOBuf* batch_out) {
   (void)s;
   (void)batch_out;

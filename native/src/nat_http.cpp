@@ -1,25 +1,393 @@
-// Native HTTP/1.1 server-side session — parse in the native cut loop,
-// execute usercode in Python (kind-3 py-lane requests), answer through
-// the native Socket write queue with pipelining-order preservation.
-// Reference shape: brpc's http parser + http_rpc_protocol
-// (details/http_parser.cpp, policy/http_rpc_protocol.cpp) — the parse
-// lives beside the socket, usercode elsewhere.
+// Native HTTP/1.1 server-side lane — parse in the native cut loop, execute
+// usercode in Python (kind-3 py-lane requests) or in registered native
+// handlers, answer through the native Socket write queue with
+// pipelining-order preservation.
+//
+// Reference shape: brpc parses HTTP natively beside the socket
+// (details/http_parser.cpp, a vendored joyent parser) and dispatches via
+// policy/http_rpc_protocol.cpp; builtin services run in C++
+// (server.cpp:468-563). Here the parse is a from-scratch incremental
+// header scanner over IOBuf, the usercode split is the py lane
+// (usercode_backup_pool discipline), and response ordering across the
+// native/py lanes is a per-session (seq -> response) reorder window —
+// the pipelining discipline http_rpc_protocol.cpp keeps via its
+// per-socket response queue.
 #include "nat_internal.h"
 
 namespace brpc_tpu {
 
+static constexpr size_t kMaxHeaderBytes = 64u << 10;
+static constexpr size_t kMaxBodyBytes = 512u << 20;
+
 struct HttpSessionN {
-  // stub (sniff never latches until nat_rpc_server_native_http wiring
-  // lands); replaced by the real parser in this round's HTTP lane work
-  int unused = 0;
+  uint64_t next_req_seq = 1;  // reading thread only
+  // Response reorder window: responses (native or py) may complete out of
+  // request order; only the response matching next_resp_seq is written,
+  // later ones park. mu guards everything below (py pthreads + reading
+  // thread both emit).
+  std::mutex mu;
+  uint64_t next_resp_seq = 1;
+  struct Resp {
+    std::string data;
+    bool close = false;
+  };
+  std::map<uint64_t, Resp> parked;
+  // requests that asked for Connection: close, by seq — the emitter
+  // honors close even when the responder didn't echo it back
+  std::vector<uint64_t> close_seqs;
 };
 
+int http_sniff(const char* p, size_t n) {
+  static const char* kVerbs[] = {"GET ",     "POST ",  "PUT ",
+                                 "DELETE ",  "HEAD ",  "OPTIONS ",
+                                 "PATCH ",   "TRACE "};
+  for (const char* v : kVerbs) {
+    size_t vl = strlen(v);
+    size_t cmp = n < vl ? n : vl;
+    if (memcmp(p, v, cmp) == 0) return n >= vl ? 1 : 2;
+  }
+  return 0;
+}
+
+// Write any now-in-order parked responses. Requires h->mu. Appends into
+// out (the caller writes outside the lock).
+static void http_emit_locked(NatSocket* s, HttpSessionN* h,
+                             std::string* out, bool* want_close) {
+  while (true) {
+    auto it = h->parked.find(h->next_resp_seq);
+    if (it == h->parked.end()) break;
+    out->append(it->second.data);
+    bool close = it->second.close;
+    if (!close) {
+      for (uint64_t cs : h->close_seqs) {
+        if (cs == h->next_resp_seq) {
+          close = true;
+          break;
+        }
+      }
+    }
+    h->parked.erase(it);
+    h->next_resp_seq++;
+    if (close) {
+      *want_close = true;
+      break;  // nothing after a close goes out
+    }
+  }
+}
+
+// Queue a complete response for `seq`, preserving request order. Called
+// from the reading thread (native handlers) and from py pthreads.
+static void http_emit_response(NatSocket* s, uint64_t seq, std::string data,
+                               bool close, IOBuf* batch_out) {
+  HttpSessionN* h = s->http;
+  if (h == nullptr) return;
+  std::string out;
+  bool want_close = false;
+  {
+    std::lock_guard<std::mutex> g(h->mu);
+    auto& slot = h->parked[seq];
+    slot.data = std::move(data);
+    slot.close = close;
+    http_emit_locked(s, h, &out, &want_close);
+  }
+  if (!out.empty()) {
+    if (want_close) s->close_after_drain.store(true,
+                                               std::memory_order_release);
+    if (batch_out != nullptr) {
+      batch_out->append(out.data(), out.size());
+      // batch_out rides the reading thread's per-round accumulator and
+      // lands in write_q after this returns; the close flag is armed
+      // above so the drain-side check fires once those bytes flush
+    } else {
+      IOBuf buf;
+      buf.append(out.data(), out.size());
+      s->write(std::move(buf));
+      if (want_close) {
+        // the write may have drained synchronously before the flag was
+        // visible to it — re-check now
+        bool empty;
+        {
+          std::lock_guard<std::mutex> g(s->write_mu);
+          empty = s->write_q.empty() && !s->ring_sending && !s->writing;
+        }
+        if (empty) s->set_failed();
+      }
+    }
+  } else if (want_close) {
+    s->close_after_drain.store(true, std::memory_order_release);
+  }
+}
+
+static void build_http_response(std::string* out, int status,
+                                const char* content_type,
+                                const char* body, size_t body_len,
+                                bool head_only) {
+  const char* reason = status == 200   ? "OK"
+                       : status == 400 ? "Bad Request"
+                       : status == 404 ? "Not Found"
+                       : status == 500 ? "Internal Server Error"
+                                       : "Error";
+  char hdr[256];
+  int n = snprintf(hdr, sizeof(hdr),
+                   "HTTP/1.1 %d %s\r\nServer: brpc_tpu_native\r\n"
+                   "Content-Type: %s\r\nContent-Length: %zu\r\n\r\n",
+                   status, reason, content_type, body_len);
+  out->append(hdr, (size_t)n);
+  if (!head_only && body_len) out->append(body, body_len);
+}
+
+// Parse + dispatch every complete pipelined request buffered on s.
+// Returns 1 (session active), 2 (sniff needs more bytes), 0 (error).
 int http_try_process(NatSocket* s, IOBuf* batch_out) {
-  (void)s;
-  (void)batch_out;
-  return 0;  // not HTTP (stub)
+  if (s->http == nullptr) {
+    char pfx[9] = {0};
+    size_t n = s->in_buf.length() < 8 ? s->in_buf.length() : 8;
+    s->in_buf.copy_to(pfx, n);
+    int sn = http_sniff(pfx, n);
+    if (sn == 0) return 0;
+    if (sn == 2) return 2;
+    if (s->server == nullptr) return 0;  // server-side lane only
+    s->http = new HttpSessionN();
+  }
+  NatServer* srv = s->server;
+  HttpSessionN* h = s->http;
+  while (true) {
+    size_t buffered = s->in_buf.length();
+    if (buffered == 0) break;
+    // locate end of headers without copying the whole buffer: scan a
+    // bounded prefix (headers beyond 64KB are an error, as in the
+    // Python parser)
+    char stack_scan[4096];
+    std::string heap_scan;
+    size_t scan_len = buffered < kMaxHeaderBytes + 4 ? buffered
+                                                     : kMaxHeaderBytes + 4;
+    const char* scan;
+    if (scan_len <= sizeof(stack_scan)) {
+      scan = s->in_buf.fetch(stack_scan, scan_len);
+    } else {
+      heap_scan.resize(scan_len);
+      s->in_buf.copy_to(&heap_scan[0], scan_len);
+      scan = heap_scan.data();
+    }
+    const char* hdr_end = nullptr;
+    for (size_t i = 0; i + 3 < scan_len; i++) {
+      if (scan[i] == '\r' && scan[i + 1] == '\n' && scan[i + 2] == '\r' &&
+          scan[i + 3] == '\n') {
+        hdr_end = scan + i;
+        break;
+      }
+    }
+    if (hdr_end == nullptr) {
+      if (buffered > kMaxHeaderBytes) return 0;  // oversized header
+      break;                                     // need more bytes
+    }
+    size_t hdr_len = (size_t)(hdr_end - scan);
+    // request line: VERB SP URI SP VERSION
+    const char* sp1 = (const char*)memchr(scan, ' ', hdr_len);
+    if (sp1 == nullptr) return 0;
+    const char* sp2 = (const char*)memchr(
+        sp1 + 1, ' ', (size_t)(hdr_end - sp1 - 1));
+    if (sp2 == nullptr) return 0;
+    std::string_view verb(scan, (size_t)(sp1 - scan));
+    std::string_view uri(sp1 + 1, (size_t)(sp2 - sp1 - 1));
+    // header lines: lowercase keys in a flat "key: value\n" block for the
+    // py lane; extract content-length / transfer-encoding / connection
+    std::string flat;
+    flat.reserve(hdr_len);
+    size_t content_length = 0;
+    bool chunked = false;
+    bool conn_close = false;
+    const char* line = (const char*)memchr(scan, '\n', hdr_len);
+    line = line == nullptr ? hdr_end : line + 1;
+    while (line < hdr_end) {
+      const char* eol = (const char*)memchr(line, '\r',
+                                            (size_t)(hdr_end - line));
+      if (eol == nullptr) eol = hdr_end;
+      const char* colon = (const char*)memchr(line, ':',
+                                              (size_t)(eol - line));
+      if (colon != nullptr) {
+        size_t kstart = flat.size();
+        for (const char* p = line; p < colon; p++) {
+          flat.push_back((char)tolower((unsigned char)*p));
+        }
+        std::string_view key(flat.data() + kstart, flat.size() - kstart);
+        const char* v = colon + 1;
+        while (v < eol && (*v == ' ' || *v == '\t')) v++;
+        const char* ve = eol;
+        while (ve > v && (ve[-1] == ' ' || ve[-1] == '\t')) ve--;
+        std::string_view val(v, (size_t)(ve - v));
+        if (key == "content-length") {
+          content_length = (size_t)strtoull(std::string(val).c_str(),
+                                            nullptr, 10);
+        } else if (key == "transfer-encoding") {
+          chunked = val.find("chunked") != std::string_view::npos;
+        } else if (key == "connection") {
+          // tolower for "Close"/"close"
+          std::string lv(val);
+          for (char& c : lv) c = (char)tolower((unsigned char)c);
+          conn_close = lv.find("close") != std::string::npos;
+        }
+        flat.push_back(':');
+        flat.push_back(' ');
+        flat.append(v, (size_t)(ve - v));
+        flat.push_back('\n');
+      }
+      line = eol + 2;
+    }
+    if (content_length > kMaxBodyBytes) return 0;
+    size_t body_start = hdr_len + 4;
+    std::string body;
+    size_t total = 0;
+    if (chunked) {
+      // dechunk (requires the full chunked body buffered — the Python
+      // parser's discipline; chunked uploads are rare and small here)
+      if (scan_len < buffered) {
+        heap_scan.resize(buffered);
+        s->in_buf.copy_to(&heap_scan[0], buffered);
+        scan = heap_scan.data();
+        scan_len = buffered;
+      }
+      size_t pos = body_start;
+      bool done = false;
+      while (true) {
+        const char* nl = (const char*)memchr(scan + pos, '\n',
+                                             scan_len - pos);
+        if (nl == nullptr) break;
+        size_t chunk_hdr_end = (size_t)(nl - scan) + 1;
+        size_t sz = (size_t)strtoull(scan + pos, nullptr, 16);
+        if (sz == 0) {
+          // trailer: expect final CRLF
+          if (scan_len < chunk_hdr_end + 2) break;
+          total = chunk_hdr_end + 2;
+          done = true;
+          break;
+        }
+        if (scan_len < chunk_hdr_end + sz + 2) break;
+        body.append(scan + chunk_hdr_end, sz);
+        if (body.size() > kMaxBodyBytes) return 0;
+        pos = chunk_hdr_end + sz + 2;
+      }
+      if (!done) break;  // need more bytes
+    } else {
+      if (buffered < body_start + content_length) break;  // need body
+      total = body_start + content_length;
+    }
+    // dispatch
+    uint64_t seq = h->next_req_seq++;
+    bool head_only = verb == "HEAD";
+    std::string_view path = uri.substr(0, uri.find('?'));
+    srv->requests.fetch_add(1, std::memory_order_relaxed);
+    auto nit = srv->http_handlers.find(path);
+    if (nit != srv->http_handlers.end()) {
+      // native usercode, inline (builtin-service discipline)
+      HttpHandlerCtxN ctx;
+      ctx.verb = verb;
+      ctx.path = path;
+      if (chunked) {
+        ctx.body = body;
+      } else {
+        // body view into the scan buffer (valid during the handler)
+        if (scan_len >= body_start + content_length) {
+          ctx.body = std::string_view(scan + body_start, content_length);
+        } else {
+          body.resize(content_length);
+          s->in_buf.copy_to(&body[0], content_length, body_start);
+          ctx.body = body;
+        }
+      }
+      nit->second(ctx);
+      std::string resp_bytes;
+      std::string resp_body = ctx.resp_body.to_string();
+      build_http_response(&resp_bytes, ctx.status, ctx.content_type,
+                          resp_body.data(), resp_body.size(), head_only);
+      if (conn_close) {
+        std::lock_guard<std::mutex> g(h->mu);
+        h->close_seqs.push_back(seq);
+      }
+      s->in_buf.pop_front(total);
+      http_emit_response(s, seq, std::move(resp_bytes), false, batch_out);
+      if (s->failed.load(std::memory_order_acquire) ||
+          s->close_after_drain.load(std::memory_order_acquire)) {
+        break;
+      }
+      continue;
+    }
+    if (!srv->py_lane_enabled) {
+      std::string resp_bytes;
+      const char kBody[] = "no handler on native http port\n";
+      build_http_response(&resp_bytes, 404, "text/plain", kBody,
+                          sizeof(kBody) - 1, head_only);
+      s->in_buf.pop_front(total);
+      http_emit_response(s, seq, std::move(resp_bytes), conn_close,
+                         batch_out);
+      continue;
+    }
+    // py lane: parse native, execute Python
+    PyRequest* r = new PyRequest();
+    r->kind = 3;
+    r->sock_id = s->id;
+    r->cid = (int64_t)seq;
+    r->service.assign(verb.data(), verb.size());
+    r->method.assign(uri.data(), uri.size());
+    r->meta_bytes = std::move(flat);
+    if (chunked) {
+      r->payload = std::move(body);
+    } else if (content_length > 0) {
+      if (scan_len >= body_start + content_length) {
+        r->payload.assign(scan + body_start, content_length);
+      } else {
+        r->payload.resize(content_length);
+        s->in_buf.copy_to(&r->payload[0], content_length, body_start);
+      }
+    }
+    if (conn_close) {
+      std::lock_guard<std::mutex> g(h->mu);
+      h->close_seqs.push_back(seq);
+    }
+    s->in_buf.pop_front(total);
+    srv->enqueue_py(r);
+  }
+  return 1;
 }
 
 void http_session_free(HttpSessionN* h) { delete h; }
+
+extern "C" {
+
+// Python lane answer for a kind-3 request: `data` is the complete
+// serialized HTTP response; close_after shuts the connection down once
+// the bytes flush (Connection: close). Ordering across pipelined
+// requests is enforced natively via the session reorder window.
+int nat_http_respond(uint64_t sock_id, int64_t seq, const char* data,
+                     size_t len, int close_after) {
+  NatSocket* s = sock_address(sock_id);
+  if (s == nullptr) return -1;
+  if (s->http == nullptr) {
+    s->release();
+    return -1;
+  }
+  http_emit_response(s, (uint64_t)seq, std::string(data, len),
+                     close_after != 0, nullptr);
+  s->release();
+  return 0;
+}
+
+// Graceful close: fail the socket once queued writes drain (FIN after
+// the last response byte) — Connection: close semantics for any lane.
+int nat_sock_graceful_close(uint64_t sock_id) {
+  NatSocket* s = sock_address(sock_id);
+  if (s == nullptr) return -1;
+  s->close_after_drain.store(true, std::memory_order_release);
+  bool empty;
+  {
+    std::lock_guard<std::mutex> g(s->write_mu);
+    empty = s->write_q.empty() && !s->ring_sending && !s->writing;
+  }
+  if (empty) s->set_failed();
+  s->release();
+  return 0;
+}
+
+}  // extern "C"
 
 }  // namespace brpc_tpu

@@ -125,6 +125,11 @@ struct NatSocket {
   HttpSessionN* http = nullptr;  // native HTTP/1.1 session
   H2SessionN* h2 = nullptr;      // native h2/gRPC session
 
+  // Graceful close (Connection: close semantics): once set, the socket
+  // fails as soon as the write queue drains — queued bytes flush first,
+  // then shutdown sends FIN.
+  std::atomic<bool> close_after_drain{false};
+
   // io_uring datapath (RingListener): (generation<<32 | file index) when
   // this socket's reads ride the provided-buffer ring (-1 = epoll lane);
   // the generation lets the ring reject stale rearms/sends after the
@@ -235,6 +240,18 @@ struct NativeHandlerCtx {
 };
 using NativeHandler = std::function<void(NativeHandlerCtx&)>;
 
+// Native HTTP handler (the builtin-service-in-C++ discipline of
+// server.cpp:468-563): runs inline in the reading thread — must not block.
+struct HttpHandlerCtxN {
+  std::string_view verb;
+  std::string_view path;
+  std::string_view body;
+  int status = 200;
+  const char* content_type = "text/plain";
+  IOBuf resp_body;
+};
+using HttpHandlerN = std::function<void(HttpHandlerCtxN&)>;
+
 // A request handed to the Python lane (usercode_backup_pool discipline:
 // Python user code runs on pthreads, not fiber stacks).
 // kind: 0 = parsed tpu_std request; 1 = raw bytes for the Python protocol
@@ -280,6 +297,8 @@ class NatServer {
 
   // frozen at start; std::less<> enables allocation-free string_view find
   std::map<std::string, NativeHandler, std::less<>> handlers;
+  // native HTTP handlers keyed by exact path (checked before the py lane)
+  std::map<std::string, HttpHandlerN, std::less<>> http_handlers;
   bool py_lane_enabled = false;
   // Route unrecognized framing to the Python protocol stack instead of
   // failing the socket (set when a Python server with a full protocol
@@ -529,12 +548,18 @@ void build_request_frame(IOBuf* out, int64_t cid, const std::string& service,
 bool process_input(NatSocket* s, IOBuf* defer_out = nullptr);
 bool drain_socket_inline(NatSocket* s);
 
-// Native HTTP/1.1 session (nat_http.cpp): parse state + keep-alive queue.
-int http_try_process(NatSocket* s, IOBuf* batch_out);  // 1/2/0 like console
+// Native HTTP/1.1 session (nat_http.cpp).
+// try_process returns: 1 = session active (consumed what it could),
+// 2 = sniff needs more bytes, 0 = not HTTP / protocol error.
+int http_try_process(NatSocket* s, IOBuf* batch_out);
 void http_session_free(HttpSessionN* h);
-// Native h2/gRPC session (nat_h2.cpp).
+// Sniff a few leading bytes: 1 = HTTP verb, 2 = could become one (need
+// more bytes), 0 = definitely not HTTP.
+int http_sniff(const char* p, size_t n);
+// Native h2/gRPC session (nat_h2.cpp); same conventions.
 int h2_try_process(NatSocket* s, IOBuf* batch_out);
 void h2_session_free(H2SessionN* h);
+int h2_sniff(const char* p, size_t n);
 
 extern "C" {
 // forward decls shared with the bench harness

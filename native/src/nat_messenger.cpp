@@ -172,11 +172,16 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
       // already rule out the tpu_std magic, hand off to raw mode now
       // rather than deadlocking on a 12-byte header that never comes.
       if (!s->in_buf.empty() && s->server != nullptr &&
-          s->server->raw_fallback && s->server->py_lane_enabled) {
-        char pfx[4];
-        size_t n = s->in_buf.length() < 4 ? s->in_buf.length() : 4;
+          s->server->py_lane_enabled) {
+        char pfx[12];
+        size_t n = s->in_buf.length() < 12 ? s->in_buf.length() : 12;
         s->in_buf.copy_to(pfx, n);
-        if (memcmp(pfx, kMagicRpc, n) != 0) {
+        if (s->server->native_http &&
+            (http_sniff(pfx, n) != 0 || h2_sniff(pfx, n) != 0)) {
+          break;  // could be a native-lane protocol: wait for 12+ bytes
+        }
+        size_t mn = n < 4 ? n : 4;
+        if (s->server->raw_fallback && memcmp(pfx, kMagicRpc, mn) != 0) {
           s->py_raw.store(true, std::memory_order_release);
           forward_raw_chunk(s);
         }

@@ -259,6 +259,17 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
       ctx.resp_payload.append(std::move(*ctx.req_payload));
       ctx.resp_attachment.append(std::move(*ctx.req_attachment));
     };
+    // the native-usercode HTTP twin (builtin-service discipline): POST
+    // body echoes back, GET answers a constant — the bench lane for
+    // native-parse + native-usercode HTTP
+    srv->http_handlers["/echo"] = [](HttpHandlerCtxN& ctx) {
+      if (ctx.body.empty()) {
+        ctx.resp_body.append("pong", 4);
+      } else {
+        ctx.resp_body.append(ctx.body.data(), ctx.body.size());
+      }
+      ctx.content_type = "application/octet-stream";
+    };
   }
   {
     // publish AND register the listener in ONE critical section: a

@@ -159,6 +159,7 @@ void NatSocket::reset_for_reuse() {
   py_raw_seq = 0;
   http = nullptr;
   h2 = nullptr;
+  close_after_drain.store(false, std::memory_order_relaxed);
 }
 
 void NatSocket::set_failed() {
@@ -237,6 +238,12 @@ bool NatSocket::flush_some() {
       std::lock_guard<std::mutex> g(write_mu);
       if (write_q.empty()) {
         writing = false;
+        if (close_after_drain.load(std::memory_order_acquire) &&
+            !failed.load(std::memory_order_acquire)) {
+          // Connection: close — everything flushed; FIN follows the
+          // last response byte (shutdown flushes kernel-buffered data)
+          break;
+        }
         return true;
       }
       batch.append(std::move(write_q));  // take the whole queue: syscall
@@ -257,6 +264,8 @@ bool NatSocket::flush_some() {
       }
     }
   }
+  set_failed();  // close_after_drain: queue empty, bytes flushed
+  return true;
 }
 
 void keep_write_fiber(void* arg) {
@@ -434,6 +443,7 @@ bool ring_drain() {
           s->set_failed();
         } else {
           bool need_retry;
+          bool drained_close = false;
           {
             std::lock_guard<std::mutex> g(s->write_mu);
             size_t done = (size_t)c.res;
@@ -442,11 +452,18 @@ bool ring_drain() {
             s->ring_sending = false;
             s->ring_inflight = 0;
             need_retry = !ring_submit_locked(s);
+            drained_close =
+                s->write_q.empty() &&
+                s->close_after_drain.load(std::memory_order_acquire);
           }
-          if (need_retry) ring_retry_later(s->id);
-          // a demotion landing between completions leaves queued bytes
-          // with no sender: hand them to the epoll write lane
-          kick_epoll_writer_if_stranded(s);
+          if (drained_close) {
+            s->set_failed();  // Connection: close — all bytes flushed
+          } else {
+            if (need_retry) ring_retry_later(s->id);
+            // a demotion landing between completions leaves queued bytes
+            // with no sender: hand them to the epoll write lane
+            kick_epoll_writer_if_stranded(s);
+          }
         }
       }
     }

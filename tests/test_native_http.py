@@ -1,0 +1,196 @@
+"""Native HTTP/1.1 lane — parse in the native cut loop, usercode in Python
+(kind-3 py lane) or native handlers, responses ordered across pipelining.
+
+Reference counterpart: brpc parses HTTP natively in InputMessenger
+(details/http_parser.cpp) and keeps pipelined responses in request order
+(policy/http_rpc_protocol.cpp); builtin services run in C++
+(server.cpp:468-563).
+"""
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.proto import echo_pb2
+
+native = pytest.importorskip("brpc_tpu.native")
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+class SlowFirstService(rpc.Service):
+    """First call stalls; later calls answer immediately — exercises the
+    native response-reorder window under pipelining."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+        self.lock = threading.Lock()
+
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        with self.lock:
+            self.calls += 1
+            first = self.calls == 1
+        if first:
+            time.sleep(0.4)
+        response.message = request.message
+        done()
+
+
+@pytest.fixture()
+def http_server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                       use_native_runtime=True))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _recv_until(sk, n_responses, timeout=5.0):
+    """Read until n_responses complete HTTP responses are buffered."""
+    sk.settimeout(timeout)
+    buf = b""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        parsed = 0
+        scan = buf
+        bodies = []
+        while True:
+            he = scan.find(b"\r\n\r\n")
+            if he < 0:
+                break
+            head = scan[:he].lower()
+            cl = 0
+            for line in head.split(b"\r\n"):
+                if line.startswith(b"content-length:"):
+                    cl = int(line.split(b":")[1])
+            if len(scan) < he + 4 + cl:
+                break
+            bodies.append((scan[:he], scan[he + 4: he + 4 + cl]))
+            scan = scan[he + 4 + cl:]
+            parsed += 1
+        if parsed >= n_responses:
+            return bodies
+        try:
+            chunk = sk.recv(65536)
+        except socket.timeout:
+            break
+        if not chunk:
+            break
+        buf += chunk
+    raise AssertionError(f"wanted {n_responses} responses, buffered {buf!r}")
+
+
+def test_rpc_over_http_rides_native_lane(http_server):
+    port = http_server.listen_endpoint.port
+    sk = socket.create_connection(("127.0.0.1", port))
+    body = json.dumps({"message": "native-http"}).encode()
+    req = (b"POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+           b"Content-Type: application/json\r\n"
+           b"Content-Length: %d\r\n\r\n" % len(body)) + body
+    sk.sendall(req)
+    (head, resp_body), = _recv_until(sk, 1)
+    assert b"200" in head.split(b"\r\n")[0]
+    assert json.loads(resp_body)["message"] == "native-http"
+    # keep-alive: same connection serves the console too
+    sk.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+    (_, body2), = _recv_until(sk, 1)
+    assert body2 == b"OK\n"
+    sk.close()
+
+
+def test_pipelined_responses_stay_in_request_order():
+    svc = SlowFirstService()
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                       use_native_runtime=True))
+    srv.add_service(svc)
+    assert srv.start("127.0.0.1:0") == 0
+    try:
+        port = srv.listen_endpoint.port
+        sk = socket.create_connection(("127.0.0.1", port))
+        reqs = b""
+        for i in range(3):
+            body = json.dumps({"message": f"m{i}"}).encode()
+            reqs += (b"POST /SlowFirstService/Echo HTTP/1.1\r\nHost: x\r\n"
+                     b"Content-Type: application/json\r\n"
+                     b"Content-Length: %d\r\n\r\n" % len(body)) + body
+        sk.sendall(reqs)  # one write: truly pipelined
+        bodies = _recv_until(sk, 3)
+        got = [json.loads(b)["message"] for _, b in bodies]
+        # the first (slow) response must still arrive first
+        assert got == ["m0", "m1", "m2"]
+        sk.close()
+    finally:
+        srv.stop()
+
+
+def test_chunked_request_body(http_server):
+    port = http_server.listen_endpoint.port
+    sk = socket.create_connection(("127.0.0.1", port))
+    body = json.dumps({"message": "chunky"}).encode()
+    half = len(body) // 2
+    chunked = (b"%x\r\n" % half) + body[:half] + b"\r\n" + \
+              (b"%x\r\n" % (len(body) - half)) + body[half:] + b"\r\n" + \
+              b"0\r\n\r\n"
+    sk.sendall(b"POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+               b"Content-Type: application/json\r\n"
+               b"Transfer-Encoding: chunked\r\n\r\n" + chunked)
+    (head, resp_body), = _recv_until(sk, 1)
+    assert json.loads(resp_body)["message"] == "chunky"
+    sk.close()
+
+
+def test_connection_close_gets_fin_after_response(http_server):
+    port = http_server.listen_endpoint.port
+    sk = socket.create_connection(("127.0.0.1", port))
+    sk.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+    sk.settimeout(5.0)
+    data = b""
+    while True:
+        chunk = sk.recv(4096)
+        if not chunk:
+            break  # FIN after the response — graceful close
+        data += chunk
+    assert b"200" in data and data.endswith(b"OK\n")
+    sk.close()
+
+
+def test_native_http_echo_handler_and_bench():
+    """The native-usercode lane: /echo runs in C++, no Python in the loop."""
+    port = native.rpc_server_start(native_echo=True)
+    try:
+        native.rpc_server_native_http(True)
+        sk = socket.create_connection(("127.0.0.1", port))
+        sk.sendall(b"POST /echo HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Length: 5\r\n\r\nhello")
+        (head, body), = _recv_until(sk, 1)
+        assert body == b"hello"
+        sk.close()
+        res = native.http_client_bench("127.0.0.1", port, nconn=2,
+                                       pipeline=32, seconds=0.5,
+                                       path="/echo", post_body=16)
+        assert res["requests"] > 100  # sanity: the lane moves
+    finally:
+        native.rpc_server_stop()
+
+
+def test_404_and_bad_method_pages_still_work(http_server):
+    port = http_server.listen_endpoint.port
+    sk = socket.create_connection(("127.0.0.1", port))
+    sk.sendall(b"GET /EchoService/NoSuch HTTP/1.1\r\nHost: x\r\n\r\n")
+    (head, body), = _recv_until(sk, 1)
+    assert b"404" in head.split(b"\r\n")[0]
+    assert b"Echo" in body  # bad_method page lists available methods
+    sk.close()
