@@ -104,10 +104,20 @@ def http_lane_bench(seconds: float = 1.5) -> dict:
             response.message = request.message
             done()
 
+    class PyEchoService(rpc.Service):
+        """Distinct name so the native EchoService.Echo handler can't
+        shadow it — the Python-usercode gRPC lane."""
+
+        @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = request.message
+            done()
+
     srv = rpc.Server(rpc.ServerOptions(num_threads=4,
                                        use_native_runtime=True,
                                        native_builtin_echo=True))
     srv.add_service(EchoService())
+    srv.add_service(PyEchoService())
     assert srv.start("127.0.0.1:0") == 0
     try:
         port = srv.listen_endpoint.port
@@ -120,10 +130,23 @@ def http_lane_bench(seconds: float = 1.5) -> dict:
                                       path="/EchoService/Echo",
                                       post_body=body,
                                       content_type="application/json")
+        # gRPC-over-h2 through the same native parse path: native
+        # usercode (the registered EchoService.Echo native handler) and
+        # Python usercode (PyEchoService on the py lane)
+        grpc_nat = native.grpc_client_bench("127.0.0.1", port, nconn=4,
+                                            window=128, seconds=seconds,
+                                            path="/EchoService/Echo",
+                                            payload=b"x" * 16)
+        req = echo_pb2.EchoRequest(message="x" * 16)
+        grpc_py = native.grpc_client_bench(
+            "127.0.0.1", port, nconn=2, window=32, seconds=seconds,
+            path="/PyEchoService/Echo", payload=req.SerializeToString())
     finally:
         srv.stop()
     return {"http_qps": round(nat["qps"], 1),
-            "http_py_qps": round(py["qps"], 1)}
+            "http_py_qps": round(py["qps"], 1),
+            "grpc_qps": round(grpc_nat["qps"], 1),
+            "grpc_py_qps": round(grpc_py["qps"], 1)}
 
 
 def native_echo_bench(nconn: int = 2, seconds: float = 3.0,

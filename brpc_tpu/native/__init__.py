@@ -167,12 +167,21 @@ def load() -> ctypes.CDLL:
         lib.nat_http_respond.restype = ctypes.c_int
         lib.nat_sock_graceful_close.argtypes = [ctypes.c_uint64]
         lib.nat_sock_graceful_close.restype = ctypes.c_int
+        lib.nat_grpc_respond.argtypes = [
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_int, ctypes.c_char_p]
+        lib.nat_grpc_respond.restype = ctypes.c_int
         lib.nat_http_client_bench.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_double, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_size_t, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_uint64)]
         lib.nat_http_client_bench.restype = ctypes.c_double
+        lib.nat_grpc_client_bench.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_grpc_client_bench.restype = ctypes.c_double
         _lib = lib
         return lib
 
@@ -282,7 +291,7 @@ def take_request(timeout_ms: int = 100):
         n = ctypes.c_size_t(0)
         p = lib.nat_req_field(h, which, ctypes.byref(n))
         return ctypes.string_at(p, n.value) if p and n.value else b""
-    if kind == 3:
+    if kind in (3, 4):  # native-parsed HTTP / gRPC-over-h2
         return (h, kind, field(4), field(2), b"",
                 lib.nat_req_sock_id(h), lib.nat_req_cid(h),
                 field(0), field(1))
@@ -325,12 +334,35 @@ def rpc_server_native_http(enable: bool = True) -> int:
     return load().nat_rpc_server_native_http(1 if enable else 0)
 
 
+def grpc_respond(sock_id: int, stream_id: int, payload: bytes = b"",
+                 grpc_status: int = 0, grpc_message: str = "") -> int:
+    """Answer a kind-4 request: unary gRPC response framed natively
+    (HEADERS + DATA + grpc-status trailers) onto the h2 session."""
+    return load().nat_grpc_respond(sock_id, stream_id, payload,
+                                   len(payload), grpc_status,
+                                   grpc_message.encode() or None)
+
+
 def http_respond(sock_id: int, seq: int, data: bytes,
                  close_after: bool = False) -> int:
     """Answer a kind-3 request: data is the complete serialized HTTP
     response; ordering across pipelined requests is enforced natively."""
     return load().nat_http_respond(sock_id, seq, data, len(data),
                                    1 if close_after else 0)
+
+
+def grpc_client_bench(ip: str, port: int, nconn: int = 4,
+                      window: int = 64, seconds: float = 2.0,
+                      path: str = "/EchoService/Echo",
+                      payload: bytes = b"x" * 16) -> dict:
+    """gRPC-over-h2 bench client (minimal native h2 client, `window`
+    concurrent unary streams per connection)."""
+    out_requests = ctypes.c_uint64(0)
+    qps = load().nat_grpc_client_bench(ip.encode(), port, nconn, window,
+                                       seconds, path.encode(), payload,
+                                       len(payload),
+                                       ctypes.byref(out_requests))
+    return {"qps": qps, "requests": out_requests.value}
 
 
 def http_client_bench(ip: str, port: int, nconn: int = 4,

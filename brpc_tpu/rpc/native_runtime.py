@@ -152,9 +152,9 @@ class NativeRuntimeMount:
                          if p.name in self.server.options.enabled_protocols]
         self._messenger = InputMessenger(protocols, arg=self.server)
         native.rpc_server_enable_raw_fallback(True)
-        # native HTTP/1.1 parse lane (kind-3 requests): parse native,
-        # execute Python — only when the http protocol is mounted
-        if any(p.name == "http" for p in protocols):
+        # native HTTP/1.1 + h2/gRPC parse lanes (kind-3/4 requests): parse
+        # native, execute Python — only when those protocols are mounted
+        if any(p.name in ("http", "h2:grpc") for p in protocols):
             try:
                 native.rpc_server_native_http(True)
             except AttributeError:
@@ -187,6 +187,10 @@ class NativeRuntimeMount:
             if kind == 3:  # native-parsed HTTP request
                 native.req_free(handle)
                 self._handle_http(f0, f1, meta_bytes, payload, sock_id, seq)
+                continue
+            if kind == 4:  # native-parsed gRPC-over-h2 request
+                native.req_free(handle)
+                self._handle_grpc(f1, meta_bytes, payload, sock_id, seq)
                 continue
             if kind == 1:  # raw protocol bytes
                 native.req_free(handle)
@@ -221,6 +225,93 @@ class NativeRuntimeMount:
             finally:
                 if handle is not None:
                     native.req_free(handle)
+
+    def _handle_grpc(self, path: bytes, flat_headers: bytes, data: bytes,
+                     sock_id: int, sid: int):
+        """kind-4 dispatch: the native h2 session decoded HEADERS (HPACK)
+        and buffered the gRPC-framed body; run the same method dispatch as
+        the Python h2 stack (_dispatch_server_request semantics) and
+        answer through the native response framer."""
+        import time as _time
+
+        from brpc_tpu.rpc import errors
+        from brpc_tpu.rpc.controller import Controller
+        from brpc_tpu.rpc.h2_protocol import (
+            GRPC_INTERNAL,
+            GRPC_NOT_FOUND,
+            GRPC_OK,
+            GRPC_RESOURCE_EXHAUSTED,
+            GRPC_UNIMPLEMENTED,
+            _parse_grpc_timeout,
+            error_to_grpc_status,
+            grpc_unwrap,
+        )
+
+        def respond(payload=b"", status=GRPC_OK, message=""):
+            native.grpc_respond(sock_id, sid, payload, status, message)
+
+        try:
+            server = self.server
+            pstr = path.decode("latin-1")
+            parts = [p for p in pstr.split("/") if p]
+            if len(parts) != 2:
+                return respond(b"", GRPC_UNIMPLEMENTED, f"bad path {pstr}")
+            entry = server.find_method(parts[0], parts[1])
+            if entry is None:
+                missing = server.find_service(parts[0]) is None
+                return respond(
+                    b"", GRPC_NOT_FOUND if missing else GRPC_UNIMPLEMENTED,
+                    f"unknown method {pstr}")
+            service_obj, minfo, method_status = entry
+            headers = {}
+            for line in flat_headers.decode("latin-1").split("\n"):
+                if line:
+                    k, _, v = line.partition(": ")
+                    headers[k] = v
+            cntl = Controller()
+            cntl.server = server
+            cntl.service_name, cntl.method_name = parts[0], parts[1]
+            cntl.server_start_time = _time.monotonic()
+            timeout = headers.get("grpc-timeout")
+            if timeout:
+                cntl.timeout_ms = _parse_grpc_timeout(timeout)
+            if not method_status.on_requested():
+                return respond(b"", GRPC_RESOURCE_EXHAUSTED,
+                               "reached max_concurrency")
+            request = minfo.request_class()
+            body = grpc_unwrap(data)
+            try:
+                if body:
+                    request.ParseFromString(body)
+            except Exception as e:
+                method_status.on_response(errors.EREQUEST,
+                                          cntl.server_start_time)
+                return respond(b"", GRPC_INTERNAL,
+                               f"fail to parse request: {e}")
+            response = minfo.response_class()
+            responded = [False]
+
+            def done():
+                if responded[0]:
+                    return
+                responded[0] = True
+                method_status.on_response(cntl.error_code_value,
+                                          cntl.server_start_time)
+                if cntl.failed():
+                    respond(b"",
+                            error_to_grpc_status(cntl.error_code_value),
+                            cntl.error_text_value)
+                else:
+                    respond(response.SerializeToString(), GRPC_OK)
+
+            try:
+                minfo.handler(service_obj, cntl, request, response, done)
+            except Exception as e:
+                if not responded[0]:
+                    cntl.set_failed(errors.EINVAL, f"method raised: {e}")
+                    done()
+        except Exception as e:
+            respond(b"", GRPC_INTERNAL, f"py-lane grpc dispatch: {e}")
 
     def _handle_http(self, verb: bytes, uri: bytes, flat_headers: bytes,
                      body: bytes, sock_id: int, seq: int):

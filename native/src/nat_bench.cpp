@@ -429,6 +429,146 @@ double nat_http_client_bench(const char* ip, int port, int nconn,
   return dt > 0 ? (double)total.load() / dt : 0.0;
 }
 
+// gRPC-over-h2 bench client: minimal h2 client on blocking sockets —
+// preface + SETTINGS + a huge connection window, then `window` concurrent
+// unary streams per write batch, counting END_STREAM trailers. Exercises
+// the server's native h2 lane (HPACK decode, stream state, gRPC framing).
+double nat_grpc_client_bench(const char* ip, int port, int nconn,
+                             int window, double seconds, const char* path,
+                             const char* payload, size_t payload_len,
+                             uint64_t* out_requests) {
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> total{0};
+  // static-encoded request HEADERS block (same bytes every stream)
+  std::string hdr_block;
+  hp_enc_int(&hdr_block, 3, 7, 0x80);  // :method POST
+  hp_enc_int(&hdr_block, 6, 7, 0x80);  // :scheme http
+  hp_enc_header(&hdr_block, ":path", path);
+  hp_enc_header(&hdr_block, ":authority", "bench");
+  hp_enc_header(&hdr_block, "content-type", "application/grpc");
+  hp_enc_header(&hdr_block, "te", "trailers");
+  // gRPC-framed request body
+  std::string body;
+  body.push_back('\x00');
+  body.push_back((char)((payload_len >> 24) & 0xff));
+  body.push_back((char)((payload_len >> 16) & 0xff));
+  body.push_back((char)((payload_len >> 8) & 0xff));
+  body.push_back((char)(payload_len & 0xff));
+  body.append(payload, payload_len);
+
+  auto frame_hdr = [](std::string* o, size_t len, uint8_t type,
+                      uint8_t flags, uint32_t sid) {
+    o->push_back((char)((len >> 16) & 0xff));
+    o->push_back((char)((len >> 8) & 0xff));
+    o->push_back((char)(len & 0xff));
+    o->push_back((char)type);
+    o->push_back((char)flags);
+    o->push_back((char)((sid >> 24) & 0x7f));
+    o->push_back((char)((sid >> 16) & 0xff));
+    o->push_back((char)((sid >> 8) & 0xff));
+    o->push_back((char)(sid & 0xff));
+  };
+
+  std::vector<std::thread> threads;
+  for (int c = 0; c < nconn; c++) {
+    threads.emplace_back([&] {
+      int fd = dial_nonblocking(ip, port, 5000);
+      if (fd < 0) return;
+      int fl = fcntl(fd, F_GETFL, 0);
+      fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);
+      struct timeval tv = {0, 200000};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      std::string hello = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+      frame_hdr(&hello, 0, 4 /*SETTINGS*/, 0, 0);
+      // open the connection send window wide so the server never parks
+      frame_hdr(&hello, 4, 8 /*WINDOW_UPDATE*/, 0, 0);
+      uint32_t winc = (1u << 30) - 65535;
+      hello.push_back((char)((winc >> 24) & 0x7f));
+      hello.push_back((char)((winc >> 16) & 0xff));
+      hello.push_back((char)((winc >> 8) & 0xff));
+      hello.push_back((char)(winc & 0xff));
+      if (::send(fd, hello.data(), hello.size(), 0) < 0) {
+        ::close(fd);
+        return;
+      }
+      uint32_t next_sid = 1;
+      std::string rbuf;
+      char tmp[65536];
+      int w = window > 0 ? window : 32;
+      while (!stop.load(std::memory_order_relaxed)) {
+        std::string batch;
+        batch.reserve((size_t)w * (18 + hdr_block.size() + body.size()));
+        for (int i = 0; i < w; i++) {
+          frame_hdr(&batch, hdr_block.size(), 1 /*HEADERS*/,
+                    0x4 /*END_HEADERS*/, next_sid);
+          batch.append(hdr_block);
+          frame_hdr(&batch, body.size(), 0 /*DATA*/,
+                    0x1 /*END_STREAM*/, next_sid);
+          batch.append(body);
+          next_sid += 2;
+        }
+        size_t off = 0;
+        while (off < batch.size()) {
+          ssize_t wn = ::send(fd, batch.data() + off, batch.size() - off,
+                              0);
+          if (wn <= 0) goto out;
+          off += (size_t)wn;
+        }
+        int need = w;
+        std::string ctl;  // acks we owe the server
+        while (need > 0 && !stop.load(std::memory_order_relaxed)) {
+          // parse complete frames at the front of rbuf
+          size_t pos = 0;
+          while (pos + 9 <= rbuf.size()) {
+            const uint8_t* p = (const uint8_t*)rbuf.data() + pos;
+            size_t flen =
+                ((size_t)p[0] << 16) | ((size_t)p[1] << 8) | p[2];
+            if (pos + 9 + flen > rbuf.size()) break;
+            uint8_t ftype = p[3];
+            uint8_t flags = p[4];
+            if (ftype == 1 && (flags & 0x1)) {  // trailers END_STREAM
+              total.fetch_add(1, std::memory_order_relaxed);
+              need--;
+            } else if (ftype == 4 && !(flags & 0x1)) {  // SETTINGS
+              frame_hdr(&ctl, 0, 4, 0x1 /*ACK*/, 0);
+            } else if (ftype == 6 && !(flags & 0x1)) {  // PING
+              frame_hdr(&ctl, 8, 6, 0x1, 0);
+              ctl.append(rbuf.data() + pos + 9, 8);
+            }
+            pos += 9 + flen;
+          }
+          if (pos > 0) rbuf.erase(0, pos);
+          if (!ctl.empty()) {
+            if (::send(fd, ctl.data(), ctl.size(), 0) < 0) goto out;
+            ctl.clear();
+          }
+          if (need == 0) break;
+          ssize_t r = ::recv(fd, tmp, sizeof(tmp), 0);
+          if (r <= 0) {
+            if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK) &&
+                !stop.load(std::memory_order_relaxed)) {
+              continue;
+            }
+            goto out;
+          }
+          rbuf.append(tmp, (size_t)r);
+        }
+      }
+    out:
+      ::close(fd);
+    });
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((int64_t)(seconds * 1000)));
+  stop.store(true);
+  for (auto& t : threads) t.join();
+  auto t1 = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(t1 - t0).count();
+  if (out_requests != nullptr) *out_requests = total.load();
+  return dt > 0 ? (double)total.load() / dt : 0.0;
+}
+
 }  // extern "C"
 
 }  // namespace brpc_tpu

@@ -560,6 +560,12 @@ int http_sniff(const char* p, size_t n);
 int h2_try_process(NatSocket* s, IOBuf* batch_out);
 void h2_session_free(H2SessionN* h);
 int h2_sniff(const char* p, size_t n);
+// Static-table HPACK encode primitives (stateless; used by the h2
+// response framer and the bench client).
+void hp_enc_int(std::string* out, uint64_t v, int prefix, uint8_t first);
+void hp_enc_str(std::string* out, std::string_view s);
+void hp_enc_header(std::string* out, std::string_view name,
+                   std::string_view value);
 
 extern "C" {
 // forward decls shared with the bench harness
