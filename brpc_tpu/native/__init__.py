@@ -97,6 +97,8 @@ def load() -> ctypes.CDLL:
         lib.nat_req_field.restype = ctypes.c_void_p
         lib.nat_req_cid.argtypes = [ctypes.c_void_p]
         lib.nat_req_cid.restype = ctypes.c_int64
+        lib.nat_req_aux.argtypes = [ctypes.c_void_p]
+        lib.nat_req_aux.restype = ctypes.c_uint64
         lib.nat_req_compress.argtypes = [ctypes.c_void_p]
         lib.nat_req_compress.restype = ctypes.c_int32
         lib.nat_req_sock_id.argtypes = [ctypes.c_void_p]
@@ -294,9 +296,13 @@ def take_request(timeout_ms: int = 100):
     if kind in (3, 4):  # native-parsed HTTP / gRPC-over-h2
         return (h, kind, field(4), field(2), b"",
                 lib.nat_req_sock_id(h), lib.nat_req_cid(h),
-                field(0), field(1))
+                field(0), field(1), 0)
+    if kind == 5:  # native-cut streaming frame: aux = dest stream id,
+        # compress slot = frame type, cid = per-socket order
+        return (h, kind, b"", field(2), b"", lib.nat_req_sock_id(h),
+                lib.nat_req_cid(h), b"", b"", lib.nat_req_aux(h))
     return (h, kind, field(4), field(2), field(3),
-            lib.nat_req_sock_id(h), lib.nat_req_cid(h), b"", b"")
+            lib.nat_req_sock_id(h), lib.nat_req_cid(h), b"", b"", 0)
 
 
 def rpc_server_enable_raw_fallback(enable: bool = True) -> int:

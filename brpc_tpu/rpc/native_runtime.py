@@ -79,6 +79,68 @@ class _NativeHttpShim(NativeSocketShim):
         native.sock_set_failed(self.sock_id)
 
 
+class _StreamSession:
+    """Per-connection dispatcher for natively-cut streaming frames
+    (kind 5): frames are reassembled by per-socket sequence (py-lane
+    pthreads race) and fed straight into the Python Stream objects —
+    the ordered-delivery role stream.py gets from process_inline on the
+    Python port, without re-parsing framing in Python."""
+
+    FRAME_DATA = 0
+    FRAME_FEEDBACK = 1
+    FRAME_CLOSE = 2
+
+    def __init__(self, sock_id: int):
+        self.sock_id = sock_id
+        self.lock = threading.Lock()
+        self.pending = {}
+        self.next_seq = 1
+        self.busy = False
+
+    def feed(self, seq: int, ftype: int, dest_id: int, payload: bytes):
+        with self.lock:
+            self.pending[seq] = (ftype, dest_id, payload)
+            if self.busy:
+                return
+            self.busy = True
+        while True:
+            with self.lock:
+                item = self.pending.pop(self.next_seq, None)
+                if item is None:
+                    self.busy = False
+                    return
+                self.next_seq += 1
+            try:
+                self._dispatch(*item)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "native stream frame dispatch raised")
+
+    def _dispatch(self, ftype: int, dest_id: int, payload: bytes):
+        import struct
+
+        from brpc_tpu.butil.iobuf import IOBuf
+        from brpc_tpu.rpc.stream import Stream
+
+        stream = Stream.find(dest_id)
+        if stream is None:
+            return  # already closed; drop silently (reference behavior)
+        if ftype == self.FRAME_DATA:
+            if len(payload) >= 65536:
+                buf = IOBuf()  # zero-copy wrap: bytes are immutable
+                buf.append_user_data(payload)
+            else:
+                buf = IOBuf(payload)
+            stream._on_data(buf)
+        elif ftype == self.FRAME_FEEDBACK:
+            (consumed,) = struct.unpack(">Q", payload)
+            stream._on_feedback(consumed)
+        elif ftype == self.FRAME_CLOSE:
+            stream.close(notify_remote=False)
+
+
 class _RawSession:
     """Per-connection protocol session for the raw fallback lane (the
     native port's multi-protocol capability, input_messenger.h:33-154):
@@ -134,6 +196,7 @@ class NativeRuntimeMount:
         self._num_threads = num_threads or max(2, server.options.num_threads)
         self._messenger = None
         self._raw_sessions = {}
+        self._stream_sessions = {}
         self._raw_lock = threading.Lock()
 
     def start(self, ip: str = "127.0.0.1", port: int = 0,
@@ -183,7 +246,17 @@ class NativeRuntimeMount:
             if item is None:
                 continue
             (handle, kind, meta_bytes, payload, attachment, sock_id, seq,
-             f0, f1) = item
+             f0, f1, aux) = item
+            if kind == 5:  # native-cut streaming frame
+                ftype = native.load().nat_req_compress(handle)
+                native.req_free(handle)
+                with self._raw_lock:
+                    sess = self._stream_sessions.get(sock_id)
+                    if sess is None:
+                        sess = _StreamSession(sock_id)
+                        self._stream_sessions[sock_id] = sess
+                sess.feed(seq, ftype, aux, payload)
+                continue
             if kind == 3:  # native-parsed HTTP request
                 native.req_free(handle)
                 self._handle_http(f0, f1, meta_bytes, payload, sock_id, seq)
@@ -201,10 +274,11 @@ class NativeRuntimeMount:
                         self._raw_sessions[sock_id] = sess
                 sess.feed(seq, payload)
                 continue
-            if kind == 2:  # connection closed: drop the session
+            if kind == 2:  # connection closed: drop the sessions
                 native.req_free(handle)
                 with self._raw_lock:
                     self._raw_sessions.pop(sock_id, None)
+                    self._stream_sessions.pop(sock_id, None)
                 continue
             try:
                 meta = rpc_meta_pb2.RpcMeta()

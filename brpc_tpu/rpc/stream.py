@@ -117,7 +117,16 @@ class Stream:
             return errors.EEOF
         if self._sock is None or self.peer_id is None:
             return errors.EINVAL
-        buf = data if isinstance(data, IOBuf) else IOBuf(data)
+        if isinstance(data, IOBuf):
+            buf = data
+        elif isinstance(data, bytes) and len(data) >= 65536:
+            # large immutable payload: share it zero-copy instead of
+            # copying through 8KB blocks (the IOBuf::append(user_data)
+            # path, iobuf.h:257-266) — the 1GB/s stream lane depends on it
+            buf = IOBuf()
+            buf.append_user_data(data)
+        else:
+            buf = IOBuf(data)
         size = len(buf)
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         with self._window_cond:

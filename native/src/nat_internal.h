@@ -117,6 +117,10 @@ struct NatSocket {
   // only the single reading thread touches it.
   std::atomic<bool> py_raw{false};
   uint64_t py_raw_seq = 0;
+  // streaming frames cut natively on this socket (kind-5 ordering);
+  // py_streams mirrors py_raw's close-notice duty for stream sessions
+  std::atomic<bool> py_streams{false};
+  uint64_t stream_seq = 0;
 
   // Native protocol sessions (the per-connection parse state the
   // reference keeps in Socket::_parsing_context, socket.h:793): owned by
@@ -260,12 +264,15 @@ using HttpHandlerN = std::function<void(HttpHandlerCtxN&)>;
 // HTTP/1.1 request (service = method verb, method = path, meta_bytes =
 // "k:v\n" header lines, cid = native http session token); 4 = parsed
 // gRPC-over-h2 request (method = ":path", payload = de-framed message,
-// meta_bytes = header lines, cid = h2 stream id).
+// meta_bytes = header lines, cid = h2 stream id); 5 = streaming frame
+// (aux = dest stream id, compress_type = frame type DATA/FEEDBACK/CLOSE,
+// cid = per-socket sequence for ordered delivery, payload = frame body).
 struct PyRequest {
   int32_t kind = 0;
   uint64_t sock_id = 0;
   int64_t cid = 0;
   int32_t compress_type = 0;
+  uint64_t aux = 0;
   std::string service;
   std::string method;
   std::string payload;

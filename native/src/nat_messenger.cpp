@@ -180,6 +180,8 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
             (http_sniff(pfx, n) != 0 || h2_sniff(pfx, n) != 0)) {
           break;  // could be a native-lane protocol: wait for 12+ bytes
         }
+        size_t sn = n < 4 ? n : 4;
+        if (memcmp(pfx, "TSTR", sn) == 0) break;  // partial stream frame
         size_t mn = n < 4 ? n : 4;
         if (s->server->raw_fallback && memcmp(pfx, kMagicRpc, mn) != 0) {
           s->py_raw.store(true, std::memory_order_release);
@@ -190,6 +192,39 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
     }
     char header[12];
     s->in_buf.copy_to(header, 12);
+    if (memcmp(header, "TSTR", 4) == 0 && s->server != nullptr &&
+        s->server->py_lane_enabled) {
+      // Streaming frame (streaming_rpc_protocol.cpp role): cut natively,
+      // deliver ordered to the Python Stream objects via the py lane —
+      // the Python loop never re-parses stream framing. Body = 8B dest
+      // stream id + 1B frame type + payload.
+      uint32_t body = rd_be32(header + 4);
+      if (body < 9 || body > (1u << 31)) {
+        ok = false;
+        break;
+      }
+      if (s->in_buf.length() < 8 + (size_t)body) break;
+      s->in_buf.pop_front(8);
+      char fh[9];
+      s->in_buf.copy_to(fh, 9);
+      s->in_buf.pop_front(9);
+      uint64_t dest = ((uint64_t)rd_be32(fh) << 32) | rd_be32(fh + 4);
+      PyRequest* r = new PyRequest();
+      r->kind = 5;
+      r->sock_id = s->id;
+      r->aux = dest;
+      r->compress_type = (int32_t)(uint8_t)fh[8];
+      r->cid = (int64_t)(++s->stream_seq);
+      size_t plen = body - 9;
+      if (plen > 0) {
+        r->payload.resize(plen);
+        s->in_buf.copy_to(&r->payload[0], plen);
+        s->in_buf.pop_front(plen);
+      }
+      s->py_streams.store(true, std::memory_order_release);
+      s->server->enqueue_py(r);
+      continue;
+    }
     if (memcmp(header, kMagicRpc, 4) != 0) {
       // Not tpu_std. Native HTTP/h2 sessions (sniff once, remember) take
       // precedence when enabled; then the raw-fallback py lane; then the

@@ -401,6 +401,7 @@ const char* nat_req_field(void* h, int which, size_t* len) {
 }
 
 int64_t nat_req_cid(void* h) { return ((PyRequest*)h)->cid; }
+uint64_t nat_req_aux(void* h) { return ((PyRequest*)h)->aux; }
 int32_t nat_req_compress(void* h) { return ((PyRequest*)h)->compress_type; }
 uint64_t nat_req_sock_id(void* h) { return ((PyRequest*)h)->sock_id; }
 void nat_req_free(void* h) { delete (PyRequest*)h; }

@@ -157,6 +157,8 @@ void NatSocket::reset_for_reuse() {
   ring_inflight = 0;
   py_raw.store(false, std::memory_order_relaxed);
   py_raw_seq = 0;
+  py_streams.store(false, std::memory_order_relaxed);
+  stream_seq = 0;
   http = nullptr;
   h2 = nullptr;
   close_after_drain.store(false, std::memory_order_relaxed);
@@ -187,7 +189,9 @@ void NatSocket::set_failed() {
   // wake any KeepWrite parked on EPOLLOUT
   epollout.value.fetch_add(1, std::memory_order_release);
   Scheduler::butex_wake(&epollout, INT32_MAX);
-  if (py_raw.load(std::memory_order_acquire) && server != nullptr) {
+  if ((py_raw.load(std::memory_order_acquire) ||
+       py_streams.load(std::memory_order_acquire)) &&
+      server != nullptr) {
     // tell the Python protocol stack to drop this connection's session
     PyRequest* r = new PyRequest();
     r->kind = 2;

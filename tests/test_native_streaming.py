@@ -1,0 +1,152 @@
+"""Streaming RPC over the NATIVE port — DATA/FEEDBACK/CLOSE frames are cut
+in the native loop (kind-5 py-lane requests) instead of riding the raw
+fallback; semantics must match the Python port (test_streaming.py).
+
+Reference counterpart: policy/streaming_rpc_protocol.cpp parse +
+stream.cpp write/window paths.
+"""
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc import errors
+from brpc_tpu.rpc.proto import echo_pb2
+
+native = pytest.importorskip("brpc_tpu.native")
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+class Collector(rpc.StreamInputHandler):
+    def __init__(self):
+        self.chunks = []
+        self.closed = threading.Event()
+        self.lock = threading.Lock()
+
+    def on_received_messages(self, stream, messages):
+        with self.lock:
+            for m in messages:
+                self.chunks.append(m.to_bytes())
+
+    def on_closed(self, stream):
+        self.closed.set()
+
+
+class StreamEchoService(rpc.Service):
+    """Accepts a stream and echoes every chunk back on it."""
+
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def OpenStream(self, cntl, request, response, done):
+        class EchoBack(rpc.StreamInputHandler):
+            def on_received_messages(self, stream, messages):
+                for m in messages:
+                    stream.write(m)
+
+        s = rpc.stream_accept(cntl,
+                              rpc.StreamOptions(handler=EchoBack(),
+                                                max_buf_size=32 << 20))
+        if s is None:
+            cntl.set_failed(errors.EINVAL, "no stream in request")
+        response.message = "stream accepted"
+        done()
+
+
+@pytest.fixture(scope="module")
+def native_stream_server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                       use_native_runtime=True))
+    srv.add_service(StreamEchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _open_stream(server, handler, **opts):
+    ch = rpc.Channel()
+    assert ch.init(str(server.listen_endpoint)) == 0
+    cntl = rpc.Controller()
+    cntl.timeout_ms = 5000
+    stream = rpc.stream_create(
+        cntl, rpc.StreamOptions(handler=handler, **opts))
+    resp = echo_pb2.EchoResponse()
+    ch.call_method("StreamEchoService.OpenStream", cntl,
+                   echo_pb2.EchoRequest(message="open"), resp)
+    assert not cntl.failed(), cntl.error_text
+    assert stream.wait_connected(3)
+    return ch, stream
+
+
+def test_ordered_echo_over_native_port(native_stream_server):
+    col = Collector()
+    ch, stream = _open_stream(native_stream_server, col)
+    msgs = [f"chunk-{i}".encode() for i in range(50)]
+    for m in msgs:
+        assert stream.write(m) == 0
+    deadline = time.time() + 10
+    while len(col.chunks) < len(msgs) and time.time() < deadline:
+        time.sleep(0.01)
+    assert col.chunks == msgs  # ordered, complete
+    stream.close()
+    assert col.closed.wait(5)
+
+
+def test_large_chunks_echo_and_window(native_stream_server):
+    """Multi-MB chunks: the native cut loop reassembles whole frames, the
+    window (FEEDBACK frames) keeps the writer from overrunning."""
+    col = Collector()
+    ch, stream = _open_stream(native_stream_server, col,
+                              max_buf_size=8 << 20)
+    chunk = b"z" * (1 << 20)
+    for _ in range(16):
+        assert stream.write(chunk, timeout_s=15) == 0
+    deadline = time.time() + 20
+    while sum(len(c) for c in col.chunks) < 16 << 20 and \
+            time.time() < deadline:
+        time.sleep(0.01)
+    assert sum(len(c) for c in col.chunks) == 16 << 20
+    assert all(c == chunk for c in col.chunks)
+    # feedback drained the window
+    deadline = time.time() + 5
+    while stream.unconsumed_bytes and time.time() < deadline:
+        time.sleep(0.01)
+    assert stream.unconsumed_bytes == 0
+    stream.close()
+
+
+def test_close_propagates_to_server(native_stream_server):
+    col = Collector()
+    ch, stream = _open_stream(native_stream_server, col)
+    assert stream.write(b"one") == 0
+    stream.close()
+    # server's CLOSE notification comes back: our handler sees on_closed
+    assert col.closed.wait(5)
+
+
+def test_stream_throughput_sanity(native_stream_server):
+    """The kind-5 lane moves multi-MB frames without the Python re-parse;
+    assert a floor far above the raw-lane era (~0.1 GB/s locally)."""
+    col = Collector()
+    ch, stream = _open_stream(native_stream_server, col,
+                              max_buf_size=32 << 20)
+    chunk = b"x" * (4 << 20)
+    total = 32 << 20
+    t0 = time.perf_counter()
+    sent = 0
+    while sent < total:
+        assert stream.write(chunk, timeout_s=15) == 0
+        sent += len(chunk)
+    deadline = time.time() + 30
+    while sum(len(c) for c in col.chunks) < total and \
+            time.time() < deadline:
+        time.sleep(0.005)
+    dt = time.perf_counter() - t0
+    got = sum(len(c) for c in col.chunks)
+    assert got == total
+    # echo doubles the wire bytes; even so this must beat the raw lane.
+    # Low floor: correctness gate only — the 1-core CI box runs client,
+    # native loop and py lane on one core; the real figure is the bench
+    # artifact's stream_GBps.
+    assert total / dt > 0.05e9, f"{total / dt / 1e9:.3f} GB/s"
+    stream.close()
