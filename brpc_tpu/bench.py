@@ -379,6 +379,13 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # model step + collective rows (VERDICT r3 #6)
+    model_rows = {}
+    try:
+        model_rows = model_collective_bench()
+    except Exception:
+        pass
+
     lanes = {"epoll": (fw["qps"], fw["requests"]),
              "io_uring": (ring_qps,
                           ring["requests"] if ring_qps > 0 else 0),
@@ -415,6 +422,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             "device_lanes": device_lanes,
             **http_lanes,
             **stream_lanes,
+            **model_rows,
         },
     }
 
@@ -623,6 +631,87 @@ def device_lane_bench() -> dict:
     except Exception:
         pass
 
+    return out
+
+
+def model_collective_bench() -> dict:
+    """Round-over-round model + collective rows (VERDICT r3 #6): the
+    single-chip flagship train-step rate on the real device, and the
+    8-virtual-device CPU-mesh collective bandwidth — the measurable proxy
+    for BASELINE.md's ParallelChannel-allreduce north star (harness shape:
+    example/rdma_performance/client.cpp:136-183, timed loop over a fixed
+    transfer size).
+
+    Returns {model_step_per_s, model_tokens_per_s, collective_GBps,
+    a2a_GBps}."""
+    import os
+    import subprocess
+    import sys
+
+    out = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from brpc_tpu.tensor import (ModelConfig, init_params,
+                                     make_spmd_train_step)
+        from brpc_tpu.tensor.config import MeshSpec
+
+        cfg = ModelConfig(vocab=256, d_model=128, n_heads=4, d_head=32,
+                          d_ff=256, n_layers=2, n_experts=2)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        mesh, step = make_spmd_train_step(cfg, MeshSpec())  # single chip
+        B, T = 4, 256
+        tokens = jnp.zeros((B, T), dtype=jnp.int32)
+        labels = jnp.zeros((B, T), dtype=jnp.int32)
+        loss, params2 = step(params, tokens, labels)  # compile
+        jax.block_until_ready(loss)
+        iters = 10
+        t0 = time.perf_counter()
+        p = params
+        for _ in range(iters):
+            loss, p = step(p, tokens, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        out["model_step_per_s"] = round(iters / dt, 2)
+        out["model_tokens_per_s"] = round(B * T * iters / dt, 1)
+    except Exception:
+        pass
+    try:
+        # collectives need >1 device: virtual 8-device CPU mesh in a
+        # subprocess (the dryrun_multichip environment)
+        # sitecustomize pins jax_platforms through jax.config (overrides
+        # the env var): override it back before the backend initializes,
+        # exactly as the test conftest does
+        script = (
+            "import sys; sys.path.insert(0, '.')\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from brpc_tpu import parallel\n"
+            "mesh = parallel.make_mesh({'x': 8})\n"
+            "s = parallel.ici_bandwidth_probe(mesh, 'x',\n"
+            "                                 nbytes=1 << 24, iters=5)\n"
+            "import json; print(json.dumps(s), flush=True)\n")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        res = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=300,
+                             cwd=repo_root, env=env)
+        if res.returncode == 0:
+            import json as _json
+
+            stats = _json.loads(res.stdout.strip().splitlines()[-1])
+            out["collective_GBps"] = stats.get("allreduce_GBps")
+            for k in ("allgather_GBps", "all_to_all_GBps", "a2a_GBps",
+                      "reduce_scatter_GBps"):
+                if k in stats:
+                    out[k] = stats[k]
+    except Exception:
+        pass
     return out
 
 
