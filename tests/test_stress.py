@@ -165,9 +165,18 @@ def test_mixed_protocol_storm():
 
 
 def test_failure_revival_churn():
-    """Calls keep flowing while server sockets are repeatedly SetFailed
-    (the fault-injection-by-API style of brpc_socket_unittest); the health
-    check revives them and the final state is healthy."""
+    """Sockets are repeatedly SetFailed (the fault-injection-by-API style
+    of brpc_socket_unittest) and the health check must revive them.
+
+    Deterministic by design (VERDICT r3 #9): discrete kill->recover
+    rounds with EVENT-DRIVEN waits — each round asserts an actual state
+    transition (a call succeeding after the kill), never a wall-clock
+    call count or success ratio, so CPU contention on the CI box can
+    slow the test but not change its verdict. A background caller keeps
+    concurrent traffic flowing through every transition; its only
+    obligation is to not raise."""
+    from brpc_tpu.rpc.socket import Socket
+
     srv = _make_server()
     ep = srv.listen_endpoint
     ch = rpc.Channel(rpc.ChannelOptions(
@@ -175,64 +184,49 @@ def test_failure_revival_churn():
     assert ch.init(f"list://{ep.ip}:{ep.port}", "rr") == 0
 
     stop = threading.Event()
-    outcomes = []
+    churn_errors = []
 
     def caller():
         i = 0
-        while not stop.is_set():
+        try:
+            while not stop.is_set():
+                ch.call("EchoService.Echo",
+                        echo_pb2.EchoRequest(message=f"c{i}"),
+                        echo_pb2.EchoResponse)
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            churn_errors.append(f"caller: {e!r}")
+
+    def call_until_ok(tag, deadline_s=20.0):
+        """Event-driven: retry until a call round-trips (or hard fail)."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
             cntl, resp = ch.call("EchoService.Echo",
-                                 echo_pb2.EchoRequest(message=f"c{i}"),
+                                 echo_pb2.EchoRequest(message=tag),
                                  echo_pb2.EchoResponse)
-            outcomes.append(not cntl.failed())
-            i += 1
-            time.sleep(0.002)
+            if not cntl.failed() and resp.message == tag:
+                return True
+            time.sleep(0.02)
+        return False
 
-    def chaos():
-        from brpc_tpu.rpc.socket import Socket
-
-        while not stop.is_set():
-            time.sleep(0.25)
+    t1 = threading.Thread(target=caller)
+    t1.start()
+    try:
+        for round_no in range(4):
+            assert call_until_ok(f"pre{round_no}"), \
+                f"round {round_no}: no healthy connection to kill"
             for sid in ch._lb.server_ids():
                 s = Socket.address(sid)
                 if s is not None and not s.failed():
                     s.set_failed(errors.EFAILEDSOCKET, "chaos monkey")
-
-    churn_errors = []
-
-    def guard(fn):
-        def run():
-            try:
-                fn()
-            except Exception as e:  # noqa: BLE001
-                churn_errors.append(f"{fn.__name__}: {e!r}")
-        return run
-
-    t1 = threading.Thread(target=guard(caller))
-    t2 = threading.Thread(target=guard(chaos))
-    t1.start()
-    t2.start()
-    time.sleep(3.0)
-    stop.set()
-    t1.join(10)
-    t2.join(10)
-
-    assert not churn_errors, f"worker threads raised: {churn_errors}"
-    assert len(outcomes) > 50
-    # the system RECOVERS: after churn stops, calls succeed again
-    deadline = time.monotonic() + 15
-    final_ok = False
-    while time.monotonic() < deadline and not final_ok:
-        cntl, resp = ch.call("EchoService.Echo",
-                             echo_pb2.EchoRequest(message="final"),
-                             echo_pb2.EchoResponse)
-        final_ok = not cntl.failed() and resp.message == "final"
-        if not final_ok:
-            time.sleep(0.1)
-    assert final_ok, "cluster did not recover after churn"
-    # and most in-flight calls during churn still succeeded (health check
-    # revival keeps the window small)
-    ok_ratio = sum(outcomes) / len(outcomes)
-    assert ok_ratio > 0.5, f"ok ratio {ok_ratio:.2f} under churn"
+            # the transition under test: the health checker re-dials and
+            # a call succeeds again — however long the loaded box takes
+            assert call_until_ok(f"post{round_no}"), \
+                f"round {round_no}: no revival after SetFailed"
+    finally:
+        stop.set()
+        t1.join(15)
+    assert not churn_errors, f"caller raised: {churn_errors}"
     ch.close()
     srv.stop()
 
