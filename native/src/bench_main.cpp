@@ -92,6 +92,7 @@ static void prof_dump(const char* path) {
 extern "C" {
 int nat_rpc_server_start(const char* ip, int port, int nworkers,
                          int enable_native_echo);
+int nat_rpc_use_io_uring(int enable);
 void nat_rpc_server_stop();
 double nat_rpc_client_bench(const char* ip, int port, int nconn,
                             int fibers_per_conn, double seconds,
@@ -122,6 +123,13 @@ int main(int argc, char** argv) {
   int depth = argc > 4 ? atoi(argv[4]) : 256;
 
   const char* prof_path = getenv("PROF");
+  if (strcmp(mode, "ring") == 0) {  // the io_uring_async headline lane
+    if (nat_rpc_use_io_uring(1) != 1) {
+      fprintf(stderr, "io_uring unavailable\n");
+      return 1;
+    }
+    mode = "async";
+  }
   int port = nat_rpc_server_start("127.0.0.1", 0, 0, 1);
   if (port <= 0) {
     fprintf(stderr, "server start failed\n");

@@ -115,7 +115,34 @@ void IOBuf::append(const void* data, size_t n) {
   }
 }
 
+// Below this, splicing refs costs more than copying the bytes: every
+// spliced ref is two atomic RMWs (add_ref now, release later), a ref-slot
+// push, and one more iovec for the eventual writev — while a short memcpy
+// into the shared tail block merges into the previous ref and vanishes.
+// The r03 flat profile showed exactly this: no single hotspot, the cycles
+// spread across IOBlock::release / cut_into / push_back on ~40-byte
+// frames. (The reference trades the same way: its IOBuf::append_to copies
+// short data instead of sharing blocks.)
+static const size_t kSmallCopy = 512;
+
+// Copy the first n bytes of src's refs into this buffer's shared tail
+// block(s) — the one-memcpy-per-block flat path behind the small-copy
+// appends (no stack bounce).
+void IOBuf::append_flat_from(const IOBuf& src, size_t n) {
+  size_t left = n;
+  for (uint32_t i = 0; i < src.count_ && left > 0; i++) {
+    const BlockRef& r = src.at(i);
+    size_t take = std::min((size_t)r.length, left);
+    append(r.block->data + r.offset, take);
+    left -= take;
+  }
+}
+
 void IOBuf::append(const IOBuf& other) {
+  if (other.length_ <= kSmallCopy && other.length_ > 0) {
+    append_flat_from(other, other.length_);
+    return;
+  }
   for (uint32_t i = 0; i < other.count_; i++) {
     const BlockRef& r = other.at(i);
     r.block->add_ref();
@@ -132,6 +159,11 @@ void IOBuf::append(IOBuf&& other) {
     steal(std::move(other));
     return;
   }
+  if (other.length_ <= kSmallCopy) {
+    if (other.length_ > 0) append_flat_from(other, other.length_);
+    other.clear();
+    return;
+  }
   for (uint32_t i = 0; i < other.count_; i++) {
     push_back(other.at(i));  // refs transfer as-is
   }
@@ -143,6 +175,11 @@ void IOBuf::append(IOBuf&& other) {
 
 size_t IOBuf::cut_into(IOBuf* out, size_t n) {
   n = std::min(n, length_);
+  if (n > 0 && n <= kSmallCopy) {
+    out->append_flat_from(*this, n);
+    pop_front(n);
+    return n;
+  }
   size_t remain = n;
   while (remain > 0) {
     BlockRef& r = front();

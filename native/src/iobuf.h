@@ -78,8 +78,9 @@ class IOBuf {
 
   void append(const void* data, size_t n);
   void append(const std::string& s) { append(s.data(), s.size()); }
-  void append(const IOBuf& other);  // zero-copy ref share
-  void append(IOBuf&& other);       // zero-copy ref splice (no ref churn)
+  void append(const IOBuf& other);  // ref share (short buffers flat-copy)
+  void append(IOBuf&& other);       // ref splice (short buffers flat-copy)
+  void append_flat_from(const IOBuf& src, size_t n);  // forced flat copy
 
   // move first n bytes of this into out (zero-copy)
   size_t cut_into(IOBuf* out, size_t n);

@@ -160,7 +160,8 @@ static void async_bench_fiber(void* a) {
   AsyncBenchConn* ab = (AsyncBenchConn*)a;
   NatChannel* ch = ab->ch;
   while (!ab->stop->load(std::memory_order_acquire)) {
-    if (ab->inflight.load(std::memory_order_acquire) >= ab->window) {
+    int in_flight = ab->inflight.load(std::memory_order_acquire);
+    if (in_flight >= ab->window) {
       int32_t expected = ab->room.value.load(std::memory_order_acquire);
       if (ab->inflight.load(std::memory_order_acquire) >= ab->window) {
         Scheduler::butex_wait(&ab->room, expected);
@@ -169,32 +170,36 @@ static void async_bench_fiber(void* a) {
     }
     NatSocket* s = sock_address(ch->sock_id);
     if (s == nullptr) break;
-    int64_t cid = 0;
-    ab->inflight.fetch_add(1, std::memory_order_acq_rel);
-    ab->add_ref();  // released by async_bench_cb
-    PendingCall* pc = ch->begin_call(&cid, async_bench_cb, ab);
-    if (pc == nullptr) {
-      ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
-      ab->release();
-      s->release();
-      break;
-    }
-    IOBuf frame;
-    build_request_frame(&frame, cid, "EchoService", "Echo",
-                        ab->payload->data(), ab->payload->size(), nullptr,
-                        0);
-    int wrc = s->write(std::move(frame));
-    if (wrc != 0) {
-      PendingCall* mine = ch->take_pending(cid);  // s pins the channel
-      if (mine != nullptr) {  // not yet consumed by fail_all's cb path
-        pc_free(mine);
+    // Burst fill: build every frame the window allows into ONE buffer,
+    // then one socket write — the whole burst costs one write_mu pass
+    // and one (eventual) writev instead of per-call queue traffic.
+    int room = ab->window - in_flight;
+    IOBuf burst;
+    bool dead = false;
+    for (int i = 0; i < room; i++) {
+      int64_t cid = 0;
+      ab->inflight.fetch_add(1, std::memory_order_acq_rel);
+      ab->add_ref();  // released by async_bench_cb
+      PendingCall* pc = ch->begin_call(&cid, async_bench_cb, ab);
+      if (pc == nullptr) {
         ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
         ab->release();
+        dead = true;
+        break;
       }
-      s->release();
-      break;
+      build_request_frame(&burst, cid, "EchoService", "Echo",
+                          ab->payload->data(), ab->payload->size(),
+                          nullptr, 0);
+    }
+    if (!burst.empty() && s->write(std::move(burst)) != 0) {
+      // the socket failed; its fail_all may have swept BEFORE some of
+      // this burst's begin_calls — sweep again so every in-flight call
+      // completes exactly once through the cb path (CAS-arbitrated)
+      ch->fail_all(kEFAILEDSOCKET, "socket failed");
+      dead = true;
     }
     s->release();
+    if (dead) break;
   }
   // drain the window before reporting done
   while (ab->inflight.load(std::memory_order_acquire) > 0) {

@@ -289,9 +289,14 @@ static int call_attempt(NatChannel* ch, NatSocket* s, const char* service,
   }
   int rc = pc->error_code;
   if (rc == 0 && resp_out != nullptr) {
-    *resp_len = pc->response.length();
+    *resp_len = pc->inline_len > 0 ? pc->inline_len
+                                   : pc->response.length();
     *resp_out = (char*)malloc(*resp_len ? *resp_len : 1);
-    pc->response.copy_to(*resp_out, *resp_len);
+    if (pc->inline_len > 0) {
+      memcpy(*resp_out, pc->inline_resp, pc->inline_len);
+    } else {
+      pc->response.copy_to(*resp_out, *resp_len);
+    }
   } else if (resp_out != nullptr) {
     *resp_out = nullptr;
     *resp_len = 0;
@@ -410,8 +415,12 @@ struct AcallCtx {
 
 static void acall_complete(PendingCall* pc, void* raw) {
   AcallCtx* ctx = (AcallCtx*)raw;
-  std::string resp = pc->response.to_string();
-  ctx->cb(ctx->arg, pc->error_code, resp.data(), resp.size());
+  if (pc->inline_len > 0) {
+    ctx->cb(ctx->arg, pc->error_code, pc->inline_resp, pc->inline_len);
+  } else {
+    std::string resp = pc->response.to_string();
+    ctx->cb(ctx->arg, pc->error_code, resp.data(), resp.size());
+  }
   pc_free(pc);
   delete ctx;
 }

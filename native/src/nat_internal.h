@@ -351,6 +351,16 @@ struct PendingCall {
   std::string error_text;
   IOBuf response;
   IOBuf attachment;
+  // Small responses land here instead of the IOBuf: the typical RPC
+  // reply is tens of bytes, and an inline copy skips the block
+  // add_ref/release pair plus the ref bookkeeping entirely (the same
+  // trade the short-buffer flat-copy makes in iobuf.cpp).
+  uint8_t inline_len = 0;
+  char inline_resp[56];
+
+  const char* resp_data() const {
+    return inline_len > 0 ? inline_resp : nullptr;
+  }
   // Asynchronous completion (brpc's done-closure, controller.h): when
   // set, the response path invokes cb (which owns pc) instead of waking
   // a parked caller — the async RPC surface sync calls are built on.
@@ -426,6 +436,7 @@ class NatChannel {
     pc->error_text.clear();
     pc->response.clear();
     pc->attachment.clear();
+    pc->inline_len = 0;
     pc->cb = cb;
     pc->cb_arg = cb_arg;
     pc->owner = this;
@@ -489,8 +500,31 @@ class NatChannel {
   std::atomic<uint32_t> nslots_{0};
   std::atomic<uint64_t> free_head_{0};  // (aba_tag<<32) | (idx+1)
   std::mutex grow_mu_;
+  // Consumer-side cache: pop_free grabs the WHOLE free chain in one
+  // exchange and walks it privately, so steady-state allocation costs no
+  // CAS at all (completions still CAS-push). pop_cache_lock_ arbitrates
+  // the rare case of concurrent begin_call callers — losers fall back to
+  // the shared-head CAS pop.
+  std::atomic<bool> pop_cache_lock_{false};
+  uint32_t pop_cache_ = 0;  // encoded idx+1 chain head; under the lock
 
   uint32_t pop_free() {
+    if (!pop_cache_lock_.exchange(true, std::memory_order_acquire)) {
+      uint32_t idx = UINT32_MAX;
+      if (pop_cache_ == 0) {
+        // refill: take the entire shared chain in one exchange
+        uint64_t head = free_head_.exchange(0, std::memory_order_acq_rel);
+        pop_cache_ = (uint32_t)head;
+      }
+      if (pop_cache_ != 0) {
+        idx = pop_cache_ - 1;
+        pop_cache_ = slot_at(idx)->next_free;
+      }
+      pop_cache_lock_.store(false, std::memory_order_release);
+      if (idx != UINT32_MAX) return idx;
+      if (!grow()) return UINT32_MAX;
+      return pop_free();
+    }
     while (true) {
       uint64_t head = free_head_.load(std::memory_order_acquire);
       while ((uint32_t)head != 0) {

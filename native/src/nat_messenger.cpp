@@ -305,6 +305,34 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
     }
     s->in_buf.pop_front(meta_size);
     size_t payload_size = body - meta_size - att_size;
+    if (srv == nullptr && s->channel != nullptr) {
+      // client response: route FIRST, then land the bytes — a small
+      // payload goes straight into the call slot's inline buffer (no
+      // IOBuf, no block refs), and a stale/duplicate response costs
+      // only a pop_front
+      PendingCall* pc = s->channel->take_pending(meta.correlation_id);
+      if (pc == nullptr) {
+        s->in_buf.pop_front(payload_size + att_size);
+        continue;
+      }
+      pc->error_code = meta.has_response ? meta.response.error_code : 0;
+      pc->error_text = meta.has_response ? meta.response.error_text : "";
+      if (att_size == 0 && payload_size <= sizeof(pc->inline_resp)) {
+        s->in_buf.copy_to(pc->inline_resp, payload_size);
+        s->in_buf.pop_front(payload_size);
+        pc->inline_len = (uint8_t)payload_size;
+      } else {
+        s->in_buf.cut_into(&pc->response, payload_size);
+        s->in_buf.cut_into(&pc->attachment, att_size);
+      }
+      if (pc->cb != nullptr) {
+        pc->cb(pc, pc->cb_arg);  // async completion; cb owns pc
+      } else {
+        pc->done.value.store(1, std::memory_order_release);
+        Scheduler::butex_wake(&pc->done, INT32_MAX);
+      }
+      continue;
+    }
     IOBuf payload, attachment;
     s->in_buf.cut_into(&payload, payload_size);
     s->in_buf.cut_into(&attachment, att_size);
@@ -334,20 +362,6 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
         build_response_frame(&batch_out, meta.correlation_id, kENOSERVICE,
                              "no such service/method on native port",
                              IOBuf(), IOBuf());
-      }
-    } else if (s->channel != nullptr) {
-      PendingCall* pc = s->channel->take_pending(meta.correlation_id);
-      if (pc != nullptr) {
-        pc->error_code = meta.has_response ? meta.response.error_code : 0;
-        pc->error_text = meta.has_response ? meta.response.error_text : "";
-        pc->response = std::move(payload);
-        pc->attachment = std::move(attachment);
-        if (pc->cb != nullptr) {
-          pc->cb(pc, pc->cb_arg);  // async completion; cb owns pc
-        } else {
-          pc->done.value.store(1, std::memory_order_release);
-          Scheduler::butex_wake(&pc->done, INT32_MAX);
-        }
       }
     }
   }
