@@ -546,9 +546,9 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
         memcpy(keybuf, path.data() + 1, svc_len);
         keybuf[svc_len] = '.';
         memcpy(keybuf + svc_len + 1, path.data() + slash + 1, m_len);
-        auto hit = srv->handlers.find(
+        const NativeHandler* hit = srv->find_handler(
             std::string_view(keybuf, svc_len + 1 + m_len));
-        if (hit != srv->handlers.end()) {
+        if (hit != nullptr) {
           // de-frame the (single, uncompressed) gRPC message
           IOBuf payload, attachment;
           if (data.size() >= 5 && data[0] == '\x00') {
@@ -560,7 +560,7 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
           NativeHandlerCtx ctx;
           ctx.req_payload = &payload;
           ctx.req_attachment = &attachment;
-          hit->second(ctx);
+          (*hit)(ctx);
           std::string resp = ctx.resp_payload.to_string();
           h2_respond(s, sid, resp.data(), resp.size(),
                      ctx.error_code == 0 ? 0 : 2,

@@ -306,6 +306,27 @@ class NatServer {
   std::map<std::string, NativeHandler, std::less<>> handlers;
   // native HTTP handlers keyed by exact path (checked before the py lane)
   std::map<std::string, HttpHandlerN, std::less<>> http_handlers;
+  // flat view of `handlers` built at start: with a handful of handlers a
+  // length-check + memcmp scan beats the per-request red-black-tree walk
+  // the r04 profile surfaced
+  std::vector<std::pair<std::string, const NativeHandler*>> handler_vec;
+
+  void freeze_handlers() {
+    handler_vec.clear();
+    for (const auto& kv : handlers) {
+      handler_vec.emplace_back(kv.first, &kv.second);
+    }
+  }
+
+  const NativeHandler* find_handler(std::string_view key) const {
+    for (const auto& kv : handler_vec) {
+      if (kv.first.size() == key.size() &&
+          memcmp(kv.first.data(), key.data(), key.size()) == 0) {
+        return kv.second;
+      }
+    }
+    return nullptr;
+  }
   bool py_lane_enabled = false;
   // Route unrecognized framing to the Python protocol stack instead of
   // failing the socket (set when a Python server with a full protocol

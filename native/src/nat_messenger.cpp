@@ -285,8 +285,7 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
     // should pay it — native-handled frames stay allocation-free
     NatServer* srv =
         (meta.has_request && s->server != nullptr) ? s->server : nullptr;
-    auto it = srv != nullptr ? srv->handlers.end()
-                             : decltype(srv->handlers.end())();
+    const NativeHandler* handler = nullptr;
     std::string meta_copy;
     if (srv != nullptr) {
       char keybuf[256];
@@ -296,10 +295,10 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
         memcpy(keybuf, sn.data(), sn.size());
         keybuf[sn.size()] = '.';
         memcpy(keybuf + sn.size() + 1, mn.data(), mn.size());
-        it = srv->handlers.find(
+        handler = srv->find_handler(
             std::string_view(keybuf, sn.size() + 1 + mn.size()));
       }
-      if (it == srv->handlers.end() && srv->py_lane_enabled) {
+      if (handler == nullptr && srv->py_lane_enabled) {
         meta_copy.assign(meta_ptr, meta_size);  // py lane re-parses it
       }
     }
@@ -339,11 +338,11 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
 
     if (srv != nullptr) {
       srv->requests.fetch_add(1, std::memory_order_relaxed);
-      if (it != srv->handlers.end()) {
+      if (handler != nullptr) {
         NativeHandlerCtx ctx;
         ctx.req_payload = &payload;
         ctx.req_attachment = &attachment;
-        it->second(ctx);
+        (*handler)(ctx);
         build_response_frame(&batch_out, meta.correlation_id, ctx.error_code,
                              ctx.error_text, std::move(ctx.resp_payload),
                              std::move(ctx.resp_attachment));

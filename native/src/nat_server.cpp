@@ -271,6 +271,7 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
       ctx.content_type = "application/octet-stream";
     };
   }
+  srv->freeze_handlers();
   {
     // publish AND register the listener in ONE critical section: a
     // concurrent stop can then never observe the published server while
