@@ -243,7 +243,12 @@ def _parse_scalar(field, value, opts: Json2PbOptions, path: str):
                 try:
                     closed = field.enum_type.is_closed()
                 except AttributeError:
-                    closed = True
+                    # older protobuf without is_closed(): proto3 enums are
+                    # open (unknown numbers are preserved), proto2 closed —
+                    # decide by syntax instead of rejecting everything
+                    syntax = getattr(field.enum_type.file, "syntax",
+                                     "proto2")
+                    closed = syntax == "proto2"
                 if closed:
                     raise ParseError(
                         f"{path}: {value} is not a value of "

@@ -88,6 +88,9 @@ class RtmpService:
     one publisher per stream name, any number of players; metadata and
     codec sequence headers are cached and replayed to late joiners."""
 
+    # a player this far behind is shed rather than buffered further
+    MAX_PLAYER_BACKLOG = 8 << 20
+
     def __init__(self):
         self._streams: Dict[str, _LiveStream] = {}
         self._lock = threading.Lock()
@@ -165,6 +168,16 @@ class RtmpService:
                 if getattr(p.sock, "failed", lambda: False)():
                     self.drop(p)  # EOF'd player: sockets report failure
                     continue      # by flag, not by raising
+                # Backpressure (the reference's write-overflow shedding for
+                # media streams): a stalled player's queue would otherwise
+                # grow without bound while the publisher keeps pushing —
+                # one slow consumer must not exhaust the relay's memory.
+                backlog = getattr(p.sock, "write_backlog_bytes",
+                                  lambda: 0)()
+                if backlog > self.MAX_PLAYER_BACKLOG:
+                    p.sock.set_failed()
+                    self.drop(p)
+                    continue
                 p.send_message(msg_type, ts, payload, stream_id=1)
                 _rtmp_relayed.update(1)
             except Exception:

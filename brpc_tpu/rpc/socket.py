@@ -274,6 +274,12 @@ class Socket:
                 get_global_dispatcher(fdno).resume_read(fdno)
 
     # -- write path --------------------------------------------------------
+    def write_backlog_bytes(self) -> int:
+        """Bytes queued but not yet written — the write-overflow signal
+        media relays use to shed slow consumers (socket.h backlog role)."""
+        with self._write_lock:
+            return sum(len(r.buf) for r in self._write_q)
+
     def write(self, buf: IOBuf, id_wait: Optional[int] = None,
               on_queued: Optional[Callable[[], None]] = None) -> int:
         """Queue a whole message; never interleaves with other writers
