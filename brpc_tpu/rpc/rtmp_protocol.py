@@ -315,9 +315,24 @@ class RtmpSession:
             if data[pos] != 3:
                 raise ValueError("rtmp: unsupported handshake version")
             c1 = bytes(data[pos + 1:pos + 1 + HANDSHAKE_SIZE])
-            s1 = c1[:8] + os.urandom(HANDSHAKE_SIZE - 8)
-            # S0 + S1 + S2(echo of C1) in one write
-            self._write(bytes([3]) + s1 + c1)
+            # Digest handshake (policy/rtmp_protocol.cpp:149 role): a
+            # nonzero version field means the client (OBS/Flash) expects
+            # the server to prove itself with the Media-Server key and
+            # chain S2 from C1's digest; a plain C1 gets the simple echo.
+            found = None
+            if c1[4:8] != b"\x00\x00\x00\x00":
+                from brpc_tpu.rpc import rtmp_client as rc
+
+                found = rc.find_digest(c1, rc.FP_KEY)
+            if found is not None:
+                scheme, c1_digest = found
+                s1, _ = rc.make_digest_s1(scheme)
+                s2 = rc.make_chained_reply(c1_digest, rc.FMS_KEY_FULL)
+                self._write(bytes([3]) + s1 + s2)
+            else:
+                s1 = c1[:8] + os.urandom(HANDSHAKE_SIZE - 8)
+                # S0 + S1 + S2(echo of C1) in one write
+                self._write(bytes([3]) + s1 + c1)
             self.state = self.ST_WAIT_C2
             return 1 + HANDSHAKE_SIZE
         if self.state == self.ST_WAIT_C2:
