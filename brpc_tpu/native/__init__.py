@@ -173,6 +173,8 @@ def load() -> ctypes.CDLL:
             ctypes.c_uint64, ctypes.c_int64, ctypes.c_char_p,
             ctypes.c_size_t, ctypes.c_int, ctypes.c_char_p]
         lib.nat_grpc_respond.restype = ctypes.c_int
+        lib.nat_rpc_server_ssl.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.nat_rpc_server_ssl.restype = ctypes.c_int
         lib.nat_http_client_bench.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_double, ctypes.c_char_p, ctypes.c_char_p,
@@ -332,6 +334,13 @@ def sock_graceful_close(sock_id: int) -> int:
     """Fail the socket once queued writes drain (FIN after the last
     response byte) — Connection: close semantics."""
     return load().nat_sock_graceful_close(sock_id)
+
+
+def rpc_server_ssl(certfile: str, keyfile: str) -> int:
+    """TLS on the native port (Socket-level SSLState role): connections
+    whose first record is a TLS handshake get a native SSL session; the
+    same port keeps answering plaintext. 0 = ok, -2 = libssl missing."""
+    return load().nat_rpc_server_ssl(certfile.encode(), keyfile.encode())
 
 
 def rpc_server_native_http(enable: bool = True) -> int:

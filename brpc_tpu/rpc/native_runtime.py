@@ -222,6 +222,15 @@ class NativeRuntimeMount:
                 native.rpc_server_native_http(True)
             except AttributeError:
                 pass  # older .so without the lane
+        # TLS on the native port (ServerSSLOptions role)
+        if self.server.options.ssl_certfile:
+            rc = native.rpc_server_ssl(self.server.options.ssl_certfile,
+                                       self.server.options.ssl_keyfile)
+            if rc != 0:
+                native.rpc_server_stop()
+                raise RuntimeError(
+                    f"native TLS unavailable (rc={rc}): libssl missing or "
+                    f"bad cert/key")
         for i in range(self._num_threads):
             t = threading.Thread(target=self._worker,
                                  name=f"native_py_lane_{i}", daemon=True)

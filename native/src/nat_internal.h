@@ -69,6 +69,7 @@ class NatServer;
 class NatChannel;
 struct HttpSessionN;
 struct H2SessionN;
+struct SslSessionN;
 
 // ---------------------------------------------------------------------------
 // NatSocket + versioned-id registry (socket_inl.h:28-185 shape)
@@ -134,6 +135,14 @@ struct NatSocket {
   // then shutdown sends FIN.
   std::atomic<bool> close_after_drain{false};
 
+  // TLS (the Socket-level SSLState of socket.h:539-540): set when the
+  // first record on a TLS-enabled server port sniffs as a handshake;
+  // in_buf then holds PLAINTEXT only (read paths feed ciphertext through
+  // the session), and write() encrypts before queueing. ssl_declined
+  // remembers a plaintext peer so the sniff runs once.
+  SslSessionN* ssl_sess = nullptr;
+  bool ssl_declined = false;
+
   // io_uring datapath (RingListener): (generation<<32 | file index) when
   // this socket's reads ride the provided-buffer ring (-1 = epoll lane);
   // the generation lets the ring reject stale rearms/sends after the
@@ -148,7 +157,8 @@ struct NatSocket {
   void add_ref() { versioned_ref.fetch_add(1, std::memory_order_relaxed); }
   void release();
   void reset_for_reuse();
-  int write(IOBuf&& frame);
+  int write(IOBuf&& frame);      // encrypts first on TLS sockets
+  int write_raw(IOBuf&& frame);  // wire bytes as-is (TLS records)
   bool flush_some();  // true = drained/failed-and-drained, false = EAGAIN
   void set_failed();
   void arm_epollout();
@@ -335,6 +345,10 @@ class NatServer {
   // Parse HTTP/1.1 and h2/gRPC natively (kind 3/4 py-lane requests)
   // instead of shovelling raw bytes; set with nat_rpc_server_native_http.
   bool native_http = false;
+  // TLS context (opaque SSL_CTX*, nat_ssl.cpp) — when set, connections
+  // whose first record sniffs as a TLS handshake get a native SSL
+  // session; plaintext peers keep working on the same port.
+  void* ssl_ctx = nullptr;
 
   // Python lane MPSC queue
   std::mutex py_mu;
@@ -628,6 +642,12 @@ void hp_enc_int(std::string* out, uint64_t v, int prefix, uint8_t first);
 void hp_enc_str(std::string* out, std::string_view s);
 void hp_enc_header(std::string* out, std::string_view name,
                    std::string_view value);
+
+// Native TLS session (nat_ssl.cpp).
+bool ssl_accept_begin(NatSocket* s);
+bool ssl_feed(NatSocket* s, const char* data, size_t n);
+bool ssl_encrypt(NatSocket* s, IOBuf&& plain, IOBuf* cipher_out);
+void ssl_session_free(SslSessionN* s);
 
 extern "C" {
 // forward decls shared with the bench harness

@@ -153,6 +153,39 @@ static void forward_raw_chunk(NatSocket* s) {
 // accumulator so one writev covers EVERY burst of the round (cross-burst
 // syscall batching; the client-side defer_writes twin of this discipline).
 bool process_input(NatSocket* s, IOBuf* defer_out) {
+  // TLS sniff (Socket-level SSLState, socket.h:539-540): on a
+  // TLS-enabled server port the FIRST bytes decide — a handshake record
+  // (0x16 0x03) builds the native SSL session and everything buffered so
+  // far is ciphertext to feed it; anything else stays plaintext for
+  // good. After the session exists, the read paths feed ciphertext
+  // directly, so in_buf only ever holds plaintext here.
+  if (s->server != nullptr && s->server->ssl_ctx != nullptr &&
+      s->ssl_sess == nullptr && !s->ssl_declined) {
+    if (s->in_buf.empty()) return true;
+    char pfx[3] = {0};
+    size_t pn = s->in_buf.length() < 3 ? s->in_buf.length() : 3;
+    s->in_buf.copy_to(pfx, pn);
+    if ((uint8_t)pfx[0] == 0x16) {
+      if (pn < 3) return true;  // wait for the record version bytes
+      if ((uint8_t)pfx[1] == 0x03) {
+        if (!ssl_accept_begin(s)) return false;
+        IOBuf cipher;
+        cipher.append(std::move(s->in_buf));
+        char tmp[16384];
+        while (!cipher.empty()) {
+          size_t n = cipher.length() < sizeof(tmp) ? cipher.length()
+                                                   : sizeof(tmp);
+          cipher.copy_to(tmp, n);
+          cipher.pop_front(n);
+          if (!ssl_feed(s, tmp, n)) return false;
+        }
+      } else {
+        s->ssl_declined = true;
+      }
+    } else {
+      s->ssl_declined = true;
+    }
+  }
   if (s->py_raw.load(std::memory_order_relaxed)) {
     forward_raw_chunk(s);
     return true;
@@ -390,7 +423,19 @@ bool drain_socket_inline(NatSocket* s) {
   IOBuf acc;  // responses of EVERY burst in this drain, flushed as one
   bool dead = false;
   while (!s->failed.load(std::memory_order_acquire)) {
-    ssize_t n = s->in_buf.append_from_fd(s->fd, 65536);
+    ssize_t n;
+    if (s->ssl_sess != nullptr) {
+      // TLS lane: ciphertext goes through the session; plaintext lands
+      // in in_buf inside ssl_feed
+      char tmp[65536];
+      n = ::read(s->fd, tmp, sizeof(tmp));
+      if (n > 0 && !ssl_feed(s, tmp, (size_t)n)) {
+        dead = true;
+        break;
+      }
+    } else {
+      n = s->in_buf.append_from_fd(s->fd, 65536);
+    }
     if (n > 0) {
       if (!process_input(s, &acc)) {
         dead = true;
@@ -404,6 +449,17 @@ bool drain_socket_inline(NatSocket* s) {
     break;
   }
   bool queued = false;
+  if (!acc.empty() && !dead) {
+    if (s->ssl_sess != nullptr) {
+      IOBuf cipher;  // the deferred accumulator bypasses write(): the
+                     // record layer must still wrap it
+      if (ssl_encrypt(s, std::move(acc), &cipher)) {
+        acc = std::move(cipher);
+      } else {
+        dead = true;
+      }
+    }
+  }
   if (!acc.empty() && !dead) {
     std::lock_guard<std::mutex> g(s->write_mu);
     if (!s->failed.load(std::memory_order_acquire)) {

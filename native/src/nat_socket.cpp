@@ -131,6 +131,10 @@ void NatSocket::release() {
       h2_session_free(h2);
       h2 = nullptr;
     }
+    if (ssl_sess != nullptr) {
+      ssl_session_free(ssl_sess);
+      ssl_sess = nullptr;
+    }
     in_buf.clear();
     {
       std::lock_guard<std::mutex> g(write_mu);
@@ -161,6 +165,8 @@ void NatSocket::reset_for_reuse() {
   stream_seq = 0;
   http = nullptr;
   h2 = nullptr;
+  ssl_sess = nullptr;
+  ssl_declined = false;
   close_after_drain.store(false, std::memory_order_relaxed);
 }
 
@@ -317,6 +323,18 @@ static void ring_retry_later(uint64_t sock_id) {
 }
 
 int NatSocket::write(IOBuf&& frame) {
+  if (ssl_sess != nullptr) {
+    IOBuf cipher;
+    if (!ssl_encrypt(this, std::move(frame), &cipher)) {
+      set_failed();
+      return -1;
+    }
+    return write_raw(std::move(cipher));
+  }
+  return write_raw(std::move(frame));
+}
+
+int NatSocket::write_raw(IOBuf&& frame) {
   if (failed.load(std::memory_order_acquire)) return -1;
   if (ring_ref.load(std::memory_order_acquire) >= 0) {
     // io_uring lane: queue + submit from registered send memory; ordering
@@ -410,7 +428,20 @@ bool ring_drain() {
     if (c.kind == 0) {  // recv
       if (c.res > 0) {
         if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
-          s->in_buf.append(g_ring->buffer_data(c.buf_id), (size_t)c.res);
+          if (s->ssl_sess != nullptr) {
+            // TLS: ciphertext feeds the session; plaintext lands in
+            // in_buf inside ssl_feed
+            if (!ssl_feed(s, g_ring->buffer_data(c.buf_id),
+                          (size_t)c.res)) {
+              g_ring->recycle_buffer(c.buf_id);
+              s->set_failed();
+              s->release();
+              continue;
+            }
+          } else {
+            s->in_buf.append(g_ring->buffer_data(c.buf_id),
+                             (size_t)c.res);
+          }
           g_ring->recycle_buffer(c.buf_id);
           int64_t rr = s->ring_ref.load(std::memory_order_acquire);
           if (!process_input(s)) {

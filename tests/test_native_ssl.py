@@ -1,0 +1,130 @@
+"""TLS on the NATIVE lane — SSL integrated into NatSocket (the
+socket.h:539-540 SSLState design): the same native port answers TLS and
+plaintext, and every native protocol lane (tpu_std, HTTP, h2, raw
+fallback) rides the decrypted stream unchanged.
+"""
+import os
+import socket
+import ssl as pyssl
+import subprocess
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.proto import echo_pb2
+
+native = pytest.importorskip("brpc_tpu.native")
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("nat_certs")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    proc = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         # grpcio validates the SAN, not the CN
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        capture_output=True, timeout=60,
+    )
+    if proc.returncode != 0:
+        pytest.skip("openssl unavailable")
+    return cert, key
+
+
+@pytest.fixture(scope="module")
+def tls_server(certs):
+    cert, key = certs
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                       use_native_runtime=True,
+                                       ssl_certfile=cert,
+                                       ssl_keyfile=key))
+    srv.add_service(EchoService())
+    rc = srv.start("127.0.0.1:0")
+    if rc != 0:
+        pytest.skip("native TLS unavailable (libssl missing?)")
+    yield srv
+    srv.stop()
+
+
+def _tls_connect(port):
+    ctx = pyssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = pyssl.CERT_NONE
+    raw = socket.create_connection(("127.0.0.1", port), timeout=5)
+    return ctx.wrap_socket(raw)
+
+
+def test_https_through_native_http_lane(tls_server):
+    port = tls_server.listen_endpoint.port
+    tls = _tls_connect(port)
+    tls.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+    data = tls.recv(65536)
+    assert b"200" in data and data.endswith(b"OK\n")
+    # keep-alive RPC-over-HTTPS on the same TLS connection
+    body = b'{"message": "https"}'
+    tls.sendall(b"POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: %d\r\n\r\n" % len(body) + body)
+    data = tls.recv(65536)
+    assert b'"https"' in data
+    tls.close()
+
+
+def test_plaintext_coexists_on_same_port(tls_server):
+    port = tls_server.listen_endpoint.port
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+    assert b"200" in c.recv(65536)
+    c.close()
+
+
+def test_tpu_std_rpc_over_native_tls(tls_server):
+    ch = rpc.Channel(rpc.ChannelOptions(use_ssl=True, timeout_ms=5000,
+                                        connect_timeout_ms=5000))
+    assert ch.init(str(tls_server.listen_endpoint)) == 0
+    for i in range(5):
+        cntl, resp = ch.call("EchoService.Echo",
+                             echo_pb2.EchoRequest(message=f"ntls{i}"),
+                             echo_pb2.EchoResponse)
+        assert not cntl.failed(), cntl.error_text
+        assert resp.message == f"ntls{i}"
+
+
+def test_large_payload_over_native_tls(tls_server):
+    """Multi-record messages both directions: the memory-BIO filter must
+    reassemble across TLS record boundaries."""
+    ch = rpc.Channel(rpc.ChannelOptions(use_ssl=True, timeout_ms=15000,
+                                        connect_timeout_ms=5000))
+    assert ch.init(str(tls_server.listen_endpoint)) == 0
+    big = "s" * 300_000
+    cntl, resp = ch.call("EchoService.Echo",
+                         echo_pb2.EchoRequest(message=big),
+                         echo_pb2.EchoResponse)
+    assert not cntl.failed(), cntl.error_text
+    assert resp.message == big
+
+
+def test_grpc_over_native_tls(tls_server, certs):
+    grpc = pytest.importorskip("grpc")
+    cert, _ = certs
+    port = tls_server.listen_endpoint.port
+    creds = grpc.ssl_channel_credentials(
+        root_certificates=open(cert, "rb").read())
+    with grpc.secure_channel(f"127.0.0.1:{port}", creds) as channel:
+        stub = channel.unary_unary(
+            "/EchoService/Echo",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=echo_pb2.EchoResponse.FromString)
+        resp = stub(echo_pb2.EchoRequest(message="grpc+tls"), timeout=10)
+        assert resp.message == "grpc+tls"
