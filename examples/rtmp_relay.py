@@ -77,6 +77,40 @@ def main():
     print(f"TS segment = {len(seg) // mpegts.TS_PACKET} packets, "
           f"{demuxed} PES demuxed")
 
+    # edge-pull topology: a SECOND relay server pulls "demo" from the
+    # first over the digest-handshake RtmpClient and serves its own
+    # players — the CDN-edge shape (rtmp.h RtmpClient/RtmpClientStream)
+    from brpc_tpu.rpc import rtmp_client as rclient
+
+    edge_svc = rtmp.RtmpService()
+    edge = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                        rtmp_service=edge_svc))
+    assert edge.start("127.0.0.1:0") == 0
+    puller = rclient.pull_into_service(edge_svc, "demo",
+                                       "127.0.0.1", port)
+    got = []
+
+    def on_edge_media(msg_type, ts_ms, payload):
+        if msg_type == rtmp.MSG_VIDEO:
+            got.append(payload)
+
+    edge_player = rclient.RtmpClient(
+        "127.0.0.1", edge.listen_endpoint.port).connect()
+    assert edge_player.digest_mode  # the digest handshake was used
+    edge_player.start_reader()
+    edge_player.create_stream().play("demo", on_edge_media)
+    deadline = time.monotonic() + 10
+    while len(got) < 3 and time.monotonic() < deadline:
+        pub.send_message(rtmp.MSG_VIDEO, 999, b"\x27edgeframe",
+                         stream_id=1)
+        time.sleep(0.1)
+    assert len(got) >= 3, "edge pull relayed nothing"
+    print(f"edge server relayed {len(got)} frames pulled from the origin "
+          f"(digest handshake)")
+    edge_player.close()
+    puller.close()
+    edge.stop()
+
     pconn.close()
     vconn.close()
     time.sleep(0.1)
