@@ -35,6 +35,9 @@ struct HttpSessionN {
   // requests that asked for Connection: close, by seq — the emitter
   // honors close even when the responder didn't echo it back
   std::vector<uint64_t> close_seqs;
+  // Expect: 100-continue — the interim response was already sent for the
+  // request currently awaiting its body (reading thread only)
+  bool continue_sent = false;
 };
 
 int http_sniff(const char* p, size_t n) {
@@ -197,6 +200,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
     size_t content_length = 0;
     bool chunked = false;
     bool conn_close = false;
+    bool expect_continue = false;
     const char* line = (const char*)memchr(scan, '\n', hdr_len);
     line = line == nullptr ? hdr_end : line + 1;
     while (line < hdr_end) {
@@ -226,6 +230,9 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
           std::string lv(val);
           for (char& c : lv) c = (char)tolower((unsigned char)c);
           conn_close = lv.find("close") != std::string::npos;
+        } else if (key == "expect") {
+          expect_continue =
+              val.find("100-continue") != std::string_view::npos;
         }
         flat.push_back(':');
         flat.push_back(' ');
@@ -267,13 +274,28 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         if (body.size() > kMaxBodyBytes) return 0;
         pos = chunk_hdr_end + sz + 2;
       }
-      if (!done) break;  // need more bytes
+      if (!done) {
+        if (expect_continue && !h->continue_sent) {
+          // interim reply unblocks clients (curl) that wait for it
+          // before sending the body
+          batch_out->append("HTTP/1.1 100 Continue\r\n\r\n", 25);
+          h->continue_sent = true;
+        }
+        break;  // need more bytes
+      }
     } else {
-      if (buffered < body_start + content_length) break;  // need body
+      if (buffered < body_start + content_length) {
+        if (expect_continue && !h->continue_sent) {
+          batch_out->append("HTTP/1.1 100 Continue\r\n\r\n", 25);
+          h->continue_sent = true;
+        }
+        break;  // need body
+      }
       total = body_start + content_length;
     }
     // dispatch
     uint64_t seq = h->next_req_seq++;
+    h->continue_sent = false;  // this request is complete
     bool head_only = verb == "HEAD";
     std::string_view path = uri.substr(0, uri.find('?'));
     srv->requests.fetch_add(1, std::memory_order_relaxed);

@@ -186,6 +186,35 @@ def test_native_http_echo_handler_and_bench():
         native.rpc_server_stop()
 
 
+def test_stock_curl_interop(http_server):
+    """A stock client against the native lane: plain GET, keep-alive, and
+    a POST with Expect: 100-continue (curl waits for the interim reply
+    before sending the body — the lane must emit it)."""
+    import shutil
+    import subprocess
+
+    if shutil.which("curl") is None:
+        pytest.skip("curl unavailable")
+    port = http_server.listen_endpoint.port
+    r = subprocess.run(["curl", "-s", f"http://127.0.0.1:{port}/health"],
+                       capture_output=True, text=True, timeout=15)
+    assert r.stdout.strip() == "OK"
+    big = json.dumps({"message": "x" * 2000})
+    r = subprocess.run(
+        ["curl", "-s", "-X", "POST",
+         "-H", "Content-Type: application/json",
+         "-H", "Expect: 100-continue", "-d", big,
+         f"http://127.0.0.1:{port}/EchoService/Echo"],
+        capture_output=True, text=True, timeout=15)
+    assert json.loads(r.stdout)["message"] == "x" * 2000
+    # two URLs in one invocation reuse the connection (keep-alive)
+    r = subprocess.run(["curl", "-s", f"http://127.0.0.1:{port}/health",
+                        f"http://127.0.0.1:{port}/version"],
+                       capture_output=True, text=True, timeout=15)
+    assert "OK" in r.stdout
+    r.check_returncode()
+
+
 def test_404_and_bad_method_pages_still_work(http_server):
     port = http_server.listen_endpoint.port
     sk = socket.create_connection(("127.0.0.1", port))
