@@ -115,6 +115,39 @@ def test_large_payload_over_native_tls(tls_server):
     assert resp.message == big
 
 
+def test_concurrent_tls_connections(tls_server):
+    """Several TLS clients at once: the per-session lock must keep the
+    record layer sane while responders (py lane) and the reading thread
+    interleave."""
+    import threading
+
+    errs = []
+
+    def worker(tag):
+        try:
+            ch = rpc.Channel(rpc.ChannelOptions(use_ssl=True,
+                                                timeout_ms=10000,
+                                                connect_timeout_ms=5000))
+            assert ch.init(str(tls_server.listen_endpoint)) == 0
+            for i in range(20):
+                m = f"t{tag}-{i}"
+                cntl, resp = ch.call("EchoService.Echo",
+                                     echo_pb2.EchoRequest(message=m),
+                                     echo_pb2.EchoResponse)
+                assert not cntl.failed(), cntl.error_text
+                assert resp.message == m
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [__import__("threading").Thread(target=worker, args=(t,))
+          for t in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+
+
 def test_grpc_over_native_tls(tls_server, certs):
     grpc = pytest.importorskip("grpc")
     cert, _ = certs
