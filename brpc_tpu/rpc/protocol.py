@@ -108,6 +108,9 @@ class Protocol:
 
 _protocols: Dict[ProtocolType, Protocol] = {}
 _lock = threading.Lock()
+# RLock: a registration import that re-enters globally_initialize on the
+# same thread must not deadlock
+_init_lock = threading.RLock()
 _globally_initialized = False
 
 
@@ -158,12 +161,23 @@ def list_server_protocols() -> List[Protocol]:
 
 def globally_initialize():
     """GlobalInitializeOrDie's role (global.cpp:354-606): register every
-    built-in protocol / LB / NS / compressor exactly once."""
+    built-in protocol / LB / NS / compressor exactly once.
+
+    The done flag only flips AFTER every registration import completes:
+    flipping it first let a concurrent initializer return early and look
+    up protocols in a half-populated registry (EPROTONOTSUP from
+    Channel.init under thread races — seen in the ring storm test)."""
     global _globally_initialized
-    with _lock:
+    if _globally_initialized:
+        return  # fast path: flag is only ever set after full registration
+    with _init_lock:
         if _globally_initialized:
             return
+        _do_global_imports()
         _globally_initialized = True
+
+
+def _do_global_imports():
     from brpc_tpu.rpc import tpu_std_protocol  # noqa: F401 (self-registers)
     from brpc_tpu.rpc import http_protocol  # noqa: F401
     from brpc_tpu.rpc import streaming_protocol  # noqa: F401
