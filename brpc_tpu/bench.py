@@ -273,8 +273,8 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
         """One async-windowed measurement; (qps, requests)."""
         out = ctypes.c_uint64(0)
         q = native.load().nat_rpc_client_bench_async(
-            b"127.0.0.1", port_, conns, window, max(1.0, seconds / 2),
-            payload, ctypes.byref(out))
+            b"127.0.0.1", port_, conns, int(window),
+            max(1.0, seconds / 2), payload, ctypes.byref(out))
         return q, out.value
 
     port = native.rpc_server_start(native_echo=True)
@@ -303,14 +303,15 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
                     seconds=seconds, payload=payload)
                 ring_qps = ring["qps"]
                 # shape sweep: more connections shard across the
-                # dispatcher pool on many-core hosts; the narrow shape
-                # wins on few cores — keep the better
-                for shape_conns in (nconn, nconn * 2):
-                    q, reqs = _async_lane(port_r, shape_conns)
+                # dispatcher pool on many-core hosts, deeper windows
+                # amortize per-burst costs; keep the best
+                for shape_conns, win in ((nconn, 256), (nconn * 2, 256),
+                                         (nconn, 512)):
+                    q, reqs = _async_lane(port_r, shape_conns, win)
                     if q > ring_async_qps:
                         ring_async_qps = q
                         ring_async_requests = reqs
-                        ring_async_shape = f"{shape_conns}conn"
+                        ring_async_shape = f"{shape_conns}conn/w{win}"
             finally:
                 native.rpc_server_stop()
     except Exception:
@@ -428,8 +429,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     lane_config = {"epoll": f"{fibers_per_conn} sync fibers/conn",
                    "io_uring": f"{fibers_per_conn} sync fibers/conn",
                    "io_uring_async":
-                       f"{ring_async_shape}, window=256/conn, "
-                       f"done-callbacks",
+                       f"{ring_async_shape}, done-callbacks",
                    "async_windowed":
                        f"{async_shape}, window=256/conn, done-callbacks"}
     return {
