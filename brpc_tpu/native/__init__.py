@@ -281,11 +281,15 @@ def rpc_server_requests() -> int:
 def take_request(timeout_ms: int = 100):
     """Python lane: pull one item handed off by the native runtime.
     Returns (handle, kind, meta_bytes, payload, attachment, sock_id, seq,
-    f0, f1) or None. kind 0 = parsed tpu_std request; 1 = raw protocol
-    bytes (seq orders chunks per socket); 2 = connection closed; 3 =
-    native-parsed HTTP request (f0 = verb, f1 = uri, meta_bytes =
+    f0, f1, aux) or None. kind 0 = parsed tpu_std request; 1 = raw
+    protocol bytes (seq orders chunks per socket); 2 = connection closed;
+    3 = native-parsed HTTP request (f0 = verb, f1 = uri, meta_bytes =
     lowercased "key: value\\n" header lines, payload = body, seq = the
-    connection-ordered response token for http_respond)."""
+    connection-ordered response token for http_respond); 4 =
+    native-parsed gRPC request (f1 = :path, payload = gRPC-framed body,
+    seq = h2 stream id); 5 = streaming frame (aux = dest stream id,
+    payload = frame body, seq orders frames per socket). aux is 0 except
+    for kind 5."""
     lib = load()
     h = lib.nat_take_request(timeout_ms)
     if not h:

@@ -424,9 +424,9 @@ class NativeRuntimeMount:
             msg.arg = self.server
             http_process_request(msg)
         except Exception as e:
+            body = f"{e}\n".encode()
             resp = (f"HTTP/1.1 500 Internal Server Error\r\n"
-                    f"Content-Length: {len(str(e)) + 1}\r\n\r\n"
-                    f"{e}\n").encode()
+                    f"Content-Length: {len(body)}\r\n\r\n").encode() + body
             try:
                 native.http_respond(sock_id, seq, resp)
             except Exception:

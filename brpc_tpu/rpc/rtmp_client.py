@@ -368,12 +368,19 @@ def pull_into_service(service: "rp.RtmpService", name: str, host: str,
         raise RuntimeError(f"rtmp relay: stream {name!r} already "
                            f"has a publisher")
     client = RtmpClient(host, port, app=app, timeout=timeout)
-    client.connect()
-    stream = client.create_stream()
+    try:
+        client.connect()
+        stream = client.create_stream()
 
-    def on_media(msg_type, ts, payload):
-        service.on_media(name, msg_type, ts, payload)
+        def on_media(msg_type, ts, payload):
+            service.on_media(name, msg_type, ts, payload)
 
-    client.start_reader()
-    stream.play(remote_name or name, on_media, timeout=timeout)
+        client.start_reader()
+        stream.play(remote_name or name, on_media, timeout=timeout)
+    except Exception:
+        # release the claim or the name is wedged until process restart
+        # (the origin's null sock never reports failed())
+        service.release_publisher(name, origin)
+        client.close()
+        raise
     return client

@@ -237,7 +237,11 @@ class HpackDecoderN {
       } else if (b & 0x20) {  // dynamic table size update
         uint64_t sz;
         if (!hp_int(d, n, &pos, 5, &sz)) return false;
-        max_size_ = (size_t)sz;
+        // we never advertise SETTINGS_HEADER_TABLE_SIZE, so the peer's
+        // update must stay within the 4096 default (RFC 7541 §6.3) —
+        // clamping also caps per-connection memory against a client
+        // announcing a huge table and filling it
+        max_size_ = sz > 4096 ? 4096 : (size_t)sz;
         evict();
         continue;
       } else {  // literal without indexing / never indexed

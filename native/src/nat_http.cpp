@@ -139,6 +139,21 @@ static void build_http_response(std::string* out, int status,
   if (!head_only && body_len) out->append(body, body_len);
 }
 
+// Interim 100 Continue for a body still in flight (curl waits for it).
+// Only sent when every earlier pipelined response has already gone out —
+// an interim reply jumping the reorder window would desynchronize the
+// client's response matching.
+static void http_maybe_send_continue(HttpSessionN* h, bool expect_continue,
+                                     IOBuf* batch_out) {
+  if (!expect_continue || h->continue_sent) return;
+  {
+    std::lock_guard<std::mutex> g(h->mu);
+    if (!h->parked.empty() || h->next_resp_seq != h->next_req_seq) return;
+  }
+  batch_out->append("HTTP/1.1 100 Continue\r\n\r\n", 25);
+  h->continue_sent = true;
+}
+
 // Parse + dispatch every complete pipelined request buffered on s.
 // Returns 1 (session active), 2 (sniff needs more bytes), 0 (error).
 int http_try_process(NatSocket* s, IOBuf* batch_out) {
@@ -249,10 +264,17 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
       // dechunk (requires the full chunked body buffered — the Python
       // parser's discipline; chunked uploads are rare and small here)
       if (scan_len < buffered) {
+        // the resize reallocates the buffer verb/uri point into: save
+        // their offsets and rebind after the copy (use-after-free
+        // otherwise, remotely reachable via a >64KB chunked upload)
+        size_t verb_off = (size_t)(verb.data() - scan);
+        size_t uri_off = (size_t)(uri.data() - scan);
         heap_scan.resize(buffered);
         s->in_buf.copy_to(&heap_scan[0], buffered);
         scan = heap_scan.data();
         scan_len = buffered;
+        verb = std::string_view(scan + verb_off, verb.size());
+        uri = std::string_view(scan + uri_off, uri.size());
       }
       size_t pos = body_start;
       bool done = false;
@@ -275,20 +297,12 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         pos = chunk_hdr_end + sz + 2;
       }
       if (!done) {
-        if (expect_continue && !h->continue_sent) {
-          // interim reply unblocks clients (curl) that wait for it
-          // before sending the body
-          batch_out->append("HTTP/1.1 100 Continue\r\n\r\n", 25);
-          h->continue_sent = true;
-        }
+        http_maybe_send_continue(h, expect_continue, batch_out);
         break;  // need more bytes
       }
     } else {
       if (buffered < body_start + content_length) {
-        if (expect_continue && !h->continue_sent) {
-          batch_out->append("HTTP/1.1 100 Continue\r\n\r\n", 25);
-          h->continue_sent = true;
-        }
+        http_maybe_send_continue(h, expect_continue, batch_out);
         break;  // need body
       }
       total = body_start + content_length;
