@@ -294,3 +294,121 @@ def test_ring_lane_storm():
     finally:
         native.rpc_server_stop()
         native.use_io_uring(False)
+
+
+def test_native_lane_storm():
+    """Every native lane at once on ONE use_native_runtime port: tpu_std
+    via Python channels, HTTP through the native parser (native + py
+    usercode), gRPC through the native h2 session, and streaming frames —
+    the cross-lane concurrency soak for the round-4 native data path."""
+    from brpc_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+
+    class StreamSink(rpc.StreamInputHandler):
+        def __init__(self):
+            self.nbytes = 0
+
+        def on_received_messages(self, stream, messages):
+            for m in messages:
+                self.nbytes += len(m)
+
+    sink = StreamSink()
+
+    class StormService(rpc.Service):
+        @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            response.message = request.message
+            done()
+
+        @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        def OpenStream(self, cntl, request, response, done):
+            rpc.stream_accept(cntl, rpc.StreamOptions(handler=sink,
+                                                      max_buf_size=8 << 20))
+            response.message = "ok"
+            done()
+
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                       use_native_runtime=True,
+                                       native_builtin_echo=True))
+    srv.add_service(StormService())
+    assert srv.start("127.0.0.1:0") == 0
+    port = srv.listen_endpoint.port
+    stop = threading.Event()
+    errors_seen = []
+    progress = {"std": 0, "http": 0, "grpc": 0, "stream": 0}
+
+    def guard(fn, tag):
+        def run():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                errors_seen.append(f"{tag}: {e!r}")
+        return run
+
+    def std_loop():
+        ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=10000))
+        assert ch.init(f"127.0.0.1:{port}") == 0
+        i = 0
+        while not stop.is_set():
+            cntl, resp = ch.call("StormService.Echo",
+                                 echo_pb2.EchoRequest(message=f"s{i}"),
+                                 echo_pb2.EchoResponse)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == f"s{i}"
+            progress["std"] += 1
+            i += 1
+        ch.close()
+
+    def http_loop():
+        r = native.http_client_bench("127.0.0.1", port, nconn=1,
+                                     pipeline=8, seconds=1.8,
+                                     path="/echo", post_body=b"h" * 16)
+        progress["http"] += r["requests"]
+
+    def grpc_loop():
+        from brpc_tpu.rpc.proto import echo_pb2 as _pb
+
+        req = _pb.EchoRequest(message="g" * 16)
+        r = native.grpc_client_bench("127.0.0.1", port, nconn=1,
+                                     window=8, seconds=1.8,
+                                     path="/StormService/Echo",
+                                     payload=req.SerializeToString())
+        progress["grpc"] += r["requests"]
+
+    def stream_loop():
+        ch = rpc.Channel()
+        assert ch.init(f"127.0.0.1:{port}") == 0
+        cntl = rpc.Controller()
+        cntl.timeout_ms = 10000
+        st = rpc.stream_create(cntl, rpc.StreamOptions(max_buf_size=8 << 20))
+        resp = echo_pb2.EchoResponse()
+        ch.call_method("StormService.OpenStream", cntl,
+                       echo_pb2.EchoRequest(message="open"), resp)
+        assert not cntl.failed(), cntl.error_text
+        assert st.wait_connected(5)
+        chunk = b"z" * 65536
+        while not stop.is_set():
+            assert st.write(chunk, timeout_s=10) == 0
+            progress["stream"] += 1
+        st.close()
+
+    threads = [threading.Thread(target=guard(std_loop, "std")),
+               threading.Thread(target=guard(http_loop, "http")),
+               threading.Thread(target=guard(grpc_loop, "grpc")),
+               threading.Thread(target=guard(stream_loop, "stream"))]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(20)
+    assert not errors_seen, errors_seen[:3]
+    # every lane made real progress through the one port
+    assert progress["std"] > 10, progress
+    assert progress["http"] > 10, progress
+    assert progress["grpc"] > 10, progress
+    assert progress["stream"] > 2, progress
+    assert sink.nbytes > 0
+    srv.stop()
