@@ -202,7 +202,7 @@ size_t IOBuf::cut_into(IOBuf* out, size_t n) {
   return n;
 }
 
-size_t IOBuf::pop_front(size_t n) {
+size_t IOBuf::pop_front_slow(size_t n) {
   n = std::min(n, length_);
   size_t remain = n;
   while (remain > 0) {
@@ -222,7 +222,7 @@ size_t IOBuf::pop_front(size_t n) {
   return n;
 }
 
-size_t IOBuf::copy_to(void* out, size_t n, size_t pos) const {
+size_t IOBuf::copy_to_slow(void* out, size_t n, size_t pos) const {
   char* dst = (char*)out;
   size_t copied = 0, skip = pos;
   for (uint32_t i = 0; i < count_; i++) {

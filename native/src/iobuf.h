@@ -84,8 +84,31 @@ class IOBuf {
 
   // move first n bytes of this into out (zero-copy)
   size_t cut_into(IOBuf* out, size_t n);
-  size_t pop_front(size_t n);
-  size_t copy_to(void* out, size_t n, size_t pos = 0) const;
+  // Inline fast path: the overwhelmingly common shape on the cut loop is
+  // a pop that stays inside the front block (frames are far smaller than
+  // the 8KB blocks) — one offset bump, no loop, no release.
+  size_t pop_front(size_t n) {
+    if (count_ > 0) {
+      BlockRef& r = refs_[begin_];
+      if (r.length > n) {
+        r.offset += (uint32_t)n;
+        r.length -= (uint32_t)n;
+        length_ -= n;
+        return n;
+      }
+    }
+    return pop_front_slow(n);
+  }
+  size_t copy_to(void* out, size_t n, size_t pos = 0) const {
+    if (count_ > 0) {
+      const BlockRef& r = refs_[begin_];
+      if (pos + n <= r.length) {  // entirely inside the front block
+        memcpy(out, r.block->data + r.offset + pos, n);
+        return n;
+      }
+    }
+    return copy_to_slow(out, n, pos);
+  }
   std::string to_string() const;
 
   // Contiguous view of the first n bytes: returns a pointer into the first
@@ -109,6 +132,8 @@ class IOBuf {
  private:
   static const uint32_t kInlineRefs = 6;
 
+  size_t pop_front_slow(size_t n);
+  size_t copy_to_slow(void* out, size_t n, size_t pos) const;
   void push_ref(IOBlock* b, uint32_t off, uint32_t len);
 
   BlockRef& front() { return refs_[begin_]; }
