@@ -175,6 +175,9 @@ def load() -> ctypes.CDLL:
         lib.nat_grpc_respond.restype = ctypes.c_int
         lib.nat_rpc_server_ssl.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.nat_rpc_server_ssl.restype = ctypes.c_int
+        lib.nat_take_request_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int]
+        lib.nat_take_request_batch.restype = ctypes.c_int
         lib.nat_http_client_bench.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_double, ctypes.c_char_p, ctypes.c_char_p,
@@ -309,6 +312,37 @@ def take_request(timeout_ms: int = 100):
                 lib.nat_req_cid(h), b"", b"", lib.nat_req_aux(h))
     return (h, kind, field(4), field(2), field(3),
             lib.nat_req_sock_id(h), lib.nat_req_cid(h), b"", b"", 0)
+
+
+def take_requests(max_items: int = 16, timeout_ms: int = 100):
+    """Batch take: one condvar round + one FFI crossing per burst. Returns
+    a list of the same tuples take_request yields (possibly empty)."""
+    lib = load()
+    arr = (ctypes.c_void_p * max_items)()
+    n = lib.nat_take_request_batch(arr, max_items, timeout_ms)
+    out = []
+    for i in range(n):
+        h = arr[i]
+        kind = lib.nat_req_kind(h)
+
+        def field(which, h=h):
+            ln = ctypes.c_size_t(0)
+            p = lib.nat_req_field(h, which, ctypes.byref(ln))
+            return ctypes.string_at(p, ln.value) if p and ln.value else b""
+
+        if kind in (3, 4):
+            out.append((h, kind, field(4), field(2), b"",
+                        lib.nat_req_sock_id(h), lib.nat_req_cid(h),
+                        field(0), field(1), 0))
+        elif kind == 5:
+            out.append((h, kind, b"", field(2), b"",
+                        lib.nat_req_sock_id(h), lib.nat_req_cid(h),
+                        b"", b"", lib.nat_req_aux(h)))
+        else:
+            out.append((h, kind, field(4), field(2), field(3),
+                        lib.nat_req_sock_id(h), lib.nat_req_cid(h),
+                        b"", b"", 0))
+    return out
 
 
 def rpc_server_enable_raw_fallback(enable: bool = True) -> int:

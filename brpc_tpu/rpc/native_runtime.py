@@ -251,44 +251,51 @@ class NativeRuntimeMount:
         from brpc_tpu.rpc.tpu_std_protocol import RpcMessage, process_request
 
         while not self._stopping:
-            item = native.take_request(100)
-            if item is None:
+            items = native.take_requests(16, 100)
+            if not items:
                 continue
-            (handle, kind, meta_bytes, payload, attachment, sock_id, seq,
-             f0, f1, aux) = item
-            if kind == 5:  # native-cut streaming frame
-                ftype = native.load().nat_req_compress(handle)
-                native.req_free(handle)
-                with self._raw_lock:
-                    sess = self._stream_sessions.get(sock_id)
-                    if sess is None:
-                        sess = _StreamSession(sock_id)
-                        self._stream_sessions[sock_id] = sess
-                sess.feed(seq, ftype, aux, payload)
-                continue
-            if kind == 3:  # native-parsed HTTP request
-                native.req_free(handle)
-                self._handle_http(f0, f1, meta_bytes, payload, sock_id, seq)
-                continue
-            if kind == 4:  # native-parsed gRPC-over-h2 request
-                native.req_free(handle)
-                self._handle_grpc(f1, meta_bytes, payload, sock_id, seq)
-                continue
-            if kind == 1:  # raw protocol bytes
-                native.req_free(handle)
-                with self._raw_lock:
-                    sess = self._raw_sessions.get(sock_id)
-                    if sess is None:
-                        sess = _RawSession(self._messenger, sock_id)
-                        self._raw_sessions[sock_id] = sess
-                sess.feed(seq, payload)
-                continue
-            if kind == 2:  # connection closed: drop the sessions
-                native.req_free(handle)
-                with self._raw_lock:
-                    self._raw_sessions.pop(sock_id, None)
-                    self._stream_sessions.pop(sock_id, None)
-                continue
+            for item in items:
+                self._dispatch_one(item)
+
+    def _dispatch_one(self, item):
+        from brpc_tpu.rpc.tpu_std_protocol import RpcMessage, process_request
+
+        (handle, kind, meta_bytes, payload, attachment, sock_id, seq,
+         f0, f1, aux) = item
+        if kind == 5:  # native-cut streaming frame
+            ftype = native.load().nat_req_compress(handle)
+            native.req_free(handle)
+            with self._raw_lock:
+                sess = self._stream_sessions.get(sock_id)
+                if sess is None:
+                    sess = _StreamSession(sock_id)
+                    self._stream_sessions[sock_id] = sess
+            sess.feed(seq, ftype, aux, payload)
+            return
+        if kind == 3:  # native-parsed HTTP request
+            native.req_free(handle)
+            self._handle_http(f0, f1, meta_bytes, payload, sock_id, seq)
+            return
+        if kind == 4:  # native-parsed gRPC-over-h2 request
+            native.req_free(handle)
+            self._handle_grpc(f1, meta_bytes, payload, sock_id, seq)
+            return
+        if kind == 1:  # raw protocol bytes
+            native.req_free(handle)
+            with self._raw_lock:
+                sess = self._raw_sessions.get(sock_id)
+                if sess is None:
+                    sess = _RawSession(self._messenger, sock_id)
+                    self._raw_sessions[sock_id] = sess
+            sess.feed(seq, payload)
+            return
+        if kind == 2:  # connection closed: drop the sessions
+            native.req_free(handle)
+            with self._raw_lock:
+                self._raw_sessions.pop(sock_id, None)
+                self._stream_sessions.pop(sock_id, None)
+            return
+        if True:
             try:
                 meta = rpc_meta_pb2.RpcMeta()
                 meta.ParseFromString(meta_bytes)

@@ -374,6 +374,21 @@ class NatServer {
     py_q.pop_front();
     return r;
   }
+
+  // Batch take: one condvar round + one FFI crossing covers a whole
+  // burst (the py lane's per-item wakeup was measurable at qps scale).
+  int take_py_batch(PyRequest** out, int max, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(py_mu);
+    if (py_q.empty() && !py_stopping) {
+      py_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+    }
+    int n = 0;
+    while (n < max && !py_q.empty()) {
+      out[n++] = py_q.front();
+      py_q.pop_front();
+    }
+    return n;
+  }
 };
 
 // ---------------------------------------------------------------------------

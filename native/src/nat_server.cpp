@@ -386,6 +386,20 @@ void* nat_take_request(int timeout_ms) {
   return r;
 }
 
+// Batch variant: fills up to `max` handles, returns the count.
+int nat_take_request_batch(void** out, int max, int timeout_ms) {
+  NatServer* srv;
+  {
+    std::lock_guard<std::mutex> g(g_rt_mu);
+    srv = g_rpc_server;
+    if (srv == nullptr) return 0;
+    srv->add_ref();
+  }
+  int n = srv->take_py_batch((PyRequest**)out, max, timeout_ms);
+  srv->release();
+  return n;
+}
+
 const char* nat_req_field(void* h, int which, size_t* len) {
   PyRequest* r = (PyRequest*)h;
   const std::string* s = nullptr;
