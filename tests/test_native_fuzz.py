@@ -1,0 +1,153 @@
+"""Fuzz the NATIVE parsers (HTTP/1.1, h2, TLS sniff, tpu_std cut loop)
+with hostile bytes — these run in C++, so a parser bug is a process
+crash, not an exception. After every volley the server must still answer
+a well-formed request (the liveness oracle).
+
+Deterministic seeds: failures reproduce.
+"""
+import json
+import random
+import socket
+import struct
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.proto import echo_pb2
+
+native = pytest.importorskip("brpc_tpu.native")
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+@pytest.fixture(scope="module")
+def fuzz_server():
+    srv = rpc.Server(rpc.ServerOptions(num_threads=4,
+                                       use_native_runtime=True))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    yield srv
+    srv.stop()
+
+
+def _alive(port) -> bool:
+    """Liveness oracle: a clean HTTP request round-trips."""
+    try:
+        sk = socket.create_connection(("127.0.0.1", port), timeout=5)
+        sk.settimeout(5)
+        sk.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+        data = sk.recv(65536)
+        sk.close()
+        return b"200" in data
+    except OSError:
+        return False
+
+
+def _volley(port, payloads):
+    """Throw each payload on its own connection; tolerate resets."""
+    for p in payloads:
+        try:
+            sk = socket.create_connection(("127.0.0.1", port), timeout=5)
+            sk.settimeout(0.25)  # long enough to elicit a reply/reset;
+            sk.sendall(p)        # the oracle, not the recv, proves health
+            try:
+                sk.recv(4096)
+            except OSError:
+                pass
+            sk.close()
+        except OSError:
+            pass
+
+
+def test_random_bytes_storm(fuzz_server):
+    port = fuzz_server.listen_endpoint.port
+    rng = random.Random(0xBADC0DE)
+    payloads = [bytes(rng.randrange(256) for _ in range(rng.randrange(1, 600)))
+                for _ in range(60)]
+    _volley(port, payloads)
+    assert _alive(port)
+
+
+def test_http_shaped_garbage(fuzz_server):
+    port = fuzz_server.listen_endpoint.port
+    rng = random.Random(7)
+    base = (b"POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\nContent-Length: 18\r\n\r\n"
+            b'{"message": "ok!"}')
+    payloads = [
+        b"GET " + b"/" * 70000 + b" HTTP/1.1\r\n\r\n",  # oversized header
+        b"POST / HTTP/1.1\r\nContent-Length: 99999999999999999999\r\n\r\n",
+        b"POST / HTTP/1.1\r\nContent-Length: -5\r\n\r\nxxxx",
+        b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"ZZZ\r\njunk\r\n0\r\n\r\n",  # bad chunk size
+        b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"ffffffffffffffff\r\n",  # absurd chunk size
+        b"GET /\r\n\r\n",  # missing version
+        b"GET  HTTP/1.1\r\n\r\n",  # missing path
+        b"POST / HTTP/1.1\r\nExpect: 100-continue\r\n"
+        b"Content-Length: 10\r\n\r\n",  # body never arrives
+    ]
+    # mutations of a valid request: bit flips + truncations
+    for _ in range(40):
+        b = bytearray(base)
+        for _ in range(rng.randrange(1, 6)):
+            b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+        payloads.append(bytes(b[:rng.randrange(1, len(b) + 1)]))
+    _volley(port, payloads)
+    assert _alive(port)
+
+
+def test_h2_frame_garbage(fuzz_server):
+    port = fuzz_server.listen_endpoint.port
+    rng = random.Random(42)
+    preface = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+    def frame(ftype, flags, sid, payload):
+        return (struct.pack(">I", len(payload))[1:] +
+                bytes([ftype, flags]) + struct.pack(">I", sid) + payload)
+
+    payloads = [
+        preface[:10],  # truncated preface
+        preface + frame(1, 0x4, 1, b"\xff" * 64),  # bad HPACK
+        preface + frame(1, 0x4, 1, b"\x82\x84"),   # headers w/o :path value
+        preface + frame(4, 0, 0, b"\x00\x04" + b"\xff" * 4),  # huge window
+        preface + frame(4, 0, 0, b"123"),          # bad SETTINGS length
+        preface + frame(6, 0, 0, b"x" * 3),        # bad PING length
+        preface + frame(8, 0, 0, b"\x00\x00\x00\x00"),  # zero window inc
+        preface + frame(0, 0x1, 99, b"\x00\x00\x00\x00\x05hello"),  # DATA
+        preface + frame(9, 0x4, 1, b"junk"),       # CONTINUATION w/o HEADERS
+        preface + frame(5, 0, 2, b"push"),         # client PUSH_PROMISE
+        preface + frame(1, 0x8 | 0x4, 1, b"\xf0\x01\x82"),  # padded > len
+    ]
+    for _ in range(30):
+        payloads.append(preface + bytes(
+            rng.randrange(256) for _ in range(rng.randrange(9, 120))))
+    _volley(port, payloads)
+    assert _alive(port)
+
+
+def test_tpu_std_frame_garbage(fuzz_server):
+    port = fuzz_server.listen_endpoint.port
+    rng = random.Random(3)
+    payloads = [
+        b"TRPC" + struct.pack(">II", 0xFFFFFFFF, 0),   # absurd body size
+        b"TRPC" + struct.pack(">II", 8, 16),           # meta > body
+        b"TRPC" + struct.pack(">II", 64, 32) + b"\xff" * 64,  # bad meta
+        b"TSTR" + struct.pack(">I", 3),                # stream body < 9
+        b"TSTR" + struct.pack(">I", 0xFFFFFFFF),       # stream too big
+        b"TST",                                        # partial magic
+    ]
+    for _ in range(30):
+        hdr = b"TRPC" + struct.pack(
+            ">II", rng.randrange(0, 1 << 16), rng.randrange(0, 1 << 10))
+        payloads.append(hdr + bytes(rng.randrange(256) for _ in
+                                    range(rng.randrange(0, 200))))
+    _volley(port, payloads)
+    assert _alive(port)

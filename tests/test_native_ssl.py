@@ -161,3 +161,44 @@ def test_grpc_over_native_tls(tls_server, certs):
             response_deserializer=echo_pb2.EchoResponse.FromString)
         resp = stub(echo_pb2.EchoRequest(message="grpc+tls"), timeout=10)
         assert resp.message == "grpc+tls"
+
+
+def test_tls_record_garbage_keeps_server_alive(tls_server):
+    """Hostile TLS records against the native SSL session: the parser is
+    C++, so surviving garbage IS the test — afterwards both a plaintext
+    and a clean TLS request must still answer."""
+    import random
+
+    port = tls_server.listen_endpoint.port
+    rng = random.Random(11)
+    payloads = [
+        b"\x16\x03\x01" + b"\xff" * 100,  # bogus ClientHello
+        b"\x16\x03",                      # truncated record header
+        b"\x16\x03\x01\xff\xff" + b"A" * 200,  # huge declared record
+    ]
+    for _ in range(25):
+        payloads.append(b"\x16\x03" + bytes(
+            rng.randrange(256) for _ in range(rng.randrange(1, 300))))
+    for p in payloads:
+        try:
+            sk = socket.create_connection(("127.0.0.1", port), timeout=5)
+            sk.settimeout(0.25)
+            sk.sendall(p)
+            try:
+                sk.recv(4096)
+            except OSError:
+                pass
+            sk.close()
+        except OSError:
+            pass
+    # plaintext lane still answers...
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    c.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+    assert b"200" in c.recv(65536)
+    c.close()
+    # ...and so does a REAL TLS handshake
+    tls = _tls_connect(port)
+    tls.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+    assert b"200" in tls.recv(65536)
+    tls.close()
