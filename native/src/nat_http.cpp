@@ -86,38 +86,43 @@ static void http_emit_response(NatSocket* s, uint64_t seq, std::string data,
   if (h == nullptr) return;
   std::string out;
   bool want_close = false;
+  bool wrote = false;
   {
     std::lock_guard<std::mutex> g(h->mu);
     auto& slot = h->parked[seq];
     slot.data = std::move(data);
     slot.close = close;
     http_emit_locked(s, h, &out, &want_close);
-  }
-  if (!out.empty()) {
-    if (want_close) s->close_after_drain.store(true,
-                                               std::memory_order_release);
-    if (batch_out != nullptr) {
-      batch_out->append(out.data(), out.size());
-      // batch_out rides the reading thread's per-round accumulator and
-      // lands in write_q after this returns; the close flag is armed
-      // above so the drain-side check fires once those bytes flush
-    } else {
-      IOBuf buf;
-      buf.append(out.data(), out.size());
-      s->write(std::move(buf));
+    if (!out.empty()) {
       if (want_close) {
-        // the write may have drained synchronously before the flag was
-        // visible to it — re-check now
-        bool empty;
-        {
-          std::lock_guard<std::mutex> g(s->write_mu);
-          empty = s->write_q.empty() && !s->ring_sending && !s->writing;
-        }
-        if (empty) s->set_failed();
+        s->close_after_drain.store(true, std::memory_order_release);
       }
+      if (batch_out != nullptr) {
+        // single-producer: batch_out is the reading thread's per-round
+        // accumulator; only reading-thread emissions use it
+        batch_out->append(out.data(), out.size());
+      } else {
+        // the socket write happens UNDER h->mu: two py responders that
+        // drain consecutive seqs must hit the write queue in that order
+        // (emitting outside the lock let the later seq overtake)
+        IOBuf buf;
+        buf.append(out.data(), out.size());
+        s->write(std::move(buf));
+        wrote = true;
+      }
+    } else if (want_close) {
+      s->close_after_drain.store(true, std::memory_order_release);
     }
-  } else if (want_close) {
-    s->close_after_drain.store(true, std::memory_order_release);
+  }
+  if (wrote && want_close) {
+    // the write may have drained synchronously before the flag was
+    // visible to it — re-check now
+    bool empty;
+    {
+      std::lock_guard<std::mutex> g(s->write_mu);
+      empty = s->write_q.empty() && !s->ring_sending && !s->writing;
+    }
+    if (empty) s->set_failed();
   }
 }
 

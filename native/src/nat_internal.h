@@ -662,6 +662,7 @@ void hp_enc_header(std::string* out, std::string_view name,
 bool ssl_accept_begin(NatSocket* s);
 bool ssl_feed(NatSocket* s, const char* data, size_t n);
 bool ssl_encrypt(NatSocket* s, IOBuf&& plain, IOBuf* cipher_out);
+int ssl_encrypt_and_write(NatSocket* s, IOBuf&& plain);
 void ssl_session_free(SslSessionN* s);
 
 extern "C" {

@@ -449,16 +449,11 @@ bool drain_socket_inline(NatSocket* s) {
     break;
   }
   bool queued = false;
-  if (!acc.empty() && !dead) {
-    if (s->ssl_sess != nullptr) {
-      IOBuf cipher;  // the deferred accumulator bypasses write(): the
-                     // record layer must still wrap it
-      if (ssl_encrypt(s, std::move(acc), &cipher)) {
-        acc = std::move(cipher);
-      } else {
-        dead = true;
-      }
-    }
+  if (!acc.empty() && !dead && s->ssl_sess != nullptr) {
+    // TLS: encrypt + queue atomically (ssl_encrypt_and_write) — a py
+    // responder encrypting concurrently must not interleave records
+    if (ssl_encrypt_and_write(s, std::move(acc)) != 0) dead = true;
+    acc.clear();
   }
   if (!acc.empty() && !dead) {
     std::lock_guard<std::mutex> g(s->write_mu);

@@ -324,12 +324,9 @@ static void ring_retry_later(uint64_t sock_id) {
 
 int NatSocket::write(IOBuf&& frame) {
   if (ssl_sess != nullptr) {
-    IOBuf cipher;
-    if (!ssl_encrypt(this, std::move(frame), &cipher)) {
-      set_failed();
-      return -1;
-    }
-    return write_raw(std::move(cipher));
+    int rc = ssl_encrypt_and_write(this, std::move(frame));
+    if (rc < 0) set_failed();
+    return rc;
   }
   return write_raw(std::move(frame));
 }

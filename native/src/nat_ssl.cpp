@@ -211,8 +211,11 @@ bool ssl_feed(NatSocket* s, const char* data, size_t n) {
         return false;
       }
     }
+    // queue while still holding sess->mu: record order on the wire must
+    // match production order even against concurrent encrypt_and_write
+    // callers (lock order sess->mu -> write_mu, never inverted)
+    if (!out.empty()) s->write_raw(std::move(out));
   }
-  if (!out.empty()) s->write_raw(std::move(out));
   return true;
 }
 
@@ -222,6 +225,21 @@ bool ssl_encrypt(NatSocket* s, IOBuf&& plain, IOBuf* cipher_out) {
   std::lock_guard<std::mutex> g(sess->mu);
   if (sess->failed) return false;
   return ssl_encrypt_locked(s, sess, std::move(plain), cipher_out);
+}
+
+// Encrypt AND queue under ONE session lock: record order on the wire
+// must match encryption order, and two concurrent writers that encrypt
+// A-then-B but queue B-then-A would corrupt the record stream (the peer
+// MACs records sequentially). Lock order sess->mu -> write_mu; nothing
+// takes them inversely.
+int ssl_encrypt_and_write(NatSocket* s, IOBuf&& plain) {
+  SslSessionN* sess = s->ssl_sess;
+  std::lock_guard<std::mutex> g(sess->mu);
+  if (sess->failed) return -1;
+  IOBuf cipher;
+  if (!ssl_encrypt_locked(s, sess, std::move(plain), &cipher)) return -1;
+  if (cipher.empty()) return 0;  // parked pre-handshake
+  return s->write_raw(std::move(cipher));
 }
 
 // Sniffed a TLS record on a TLS-enabled server port: build the session.
