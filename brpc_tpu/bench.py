@@ -432,12 +432,17 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
                        f"{ring_async_shape}, done-callbacks",
                    "async_windowed":
                        f"{async_shape}, window=256/conn, done-callbacks"}
+    import os
+
     return {
         "metric": "echo_qps_framework_native",
         "value": round(qps, 1),
         "unit": "qps",
         "vs_baseline": round(qps / BASELINE_QPS, 4),
         "extra": {
+            # client + server + py lanes share these cores; on 1 core the
+            # absolute numbers carry the whole pipeline on one CPU
+            "host_cpus": os.cpu_count(),
             "connections": nconn,
             "payload_bytes": payload,
             "requests": requests,
