@@ -408,9 +408,12 @@ class Socket:
             self._inflight_ids.discard(cid)
 
     def _start_health_check(self):
-        if self._hc_running or self.remote_side is None:
+        if self.remote_side is None:
             return
-        self._hc_running = True
+        with self._write_lock:
+            if self._hc_running:
+                return
+            self._hc_running = True
         timer_add(self.health_check_interval_s, self._health_check_once)
 
     def _health_check_once(self):
@@ -421,20 +424,41 @@ class Socket:
             healthy = False
         if healthy:
             rc = self.revive()
-            self._hc_running = False
             if rc == 0:
-                user.on_revived(self)
-            return
+                with self._write_lock:
+                    self._hc_running = False
+                    failed_again = self._failed
+                if failed_again:
+                    # a set_failed that ran inside the revive window saw
+                    # _hc_running still True and skipped scheduling — its
+                    # failure is ours to cover, or the socket stays dead
+                    # with no checker (seen as a rare no-revival hang in
+                    # the churn test)
+                    self._start_health_check()
+                else:
+                    user.on_revived(self)
+                return
+            # probe said healthy but the reconnect failed (transient):
+            # keep the checker alive instead of abandoning the socket
         timer_add(self.health_check_interval_s, self._health_check_once)
 
     def revive(self) -> int:
-        """Reconnect and clear the failed state (Socket::Revive role)."""
-        self._reset_keep_identity()
-        rc = self.connect()
-        if rc != 0:
-            self._failed = True
-            return rc
-        return 0
+        """Reconnect and clear the failed state (Socket::Revive role).
+
+        Holds the connect lock across reset+dial: _reset_keep_identity
+        clears _failed, and from that instant an ensure_connected caller
+        would otherwise dial CONCURRENTLY — two fds, with _fd ending on
+        one while the dispatcher delivers responses for the other (seen
+        as a revived-but-deaf socket in the churn test)."""
+        with self._connect_lock:
+            if self._conn_ready and not self._failed:
+                return 0  # a racing dial already revived it
+            self._reset_keep_identity()
+            rc = self.connect()
+            if rc != 0:
+                self._failed = True
+                return rc
+            return 0
 
     def _reset_keep_identity(self):
         self._failed = False
