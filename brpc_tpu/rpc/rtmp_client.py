@@ -24,8 +24,9 @@ import os
 import struct
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
+from brpc_tpu.rpc import amf
 from brpc_tpu.rpc import rtmp_protocol as rp
 from brpc_tpu.rpc.rtmp_protocol import (
     HANDSHAKE_SIZE,
@@ -182,6 +183,9 @@ class RtmpClient:
         self._reader: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        # commands the reader thread pulled out of the session inbox,
+        # decoded once, bounded (status waiters only care about recency)
+        self._cmd_log: List[list] = []
 
     def _txn(self) -> float:
         self._txn_id += 1.0
@@ -252,40 +256,43 @@ class RtmpClient:
                                 struct.pack(">I", OUT_CHUNK))
         return self
 
-    def create_stream(self, timeout: float = 5.0) -> RtmpClientStream:
-        txn = self._txn()
-        self.sess.send_command("createStream", txn, None)
-
-        def got_result(s):
-            return any(c and c[0] == "_result" and len(c) > 1
-                       and c[1] == txn for c in s.commands())
-
-        if not self.sess.pump_until(got_result, timeout=timeout):
-            raise ConnectionError("rtmp: createStream timed out")
-        sid = 1
-        for c in self.sess.commands():
-            if c and c[0] == "_result" and len(c) > 3 and c[1] == txn \
-                    and isinstance(c[3], (int, float)):
-                sid = int(c[3])
-        self.sess.inbox.clear()
-        return RtmpClientStream(self, sid)
-
-    def _wait_status(self, code: str, timeout: float) -> bool:
-        # statuses may arrive on the reader thread (inbox) or be pumped
-        # here before the reader starts
+    def _wait_command(self, pred, timeout: float):
+        """Wait for a command matching pred. Commands may arrive via the
+        reader thread (drained once into _cmd_log) or be pumped here when
+        no reader is running — never both recv'ing concurrently."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                for c in self.sess.commands():
-                    if c and c[0] == "onStatus" and len(c) > 3 and \
-                            isinstance(c[3], dict) and \
-                            c[3].get("code") == code:
-                        return True
+                cmds = self.sess.commands() + self._cmd_log
+            for c in cmds:
+                if c and pred(c):
+                    return c
             if self._reader is None:
                 self.sess.pump(want=len(self.sess.inbox) + 1, timeout=0.3)
             else:
                 time.sleep(0.02)
-        return False
+        return None
+
+    def create_stream(self, timeout: float = 5.0) -> RtmpClientStream:
+        txn = self._txn()
+        self.sess.send_command("createStream", txn, None)
+        c = self._wait_command(
+            lambda c: c[0] == "_result" and len(c) > 1 and c[1] == txn,
+            timeout)
+        if c is None:
+            raise ConnectionError("rtmp: createStream timed out")
+        sid = int(c[3]) if len(c) > 3 and isinstance(c[3], (int, float)) \
+            else 1
+        with self._lock:
+            if self._reader is None:
+                self.sess.inbox.clear()
+        return RtmpClientStream(self, sid)
+
+    def _wait_status(self, code: str, timeout: float) -> bool:
+        return self._wait_command(
+            lambda c: c[0] == "onStatus" and len(c) > 3 and
+            isinstance(c[3], dict) and c[3].get("code") == code,
+            timeout) is not None
 
     # -- reader thread (player mode) ----------------------------------------
     def start_reader(self):
@@ -331,8 +338,16 @@ class RtmpClient:
                     except Exception:
                         pass
             elif msg_type == MSG_COMMAND_AMF0:
+                # consumed once into the bounded command log (re-appending
+                # to inbox would re-scan them forever and leak); decode
+                # here so waiters polling the log never re-decode
+                try:
+                    decoded = amf.decode_all(payload)
+                except amf.AmfError:
+                    continue
                 with self._lock:
-                    self.sess.inbox.append((msg_type, ts, payload))
+                    self._cmd_log.append(decoded)
+                    del self._cmd_log[:-64]
 
     def close(self):
         self._stop.set()

@@ -345,6 +345,10 @@ void hp_enc_header(std::string* out, std::string_view name,
 
 static const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
 static const size_t kPrefaceLen = 24;
+// Per-connection resource bounds (the reference enforces
+// MAX_CONCURRENT_STREAMS / header-size limits in its H2Context)
+static const size_t kMaxConcurrentStreams = 1024;
+static const size_t kMaxHeaderBlock = 1u << 20;
 
 enum H2FrameType : uint8_t {
   kFData = 0,
@@ -383,6 +387,7 @@ struct H2StreamN {
   std::string data;       // raw gRPC-framed body
   bool headers_done = false;
   bool end_stream = false;
+  bool dispatched = false;  // usercode ran; later frames on the sid drop
   int64_t send_window = 65535;  // for OUR DATA on this stream
 };
 
@@ -484,25 +489,30 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
     if (!data.empty()) {
       // window exhausted: park the remainder + trailers; the
       // WINDOW_UPDATE path finishes the stream
-      int64_t parked_window = st->send_window;
       h->pending.push_back({sid, std::move(data), std::move(trailers)});
       if (it != h->streams.end()) {
         // keep the stream entry alive for its send window
         it->second.data.clear();
         it->second.flat_headers.clear();
-        (void)parked_window;
       }
     } else {
       out.append(trailers);
       if (it != h->streams.end()) h->streams.erase(it);
     }
+    if (batch_out == nullptr) {
+      // Write while still holding h->mu: a WINDOW_UPDATE handled
+      // concurrently by the reading thread flushes the parked remainder
+      // under this same lock, so releasing before the write could put
+      // DATA/trailers on the wire ahead of these HEADERS (the overtake
+      // class 8ddf64e fixed for HTTP). Lock order sess mu -> write_mu
+      // is the established order.
+      IOBuf buf;
+      buf.append(out.data(), out.size());
+      s->write(std::move(buf));
+    }
   }
   if (batch_out != nullptr) {
     batch_out->append(out.data(), out.size());
-  } else {
-    IOBuf buf;
-    buf.append(out.data(), out.size());
-    s->write(std::move(buf));
   }
 }
 
@@ -533,7 +543,9 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
     std::lock_guard<std::mutex> g(h->mu);
     auto it = h->streams.find(sid);
     if (it == h->streams.end()) return;
-    path = it->second.path;
+    if (it->second.dispatched) return;  // e.g. a second END_STREAM DATA
+    it->second.dispatched = true;
+    path = std::move(it->second.path);
     flat = std::move(it->second.flat_headers);
     data = std::move(it->second.data);
     // entry stays (send windows) until the response goes out
@@ -599,9 +611,18 @@ static bool h2_headers_complete(NatSocket* s, H2SessionN* h, uint32_t sid,
   if (!h->dec.decode(block, len, &flat, &path)) return false;
   {
     std::lock_guard<std::mutex> g(h->mu);
+    if (h->streams.size() >= kMaxConcurrentStreams &&
+        h->streams.find(sid) == h->streams.end()) {
+      return false;  // connection error: stream table full
+    }
     H2StreamN& st = h->streams[sid];
     if (st.headers_done) {
-      // trailers on a request stream: append to the flat block
+      // trailers on a request stream: append to the flat block, under
+      // the same total header-bytes bound as any block (a trailer flood
+      // on one stream must not grow memory unboundedly)
+      if (st.flat_headers.size() + flat.size() > kMaxHeaderBlock) {
+        return false;
+      }
       st.flat_headers.append(flat);
     } else {
       st.flat_headers = std::move(flat);
@@ -715,6 +736,8 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
       case kFPushPromise:
         return 0;  // clients must not push
       case kFHeaders: {
+        // request streams are client-initiated: odd, nonzero sid
+        if (sid == 0 || (sid & 1) == 0) return 0;
         size_t off = 0;
         size_t end = flen;
         if (flags & kFlagPadded) {
@@ -729,6 +752,7 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
           off += 5;
         }
         bool end_stream = (flags & kFlagEndStream) != 0;
+        if (end - off > kMaxHeaderBlock) return 0;  // both branches
         if (flags & kFlagEndHeaders) {
           if (!h2_headers_complete(s, h, sid, p + off, end - off,
                                    end_stream, batch_out)) {
@@ -744,6 +768,9 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
       }
       case kFContinuation: {
         if (!h->cont_active || sid != h->cont_sid) return 0;
+        if (h->cont_block.size() + payload.size() > kMaxHeaderBlock) {
+          return 0;  // unbounded CONTINUATION accumulation
+        }
         h->cont_block.append(payload);
         if (flags & kFlagEndHeaders) {
           h->cont_active = false;
@@ -767,23 +794,40 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
           end -= pad;
         }
         bool end_stream = (flags & kFlagEndStream) != 0;
+        // sid 0 / even sids are never legal for client DATA
+        if (sid == 0 || (sid & 1) == 0) return 0;
+        bool drop = false;
         {
           std::lock_guard<std::mutex> g(h->mu);
-          H2StreamN& st = h->streams[sid];
-          st.data.append((const char*)(p + off), end - off);
-          if (st.data.size() > (512u << 20)) return 0;
-          st.end_stream = end_stream;
+          // DATA must land on a stream HEADERS opened — never auto-create
+          // a table entry (remote memory growth). An unknown sid is NOT a
+          // connection error though: in-flight DATA racing our processing
+          // of the client's own RST_STREAM is legal (RFC 9113 §5.1) and
+          // must be ignored, not kill every other stream.
+          auto dit = h->streams.find(sid);
+          if (dit == h->streams.end() || !dit->second.headers_done ||
+              dit->second.dispatched) {
+            drop = true;  // post-RST / post-END_STREAM frames: ignore
+          } else {
+            H2StreamN& st = dit->second;
+            st.data.append((const char*)(p + off), end - off);
+            if (st.data.size() > (512u << 20)) return 0;
+            st.end_stream = end_stream;
+          }
         }
+        if (drop) end_stream = false;  // dropped frames never dispatch
         // replenish recv windows so the client keeps sending (we buffer
         // whole messages, so consumption == receipt)
         if (flen > 0) {
+          // connection window replenishes even for dropped frames (they
+          // consumed it on the wire); the stream window only for live ones
           frame_header(&out, 4, kFWindowUpdate, 0, 0);
           uint32_t inc = (uint32_t)flen;
           out.push_back((char)((inc >> 24) & 0x7f));
           out.push_back((char)((inc >> 16) & 0xff));
           out.push_back((char)((inc >> 8) & 0xff));
           out.push_back((char)(inc & 0xff));
-          if (!end_stream) {
+          if (!drop && !end_stream) {
             frame_header(&out, 4, kFWindowUpdate, 0, sid);
             out.push_back((char)((inc >> 24) & 0x7f));
             out.push_back((char)((inc >> 16) & 0xff));

@@ -288,7 +288,11 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
                                              scan_len - pos);
         if (nl == nullptr) break;
         size_t chunk_hdr_end = (size_t)(nl - scan) + 1;
+        if (!isxdigit((unsigned char)scan[pos])) return 0;
         size_t sz = (size_t)strtoull(scan + pos, nullptr, 16);
+        // reject before arithmetic: sz near SIZE_MAX would wrap the
+        // buffered-length comparison below and pass a bogus append
+        if (sz > kMaxBodyBytes) return 0;
         if (sz == 0) {
           // trailer: expect final CRLF
           if (scan_len < chunk_hdr_end + 2) break;
@@ -302,6 +306,9 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         pos = chunk_hdr_end + sz + 2;
       }
       if (!done) {
+        // cap what an incomplete chunked body may buffer: without this a
+        // peer that never sends the terminal chunk grows in_buf forever
+        if (buffered > kMaxBodyBytes + 65536) return 0;
         http_maybe_send_continue(h, expect_continue, batch_out);
         break;  // need more bytes
       }

@@ -267,6 +267,13 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
         int prc = h2_try_process(s, &batch_out);
         if (prc == 1 || prc == 2) break;  // h2 session latched (or needs
                                           // more preface bytes)
+        if (s->h2 != nullptr) {
+          // latched THEN erred (bad first frame after the preface): a
+          // protocol error, not "not h2" — falling through would feed the
+          // half-consumed stream to the HTTP/raw lanes
+          ok = false;
+          break;
+        }
         prc = http_try_process(s, &batch_out);
         if (prc == 1 || prc == 2) break;  // http session latched
         // fall through: not HTTP-shaped either

@@ -89,6 +89,14 @@ def test_http_shaped_garbage(fuzz_server):
         b"ZZZ\r\njunk\r\n0\r\n\r\n",  # bad chunk size
         b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
         b"ffffffffffffffff\r\n",  # absurd chunk size
+        # absurd chunk size WITH buffered body bytes: sz near SIZE_MAX must
+        # be rejected before `hdr_end + sz + 2` wraps and "passes"
+        b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"ffffffffffffffff\r\nAAAABBBB\r\n0\r\n\r\n",
+        b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"fffffffffffffff0\r\n" + b"C" * 64,
+        b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"qq\r\nnothex\r\n",  # non-hex chunk-size line
         b"GET /\r\n\r\n",  # missing version
         b"GET  HTTP/1.1\r\n\r\n",  # missing path
         b"POST / HTTP/1.1\r\nExpect: 100-continue\r\n"
@@ -125,6 +133,14 @@ def test_h2_frame_garbage(fuzz_server):
         preface + frame(9, 0x4, 1, b"junk"),       # CONTINUATION w/o HEADERS
         preface + frame(5, 0, 2, b"push"),         # client PUSH_PROMISE
         preface + frame(1, 0x8 | 0x4, 1, b"\xf0\x01\x82"),  # padded > len
+        preface + frame(0, 0x1, 0, b"\x00" * 10),   # DATA on sid 0
+        preface + frame(0, 0, 7, b"\x00" * 10),     # DATA on unopened sid
+        preface + frame(1, 0x4, 2, b"\x82"),        # HEADERS on even sid
+        preface + frame(1, 0x4, 0, b"\x82"),        # HEADERS on sid 0
+        # duplicate END_STREAM DATA on one stream (double-dispatch probe)
+        preface + frame(1, 0x4, 1,
+                        b"\x83\x86\x44\x01/")       # POST, scheme, :path=/
+        + frame(0, 0x1, 1, b"") + frame(0, 0x1, 1, b"\x00" * 5),
     ]
     for _ in range(30):
         payloads.append(preface + bytes(
