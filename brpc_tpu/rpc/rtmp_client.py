@@ -125,11 +125,13 @@ class RtmpClientStream:
     # -- publisher half -----------------------------------------------------
     def publish(self, name: str, timeout: float = 5.0):
         c = self.client
+        since = c._cmd_marker()
         c.sess.send_command("releaseStream", c._txn(), None, name)
         c.sess.send_command("FCPublish", c._txn(), None, name)
         c.sess.send_command("publish", c._txn(), None, name, "live",
                             stream_id=self.stream_id, csid=4)
-        if not c._wait_status("NetStream.Publish.Start", timeout):
+        if not c._wait_status("NetStream.Publish.Start", timeout,
+                              since=since):
             raise ConnectionError(f"rtmp: publish {name!r} refused")
         self.name = name
         return self
@@ -156,10 +158,12 @@ class RtmpClientStream:
         """Start playing; on_media(msg_type, timestamp, payload) runs on
         the client's reader thread for every audio/video/data message."""
         c = self.client
+        since = c._cmd_marker()
         c._media_sinks[self.stream_id] = on_media
         c.sess.send_command("play", c._txn(), None, name,
                             stream_id=self.stream_id, csid=4)
-        if not c._wait_status("NetStream.Play.Start", timeout):
+        if not c._wait_status("NetStream.Play.Start", timeout,
+                              since=since):
             c._media_sinks.pop(self.stream_id, None)
             raise ConnectionError(f"rtmp: play {name!r} refused")
         self.name = name
@@ -184,8 +188,12 @@ class RtmpClient:
         self._stop = threading.Event()
         self._lock = threading.Lock()
         # commands the reader thread pulled out of the session inbox,
-        # decoded once, bounded (status waiters only care about recency)
-        self._cmd_log: List[list] = []
+        # decoded once, bounded, tagged with a monotone seq so waiters
+        # only match commands that arrived after they started caring
+        # (a stale NetStream.Play.Start from stream A must not approve
+        # a later, refused play on stream B)
+        self._cmd_log: List[tuple] = []  # (seq, decoded command)
+        self._cmd_seq = 0
 
     def _txn(self) -> float:
         self._txn_id += 1.0
@@ -256,14 +264,23 @@ class RtmpClient:
                                 struct.pack(">I", OUT_CHUNK))
         return self
 
-    def _wait_command(self, pred, timeout: float):
+    def _cmd_marker(self) -> int:
+        """Watermark for _wait_command: take BEFORE sending the command
+        whose reply is awaited (the reply may be logged between the send
+        and the wait)."""
+        with self._lock:
+            return self._cmd_seq
+
+    def _wait_command(self, pred, timeout: float, since: int = 0):
         """Wait for a command matching pred. Commands may arrive via the
         reader thread (drained once into _cmd_log) or be pumped here when
-        no reader is running — never both recv'ing concurrently."""
+        no reader is running — never both recv'ing concurrently. Only
+        log entries newer than `since` count."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                cmds = self.sess.commands() + self._cmd_log
+                cmds = self.sess.commands() + [
+                    c for q, c in self._cmd_log if q > since]
             for c in cmds:
                 if c and pred(c):
                     return c
@@ -275,10 +292,11 @@ class RtmpClient:
 
     def create_stream(self, timeout: float = 5.0) -> RtmpClientStream:
         txn = self._txn()
+        since = self._cmd_marker()
         self.sess.send_command("createStream", txn, None)
         c = self._wait_command(
             lambda c: c[0] == "_result" and len(c) > 1 and c[1] == txn,
-            timeout)
+            timeout, since=since)
         if c is None:
             raise ConnectionError("rtmp: createStream timed out")
         sid = int(c[3]) if len(c) > 3 and isinstance(c[3], (int, float)) \
@@ -288,11 +306,12 @@ class RtmpClient:
                 self.sess.inbox.clear()
         return RtmpClientStream(self, sid)
 
-    def _wait_status(self, code: str, timeout: float) -> bool:
+    def _wait_status(self, code: str, timeout: float,
+                     since: int = 0) -> bool:
         return self._wait_command(
             lambda c: c[0] == "onStatus" and len(c) > 3 and
             isinstance(c[3], dict) and c[3].get("code") == code,
-            timeout) is not None
+            timeout, since=since) is not None
 
     # -- reader thread (player mode) ----------------------------------------
     def start_reader(self):
@@ -346,7 +365,8 @@ class RtmpClient:
                 except amf.AmfError:
                     continue
                 with self._lock:
-                    self._cmd_log.append(decoded)
+                    self._cmd_seq += 1
+                    self._cmd_log.append((self._cmd_seq, decoded))
                     del self._cmd_log[:-64]
 
     def close(self):
