@@ -141,12 +141,24 @@ def http_lane_bench(seconds: float = 1.5) -> dict:
         grpc_py = native.grpc_client_bench(
             "127.0.0.1", port, nconn=2, window=32, seconds=seconds,
             path="/PyEchoService/Echo", payload=req.SerializeToString())
+        # CLIENT lanes (nat_client.cpp): same loopback server, but the
+        # load generator is the REAL framework client — NatChannel + h2
+        # session / pipelined HTTP FIFO (reference client half:
+        # policy/http2_rpc_protocol.h:133, http_rpc_protocol.cpp:663)
+        grpc_cli = native.grpc_channel_bench(
+            "127.0.0.1", port, nconn=2, window=128, seconds=seconds,
+            path="/EchoService/Echo", payload=req.SerializeToString())
+        http_cli = native.http_channel_bench(
+            "127.0.0.1", port, nconn=2, window=128, seconds=seconds,
+            path="/echo", body=b"x" * 16)
     finally:
         srv.stop()
     return {"http_qps": round(nat["qps"], 1),
             "http_py_qps": round(py["qps"], 1),
             "grpc_qps": round(grpc_nat["qps"], 1),
-            "grpc_py_qps": round(grpc_py["qps"], 1)}
+            "grpc_py_qps": round(grpc_py["qps"], 1),
+            "grpc_client_qps": round(grpc_cli["qps"], 1),
+            "http_client_qps": round(http_cli["qps"], 1)}
 
 
 def stream_lane_bench(total_mb: int = 64, chunk_mb: int = 4) -> dict:

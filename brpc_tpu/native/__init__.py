@@ -189,6 +189,33 @@ def load() -> ctypes.CDLL:
             ctypes.c_double, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)]
         lib.nat_grpc_client_bench.restype = ctypes.c_double
+        # -- native client lanes (HTTP/h2 through the framework client) --
+        lib.nat_channel_open_proto.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
+        lib.nat_channel_open_proto.restype = ctypes.c_void_p
+        lib.nat_http_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t)]
+        lib.nat_http_call.restype = ctypes.c_int
+        lib.nat_grpc_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_char_p)]
+        lib.nat_grpc_call.restype = ctypes.c_int
+        lib.nat_grpc_channel_bench.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_grpc_channel_bench.restype = ctypes.c_double
+        lib.nat_http_channel_bench.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_http_channel_bench.restype = ctypes.c_double
         _lib = lib
         return lib
 
@@ -460,6 +487,113 @@ def channel_open(ip: str, port: int, batch_writes: bool = False,
 
 def channel_close(handle):
     load().nat_channel_close(handle)
+
+
+def channel_open_http(ip: str, port: int, authority: str = "",
+                      connect_timeout_ms: int = 0,
+                      health_check_ms: int = 0):
+    """Open a native HTTP/1.1 client channel (the client half of the
+    native HTTP lane: request framing, pipelined response correlation,
+    chunked decode — all in C++)."""
+    h = load().nat_channel_open_proto(
+        ip.encode(), port, 0, 0, connect_timeout_ms, health_check_ms, 1,
+        authority.encode() or None)
+    if not h:
+        raise RuntimeError("native http channel connect failed")
+    return h
+
+
+def channel_open_grpc(ip: str, port: int, authority: str = "",
+                      connect_timeout_ms: int = 0,
+                      health_check_ms: int = 0):
+    """Open a native h2/gRPC client channel (preface + SETTINGS + HPACK
+    + flow-controlled unary streams in C++)."""
+    h = load().nat_channel_open_proto(
+        ip.encode(), port, 0, 0, connect_timeout_ms, health_check_ms, 2,
+        authority.encode() or None)
+    if not h:
+        raise RuntimeError("native grpc channel connect failed")
+    return h
+
+
+def http_call(handle, verb: str, path: str, body: bytes = b"",
+              headers: str = "", timeout_ms: int = 0):
+    """Synchronous HTTP call through the native client lane. Returns
+    (status, body_bytes); raises on transport errors. `headers` is raw
+    "Name: value\\r\\n" lines appended to the request head."""
+    lib = load()
+    status = ctypes.c_int(0)
+    resp = ctypes.c_char_p()
+    rlen = ctypes.c_size_t(0)
+    rc = lib.nat_http_call(handle, verb.encode(), path.encode(),
+                           headers.encode() or None, body, len(body),
+                           timeout_ms, ctypes.byref(status),
+                           ctypes.byref(resp), ctypes.byref(rlen))
+    if rc != 0:
+        raise ConnectionError(f"native http call failed: rc={rc}")
+    # pointer truthiness only: .value would strlen an un-terminated
+    # malloc'd buffer (out-of-bounds read)
+    out = b""
+    if resp:
+        out = ctypes.string_at(resp, rlen.value)
+        lib.nat_buf_free(resp)
+    return status.value, out
+
+
+def grpc_call(handle, path: str, payload: bytes = b"",
+              timeout_ms: int = 0):
+    """Synchronous gRPC unary call through the native h2 client lane.
+    Returns (grpc_status, response_bytes, message); raises on transport
+    errors."""
+    lib = load()
+    st = ctypes.c_int(-1)
+    resp = ctypes.c_char_p()
+    rlen = ctypes.c_size_t(0)
+    err = ctypes.c_char_p()
+    rc = lib.nat_grpc_call(handle, path.encode(), payload, len(payload),
+                           timeout_ms, ctypes.byref(st), ctypes.byref(resp),
+                           ctypes.byref(rlen), ctypes.byref(err))
+    # err IS NUL-terminated (malloc'd c_str copy); resp is NOT — only
+    # pointer truthiness + string_at(len) may touch it
+    message = ""
+    if err:
+        message = ctypes.string_at(err).decode(errors="replace")
+        lib.nat_buf_free(err)
+    if rc != 0:
+        raise ConnectionError(
+            f"native grpc call failed: {message or f'rc={rc}'}")
+    out = b""
+    if resp:
+        out = ctypes.string_at(resp, rlen.value)
+        lib.nat_buf_free(resp)
+    return st.value, out, message
+
+
+def grpc_channel_bench(ip: str, port: int, nconn: int = 4,
+                       window: int = 64, seconds: float = 2.0,
+                       path: str = "/EchoService/Echo",
+                       payload: bytes = b"x" * 16) -> dict:
+    """gRPC through the REAL native client lane (NatChannel + h2 session),
+    `window` async unary calls in flight per connection."""
+    out_requests = ctypes.c_uint64(0)
+    qps = load().nat_grpc_channel_bench(ip.encode(), port, nconn, window,
+                                        seconds, path.encode(), payload,
+                                        len(payload),
+                                        ctypes.byref(out_requests))
+    return {"qps": qps, "requests": out_requests.value}
+
+
+def http_channel_bench(ip: str, port: int, nconn: int = 4,
+                       window: int = 64, seconds: float = 2.0,
+                       path: str = "/echo", body: bytes = b"x" * 16) -> dict:
+    """HTTP through the REAL native client lane (NatChannel + pipelined
+    FIFO correlation), `window` async calls in flight per connection."""
+    out_requests = ctypes.c_uint64(0)
+    qps = load().nat_http_channel_bench(ip.encode(), port, nconn, window,
+                                        seconds, path.encode(), body,
+                                        len(body),
+                                        ctypes.byref(out_requests))
+    return {"qps": qps, "requests": out_requests.value}
 
 
 ACALL_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int32,

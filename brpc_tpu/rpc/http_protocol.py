@@ -82,7 +82,14 @@ def _respond(sock, response: HttpResponse, close: bool = False):
     response.headers.set("server", "brpc_tpu")
     if close:
         response.headers.set("connection", "close")
-    sock.write(response.serialize())
+    out = response.serialize()
+    if getattr(response, "_head_only", False):
+        # HEAD: status + headers (incl. the body's Content-Length) but
+        # never the body bytes (RFC 9110 §9.3.2)
+        body_len = len(response.body)
+        if body_len:
+            out = IOBuf(out.copy_to_bytes(len(out) - body_len))
+    sock.write(out)
     if close:
         sock.set_failed(errors.ECLOSE, "http connection: close")
 
@@ -94,6 +101,7 @@ def process_request(msg: HttpInputMessage):
     sock = msg.socket
     close = (req.headers.get("connection", "").lower() == "close")
     resp = HttpResponse()
+    resp._head_only = req.method == "HEAD"
     if server is None:
         resp.status_code = 500
         resp.set_body("no server bound")

@@ -576,4 +576,162 @@ double nat_grpc_client_bench(const char* ip, int port, int nconn,
 
 }  // extern "C"
 
+// Framework-client lane benches: drive the REAL native client lanes
+// (nat_client.cpp — NatChannel + HTTP/h2 sessions + pending-call table)
+// with `window` async calls in flight per connection. Unlike the raw
+// *_client_bench load generators above, these measure OUR client stack:
+// the number is the client lane's throughput against a loopback server.
+struct CliLaneConn {
+  void* ch = nullptr;
+  std::atomic<bool>* stop = nullptr;
+  std::atomic<uint64_t>* total = nullptr;
+  Butex* done_count = nullptr;
+  std::atomic<int> inflight{0};
+  Butex room;
+  int window = 64;
+  int proto = 2;  // 1 http, 2 grpc
+  const std::string* path = nullptr;
+  const std::string* payload = nullptr;
+  std::atomic<int> refs{1};
+
+  void add_ref() { refs.fetch_add(1, std::memory_order_relaxed); }
+  void release() {
+    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+  }
+};
+
+static void cli_lane_cb(void* arg, int32_t ec, int32_t aux,
+                        const char* resp, size_t n) {
+  (void)resp;
+  (void)n;
+  CliLaneConn* cc = (CliLaneConn*)arg;
+  bool ok = cc->proto == 2 ? (ec == 0 && aux == 0)
+                           : (ec == 0 && aux / 100 == 2);
+  if (ok) cc->total->fetch_add(1, std::memory_order_relaxed);
+  cc->inflight.fetch_sub(1, std::memory_order_acq_rel);
+  cc->room.value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(&cc->room, 1);
+  cc->release();
+}
+
+static void cli_lane_fiber(void* a) {
+  CliLaneConn* cc = (CliLaneConn*)a;
+  while (!cc->stop->load(std::memory_order_acquire)) {
+    int in_flight = cc->inflight.load(std::memory_order_acquire);
+    if (in_flight >= cc->window) {
+      int32_t expected = cc->room.value.load(std::memory_order_acquire);
+      if (cc->inflight.load(std::memory_order_acquire) >= cc->window) {
+        Scheduler::butex_wait(&cc->room, expected);
+      }
+      continue;
+    }
+    int room = cc->window - in_flight;
+    bool dead = false;
+    for (int i = 0; i < room; i++) {
+      cc->inflight.fetch_add(1, std::memory_order_acq_rel);
+      cc->add_ref();
+      int rc =
+          cc->proto == 2
+              ? nat_grpc_acall(cc->ch, cc->path->c_str(),
+                               cc->payload->data(), cc->payload->size(),
+                               0, cli_lane_cb, cc)
+              : nat_http_acall(cc->ch, "POST", cc->path->c_str(), nullptr,
+                               cc->payload->data(), cc->payload->size(),
+                               0, cli_lane_cb, cc);
+      if (rc != 0) {  // never queued: cb will not fire
+        cc->inflight.fetch_sub(1, std::memory_order_acq_rel);
+        cc->release();
+        dead = true;
+        break;
+      }
+    }
+    if (dead) break;
+  }
+  while (cc->inflight.load(std::memory_order_acquire) > 0) {
+    int32_t expected = cc->room.value.load(std::memory_order_acquire);
+    if (cc->inflight.load(std::memory_order_acquire) == 0) break;
+    Scheduler::butex_wait(&cc->room, expected);
+  }
+  Butex* done = cc->done_count;
+  cc->release();
+  done->value.fetch_add(1, std::memory_order_release);
+  Scheduler::butex_wake(done, INT32_MAX);
+}
+
+static double run_cli_lane_bench(const char* ip, int port, int nconn,
+                                 int window, double seconds, int proto,
+                                 const std::string& path,
+                                 const std::string& payload,
+                                 uint64_t* out_requests) {
+  ensure_runtime(0);
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> total{0};
+  Butex done_count;
+  std::vector<CliLaneConn*> conns;
+  int started = 0;
+  for (int c = 0; c < nconn; c++) {
+    // batch_writes=1: per-call writes only queue; a writer fiber drains
+    // the whole burst in one writev (the async-lane discipline)
+    void* ch = nat_channel_open_proto(ip, port, 0, 1, 5000, 0, proto,
+                                      "bench");
+    if (ch == nullptr) continue;
+    CliLaneConn* cc = new CliLaneConn();
+    cc->ch = ch;
+    cc->stop = &stop;
+    cc->total = &total;
+    cc->done_count = &done_count;
+    cc->window = window > 0 ? window : 64;
+    cc->proto = proto;
+    cc->path = &path;
+    cc->payload = &payload;
+    cc->add_ref();  // harness reference
+    conns.push_back(cc);
+    Scheduler::instance()->spawn_detached(cli_lane_fiber, cc);
+    started++;
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((int64_t)(seconds * 1000)));
+  stop.store(true);
+  for (CliLaneConn* cc : conns) {
+    cc->room.value.fetch_add(1, std::memory_order_release);
+    Scheduler::butex_wake(&cc->room, INT32_MAX);
+  }
+  while (done_count.value.load(std::memory_order_acquire) < started) {
+    int32_t expected = done_count.value.load(std::memory_order_acquire);
+    if (expected >= started) break;
+    Scheduler::butex_wait(&done_count, expected);
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(t1 - t0).count();
+  for (CliLaneConn* cc : conns) {
+    nat_channel_close(cc->ch);
+    cc->release();
+  }
+  if (out_requests != nullptr) *out_requests = total.load();
+  return dt > 0 ? (double)total.load() / dt : 0.0;
+}
+
+extern "C" {
+
+double nat_grpc_channel_bench(const char* ip, int port, int nconn,
+                              int window, double seconds, const char* path,
+                              const char* payload, size_t payload_len,
+                              uint64_t* out_requests) {
+  std::string p(path), body(payload, payload_len);
+  return run_cli_lane_bench(ip, port, nconn, window, seconds, 2, p, body,
+                            out_requests);
+}
+
+double nat_http_channel_bench(const char* ip, int port, int nconn,
+                              int window, double seconds, const char* path,
+                              const char* body, size_t body_len,
+                              uint64_t* out_requests) {
+  std::string p(path), b(body, body_len);
+  return run_cli_lane_bench(ip, port, nconn, window, seconds, 1, p, b,
+                            out_requests);
+}
+
+}  // extern "C"
+
 }  // namespace brpc_tpu

@@ -92,6 +92,7 @@ NatSocket* channel_socket(NatChannel* ch, int max_dial_ms) {
   ch->add_ref();  // the socket's channel reference
   ns->defer_writes = ch->defer_writes_flag;
   ch->sock_id.store(ns->id, std::memory_order_release);
+  if (ch->protocol != 0) channel_attach_client_session(ch, ns);
   ns->add_ref();  // the caller's borrowed reference, taken BEFORE epoll
                   // can fail the socket
   ns->disp->add_consumer(ns);  // client sockets stay on epoll (measured
@@ -160,17 +161,21 @@ static void call_timeout_fire(void* raw) {
   Scheduler::instance()->spawn_detached(call_timeout_work, raw);
 }
 
-static void arm_call_timeout(NatChannel* ch, int64_t cid, int timeout_ms) {
+void arm_call_timeout(NatChannel* ch, int64_t cid, int timeout_ms) {
   ch->add_ref();
   TimerThread::instance()->schedule(call_timeout_fire,
                                     new CallTimeout{ch, cid}, timeout_ms);
 }
 
-extern "C" {
-
-void* nat_channel_open(const char* ip, int port, int nworkers,
-                       int batch_writes, int connect_timeout_ms,
-                       int health_check_ms) {
+// Shared open path: the client session (and ch->protocol) must be fully
+// attached BEFORE the socket joins epoll — a spec-compliant h2 server
+// sends SETTINGS immediately on accept, and the dispatcher must never
+// observe a protocol!=0 channel with a null session (or route
+// server-first bytes into the tpu_std parser).
+static void* channel_open_impl(const char* ip, int port, int nworkers,
+                               int batch_writes, int connect_timeout_ms,
+                               int health_check_ms, int protocol,
+                               const char* authority) {
   if (ensure_runtime(nworkers) != 0) return nullptr;
   int fd = dial_nonblocking(ip, port, connect_timeout_ms);
   if (fd < 0) return nullptr;
@@ -181,6 +186,14 @@ void* nat_channel_open(const char* ip, int port, int nworkers,
   ch->connect_timeout_ms = connect_timeout_ms;
   ch->health_check_interval_ms = health_check_ms;
   ch->defer_writes_flag = (batch_writes != 0);
+  ch->protocol = protocol;
+  if (authority != nullptr && authority[0] != '\0') {
+    ch->authority = authority;
+  } else if (protocol != 0) {
+    char buf[64];
+    snprintf(buf, sizeof(buf), "%s:%d", ip, port);
+    ch->authority = buf;
+  }
   NatSocket* s = sock_create();
   if (s == nullptr) {
     ::close(fd);
@@ -193,11 +206,30 @@ void* nat_channel_open(const char* ip, int port, int nworkers,
   ch->add_ref();  // the socket's reference, dropped in NatSocket::release
   s->defer_writes = (batch_writes != 0);
   ch->sock_id.store(s->id, std::memory_order_release);
+  if (protocol != 0) channel_attach_client_session(ch, s);
   // NOT ring-adopted: measured slower for clients — the one-in-flight
   // fixed-send discipline throttles request pipelining, while the epoll
   // lane's writer fiber flushes the whole queue per writev
   s->disp->add_consumer(s);
   return ch;
+}
+
+extern "C" {
+
+void* nat_channel_open(const char* ip, int port, int nworkers,
+                       int batch_writes, int connect_timeout_ms,
+                       int health_check_ms) {
+  return channel_open_impl(ip, port, nworkers, batch_writes,
+                           connect_timeout_ms, health_check_ms, 0, nullptr);
+}
+
+void* nat_channel_open_proto(const char* ip, int port, int nworkers,
+                             int batch_writes, int connect_timeout_ms,
+                             int health_check_ms, int protocol,
+                             const char* authority) {
+  return channel_open_impl(ip, port, nworkers, batch_writes,
+                           connect_timeout_ms, health_check_ms, protocol,
+                           authority);
 }
 
 void nat_channel_close(void* h) {

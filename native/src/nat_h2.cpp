@@ -848,6 +848,20 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
 
 void h2_session_free(H2SessionN* h) { delete h; }
 
+// Shared primitives for the client lane (nat_client.cpp): the frame
+// emitter and a heap HpackDecoderN behind an opaque pointer so the
+// decoder class (and its tables) stay private to this TU.
+void h2_frame_header(std::string* out, size_t len, uint8_t type,
+                     uint8_t flags, uint32_t sid) {
+  frame_header(out, len, type, flags, sid);
+}
+void* hpack_decoder_new() { return new HpackDecoderN(); }
+bool hpack_decoder_decode(void* dec, const uint8_t* d, size_t n,
+                          std::string* flat, std::string* path) {
+  return ((HpackDecoderN*)dec)->decode(d, n, flat, path);
+}
+void hpack_decoder_free(void* dec) { delete (HpackDecoderN*)dec; }
+
 extern "C" {
 
 // Python lane answer for a kind-4 request: unary gRPC response (payload

@@ -70,6 +70,8 @@ class NatChannel;
 struct HttpSessionN;
 struct H2SessionN;
 struct SslSessionN;
+struct HttpCliSessN;
+struct H2CliSessN;
 
 // ---------------------------------------------------------------------------
 // NatSocket + versioned-id registry (socket_inl.h:28-185 shape)
@@ -129,6 +131,11 @@ struct NatSocket {
   // connection like py_raw.
   HttpSessionN* http = nullptr;  // native HTTP/1.1 session
   H2SessionN* h2 = nullptr;      // native h2/gRPC session
+  // client-side protocol sessions (the reference's client half of
+  // http_rpc_protocol.cpp / http2_rpc_protocol.cpp): attached when the
+  // owning channel speaks HTTP/h2 instead of tpu_std
+  HttpCliSessN* httpc = nullptr;
+  H2CliSessN* h2c = nullptr;
 
   // Graceful close (Connection: close semantics): once set, the socket
   // fails as soon as the write queue drains — queued bytes flush first,
@@ -398,6 +405,9 @@ class NatServer {
 struct PendingCall {
   Butex done;  // 0 = in flight, 1 = complete
   int32_t error_code = 0;
+  // protocol-level status riding beside the RPC error: HTTP status code
+  // on the native HTTP client lane, grpc-status on the h2 client lane
+  int32_t aux_status = 0;
   std::string error_text;
   IOBuf response;
   IOBuf attachment;
@@ -440,6 +450,11 @@ class NatChannel {
   static const uint32_t kMaxSlabs = 1u << (kIdxBits - kSlabBits);
 
   std::atomic<uint64_t> sock_id{0};
+  // Wire protocol this channel speaks: 0 = tpu_std, 1 = HTTP/1.1,
+  // 2 = h2/gRPC (the reference's per-channel protocol option,
+  // channel.h ChannelOptions.protocol).
+  int protocol = 0;
+  std::string authority;  // Host / :authority for the HTTP/h2 lanes
   // Reconnect state (single-connection Channel semantics: the reference
   // re-establishes a failed single connection on use, and the health
   // checker revives it in the background — health_check.cpp:146-237).
@@ -483,6 +498,7 @@ class NatChannel {
         (pc->state.load(std::memory_order_relaxed) >> 1) + 1;
     pc->done.value.store(0, std::memory_order_relaxed);
     pc->error_code = 0;
+    pc->aux_status = 0;
     pc->error_text.clear();
     pc->response.clear();
     pc->attachment.clear();
@@ -621,10 +637,12 @@ class NatChannel {
   }
 };
 
-// channel internals shared across nat_channel.cpp / nat_bench.cpp
+// channel internals shared across nat_channel.cpp / nat_client.cpp /
+// nat_bench.cpp
 int dial_nonblocking(const char* ip, int port, int timeout_ms);
 NatSocket* channel_socket(NatChannel* ch, int max_dial_ms = 0);
 void health_check_fire(void* raw);
+void arm_call_timeout(NatChannel* ch, int64_t cid, int timeout_ms);
 
 // ---------------------------------------------------------------------------
 // Messenger seam (nat_messenger.cpp)
@@ -658,6 +676,27 @@ void hp_enc_str(std::string* out, std::string_view s);
 void hp_enc_header(std::string* out, std::string_view name,
                    std::string_view value);
 
+// Native client protocol lanes (nat_client.cpp): HTTP/1.1 and h2/gRPC
+// request framing + response parsing for channel-owned sockets.
+// *_process conventions mirror the server lanes: 1 = consumed what it
+// could, 0 = protocol error (socket dies).
+int http_client_process(NatSocket* s);
+int h2_client_process(NatSocket* s, IOBuf* batch_out);
+void http_cli_free(HttpCliSessN* c);
+void h2_cli_free(H2CliSessN* c);
+// Attach the channel's protocol session to a (re)dialed socket; for h2
+// this also queues the connection preface + SETTINGS.
+void channel_attach_client_session(NatChannel* ch, NatSocket* s);
+
+// h2 shared primitives (implemented in nat_h2.cpp, reused by the client
+// lane): frame header emitter and an opaque stateful HPACK decoder.
+void h2_frame_header(std::string* out, size_t len, uint8_t type,
+                     uint8_t flags, uint32_t sid);
+void* hpack_decoder_new();
+bool hpack_decoder_decode(void* dec, const uint8_t* d, size_t n,
+                          std::string* flat, std::string* path);
+void hpack_decoder_free(void* dec);
+
 // Native TLS session (nat_ssl.cpp).
 bool ssl_accept_begin(NatSocket* s);
 bool ssl_feed(NatSocket* s, const char* data, size_t n);
@@ -671,6 +710,28 @@ void* nat_channel_open(const char* ip, int port, int unused,
                        int batch_writes, int connect_timeout_ms,
                        int health_check_ms);
 void nat_channel_close(void* h);
+// client protocol lanes (nat_client.cpp)
+typedef void (*nat_acall2_cb)(void* arg, int32_t error_code,
+                              int32_t aux_status, const char* resp,
+                              size_t resp_len);
+void* nat_channel_open_proto(const char* ip, int port, int nworkers,
+                             int batch_writes, int connect_timeout_ms,
+                             int health_check_ms, int protocol,
+                             const char* authority);
+int nat_http_call(void* h, const char* verb, const char* path,
+                  const char* extra_headers, const char* body,
+                  size_t body_len, int timeout_ms, int* status_out,
+                  char** resp_out, size_t* resp_len);
+int nat_http_acall(void* h, const char* verb, const char* path,
+                   const char* extra_headers, const char* body,
+                   size_t body_len, int timeout_ms, nat_acall2_cb cb,
+                   void* arg);
+int nat_grpc_call(void* h, const char* path, const char* payload,
+                  size_t payload_len, int timeout_ms, int* grpc_status_out,
+                  char** resp_out, size_t* resp_len, char** err_text_out);
+int nat_grpc_acall(void* h, const char* path, const char* payload,
+                   size_t payload_len, int timeout_ms, nat_acall2_cb cb,
+                   void* arg);
 }
 
 }  // namespace brpc_tpu

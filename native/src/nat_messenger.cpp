@@ -192,6 +192,15 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
   }
   IOBuf batch_out;
   bool ok = true;
+  // client-side protocol lanes: a channel that speaks HTTP/h2 routes all
+  // input to its client session (nat_client.cpp), never the tpu_std cut
+  if (s->channel != nullptr && s->server == nullptr &&
+      s->channel->protocol != 0) {
+    int prc = s->channel->protocol == 2 ? h2_client_process(s, &batch_out)
+                                        : http_client_process(s);
+    if (prc == 0) ok = false;
+    goto flush;
+  }
   // native protocol sessions take over the whole connection once sniffed
   if (s->http != nullptr || s->h2 != nullptr) {
     int prc = s->h2 != nullptr ? h2_try_process(s, &batch_out)

@@ -135,6 +135,14 @@ void NatSocket::release() {
       ssl_session_free(ssl_sess);
       ssl_sess = nullptr;
     }
+    if (httpc != nullptr) {
+      http_cli_free(httpc);
+      httpc = nullptr;
+    }
+    if (h2c != nullptr) {
+      h2_cli_free(h2c);
+      h2c = nullptr;
+    }
     in_buf.clear();
     {
       std::lock_guard<std::mutex> g(write_mu);
@@ -165,6 +173,8 @@ void NatSocket::reset_for_reuse() {
   stream_seq = 0;
   http = nullptr;
   h2 = nullptr;
+  httpc = nullptr;
+  h2c = nullptr;
   ssl_sess = nullptr;
   ssl_declined = false;
   close_after_drain.store(false, std::memory_order_relaxed);
