@@ -216,6 +216,17 @@ def load() -> ctypes.CDLL:
             ctypes.c_double, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)]
         lib.nat_http_channel_bench.restype = ctypes.c_double
+        # -- native Redis lane --
+        lib.nat_rpc_server_redis.argtypes = [ctypes.c_int]
+        lib.nat_rpc_server_redis.restype = ctypes.c_int
+        lib.nat_redis_respond.argtypes = [
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_size_t]
+        lib.nat_redis_respond.restype = ctypes.c_int
+        lib.nat_redis_client_bench.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_redis_client_bench.restype = ctypes.c_double
         _lib = lib
         return lib
 
@@ -429,6 +440,29 @@ def http_respond(sock_id: int, seq: int, data: bytes,
     response; ordering across pipelined requests is enforced natively."""
     return load().nat_http_respond(sock_id, seq, data, len(data),
                                    1 if close_after else 0)
+
+
+def rpc_server_redis(mode: int = 1) -> int:
+    """Native Redis lane: 1 = RESP parsed natively, commands to the
+    Python RedisService (kind-6); 2 = + native in-memory store for the
+    GET/SET family."""
+    return load().nat_rpc_server_redis(mode)
+
+
+def redis_respond(sock_id: int, seq: int, data: bytes) -> int:
+    """Answer a kind-6 request: data is the complete RESP reply;
+    ordering across pipelined commands is enforced natively."""
+    return load().nat_redis_respond(sock_id, seq, data, len(data))
+
+
+def redis_client_bench(ip: str, port: int, nconn: int = 2,
+                       pipeline: int = 64, seconds: float = 2.0) -> dict:
+    """Raw RESP pipelined GET load against the native redis lane."""
+    out_requests = ctypes.c_uint64(0)
+    qps = load().nat_redis_client_bench(ip.encode(), port, nconn, pipeline,
+                                        seconds,
+                                        ctypes.byref(out_requests))
+    return {"qps": qps, "requests": out_requests.value}
 
 
 def grpc_client_bench(ip: str, port: int, nconn: int = 4,

@@ -222,6 +222,17 @@ class NativeRuntimeMount:
                 native.rpc_server_native_http(True)
             except AttributeError:
                 pass  # older .so without the lane
+        # native Redis lane (kind-6): RESP parsed in C++, commands run in
+        # the Python RedisService — or, with native_redis_store, the
+        # GET/SET family executes against a C++ in-memory store and only
+        # unknown commands reach Python
+        if self.server.redis_service is not None:
+            try:
+                native.rpc_server_redis(
+                    2 if getattr(self.server.options,
+                                 "native_redis_store", False) else 1)
+            except AttributeError:
+                pass
         # TLS on the native port (ServerSSLOptions role)
         if self.server.options.ssl_certfile:
             rc = native.rpc_server_ssl(self.server.options.ssl_certfile,
@@ -279,6 +290,10 @@ class NativeRuntimeMount:
         if kind == 4:  # native-parsed gRPC-over-h2 request
             native.req_free(handle)
             self._handle_grpc(f1, meta_bytes, payload, sock_id, seq)
+            return
+        if kind == 6:  # native-parsed RESP command
+            native.req_free(handle)
+            self._handle_redis(payload, sock_id, seq)
             return
         if kind == 1:  # raw protocol bytes
             native.req_free(handle)
@@ -402,6 +417,41 @@ class NativeRuntimeMount:
                     done()
         except Exception as e:
             respond(b"", GRPC_INTERNAL, f"py-lane grpc dispatch: {e}")
+
+    def _handle_redis(self, packed: bytes, sock_id: int, seq: int):
+        """kind-6 dispatch: argv was RESP-parsed natively (count +
+        (len,bytes)* packing); run the Python RedisService handler and
+        answer through the native reorder window (nat_redis_respond)."""
+        import struct as _struct
+
+        from brpc_tpu.rpc.redis import RedisReply
+
+        try:
+            (count,) = _struct.unpack_from(">I", packed, 0)
+            pos = 4
+            args = []
+            for _ in range(count):
+                (n,) = _struct.unpack_from(">I", packed, pos)
+                pos += 4
+                args.append(packed[pos:pos + n])
+                pos += n
+            service = getattr(self.server, "redis_service", None)
+            if service is None:
+                reply = RedisReply.error("ERR no redis service")
+            else:
+                reply = service.dispatch(args)
+        except Exception as e:
+            reply = RedisReply.error(f"ERR dispatch raised: {e}")
+        try:
+            encoded = reply.encode()
+        except Exception as e:
+            # e.g. a handler returned a plain str: the seq MUST still be
+            # answered or the ordered window wedges the connection
+            encoded = RedisReply.error(f"ERR bad reply object: {e}").encode()
+        try:
+            native.redis_respond(sock_id, seq, encoded)
+        except Exception:
+            pass  # socket already gone; the session dies with it
 
     def _handle_http(self, verb: bytes, uri: bytes, flat_headers: bytes,
                      body: bytes, sock_id: int, seq: int):

@@ -74,6 +74,11 @@ class ServerOptions:
     # that shadow same-named Python services, the builtin-native-service
     # discipline of server.cpp:468-563. Bench/diagnostic lanes.
     native_builtin_echo: bool = False
+    # With use_native_runtime + redis_service: execute the GET/SET
+    # command family against a NATIVE in-memory store (DictRedisService
+    # semantics in C++); unknown commands still reach the Python
+    # handlers. The store's data lives native-side only.
+    native_redis_store: bool = False
 
 
 class Server:

@@ -574,6 +574,80 @@ double nat_grpc_client_bench(const char* ip, int port, int nconn,
   return dt > 0 ? (double)total.load() / dt : 0.0;
 }
 
+// Redis bench client: raw RESP on blocking sockets, `pipeline` GET
+// commands per write, counting replies — measures the server-side
+// native RESP lane (parse + native store execute + ordered replies).
+double nat_redis_client_bench(const char* ip, int port, int nconn,
+                              int pipeline, double seconds,
+                              uint64_t* out_requests) {
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> total{0};
+  std::string one = "*3\r\n$3\r\nSET\r\n$5\r\nbench\r\n$5\r\nvalue\r\n";
+  std::string getc = "*2\r\n$3\r\nGET\r\n$5\r\nbench\r\n";
+  std::string batch;
+  int p = pipeline > 0 ? pipeline : 32;
+  for (int i = 0; i < p; i++) batch += getc;
+  std::vector<std::thread> threads;
+  for (int c = 0; c < nconn; c++) {
+    threads.emplace_back([&] {
+      int fd = dial_nonblocking(ip, port, 5000);
+      if (fd < 0) return;
+      int fl = fcntl(fd, F_GETFL, 0);
+      fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);
+      struct timeval tv = {0, 200000};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      // seed the key, swallow +OK
+      if (::send(fd, one.data(), one.size(), 0) < 0) {
+        ::close(fd);
+        return;
+      }
+      char tmp[65536];
+      ::recv(fd, tmp, sizeof(tmp), 0);
+      std::string rbuf;
+      while (!stop.load(std::memory_order_relaxed)) {
+        size_t off = 0;
+        while (off < batch.size()) {
+          ssize_t w = ::send(fd, batch.data() + off, batch.size() - off, 0);
+          if (w <= 0) goto out;
+          off += (size_t)w;
+        }
+        int need = p;
+        while (need > 0 && !stop.load(std::memory_order_relaxed)) {
+          // count complete bulk replies ($5\r\nvalue\r\n = 11 bytes)
+          size_t pos = 0;
+          while (pos + 11 <= rbuf.size()) {
+            pos += 11;
+            total.fetch_add(1, std::memory_order_relaxed);
+            need--;
+          }
+          if (pos > 0) rbuf.erase(0, pos);
+          if (need == 0) break;
+          ssize_t r = ::recv(fd, tmp, sizeof(tmp), 0);
+          if (r <= 0) {
+            if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK) &&
+                !stop.load(std::memory_order_relaxed)) {
+              continue;
+            }
+            goto out;
+          }
+          rbuf.append(tmp, (size_t)r);
+        }
+      }
+    out:
+      ::close(fd);
+    });
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((int64_t)(seconds * 1000)));
+  stop.store(true);
+  for (auto& t : threads) t.join();
+  auto t1 = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(t1 - t0).count();
+  if (out_requests != nullptr) *out_requests = total.load();
+  return dt > 0 ? (double)total.load() / dt : 0.0;
+}
+
 }  // extern "C"
 
 // Framework-client lane benches: drive the REAL native client lanes

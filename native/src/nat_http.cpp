@@ -38,6 +38,11 @@ struct HttpSessionN {
   // Expect: 100-continue — the interim response was already sent for the
   // request currently awaiting its body (reading thread only)
   bool continue_sent = false;
+  // The reading thread is mid-round with possibly-unflushed responses
+  // in its batch accumulator: py emissions must park instead of writing
+  // directly, or a later seq could reach the write queue before the
+  // accumulator's earlier ones (reordering on multi-core hosts).
+  bool round_active = false;
 };
 
 int http_sniff(const char* p, size_t n) {
@@ -92,6 +97,11 @@ static void http_emit_response(NatSocket* s, uint64_t seq, std::string data,
     auto& slot = h->parked[seq];
     slot.data = std::move(data);
     slot.close = close;
+    if (batch_out == nullptr && h->round_active) {
+      // the reading thread's round holds unflushed earlier responses;
+      // stay parked — http_round_end drains after its flush
+      return;
+    }
     http_emit_locked(s, h, &out, &want_close);
     if (!out.empty()) {
       if (want_close) {
@@ -174,6 +184,10 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
   }
   NatServer* srv = s->server;
   HttpSessionN* h = s->http;
+  {
+    std::lock_guard<std::mutex> g(h->mu);
+    h->round_active = true;
+  }
   while (true) {
     size_t buffered = s->in_buf.length();
     if (buffered == 0) break;
@@ -399,6 +413,25 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
 }
 
 void http_session_free(HttpSessionN* h) { delete h; }
+
+// End of a read round, called AFTER the round's batch accumulator was
+// flushed to the write queue: drain responses py responders parked
+// while the round was active, then let direct py writes through again.
+void http_round_end(NatSocket* s) {
+  HttpSessionN* h = s->http;
+  if (h == nullptr) return;
+  std::string out;
+  bool want_close = false;
+  std::lock_guard<std::mutex> g(h->mu);
+  http_emit_locked(s, h, &out, &want_close);
+  h->round_active = false;
+  if (want_close) s->close_after_drain.store(true, std::memory_order_release);
+  if (!out.empty()) {
+    IOBuf f;
+    f.append(out.data(), out.size());
+    s->write(std::move(f));  // under h->mu: ordered vs py emitters
+  }
+}
 
 extern "C" {
 

@@ -72,6 +72,8 @@ struct H2SessionN;
 struct SslSessionN;
 struct HttpCliSessN;
 struct H2CliSessN;
+struct RedisSessN;
+struct RedisStoreN;
 
 // ---------------------------------------------------------------------------
 // NatSocket + versioned-id registry (socket_inl.h:28-185 shape)
@@ -131,6 +133,7 @@ struct NatSocket {
   // connection like py_raw.
   HttpSessionN* http = nullptr;  // native HTTP/1.1 session
   H2SessionN* h2 = nullptr;      // native h2/gRPC session
+  RedisSessN* redis = nullptr;   // native RESP session
   // client-side protocol sessions (the reference's client half of
   // http_rpc_protocol.cpp / http2_rpc_protocol.cpp): attached when the
   // owning channel speaks HTTP/h2 instead of tpu_std
@@ -352,6 +355,11 @@ class NatServer {
   // Parse HTTP/1.1 and h2/gRPC natively (kind 3/4 py-lane requests)
   // instead of shovelling raw bytes; set with nat_rpc_server_native_http.
   bool native_http = false;
+  // Parse RESP natively (policy/redis_protocol.cpp role): 0 = off,
+  // 1 = py-lane dispatch (kind 6), 2 = + native in-memory store for the
+  // GET/SET command family (unknown commands still go to py handlers).
+  int native_redis = 0;
+  RedisStoreN* redis_store = nullptr;  // owned; freed in ~NatServer
   // TLS context (opaque SSL_CTX*, nat_ssl.cpp) — when set, connections
   // whose first record sniffs as a TLS handshake get a native SSL
   // session; plaintext peers keep working on the same port.
@@ -661,6 +669,7 @@ bool drain_socket_inline(NatSocket* s);
 // try_process returns: 1 = session active (consumed what it could),
 // 2 = sniff needs more bytes, 0 = not HTTP / protocol error.
 int http_try_process(NatSocket* s, IOBuf* batch_out);
+void http_round_end(NatSocket* s);
 void http_session_free(HttpSessionN* h);
 // Sniff a few leading bytes: 1 = HTTP verb, 2 = could become one (need
 // more bytes), 0 = definitely not HTTP.
@@ -675,6 +684,15 @@ void hp_enc_int(std::string* out, uint64_t v, int prefix, uint8_t first);
 void hp_enc_str(std::string* out, std::string_view s);
 void hp_enc_header(std::string* out, std::string_view name,
                    std::string_view value);
+
+// Native Redis lane (nat_redis.cpp): RESP parse + ordered replies +
+// native store / kind-6 py dispatch.
+int redis_try_process(NatSocket* s, IOBuf* batch_out);
+void redis_round_end(NatSocket* s);
+void redis_session_free(RedisSessN* h);
+void redis_store_free(RedisStoreN* st);
+RedisStoreN* redis_store_new();
+int redis_sniff(const char* p, size_t n);
 
 // Native client protocol lanes (nat_client.cpp): HTTP/1.1 and h2/gRPC
 // request framing + response parsing for channel-owned sockets.

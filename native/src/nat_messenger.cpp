@@ -202,9 +202,10 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
     goto flush;
   }
   // native protocol sessions take over the whole connection once sniffed
-  if (s->http != nullptr || s->h2 != nullptr) {
-    int prc = s->h2 != nullptr ? h2_try_process(s, &batch_out)
-                               : http_try_process(s, &batch_out);
+  if (s->http != nullptr || s->h2 != nullptr || s->redis != nullptr) {
+    int prc = s->h2 != nullptr      ? h2_try_process(s, &batch_out)
+              : s->http != nullptr ? http_try_process(s, &batch_out)
+                                   : redis_try_process(s, &batch_out);
     if (prc == 0) ok = false;
     goto flush;
   }
@@ -221,6 +222,15 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
         if (s->server->native_http &&
             (http_sniff(pfx, n) != 0 || h2_sniff(pfx, n) != 0)) {
           break;  // could be a native-lane protocol: wait for 12+ bytes
+        }
+        if (s->server->native_redis != 0 && redis_sniff(pfx, n) != 0 &&
+            s->server->py_lane_enabled) {
+          // a COMPLETE command can be under 12 bytes ("*1\r\n$1\r\nX\r\n"
+          // is 11): dispatch now — the lane handles partial input itself
+          int prc = redis_try_process(s, &batch_out);
+          if (prc == 1) break;
+          ok = false;  // latched then erred
+          break;
         }
         size_t sn = n < 4 ? n : 4;
         if (memcmp(pfx, "TSTR", sn) == 0) break;  // partial stream frame
@@ -286,6 +296,15 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
         prc = http_try_process(s, &batch_out);
         if (prc == 1 || prc == 2) break;  // http session latched
         // fall through: not HTTP-shaped either
+      }
+      if (s->server != nullptr && s->server->native_redis != 0 &&
+          s->server->py_lane_enabled) {
+        int prc = redis_try_process(s, &batch_out);
+        if (prc == 1) break;  // redis session latched
+        if (s->redis != nullptr) {
+          ok = false;  // latched then erred
+          break;
+        }
       }
       if (s->server != nullptr && s->server->raw_fallback &&
           s->server->py_lane_enabled) {
@@ -421,6 +440,13 @@ flush:
       s->write(std::move(batch_out));
     }
   }
+  // Round end for the ordered-reply lanes: ONLY once this round's bytes
+  // are queued may py responders write directly again (with defer_out
+  // the caller owns the flush and calls the round ends itself).
+  if (defer_out == nullptr) {
+    if (s->redis != nullptr) redis_round_end(s);
+    if (s->http != nullptr) http_round_end(s);
+  }
   return ok;
 }
 
@@ -477,6 +503,11 @@ bool drain_socket_inline(NatSocket* s) {
       s->write_q.append(std::move(acc));
       queued = true;
     }
+  }
+  if (!dead) {
+    // this drain's accumulator is queued: end the ordered-lane rounds
+    if (s->redis != nullptr) redis_round_end(s);
+    if (s->http != nullptr) http_round_end(s);
   }
   if (dead || s->failed.load(std::memory_order_acquire)) {
     s->set_failed();

@@ -16,6 +16,7 @@ NatServer::~NatServer() {
   // stop() drains py_q, but a raw-mode socket failing AFTER stop still
   // enqueues its kind-2 close notice; free whatever is left.
   for (PyRequest* r : py_q) delete r;
+  if (redis_store != nullptr) redis_store_free(redis_store);
 }
 
 // ---------------------------------------------------------------------------
@@ -356,6 +357,22 @@ int nat_rpc_server_native_http(int enable) {
   NatServer* srv = g_rpc_server;
   if (srv == nullptr) return -1;
   srv->native_http = (enable != 0);
+  return 0;
+}
+
+// Enable the native Redis lane (policy/redis_protocol.cpp role):
+// mode 1 = RESP parsed natively, commands dispatched to the Python
+// RedisService as kind-6 requests; mode 2 = additionally execute the
+// GET/SET command family against a native in-memory store (unknown
+// commands still reach the Python handlers). Call right after start.
+int nat_rpc_server_redis(int mode) {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  NatServer* srv = g_rpc_server;
+  if (srv == nullptr) return -1;
+  srv->native_redis = mode;
+  if (mode == 2 && srv->redis_store == nullptr) {
+    srv->redis_store = redis_store_new();
+  }
   return 0;
 }
 

@@ -135,6 +135,10 @@ void NatSocket::release() {
       ssl_session_free(ssl_sess);
       ssl_sess = nullptr;
     }
+    if (redis != nullptr) {
+      redis_session_free(redis);
+      redis = nullptr;
+    }
     if (httpc != nullptr) {
       http_cli_free(httpc);
       httpc = nullptr;
@@ -173,6 +177,7 @@ void NatSocket::reset_for_reuse() {
   stream_seq = 0;
   http = nullptr;
   h2 = nullptr;
+  redis = nullptr;
   httpc = nullptr;
   h2c = nullptr;
   ssl_sess = nullptr;
