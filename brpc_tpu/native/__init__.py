@@ -12,6 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import weakref
 from typing import Optional
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
@@ -345,9 +346,10 @@ def take_request(timeout_ms: int = 100):
                 lib.nat_req_sock_id(h), lib.nat_req_cid(h),
                 field(0), field(1), 0)
     if kind == 5:  # native-cut streaming frame: aux = dest stream id,
-        # compress slot = frame type, cid = per-socket order
+        # f0 = frame type (same contract as take_requests), cid = order
         return (h, kind, b"", field(2), b"", lib.nat_req_sock_id(h),
-                lib.nat_req_cid(h), b"", b"", lib.nat_req_aux(h))
+                lib.nat_req_cid(h), lib.nat_req_compress(h), b"",
+                lib.nat_req_aux(h))
     return (h, kind, field(4), field(2), field(3),
             lib.nat_req_sock_id(h), lib.nat_req_cid(h), b"", b"", 0)
 
@@ -373,9 +375,28 @@ def take_requests(max_items: int = 16, timeout_ms: int = 100):
                         lib.nat_req_sock_id(h), lib.nat_req_cid(h),
                         field(0), field(1), 0))
         elif kind == 5:
+            # frame type rides in the f0 slot (the zero-copy path below
+            # hands the handle to a finalizer, so it can't be queried
+            # at dispatch time)
+            ftype = lib.nat_req_compress(h)
+            ln = ctypes.c_size_t(0)
+            p = lib.nat_req_field(h, 2, ctypes.byref(ln))
+            if p and ln.value >= 65536:
+                # big stream payload: wrap the native buffer read-only
+                # with ZERO copy; the request handle is freed when the
+                # last view of the buffer is garbage-collected, so a
+                # handler retaining the message stays safe. The handle
+                # slot in the tuple is None: ownership moved here.
+                cbuf = (ctypes.c_char * ln.value).from_address(p)
+                weakref.finalize(cbuf, lib.nat_req_free, h)
+                payload = memoryview(cbuf).toreadonly()
+                out.append((None, kind, b"", payload, b"",
+                            lib.nat_req_sock_id(h), lib.nat_req_cid(h),
+                            ftype, b"", lib.nat_req_aux(h)))
+                continue
             out.append((h, kind, b"", field(2), b"",
                         lib.nat_req_sock_id(h), lib.nat_req_cid(h),
-                        b"", b"", lib.nat_req_aux(h)))
+                        ftype, b"", lib.nat_req_aux(h)))
         else:
             out.append((h, kind, field(4), field(2), field(3),
                         lib.nat_req_sock_id(h), lib.nat_req_cid(h),

@@ -274,8 +274,10 @@ class NativeRuntimeMount:
         (handle, kind, meta_bytes, payload, attachment, sock_id, seq,
          f0, f1, aux) = item
         if kind == 5:  # native-cut streaming frame
-            ftype = native.load().nat_req_compress(handle)
-            native.req_free(handle)
+            ftype = f0  # frame type rides in the tuple (zero-copy big
+            # payloads hand their handle to a GC finalizer: handle=None)
+            if handle is not None:
+                native.req_free(handle)
             with self._raw_lock:
                 sess = self._stream_sessions.get(sock_id)
                 if sess is None:

@@ -74,6 +74,7 @@ struct HttpCliSessN;
 struct H2CliSessN;
 struct RedisSessN;
 struct RedisStoreN;
+struct PyRequest;
 
 // ---------------------------------------------------------------------------
 // NatSocket + versioned-id registry (socket_inl.h:28-185 shape)
@@ -126,6 +127,13 @@ struct NatSocket {
   // py_streams mirrors py_raw's close-notice duty for stream sessions
   std::atomic<bool> py_streams{false};
   uint64_t stream_seq = 0;
+  // Large-payload fill mode (the IOBuf→HBM zero-copy north star's
+  // socket leg): a big TSTR DATA payload fills its PyRequest buffer
+  // STRAIGHT from the socket/ring-buffer — in_buf (and its copy) is
+  // bypassed for the payload bytes. Owned by the reading thread; freed
+  // on socket teardown.
+  PyRequest* fill_req = nullptr;
+  size_t fill_off = 0;
 
   // Native protocol sessions (the per-connection parse state the
   // reference keeps in Socket::_parsing_context, socket.h:793): owned by
@@ -299,6 +307,16 @@ struct PyRequest {
   std::string attachment;
   std::string meta_bytes;  // full RpcMeta wire bytes: Python re-parses for
                            // log/trace ids, auth_data, timeout, tensors…
+  // Large stream payloads (fill mode) live in a malloc'd buffer instead
+  // of `payload`: malloc'd pages are lazily mapped, so no zero-fill pass
+  // precedes the reads that populate them. nat_req_field(2) serves it.
+  // The buffer GROWS with received bytes (big_cap doubles toward
+  // big_len) so a 17-byte header claiming a huge body cannot reserve
+  // the whole allocation up front (claim-without-send exhaustion).
+  char* big_payload = nullptr;
+  size_t big_len = 0;  // final payload size (frame-declared)
+  size_t big_cap = 0;  // currently allocated
+  ~PyRequest() { ::free(big_payload); }
 };
 
 class NatServer {
@@ -655,6 +673,11 @@ void arm_call_timeout(NatChannel* ch, int64_t cid, int timeout_ms);
 // ---------------------------------------------------------------------------
 // Messenger seam (nat_messenger.cpp)
 // ---------------------------------------------------------------------------
+
+// Large stream payloads fill their request buffer directly from the
+// socket/ring (in_buf bypass); frames at least this big use it.
+inline constexpr size_t kStreamFillMin = 64u << 10;
+size_t stream_fill_feed(NatSocket* s, const char* data, size_t n);
 
 void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
                           const std::string& error_text, IOBuf&& payload,

@@ -132,6 +132,52 @@ static int try_process_http(NatSocket* s, IOBuf* batch_out) {
   return 1;
 }
 
+// Parse the 9-byte stream frame header (8B dest stream id + 1B type)
+// into a kind-5 request — shared by the buffered and fill paths.
+static PyRequest* make_stream_request(NatSocket* s, const char fh[9]) {
+  PyRequest* r = new PyRequest();
+  r->kind = 5;
+  r->sock_id = s->id;
+  r->aux = ((uint64_t)rd_be32(fh) << 32) | rd_be32(fh + 4);
+  r->compress_type = (int32_t)(uint8_t)fh[8];
+  r->cid = (int64_t)(++s->stream_seq);
+  return r;
+}
+
+// Grow the fill buffer so [0, need_off) is addressable: doubles toward
+// big_len (realloc is mremap-cheap for large buffers). False on OOM.
+static bool stream_fill_reserve(PyRequest* r, size_t need_off) {
+  if (need_off <= r->big_cap) return true;
+  size_t cap = r->big_cap > 0 ? r->big_cap : (1u << 20);
+  while (cap < need_off) cap *= 2;
+  if (cap > r->big_len) cap = r->big_len;
+  char* p = (char*)realloc(r->big_payload, cap);
+  if (p == nullptr) return false;
+  r->big_payload = p;
+  r->big_cap = cap;
+  return true;
+}
+
+// Stream fill mode: feed `n` freshly-received bytes at `data` into the
+// pending large-payload request. Returns the number of bytes consumed
+// (the rest belongs to the next frame and goes to in_buf); SIZE_MAX on
+// allocation failure. Enqueues the request when complete. Reading
+// thread only.
+size_t stream_fill_feed(NatSocket* s, const char* data, size_t n) {
+  PyRequest* r = s->fill_req;
+  size_t want = r->big_len - s->fill_off;
+  size_t take = n < want ? n : want;
+  if (!stream_fill_reserve(r, s->fill_off + take)) return SIZE_MAX;
+  memcpy(r->big_payload + s->fill_off, data, take);
+  s->fill_off += take;
+  if (s->fill_off == r->big_len) {
+    s->fill_req = nullptr;
+    s->fill_off = 0;
+    s->server->enqueue_py(r);
+  }
+  return take;
+}
+
 // Forward everything buffered on a raw-mode socket to the py lane as one
 // ordered chunk.
 static void forward_raw_chunk(NatSocket* s) {
@@ -251,22 +297,48 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
       // the Python loop never re-parses stream framing. Body = 8B dest
       // stream id + 1B frame type + payload.
       uint32_t body = rd_be32(header + 4);
-      if (body < 9 || body > (1u << 31)) {
-        ok = false;
+      if (body < 9 || body > (512u << 20)) {
+        ok = false;  // same body cap as every other native lane
         break;
       }
-      if (s->in_buf.length() < 8 + (size_t)body) break;
+      if (s->in_buf.length() < 8 + (size_t)body) {
+        // Large payload not fully buffered: switch to FILL MODE — the
+        // remaining payload bytes go straight from the socket into the
+        // request buffer, skipping in_buf and its extra copy (the
+        // streaming_echo 1-64MB zero-copy north star). TLS stays on
+        // the buffered path (payload bytes exist only post-decrypt).
+        if ((size_t)body >= kStreamFillMin && s->ssl_sess == nullptr &&
+            s->in_buf.length() >= 8 + 9) {
+          s->in_buf.pop_front(8);
+          char fh[9];
+          s->in_buf.copy_to(fh, 9);
+          s->in_buf.pop_front(9);
+          PyRequest* r = make_stream_request(s, fh);
+          // malloc'd, grown with received bytes (stream_fill_reserve) —
+          // no zero-fill pass, and a header claiming a huge body can't
+          // reserve the allocation up front
+          r->big_len = (size_t)body - 9;
+          size_t have = s->in_buf.length();  // all of it is payload
+          if (!stream_fill_reserve(r, have)) {
+            delete r;
+            ok = false;
+            break;
+          }
+          if (have > 0) {
+            s->in_buf.copy_to(r->big_payload, have);
+            s->in_buf.pop_front(have);
+          }
+          s->py_streams.store(true, std::memory_order_release);
+          s->fill_req = r;
+          s->fill_off = have;
+        }
+        break;
+      }
       s->in_buf.pop_front(8);
       char fh[9];
       s->in_buf.copy_to(fh, 9);
       s->in_buf.pop_front(9);
-      uint64_t dest = ((uint64_t)rd_be32(fh) << 32) | rd_be32(fh + 4);
-      PyRequest* r = new PyRequest();
-      r->kind = 5;
-      r->sock_id = s->id;
-      r->aux = dest;
-      r->compress_type = (int32_t)(uint8_t)fh[8];
-      r->cid = (int64_t)(++s->stream_seq);
+      PyRequest* r = make_stream_request(s, fh);
       size_t plen = body - 9;
       if (plen > 0) {
         r->payload.resize(plen);
@@ -466,6 +538,31 @@ bool drain_socket_inline(NatSocket* s) {
   bool dead = false;
   while (!s->failed.load(std::memory_order_acquire)) {
     ssize_t n;
+    if (s->fill_req != nullptr && s->ssl_sess == nullptr) {
+      // large-payload fill: the read syscall writes STRAIGHT into the
+      // request buffer — zero userspace copies for the payload bytes
+      PyRequest* r = s->fill_req;
+      size_t want = r->big_len - s->fill_off;
+      if (want > (4u << 20)) want = 4u << 20;  // grow-as-received slice
+      if (!stream_fill_reserve(r, s->fill_off + want)) {
+        dead = true;
+        break;
+      }
+      n = ::read(s->fd, r->big_payload + s->fill_off, want);
+      if (n > 0) {
+        s->fill_off += (size_t)n;
+        if (s->fill_off == r->big_len) {
+          s->fill_req = nullptr;
+          s->fill_off = 0;
+          s->server->enqueue_py(r);
+        }
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      if (n < 0 && errno == EINTR) continue;
+      dead = true;  // EOF or hard error mid-payload
+      break;
+    }
     if (s->ssl_sess != nullptr) {
       // TLS lane: ciphertext goes through the session; plaintext lands
       // in in_buf inside ssl_feed

@@ -423,7 +423,13 @@ const char* nat_req_field(void* h, int which, size_t* len) {
   switch (which) {
     case 0: s = &r->service; break;
     case 1: s = &r->method; break;
-    case 2: s = &r->payload; break;
+    case 2:
+      if (r->big_payload != nullptr) {  // fill-mode stream payload
+        *len = r->big_len;
+        return r->big_payload;
+      }
+      s = &r->payload;
+      break;
     case 3: s = &r->attachment; break;
     case 4: s = &r->meta_bytes; break;
     default: *len = 0; return nullptr;

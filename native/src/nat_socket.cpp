@@ -139,6 +139,11 @@ void NatSocket::release() {
       redis_session_free(redis);
       redis = nullptr;
     }
+    if (fill_req != nullptr) {  // connection died mid-payload
+      delete fill_req;
+      fill_req = nullptr;
+      fill_off = 0;
+    }
     if (httpc != nullptr) {
       http_cli_free(httpc);
       httpc = nullptr;
@@ -175,6 +180,8 @@ void NatSocket::reset_for_reuse() {
   py_raw_seq = 0;
   py_streams.store(false, std::memory_order_relaxed);
   stream_seq = 0;
+  fill_req = nullptr;
+  fill_off = 0;
   http = nullptr;
   h2 = nullptr;
   redis = nullptr;
@@ -451,8 +458,21 @@ bool ring_drain() {
               continue;
             }
           } else {
-            s->in_buf.append(g_ring->buffer_data(c.buf_id),
-                             (size_t)c.res);
+            const char* src = g_ring->buffer_data(c.buf_id);
+            size_t len = (size_t)c.res;
+            if (s->fill_req != nullptr) {
+              // stream fill mode: payload bytes skip in_buf entirely
+              size_t took = stream_fill_feed(s, src, len);
+              if (took == SIZE_MAX) {  // allocation failed
+                g_ring->recycle_buffer(c.buf_id);
+                s->set_failed();
+                s->release();
+                continue;
+              }
+              src += took;
+              len -= took;
+            }
+            if (len > 0) s->in_buf.append(src, len);
           }
           g_ring->recycle_buffer(c.buf_id);
           int64_t rr = s->ring_ref.load(std::memory_order_acquire);

@@ -48,13 +48,18 @@ struct RingCompletion {
 class RingListener {
  public:
   static constexpr unsigned kEntries = 256;     // SQ depth
-  static constexpr unsigned kNumBufs = 256;     // provided recv buffers
-  static constexpr unsigned kBufSize = 16384;   // each 16KB
-  // 64KB fixed send buffers (was 16KB): one-in-flight-per-socket keeps
-  // TCP ordering under short writes, so per-completion payload is the
-  // bandwidth lever for large responses (ring_write_buf_pool.h role).
+  // provided recv buffers: 64KB each (was 16KB) — a bulk sender fills
+  // whole buffers, so per-completion payload quadruples and the
+  // completion-handling overhead per MB drops 4x (stream lane lever)
+  static constexpr unsigned kNumBufs = 256;
+  static constexpr unsigned kBufSize = 65536;
+  // 256KB fixed send buffers (was 64KB): one-in-flight-per-socket keeps
+  // TCP ordering under short writes (independent io_uring sends may
+  // execute out of order, and IOSQE_IO_LINK continues after a short
+  // write), so per-completion payload is the bandwidth lever for large
+  // responses (ring_write_buf_pool.h role). 64 x 256KB = 16MB pinned.
   static constexpr unsigned kNumSendBufs = 64;
-  static constexpr unsigned kSendBufSize = 65536;
+  static constexpr unsigned kSendBufSize = 262144;
   static constexpr unsigned kMaxFiles = 4096;   // registered-file table
 
   ~RingListener() { shutdown(); }
