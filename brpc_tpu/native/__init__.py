@@ -228,6 +228,23 @@ def load() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_double, ctypes.POINTER(ctypes.c_uint64)]
         lib.nat_redis_client_bench.restype = ctypes.c_double
+        # -- shm usercode worker lane --
+        lib.nat_shm_lane_create.argtypes = [ctypes.c_size_t]
+        lib.nat_shm_lane_create.restype = ctypes.c_int
+        lib.nat_shm_lane_name.restype = ctypes.c_char_p
+        lib.nat_shm_lane_enable.argtypes = [ctypes.c_int]
+        lib.nat_shm_lane_enable.restype = ctypes.c_int
+        lib.nat_shm_worker_attach.argtypes = [ctypes.c_char_p]
+        lib.nat_shm_worker_attach.restype = ctypes.c_int
+        lib.nat_shm_take_request.argtypes = [ctypes.c_int]
+        lib.nat_shm_take_request.restype = ctypes.c_void_p
+        lib.nat_shm_respond.argtypes = [
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int]
+        lib.nat_shm_respond.restype = ctypes.c_int
+        lib.nat_shm_lane_set_timeout_ms.argtypes = [ctypes.c_int]
+        lib.nat_shm_lane_set_timeout_ms.restype = ctypes.c_int
+        lib.nat_shm_lane_workers.restype = ctypes.c_int
         _lib = lib
         return lib
 

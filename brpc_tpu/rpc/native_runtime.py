@@ -247,10 +247,66 @@ class NativeRuntimeMount:
                                  name=f"native_py_lane_{i}", daemon=True)
             t.start()
             self._threads.append(t)
+        # usercode worker processes (shm lane): kind-3/4 dispatch fans
+        # out across N interpreters; the in-process lane keeps serving
+        # every other kind (and is the overflow path when rings fill)
+        opts = self.server.options
+        if getattr(opts, "py_workers", 0) > 0 and \
+                getattr(opts, "py_worker_factory", ""):
+            self._start_shm_workers(opts.py_workers, opts.py_worker_factory)
         return self.port
+
+    def _start_shm_workers(self, n: int, factory: str):
+        import os
+        import subprocess
+        import sys
+
+        lib = native.load()
+        if lib.nat_shm_lane_create(0) != 0:
+            raise RuntimeError("shm lane creation failed")
+        name = lib.nat_shm_lane_name().decode()
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        self._shm_workers = [
+            subprocess.Popen([sys.executable, "-m",
+                              "brpc_tpu.rpc.shm_worker", name, factory],
+                             env=env, cwd=repo_root)
+            for _ in range(n)
+        ]
+        # readiness barrier BEFORE the lane routes any request: a fresh
+        # interpreter + .so load takes seconds on a loaded host, and
+        # early requests would otherwise sit in the ring against the
+        # reap deadline. A worker that dies at boot only lowers the
+        # attach target (the rest still count).
+        import time as _time
+
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            alive = sum(1 for p in self._shm_workers if p.poll() is None)
+            if lib.nat_shm_lane_workers() >= max(alive, 1) or alive == 0:
+                break
+            _time.sleep(0.1)
+        lib.nat_shm_lane_enable(1)
 
     def stop(self):
         self._stopping = True
+        workers = getattr(self, "_shm_workers", None)
+        if workers:
+            try:
+                native.load().nat_shm_lane_enable(0)
+            except Exception:
+                pass
+            for p in workers:
+                p.terminate()
+            for p in workers:
+                try:
+                    p.wait(timeout=3)
+                except Exception:
+                    p.kill()
+            self._shm_workers = []
         native.rpc_server_stop()
         for t in self._threads:
             t.join(timeout=2.0)

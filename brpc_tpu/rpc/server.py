@@ -79,6 +79,14 @@ class ServerOptions:
     # semantics in C++); unknown commands still reach the Python
     # handlers. The store's data lives native-side only.
     native_redis_store: bool = False
+    # Usercode WORKER PROCESSES (the reference's N-worker usercode
+    # concurrency, server.h:59-285 + usercode_backup_pool.h): with
+    # use_native_runtime, kind-3/4 (HTTP/gRPC) dispatch fans out over
+    # shm rings to this many Python processes, each with its own GIL.
+    # py_worker_factory = "module:function" returning the Service list
+    # the workers serve (must be importable in a fresh process).
+    py_workers: int = 0
+    py_worker_factory: str = ""
 
 
 class Server:

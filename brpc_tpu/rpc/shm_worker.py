@@ -1,0 +1,108 @@
+"""Usercode worker process for the shm lane (nat_shm_lane.cpp).
+
+The parent's native runtime parses HTTP/gRPC requests and fans kind-3/4
+dispatch across N of these processes over shared-memory rings — Python
+usercode scales past one interpreter's GIL the way the reference runs
+usercode on all N workers (server.h:59-285 num_threads,
+details/usercode_backup_pool.h:29-72).
+
+Invocation (by brpc_tpu.rpc.server, not by hand):
+
+    python -m brpc_tpu.rpc.shm_worker <shm_name> <module:factory>
+
+`factory()` returns the list of Service objects to serve — the worker
+rebuilds them (services must be constructible in a fresh process; the
+same constraint every prefork server imposes on app state).
+"""
+from __future__ import annotations
+
+import ctypes
+import importlib
+import sys
+
+
+def main(shm_name: str, factory_spec: str) -> int:
+    from brpc_tpu import native, rpc
+
+    lib = native.load()  # signatures declared centrally in native.load()
+    if lib.nat_shm_worker_attach(shm_name.encode()) != 0:
+        print(f"shm_worker: cannot attach {shm_name}", file=sys.stderr)
+        return 1
+
+    # Responses ride the shm response ring; the parent's drainer feeds
+    # them through the ordered per-session emitters. The module-level
+    # rebind is worker-local: this process never owns sockets.
+    def http_respond(sock_id, seq, data, close_after=False):
+        return lib.nat_shm_respond(3, sock_id, seq, data, len(data), 0,
+                                   None, 1 if close_after else 0)
+
+    def grpc_respond(sock_id, stream_id, payload=b"", grpc_status=0,
+                     grpc_message=""):
+        return lib.nat_shm_respond(4, sock_id, stream_id, payload,
+                                   len(payload), grpc_status,
+                                   grpc_message.encode() or None, 0)
+
+    native.http_respond = http_respond
+    native.grpc_respond = grpc_respond
+    native.sock_write = lambda *a, **k: -1       # no sockets here
+    native.sock_set_failed = lambda *a, **k: -1
+
+    mod_name, _, fn_name = factory_spec.partition(":")
+    factory = getattr(importlib.import_module(mod_name), fn_name)
+    services = factory()
+
+    from brpc_tpu.builtin import register_builtin_services
+    from brpc_tpu.rpc.native_runtime import NativeRuntimeMount
+
+    server = rpc.Server(rpc.ServerOptions())
+    for svc in services:
+        server.add_service(svc)
+    register_builtin_services(server)
+    mount = NativeRuntimeMount(server, num_threads=1)
+
+    def field(h, which):
+        n = ctypes.c_size_t(0)
+        p = lib.nat_req_field(h, which, ctypes.byref(n))
+        return ctypes.string_at(p, n.value) if p and n.value else b""
+
+    import os
+
+    while True:
+        h = lib.nat_shm_take_request(500)
+        if not h:
+            # attach armed PR_SET_PDEATHSIG, but belt-and-braces: a
+            # reparented worker (parent hard-killed before prctl) must
+            # not poll a leaked segment forever
+            if os.getppid() == 1:
+                return 0
+            continue
+        kind = lib.nat_req_kind(h)
+        sock_id = lib.nat_req_sock_id(h)
+        seq = lib.nat_req_cid(h)
+        verb_or_blank = field(h, 0)
+        path = field(h, 1)
+        headers = field(h, 4)
+        payload = field(h, 2)
+        lib.nat_req_free(h)
+        try:
+            if kind == 3:
+                mount._handle_http(verb_or_blank, path, headers, payload,
+                                   sock_id, seq)
+            elif kind == 4:
+                mount._handle_grpc(path, headers, payload, sock_id, seq)
+        except Exception as e:  # answer rather than drop
+            try:
+                if kind == 3:
+                    body = f"{e}\n".encode()
+                    resp = (f"HTTP/1.1 500 Internal Server Error\r\n"
+                            f"Content-Length: {len(body)}\r\n\r\n"
+                            ).encode() + body
+                    http_respond(sock_id, seq, resp)
+                else:
+                    grpc_respond(sock_id, seq, b"", 13, f"{e}")
+            except Exception:
+                pass
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]) or 0)

@@ -319,6 +319,10 @@ struct PyRequest {
   ~PyRequest() { ::free(big_payload); }
 };
 
+// shm usercode lane (nat_shm_lane.cpp): true = request consumed by the
+// worker-process rings (kinds 3/4 only, when enabled).
+bool shm_lane_offer(PyRequest* r);
+
 class NatServer {
  public:
   int listen_fd = -1;
@@ -390,6 +394,9 @@ class NatServer {
   bool py_stopping = false;
 
   void enqueue_py(PyRequest* r) {
+    // worker-process lane first (kinds 3/4 when enabled): usercode runs
+    // across N interpreters instead of behind this process's GIL
+    if ((r->kind == 3 || r->kind == 4) && shm_lane_offer(r)) return;
     {
       std::lock_guard<std::mutex> g(py_mu);
       py_q.push_back(r);
@@ -746,6 +753,13 @@ int ssl_encrypt_and_write(NatSocket* s, IOBuf&& plain);
 void ssl_session_free(SslSessionN* s);
 
 extern "C" {
+// response emitters the shm response drainer reuses (nat_http.cpp /
+// nat_h2.cpp)
+int nat_http_respond(uint64_t sock_id, int64_t seq, const char* data,
+                     size_t len, int close_after);
+int nat_grpc_respond(uint64_t sock_id, int64_t sid, const char* payload,
+                     size_t payload_len, int grpc_status,
+                     const char* grpc_message);
 // forward decls shared with the bench harness
 void* nat_channel_open(const char* ip, int port, int unused,
                        int batch_writes, int connect_timeout_ms,

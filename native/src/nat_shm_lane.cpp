@@ -1,0 +1,535 @@
+// Shared-memory usercode lane — kind-3/4 (HTTP / gRPC) py-lane requests
+// fan out to N WORKER PROCESSES over a pair of shm rings, so Python
+// usercode scales past one interpreter's GIL the way the reference's
+// usercode runs on all N workers (server.h:59-285 num_threads,
+// details/usercode_backup_pool.h:29-72 — usercode concurrency is the
+// product, not the port).
+//
+//   parent (native runtime)                worker processes (Python)
+//   cut loop parses request  ──req ring──▶ nat_shm_take_request()
+//                                          dispatch via user services
+//   response drainer thread  ◀─resp ring── nat_shm_respond_{http,grpc}()
+//   emits via the ordered
+//   reorder windows (seq)
+//
+// The rings live in one shm_open segment; both sides use THIS library's
+// helpers (the workers load the same .so), so the record layout never
+// crosses a language boundary. Mutexes are PTHREAD_PROCESS_SHARED +
+// ROBUST: a worker dying mid-ring marks the lock consistent instead of
+// wedging the server.
+#include <linux/futex.h>
+#include <signal.h>
+#include <sys/prctl.h>
+#include <pthread.h>
+#include <sys/syscall.h>
+#include <sys/stat.h>
+#include <sys/mman.h>
+
+#include "nat_internal.h"
+
+namespace brpc_tpu {
+
+namespace {
+
+struct ShmRing {
+  // Mutation is guarded by a ROBUST process-shared mutex (a worker dying
+  // mid-record recovers the lock). Blocking uses RAW FUTEXES on the seq
+  // counters, NOT pthread condvars: process-shared condvars are not
+  // robust — a waiter killed with SIGKILL can wedge every later
+  // waiter/broadcaster forever (observed: the response drainer hung in
+  // the condvar's internal futex after test_worker_crash_recovers).
+  // A futex-on-counter has no shared internal state to corrupt.
+  pthread_mutex_t mu;
+  std::atomic<uint32_t> seq_data{0};   // bumped on put  (wakes readers)
+  std::atomic<uint32_t> seq_space{0};  // bumped on take (wakes writers)
+  uint64_t head = 0;  // read offset  (monotone, mod cap)
+  uint64_t tail = 0;  // write offset (monotone, mod cap)
+  uint64_t cap = 0;
+  std::atomic<int> shutdown{0};
+  char data[1];  // cap bytes follow
+
+  size_t used() const { return (size_t)(tail - head); }
+  size_t room() const { return (size_t)(cap - used()); }
+
+  void put_bytes(const char* p, size_t n) {  // requires mu, room
+    size_t off = (size_t)(tail % cap);
+    size_t first = cap - off < n ? cap - off : n;
+    memcpy(data + off, p, first);
+    if (n > first) memcpy(data, p + first, n - first);
+    tail += n;
+  }
+  void get_bytes(char* p, size_t n) {  // requires mu, used
+    size_t off = (size_t)(head % cap);
+    size_t first = cap - off < n ? cap - off : n;
+    memcpy(p + 0, data + off, first);
+    if (n > first) memcpy(p + first, data, n - first);
+    head += n;
+  }
+};
+
+// robust-mutex lock: a dead owner's lock is recovered, not inherited
+int ring_lock(ShmRing* r) {
+  int rc = pthread_mutex_lock(&r->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&r->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+// shared (non-PRIVATE) futex wait/wake on a ring seq counter
+void futex_wait_shared(std::atomic<uint32_t>* a, uint32_t expect,
+                       int timeout_ms) {
+  struct timespec ts;
+  ts.tv_sec = timeout_ms / 1000;
+  ts.tv_nsec = (long)(timeout_ms % 1000) * 1000000L;
+  syscall(SYS_futex, (uint32_t*)a, FUTEX_WAIT, expect, &ts, nullptr, 0);
+}
+void futex_wake_shared(std::atomic<uint32_t>* a) {
+  syscall(SYS_futex, (uint32_t*)a, FUTEX_WAKE, INT32_MAX, nullptr, nullptr,
+          0);
+}
+
+void ring_init(ShmRing* r, size_t cap) {
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&r->mu, &ma);
+  r->seq_data.store(0);
+  r->seq_space.store(0);
+  r->head = r->tail = 0;
+  r->cap = cap;
+  r->shutdown.store(0);
+}
+
+// Blocking record put/take. Records are u32 length + payload. False on
+// shutdown (put also fails when the record can never fit).
+// timeout_ms semantics: <0 = try-put (never blocks), >0 = one bounded
+// wait, 0 = keep waiting (bounded 1s slices, rechecking shutdown).
+bool ring_put(ShmRing* r, const std::string& rec, int timeout_ms) {
+  if (rec.size() + 4 > r->cap) return false;
+  // loop: check under the lock, block OUTSIDE it on the seq futex
+  for (int attempt = 0;; attempt++) {
+    if (ring_lock(r) != 0) return false;
+    if (r->used() > r->cap) r->head = r->tail = 0;  // desynced: reset
+    if (r->shutdown.load(std::memory_order_relaxed) != 0) {
+      pthread_mutex_unlock(&r->mu);
+      return false;
+    }
+    if (r->room() >= rec.size() + 4) {
+      char len[4];
+      uint32_t n = (uint32_t)rec.size();
+      memcpy(len, &n, 4);
+      r->put_bytes(len, 4);
+      r->put_bytes(rec.data(), rec.size());
+      r->seq_data.fetch_add(1, std::memory_order_release);
+      pthread_mutex_unlock(&r->mu);
+      futex_wake_shared(&r->seq_data);
+      return true;
+    }
+    uint32_t seq = r->seq_space.load(std::memory_order_acquire);
+    pthread_mutex_unlock(&r->mu);
+    if (timeout_ms < 0) return false;  // try-put: reactor threads
+    if (timeout_ms > 0 && attempt >= 1) return false;  // bounded: gave up
+    futex_wait_shared(&r->seq_space, seq,
+                      timeout_ms > 0 ? timeout_ms : 1000);
+  }
+}
+
+bool ring_take(ShmRing* r, std::string* out, int timeout_ms) {
+  for (int attempt = 0;; attempt++) {
+    if (ring_lock(r) != 0) return false;
+    // A worker killed mid-put/take recovers the LOCK (robust mutex) but
+    // not byte-stream consistency: validate before trusting anything. A
+    // desynced ring (head past tail, or a record length that can't be
+    // in the ring) is reset empty — losing parked records is the
+    // recoverable outcome; chasing a garbage length into resize/memcpy
+    // is a parent crash.
+    if (r->used() > r->cap) r->head = r->tail = 0;
+    if (r->used() >= 4) {
+      char len[4];
+      r->get_bytes(len, 4);
+      uint32_t n;
+      memcpy(&n, len, 4);
+      bool ok = false;
+      if (n > r->used()) {
+        r->head = r->tail = 0;  // corrupt record: reset
+      } else {
+        out->resize(n);
+        if (n > 0) r->get_bytes(&(*out)[0], n);
+        ok = true;
+      }
+      r->seq_space.fetch_add(1, std::memory_order_release);
+      pthread_mutex_unlock(&r->mu);
+      futex_wake_shared(&r->seq_space);
+      if (ok) return true;
+      continue;  // corrupt record consumed; look again
+    }
+    if (r->shutdown.load(std::memory_order_relaxed) != 0) {
+      pthread_mutex_unlock(&r->mu);
+      return false;
+    }
+    uint32_t seq = r->seq_data.load(std::memory_order_acquire);
+    pthread_mutex_unlock(&r->mu);
+    if (attempt >= 1) return false;  // one bounded wait per call
+    futex_wait_shared(&r->seq_data, seq, timeout_ms > 0 ? timeout_ms : 200);
+  }
+}
+
+void ring_shutdown(ShmRing* r) {
+  r->shutdown.store(1, std::memory_order_relaxed);
+  r->seq_data.fetch_add(1, std::memory_order_release);
+  r->seq_space.fetch_add(1, std::memory_order_release);
+  futex_wake_shared(&r->seq_data);
+  futex_wake_shared(&r->seq_space);
+}
+
+// segment = header + request ring + response ring
+struct ShmSeg {
+  uint64_t magic;
+  uint64_t ring_bytes;  // per ring, data capacity
+  std::atomic<int32_t> attached{0};  // workers that completed attach
+  // liveness heartbeat: stamped (CLOCK_MONOTONIC ms) by every worker
+  // take-loop pass, so the parent can detect all-workers-dead and fall
+  // back to the in-process lane instead of 503ing via the reaper
+  std::atomic<int64_t> last_worker_poll_ms{0};
+};
+
+int64_t mono_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+constexpr uint64_t kShmMagic = 0x62727063746C616EULL;  // "brpctlan"
+
+ShmSeg* g_seg = nullptr;
+size_t g_seg_total = 0;
+bool g_seg_unlinked = false;
+char g_seg_name[64];
+std::thread g_resp_drainer;
+std::atomic<bool> g_lane_enabled{false};
+std::atomic<bool> g_drainer_stop{false};
+
+// In-flight table: every request handed to the rings is tracked until a
+// worker answers it — a worker dying mid-request (or a request stuck in
+// the ring with no workers left) is reaped with an error response after
+// the deadline, so a pipelined connection's reorder window can never
+// wedge on a seq nobody will answer. The drainer only emits responses
+// whose entry is still present, so a straggler worker answering after
+// the reaper cannot double-respond.
+struct InflightKey {
+  uint64_t sock_id;
+  int64_t seq;
+  bool operator<(const InflightKey& o) const {
+    return sock_id != o.sock_id ? sock_id < o.sock_id : seq < o.seq;
+  }
+};
+struct InflightEntry {
+  uint8_t kind;
+  std::chrono::steady_clock::time_point deadline;
+};
+std::mutex g_inflight_mu;
+std::map<InflightKey, InflightEntry> g_inflight;
+std::atomic<int> g_reap_timeout_ms{30000};
+
+ShmRing* req_ring() {
+  return (ShmRing*)((char*)g_seg + sizeof(ShmSeg));
+}
+ShmRing* resp_ring() {
+  return (ShmRing*)((char*)g_seg + sizeof(ShmSeg) + sizeof(ShmRing) +
+                    g_seg->ring_bytes);
+}
+
+void put_str(std::string* out, const std::string& s) {
+  uint32_t n = (uint32_t)s.size();
+  out->append((const char*)&n, 4);
+  out->append(s);
+}
+bool get_str(const std::string& in, size_t* pos, std::string* s) {
+  if (*pos + 4 > in.size()) return false;
+  uint32_t n;
+  memcpy(&n, in.data() + *pos, 4);
+  *pos += 4;
+  if (*pos + n > in.size()) return false;
+  s->assign(in.data() + *pos, n);
+  *pos += n;
+  return true;
+}
+
+// Emit the error response that unwedges a reaped request's window slot.
+void emit_reaped(uint8_t kind, uint64_t sock_id, int64_t seq) {
+  if (kind == 3) {
+    static const char kResp[] =
+        "HTTP/1.1 503 Service Unavailable\r\nContent-Length: 24\r\n\r\n"
+        "usercode worker timeout\n";
+    nat_http_respond(sock_id, seq, kResp, sizeof(kResp) - 1, 0);
+  } else {
+    nat_grpc_respond(sock_id, seq, nullptr, 0, 14 /* UNAVAILABLE */,
+                     "usercode worker timeout");
+  }
+}
+
+void reap_expired() {
+  auto now = std::chrono::steady_clock::now();
+  std::vector<std::pair<InflightKey, uint8_t>> dead;
+  {
+    std::lock_guard<std::mutex> g(g_inflight_mu);
+    for (auto it = g_inflight.begin(); it != g_inflight.end();) {
+      if (it->second.deadline <= now) {
+        dead.emplace_back(it->first, it->second.kind);
+        it = g_inflight.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (auto& d : dead) emit_reaped(d.second, d.first.sock_id, d.first.seq);
+}
+
+// parent: response records -> the ordered per-session emitters
+void resp_drainer_loop() {
+  while (!g_drainer_stop.load(std::memory_order_relaxed)) {
+    std::string rec;
+    bool got = ring_take(resp_ring(), &rec, 200);
+    reap_expired();
+    if (!got) continue;
+    size_t pos = 0;
+    if (rec.size() < 1 + 8 + 8 + 4 + 1) continue;
+    uint8_t kind = (uint8_t)rec[pos++];
+    uint64_t sock_id;
+    int64_t seq;
+    int32_t status;
+    memcpy(&sock_id, rec.data() + pos, 8);
+    pos += 8;
+    memcpy(&seq, rec.data() + pos, 8);
+    pos += 8;
+    memcpy(&status, rec.data() + pos, 4);
+    pos += 4;
+    uint8_t close_after = (uint8_t)rec[pos++];
+    std::string payload, message;
+    if (!get_str(rec, &pos, &payload) || !get_str(rec, &pos, &message)) {
+      continue;
+    }
+    {
+      // already reaped (worker answered late): drop — emitting twice
+      // would poison the session reorder windows
+      std::lock_guard<std::mutex> g(g_inflight_mu);
+      auto it = g_inflight.find(InflightKey{sock_id, seq});
+      if (it == g_inflight.end()) continue;
+      g_inflight.erase(it);
+    }
+    if (kind == 3) {
+      nat_http_respond(sock_id, seq, payload.data(), payload.size(),
+                       close_after);
+    } else if (kind == 4) {
+      nat_grpc_respond(sock_id, seq, payload.data(), payload.size(),
+                       status, message.empty() ? nullptr : message.c_str());
+    }
+  }
+}
+
+}  // namespace
+
+// enqueue hook used by the cut loops: true = the request was routed to
+// the shm worker lane (consumed), false = keep the in-process py lane.
+bool shm_lane_offer(PyRequest* r) {
+  if (!g_lane_enabled.load(std::memory_order_acquire)) return false;
+  if (r->kind != 3 && r->kind != 4) return false;
+  // all workers dead/stalled (no take-loop heartbeat for 2s): serve
+  // in-process instead of queueing requests for the reaper to 503
+  int64_t last = g_seg->last_worker_poll_ms.load(std::memory_order_relaxed);
+  if (last == 0 || mono_ms() - last > 2000) return false;
+  std::string rec;
+  rec.reserve(64 + r->service.size() + r->method.size() +
+              r->payload.size() + r->meta_bytes.size());
+  rec.push_back((char)r->kind);
+  rec.append((const char*)&r->sock_id, 8);
+  rec.append((const char*)&r->cid, 8);
+  put_str(&rec, r->service);
+  put_str(&rec, r->method);
+  put_str(&rec, r->meta_bytes);
+  put_str(&rec, r->payload);
+  // track BEFORE the put: once the record is visible a worker may
+  // answer instantly, and the drainer drops responses with no entry
+  {
+    std::lock_guard<std::mutex> g(g_inflight_mu);
+    g_inflight[InflightKey{r->sock_id, r->cid}] = InflightEntry{
+        (uint8_t)r->kind,
+        std::chrono::steady_clock::now() +
+            std::chrono::milliseconds(
+                g_reap_timeout_ms.load(std::memory_order_relaxed))};
+  }
+  // ring full / shutdown: fall back to the in-process lane. TRY-put —
+  // this runs on the reactor thread, which must never park on a futex
+  // (a stalled worker pool would freeze every connection it serves)
+  if (!ring_put(req_ring(), rec, -1)) {
+    std::lock_guard<std::mutex> g(g_inflight_mu);
+    g_inflight.erase(InflightKey{r->sock_id, r->cid});
+    return false;
+  }
+  delete r;
+  return true;
+}
+
+extern "C" {
+
+// Parent: create the segment (call BEFORE spawning workers). Returns 0.
+// After a full disable (which unlinks the name) a new segment with a
+// fresh name is created, so stop -> start cycles work.
+int nat_shm_lane_create(size_t ring_bytes) {
+  if (g_seg != nullptr && !g_seg_unlinked) return 0;
+  if (g_seg != nullptr) {  // previous lane fully shut down: replace
+    munmap(g_seg, g_seg_total);
+    g_seg = nullptr;
+  }
+  if (ring_bytes == 0) ring_bytes = 8u << 20;
+  static std::atomic<int> counter{0};
+  snprintf(g_seg_name, sizeof(g_seg_name), "/brpc_tpu_lane_%d_%d",
+           (int)getpid(), counter.fetch_add(1));
+  size_t total = sizeof(ShmSeg) + 2 * (sizeof(ShmRing) + ring_bytes);
+  shm_unlink(g_seg_name);
+  int fd = shm_open(g_seg_name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return -1;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    ::close(fd);
+    shm_unlink(g_seg_name);
+    return -1;
+  }
+  void* mem =
+      mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(g_seg_name);
+    return -1;
+  }
+  g_seg = (ShmSeg*)mem;
+  g_seg_total = total;
+  g_seg_unlinked = false;
+  g_seg->magic = kShmMagic;
+  g_seg->ring_bytes = ring_bytes;
+  g_seg->attached.store(0);
+  ring_init(req_ring(), ring_bytes);
+  ring_init(resp_ring(), ring_bytes);
+  return 0;
+}
+
+// Parent: how many workers have completed attach (readiness barrier —
+// a short reap timeout must not fire while workers are still booting).
+int nat_shm_lane_workers() {
+  return g_seg != nullptr ? g_seg->attached.load() : 0;
+}
+
+const char* nat_shm_lane_name() { return g_seg != nullptr ? g_seg_name : ""; }
+
+// Parent: route kind-3/4 py-lane requests to the workers + start the
+// response drainer. Disable unlinks the shm name (the RAM-backed
+// segment must not outlive the server run); the mapping stays until a
+// later create replaces it.
+int nat_shm_lane_enable(int enable) {
+  if (g_seg == nullptr) return -1;
+  if (enable != 0 && !g_lane_enabled.load()) {
+    {
+      std::lock_guard<std::mutex> g(g_inflight_mu);
+      g_inflight.clear();
+    }
+    g_drainer_stop.store(false);
+    g_resp_drainer = std::thread(resp_drainer_loop);
+    g_lane_enabled.store(true, std::memory_order_release);
+  } else if (enable == 0 && g_lane_enabled.load()) {
+    g_lane_enabled.store(false, std::memory_order_release);
+    ring_shutdown(req_ring());
+    ring_shutdown(resp_ring());
+    g_drainer_stop.store(true);
+    if (g_resp_drainer.joinable()) g_resp_drainer.join();
+    if (!g_seg_unlinked) {
+      shm_unlink(g_seg_name);
+      g_seg_unlinked = true;
+    }
+  }
+  return 0;
+}
+
+// Test/ops knob: how long an unanswered worker request waits before the
+// reaper answers it with 503/UNAVAILABLE (default 30s).
+int nat_shm_lane_set_timeout_ms(int ms) {
+  if (ms <= 0) return -1;
+  g_reap_timeout_ms.store(ms, std::memory_order_relaxed);
+  return 0;
+}
+
+// Worker: map the parent's segment. Also arms parent-death delivery of
+// SIGTERM so a hard parent crash cannot leave orphan workers polling
+// the (leaked) segment forever.
+int nat_shm_worker_attach(const char* name) {
+  if (g_seg != nullptr) return 0;
+  prctl(PR_SET_PDEATHSIG, SIGTERM);
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return -1;
+  g_seg = (ShmSeg*)mem;
+  if (g_seg->magic != kShmMagic) return -1;
+  // the attach IS the first heartbeat: requests arriving between attach
+  // and the worker's first take must route to the ring, not fall back
+  g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
+  g_seg->attached.fetch_add(1);
+  return 0;
+}
+
+// Worker: take one request; returns a PyRequest* handle compatible with
+// the nat_req_* accessors (+ nat_req_free), or null on timeout.
+void* nat_shm_take_request(int timeout_ms) {
+  if (g_seg == nullptr) return nullptr;
+  // liveness heartbeat for the parent's all-workers-dead fallback
+  g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
+  std::string rec;
+  if (!ring_take(req_ring(), &rec, timeout_ms)) return nullptr;
+  if (rec.size() < 17) return nullptr;
+  PyRequest* r = new PyRequest();
+  size_t pos = 0;
+  r->kind = (int32_t)(uint8_t)rec[pos++];
+  memcpy(&r->sock_id, rec.data() + pos, 8);
+  pos += 8;
+  memcpy(&r->cid, rec.data() + pos, 8);
+  pos += 8;
+  if (!get_str(rec, &pos, &r->service) ||
+      !get_str(rec, &pos, &r->method) ||
+      !get_str(rec, &pos, &r->meta_bytes) ||
+      !get_str(rec, &pos, &r->payload)) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+// Worker: push a response record (kind 3 = serialized HTTP response,
+// kind 4 = gRPC payload + status + message).
+int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
+                    const char* payload, size_t payload_len, int32_t status,
+                    const char* message, int close_after) {
+  if (g_seg == nullptr) return -1;
+  std::string rec;
+  rec.reserve(32 + payload_len);
+  rec.push_back((char)kind);
+  rec.append((const char*)&sock_id, 8);
+  rec.append((const char*)&seq, 8);
+  rec.append((const char*)&status, 4);
+  rec.push_back((char)(close_after != 0));
+  std::string p(payload, payload_len);
+  put_str(&rec, p);
+  std::string m(message != nullptr ? message : "");
+  put_str(&rec, m);
+  return ring_put(resp_ring(), rec, 0) ? 0 : -1;
+}
+
+}  // extern "C"
+
+}  // namespace brpc_tpu

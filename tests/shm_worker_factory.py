@@ -1,0 +1,17 @@
+"""Service factory for the shm worker-process tests (imported by the
+worker subprocesses as tests.shm_worker_factory:make)."""
+
+
+def make():
+    from brpc_tpu import rpc
+    from brpc_tpu.rpc.proto import echo_pb2
+
+    class EchoService(rpc.Service):
+        @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            import os
+
+            response.message = f"{request.message}@{os.getpid()}"
+            done()
+
+    return [EchoService()]
