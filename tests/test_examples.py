@@ -31,6 +31,8 @@ EXAMPLES = [
                                         "--iters", "3"]),
     ("examples/io_uring_echo.py", ["--seconds", "1"]),
     ("examples/native_client.py", []),
+    ("examples/native_protocol_clients.py", []),
+    ("examples/usercode_workers.py", []),
     ("examples/rtmp_relay.py", []),
 ]
 
