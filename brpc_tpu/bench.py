@@ -549,13 +549,11 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
-    # device-transport bandwidth (the rdma_performance analog): tracked
-    # round over round in the artifact
-    device_lanes = {}
-    try:
-        device_lanes = device_lane_bench()
-    except Exception:
-        pass
+    # ALL pure-loopback lanes run BEFORE any tunnel-DMA section: the
+    # device lanes' h2d/d2h probes depress host loopback throughput for
+    # tens of seconds afterwards (the shm_push 0.04 artifact of r4 —
+    # same mechanism, and a stream/worker row captured mid-cooldown
+    # reads as a lane regression).
 
     # the native HTTP/1.1 lane (VERDICT r3 #1): native parse + native
     # usercode (/echo) and native parse + Python usercode (RPC-over-HTTP)
@@ -586,7 +584,16 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
-    # model step + collective rows (VERDICT r3 #6)
+    # device-transport bandwidth (the rdma_performance analog): tracked
+    # round over round in the artifact. Runs AFTER the loopback lanes
+    # (its DMA sections poison them); shm_push runs first inside it.
+    device_lanes = {}
+    try:
+        device_lanes = device_lane_bench()
+    except Exception:
+        pass
+
+    # model step + collective rows (VERDICT r3 #6) — TPU work, last
     model_rows = {}
     try:
         model_rows = model_collective_bench()

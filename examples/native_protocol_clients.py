@@ -26,8 +26,7 @@ def main():
         return
 
     srv = rpc.Server(rpc.ServerOptions(num_threads=2,
-                                       use_native_runtime=True,
-                                       native_builtin_echo=True))
+                                       use_native_runtime=True))
     srv.add_service(EchoService())
     assert srv.start("127.0.0.1:0") == 0
     port = srv.listen_endpoint.port
