@@ -391,11 +391,65 @@ struct H2StreamN {
   int64_t send_window = 65535;  // for OUR DATA on this stream
 };
 
+// Encoder-side HPACK dynamic table (the reference keeps one in
+// details/hpack.cpp). Blocks that ADD to or INDEX INTO this table must
+// reach the wire in encoder-state order, so it is used ONLY for
+// response HEADERS emitted on the reading thread (single-threaded,
+// batch-ordered); py-thread responses and parked trailers stay on the
+// state-independent static encoding and may interleave freely.
+struct HpackEncTableN {
+  struct Entry {
+    std::string name, value;
+  };
+  std::deque<Entry> entries;  // front = newest
+  size_t size = 0;
+  // RFC 7541 §4.2 resize protocol: `max_size` is what the decoder
+  // currently believes; when the peer's SETTINGS change the cap, the
+  // next reading-thread block prefixes update(lowest-since-signal)
+  // then update(target) if they differ (shrink-then-grow must signal
+  // the minimum). py-thread static blocks cannot carry the update
+  // (they are deliberately order-independent), so with a mid-stream
+  // shrink an ultra-strict decoder may see the update one block late —
+  // documented limitation; gRPC stacks do not resize mid-connection.
+  size_t max_size = 4096;  // as signaled to (believed by) the decoder
+  size_t lowest = 4096;    // min cap since the last signaled update
+  size_t target = 4096;    // latest peer cap (≤4096)
+  bool pending_resize = false;
+
+  int find(std::string_view n, std::string_view v) const {
+    for (size_t i = 0; i < entries.size(); i++) {
+      if (entries[i].name == n && entries[i].value == v) {
+        return (int)(kStaticCount + 1 + i);
+      }
+    }
+    return -1;
+  }
+  void evict() {
+    while (size > max_size && !entries.empty()) {
+      size -= entries.back().name.size() + entries.back().value.size() + 32;
+      entries.pop_back();
+    }
+  }
+  void add(std::string_view n, std::string_view v) {
+    size_t esz = n.size() + v.size() + 32;
+    if (esz > max_size) {  // RFC 7541 §4.4: clears the table
+      entries.clear();
+      size = 0;
+      return;
+    }
+    size += esz;
+    evict();
+    entries.push_front({std::string(n), std::string(v)});
+  }
+};
+
 struct H2SessionN {
   HpackDecoderN dec;  // reading thread only
   // settings from the client (apply to frames WE send)
   int64_t peer_initial_window = 65535;
   size_t peer_max_frame = 16384;
+  // encoder table for reading-thread response HEADERS (under mu)
+  HpackEncTableN enc;
   // everything below is shared with py-lane responders: mu guards it
   std::mutex mu;
   int64_t conn_send_window = 65535;
@@ -445,15 +499,49 @@ static void h2_send_data_locked(H2SessionN* h, H2StreamN* st, uint32_t sid,
 // trailers (grpc-status). Flow-control leftovers park on the session.
 // Called from the reading thread (native handlers, batch_out != nullptr)
 // and from py pthreads (batch_out == nullptr).
+// Encode one header with the session dynamic table (requires h->mu;
+// reading-thread blocks only — see HpackEncTableN).
+static void hp_enc_header_dyn(H2SessionN* h, std::string* out,
+                              std::string_view name,
+                              std::string_view value) {
+  if (h->enc.max_size > 0) {
+    int idx = h->enc.find(name, value);
+    if (idx > 0) {
+      hp_enc_int(out, (uint64_t)idx, 7, 0x80);  // indexed (dynamic)
+      return;
+    }
+  }
+  if (h->enc.max_size == 0) {  // client forbade a dynamic table
+    hp_enc_header(out, name, value);
+    return;
+  }
+  // literal WITH incremental indexing: next response hits the index
+  for (int i = 0; i < kStaticCount; i++) {
+    if (name == kStatic[i].name) {
+      hp_enc_int(out, (uint64_t)(i + 1), 6, 0x40);
+      hp_enc_str(out, value);
+      h->enc.add(name, value);
+      return;
+    }
+  }
+  out->push_back('\x40');
+  hp_enc_str(out, name);
+  hp_enc_str(out, value);
+  h->enc.add(name, value);
+}
+
 static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
                        size_t payload_len, int grpc_status,
                        const char* grpc_message, IOBuf* batch_out) {
   H2SessionN* h = s->h2;
   if (h == nullptr) return;
-  // response headers (static-encoded, stateless)
+  // response headers: dynamic-table encoded on the reading thread
+  // (wire-ordered), static-encoded from py threads (order-independent)
   std::string hdr_block;
-  hp_enc_int(&hdr_block, 8, 7, 0x80);  // :status 200 (static idx 8)
-  hp_enc_header(&hdr_block, "content-type", "application/grpc");
+  if (batch_out == nullptr) {
+    hp_enc_int(&hdr_block, 8, 7, 0x80);  // :status 200 (static idx 8)
+    hp_enc_header(&hdr_block, "content-type", "application/grpc");
+  }
   std::string trailer_block;
   char stbuf[16];
   snprintf(stbuf, sizeof(stbuf), "%d", grpc_status);
@@ -478,10 +566,28 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
   trailers.append(trailer_block);
 
   std::string out;
-  frame_header(&out, hdr_block.size(), kFHeaders, kFlagEndHeaders, sid);
-  out.append(hdr_block);
   {
     std::lock_guard<std::mutex> g(h->mu);
+    if (batch_out != nullptr) {
+      // reading-thread block: encode under mu with the dynamic table
+      if (h->enc.pending_resize) {  // peer changed the table cap
+        if (h->enc.lowest < h->enc.max_size) {
+          hp_enc_int(&hdr_block, h->enc.lowest, 5, 0x20);
+        }
+        if (h->enc.target != h->enc.lowest) {
+          hp_enc_int(&hdr_block, h->enc.target, 5, 0x20);
+        }
+        h->enc.max_size = h->enc.target;
+        h->enc.lowest = h->enc.target;
+        h->enc.pending_resize = false;
+        h->enc.evict();
+      }
+      hp_enc_int(&hdr_block, 8, 7, 0x80);  // :status 200
+      hp_enc_header_dyn(h, &hdr_block, "content-type",
+                        "application/grpc");
+    }
+    frame_header(&out, hdr_block.size(), kFHeaders, kFlagEndHeaders, sid);
+    out.append(hdr_block);
     auto it = h->streams.find(sid);
     H2StreamN tmp;  // stream may already be gone (RST) — send anyway
     H2StreamN* st = it != h->streams.end() ? &it->second : &tmp;
@@ -686,7 +792,14 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
           uint32_t val = ((uint32_t)p[i + 2] << 24) |
                          ((uint32_t)p[i + 3] << 16) |
                          ((uint32_t)p[i + 4] << 8) | p[i + 5];
-          if (id == 4) {  // INITIAL_WINDOW_SIZE
+          if (id == 1) {  // HEADER_TABLE_SIZE: bounds OUR encoder table
+            std::lock_guard<std::mutex> g(h->mu);
+            size_t cap = val > 4096 ? 4096 : (size_t)val;
+            h->enc.target = cap;
+            if (cap < h->enc.lowest) h->enc.lowest = cap;
+            h->enc.pending_resize = (h->enc.target != h->enc.max_size ||
+                                     h->enc.lowest < h->enc.max_size);
+          } else if (id == 4) {  // INITIAL_WINDOW_SIZE
             std::lock_guard<std::mutex> g(h->mu);
             int64_t delta = (int64_t)val - h->peer_initial_window;
             h->peer_initial_window = val;
