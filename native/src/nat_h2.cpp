@@ -573,6 +573,11 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
       if (h->enc.pending_resize) {  // peer changed the table cap
         if (h->enc.lowest < h->enc.max_size) {
           hp_enc_int(&hdr_block, h->enc.lowest, 5, 0x20);
+          // the decoder evicts at `lowest` (a grow does NOT restore its
+          // entries) — the encoder must drop the same entries or later
+          // indexed refs point at ghosts
+          h->enc.max_size = h->enc.lowest;
+          h->enc.evict();
         }
         if (h->enc.target != h->enc.lowest) {
           hp_enc_int(&hdr_block, h->enc.target, 5, 0x20);

@@ -102,13 +102,59 @@ def test_settings_table_size_zero_signals_update(mixed_server):
         assert hdr_payload is not None, "no response HEADERS seen"
         # first instruction: dynamic table size update to 0 (0x20)
         assert hdr_payload[0] == 0x20, hdr_payload.hex()
-        # and nothing in the block uses incremental indexing (0x40 bit
-        # pattern 01xxxxxx) — the decoder has no table to store into
-        i = 1
-        assert all((b & 0xC0) != 0x40 for b in hdr_payload[i:i + 1]), \
-            hdr_payload.hex()
+        # walk the block instruction by instruction: nothing may use
+        # incremental indexing — the decoder has no table to store into
+        assert "incr" not in _hpack_ops(hdr_payload), hdr_payload.hex()
     finally:
         sk.close()
+
+
+def _hpack_ops(block: bytes):
+    """Minimal HPACK instruction walker: returns the op kind sequence
+    (idx / incr / resize / lit) so tests can assert on instruction
+    boundaries instead of single bytes."""
+    ops = []
+    i = 0
+
+    def rdint(prefix):
+        nonlocal i
+        v = block[i] & ((1 << prefix) - 1)
+        i += 1
+        if v == (1 << prefix) - 1:
+            shift = 0
+            while True:
+                b = block[i]
+                i += 1
+                v += (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+        return v
+
+    def rdstr():
+        nonlocal i
+        n = rdint(7)
+        i += n
+
+    while i < len(block):
+        b = block[i]
+        if b & 0x80:
+            ops.append("idx")
+            rdint(7)
+        elif (b & 0xC0) == 0x40:
+            ops.append("incr")
+            if rdint(6) == 0:
+                rdstr()
+            rdstr()
+        elif (b & 0xE0) == 0x20:
+            ops.append("resize")
+            rdint(5)
+        else:
+            ops.append("lit")
+            if rdint(4) == 0:
+                rdstr()
+            rdstr()
+    return ops
 
 
 def test_interleaved_native_and_py_responses(mixed_server):
