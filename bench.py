@@ -5,6 +5,18 @@ lands this reports the flagship-model forward throughput on the real chip;
 once brpc_tpu.rpc + native core are in, this runs the echo benchmark
 (multi_threaded_echo analog) and reports QPS vs the reference's 500k QPS
 production claim (docs/en/overview.md:88).
+
+JSON schema (one line on stdout):
+  metric / value / unit / vs_baseline  — the headline figure
+  extra.*_qps                          — per-lane throughput
+  extra.native_latency_us              — per-lane tail latency from the
+      native log2 histograms (nat_stats.cpp), keyed by lane
+      (echo/http/redis/grpc/client), each {p50, p99, p999} in
+      microseconds measured parse-complete -> response-write (server
+      lanes) or call-begin -> completion (client lane)
+  extra.device_lanes                   — device-transport GB/s rows
+The process must exit 0: the artifact of record is untrustworthy if the
+bench dies at teardown (BENCH_r05 rc 139).
 """
 import json
 import sys

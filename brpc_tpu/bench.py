@@ -432,6 +432,14 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     # throughput number is recorded
     _loopback_stabilize()
 
+    # tail latency rides the native stat cells (nat_stats.cpp log2
+    # histograms): zero them so the per-lane percentiles reported at the
+    # end describe THIS run only
+    try:
+        native.stats_reset()
+    except Exception:
+        pass
+
     def _async_lane(port_, conns, window=256):
         """One async-windowed measurement; (qps, requests)."""
         out = ctypes.c_uint64(0)
@@ -600,6 +608,24 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # per-lane tail latency from the native log2 histograms (us): every
+    # loopback lane above ran in this process, so the combined cells hold
+    # echo/http/redis/grpc server latency (parse-complete -> response-
+    # write) and the client-lane round trips. Tracked round over round so
+    # a tail regression is visible even when qps holds.
+    native_latency_us = {}
+    try:
+        for idx, lane_name in enumerate(native.stats_lane_names()):
+            if not any(native.stats_hist(idx)):
+                continue
+            native_latency_us[lane_name] = {
+                "p50": round(native.stats_quantile(idx, 0.50) / 1e3, 1),
+                "p99": round(native.stats_quantile(idx, 0.99) / 1e3, 1),
+                "p999": round(native.stats_quantile(idx, 0.999) / 1e3, 1),
+            }
+    except Exception:
+        pass
+
     lanes = {"epoll": (fw["qps"], fw["requests"]),
              "io_uring": (ring_qps,
                           ring["requests"] if ring_qps > 0 else 0),
@@ -638,6 +664,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             "async_windowed_qps": round(async_qps, 1),
             "python_framework_qps": round(python_qps, 1),
             "bypass_ceiling_qps": round(bypass_qps, 1),
+            "native_latency_us": native_latency_us,
             "device_lanes": device_lanes,
             **http_lanes,
             **redis_lanes,

@@ -27,6 +27,14 @@ def _status_handler(server, req):
     ]
     for full, st in sorted(server.method_statuses().items()):
         lines.append(st.describe())
+    # native-runtime section (per-protocol counters + tail latency from
+    # the C++ stat cells) when native traffic exists
+    try:
+        from brpc_tpu.bvar.native_vars import native_status_lines
+
+        lines += native_status_lines()
+    except Exception:
+        pass
     return 200, "text/plain", "\n".join(lines) + "\n"
 
 

@@ -22,6 +22,7 @@ from brpc_tpu.bvar.latency_recorder import LatencyRecorder  # noqa: F401
 from brpc_tpu.bvar.multi_dimension import MultiDimension  # noqa: F401
 from brpc_tpu.bvar.sampler import force_tick_for_tests  # noqa: F401
 from brpc_tpu.bvar.default_variables import expose_default_variables  # noqa: F401
+from brpc_tpu.bvar.native_vars import register_native_bvars  # noqa: F401
 
 
 def expose_flags_as_bvars():

@@ -1,0 +1,177 @@
+"""Native-runtime bvars — the C++ stat cells surfaced as first-class vars.
+
+The native core (native/src/nat_stats.{h,cpp}) keeps cache-line-aligned
+per-thread cells of monotonic counters and log2 latency histograms, combined
+on demand like bvar's AgentCombiner. This module registers that snapshot
+surface into the Python bvar registry so native traffic appears in /vars,
+/status and /brpc_metrics beside the Python lanes — one pane of glass:
+
+- one PassiveStatus per counter under its native name (nat_*);
+- a PerSecond window (``<name>_second``) over each traffic counter, which
+  also gives the /vars?chart=1 SVG trend for free;
+- per-lane latency percentiles (``nat_<lane>_latency_p50/p99/p999_us``)
+  interpolated from the combined log2 histograms (percentile.h's role with
+  a deterministic histogram instead of a reservoir).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from brpc_tpu.bvar.variable import PassiveStatus, find_exposed
+from brpc_tpu.bvar.window import PerSecond
+
+_lock = threading.Lock()
+_registered = False
+_vars = []  # keep strong refs: exposed Variables must not be GC'd
+
+# one combined-snapshot call per dump, not one per counter: /vars and the
+# sampler tick read ~20 counters at once and each combine walks every cell
+_snap_cache = (0.0, None)
+
+
+def _snapshot() -> Dict[str, int]:
+    global _snap_cache
+    now = time.monotonic()
+    ts, snap = _snap_cache
+    if snap is None or now - ts > 0.25:
+        from brpc_tpu import native
+
+        snap = native.stats_counters()
+        _snap_cache = (now, snap)
+    return snap
+
+
+class _CounterSource:
+    """Quacks like an invertible Reducer so Window/PerSecond can sample
+    it: get_value() is the combined native counter."""
+
+    invertible = True
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def get_value(self) -> int:
+        return int(_snapshot().get(self._name, 0))
+
+
+# gauges / bookkeeping counters whose per-second delta is meaningless
+_NO_RATE = {"nat_py_queue_depth", "nat_spans_dropped",
+            "nat_connections_accepted"}
+
+_PCTS = (("p50", 0.50), ("p99", 0.99), ("p999", 0.999))
+
+
+def register_native_bvars() -> bool:
+    """Idempotently expose the native stat surface; False when the native
+    library is unavailable."""
+    global _registered
+    with _lock:
+        if _registered:
+            return True
+        try:
+            from brpc_tpu import native
+
+            if not native.available():
+                return False
+            names = native.stats_counter_names()
+            lanes = native.stats_lane_names()
+        except Exception:
+            return False
+        for name in names:
+            if find_exposed(name) is None:
+                _vars.append(PassiveStatus(
+                    lambda n=name: int(_snapshot().get(n, 0)), name))
+            if name not in _NO_RATE and \
+                    find_exposed(f"{name}_second") is None:
+                _vars.append(PerSecond(_CounterSource(name), 10,
+                                       f"{name}_second"))
+        for idx, lane in enumerate(lanes):
+            for suffix, q in _PCTS:
+                vname = f"nat_{lane}_latency_{suffix}_us"
+                if find_exposed(vname) is None:
+                    _vars.append(PassiveStatus(
+                        lambda i=idx, qq=q: round(
+                            _stats_quantile_us(i, qq), 1), vname))
+        _registered = True
+        return True
+
+
+def _stats_quantile_us(lane: int, q: float) -> float:
+    from brpc_tpu import native
+
+    return native.stats_quantile(lane, q) / 1e3
+
+
+def native_status_lines() -> List[str]:
+    """The /status page's native section: per-protocol traffic counters
+    and tail latency, empty when the native runtime never carried any."""
+    try:
+        from brpc_tpu import native
+
+        if not native.available():
+            return []
+        snap = native.stats_counters()
+        lanes = native.stats_lane_names()
+    except Exception:
+        return []
+    if not any(snap.values()):
+        return []
+    lines = ["", "native runtime:"]
+    lines.append(
+        f"  read_bytes: {snap.get('nat_socket_read_bytes', 0)}  "
+        f"write_bytes: {snap.get('nat_socket_write_bytes', 0)}  "
+        f"accepted: {snap.get('nat_connections_accepted', 0)}  "
+        f"py_queue_depth: {snap.get('nat_py_queue_depth', 0)}")
+    proto_keys = (("tpu_std", "nat_tpu_std"), ("http", "nat_http"),
+                  ("grpc", "nat_grpc"), ("redis", "nat_redis"),
+                  ("client", "nat_client"))
+    count_suffix = {"client": ("calls", "responses", "errors")}
+    for label, pfx in proto_keys:
+        s_in, s_out, s_err = count_suffix.get(
+            label, ("msgs_in", "responses_out", "errors"))
+        msgs = snap.get(f"{pfx}_{s_in}", 0)
+        if msgs == 0:
+            continue
+        lines.append(
+            f"  {label}: in={msgs} out={snap.get(f'{pfx}_{s_out}', 0)} "
+            f"errors={snap.get(f'{pfx}_{s_err}', 0)}")
+    for idx, lane in enumerate(lanes):
+        try:
+            from brpc_tpu import native as _n
+
+            if not any(_n.stats_hist(idx)):
+                continue
+            p50, p99, p999 = (_n.stats_quantile(idx, q) / 1e3
+                              for _, q in _PCTS)
+        except Exception:
+            continue
+        lines.append(f"  {lane}_latency_us: p50={p50:.1f} p99={p99:.1f} "
+                     f"p999={p999:.1f}")
+    return lines
+
+
+def reset_for_tests():
+    """Drop registration state (the exposed vars stay hidden-on-GC) and
+    zero the native cells."""
+    global _registered, _snap_cache
+    with _lock:
+        for v in _vars:
+            try:
+                if hasattr(v, "destroy"):
+                    v.destroy()
+                else:
+                    v.hide()
+            except Exception:
+                pass
+        _vars.clear()
+        _registered = False
+        _snap_cache = (0.0, None)
+    try:
+        from brpc_tpu import native
+
+        if native.available():
+            native.stats_reset()
+    except Exception:
+        pass

@@ -27,6 +27,26 @@ class NativeUnavailable(RuntimeError):
     pass
 
 
+class NatSpanRec(ctypes.Structure):
+    """Mirror of nat_stats.h NatSpanRec — one sampled native-handled call
+    (timestamps are CLOCK_MONOTONIC ns; see stats_now_ns for mapping)."""
+
+    _fields_ = [
+        ("trace_id", ctypes.c_uint64),
+        ("span_id", ctypes.c_uint64),
+        ("sock_id", ctypes.c_uint64),
+        ("recv_ns", ctypes.c_uint64),
+        ("parse_ns", ctypes.c_uint64),
+        ("dispatch_ns", ctypes.c_uint64),
+        ("write_ns", ctypes.c_uint64),
+        ("protocol", ctypes.c_int32),
+        ("error_code", ctypes.c_int32),
+        ("req_bytes", ctypes.c_uint32),
+        ("resp_bytes", ctypes.c_uint32),
+        ("method", ctypes.c_char * 48),
+    ]
+
+
 def _build() -> bool:
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
@@ -245,6 +265,31 @@ def load() -> ctypes.CDLL:
         lib.nat_shm_lane_set_timeout_ms.argtypes = [ctypes.c_int]
         lib.nat_shm_lane_set_timeout_ms.restype = ctypes.c_int
         lib.nat_shm_lane_workers.restype = ctypes.c_int
+        # -- native observability (nat_stats.cpp: per-thread stat cells,
+        #    log2 latency histograms, rpcz span ring) --
+        lib.nat_stats_counter_count.restype = ctypes.c_int
+        lib.nat_stats_counter_name.argtypes = [ctypes.c_int]
+        lib.nat_stats_counter_name.restype = ctypes.c_char_p
+        lib.nat_stats_counters.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        lib.nat_stats_counters.restype = ctypes.c_int
+        lib.nat_stats_lane_count.restype = ctypes.c_int
+        lib.nat_stats_lane_name.argtypes = [ctypes.c_int]
+        lib.nat_stats_lane_name.restype = ctypes.c_char_p
+        lib.nat_stats_hist_nbuckets.restype = ctypes.c_int
+        lib.nat_stats_hist.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        lib.nat_stats_hist.restype = ctypes.c_int
+        lib.nat_stats_hist_quantile.argtypes = [ctypes.c_int,
+                                                ctypes.c_double]
+        lib.nat_stats_hist_quantile.restype = ctypes.c_double
+        lib.nat_stats_enable_spans.argtypes = [ctypes.c_int]
+        lib.nat_stats_enable_spans.restype = None
+        lib.nat_stats_drain_spans.argtypes = [ctypes.POINTER(NatSpanRec),
+                                              ctypes.c_int]
+        lib.nat_stats_drain_spans.restype = ctypes.c_int
+        lib.nat_stats_reset.restype = None
+        lib.nat_stats_now_ns.restype = ctypes.c_uint64
         _lib = lib
         return lib
 
@@ -747,3 +792,94 @@ def rpc_client_bench(ip: str, port: int, nconn: int = 2,
                                       fibers_per_conn, seconds, payload,
                                       ctypes.byref(out_requests))
     return {"qps": qps, "requests": out_requests.value}
+
+
+# -- native observability (nat_stats.cpp) -----------------------------------
+
+def stats_counter_names() -> list:
+    """Names of the native monotonic counters, index-aligned with the
+    snapshot stats_counters() returns."""
+    lib = load()
+    n = lib.nat_stats_counter_count()
+    return [lib.nat_stats_counter_name(i).decode() for i in range(n)]
+
+
+def stats_counters() -> dict:
+    """Combined snapshot {name: value} of every native counter (per-thread
+    cells summed; gauges computed in place)."""
+    lib = load()
+    n = lib.nat_stats_counter_count()
+    arr = (ctypes.c_uint64 * n)()
+    got = lib.nat_stats_counters(arr, n)
+    return {lib.nat_stats_counter_name(i).decode(): arr[i]
+            for i in range(got)}
+
+
+def stats_lane_names() -> list:
+    """Latency-histogram lane names (echo/http/redis/grpc/client)."""
+    lib = load()
+    return [lib.nat_stats_lane_name(i).decode()
+            for i in range(lib.nat_stats_lane_count())]
+
+
+def stats_hist(lane: int) -> list:
+    """Combined log2-bucket latency histogram of one lane (counts; bucket
+    b covers [2^(b-1), 2^b) ns)."""
+    lib = load()
+    nb = lib.nat_stats_hist_nbuckets()
+    arr = (ctypes.c_uint64 * nb)()
+    got = lib.nat_stats_hist(lane, arr, nb)
+    return list(arr[:got])
+
+
+def stats_quantile(lane: int, q: float) -> float:
+    """Latency quantile (ns) over a lane's combined histogram,
+    interpolated inside the winning log2 bucket; 0.0 when empty."""
+    return load().nat_stats_hist_quantile(lane, q)
+
+
+def stats_enable_spans(every: int = 1):
+    """0 = spans off; N = record one of every N native-handled calls into
+    the bounded span ring (the bvar::Collector budget analog)."""
+    load().nat_stats_enable_spans(every)
+
+
+def stats_now_ns() -> int:
+    """The span clock (CLOCK_MONOTONIC ns) — subtract from time.time() to
+    map drained span timestamps onto wall time."""
+    return load().nat_stats_now_ns()
+
+
+def stats_drain_spans(max_spans: int = 4096) -> list:
+    """Drain up to max_spans native span records as dicts (consuming
+    them); timestamps are monotonic ns (see stats_now_ns)."""
+    lib = load()
+    arr = (NatSpanRec * max_spans)()
+    n = lib.nat_stats_drain_spans(arr, max_spans)
+    lanes = stats_lane_names()
+    out = []
+    for i in range(n):
+        r = arr[i]
+        lane_i = r.protocol
+        out.append({
+            "trace_id": r.trace_id,
+            "span_id": r.span_id,
+            "sock_id": r.sock_id,
+            "recv_ns": r.recv_ns,
+            "parse_ns": r.parse_ns,
+            "dispatch_ns": r.dispatch_ns,
+            "write_ns": r.write_ns,
+            "lane": lanes[lane_i] if 0 <= lane_i < len(lanes)
+                    else str(lane_i),
+            "error_code": r.error_code,
+            "req_bytes": r.req_bytes,
+            "resp_bytes": r.resp_bytes,
+            "method": r.method.decode(errors="replace"),
+        })
+    return out
+
+
+def stats_reset():
+    """Zero every stat cell and forget undrained spans (test/bench
+    hygiene only)."""
+    load().nat_stats_reset()

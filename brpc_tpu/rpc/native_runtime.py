@@ -207,6 +207,23 @@ class NativeRuntimeMount:
         self.port = native.rpc_server_start(ip, port,
                                             nworkers=0,
                                             native_echo=native_echo)
+        # one pane of glass: the C++ stat cells become bvars (/vars,
+        # /status, /brpc_metrics) and native spans drain into /rpcz
+        try:
+            from brpc_tpu.bvar.native_vars import register_native_bvars
+
+            register_native_bvars()
+        except Exception:
+            pass
+        try:
+            import brpc_tpu.rpcz  # noqa: F401  (defines the rpcz flags)
+            from brpc_tpu.butil import flags as _flags
+
+            if _flags.get_flag("enable_rpcz"):
+                native.stats_enable_spans(
+                    max(1, _flags.get_flag("rpcz_sample_every")))
+        except Exception:
+            pass
         # full protocol registry for the raw fallback lane: the native
         # port keeps the Python port's one-port-all-protocols capability
         protocols = list_server_protocols()

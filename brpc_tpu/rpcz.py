@@ -325,12 +325,62 @@ def _submit(span: Span):
         pass  # disk trouble must never fail the RPC path
 
 
+def drain_native_spans() -> int:
+    """Pull sampled span records out of the native runtime's bounded ring
+    (nat_stats.cpp) and file them with the Python spans, so /rpcz shows
+    native-handled calls beside the Python lanes. Native sampling already
+    applied the rpcz_sample_every stride, so records go straight into the
+    store. Returns the number drained."""
+    try:
+        from brpc_tpu import native
+
+        if not native.available():
+            return 0
+        recs = native.stats_drain_spans(4096)
+        if not recs:
+            return 0
+        # map CLOCK_MONOTONIC span timestamps onto wall time
+        offset = time.time() - native.stats_now_ns() / 1e9
+    except Exception:
+        return 0
+    for r in recs:
+        kind = "client" if r["lane"] == "client" else "server"
+        span = Span(kind, r["method"] or f"native.{r['lane']}",
+                    trace_id=r["trace_id"])
+        span.span_id = r["span_id"]
+        span.remote_side = f"native:{r['lane']}/sock={r['sock_id']}"
+        span.start_time = offset + r["recv_ns"] / 1e9
+        span.end_time = offset + r["write_ns"] / 1e9
+        span.error_code = r["error_code"]
+        span.request_size = r["req_bytes"]
+        span.response_size = r["resp_bytes"]
+        span.annotations = [
+            (offset + r["parse_ns"] / 1e9, "native parse done"),
+            (offset + r["dispatch_ns"] / 1e9, "native usercode done"),
+            (offset + r["write_ns"] / 1e9, "native response queued"),
+        ]
+        with _spans_lock:
+            _spans.append(span)
+        # persist like _submit does (sampling already happened native-side):
+        # the deque ages out in seconds under load, and find_trace recovers
+        # older spans from the disk store — native spans must be there too
+        try:
+            db = _get_span_db()
+            if db is not None:
+                db.append(span)
+        except Exception:
+            pass  # disk trouble must never fail the drain
+    return len(recs)
+
+
 def recent_spans(limit: int = 100) -> List[Span]:
+    drain_native_spans()
     with _spans_lock:
         return list(_spans)[-limit:]
 
 
 def find_trace(trace_id: int) -> List[Span]:
+    drain_native_spans()
     with _spans_lock:
         found = [s for s in _spans if s.trace_id == trace_id]
     # Merge with the on-disk SpanDB: parts of the trace may have aged out
@@ -350,6 +400,15 @@ def find_trace(trace_id: int) -> List[Span]:
 
 
 def clear_for_tests():
+    # flush the native ring too: stale native records must not resurface
+    # in a later test's /rpcz listing
+    try:
+        from brpc_tpu import native
+
+        if native.available():
+            native.stats_drain_spans(4096)
+    except Exception:
+        pass
     with _spans_lock:
         _spans.clear()
         _counter[0] = 0

@@ -72,7 +72,7 @@ static void bench_call_fiber(void* a) {
     int wrc = s->write(std::move(frame));
     // the socket ref pins the channel until the slot access is done
     if (wrc != 0) {
-      PendingCall* mine = ch->take_pending(cid);
+      PendingCall* mine = ch->take_pending(cid, /*ok=*/false);
       if (mine != nullptr) {
         pc_free(mine);
       } else {  // fail_all owns the completion; wait, then recycle
@@ -289,7 +289,7 @@ double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
                                     arg->att->size());
                 int wrc = s->write(std::move(frame));
                 if (wrc != 0) {
-                  PendingCall* mine = ch->take_pending(cid);
+                  PendingCall* mine = ch->take_pending(cid, /*ok=*/false);
                   if (mine != nullptr) {
                     pc_free(mine);
                   } else {

@@ -139,7 +139,7 @@ struct CallTimeout {
 
 static void call_timeout_work(void* raw) {
   CallTimeout* t = (CallTimeout*)raw;
-  PendingCall* pc = t->ch->take_pending(t->cid);
+  PendingCall* pc = t->ch->take_pending(t->cid, /*ok=*/false);
   if (pc != nullptr) {
     pc->error_code = kERPCTIMEDOUT;
     pc->error_text = "rpc timed out";
@@ -303,7 +303,7 @@ static int call_attempt(NatChannel* ch, NatSocket* s, const char* service,
     TimerThread::instance()->schedule(backup_fire, b, backup_ms);
   }
   if (s->write(std::move(frame)) != 0) {
-    PendingCall* mine = ch->take_pending(cid);
+    PendingCall* mine = ch->take_pending(cid, /*ok=*/false);
     if (mine != nullptr) {
       pc_free(mine);
     } else {
@@ -475,7 +475,7 @@ int nat_channel_acall(void* h, const char* service, const char* method,
   build_request_frame(&frame, cid, service, method, payload, payload_len,
                       nullptr, 0);
   if (s->write(std::move(frame)) != 0) {
-    PendingCall* mine = ch->take_pending(cid);  // s still pins the channel
+    PendingCall* mine = ch->take_pending(cid, /*ok=*/false);  // s still pins the channel
     if (mine != nullptr) {
       // not yet consumed: complete through the SAME callback path so the
       // caller observes exactly ONE completion (returning an error here

@@ -11,6 +11,8 @@
 // — correlation via FIFO order (HTTP/1.1 pipelining discipline) or the
 // h2 stream id, completion via the versioned-slot CAS, deadlines via the
 // native TimerThread, zero new correlation machinery.
+#include <algorithm>
+
 #include "nat_internal.h"
 
 namespace brpc_tpu {
@@ -285,6 +287,10 @@ struct H2CliSessN {
     int64_t send_window = 65535;
   };
   std::map<uint32_t, St> streams;
+  // graceful GOAWAY (RFC 7540 §6.8): streams <= goaway_last_sid are
+  // still served by the peer; no NEW streams may open (under mu)
+  bool goaway = false;
+  uint32_t goaway_last_sid = 0;
   uint32_t sends_since_sweep = 0;  // dead-stream sweep cadence (under mu)
   // CONTINUATION accumulation (reading thread only)
   uint32_t cont_sid = 0;
@@ -357,11 +363,23 @@ static int h2c_send_request(NatChannel* ch, NatSocket* s,
   data.push_back((char)(payload_len & 0xff));
   if (payload_len > 0) data.append(payload, payload_len);
 
-  std::lock_guard<std::mutex> g(h->mu);
+  std::unique_lock<std::mutex> g(h->mu);
   // stream-id space exhausted: fail the connection so the channel
-  // re-dials fresh (the reference marks the connection unwritable too)
+  // re-dials fresh (the reference marks the connection unwritable too).
+  // set_failed may sweep this session's streams (h2c_fail_own_streams
+  // locks h->mu), so it must run AFTER the unlock.
   if (h->next_sid > 0x7ffffffd) {
+    g.unlock();
     s->set_failed();
+    return kEFAILEDSOCKET;
+  }
+  // draining after GOAWAY: the peer will not serve new streams. In-flight
+  // streams <= last_stream_id keep completing; once none remain the
+  // socket is failed so the channel re-dials.
+  if (h->goaway) {
+    bool drained = h->streams.empty();
+    g.unlock();
+    if (drained) s->set_failed();
     return kEFAILEDSOCKET;
   }
   if (++h->sends_since_sweep >= 512) {
@@ -405,10 +423,35 @@ static int h2c_send_request(NatChannel* ch, NatSocket* s,
   return 0;
 }
 
+void h2c_fail_own_streams(NatSocket* s, int32_t code, const char* text) {
+  H2CliSessN* h = s->h2c;
+  NatChannel* ch = s->channel;
+  if (h == nullptr || ch == nullptr) return;
+  std::vector<int64_t> cids;
+  {
+    std::lock_guard<std::mutex> g(h->mu);
+    for (auto& kv : h->streams) cids.push_back(kv.second.cid);
+    h->streams.clear();
+  }
+  for (int64_t cid : cids) {
+    PendingCall* pc = ch->take_pending(cid, /*ok=*/false);
+    if (pc == nullptr) continue;
+    pc->error_code = code;
+    pc->error_text = text;
+    if (pc->cb != nullptr) {
+      pc->cb(pc, pc->cb_arg);
+    } else {
+      pc->done.value.store(1, std::memory_order_release);
+      Scheduler::butex_wake(&pc->done, INT32_MAX);
+    }
+  }
+}
+
 // END_STREAM arrived: extract (grpc-status, message, payload), complete.
 static void h2c_complete(NatSocket* s, H2CliSessN* h, uint32_t sid) {
   int64_t cid;
   std::string flat, data;
+  bool drained = false;
   {
     std::lock_guard<std::mutex> g(h->mu);
     auto it = h->streams.find(sid);
@@ -417,7 +460,11 @@ static void h2c_complete(NatSocket* s, H2CliSessN* h, uint32_t sid) {
     flat = std::move(it->second.flat);
     data = std::move(it->second.data);
     h->streams.erase(it);
+    drained = h->goaway && h->streams.empty();
   }
+  // last permitted stream after a graceful GOAWAY: retire the socket so
+  // the channel re-dials instead of queueing calls a peer won't serve
+  if (drained) s->set_failed();
   NatChannel* ch = s->channel;
   PendingCall* pc = ch != nullptr ? ch->take_pending(cid) : nullptr;
   if (pc == nullptr) return;
@@ -608,7 +655,8 @@ int h2_client_process(NatSocket* s, IOBuf* batch_out) {
           h->streams.erase(it);
         }
         NatChannel* ch = s->channel;
-        PendingCall* pc = ch != nullptr ? ch->take_pending(cid) : nullptr;
+        PendingCall* pc =
+            ch != nullptr ? ch->take_pending(cid, /*ok=*/false) : nullptr;
         if (pc != nullptr) {
           pc->error_code = kEFAILEDSOCKET;
           pc->error_text = "stream reset by server";
@@ -621,8 +669,62 @@ int h2_client_process(NatSocket* s, IOBuf* batch_out) {
         }
         break;
       }
-      case kCFGoaway:
-        return 0;  // fail the socket; fail_all completes pending calls
+      case kCFGoaway: {
+        // Graceful drain (ADVICE r5): streams <= last_stream_id will
+        // still be served — keep them, fail only streams above it, and
+        // stop opening new streams. A non-NO_ERROR GOAWAY still fails
+        // the whole socket (fail_all completes pending calls).
+        if (flen < 8) return 0;
+        uint32_t last_sid = (((uint32_t)p[0] & 0x7f) << 24) |
+                            ((uint32_t)p[1] << 16) |
+                            ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+        uint32_t err_code = ((uint32_t)p[4] << 24) | ((uint32_t)p[5] << 16) |
+                            ((uint32_t)p[6] << 8) | (uint32_t)p[7];
+        if (err_code != 0) return 0;
+        std::vector<int64_t> refused;
+        bool drained;
+        {
+          std::lock_guard<std::mutex> g(h->mu);
+          // repeated GOAWAYs may only shrink the permitted window
+          // (RFC 7540 §6.8: last_sid must not increase across frames)
+          h->goaway_last_sid =
+              h->goaway ? std::min(h->goaway_last_sid, last_sid) : last_sid;
+          h->goaway = true;
+          for (auto it = h->streams.begin(); it != h->streams.end();) {
+            if (it->first > h->goaway_last_sid) {
+              refused.push_back(it->second.cid);
+              it = h->streams.erase(it);
+            } else {
+              ++it;
+            }
+          }
+          drained = h->streams.empty();
+        }
+        NatChannel* ch = s->channel;
+        // detach this socket from the channel NOW: new calls dial a
+        // fresh connection immediately instead of hard-failing for the
+        // whole drain window, while the permitted streams finish here
+        if (ch != nullptr) {
+          uint64_t expect = s->id;
+          ch->sock_id.compare_exchange_strong(expect, 0);
+        }
+        for (int64_t cid : refused) {
+          PendingCall* pc = ch != nullptr
+                                ? ch->take_pending(cid, /*ok=*/false)
+                                : nullptr;
+          if (pc == nullptr) continue;
+          pc->error_code = kEFAILEDSOCKET;
+          pc->error_text = "stream refused by GOAWAY";
+          if (pc->cb != nullptr) {
+            pc->cb(pc, pc->cb_arg);
+          } else {
+            pc->done.value.store(1, std::memory_order_release);
+            Scheduler::butex_wake(&pc->done, INT32_MAX);
+          }
+        }
+        if (drained) return 0;  // nothing left to serve: recycle now
+        break;
+      }
       case kCFPushPromise:
         return 0;  // we never enable push
       case kCFHeaders: {
@@ -830,7 +932,7 @@ static int harvest_sync(NatChannel* ch, PendingCall* pc, int* aux_out,
 // On send failure: complete/reap the call exactly once (fail_all may
 // have consumed it already).
 static void reap_failed_send(NatChannel* ch, PendingCall* pc, int64_t cid) {
-  PendingCall* mine = ch->take_pending(cid);
+  PendingCall* mine = ch->take_pending(cid, /*ok=*/false);
   if (mine != nullptr) {
     pc_free(mine);
     return;
@@ -887,7 +989,7 @@ int nat_http_acall(void* h, const char* verb, const char* path,
   if (rc != 0) {
     // complete through the callback exactly once (unless fail_all
     // already swept the cid and fired it)
-    PendingCall* mine = ch->take_pending(cid);
+    PendingCall* mine = ch->take_pending(cid, /*ok=*/false);
     if (mine != nullptr) {
       mine->error_code = rc;
       mine->error_text = "socket failed before write";
@@ -940,7 +1042,7 @@ int nat_grpc_acall(void* h, const char* path, const char* payload,
   int rc = h2c_send_request(ch, s, path, payload, payload_len, cid);
   if (rc != 0) {
     // complete through the callback exactly once (unless fail_all did)
-    PendingCall* mine = ch->take_pending(cid);
+    PendingCall* mine = ch->take_pending(cid, /*ok=*/false);
     if (mine != nullptr) {
       mine->error_code = rc;
       mine->error_text = "socket failed before write";

@@ -389,6 +389,7 @@ struct H2StreamN {
   bool end_stream = false;
   bool dispatched = false;  // usercode ran; later frames on the sid drop
   int64_t send_window = 65535;  // for OUR DATA on this stream
+  uint64_t recv_ns = 0;  // HEADERS decoded (span timeline anchor)
 };
 
 // Encoder-side HPACK dynamic table (the reference keeps one in
@@ -535,6 +536,7 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
                        const char* grpc_message, IOBuf* batch_out) {
   H2SessionN* h = s->h2;
   if (h == nullptr) return;
+  nat_counter_add(NS_H2_RESPONSES_OUT, 1);
   // response headers: dynamic-table encoded on the reading thread
   // (wire-ordered), static-encoded from py threads (order-independent)
   std::string hdr_block;
@@ -650,6 +652,7 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
                         IOBuf* batch_out) {
   NatServer* srv = s->server;
   std::string path, flat, data;
+  uint64_t t_recv;
   {
     std::lock_guard<std::mutex> g(h->mu);
     auto it = h->streams.find(sid);
@@ -659,9 +662,11 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
     path = std::move(it->second.path);
     flat = std::move(it->second.flat_headers);
     data = std::move(it->second.data);
+    t_recv = it->second.recv_ns;
     // entry stays (send windows) until the response goes out
   }
   srv->requests.fetch_add(1, std::memory_order_relaxed);
+  nat_counter_add(NS_H2_MSGS_IN, 1);
   // native handler: "/EchoService/Echo" -> "EchoService.Echo"
   if (!srv->handlers.empty() && path.size() > 1) {
     size_t slash = path.find('/', 1);
@@ -684,16 +689,27 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
               payload.append(data.data() + 5, mlen);
             }
           }
+          uint64_t t_parse = nat_now_ns();
+          uint32_t req_bytes = (uint32_t)payload.length();
           NativeHandlerCtx ctx;
           ctx.req_payload = &payload;
           ctx.req_attachment = &attachment;
           (*hit)(ctx);
+          uint64_t t_dispatch = nat_now_ns();
           std::string resp = ctx.resp_payload.to_string();
           h2_respond(s, sid, resp.data(), resp.size(),
                      ctx.error_code == 0 ? 0 : 2,
                      ctx.error_text.empty() ? nullptr
                                             : ctx.error_text.c_str(),
                      batch_out);
+          uint64_t t_write = nat_now_ns();
+          nat_lat_record(NL_GRPC, t_write - t_parse);
+          if (nat_span_tick()) {
+            nat_span_record(NL_GRPC, s->id, path.data(), path.size(),
+                            t_recv != 0 ? t_recv : t_parse, t_parse,
+                            t_dispatch, t_write, ctx.error_code, req_bytes,
+                            (uint32_t)resp.size());
+          }
           return;
         }
       }
@@ -740,6 +756,7 @@ static bool h2_headers_complete(NatSocket* s, H2SessionN* h, uint32_t sid,
       st.path = std::move(path);
       st.headers_done = true;
       st.send_window = h->peer_initial_window;
+      st.recv_ns = nat_now_ns();
     }
     st.end_stream = end_stream;
   }

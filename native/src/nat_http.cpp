@@ -89,6 +89,7 @@ static void http_emit_response(NatSocket* s, uint64_t seq, std::string data,
                                bool close, IOBuf* batch_out) {
   HttpSessionN* h = s->http;
   if (h == nullptr) return;
+  nat_counter_add(NS_HTTP_RESPONSES_OUT, 1);
   std::string out;
   bool want_close = false;
   bool wrote = false;
@@ -219,6 +220,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
       break;                                     // need more bytes
     }
     size_t hdr_len = (size_t)(hdr_end - scan);
+    uint64_t t_recv = nat_now_ns();  // request head fully buffered
     // request line: VERB SP URI SP VERSION
     const char* sp1 = (const char*)memchr(scan, ' ', hdr_len);
     if (sp1 == nullptr) return 0;
@@ -334,11 +336,13 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
       total = body_start + content_length;
     }
     // dispatch
+    uint64_t t_parse = nat_now_ns();  // head + body parsed
     uint64_t seq = h->next_req_seq++;
     h->continue_sent = false;  // this request is complete
     bool head_only = verb == "HEAD";
     std::string_view path = uri.substr(0, uri.find('?'));
     srv->requests.fetch_add(1, std::memory_order_relaxed);
+    nat_counter_add(NS_HTTP_MSGS_IN, 1);
     auto nit = srv->http_handlers.find(path);
     if (nit != srv->http_handlers.end()) {
       // native usercode, inline (builtin-service discipline)
@@ -358,6 +362,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         }
       }
       nit->second(ctx);
+      uint64_t t_dispatch = nat_now_ns();
       std::string resp_bytes;
       std::string resp_body = ctx.resp_body.to_string();
       build_http_response(&resp_bytes, ctx.status, ctx.content_type,
@@ -366,8 +371,29 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         std::lock_guard<std::mutex> g(h->mu);
         h->close_seqs.push_back(seq);
       }
+      // capture the span method BEFORE pop_front: `path` may view into
+      // in_buf's own blocks (fetch's zero-copy case) which the pop
+      // recycles
+      bool take_span = nat_span_tick();
+      char span_path[48];
+      size_t span_path_n = 0;
+      if (take_span) {
+        span_path_n = path.size() < sizeof(span_path) ? path.size()
+                                                      : sizeof(span_path);
+        memcpy(span_path, path.data(), span_path_n);
+      }
       s->in_buf.pop_front(total);
+      uint32_t req_bytes = (uint32_t)ctx.body.size();
+      uint32_t out_bytes = (uint32_t)resp_bytes.size();
       http_emit_response(s, seq, std::move(resp_bytes), false, batch_out);
+      uint64_t t_write = nat_now_ns();
+      nat_lat_record(NL_HTTP, t_write - t_parse);
+      if (take_span) {
+        nat_span_record(NL_HTTP, s->id, span_path, span_path_n, t_recv,
+                        t_parse, t_dispatch, t_write,
+                        ctx.status >= 400 ? ctx.status : 0, req_bytes,
+                        out_bytes);
+      }
       if (s->failed.load(std::memory_order_acquire) ||
           s->close_after_drain.load(std::memory_order_acquire)) {
         break;

@@ -38,6 +38,7 @@
 #include <vector>
 
 #include "iobuf.h"
+#include "nat_stats.h"
 #include "ring_listener.h"
 #include "rpc_meta.h"
 #include "scheduler.h"
@@ -197,7 +198,7 @@ inline constexpr uint32_t kSockSlabs = 1024;                    // 1M max
 // no reader can observe a half-constructed NatSocket (ADVICE r3 #1)
 extern std::atomic<std::atomic<NatSocket*>*> g_sock_slab[kSockSlabs];
 extern std::mutex g_sock_alloc_mu;
-extern std::vector<uint32_t> g_sock_free;
+extern std::vector<uint32_t>& g_sock_free;  // leaked: see nat_socket.cpp
 extern uint32_t g_sock_next_idx;
 
 inline NatSocket* sock_at(uint32_t idx) {
@@ -248,7 +249,7 @@ class Dispatcher {
 };
 
 // Dispatcher pool (-event_dispatcher_num analog, event_dispatcher.cpp:30)
-extern std::vector<Dispatcher*> g_disps;
+extern std::vector<Dispatcher*>& g_disps;  // leaked: see nat_server.cpp
 extern Dispatcher* g_disp;  // g_disps[0]: listeners + console
 extern NatServer* g_rpc_server;
 extern std::mutex g_rt_mu;
@@ -394,6 +395,9 @@ class NatServer {
   bool py_stopping = false;
 
   void enqueue_py(PyRequest* r) {
+    // kind 2 is a connection-drop control message, not work handed to
+    // Python usercode — it must not inflate nat_py_dispatches
+    if (r->kind != 2) nat_counter_add(NS_PY_DISPATCHES, 1);
     // worker-process lane first (kinds 3/4 when enabled): usercode runs
     // across N interpreters instead of behind this process's GIL
     if ((r->kind == 3 || r->kind == 4) && shm_lane_offer(r)) return;
@@ -470,6 +474,9 @@ struct PendingCall {
   uint32_t slot_idx = 0;
   uint32_t next_free = 0;  // freelist link, encoded idx+1
   std::atomic<uint64_t> state{0};  // (version << 1) | pending_bit
+  // call-begin timestamp (nat_stats client-lane latency: the round trip
+  // lands in NL_CLIENT when the completion wins take_pending)
+  uint64_t start_ns = 0;
 };
 
 void pc_free(PendingCall* pc);  // returns the slot to its channel
@@ -540,6 +547,8 @@ class NatChannel {
     pc->cb_arg = cb_arg;
     pc->owner = this;
     pc->slot_idx = idx;
+    pc->start_ns = nat_now_ns();
+    nat_counter_add(NS_CLIENT_CALLS, 1);
     // everything above must be visible before the pending bit: a racing
     // fail_all completes through cb/butex the instant it sees the bit
     pc->state.store((version << 1) | 1, std::memory_order_release);
@@ -559,13 +568,24 @@ class NatChannel {
 
   // CAS the pending bit off; the winner owns the call. Stale cids (old
   // version) and double-completions lose the CAS and get nullptr.
-  PendingCall* take_pending(int64_t cid) {
+  // `ok=false` marks an error completion (timeout, failed send, refused
+  // stream): counted into nat_client_errors and kept OUT of the client
+  // latency histogram — a 30s timeout is not a round trip.
+  PendingCall* take_pending(int64_t cid, bool ok = true) {
     uint32_t idx = (uint32_t)cid & kIdxMask;
     if (idx >= nslots_.load(std::memory_order_acquire)) return nullptr;
     PendingCall* pc = slot_at(idx);
     uint64_t expected = (((uint64_t)cid >> kIdxBits) << 1) | 1;
     if (pc->state.compare_exchange_strong(expected, expected & ~1ull,
                                           std::memory_order_acq_rel)) {
+      if (ok) {
+        nat_counter_add(NS_CLIENT_RESPONSES, 1);
+        if (pc->start_ns != 0) {
+          nat_lat_record(NL_CLIENT, nat_now_ns() - pc->start_ns);
+        }
+      } else {
+        nat_counter_add(NS_CLIENT_ERRORS, 1);
+      }
       return pc;
     }
     return nullptr;
@@ -581,6 +601,7 @@ class NatChannel {
                                              std::memory_order_acq_rel)) {
         continue;  // a response beat us to it
       }
+      nat_counter_add(NS_CLIENT_ERRORS, 1);
       pc->error_code = code;
       pc->error_text = text;
       if (pc->cb != nullptr) {
@@ -732,6 +753,11 @@ int http_client_process(NatSocket* s);
 int h2_client_process(NatSocket* s, IOBuf* batch_out);
 void http_cli_free(HttpCliSessN* c);
 void h2_cli_free(H2CliSessN* c);
+// Fail ONLY the pending calls whose streams still ride this socket's h2
+// client session (used when a GOAWAY-drained socket dies after the
+// channel has already moved to a replacement — a channel-wide fail_all
+// would spuriously kill calls in flight on the new socket).
+void h2c_fail_own_streams(NatSocket* s, int32_t code, const char* text);
 // Attach the channel's protocol session to a (re)dialed socket; for h2
 // this also queues the connection preface + SETTINGS.
 void channel_attach_client_session(NatChannel* ch, NatSocket* s);

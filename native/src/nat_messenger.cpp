@@ -14,6 +14,7 @@ namespace brpc_tpu {
 void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
                           const std::string& error_text, IOBuf&& payload,
                           IOBuf&& attachment) {
+  nat_counter_add(NS_TPU_STD_RESPONSES_OUT, 1);
   size_t bound = 12 + response_meta_bound(error_text.size());
   char stack_buf[320];
   char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
@@ -397,6 +398,7 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
       break;
     }
     if (s->in_buf.length() < 12 + (size_t)body) break;
+    uint64_t t_recv = nat_now_ns();  // frame fully buffered
     s->in_buf.pop_front(12);
     // decode straight from the buffer (fetch: contiguous view or stack
     // copy; meta blobs are tens of bytes — no heap string per frame)
@@ -478,14 +480,38 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
 
     if (srv != nullptr) {
       srv->requests.fetch_add(1, std::memory_order_relaxed);
+      nat_counter_add(NS_TPU_STD_MSGS_IN, 1);
       if (handler != nullptr) {
+        uint64_t t_parse = nat_now_ns();  // meta decoded, payload cut
         NativeHandlerCtx ctx;
         ctx.req_payload = &payload;
         ctx.req_attachment = &attachment;
+        uint32_t req_bytes = (uint32_t)(payload_size + att_size);
         (*handler)(ctx);
+        uint64_t t_dispatch = nat_now_ns();
+        uint32_t resp_bytes =
+            (uint32_t)(ctx.resp_payload.length() +
+                       ctx.resp_attachment.length());
         build_response_frame(&batch_out, meta.correlation_id, ctx.error_code,
                              ctx.error_text, std::move(ctx.resp_payload),
                              std::move(ctx.resp_attachment));
+        uint64_t t_write = nat_now_ns();
+        nat_lat_record(NL_ECHO, t_write - t_parse);
+        if (nat_span_tick()) {
+          char m[256];
+          const std::string& sn = meta.request.service_name;
+          const std::string& mn = meta.request.method_name;
+          size_t ml = 0;
+          if (sn.size() + mn.size() + 1 <= sizeof(m)) {
+            memcpy(m, sn.data(), sn.size());
+            m[sn.size()] = '.';
+            memcpy(m + sn.size() + 1, mn.data(), mn.size());
+            ml = sn.size() + 1 + mn.size();
+          }
+          nat_span_record(NL_ECHO, s->id, m, ml, t_recv, t_parse,
+                          t_dispatch, t_write, ctx.error_code, req_bytes,
+                          resp_bytes);
+        }
       } else if (srv->py_lane_enabled) {
         PyRequest* r = new PyRequest();
         r->sock_id = s->id;
@@ -505,6 +531,20 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
     }
   }
 flush:
+  if (!ok) {
+    // attribute the protocol error to the lane that owned the connection;
+    // client sockets get nothing HERE — nat_client_errors counts failed
+    // CALLS (fail_all / take_pending(ok=false) charge each one when the
+    // dying socket sweeps them), so a socket-level increment on top would
+    // double-count and break calls == responses + errors
+    if (s->channel == nullptr || s->server != nullptr) {
+      int err_id = s->h2 != nullptr      ? NS_H2_ERRORS
+                   : s->http != nullptr  ? NS_HTTP_ERRORS
+                   : s->redis != nullptr ? NS_REDIS_ERRORS
+                                         : NS_TPU_STD_ERRORS;
+      nat_counter_add(err_id, 1);
+    }
+  }
   if (!batch_out.empty()) {
     if (defer_out != nullptr) {
       defer_out->append(std::move(batch_out));
@@ -550,6 +590,7 @@ bool drain_socket_inline(NatSocket* s) {
       }
       n = ::read(s->fd, r->big_payload + s->fill_off, want);
       if (n > 0) {
+        nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)n);
         s->fill_off += (size_t)n;
         if (s->fill_off == r->big_len) {
           s->fill_req = nullptr;
@@ -576,6 +617,7 @@ bool drain_socket_inline(NatSocket* s) {
       n = s->in_buf.append_from_fd(s->fd, 65536);
     }
     if (n > 0) {
+      nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)n);
       if (!process_input(s, &acc)) {
         dead = true;
         break;

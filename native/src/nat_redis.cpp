@@ -111,6 +111,7 @@ static void redis_drain_locked(RedisSessN* h, std::string* out,
 // only on the reading thread.
 static void redis_emit(NatSocket* s, RedisSessN* h, uint64_t seq,
                        std::string&& reply, IOBuf* batch_out) {
+  nat_counter_add(NS_REDIS_RESPONSES_OUT, 1);
   std::string out;
   bool want_close = false;
   {
@@ -382,7 +383,12 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
     }
     size_t pos = (size_t)(nl - p) + 1;
     std::vector<std::string> argv;
-    argv.reserve((size_t)nargs);
+    // cap by what the buffered bytes could possibly hold ("$0\r\n\r\n" is
+    // 4+ bytes/arg): a 14-byte "*1048576\r\n$1\r\nx" must not force a
+    // ~32MB reservation every parse round (ADVICE r5)
+    size_t max_plausible = avail / 4;
+    argv.reserve((size_t)nargs < max_plausible ? (size_t)nargs
+                                               : max_plausible);
     bool complete = true;
     size_t need = 0;  // known minimum total size of this command
     for (long i = 0; i < nargs; i++) {
@@ -427,6 +433,7 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
     }
     consumed += pos;
     srv->requests.fetch_add(1, std::memory_order_relaxed);
+    nat_counter_add(NS_REDIS_MSGS_IN, 1);
     uint64_t seq = h->next_req_seq++;
 
     // QUIT: +OK, then close once that reply has drained to the socket
@@ -442,8 +449,20 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
     }
     if (srv->native_redis == 2 && srv->redis_store != nullptr) {
       std::string reply;
+      uint64_t t_parse = nat_now_ns();  // command cut, about to execute
       if (store_execute(srv->redis_store, argv, &reply)) {
+        uint64_t t_dispatch = nat_now_ns();
+        uint32_t req_b = (uint32_t)pos;
+        uint32_t resp_b = (uint32_t)reply.size();
+        bool is_err = !reply.empty() && reply[0] == '-';
         redis_emit(s, h, seq, std::move(reply), batch_out);
+        uint64_t t_write = nat_now_ns();
+        nat_lat_record(NL_REDIS, t_write - t_parse);
+        if (nat_span_tick()) {
+          nat_span_record(NL_REDIS, s->id, argv[0].data(), argv[0].size(),
+                          t_parse, t_parse, t_dispatch, t_write,
+                          is_err ? 1 : 0, req_b, resp_b);
+        }
         continue;
       }
     }

@@ -82,6 +82,7 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
     s->server = srv;
     srv->add_ref();  // released when the socket slot is recycled
     srv->connections.fetch_add(1);
+    nat_counter_add(NS_CONNECTIONS_ACCEPTED, 1);
     if (try_ring_adopt(s)) continue;  // the ring owns this read path
     s->disp->add_consumer(s);
   }
@@ -167,7 +168,10 @@ void Dispatcher::run() {
 // sockets are sharded round-robin across N independent epoll loops so the
 // inline read/process path scales past one core. Listeners live on
 // loop 0; accepted/connected sockets go to the next loop in turn.
-std::vector<Dispatcher*> g_disps;
+// Leaked on purpose: dispatcher/worker threads run through exit() and
+// pick_dispatcher() must never read a destructed vector (the bench-exit
+// SIGSEGV class, BENCH_r05 rc 139).
+std::vector<Dispatcher*>& g_disps = *new std::vector<Dispatcher*>();
 Dispatcher* g_disp = nullptr;  // g_disps[0]: listeners + console
 NatServer* g_rpc_server = nullptr;
 std::mutex g_rt_mu;
@@ -222,6 +226,17 @@ int nat_rpc_set_dispatchers(int n) {
   return g_disps.empty() ? g_disp_count : (int)g_disps.size();
 }
 
+// PassiveStatus-style gauge (nat_stats): depth of the running server's
+// py-lane queue at snapshot time. Called only from the stats C API with
+// no runtime locks held.
+static uint64_t py_queue_depth_gauge() {
+  std::lock_guard<std::mutex> g(g_rt_mu);
+  NatServer* srv = g_rpc_server;
+  if (srv == nullptr) return 0;
+  std::lock_guard<std::mutex> g2(srv->py_mu);
+  return (uint64_t)srv->py_q.size();
+}
+
 // Start the native RPC server. enable_native_echo registers the built-in
 // EchoService.Echo handler (zero-copy: response payload/attachment share
 // the request's IOBuf blocks). Python services ride the py lane.
@@ -232,6 +247,7 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
     if (g_rpc_server != nullptr) return -1;
   }
   if (ensure_runtime(nworkers) != 0) return -1;
+  nat_stats_register_gauge(NS_PY_QUEUE_DEPTH, py_queue_depth_gauge);
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -1;
   int one = 1;

@@ -207,7 +207,11 @@ ShmSeg* g_seg = nullptr;
 size_t g_seg_total = 0;
 bool g_seg_unlinked = false;
 char g_seg_name[64];
-std::thread g_resp_drainer;
+// Heap-held (leaked when never disabled): a global std::thread whose
+// destructor runs at exit() while still joinable calls std::terminate,
+// and the drainer must anyway never touch destructed globals (the
+// bench-exit crash class, BENCH_r05 rc 139).
+std::thread* g_resp_drainer = nullptr;
 std::atomic<bool> g_lane_enabled{false};
 std::atomic<bool> g_drainer_stop{false};
 
@@ -230,7 +234,9 @@ struct InflightEntry {
   std::chrono::steady_clock::time_point deadline;
 };
 std::mutex g_inflight_mu;
-std::map<InflightKey, InflightEntry> g_inflight;
+// leaked: the reaper/drainer may outrun static destruction at exit()
+std::map<InflightKey, InflightEntry>& g_inflight =
+    *new std::map<InflightKey, InflightEntry>();
 std::atomic<int> g_reap_timeout_ms{30000};
 
 ShmRing* req_ring() {
@@ -434,14 +440,17 @@ int nat_shm_lane_enable(int enable) {
       g_inflight.clear();
     }
     g_drainer_stop.store(false);
-    g_resp_drainer = std::thread(resp_drainer_loop);
+    delete g_resp_drainer;
+    g_resp_drainer = new std::thread(resp_drainer_loop);
     g_lane_enabled.store(true, std::memory_order_release);
   } else if (enable == 0 && g_lane_enabled.load()) {
     g_lane_enabled.store(false, std::memory_order_release);
     ring_shutdown(req_ring());
     ring_shutdown(resp_ring());
     g_drainer_stop.store(true);
-    if (g_resp_drainer.joinable()) g_resp_drainer.join();
+    if (g_resp_drainer != nullptr && g_resp_drainer->joinable()) {
+      g_resp_drainer->join();
+    }
     if (!g_seg_unlinked) {
       shm_unlink(g_seg_name);
       g_seg_unlinked = true;

@@ -16,7 +16,9 @@ namespace brpc_tpu {
 
 std::atomic<std::atomic<NatSocket*>*> g_sock_slab[kSockSlabs];
 std::mutex g_sock_alloc_mu;
-std::vector<uint32_t> g_sock_free;
+// Leaked on purpose: fibers on detached workers allocate/release socket
+// slots through exit(); a destructed free list here is a use-after-free.
+std::vector<uint32_t>& g_sock_free = *new std::vector<uint32_t>();
 uint32_t g_sock_next_idx = 0;
 
 // Allocate (or reuse) a socket slot; the returned socket has refcount 1
@@ -101,7 +103,9 @@ RingListener* g_ring = nullptr;
 std::atomic<bool> g_use_ring{false};
 std::atomic<bool> g_ring_draining{false};
 static std::mutex g_ring_retry_mu;
-static std::vector<uint64_t> g_ring_retry;  // sockets w/ unsubmitted sends
+// sockets w/ unsubmitted sends; leaked — the ring poller and workers may
+// still push retries while exit() destroys statics
+static std::vector<uint64_t>& g_ring_retry = *new std::vector<uint64_t>();
 
 void NatSocket::release() {
   uint64_t prev = versioned_ref.fetch_sub(1, std::memory_order_acq_rel);
@@ -227,13 +231,20 @@ void NatSocket::set_failed() {
     server->enqueue_py(r);
   }
   if (channel != nullptr) {
-    channel->fail_all(kEFAILEDSOCKET, "socket failed");
-    if (channel->health_check_interval_ms > 0 &&
-        !channel->closed.load(std::memory_order_acquire) &&
-        !channel->hc_pending.exchange(true, std::memory_order_acq_rel)) {
-      channel->add_ref();  // held by the revival chain
-      TimerThread::instance()->schedule(health_check_fire, channel,
-                                        channel->health_check_interval_ms);
+    if (channel->sock_id.load(std::memory_order_acquire) == id) {
+      channel->fail_all(kEFAILEDSOCKET, "socket failed");
+      if (channel->health_check_interval_ms > 0 &&
+          !channel->closed.load(std::memory_order_acquire) &&
+          !channel->hc_pending.exchange(true, std::memory_order_acq_rel)) {
+        channel->add_ref();  // held by the revival chain
+        TimerThread::instance()->schedule(health_check_fire, channel,
+                                          channel->health_check_interval_ms);
+      }
+    } else {
+      // already detached (GOAWAY drain): the channel's other pendings
+      // ride the replacement socket and must survive — fail only the
+      // streams this socket still owns
+      h2c_fail_own_streams(this, kEFAILEDSOCKET, "socket failed");
     }
   }
   if (server != nullptr) server->connections.fetch_sub(1);
@@ -283,6 +294,7 @@ bool NatSocket::flush_some() {
     }
     while (!batch.empty()) {
       ssize_t n = batch.cut_into_fd(fd);
+      if (n > 0) nat_counter_add(NS_SOCK_WRITE_BYTES, (uint64_t)n);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
           // put leftovers back at the FRONT (later writes are behind us)
@@ -447,6 +459,7 @@ bool ring_drain() {
     if (c.kind == 0) {  // recv
       if (c.res > 0) {
         if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
+          nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)c.res);
           if (s->ssl_sess != nullptr) {
             // TLS: ciphertext feeds the session; plaintext lands in
             // in_buf inside ssl_feed
@@ -515,6 +528,7 @@ bool ring_drain() {
             std::lock_guard<std::mutex> g(s->write_mu);
             size_t done = (size_t)c.res;
             if (done > s->ring_inflight) done = s->ring_inflight;
+            nat_counter_add(NS_SOCK_WRITE_BYTES, done);
             s->write_q.pop_front(done);
             s->ring_sending = false;
             s->ring_inflight = 0;

@@ -1,0 +1,311 @@
+// nat_stats — cell registry, combiner, span ring, and the extern "C"
+// snapshot surface consumed by brpc_tpu/native via ctypes (the /vars,
+// /brpc_metrics and /rpcz data source for native traffic). See nat_stats.h
+// for the design map to bvar.
+#include "nat_stats.h"
+
+#include <mutex>
+
+namespace brpc_tpu {
+
+// ---------------------------------------------------------------------------
+// cell registry — cells are never freed (an exited thread's monotonic
+// counts must keep contributing to the combined totals, exactly like
+// bvar's global combiner keeps exited agents' sums)
+// ---------------------------------------------------------------------------
+
+static constexpr int kMaxCells = 512;
+static std::atomic<NatStatCell*> g_cells[kMaxCells];
+static std::atomic<int> g_ncells{0};
+static std::mutex g_cell_mu;
+// overflow cell: thread #513+ shares one cell; the relaxed load+store
+// write discipline makes sharing lossy under contention, but 512
+// registered threads means the process has bigger problems
+static NatStatCell g_overflow_cell;
+
+thread_local NatStatCell* tls_nat_cell = nullptr;
+
+NatStatCell* nat_cell_slow() {
+  std::lock_guard<std::mutex> g(g_cell_mu);
+  int n = g_ncells.load(std::memory_order_relaxed);
+  NatStatCell* c;
+  if (n < kMaxCells) {
+    c = new NatStatCell();  // zero-initialized (atomics value-init to 0)
+    g_cells[n].store(c, std::memory_order_release);
+    g_ncells.store(n + 1, std::memory_order_release);
+  } else {
+    c = &g_overflow_cell;
+  }
+  tls_nat_cell = c;
+  return c;
+}
+
+// gauges (PassiveStatus role): value computed at snapshot time
+static uint64_t (*g_gauges[NS_COUNTER_COUNT])() = {};
+
+void nat_stats_register_gauge(int counter_id, uint64_t (*fn)()) {
+  if (counter_id >= 0 && counter_id < NS_COUNTER_COUNT) {
+    g_gauges[counter_id] = fn;
+  }
+}
+
+static uint64_t combined_counter(int id) {
+  if (g_gauges[id] != nullptr) return g_gauges[id]();
+  uint64_t sum = g_overflow_cell.counters[id].load(std::memory_order_relaxed);
+  int n = g_ncells.load(std::memory_order_acquire);
+  for (int i = 0; i < n; i++) {
+    NatStatCell* c = g_cells[i].load(std::memory_order_acquire);
+    if (c != nullptr) sum += c->counters[id].load(std::memory_order_relaxed);
+  }
+  return sum;
+}
+
+static const char* kCounterNames[NS_COUNTER_COUNT] = {
+    "nat_socket_read_bytes",
+    "nat_socket_write_bytes",
+    "nat_connections_accepted",
+    "nat_tpu_std_msgs_in",
+    "nat_tpu_std_responses_out",
+    "nat_tpu_std_errors",
+    "nat_http_msgs_in",
+    "nat_http_responses_out",
+    "nat_http_errors",
+    "nat_grpc_msgs_in",
+    "nat_grpc_responses_out",
+    "nat_grpc_errors",
+    "nat_redis_msgs_in",
+    "nat_redis_responses_out",
+    "nat_redis_errors",
+    "nat_client_calls",
+    "nat_client_responses",
+    "nat_client_errors",
+    "nat_py_dispatches",
+    "nat_py_queue_depth",
+    "nat_spans_dropped",
+};
+
+static const char* kLaneNames[NL_LANE_COUNT] = {
+    "echo", "http", "redis", "grpc", "client",
+};
+
+// ---------------------------------------------------------------------------
+// span ring — seqlock slots under a monotonically-increasing ticket: the
+// writer marks a slot busy (odd), fills it, then publishes (2*ticket+2);
+// the drainer skips torn or overwritten slots instead of locking writers
+// ---------------------------------------------------------------------------
+
+std::atomic<uint32_t> g_nat_span_every{0};
+
+struct SpanSlot {
+  std::atomic<uint64_t> seq{0};
+  NatSpanRec rec;
+};
+static SpanSlot g_span_ring[kNatSpanRing];
+static std::atomic<uint64_t> g_span_head{0};  // next ticket
+static std::mutex g_span_drain_mu;
+static uint64_t g_span_next_read = 0;  // under g_span_drain_mu
+
+bool nat_span_tick() {
+  uint32_t every = g_nat_span_every.load(std::memory_order_relaxed);
+  if (every == 0) return false;
+  static thread_local uint32_t n = 0;
+  return ++n % every == 0;
+}
+
+// xorshift ids, seeded per thread (random.getrandbits role; spans need
+// unique-enough ids, not cryptographic ones)
+static uint64_t span_rand() {
+  static thread_local uint64_t state = 0;
+  if (state == 0) {
+    state = nat_now_ns() ^ ((uint64_t)(uintptr_t)&state << 17) ^ 0x9e3779b97f4a7c15ull;
+  }
+  state ^= state << 13;
+  state ^= state >> 7;
+  state ^= state << 17;
+  return state;
+}
+
+void nat_span_submit(const NatSpanRec& rec) {
+  uint64_t ticket = g_span_head.fetch_add(1, std::memory_order_relaxed);
+  SpanSlot& slot = g_span_ring[ticket & (kNatSpanRing - 1)];
+  slot.seq.store(2 * ticket + 1, std::memory_order_relaxed);  // busy
+  // full fence: the rec bytes must not become visible BEFORE the busy
+  // mark (a release store only keeps PRIOR writes above it; later plain
+  // stores could otherwise float up past it on weakly-ordered CPUs)
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  slot.rec = rec;
+  slot.seq.store(2 * ticket + 2, std::memory_order_release);  // published
+}
+
+void nat_span_record(int lane, uint64_t sock_id, const char* method,
+                     size_t method_len, uint64_t recv_ns, uint64_t parse_ns,
+                     uint64_t dispatch_ns, uint64_t write_ns,
+                     int32_t error_code, uint32_t req_bytes,
+                     uint32_t resp_bytes) {
+  NatSpanRec rec;
+  rec.trace_id = span_rand();
+  rec.span_id = span_rand();
+  rec.sock_id = sock_id;
+  rec.recv_ns = recv_ns;
+  rec.parse_ns = parse_ns;
+  rec.dispatch_ns = dispatch_ns;
+  rec.write_ns = write_ns;
+  rec.protocol = lane;
+  rec.error_code = error_code;
+  rec.req_bytes = req_bytes;
+  rec.resp_bytes = resp_bytes;
+  size_t n = method_len < sizeof(rec.method) - 1 ? method_len
+                                                 : sizeof(rec.method) - 1;
+  memcpy(rec.method, method, n);
+  rec.method[n] = '\0';
+  nat_span_submit(rec);
+}
+
+}  // namespace brpc_tpu
+
+// ---------------------------------------------------------------------------
+// C API (ctypes surface) — see also api.cpp for the scheduler/selftest
+// surface; the stats snapshot lives here beside the data it reads.
+// ---------------------------------------------------------------------------
+
+using namespace brpc_tpu;
+
+extern "C" {
+
+int nat_stats_counter_count() { return NS_COUNTER_COUNT; }
+
+// The span clock (CLOCK_MONOTONIC ns): lets the drainer map NatSpanRec
+// timestamps onto wall time with one offset computed at drain time.
+uint64_t nat_stats_now_ns() { return nat_now_ns(); }
+
+const char* nat_stats_counter_name(int id) {
+  if (id < 0 || id >= NS_COUNTER_COUNT) return "";
+  return kCounterNames[id];
+}
+
+// Combined snapshot of every counter (gauges computed in place). Returns
+// the number of values written.
+int nat_stats_counters(uint64_t* out, int max) {
+  int n = max < NS_COUNTER_COUNT ? max : (int)NS_COUNTER_COUNT;
+  for (int i = 0; i < n; i++) out[i] = combined_counter(i);
+  return n;
+}
+
+int nat_stats_lane_count() { return NL_LANE_COUNT; }
+
+const char* nat_stats_lane_name(int lane) {
+  if (lane < 0 || lane >= NL_LANE_COUNT) return "";
+  return kLaneNames[lane];
+}
+
+int nat_stats_hist_nbuckets() { return kNatHistBuckets; }
+
+// Combined log2 histogram of one lane. Returns buckets written.
+int nat_stats_hist(int lane, uint64_t* out, int max) {
+  if (lane < 0 || lane >= NL_LANE_COUNT) return 0;
+  int nb = max < kNatHistBuckets ? max : (int)kNatHistBuckets;
+  for (int b = 0; b < nb; b++) {
+    out[b] = g_overflow_cell.hist[lane][b].load(std::memory_order_relaxed);
+  }
+  int n = g_ncells.load(std::memory_order_acquire);
+  for (int i = 0; i < n; i++) {
+    NatStatCell* c = g_cells[i].load(std::memory_order_acquire);
+    if (c == nullptr) continue;
+    for (int b = 0; b < nb; b++) {
+      out[b] += c->hist[lane][b].load(std::memory_order_relaxed);
+    }
+  }
+  return nb;
+}
+
+// Quantile (0..1) over a lane's combined histogram, interpolated within
+// the winning log2 bucket. ns; 0.0 when the lane is empty.
+double nat_stats_hist_quantile(int lane, double q) {
+  uint64_t buckets[kNatHistBuckets];
+  int nb = nat_stats_hist(lane, buckets, kNatHistBuckets);
+  if (nb == 0) return 0.0;
+  uint64_t total = 0;
+  for (int b = 0; b < nb; b++) total += buckets[b];
+  if (total == 0) return 0.0;
+  if (q < 0.0) q = 0.0;
+  if (q > 1.0) q = 1.0;
+  double target = q * (double)total;
+  double acc = 0.0;
+  for (int b = 0; b < nb; b++) {
+    if (buckets[b] == 0) continue;
+    if (acc + (double)buckets[b] >= target) {
+      double lo = b == 0 ? 0.0 : (double)(1ull << (b - 1));
+      double hi = (double)(1ull << b);
+      double frac = (target - acc) / (double)buckets[b];
+      return lo + frac * (hi - lo);
+    }
+    acc += (double)buckets[b];
+  }
+  return (double)(1ull << (nb - 1));
+}
+
+// 0 = spans off; N = sample one of every N native-handled calls.
+void nat_stats_enable_spans(int every) {
+  g_nat_span_every.store(every <= 0 ? 0 : (uint32_t)every,
+                         std::memory_order_relaxed);
+}
+
+// Drain up to `max` span records into `out` (an array of NatSpanRec).
+// Returns the number copied. Records overwritten before this drain are
+// counted into nat_spans_dropped.
+int nat_stats_drain_spans(NatSpanRec* out, int max) {
+  std::lock_guard<std::mutex> g(g_span_drain_mu);
+  uint64_t head = g_span_head.load(std::memory_order_acquire);
+  if (head - g_span_next_read > kNatSpanRing) {
+    uint64_t dropped = head - g_span_next_read - kNatSpanRing;
+    nat_counter_add(NS_SPANS_DROPPED, dropped);
+    g_span_next_read = head - kNatSpanRing;
+  }
+  int copied = 0;
+  while (g_span_next_read < head && copied < max) {
+    SpanSlot& slot = g_span_ring[g_span_next_read & (kNatSpanRing - 1)];
+    uint64_t want = 2 * g_span_next_read + 2;
+    if (slot.seq.load(std::memory_order_acquire) == want) {
+      out[copied] = slot.rec;
+      // the copy must complete BEFORE the recheck reads seq (seqlock
+      // reader recipe): without the fence the loads of rec could sink
+      // below the validation load
+      std::atomic_thread_fence(std::memory_order_acquire);
+      if (slot.seq.load(std::memory_order_relaxed) == want) {
+        copied++;  // untorn: a concurrent overwrite would have bumped seq
+      }
+    }
+    g_span_next_read++;
+  }
+  return copied;
+}
+
+// Test/bench hygiene: zero every cell and forget undrained spans (the
+// bvar reset-between-cases discipline; production never calls this).
+void nat_stats_reset() {
+  // the two sections are independent; g_cell_mu must be RELEASED before
+  // g_span_drain_mu is taken — the drain path holds g_span_drain_mu and
+  // its dropped-span accounting can enter nat_cell_slow (g_cell_mu), so
+  // nesting here would be an ABBA deadlock
+  {
+    std::lock_guard<std::mutex> g(g_cell_mu);
+    int n = g_ncells.load(std::memory_order_acquire);
+    for (int i = 0; i <= n; i++) {
+      NatStatCell* c = i < n ? g_cells[i].load(std::memory_order_acquire)
+                             : &g_overflow_cell;
+      if (c == nullptr) continue;
+      for (int j = 0; j < NS_COUNTER_COUNT; j++) {
+        c->counters[j].store(0, std::memory_order_relaxed);
+      }
+      for (int l = 0; l < NL_LANE_COUNT; l++) {
+        for (int b = 0; b < kNatHistBuckets; b++) {
+          c->hist[l][b].store(0, std::memory_order_relaxed);
+        }
+      }
+    }
+  }
+  std::lock_guard<std::mutex> g2(g_span_drain_mu);
+  g_span_next_read = g_span_head.load(std::memory_order_acquire);
+}
+
+}  // extern "C"

@@ -1,0 +1,151 @@
+// nat_stats — native-runtime observability substrate.
+//
+// The bvar discipline (SURVEY.md §1 "lock-light metrics: thread-local
+// agents + background sampler", reducer.h / percentile.h) brought to the
+// C++ hot path: every reading thread / fiber worker / py-lane pthread owns
+// one cache-line-aligned NatStatCell holding monotonic counters and
+// fixed-bucket log2 latency histograms. The write side is single-writer
+// relaxed stores (no lock, no RMW contention); readers combine all cells
+// on demand, exactly like bvar's AgentCombiner. Span records for
+// native-handled calls go into a bounded global ring (the bvar::Collector
+// budget analog, collector.h:40: sampling keeps the hot-path cost fixed
+// no matter the traffic) that the Python side drains into /rpcz.
+#pragma once
+
+#include <stdint.h>
+#include <string.h>
+#include <time.h>
+
+#include <atomic>
+
+namespace brpc_tpu {
+
+// ---------------------------------------------------------------------------
+// counter ids — one flat namespace, names exported via nat_stats C API
+// ---------------------------------------------------------------------------
+
+enum NatCounterId : int {
+  NS_SOCK_READ_BYTES = 0,   // bytes drained from connection fds / ring bufs
+  NS_SOCK_WRITE_BYTES,      // bytes the kernel accepted (writev / ring send)
+  NS_CONNECTIONS_ACCEPTED,  // server-side accepts
+  NS_TPU_STD_MSGS_IN,       // complete tpu_std request frames parsed
+  NS_TPU_STD_RESPONSES_OUT, // tpu_std response frames built
+  NS_TPU_STD_ERRORS,        // protocol errors on the tpu_std cut
+  NS_HTTP_MSGS_IN,          // complete native-parsed HTTP/1.1 requests
+  NS_HTTP_RESPONSES_OUT,    // HTTP responses queued (native + py lanes)
+  NS_HTTP_ERRORS,           // HTTP session protocol errors
+  NS_H2_MSGS_IN,            // gRPC-over-h2 request streams dispatched
+  NS_H2_RESPONSES_OUT,      // gRPC responses framed
+  NS_H2_ERRORS,             // h2 session protocol errors
+  NS_REDIS_MSGS_IN,         // complete RESP commands parsed
+  NS_REDIS_RESPONSES_OUT,   // RESP replies queued
+  NS_REDIS_ERRORS,          // RESP protocol errors
+  NS_CLIENT_CALLS,          // calls begun on native channels (all protocols)
+  NS_CLIENT_RESPONSES,      // completed calls (first completion wins)
+  NS_CLIENT_ERRORS,         // fail_all-completed calls (socket death)
+  NS_PY_DISPATCHES,         // requests handed to the Python lane
+  NS_PY_QUEUE_DEPTH,        // gauge: py-lane MPSC queue depth right now
+  NS_SPANS_DROPPED,         // span ring overwrites before a drain
+  NS_COUNTER_COUNT,
+};
+
+// latency-histogram lanes (per-call ns, parse-complete -> response-write)
+enum NatLatLane : int {
+  NL_ECHO = 0,  // tpu_std native handler calls
+  NL_HTTP,      // native-usercode HTTP handler calls
+  NL_REDIS,     // native redis store command execution
+  NL_GRPC,      // native-handler gRPC-over-h2 calls
+  NL_CLIENT,    // client call round trip (begin_call -> completion)
+  NL_LANE_COUNT,
+};
+
+// log2 ns buckets: bucket b holds values in [2^(b-1), 2^b) ns (b=0 holds
+// 0..1ns); 44 buckets cover ~17 seconds — combined on demand, percentiles
+// interpolated inside the winning bucket (percentile.h's role with a
+// deterministic histogram instead of a reservoir).
+inline constexpr int kNatHistBuckets = 44;
+
+struct alignas(64) NatStatCell {
+  // single-writer discipline: only the owning thread stores (relaxed
+  // load+store, no locked RMW); combiners read with relaxed loads.
+  std::atomic<uint64_t> counters[NS_COUNTER_COUNT];
+  std::atomic<uint64_t> hist[NL_LANE_COUNT][kNatHistBuckets];
+};
+
+NatStatCell* nat_cell_slow();  // registers this thread's cell
+extern thread_local NatStatCell* tls_nat_cell;
+
+inline NatStatCell* nat_cell() {
+  NatStatCell* c = tls_nat_cell;
+  return c != nullptr ? c : nat_cell_slow();
+}
+
+inline void nat_counter_add(int id, uint64_t v) {
+  std::atomic<uint64_t>& c = nat_cell()->counters[id];
+  c.store(c.load(std::memory_order_relaxed) + v, std::memory_order_relaxed);
+}
+
+inline uint64_t nat_now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+inline int nat_hist_bucket(uint64_t ns) {
+  if (ns == 0) return 0;
+  int b = 64 - __builtin_clzll(ns);  // floor(log2(ns)) + 1
+  return b < kNatHistBuckets ? b : kNatHistBuckets - 1;
+}
+
+inline void nat_lat_record(int lane, uint64_t ns) {
+  std::atomic<uint64_t>& c = nat_cell()->hist[lane][nat_hist_bucket(ns)];
+  c.store(c.load(std::memory_order_relaxed) + 1, std::memory_order_relaxed);
+}
+
+// ---------------------------------------------------------------------------
+// span ring — fixed-size records of native-handled calls, drained by the
+// Python side into the shared /rpcz store (span.h:47-224 shape, with the
+// Collector budget expressed as a sampling stride).
+// ---------------------------------------------------------------------------
+
+inline constexpr uint32_t kNatSpanRingBits = 12;
+inline constexpr uint32_t kNatSpanRing = 1u << kNatSpanRingBits;  // 4096
+
+struct NatSpanRec {
+  uint64_t trace_id;
+  uint64_t span_id;
+  uint64_t sock_id;
+  // monotonic ns timeline: recv <= parse <= dispatch <= write
+  uint64_t recv_ns;      // request fully buffered / stream complete
+  uint64_t parse_ns;     // protocol parse done, usercode about to run
+  uint64_t dispatch_ns;  // usercode returned
+  uint64_t write_ns;     // response bytes queued to the socket
+  int32_t protocol;      // a NatLatLane value
+  int32_t error_code;
+  uint32_t req_bytes;
+  uint32_t resp_bytes;
+  char method[48];       // NUL-terminated, truncated
+};
+
+// 0 = spans off (default for bare native runtimes); N = record one of
+// every N native-handled calls (the Python mount sets this from the
+// rpcz flags).
+extern std::atomic<uint32_t> g_nat_span_every;
+
+// True when THIS call should be recorded (per-thread stride counter —
+// check it first, it is one branch in the common off case).
+bool nat_span_tick();
+void nat_span_submit(const NatSpanRec& rec);
+
+// Fill + submit helper for the server-side lanes.
+void nat_span_record(int lane, uint64_t sock_id, const char* method,
+                     size_t method_len, uint64_t recv_ns, uint64_t parse_ns,
+                     uint64_t dispatch_ns, uint64_t write_ns,
+                     int32_t error_code, uint32_t req_bytes,
+                     uint32_t resp_bytes);
+
+// Gauges: computed at snapshot time (PassiveStatus discipline) — cells
+// contribute nothing; the registered callback is the value.
+void nat_stats_register_gauge(int counter_id, uint64_t (*fn)());
+
+}  // namespace brpc_tpu

@@ -67,18 +67,21 @@ __attribute__((noinline)) static Worker* current_worker() {
 static const size_t kStackSize = 256 * 1024;
 
 // Pooled stacks (StackPool role, stack_inl.h:36-105): per-request fibers
-// must not pay an mmap/munmap round trip each spawn.
+// must not pay an mmap/munmap round trip each spawn. POD storage on
+// purpose: detached worker threads outlive exit()'s static destructors
+// (BENCH_r05 rc 139 — a ~vector here would free the pool under a worker
+// still reaping fibers), and trivially-destructible globals stay valid
+// for the whole process lifetime.
 static std::mutex g_stack_pool_mu;
-static std::vector<char*> g_stack_pool;
 static const size_t kStackPoolCap = 256;
+static char* g_stack_pool[kStackPoolCap];
+static size_t g_stack_pool_n = 0;
 
 static char* alloc_stack(size_t size) {
   {
     std::lock_guard<std::mutex> g(g_stack_pool_mu);
-    if (!g_stack_pool.empty()) {
-      char* s = g_stack_pool.back();
-      g_stack_pool.pop_back();
-      return s;
+    if (g_stack_pool_n > 0) {
+      return g_stack_pool[--g_stack_pool_n];
     }
   }
   void* mem = mmap(nullptr, size + 4096, PROT_READ | PROT_WRITE,
@@ -91,8 +94,8 @@ static char* alloc_stack(size_t size) {
 static void free_stack(char* stack, size_t size) {
   {
     std::lock_guard<std::mutex> g(g_stack_pool_mu);
-    if (g_stack_pool.size() < kStackPoolCap) {
-      g_stack_pool.push_back(stack);
+    if (g_stack_pool_n < kStackPoolCap) {
+      g_stack_pool[g_stack_pool_n++] = stack;
       return;
     }
   }
@@ -119,8 +122,14 @@ void Scheduler::wake_one() {
 }
 
 Scheduler* Scheduler::instance() {
-  static Scheduler s;
-  return &s;
+  // Intentionally leaked: worker threads are detached from the process's
+  // point of view and keep scheduling through exit(). A function-local
+  // `static Scheduler s` is destroyed by __cxa_atexit while they still
+  // iterate workers_ — the use-after-free behind the bench-exit SIGSEGV
+  // (BENCH_r05 rc 139). The reference never destructs its TaskControl
+  // either.
+  static Scheduler* s = new Scheduler();
+  return s;
 }
 
 int Scheduler::start(int nworkers) {

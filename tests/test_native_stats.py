@@ -1,0 +1,218 @@
+"""Native-runtime observability (nat_stats.cpp): per-thread stat cells,
+log2 latency histograms and the bounded span ring, surfaced through the
+Python bvar registry and console pages — /vars, /status, /brpc_metrics
+(Prometheus) and /rpcz show native traffic beside the Python lanes.
+
+Also the clean-exit regression for the BENCH_r05 rc-139 class: a process
+that ran the full native stack must exit 0 (static destructors must not
+race detached runtime threads).
+"""
+import http.client
+import socket as pysock
+import subprocess
+import sys
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.proto import echo_pb2
+
+native = pytest.importorskip("brpc_tpu.native")
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+class EchoService(rpc.Service):
+    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+    def Echo(self, cntl, request, response, done):
+        response.message = request.message
+        done()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    conn.close()
+    return r.status, body
+
+
+@pytest.fixture(scope="module")
+def server():
+    """A native-runtime server carrying echo (native handler), HTTP
+    (native /echo usercode) and redis (native store) traffic."""
+    from brpc_tpu import rpcz
+    from brpc_tpu.rpc.redis import RedisService
+
+    rpcz.clear_for_tests()
+    srv = rpc.Server(rpc.ServerOptions(num_threads=2,
+                                       use_native_runtime=True,
+                                       native_builtin_echo=True,
+                                       redis_service=RedisService(),
+                                       native_redis_store=True))
+    srv.add_service(EchoService())
+    assert srv.start("127.0.0.1:0") == 0
+    port = srv.listen_endpoint.port
+
+    # echo lane: 40 native framework calls
+    h = native.channel_open("127.0.0.1", port)
+    for _ in range(40):
+        code, body, text = native.channel_call(h, "EchoService", "Echo",
+                                               b"x" * 16)
+        assert code == 0, (code, text)
+    native.channel_close(h)
+
+    # http lane: native-usercode GETs
+    for _ in range(5):
+        status, body = _get(port, "/echo")
+        assert status == 200 and body == "pong"
+
+    # redis lane: native-store SET/GET
+    sk = pysock.create_connection(("127.0.0.1", port), timeout=5)
+    sk.sendall(b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n"
+               b"*2\r\n$3\r\nGET\r\n$1\r\nk\r\n")
+    got = b""
+    deadline = time.time() + 3
+    while b"$1\r\nv\r\n" not in got and time.time() < deadline:
+        got += sk.recv(4096)
+    sk.close()
+    assert b"+OK\r\n" in got and b"$1\r\nv\r\n" in got
+
+    yield srv, port
+    srv.stop()
+
+
+def test_vars_lists_native_counters(server):
+    srv, port = server
+    status, body = _get(port, "/vars")
+    assert status == 200
+    vals = {}
+    for line in body.splitlines():
+        if line.startswith("nat_") and " : " in line:
+            name, _, v = line.partition(" : ")
+            try:
+                vals[name.strip()] = float(v)
+            except ValueError:
+                pass
+    assert vals["nat_tpu_std_msgs_in"] >= 40
+    assert vals["nat_tpu_std_responses_out"] >= 40
+    assert vals["nat_http_msgs_in"] >= 5
+    assert vals["nat_redis_msgs_in"] >= 2
+    assert vals["nat_client_calls"] >= 40
+    assert vals["nat_connections_accepted"] >= 3
+    # bytes moved: every request carries at least its frame
+    assert vals["nat_socket_read_bytes"] > 40 * 12
+    assert vals["nat_socket_write_bytes"] > 0
+    # percentile vars are exposed and plausible for the echo lane
+    assert 0 < vals["nat_echo_latency_p50_us"] <= \
+        vals["nat_echo_latency_p99_us"] + 0.1
+
+
+def test_brpc_metrics_prometheus_exposition(server):
+    srv, port = server
+    status, body = _get(port, "/brpc_metrics")
+    assert status == 200
+    metrics = {}
+    for line in body.splitlines():
+        if line.startswith("nat_") and " " in line:
+            name, _, v = line.partition(" ")
+            metrics[name] = float(v)
+    assert metrics["nat_tpu_std_msgs_in"] >= 40
+    assert metrics["nat_redis_responses_out"] >= 2
+    assert "# TYPE nat_tpu_std_msgs_in gauge" in body
+
+
+def test_rpcz_shows_native_spans_with_ordered_timeline(server):
+    from brpc_tpu import rpcz
+
+    srv, port = server
+    status, body = _get(port, "/rpcz")
+    assert status == 200
+    assert "native:" in body, body[:400]
+    native_spans = [s for s in rpcz.recent_spans(4096)
+                    if s.remote_side and s.remote_side.startswith("native:")]
+    assert native_spans
+    lanes_seen = set()
+    for s in native_spans:
+        lanes_seen.add(s.remote_side.split("/")[0])
+        # recv <= parse <= dispatch <= write, carried as start_time plus
+        # three timeline annotations ending at end_time
+        times = [s.start_time] + [ts for ts, _ in s.annotations]
+        assert times == sorted(times), (s.full_method, times)
+        assert abs(s.annotations[-1][0] - s.end_time) < 1e-9
+        assert s.end_time >= s.start_time
+    assert "native:echo" in lanes_seen
+    echo_spans = [s for s in native_spans
+                  if s.full_method == "EchoService.Echo"]
+    assert echo_spans and echo_spans[0].request_size == 16
+
+
+def test_histogram_percentiles_monotone(server):
+    lanes = native.stats_lane_names()
+    assert lanes == ["echo", "http", "redis", "grpc", "client"]
+    nonempty = 0
+    for idx, lane in enumerate(lanes):
+        hist = native.stats_hist(idx)
+        if not any(hist):
+            continue
+        nonempty += 1
+        p50 = native.stats_quantile(idx, 0.50)
+        p99 = native.stats_quantile(idx, 0.99)
+        p999 = native.stats_quantile(idx, 0.999)
+        assert 0 < p50 <= p99 <= p999, (lane, p50, p99, p999)
+        # the histogram total matches what the quantile walk saw
+        assert sum(hist) > 0
+    # echo, redis and client lanes definitely carried traffic
+    assert nonempty >= 3
+
+
+def test_status_page_has_native_section(server):
+    srv, port = server
+    status, body = _get(port, "/status")
+    assert status == 200
+    assert "native runtime:" in body
+    assert "tpu_std: in=" in body
+    assert "echo_latency_us: p50=" in body
+
+
+def test_native_stack_exits_clean():
+    """BENCH_r05 rc-139 regression: spin up the full native stack (server,
+    scheduler workers, dispatchers, client channel, py lane), do work,
+    stop, and exit — the process must not SIGSEGV in static destructors
+    racing detached runtime threads."""
+    script = (
+        "import sys; sys.path.insert(0, '.')\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from brpc_tpu import rpc, native\n"
+        "from brpc_tpu.rpc.proto import echo_pb2\n"
+        "class E(rpc.Service):\n"
+        "    @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)\n"
+        "    def Echo(self, cntl, request, response, done):\n"
+        "        response.message = request.message\n"
+        "        done()\n"
+        "srv = rpc.Server(rpc.ServerOptions(num_threads=2,\n"
+        "                 use_native_runtime=True,\n"
+        "                 native_builtin_echo=True))\n"
+        "srv.add_service(E())\n"
+        "assert srv.start('127.0.0.1:0') == 0\n"
+        "port = srv.listen_endpoint.port\n"
+        "h = native.channel_open('127.0.0.1', port)\n"
+        "for _ in range(100):\n"
+        "    code, body, text = native.channel_call(h, 'EchoService',\n"
+        "                                           'Echo', b'z' * 16)\n"
+        "    assert code == 0, (code, text)\n"
+        "native.channel_close(h)\n"
+        "srv.stop()\n"
+        "print('clean', flush=True)\n")
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=180,
+                         cwd=repo_root, env=env)
+    assert res.returncode == 0, (res.returncode, res.stderr[-2000:])
+    assert "clean" in res.stdout
