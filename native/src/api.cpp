@@ -5,6 +5,7 @@
 #include <cstring>
 
 #include "iobuf.h"
+#include "nat_api.h"
 #include "rpc_meta.h"
 #include "scheduler.h"
 
@@ -41,14 +42,14 @@ static void count_fiber(void* a) {
 }
 
 uint64_t nat_bench_spawn_join(int nfibers, int rounds) {
-  g_counter = 0;
+  g_counter.store(0, std::memory_order_relaxed);
   std::vector<Fiber*> fibers;
   CountArg arg{rounds};
   for (int i = 0; i < nfibers; i++) {
     fibers.push_back(Scheduler::instance()->spawn(count_fiber, &arg));
   }
   for (Fiber* f : fibers) Scheduler::instance()->join(f);
-  return g_counter.load();
+  return g_counter.load(std::memory_order_relaxed);
 }
 
 // ping-pong: two fibers alternating through butexes

@@ -65,7 +65,7 @@ static void prof_dump(const char* path) {
   struct itimerval off;
   memset(&off, 0, sizeof(off));
   setitimer(ITIMER_PROF, &off, nullptr);
-  size_t n = std::min(g_nsamples.load(), kMaxSamples);
+  size_t n = std::min(g_nsamples.load(std::memory_order_relaxed), kMaxSamples);
   std::map<uint64_t, uint64_t> counts;
   for (size_t i = 0; i < n; i++) counts[g_samples[i]]++;
   FILE* f = fopen(path, "w");
@@ -89,21 +89,7 @@ static void prof_dump(const char* path) {
   fclose(f);
 }
 
-extern "C" {
-int nat_rpc_server_start(const char* ip, int port, int nworkers,
-                         int enable_native_echo);
-int nat_rpc_use_io_uring(int enable);
-void nat_rpc_server_stop();
-double nat_rpc_client_bench(const char* ip, int port, int nconn,
-                            int fibers_per_conn, double seconds,
-                            int payload_size, uint64_t* out_requests);
-double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
-                                  int window, double seconds,
-                                  int payload_size, uint64_t* out_requests);
-void nat_io_counters(uint64_t* wc, uint64_t* wb, uint64_t* rc, uint64_t* rb);
-double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
-                                 double seconds, uint64_t* out_bytes);
-}
+#include "nat_api.h"
 
 static void print_io_stats(const char* lane, uint64_t reqs, uint64_t wc0,
                            uint64_t rc0) {

@@ -24,6 +24,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "nat_api.h"
 #include "rpc_meta.h"
 
 namespace brpc_tpu {
@@ -239,7 +240,7 @@ extern "C" void nat_echo_server_stop() {
 }
 
 extern "C" uint64_t nat_echo_server_requests() {
-  return g_server ? g_server->requests.load() : 0;
+  return g_server ? g_server->requests.load(std::memory_order_relaxed) : 0;
 }
 
 // ---- client bench ----
@@ -325,8 +326,8 @@ extern "C" double nat_echo_client_bench(const char* ip, int port, int nconn,
   for (auto& th : threads) th.join();
   auto t1 = std::chrono::steady_clock::now();
   double dt = std::chrono::duration<double>(t1 - t0).count();
-  if (out_requests) *out_requests = total.load();
-  return dt > 0 ? (double)total.load() / dt : 0.0;
+  if (out_requests) *out_requests = total.load(std::memory_order_relaxed);
+  return dt > 0 ? (double)total.load(std::memory_order_relaxed) / dt : 0.0;
 }
 
 }  // namespace brpc_tpu

@@ -1,5 +1,7 @@
 #include "iobuf.h"
 
+#include "nat_api.h"
+
 #include <errno.h>
 #include <stdlib.h>
 #include <unistd.h>

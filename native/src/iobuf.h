@@ -19,7 +19,9 @@
 namespace brpc_tpu {
 
 struct IOBlock {
-  static const size_t kSize = 8192;  // iobuf.h:70
+  // constexpr (implicitly inline in C++17): `static const` has no
+  // out-of-line definition, and unoptimized/sanitizer builds odr-use it
+  static constexpr size_t kSize = 8192;  // iobuf.h:70
   std::atomic<int> ref{1};
   size_t size = 0;  // filled prefix
   char data[kSize];

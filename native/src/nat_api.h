@@ -1,0 +1,188 @@
+// nat_api — the complete extern "C" surface of libbrpc_tpu_native.so.
+//
+// Single source of truth for the FFI contract: every .cpp that DEFINES one
+// of these functions includes this header, so a drifting definition is a
+// compile error in that TU instead of a silent ABI break discovered by a
+// crashing ctypes call. tools/natcheck's ABI pass closes the other half of
+// the loop: native/src/nat_abi.cpp stringifies each declaration below into
+// a manifest (sizeof/offsetof/arg types) that is cross-checked against the
+// ctypes argtypes/restype declarations in brpc_tpu/native/__init__.py, and
+// `nm -D` of the built .so is diffed against the manifest so an export
+// added without a declaration here fails `make -C native check`.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+namespace brpc_tpu {
+struct NatSpanRec;  // full layout in nat_stats.h (mirrored in ctypes)
+}
+
+extern "C" {
+
+// ---- async-call callback shapes (ctypes CFUNCTYPE mirrors) ----
+// tpu_std channel done-closure: cb(arg, error_code, resp, resp_len)
+typedef void (*nat_acall_cb)(void* arg, int32_t error_code, const char* resp,
+                             size_t resp_len);
+// HTTP/gRPC client lanes add an aux status (HTTP status / grpc-status)
+typedef void (*nat_acall2_cb)(void* arg, int32_t error_code,
+                              int32_t aux_status, const char* resp,
+                              size_t resp_len);
+
+// ---- scheduler + selftests (api.cpp) ----
+int nat_sched_start(int nworkers);
+void nat_sched_stop(void);
+int nat_sched_workers(void);
+uint64_t nat_sched_switches(void);
+uint64_t nat_bench_spawn_join(int nfibers, int rounds);
+double nat_bench_ping_pong(int rounds);
+int nat_wsq_selftest(void);
+int nat_iobuf_selftest(void);
+int nat_meta_selftest(void);
+
+// ---- minimal epoll echo runtime (echo_runtime.cpp) ----
+int nat_echo_server_start(const char* ip, int port);
+void nat_echo_server_stop(void);
+uint64_t nat_echo_server_requests(void);
+double nat_echo_client_bench(const char* ip, int port, int nconn,
+                             double seconds, int payload_size, int pipeline,
+                             uint64_t* out_requests);
+
+// ---- IOBuf syscall counters (iobuf.cpp) ----
+void nat_io_counters(uint64_t* wc, uint64_t* wb, uint64_t* rc, uint64_t* rb);
+
+// ---- native RPC runtime: server side (nat_server.cpp) ----
+int nat_rpc_set_dispatchers(int n);
+int nat_rpc_server_start(const char* ip, int port, int nworkers,
+                         int enable_native_echo);
+void nat_rpc_server_stop(void);
+int nat_rpc_server_enable_raw_fallback(int enable);
+int nat_rpc_server_native_http(int enable);
+int nat_rpc_server_redis(int mode);
+uint64_t nat_rpc_server_requests(void);
+uint64_t nat_rpc_server_connections(void);
+int nat_rpc_use_io_uring(int enable);
+void nat_ring_counters(uint64_t* recv_out, uint64_t* send_out);
+
+// py-lane request handoff
+void* nat_take_request(int timeout_ms);
+int nat_take_request_batch(void** out, int max, int timeout_ms);
+int32_t nat_req_kind(void* h);
+const char* nat_req_field(void* h, int which, size_t* len);
+int64_t nat_req_cid(void* h);
+uint64_t nat_req_aux(void* h);
+int32_t nat_req_compress(void* h);
+uint64_t nat_req_sock_id(void* h);
+void nat_req_free(void* h);
+int nat_respond(void* h, int32_t error_code, const char* error_text,
+                const char* payload, size_t payload_len, const char* att,
+                size_t att_len);
+int nat_sock_write(uint64_t sock_id, const char* data, size_t len);
+int nat_sock_set_failed(uint64_t sock_id);
+
+// protocol-lane response emitters (nat_http.cpp / nat_h2.cpp / nat_redis.cpp)
+int nat_http_respond(uint64_t sock_id, int64_t seq, const char* data,
+                     size_t len, int close_after);
+int nat_sock_graceful_close(uint64_t sock_id);
+int nat_grpc_respond(uint64_t sock_id, int64_t sid, const char* payload,
+                     size_t payload_len, int grpc_status,
+                     const char* grpc_message);
+int nat_redis_respond(uint64_t sock_id, int64_t seq, const char* data,
+                      size_t len);
+
+// TLS on the native port (nat_ssl.cpp)
+int nat_rpc_server_ssl(const char* cert_path, const char* key_path);
+
+// ---- native RPC runtime: client side (nat_channel.cpp / nat_client.cpp) ----
+void* nat_channel_open(const char* ip, int port, int nworkers,
+                       int batch_writes, int connect_timeout_ms,
+                       int health_check_ms);
+void* nat_channel_open_proto(const char* ip, int port, int nworkers,
+                             int batch_writes, int connect_timeout_ms,
+                             int health_check_ms, int protocol,
+                             const char* authority);
+void nat_channel_close(void* h);
+int nat_channel_call(void* h, const char* service, const char* method,
+                     const char* payload, size_t payload_len, int timeout_ms,
+                     char** resp_out, size_t* resp_len, char** err_text_out);
+int nat_channel_call_full(void* h, const char* service, const char* method,
+                          const char* payload, size_t payload_len,
+                          int timeout_ms, int max_retry, int backup_ms,
+                          char** resp_out, size_t* resp_len,
+                          char** err_text_out);
+int nat_channel_acall(void* h, const char* service, const char* method,
+                      const char* payload, size_t payload_len, int timeout_ms,
+                      nat_acall_cb cb, void* arg);
+void nat_buf_free(char* p);
+int nat_http_call(void* h, const char* verb, const char* path,
+                  const char* extra_headers, const char* body,
+                  size_t body_len, int timeout_ms, int* status_out,
+                  char** resp_out, size_t* resp_len);
+int nat_http_acall(void* h, const char* verb, const char* path,
+                   const char* extra_headers, const char* body,
+                   size_t body_len, int timeout_ms, nat_acall2_cb cb,
+                   void* arg);
+int nat_grpc_call(void* h, const char* path, const char* payload,
+                  size_t payload_len, int timeout_ms, int* grpc_status_out,
+                  char** resp_out, size_t* resp_len, char** err_text_out);
+int nat_grpc_acall(void* h, const char* path, const char* payload,
+                   size_t payload_len, int timeout_ms, nat_acall2_cb cb,
+                   void* arg);
+
+// ---- bench clients (nat_bench.cpp) ----
+double nat_rpc_client_bench(const char* ip, int port, int nconn,
+                            int fibers_per_conn, double seconds,
+                            int payload_size, uint64_t* out_requests);
+double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
+                                  int window, double seconds,
+                                  int payload_size, uint64_t* out_requests);
+double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
+                                 double seconds, uint64_t* out_bytes);
+double nat_http_client_bench(const char* ip, int port, int nconn,
+                             int pipeline, double seconds, const char* path,
+                             const char* body, size_t body_len,
+                             const char* content_type,
+                             uint64_t* out_requests);
+double nat_grpc_client_bench(const char* ip, int port, int nconn, int window,
+                             double seconds, const char* path,
+                             const char* payload, size_t payload_len,
+                             uint64_t* out_requests);
+double nat_redis_client_bench(const char* ip, int port, int nconn,
+                              int pipeline, double seconds,
+                              uint64_t* out_requests);
+double nat_grpc_channel_bench(const char* ip, int port, int nconn,
+                              int window, double seconds, const char* path,
+                              const char* payload, size_t payload_len,
+                              uint64_t* out_requests);
+double nat_http_channel_bench(const char* ip, int port, int nconn,
+                              int window, double seconds, const char* path,
+                              const char* body, size_t body_len,
+                              uint64_t* out_requests);
+
+// ---- shm usercode worker lane (nat_shm_lane.cpp) ----
+int nat_shm_lane_create(size_t ring_bytes);
+int nat_shm_lane_workers(void);
+const char* nat_shm_lane_name(void);
+int nat_shm_lane_enable(int enable);
+int nat_shm_lane_set_timeout_ms(int ms);
+int nat_shm_worker_attach(const char* name);
+void* nat_shm_take_request(int timeout_ms);
+int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
+                    const char* payload, size_t payload_len, int32_t status,
+                    const char* message, int close_after);
+
+// ---- observability snapshot surface (nat_stats.cpp) ----
+int nat_stats_counter_count(void);
+uint64_t nat_stats_now_ns(void);
+const char* nat_stats_counter_name(int id);
+int nat_stats_counters(uint64_t* out, int max);
+int nat_stats_lane_count(void);
+const char* nat_stats_lane_name(int lane);
+int nat_stats_hist_nbuckets(void);
+int nat_stats_hist(int lane, uint64_t* out, int max);
+double nat_stats_hist_quantile(int lane, double q);
+void nat_stats_enable_spans(int every);
+int nat_stats_drain_spans(brpc_tpu::NatSpanRec* out, int max);
+void nat_stats_reset(void);
+
+}  // extern "C"

@@ -6,9 +6,7 @@
 namespace brpc_tpu {
 
 // Shared client-bench harness: channel open, timed run, stop broadcast,
-// fiber join via done_count, and the stack-Butex destruction handshake
-// (scheduler.cpp join(): once we hold/release the butex mutex, the last
-// waker is done touching it). spawn(ch, stop, total, done) returns the
+// fiber join via done_count. spawn(ch, stop, total, done) returns the
 // number of fibers it started.
 template <typename SpawnFn, typename OnStopFn>
 static double run_client_bench(const char* ip, int port, int nconn,
@@ -16,31 +14,37 @@ static double run_client_bench(const char* ip, int port, int nconn,
                                SpawnFn spawn, OnStopFn on_stop) {
   std::atomic<bool> stop{false};
   std::atomic<uint64_t> total{0};
-  Butex done_count;
+  // done_count is heap-allocated and intentionally LEAKED (one Butex per
+  // bench invocation, ~200B): the last fiber publishes its count and then
+  // wakes through butex_wake's LOCK-FREE fast path, which reads
+  // done_count->nwaiters without taking the mutex — so once the count
+  // reaches nfibers, this frame can unwind while that read is still in
+  // flight, and a stack-lifetime Butex is a use-after-free window. The
+  // old mutex "destruction handshake" only synchronized with slow-path
+  // wakers (TSan-lane finding; see tools/natcheck/README.md).
+  Butex* done_count = new Butex();
   std::vector<NatChannel*> channels;
   int nfibers = 0;
   for (int c = 0; c < nconn; c++) {
     NatChannel* ch = (NatChannel*)nat_channel_open(ip, port, 0, 1, 0, 0);
     if (ch == nullptr) continue;
     channels.push_back(ch);
-    nfibers += spawn(ch, &stop, &total, &done_count);
+    nfibers += spawn(ch, &stop, &total, done_count);
   }
   auto t0 = std::chrono::steady_clock::now();
   std::this_thread::sleep_for(
       std::chrono::milliseconds((int64_t)(seconds * 1000)));
-  stop.store(true);
+  stop.store(true, std::memory_order_relaxed);
   on_stop();
-  while (done_count.value.load(std::memory_order_acquire) < nfibers) {
-    Scheduler::butex_wait(&done_count,
-                          done_count.value.load(std::memory_order_acquire));
+  while (done_count->value.load(std::memory_order_acquire) < nfibers) {
+    Scheduler::butex_wait(done_count,
+                          done_count->value.load(std::memory_order_acquire));
   }
-  // destruction handshake: the last fiber may still be inside butex_wake
-  { std::lock_guard<std::mutex> g(done_count.mu); }
   auto t1 = std::chrono::steady_clock::now();
   double dt = std::chrono::duration<double>(t1 - t0).count();
   for (NatChannel* ch : channels) nat_channel_close(ch);
-  if (out_requests) *out_requests = total.load();
-  return dt > 0 ? (double)total.load() / dt : 0.0;
+  if (out_requests) *out_requests = total.load(std::memory_order_relaxed);
+  return dt > 0 ? (double)total.load(std::memory_order_relaxed) / dt : 0.0;
 }
 
 // F fibers per channel issue synchronous EchoService.Echo calls; the
@@ -426,12 +430,12 @@ double nat_http_client_bench(const char* ip, int port, int nconn,
   auto t0 = std::chrono::steady_clock::now();
   std::this_thread::sleep_for(
       std::chrono::milliseconds((int64_t)(seconds * 1000)));
-  stop.store(true);
+  stop.store(true, std::memory_order_relaxed);
   for (auto& t : threads) t.join();
   auto t1 = std::chrono::steady_clock::now();
   double dt = std::chrono::duration<double>(t1 - t0).count();
-  if (out_requests != nullptr) *out_requests = total.load();
-  return dt > 0 ? (double)total.load() / dt : 0.0;
+  if (out_requests != nullptr) *out_requests = total.load(std::memory_order_relaxed);
+  return dt > 0 ? (double)total.load(std::memory_order_relaxed) / dt : 0.0;
 }
 
 // gRPC-over-h2 bench client: minimal h2 client on blocking sockets —
@@ -566,12 +570,12 @@ double nat_grpc_client_bench(const char* ip, int port, int nconn,
   auto t0 = std::chrono::steady_clock::now();
   std::this_thread::sleep_for(
       std::chrono::milliseconds((int64_t)(seconds * 1000)));
-  stop.store(true);
+  stop.store(true, std::memory_order_relaxed);
   for (auto& t : threads) t.join();
   auto t1 = std::chrono::steady_clock::now();
   double dt = std::chrono::duration<double>(t1 - t0).count();
-  if (out_requests != nullptr) *out_requests = total.load();
-  return dt > 0 ? (double)total.load() / dt : 0.0;
+  if (out_requests != nullptr) *out_requests = total.load(std::memory_order_relaxed);
+  return dt > 0 ? (double)total.load(std::memory_order_relaxed) / dt : 0.0;
 }
 
 // Redis bench client: raw RESP on blocking sockets, `pipeline` GET
@@ -640,12 +644,12 @@ double nat_redis_client_bench(const char* ip, int port, int nconn,
   auto t0 = std::chrono::steady_clock::now();
   std::this_thread::sleep_for(
       std::chrono::milliseconds((int64_t)(seconds * 1000)));
-  stop.store(true);
+  stop.store(true, std::memory_order_relaxed);
   for (auto& t : threads) t.join();
   auto t1 = std::chrono::steady_clock::now();
   double dt = std::chrono::duration<double>(t1 - t0).count();
-  if (out_requests != nullptr) *out_requests = total.load();
-  return dt > 0 ? (double)total.load() / dt : 0.0;
+  if (out_requests != nullptr) *out_requests = total.load(std::memory_order_relaxed);
+  return dt > 0 ? (double)total.load(std::memory_order_relaxed) / dt : 0.0;
 }
 
 }  // extern "C"
@@ -766,7 +770,7 @@ static double run_cli_lane_bench(const char* ip, int port, int nconn,
   auto t0 = std::chrono::steady_clock::now();
   std::this_thread::sleep_for(
       std::chrono::milliseconds((int64_t)(seconds * 1000)));
-  stop.store(true);
+  stop.store(true, std::memory_order_relaxed);
   for (CliLaneConn* cc : conns) {
     cc->room.value.fetch_add(1, std::memory_order_release);
     Scheduler::butex_wake(&cc->room, INT32_MAX);
@@ -782,8 +786,8 @@ static double run_cli_lane_bench(const char* ip, int port, int nconn,
     nat_channel_close(cc->ch);
     cc->release();
   }
-  if (out_requests != nullptr) *out_requests = total.load();
-  return dt > 0 ? (double)total.load() / dt : 0.0;
+  if (out_requests != nullptr) *out_requests = total.load(std::memory_order_relaxed);
+  return dt > 0 ? (double)total.load(std::memory_order_relaxed) / dt : 0.0;
 }
 
 extern "C" {

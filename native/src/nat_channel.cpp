@@ -436,9 +436,8 @@ void nat_buf_free(char* p) { free(p); }
 // Asynchronous call for embedders (the done-closure surface): cb runs on
 // a framework thread/fiber when the response (or failure) arrives —
 // cb(user_arg, error_code, resp_bytes, resp_len). The response buffer is
-// only valid during the callback; copy it out if needed.
-typedef void (*nat_acall_cb)(void* arg, int32_t error_code,
-                             const char* resp, size_t resp_len);
+// only valid during the callback; copy it out if needed. (nat_acall_cb is
+// declared in nat_api.h beside the rest of the C surface.)
 
 struct AcallCtx {
   nat_acall_cb cb;

@@ -706,7 +706,8 @@ int h2_client_process(NatSocket* s, IOBuf* batch_out) {
         // whole drain window, while the permitted streams finish here
         if (ch != nullptr) {
           uint64_t expect = s->id;
-          ch->sock_id.compare_exchange_strong(expect, 0);
+          ch->sock_id.compare_exchange_strong(expect, 0,
+                                              std::memory_order_seq_cst);
         }
         for (int64_t cid : refused) {
           PendingCall* pc = ch != nullptr

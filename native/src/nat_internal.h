@@ -38,6 +38,7 @@
 #include <vector>
 
 #include "iobuf.h"
+#include "nat_api.h"
 #include "nat_stats.h"
 #include "ring_listener.h"
 #include "rpc_meta.h"
@@ -411,7 +412,7 @@ class NatServer {
   PyRequest* take_py(int timeout_ms) {
     std::unique_lock<std::mutex> lk(py_mu);
     if (py_q.empty() && !py_stopping) {
-      py_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+      nat_cv_wait_for(py_cv, lk, std::chrono::milliseconds(timeout_ms));
     }
     if (py_q.empty()) return nullptr;
     PyRequest* r = py_q.front();
@@ -424,7 +425,7 @@ class NatServer {
   int take_py_batch(PyRequest** out, int max, int timeout_ms) {
     std::unique_lock<std::mutex> lk(py_mu);
     if (py_q.empty() && !py_stopping) {
-      py_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms));
+      nat_cv_wait_for(py_cv, lk, std::chrono::milliseconds(timeout_ms));
     }
     int n = 0;
     while (n < max && !py_q.empty()) {
@@ -778,41 +779,8 @@ bool ssl_encrypt(NatSocket* s, IOBuf&& plain, IOBuf* cipher_out);
 int ssl_encrypt_and_write(NatSocket* s, IOBuf&& plain);
 void ssl_session_free(SslSessionN* s);
 
-extern "C" {
-// response emitters the shm response drainer reuses (nat_http.cpp /
-// nat_h2.cpp)
-int nat_http_respond(uint64_t sock_id, int64_t seq, const char* data,
-                     size_t len, int close_after);
-int nat_grpc_respond(uint64_t sock_id, int64_t sid, const char* payload,
-                     size_t payload_len, int grpc_status,
-                     const char* grpc_message);
-// forward decls shared with the bench harness
-void* nat_channel_open(const char* ip, int port, int unused,
-                       int batch_writes, int connect_timeout_ms,
-                       int health_check_ms);
-void nat_channel_close(void* h);
-// client protocol lanes (nat_client.cpp)
-typedef void (*nat_acall2_cb)(void* arg, int32_t error_code,
-                              int32_t aux_status, const char* resp,
-                              size_t resp_len);
-void* nat_channel_open_proto(const char* ip, int port, int nworkers,
-                             int batch_writes, int connect_timeout_ms,
-                             int health_check_ms, int protocol,
-                             const char* authority);
-int nat_http_call(void* h, const char* verb, const char* path,
-                  const char* extra_headers, const char* body,
-                  size_t body_len, int timeout_ms, int* status_out,
-                  char** resp_out, size_t* resp_len);
-int nat_http_acall(void* h, const char* verb, const char* path,
-                   const char* extra_headers, const char* body,
-                   size_t body_len, int timeout_ms, nat_acall2_cb cb,
-                   void* arg);
-int nat_grpc_call(void* h, const char* path, const char* payload,
-                  size_t payload_len, int timeout_ms, int* grpc_status_out,
-                  char** resp_out, size_t* resp_len, char** err_text_out);
-int nat_grpc_acall(void* h, const char* path, const char* payload,
-                   size_t payload_len, int timeout_ms, nat_acall2_cb cb,
-                   void* arg);
-}
+// The full extern "C" surface (response emitters the shm drainer reuses,
+// channel open/call paths the bench harness shares, the nat_acall*_cb
+// typedefs) lives in nat_api.h, included at the top of this header.
 
 }  // namespace brpc_tpu

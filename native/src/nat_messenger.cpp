@@ -106,8 +106,8 @@ static int try_process_http(NatSocket* s, IOBuf* batch_out) {
              "nat_scheduler_switches : %llu\n"
              "nat_ring_recv_completions : %llu\n"
              "nat_ring_send_completions : %llu\n",
-             (unsigned long long)s->server->requests.load(),
-             (unsigned long long)s->server->connections.load(),
+             (unsigned long long)s->server->requests.load(std::memory_order_relaxed),
+             (unsigned long long)s->server->connections.load(std::memory_order_relaxed),
              Scheduler::instance()->nworkers(),
              (unsigned long long)Scheduler::instance()->total_switches(),
              (unsigned long long)ring_recv,

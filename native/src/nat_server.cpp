@@ -81,7 +81,7 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
     s->disp = pick_dispatcher();  // shard across the loop pool
     s->server = srv;
     srv->add_ref();  // released when the socket slot is recycled
-    srv->connections.fetch_add(1);
+    srv->connections.fetch_add(1, std::memory_order_relaxed);
     nat_counter_add(NS_CONNECTIONS_ACCEPTED, 1);
     if (try_ring_adopt(s)) continue;  // the ring owns this read path
     s->disp->add_consumer(s);
@@ -396,12 +396,16 @@ int32_t nat_req_kind(void* h) { return ((PyRequest*)h)->kind; }
 
 uint64_t nat_rpc_server_requests() {
   std::lock_guard<std::mutex> g(g_rt_mu);
-  return g_rpc_server ? g_rpc_server->requests.load() : 0;
+  return g_rpc_server
+             ? g_rpc_server->requests.load(std::memory_order_relaxed)
+             : 0;
 }
 
 uint64_t nat_rpc_server_connections() {
   std::lock_guard<std::mutex> g(g_rt_mu);
-  return g_rpc_server ? g_rpc_server->connections.load() : 0;
+  return g_rpc_server
+             ? g_rpc_server->connections.load(std::memory_order_relaxed)
+             : 0;
 }
 
 // ---- Python lane (usercode on pthreads) ----

@@ -96,11 +96,11 @@ void ring_init(ShmRing* r, size_t cap) {
   pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
   pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
   pthread_mutex_init(&r->mu, &ma);
-  r->seq_data.store(0);
-  r->seq_space.store(0);
+  r->seq_data.store(0, std::memory_order_relaxed);
+  r->seq_space.store(0, std::memory_order_relaxed);
   r->head = r->tail = 0;
   r->cap = cap;
-  r->shutdown.store(0);
+  r->shutdown.store(0, std::memory_order_relaxed);
 }
 
 // Blocking record put/take. Records are u32 length + payload. False on
@@ -392,7 +392,7 @@ int nat_shm_lane_create(size_t ring_bytes) {
   if (ring_bytes == 0) ring_bytes = 8u << 20;
   static std::atomic<int> counter{0};
   snprintf(g_seg_name, sizeof(g_seg_name), "/brpc_tpu_lane_%d_%d",
-           (int)getpid(), counter.fetch_add(1));
+           (int)getpid(), counter.fetch_add(1, std::memory_order_relaxed));
   size_t total = sizeof(ShmSeg) + 2 * (sizeof(ShmRing) + ring_bytes);
   shm_unlink(g_seg_name);
   int fd = shm_open(g_seg_name, O_CREAT | O_EXCL | O_RDWR, 0600);
@@ -414,7 +414,7 @@ int nat_shm_lane_create(size_t ring_bytes) {
   g_seg_unlinked = false;
   g_seg->magic = kShmMagic;
   g_seg->ring_bytes = ring_bytes;
-  g_seg->attached.store(0);
+  g_seg->attached.store(0, std::memory_order_relaxed);
   ring_init(req_ring(), ring_bytes);
   ring_init(resp_ring(), ring_bytes);
   return 0;
@@ -423,7 +423,7 @@ int nat_shm_lane_create(size_t ring_bytes) {
 // Parent: how many workers have completed attach (readiness barrier —
 // a short reap timeout must not fire while workers are still booting).
 int nat_shm_lane_workers() {
-  return g_seg != nullptr ? g_seg->attached.load() : 0;
+  return g_seg != nullptr ? g_seg->attached.load(std::memory_order_acquire) : 0;
 }
 
 const char* nat_shm_lane_name() { return g_seg != nullptr ? g_seg_name : ""; }
@@ -434,20 +434,21 @@ const char* nat_shm_lane_name() { return g_seg != nullptr ? g_seg_name : ""; }
 // later create replaces it.
 int nat_shm_lane_enable(int enable) {
   if (g_seg == nullptr) return -1;
-  if (enable != 0 && !g_lane_enabled.load()) {
+  if (enable != 0 && !g_lane_enabled.load(std::memory_order_acquire)) {
     {
       std::lock_guard<std::mutex> g(g_inflight_mu);
       g_inflight.clear();
     }
-    g_drainer_stop.store(false);
+    g_drainer_stop.store(false, std::memory_order_relaxed);
     delete g_resp_drainer;
     g_resp_drainer = new std::thread(resp_drainer_loop);
     g_lane_enabled.store(true, std::memory_order_release);
-  } else if (enable == 0 && g_lane_enabled.load()) {
+  } else if (enable == 0 &&
+             g_lane_enabled.load(std::memory_order_acquire)) {
     g_lane_enabled.store(false, std::memory_order_release);
     ring_shutdown(req_ring());
     ring_shutdown(resp_ring());
-    g_drainer_stop.store(true);
+    g_drainer_stop.store(true, std::memory_order_relaxed);
     if (g_resp_drainer != nullptr && g_resp_drainer->joinable()) {
       g_resp_drainer->join();
     }
@@ -489,7 +490,7 @@ int nat_shm_worker_attach(const char* name) {
   // the attach IS the first heartbeat: requests arriving between attach
   // and the worker's first take must route to the ring, not fall back
   g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
-  g_seg->attached.fetch_add(1);
+  g_seg->attached.fetch_add(1, std::memory_order_release);
   return 0;
 }
 

@@ -1,0 +1,191 @@
+// nat_smoke — sanitizer-lane smoke driver (tools/natcheck pass 2).
+//
+// Links libbrpc_tpu_native*.so through the public C API (nat_api.h) and
+// exercises the subset the sanitizer lanes gate on: echo (full native
+// client+server framework path, sync + async), http (native parse +
+// native usercode round trips), redis (native store), stats (counters,
+// histograms, span drain), and clean exit — the process returns 0 with
+// the scheduler's detached worker threads still live, which is exactly
+// the static-destructor-vs-detached-thread class PR 1 fixed and the
+// static-dtor lint now guards.
+//
+// Run under `make -C native asan` / `make -C native tsan` artifacts; an
+// uninstrumented `make -C native nat_smoke` exists for debugging.
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+
+#include <atomic>
+#include <chrono>
+#include <string>
+#include <thread>
+
+#include "nat_api.h"
+#include "nat_stats.h"  // full NatSpanRec layout for the drain buffer
+
+static int g_failures = 0;
+
+#define CHECK(cond, what)                                        \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      fprintf(stderr, "SMOKE FAIL: %s (%s:%d)\n", what, __FILE__, \
+              __LINE__);                                         \
+      g_failures++;                                              \
+    }                                                            \
+  } while (0)
+
+static std::atomic<int> g_acall_done{0};
+static std::atomic<int> g_acall_ok{0};
+
+static void acall_done(void*, int32_t code, const char* resp, size_t n) {
+  if (code == 0 && n == 16 && memcmp(resp, "abcdefghijklmnop", 16) == 0) {
+    g_acall_ok.fetch_add(1, std::memory_order_relaxed);
+  }
+  g_acall_done.fetch_add(1, std::memory_order_relaxed);
+}
+
+int main() {
+  // ---- selftests (wsq / iobuf / meta) ----
+  CHECK(nat_wsq_selftest() == 0, "wsq selftest");
+  CHECK(nat_iobuf_selftest() == 0, "iobuf selftest");
+  CHECK(nat_meta_selftest() == 0, "meta selftest");
+
+  // ---- server up, all native lanes on ----
+  nat_stats_enable_spans(1);  // record every call: exercises the span ring
+  int port = nat_rpc_server_start("127.0.0.1", 0, 2, 1);
+  CHECK(port > 0, "rpc server start");
+  if (port <= 0) return 1;
+  CHECK(nat_rpc_server_native_http(1) == 0, "enable native http");
+  CHECK(nat_rpc_server_redis(2) == 0, "enable native redis store");
+
+  // concurrent span drainer: races the seqlock span ring against the
+  // traffic below (the TSan lane must SEE the writer/reader overlap —
+  // a drain after traffic stops would never exercise it)
+  std::atomic<bool> drain_stop{false};
+  std::atomic<int> drained_total{0};
+  std::thread drainer([&] {
+    brpc_tpu::NatSpanRec* buf = (brpc_tpu::NatSpanRec*)calloc(
+        256, sizeof(brpc_tpu::NatSpanRec));
+    while (!drain_stop.load(std::memory_order_acquire)) {
+      drained_total.fetch_add(nat_stats_drain_spans(buf, 256),
+                              std::memory_order_relaxed);
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+    free(buf);
+  });
+
+  // ---- echo lane: sync calls through the framework client ----
+  void* ch = nat_channel_open("127.0.0.1", port, 0, 0, 0, 0);
+  CHECK(ch != nullptr, "channel open");
+  if (ch != nullptr) {
+    for (int i = 0; i < 25; i++) {
+      char* resp = nullptr;
+      size_t rlen = 0;
+      char* err = nullptr;
+      int rc = nat_channel_call_full(ch, "EchoService", "Echo",
+                                     "hello-natcheck", 14, 2000, 0, 0,
+                                     &resp, &rlen, &err);
+      CHECK(rc == 0, "echo call rc");
+      CHECK(rlen == 14 && resp != nullptr &&
+                memcmp(resp, "hello-natcheck", 14) == 0,
+            "echo payload");
+      if (resp != nullptr) nat_buf_free(resp);
+      if (err != nullptr) nat_buf_free(err);
+    }
+    // async lane: done closures run on fibers
+    for (int i = 0; i < 16; i++) {
+      int rc = nat_channel_acall(ch, "EchoService", "Echo",
+                                 "abcdefghijklmnop", 16, 2000, acall_done,
+                                 nullptr);
+      CHECK(rc == 0, "acall queue");
+    }
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::seconds(30);
+    while (g_acall_done.load(std::memory_order_relaxed) < 16 &&
+           std::chrono::steady_clock::now() < deadline) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    }
+    CHECK(g_acall_done.load(std::memory_order_relaxed) == 16,
+          "all acalls completed");
+    CHECK(g_acall_ok.load(std::memory_order_relaxed) == 16,
+          "all acalls echoed");
+    nat_channel_close(ch);
+  }
+
+  // a short fiber-load burst: spawn/steal/park under instrumentation
+  uint64_t reqs = 0;
+  double qps = nat_rpc_client_bench("127.0.0.1", port, 2, 8, 0.3, 16,
+                                    &reqs);
+  CHECK(qps > 0 && reqs > 0, "echo bench lane");
+
+  // ---- http lane: native parse + native usercode ----
+  void* hch = nat_channel_open_proto("127.0.0.1", port, 0, 0, 0, 0, 1,
+                                     nullptr);
+  CHECK(hch != nullptr, "http channel open");
+  if (hch != nullptr) {
+    for (int i = 0; i < 10; i++) {
+      int status = 0;
+      char* resp = nullptr;
+      size_t rlen = 0;
+      int rc = nat_http_call(hch, "GET", "/echo", nullptr, nullptr, 0,
+                             2000, &status, &resp, &rlen);
+      CHECK(rc == 0 && status == 200, "http GET /echo");
+      CHECK(rlen == 4 && resp != nullptr && memcmp(resp, "pong", 4) == 0,
+            "http GET body");
+      if (resp != nullptr) nat_buf_free(resp);
+    }
+    int status = 0;
+    char* resp = nullptr;
+    size_t rlen = 0;
+    int rc = nat_http_call(hch, "POST", "/echo", nullptr, "body-echo", 9,
+                           2000, &status, &resp, &rlen);
+    CHECK(rc == 0 && status == 200 && rlen == 9 && resp != nullptr &&
+              memcmp(resp, "body-echo", 9) == 0,
+          "http POST echo");
+    if (resp != nullptr) nat_buf_free(resp);
+    nat_channel_close(hch);
+  }
+
+  // ---- redis lane: native store under pipelined load ----
+  uint64_t redis_reqs = 0;
+  double redis_qps = nat_redis_client_bench("127.0.0.1", port, 1, 8, 0.2,
+                                            &redis_reqs);
+  CHECK(redis_qps > 0 && redis_reqs > 0, "redis bench lane");
+
+  // ---- stats surface: counters, histograms, spans ----
+  int nc = nat_stats_counter_count();
+  CHECK(nc > 0, "counter count");
+  uint64_t* vals = (uint64_t*)calloc((size_t)nc, sizeof(uint64_t));
+  CHECK(nat_stats_counters(vals, nc) == nc, "counter snapshot");
+  uint64_t msgs_in = 0, http_in = 0, redis_in = 0;
+  for (int i = 0; i < nc; i++) {
+    const char* nm = nat_stats_counter_name(i);
+    if (strcmp(nm, "nat_tpu_std_msgs_in") == 0) msgs_in = vals[i];
+    if (strcmp(nm, "nat_http_msgs_in") == 0) http_in = vals[i];
+    if (strcmp(nm, "nat_redis_msgs_in") == 0) redis_in = vals[i];
+  }
+  free(vals);
+  CHECK(msgs_in >= 41u, "tpu_std msgs counted");
+  CHECK(http_in >= 11u, "http msgs counted");
+  CHECK(redis_in >= 1u, "redis msgs counted");
+  CHECK(nat_stats_hist_quantile(0, 0.5) > 0.0, "echo latency histogram");
+  drain_stop.store(true, std::memory_order_release);
+  drainer.join();
+  brpc_tpu::NatSpanRec* spans = (brpc_tpu::NatSpanRec*)calloc(
+      512, sizeof(brpc_tpu::NatSpanRec));
+  int nspans = nat_stats_drain_spans(spans, 512);
+  free(spans);
+  CHECK(drained_total.load(std::memory_order_relaxed) + nspans > 0,
+        "span ring drained");
+  nat_stats_reset();
+
+  // ---- clean exit: stop the server, leave the scheduler's detached
+  // workers running — process must still exit 0 (the PR-1 class) ----
+  nat_rpc_server_stop();
+  if (g_failures != 0) {
+    fprintf(stderr, "nat_smoke: %d failure(s)\n", g_failures);
+    return 1;
+  }
+  printf("nat_smoke: ok\n");
+  return 0;
+}

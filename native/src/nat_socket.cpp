@@ -197,7 +197,7 @@ void NatSocket::reset_for_reuse() {
 }
 
 void NatSocket::set_failed() {
-  bool was = failed.exchange(true);
+  bool was = failed.exchange(true, std::memory_order_seq_cst);
   if (was) return;
   {
     int64_t rr = ring_ref.exchange(-1, std::memory_order_acq_rel);
@@ -247,7 +247,7 @@ void NatSocket::set_failed() {
       h2c_fail_own_streams(this, kEFAILEDSOCKET, "socket failed");
     }
   }
-  if (server != nullptr) server->connections.fetch_sub(1);
+  if (server != nullptr) server->connections.fetch_sub(1, std::memory_order_relaxed);
   sock_unregister(this);
   release();  // drop the registry's reference
 }
@@ -434,7 +434,8 @@ void kick_epoll_writer_if_stranded(NatSocket* s) {
 // Moves a ring socket to the epoll lane (rearm impossible / multishot
 // unsupported); the CAS makes demotion and set_failed mutually exclusive.
 static void ring_demote_to_epoll(NatSocket* s, int64_t rr) {
-  if (s->ring_ref.compare_exchange_strong(rr, -1)) {
+  if (s->ring_ref.compare_exchange_strong(rr, -1,
+                                          std::memory_order_seq_cst)) {
     g_ring->unregister_file((int)(rr & 0xffffffff));
     s->disp->add_consumer(s);
     kick_epoll_writer_if_stranded(s);

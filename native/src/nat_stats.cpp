@@ -2,6 +2,7 @@
 // snapshot surface consumed by brpc_tpu/native via ctypes (the /vars,
 // /brpc_metrics and /rpcz data source for native traffic). See nat_stats.h
 // for the design map to bvar.
+#include "nat_api.h"
 #include "nat_stats.h"
 
 #include <mutex>

@@ -158,14 +158,14 @@ bool RingListener::init(unsigned entries) {
     shutdown();
     return false;
   }
-  stop_.store(false);
+  stop_.store(false, std::memory_order_relaxed);
   poller_ = std::thread([this] { poller_loop(); });
   return true;
 }
 
 void RingListener::shutdown() {
   if (ring_fd_ < 0) return;
-  stop_.store(true);
+  stop_.store(true, std::memory_order_relaxed);
   // a NOP submission breaks the poller out of GETEVENTS
   {
     std::lock_guard<std::mutex> g(sq_mu_);

@@ -9,6 +9,21 @@
 #include <cstdio>
 #include <random>
 
+// Sanitizer fiber protocol (ThreadSanitizer/ASan practice for custom
+// context switching, per the compiler-rt fiber interfaces): the asm
+// fctx_swap is invisible to the runtimes, so every switch tells ASan
+// which stack becomes live (__sanitizer_start/finish_switch_fiber) and
+// TSan which logical thread runs (__tsan_switch_to_fiber). Without these
+// the sanitizer lanes (make asan / make tsan) report stack-buffer
+// false positives on every fiber hop.
+#if defined(__SANITIZE_ADDRESS__)
+#include <pthread.h>
+#include <sanitizer/common_interface_defs.h>
+#endif
+#if defined(__SANITIZE_THREAD__)
+#include <sanitizer/tsan_interface.h>
+#endif
+
 namespace brpc_tpu {
 
 #if BRPC_TPU_FCTX
@@ -106,8 +121,8 @@ void Worker::signal() {
   // seq_cst store-then-load pairs with the waiter's parked-then-recheck
   // (Dekker): either we see parked > 0 and notify, or the waiter's
   // park_signal recheck sees our bump and skips the sleep.
-  park_signal.fetch_add(1);
-  if (parked.load() > 0) {
+  park_signal.fetch_add(1, std::memory_order_seq_cst);
+  if (parked.load(std::memory_order_seq_cst) > 0) {
     {
       std::lock_guard<std::mutex> g(park_mu);
     }
@@ -162,20 +177,59 @@ void Scheduler::stop() {
 
 
 // Switch the running fiber out to this worker's main loop / resume a fiber.
-static inline void switch_out_to_main(Worker* w, Fiber* f) {
+// `terminal` = the fiber is finishing and will never resume: its ASan fake
+// stack is released instead of saved.
+static inline void switch_out_to_main(Worker* w, Fiber* f,
+                                      bool terminal = false) {
+#if defined(__SANITIZE_ADDRESS__)
+  __sanitizer_start_switch_fiber(terminal ? nullptr : &f->asan_fake_stack,
+                                 w->pthread_stack_bottom,
+                                 w->pthread_stack_size);
+#else
+  (void)terminal;
+#endif
+#if defined(__SANITIZE_THREAD__)
+  __tsan_switch_to_fiber(w->tsan_main_fiber, 0);
+#endif
 #if BRPC_TPU_FCTX
   fctx_swap(&f->sp, w->main_sp);
 #else
   swapcontext(&f->ctx, &w->main_ctx);
 #endif
+#if defined(__SANITIZE_ADDRESS__)
+  // resumed (possibly on a different worker thread)
+  __sanitizer_finish_switch_fiber(f->asan_fake_stack, nullptr, nullptr);
+#endif
 }
 static inline void switch_into_fiber(Worker* w, Fiber* f) {
+#if defined(__SANITIZE_ADDRESS__)
+  __sanitizer_start_switch_fiber(&w->asan_fake_stack, f->stack,
+                                 f->stack_size);
+#endif
+#if defined(__SANITIZE_THREAD__)
+  __tsan_switch_to_fiber(f->tsan_fiber, 0);
+#endif
 #if BRPC_TPU_FCTX
   fctx_swap(&w->main_sp, f->sp);
 #else
   swapcontext(&w->main_ctx, &f->ctx);
 #endif
+#if defined(__SANITIZE_ADDRESS__)
+  __sanitizer_finish_switch_fiber(w->asan_fake_stack, nullptr, nullptr);
+#endif
 }
+
+#if defined(__SANITIZE_THREAD__)
+static void sanitize_fiber_create(Fiber* f) {
+  f->tsan_fiber = __tsan_create_fiber(0);
+}
+static void sanitize_fiber_destroy(Fiber* f) {
+  if (f->tsan_fiber != nullptr) __tsan_destroy_fiber(f->tsan_fiber);
+}
+#else
+static inline void sanitize_fiber_create(Fiber*) {}
+static inline void sanitize_fiber_destroy(Fiber*) {}
+#endif
 
 static void fiber_trampoline();
 
@@ -197,6 +251,7 @@ Fiber* Scheduler::spawn(FiberFn fn, void* arg) {
   f->arg = arg;
   f->stack = alloc_stack(kStackSize);
   f->stack_size = kStackSize;
+  sanitize_fiber_create(f);
   init_fiber_ctx(f);
   ready_fiber(f);
   return f;
@@ -209,6 +264,7 @@ void Scheduler::spawn_detached(FiberFn fn, void* arg) {
   f->arg = arg;
   f->stack = alloc_stack(kStackSize);
   f->stack_size = kStackSize;
+  sanitize_fiber_create(f);
   init_fiber_ctx(f);
   ready_fiber(f);
 }
@@ -220,11 +276,13 @@ void Scheduler::spawn_detached_back(FiberFn fn, void* arg) {
   f->arg = arg;
   f->stack = alloc_stack(kStackSize);
   f->stack_size = kStackSize;
+  sanitize_fiber_create(f);
   init_fiber_ctx(f);
   f->state.store(FiberState::READY, std::memory_order_release);
   // Remote queues are FIFO and drained only when the local deque is empty:
   // every already-ready producer runs before this fiber.
-  uint32_t idx = next_worker_.fetch_add(1) % workers_.size();
+  uint32_t idx =
+      next_worker_.fetch_add(1, std::memory_order_relaxed) % workers_.size();
   Worker* target = workers_[idx];
   {
     std::lock_guard<std::mutex> g(target->remote_mu);
@@ -244,7 +302,8 @@ void Scheduler::flush_wake_batch() {
   size_t n = batch->size();
   size_t nw = workers_.size();
   size_t chunks = n < nw ? n : nw;
-  uint32_t base = next_worker_.fetch_add((uint32_t)chunks);
+  uint32_t base =
+      next_worker_.fetch_add((uint32_t)chunks, std::memory_order_relaxed);
   size_t idx = 0;
   for (size_t c = 0; c < chunks; c++) {
     size_t take = n / chunks + (c < n % chunks ? 1 : 0);
@@ -278,7 +337,8 @@ void Scheduler::ready_fiber(Fiber* f) {
   }
   // From a non-worker thread (or full local queue): remote-queue a worker
   // round-robin and wake it (start_background REMOTE path).
-  uint32_t idx = next_worker_.fetch_add(1) % workers_.size();
+  uint32_t idx =
+      next_worker_.fetch_add(1, std::memory_order_relaxed) % workers_.size();
   Worker* target = workers_[idx];
   {
     std::lock_guard<std::mutex> g(target->remote_mu);
@@ -321,6 +381,10 @@ Fiber* Scheduler::next_task(Worker* w) {
 }
 
 static void fiber_trampoline() {
+#if defined(__SANITIZE_ADDRESS__)
+  // first entry into this context: no prior fake stack to restore
+  __sanitizer_finish_switch_fiber(nullptr, nullptr, nullptr);
+#endif
   Worker* w = current_worker();
   Fiber* f = w->current;
   f->fn(f->arg);
@@ -335,7 +399,7 @@ static void fiber_trampoline() {
   w->remained_op = f->detached ? Worker::RemainedOp::FINISH_DETACHED
                                : Worker::RemainedOp::FINISH_JOINABLE;
   w->remained_fiber = f;
-  switch_out_to_main(w, f);
+  switch_out_to_main(w, f, /*terminal=*/true);
 }
 
 void Scheduler::run_fiber(Worker* w, Fiber* f) {
@@ -384,6 +448,7 @@ void Scheduler::run_fiber(Worker* w, Fiber* f) {
     case Worker::RemainedOp::FINISH_DETACHED: {
       Fiber* rf = w->remained_fiber;
       w->remained_op = Worker::RemainedOp::NONE;
+      sanitize_fiber_destroy(rf);
       free_stack(rf->stack, rf->stack_size);
       delete rf;
       break;
@@ -393,6 +458,21 @@ void Scheduler::run_fiber(Worker* w, Fiber* f) {
 
 void Scheduler::worker_loop(Worker* w) {
   tls_worker = w;
+#if defined(__SANITIZE_ADDRESS__)
+  {
+    pthread_attr_t attr;
+    pthread_getattr_np(pthread_self(), &attr);
+    void* addr = nullptr;
+    size_t sz = 0;
+    pthread_attr_getstack(&attr, &addr, &sz);
+    pthread_attr_destroy(&attr);
+    w->pthread_stack_bottom = addr;
+    w->pthread_stack_size = sz;
+  }
+#endif
+#if defined(__SANITIZE_THREAD__)
+  w->tsan_main_fiber = __tsan_get_current_fiber();
+#endif
   while (!stopping_.load(std::memory_order_acquire)) {
     // Read the lot BEFORE scanning queues: a push+signal landing between
     // the scan and the park is then visible as a changed park_signal and
@@ -435,13 +515,13 @@ void Scheduler::worker_loop(Worker* w) {
     // Publish parked BEFORE the final recheck (Dekker pairing with
     // signal()'s bump-then-load): a signaler that misses parked>0 must
     // have bumped before our recheck, which then sees it and skips.
-    w->parked.fetch_add(1);
-    if (w->park_signal.load() != expected) {
-      w->parked.fetch_sub(1);
+    w->parked.fetch_add(1, std::memory_order_seq_cst);
+    if (w->park_signal.load(std::memory_order_seq_cst) != expected) {
+      w->parked.fetch_sub(1, std::memory_order_relaxed);
       continue;
     }
-    w->park_cv.wait_for(lk, std::chrono::milliseconds(100));
-    w->parked.fetch_sub(1);
+    nat_cv_wait_for(w->park_cv, lk, std::chrono::milliseconds(100));
+    w->parked.fetch_sub(1, std::memory_order_relaxed);
   }
   tls_worker = nullptr;
 }
@@ -475,7 +555,7 @@ bool Scheduler::butex_wait(Butex* b, int32_t expected) {
     b->nwaiters.fetch_add(1, std::memory_order_seq_cst);
     while (b->value.load(std::memory_order_acquire) == expected) {
       ++b->pthread_waiters;
-      b->pthread_cv.wait_for(g, std::chrono::milliseconds(100));
+      nat_cv_wait_for(b->pthread_cv, g, std::chrono::milliseconds(100));
       --b->pthread_waiters;
     }
     b->nwaiters.fetch_sub(1, std::memory_order_relaxed);
@@ -527,6 +607,7 @@ void Scheduler::join(Fiber* f) {
   // Synchronize with the completion wake: once we hold/release the butex
   // mutex, the finishing worker is done touching the waiter list.
   { std::lock_guard<std::mutex> g(f->join_butex.mu); }
+  sanitize_fiber_destroy(f);
   free_stack(f->stack, f->stack_size);
   delete f;
 }

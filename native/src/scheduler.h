@@ -11,6 +11,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
@@ -24,6 +25,25 @@
 #include "wsq.h"
 
 namespace brpc_tpu {
+
+// Timed condition-variable wait for runtime poll loops. TSan's interceptor
+// set (gcc 10) lacks pthread_cond_clockwait, which libstdc++ uses for the
+// steady-clock wait_for: the runtime then never observes the mutex release
+// inside the wait and reports a phantom "double lock" against every waker.
+// Under TSan only, route through wait_until on the system clock
+// (pthread_cond_timedwait, which IS intercepted); production builds keep
+// the steady-clock wait_for. All call sites are periodic poll loops that
+// recheck state, so a clock jump costs at most one early/late poll tick.
+template <typename Rep, typename Period>
+inline void nat_cv_wait_for(std::condition_variable& cv,
+                            std::unique_lock<std::mutex>& lk,
+                            std::chrono::duration<Rep, Period> d) {
+#if defined(__SANITIZE_THREAD__)
+  cv.wait_until(lk, std::chrono::system_clock::now() + d);
+#else
+  cv.wait_for(lk, d);
+#endif
+}
 
 using FiberFn = void (*)(void*);
 
@@ -62,6 +82,12 @@ struct Fiber {
 #else
   ucontext_t ctx;
 #endif
+#if defined(__SANITIZE_ADDRESS__)
+  void* asan_fake_stack = nullptr;  // fake-stack save across switches
+#endif
+#if defined(__SANITIZE_THREAD__)
+  void* tsan_fiber = nullptr;  // TSan context (__tsan_create_fiber)
+#endif
   char* stack = nullptr;
   size_t stack_size = 0;
   FiberFn fn = nullptr;
@@ -89,6 +115,14 @@ class Worker {
   void* main_sp = nullptr;  // worker loop's saved context
 #else
   ucontext_t main_ctx;  // the worker loop's context
+#endif
+#if defined(__SANITIZE_ADDRESS__)
+  void* asan_fake_stack = nullptr;      // main context's fake-stack save
+  const void* pthread_stack_bottom = nullptr;  // this worker's own stack
+  size_t pthread_stack_size = 0;
+#endif
+#if defined(__SANITIZE_THREAD__)
+  void* tsan_main_fiber = nullptr;  // worker thread's implicit TSan fiber
 #endif
   Fiber* current = nullptr;
   uint64_t nswitch = 0;

@@ -1,5 +1,7 @@
 #include "timer_thread.h"
 
+#include "scheduler.h"  // nat_cv_wait_for
+
 #include <chrono>
 
 namespace brpc_tpu {
@@ -94,7 +96,7 @@ void TimerThread::run() {
     int64_t wait_us = next == INT64_MAX ? 100000 : next - now_us();
     if (wait_us > 100000) wait_us = 100000;  // re-scan staged periodically
     if (wait_us > 0) {
-      run_cv_.wait_for(lk, std::chrono::microseconds(wait_us));
+      nat_cv_wait_for(run_cv_, lk, std::chrono::microseconds(wait_us));
     }
   }
 }

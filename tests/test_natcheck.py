@@ -1,0 +1,300 @@
+"""natcheck golden tests — the checker must fail on seeded defects.
+
+A checker that never fires is indistinguishable from one that works, so
+each pass gets a deliberate defect injected into a temp copy and must
+flag it: an ABI struct-field reorder, a missing-argtypes declaration, a
+wrong scalar width, a memory_order-less atomic, a nontrivial-destructor
+static in a thread-spawning file, and a seqlock reader with no re-check.
+The shipped tree itself must come back clean.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.natcheck import abi, lint  # noqa: E402
+
+BINDINGS = os.path.join(REPO, "brpc_tpu", "native", "__init__.py")
+
+
+# ---------------------------------------------------------------------------
+# ABI pass (needs the toolchain to build the manifest generator)
+# ---------------------------------------------------------------------------
+
+def _have_toolchain():
+    return shutil.which("make") and shutil.which("g++")
+
+
+@pytest.fixture(scope="module")
+def manifest():
+    if not _have_toolchain():
+        pytest.skip("native toolchain unavailable")
+    try:
+        return abi.build_manifest()
+    except subprocess.CalledProcessError as e:
+        pytest.fail("nat_abi build failed: %s" % e.stderr[-500:])
+
+
+@pytest.fixture()
+def bindings_src():
+    with open(BINDINGS, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_abi_clean_on_shipped_tree(manifest):
+    findings = abi.check_abi(manifest, [BINDINGS])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_abi_flags_struct_field_reorder(manifest, bindings_src, tmp_path):
+    old = ('("trace_id", ctypes.c_uint64),\n'
+           '        ("span_id", ctypes.c_uint64),')
+    new = ('("span_id", ctypes.c_uint64),\n'
+           '        ("trace_id", ctypes.c_uint64),')
+    assert old in bindings_src
+    p = tmp_path / "reorder.py"
+    p.write_text(bindings_src.replace(old, new))
+    findings = abi.check_abi(manifest, [str(p)])
+    assert any(f.rule == "struct-layout" for f in findings), findings
+
+
+def test_abi_flags_missing_argtypes(manifest, bindings_src, tmp_path):
+    line = "        lib.nat_sched_start.argtypes = [ctypes.c_int]\n"
+    assert line in bindings_src
+    p = tmp_path / "noargs.py"
+    p.write_text(bindings_src.replace(line, ""))
+    findings = abi.check_abi(manifest, [str(p)])
+    assert any(f.rule == "missing-argtypes" and "nat_sched_start"
+               in f.message for f in findings), findings
+
+
+def test_abi_flags_wrong_scalar_width(manifest, bindings_src, tmp_path):
+    old = "lib.nat_sched_start.argtypes = [ctypes.c_int]"
+    p = tmp_path / "badtype.py"
+    p.write_text(bindings_src.replace(
+        old, "lib.nat_sched_start.argtypes = [ctypes.c_uint64]"))
+    findings = abi.check_abi(manifest, [str(p)])
+    assert any(f.rule == "argtype-mismatch" for f in findings), findings
+
+
+def test_abi_fields_may_reference_module_constants(manifest, bindings_src,
+                                                   tmp_path):
+    # `("method", ctypes.c_char * METHOD_LEN)` with a module-level
+    # constant is a natural refactor and must parse (not crash the pass)
+    old = '("method", ctypes.c_char * 48),'
+    assert old in bindings_src
+    p = tmp_path / "const.py"
+    p.write_text("METHOD_LEN = 48\n" + bindings_src.replace(
+        old, '("method", ctypes.c_char * METHOD_LEN),'))
+    findings = abi.check_abi(manifest, [str(p)])
+    assert findings == [], findings
+
+
+def test_abi_unresolvable_fields_is_finding_not_crash(manifest,
+                                                      bindings_src,
+                                                      tmp_path):
+    p = tmp_path / "badconst.py"
+    p.write_text(bindings_src.replace(
+        '("method", ctypes.c_char * 48),',
+        '("method", ctypes.c_char * NO_SUCH_CONSTANT),'))
+    findings = abi.check_abi(manifest, [str(p)])
+    assert any(f.rule == "struct-parse" for f in findings), findings
+
+
+def test_abi_flags_unknown_symbol(manifest, bindings_src, tmp_path):
+    p = tmp_path / "ghost.py"
+    p.write_text(bindings_src +
+                 "\n_g = None\n"
+                 "def _declare(lib):\n"
+                 "    lib.nat_no_such_export.restype = ctypes.c_int\n")
+    findings = abi.check_abi(manifest, [str(p)])
+    assert any(f.rule == "unknown-symbol" for f in findings), findings
+
+
+def test_abi_flags_fully_undeclared_symbol(manifest, bindings_src,
+                                           tmp_path):
+    # dropping BOTH argtypes and restype must still be a finding: the
+    # symbol would run through CDLL's unchecked attribute fallback
+    src = bindings_src.replace(
+        "        lib.nat_sched_start.argtypes = [ctypes.c_int]\n", ""
+    ).replace("        lib.nat_sched_start.restype = ctypes.c_int\n", "")
+    assert "nat_sched_start.argtypes" not in src
+    p = tmp_path / "undeclared.py"
+    p.write_text(src)
+    findings = abi.check_abi(manifest, [str(p)])
+    assert any(f.rule == "unbound-symbol" and "nat_sched_start"
+               in f.message for f in findings), findings
+
+
+def test_abi_flags_stale_manifest_vs_exports(manifest):
+    exports = set(manifest["symbols"]) | {"nat_added_without_decl"}
+    findings = abi.check_abi(manifest, [BINDINGS], exports)
+    assert any(f.rule == "unmanifested-export" for f in findings), findings
+
+
+# ---------------------------------------------------------------------------
+# lint pass (pure Python, no toolchain needed)
+# ---------------------------------------------------------------------------
+
+def _lint_one(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    # mirror lint.run(): class-body analysis sees scrubbed text only
+    nontrivial = lint._nontrivial_classes({str(p): lint._scrub(text)})
+    return lint.lint_file(str(p), text, nontrivial)
+
+
+def test_lint_clean_on_shipped_tree():
+    findings = lint.run()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_lint_flags_missing_memory_order(tmp_path):
+    findings = _lint_one(tmp_path, "a.cpp", """
+#include <atomic>
+std::atomic<int> g{0};
+int f() { return g.load(); }
+void h() { g.store(1, std::memory_order_release); }
+""")
+    assert [f.rule for f in findings] == ["atomic-order"], findings
+
+
+def test_lint_allows_suppressed_atomic(tmp_path):
+    findings = _lint_one(tmp_path, "a.cpp", """
+#include <atomic>
+std::atomic<int> g{0};
+// natcheck:allow(atomic-order): probe only, any order is fine
+int f() { return g.load(); }
+""")
+    assert findings == [], findings
+
+
+def test_lint_flags_static_dtor_in_thread_spawner(tmp_path):
+    findings = _lint_one(tmp_path, "b.cpp", """
+#include <string>
+#include <thread>
+static std::string g_name = "boom";  // destroyed under live threads
+void start() { std::thread([] {}).detach(); }
+""")
+    assert any(f.rule == "static-dtor" for f in findings), findings
+
+
+def test_lint_static_dtor_needs_thread_spawn(tmp_path):
+    # same static, no thread construction in the file: not this rule
+    findings = _lint_one(tmp_path, "c.cpp", """
+#include <string>
+static std::string g_name = "fine";
+""")
+    assert findings == [], findings
+
+
+def test_lint_static_dtor_skips_functions_and_pointers(tmp_path):
+    findings = _lint_one(tmp_path, "d.cpp", """
+#include <string>
+#include <thread>
+static std::string helper(int a, const std::string& b) { return b; }
+static std::string* g_leaked = new std::string("ok");
+void start() { std::thread([] {}).detach(); }
+""")
+    assert findings == [], findings
+
+
+def test_lint_flags_repo_class_with_nontrivial_member(tmp_path):
+    findings = _lint_one(tmp_path, "e.cpp", """
+#include <thread>
+#include <vector>
+struct Pool { std::vector<int> items; };
+static Pool g_pool;
+void start() { std::thread([] {}).detach(); }
+""")
+    assert any(f.rule == "static-dtor" and "Pool" in f.message
+               for f in findings), findings
+
+
+def test_lint_static_dtor_ignores_pointer_members(tmp_path):
+    # a pointer member (or a parameter/return type mention) of a
+    # nontrivial class must not taint the holder
+    findings = _lint_one(tmp_path, "i.cpp", """
+#include <thread>
+#include <vector>
+struct Pool { std::vector<int> items; };
+struct Reg { int id; Pool* owner; Pool* find(int a); };
+static Reg g_reg;
+void start() { std::thread([] {}).detach(); }
+""")
+    assert findings == [], findings
+
+
+def test_lint_flags_seqlock_reader_without_recheck(tmp_path):
+    findings = _lint_one(tmp_path, "f.cpp", """
+#include <atomic>
+struct Slot { std::atomic<unsigned long> seq; long rec; };
+Slot g_slot;
+long read_once() {
+  if (g_slot.seq.load(std::memory_order_acquire) & 1) return 0;
+  return g_slot.rec;  // no seq re-check: torn read undetected
+}
+""")
+    assert any(f.rule == "seqlock-recheck" for f in findings), findings
+
+
+def test_lint_seqlock_allow_escape_suppresses(tmp_path):
+    # the allow() comment must work on the line above the seq load, and
+    # the finding must anchor at the load even when the object's name
+    # appears earlier as a substring of another identifier
+    findings = _lint_one(tmp_path, "f2.cpp", """
+#include <atomic>
+struct Slot { std::atomic<unsigned long> seq; long rec; };
+Slot sl;
+long read_once(long cached_slx) {
+  (void)cached_slx;
+  // natcheck:allow(seqlock-recheck): single-reader mode, writer stopped
+  if (sl.seq.load(std::memory_order_acquire) & 1) return 0;
+  return sl.rec;
+}
+""")
+    assert findings == [], findings
+
+
+def test_lint_static_dtor_ignores_class_names_in_comments(tmp_path):
+    # a comment mentioning a nontrivial class must not taint the type
+    findings = _lint_one(tmp_path, "h.cpp", """
+#include <thread>
+#include <vector>
+struct Pool { std::vector<int> items; };
+struct Reg { int id;  /* freed by the Pool owner */ };
+static Reg g_reg;
+void start() { std::thread([] {}).detach(); }
+""")
+    assert findings == [], findings
+
+
+def test_lint_seqlock_reader_with_recheck_passes(tmp_path):
+    findings = _lint_one(tmp_path, "g.cpp", """
+#include <atomic>
+struct Slot { std::atomic<unsigned long> seq; long rec; };
+Slot g_slot;
+long read_ok() {
+  unsigned long s1 = g_slot.seq.load(std::memory_order_acquire);
+  long v = g_slot.rec;
+  if (g_slot.seq.load(std::memory_order_acquire) != s1) return -1;
+  return v;
+}
+""")
+    assert findings == [], findings
+
+
+# ---------------------------------------------------------------------------
+# entrypoint wiring
+# ---------------------------------------------------------------------------
+
+def test_cli_lint_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.natcheck", "lint"],
+        cwd=REPO, capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

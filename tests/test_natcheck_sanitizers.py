@@ -1,0 +1,47 @@
+"""Sanitizer lane (slow): the native smoke subset under ASan+UBSan / TSan.
+
+Builds the instrumented .so + smoke driver (`make -C native asan|tsan`)
+and runs echo / http / redis / stats / clean-exit under each, with the
+checked-in suppressions applied. Any unsuppressed report fails. Marked
+slow: two full instrumented builds; run via NATCHECK_SLOW=1 tools/check.sh
+or `pytest -m slow tests/test_natcheck_sanitizers.py`.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.natcheck import san  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+if not (shutil.which("make") and shutil.which("g++")):
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+def _sanitizer_available(flag: str) -> bool:
+    probe = "int main(){return 0;}"
+    proc = subprocess.run(
+        ["g++", "-x", "c++", "-", flag, "-o", os.devnull],
+        input=probe.encode(), capture_output=True, timeout=120)
+    return proc.returncode == 0
+
+
+@pytest.mark.parametrize("kind,flag", [
+    ("asan", "-fsanitize=address"),
+    ("tsan", "-fsanitize=thread"),
+])
+def test_sanitizer_smoke(kind, flag):
+    if not _sanitizer_available(flag):
+        pytest.skip(f"{flag} unsupported by this toolchain")
+    rc, out = san.build_and_run(kind)
+    bad = [ln for ln in out.splitlines()
+           if any(mk in ln for mk in san._BAD_MARKERS)]
+    assert rc == 0 and not bad, (
+        f"{kind} smoke rc={rc}\n" + "\n".join(bad[:10]) + "\n" + out[-1500:])
+    assert "nat_smoke: ok" in out

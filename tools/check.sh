@@ -1,0 +1,18 @@
+#!/bin/sh
+# tools/check.sh — the natcheck gate (also `make -C native check`).
+#
+# Always runs the fast passes: concurrency lint + ABI/FFI contract check.
+# With NATCHECK_SLOW=1 it adds the sanitizer lane (ASan+UBSan and TSan
+# builds of the .so + smoke run under each; several minutes of compile).
+# Exits nonzero on any finding.
+set -eu
+
+cd "$(dirname "$0")/.."
+
+PY="${PYTHON:-python3}"
+
+if [ "${NATCHECK_SLOW:-0}" = "1" ]; then
+    exec "$PY" -m tools.natcheck lint abi san
+else
+    exec "$PY" -m tools.natcheck lint abi
+fi

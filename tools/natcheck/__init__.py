@@ -1,0 +1,46 @@
+"""natcheck — standing correctness tooling for the native runtime.
+
+Three passes over the C++ core and its FFI boundary (see README.md here):
+
+- ``abi``  — cross-checks the compiler-generated ABI manifest
+  (native/nat_abi, built from nat_api.h) against the ctypes declarations
+  in brpc_tpu/native/__init__.py and against ``nm -D`` of the built .so.
+- ``lint`` — regex/clang-agnostic concurrency lint over native/src/
+  enforcing repo invariants (explicit memory_order, no racy exit-time
+  statics in thread-spawning files, seqlock readers re-check).
+- ``san``  — builds the .so under ASan+UBSan and TSan and runs the native
+  smoke driver (echo, http, stats, clean exit) under each.
+
+Entry points: ``python -m tools.natcheck`` or ``make -C native check``
+(which delegates to tools/check.sh).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass
+class Finding:
+    """One checker finding; `where` is file[:line], rule is a short slug."""
+
+    pass_name: str   # "abi" | "lint" | "san"
+    rule: str        # e.g. "atomic-order", "struct-layout"
+    where: str       # "path" or "path:lineno"
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.where}: [{self.pass_name}/{self.rule}] {self.message}"
+
+
+def print_findings(findings, stream=None) -> int:
+    """Print findings one per line; returns the count (0 = clean)."""
+    import sys
+
+    stream = stream or sys.stdout
+    for f in findings:
+        print(str(f), file=stream)
+    return len(findings)

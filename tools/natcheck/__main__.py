@@ -1,0 +1,54 @@
+"""CLI: ``python -m tools.natcheck [abi] [lint] [san]``.
+
+With no pass named, runs the fast pair (lint + abi). ``san`` (or
+NATCHECK_SLOW=1 in tools/check.sh) adds the sanitizer lane. Exits 1 on
+any finding, 2 when a pass could not run at all.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# allow `python tools/natcheck` too, not just -m from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.natcheck import print_findings  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tools.natcheck")
+    ap.add_argument("passes", nargs="*", choices=["abi", "lint", "san", []],
+                    help="passes to run (default: lint abi)")
+    args = ap.parse_args(argv)
+    passes = args.passes or ["lint", "abi"]
+
+    findings = []
+    broken = False
+    for p in passes:
+        try:
+            if p == "lint":
+                from tools.natcheck import lint
+                got = lint.run()
+            elif p == "abi":
+                from tools.natcheck import abi
+                got = abi.run()
+            else:
+                from tools.natcheck import san
+                got = san.run()
+        except Exception as e:  # toolchain missing, build failure, ...
+            print(f"natcheck: {p} pass could not run: {e}", file=sys.stderr)
+            broken = True
+            continue
+        findings.extend(got)
+        print(f"natcheck: {p}: "
+              f"{'clean' if not got else f'{len(got)} finding(s)'}")
+    n = print_findings(findings)
+    if broken:
+        return 2
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,375 @@
+"""ABI/FFI contract checker — the ctypes layer vs the compiled truth.
+
+The native build emits a manifest (native/nat_abi, generated from the
+real declarations in nat_api.h via decltype/offsetof) describing every
+exported symbol's signature and every shared struct's layout. This pass:
+
+1. statically parses the ctypes binding sources (``lib.<sym>.argtypes =
+   [...]`` / ``.restype = ...`` assignments and ``ctypes.Structure``
+   subclasses) with ``ast`` — no import of the bound library needed, so
+   golden tests can point it at perturbed copies;
+2. diffs those declarations against the manifest (canonical type names,
+   struct sizeof/offsetof/field types);
+3. diffs the manifest's symbol set against ``nm -D`` of the built .so, so
+   an export added without a nat_api.h declaration (or a stale .so) fails.
+
+Canonical type names match nat_abi.cpp: i8 u8 i16 u16 i32 u32 i64 u64
+f32 f64 char void fnptr ptr:<T> arr:<N>:<T> struct:<Name>.
+"""
+from __future__ import annotations
+
+import ast
+import ctypes
+import json
+import os
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+from tools.natcheck import Finding, REPO_ROOT
+
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+DEFAULT_BINDINGS = [
+    os.path.join(REPO_ROOT, "brpc_tpu", "native", "__init__.py"),
+    os.path.join(REPO_ROOT, "brpc_tpu", "bvar", "native_vars.py"),
+]
+
+# Exported symbols with NO ctypes declaration, on purpose: consumed only
+# by the native-side harnesses (bench_main / nat_smoke) through nat_api.h.
+# Any other manifest symbol missing from every binding file is a finding
+# — an export reached through ctypes' attribute fallback would run with
+# the default c_int restype and unchecked arguments.
+UNBOUND_OK = {
+    "nat_io_counters",           # bench_main io-per-request stats
+    "nat_rpc_client_bench_bulk", # bench_main bulk lane
+    "nat_http_acall",            # native async http (C embedders only)
+    "nat_grpc_acall",            # native async grpc (C embedders only)
+}
+
+# ---------------------------------------------------------------------------
+# manifest + nm
+# ---------------------------------------------------------------------------
+
+
+def build_manifest(native_dir: str = NATIVE_DIR) -> dict:
+    """Build (if needed) and run the manifest generator."""
+    subprocess.run(["make", "-C", native_dir, "nat_abi"], check=True,
+                   capture_output=True, timeout=600)
+    out = subprocess.run([os.path.join(native_dir, "nat_abi")], check=True,
+                         capture_output=True, timeout=60)
+    return json.loads(out.stdout)
+
+
+def so_exports(so_path: str) -> Optional[set]:
+    """nat_* symbols exported by the .so, or None when nm is unavailable."""
+    try:
+        out = subprocess.run(["nm", "-D", "--defined-only", so_path],
+                             check=True, capture_output=True, timeout=60)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    syms = set()
+    for line in out.stdout.decode(errors="replace").splitlines():
+        parts = line.split()
+        if len(parts) >= 3 and parts[1] == "T" and \
+                parts[2].startswith("nat_"):
+            syms.add(parts[2])
+    return syms
+
+
+# ---------------------------------------------------------------------------
+# ctypes expression evaluation + canonicalization
+# ---------------------------------------------------------------------------
+
+_SCALARS: Dict[type, str] = {
+    ctypes.c_bool: "u8",
+    ctypes.c_byte: "i8",
+    ctypes.c_ubyte: "u8",
+    ctypes.c_short: "i16",
+    ctypes.c_ushort: "u16",
+    ctypes.c_int: "i32",
+    ctypes.c_uint: "u32",
+    ctypes.c_long: "i64" if ctypes.sizeof(ctypes.c_long) == 8 else "i32",
+    ctypes.c_ulong: "u64" if ctypes.sizeof(ctypes.c_ulong) == 8 else "u32",
+    ctypes.c_longlong: "i64",
+    ctypes.c_ulonglong: "u64",
+    ctypes.c_float: "f32",
+    ctypes.c_double: "f64",
+    ctypes.c_char: "char",
+}
+# width-aliases (c_int32 is c_int, c_size_t is c_ulong, ...) collapse via
+# identity in _SCALARS already; nothing more to do.
+
+
+def canon(t) -> str:
+    """Canonical type name of a ctypes declaration (None = void)."""
+    if t is None:
+        return "void"
+    if t is ctypes.c_char_p:
+        return "ptr:char"
+    if t is ctypes.c_void_p:
+        return "ptr:void"
+    if t in _SCALARS:
+        return _SCALARS[t]
+    if isinstance(t, type):
+        if issubclass(t, ctypes._Pointer):  # POINTER(X)
+            return "ptr:" + canon(t._type_)
+        if issubclass(t, ctypes.Array):
+            return f"arr:{t._length_}:" + canon(t._type_)
+        if issubclass(t, ctypes.Structure):
+            return "struct:" + t.__name__
+        if issubclass(t, ctypes._CFuncPtr):
+            return "fnptr"
+    return f"unknown:{t!r}"
+
+
+def compatible(py: str, c: str) -> bool:
+    """Is the ctypes-side canonical type an acceptable mirror of the C one?
+
+    Exact match, or the opaque-pointer idioms: c_void_p stands in for any
+    pointer (handles), and a CFUNCTYPE thunk satisfies a C function
+    pointer (or void*) parameter.
+    """
+    if py == c:
+        return True
+    is_ptr = lambda s: s.startswith("ptr:") or s == "fnptr"  # noqa: E731
+    if py == "ptr:void" and is_ptr(c):
+        return True
+    if py == "fnptr" and (c == "fnptr" or c == "ptr:void"):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# static parse of the binding sources
+# ---------------------------------------------------------------------------
+
+
+class Bindings:
+    """What one Python source declares about the FFI surface."""
+
+    def __init__(self):
+        # symbol -> (lineno, [ctypes]) / (lineno, ctype-or-None)
+        self.argtypes: Dict[str, Tuple[int, list]] = {}
+        self.restype: Dict[str, Tuple[int, object]] = {}
+        # struct name -> (lineno, ctypes.Structure subclass)
+        self.structs: Dict[str, Tuple[int, type]] = {}
+
+
+def parse_bindings(path: str) -> Tuple[Bindings, List[Finding]]:
+    findings: List[Finding] = []
+    b = Bindings()
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    env = {"ctypes": ctypes}
+
+    def ev(node):
+        return eval(compile(ast.Expression(node), path, "eval"), env)  # noqa: S307
+
+    # module-level constants that structs/declarations may reference
+    # (e.g. ACALL_CB = ctypes.CFUNCTYPE(...), METHOD_LEN = 48): evaluated
+    # FIRST, best-effort, order-preserving.
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            try:
+                env[node.targets[0].id] = ev(node.value)
+            except Exception:
+                pass
+
+    for node in ast.walk(tree):
+        # class X(ctypes.Structure): _fields_ = [...]
+        if isinstance(node, ast.ClassDef):
+            is_struct = any(
+                (isinstance(base, ast.Attribute) and
+                 base.attr == "Structure") or
+                (isinstance(base, ast.Name) and base.id == "Structure")
+                for base in node.bases)
+            if not is_struct:
+                continue
+            fields = None
+            bad = None
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "_fields_"
+                        for t in stmt.targets):
+                    try:
+                        fields = ev(stmt.value)
+                    except Exception as e:
+                        bad = e
+            if fields is None:
+                findings.append(Finding(
+                    "abi", "struct-parse", f"{path}:{node.lineno}",
+                    f"ctypes.Structure {node.name}: could not evaluate "
+                    f"_fields_ ({bad})" if bad else
+                    f"ctypes.Structure {node.name} has no literal "
+                    f"_fields_"))
+                continue
+            cls = type(node.name, (ctypes.Structure,),
+                       {"_fields_": fields})
+            env[node.name] = cls
+            b.structs[node.name] = (node.lineno, cls)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute) and
+                tgt.attr in ("argtypes", "restype") and
+                isinstance(tgt.value, ast.Attribute)):
+            continue
+        sym = tgt.value.attr
+        if not sym.startswith("nat_"):
+            continue
+        try:
+            val = ev(node.value)
+        except Exception as e:
+            findings.append(Finding(
+                "abi", "decl-parse", f"{path}:{node.lineno}",
+                f"could not evaluate {sym}.{tgt.attr}: {e}"))
+            continue
+        if tgt.attr == "argtypes":
+            b.argtypes[sym] = (node.lineno, list(val))
+        else:
+            b.restype[sym] = (node.lineno, val)
+    return b, findings
+
+
+# ---------------------------------------------------------------------------
+# the check
+# ---------------------------------------------------------------------------
+
+
+def check_abi(manifest: dict, binding_paths: List[str],
+              exports: Optional[set] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    symbols: Dict[str, dict] = manifest.get("symbols", {})
+    structs: Dict[str, dict] = manifest.get("structs", {})
+
+    # manifest vs nm: both directions must agree
+    if exports is not None:
+        for s in sorted(exports - set(symbols)):
+            findings.append(Finding(
+                "abi", "unmanifested-export", "native/src/nat_api.h",
+                f"{s} is exported by the .so but missing from the ABI "
+                f"manifest — declare it in nat_api.h and add a NAT_SYM "
+                f"row in nat_abi.cpp"))
+        for s in sorted(set(symbols) - exports):
+            findings.append(Finding(
+                "abi", "stale-so", "native/libbrpc_tpu_native.so",
+                f"{s} is in the ABI manifest but not exported by the .so "
+                f"— rebuild (make -C native)"))
+
+    all_bound: set = set()
+    for path in binding_paths:
+        b, parse_findings = parse_bindings(path)
+        findings.extend(parse_findings)
+        rel = os.path.relpath(path, REPO_ROOT)
+        all_bound |= set(b.argtypes) | set(b.restype)
+
+        # ---- structs ----
+        for name, (lineno, cls) in b.structs.items():
+            man = structs.get(name)
+            if man is None:
+                findings.append(Finding(
+                    "abi", "struct-unknown", f"{rel}:{lineno}",
+                    f"ctypes mirror {name} has no native counterpart in "
+                    f"the manifest"))
+                continue
+            if ctypes.sizeof(cls) != man["size"]:
+                findings.append(Finding(
+                    "abi", "struct-layout", f"{rel}:{lineno}",
+                    f"sizeof({name}) mismatch: ctypes "
+                    f"{ctypes.sizeof(cls)} vs native {man['size']}"))
+            pyf = [(fname, getattr(cls, fname).offset,
+                    getattr(cls, fname).size, canon(ftype))
+                   for fname, ftype in cls._fields_]
+            natf = [tuple(row) for row in man["fields"]]
+            if len(pyf) != len(natf):
+                findings.append(Finding(
+                    "abi", "struct-layout", f"{rel}:{lineno}",
+                    f"{name}: field count mismatch: ctypes {len(pyf)} vs "
+                    f"native {len(natf)}"))
+            for (pn, po, ps, pt), (nn, no, ns, nt) in zip(pyf, natf):
+                if pn != nn or po != no or ps != ns or \
+                        not compatible(pt, nt):
+                    findings.append(Finding(
+                        "abi", "struct-layout", f"{rel}:{lineno}",
+                        f"{name}.{pn}: ctypes (name={pn}, off={po}, "
+                        f"size={ps}, {pt}) vs native (name={nn}, off={no},"
+                        f" size={ns}, {nt})"))
+
+        # ---- symbols ----
+        bound = sorted(set(b.argtypes) | set(b.restype))
+        for sym in bound:
+            man = symbols.get(sym)
+            at_line = b.argtypes.get(sym, (0, None))[0]
+            rt_line = b.restype.get(sym, (0, None))[0]
+            line = at_line or rt_line
+            if man is None:
+                findings.append(Finding(
+                    "abi", "unknown-symbol", f"{rel}:{line}",
+                    f"{sym} is declared in ctypes but is not an exported "
+                    f"native symbol"))
+                continue
+            # restype: ctypes defaults to c_int when never assigned —
+            # require an explicit declaration for anything non-void so a
+            # u64/ptr return can never be truncated through the default.
+            if sym in b.restype:
+                py_ret = canon(b.restype[sym][1])
+                if not compatible(py_ret, man["ret"]):
+                    findings.append(Finding(
+                        "abi", "restype-mismatch", f"{rel}:{rt_line}",
+                        f"{sym}: restype {py_ret} vs native {man['ret']}"))
+            elif man["ret"] not in ("i32", "void"):
+                # i32 matches the ctypes default; for void the defaulted
+                # c_int reads a dead register, harmless as long as the
+                # value is unused — only wider/pointer returns truncate.
+                findings.append(Finding(
+                    "abi", "missing-restype", f"{rel}:{line}",
+                    f"{sym} returns {man['ret']} natively but has no "
+                    f"restype (ctypes would truncate through the default "
+                    f"c_int)"))
+            # argtypes: required whenever the native side takes arguments
+            if sym in b.argtypes:
+                py_args = [canon(t) for t in b.argtypes[sym][1]]
+                nat_args = man["args"]
+                if len(py_args) != len(nat_args):
+                    findings.append(Finding(
+                        "abi", "argcount-mismatch", f"{rel}:{at_line}",
+                        f"{sym}: {len(py_args)} argtypes vs native "
+                        f"{len(nat_args)} parameters"))
+                else:
+                    for i, (p, n) in enumerate(zip(py_args, nat_args)):
+                        if not compatible(p, n):
+                            findings.append(Finding(
+                                "abi", "argtype-mismatch",
+                                f"{rel}:{at_line}",
+                                f"{sym}: arg {i} is {p} in ctypes but "
+                                f"{n} natively"))
+            elif man["args"]:
+                findings.append(Finding(
+                    "abi", "missing-argtypes", f"{rel}:{line}",
+                    f"{sym} takes {len(man['args'])} native parameters "
+                    f"but declares no argtypes (every call is unchecked)"))
+
+    # exports with no ctypes declaration anywhere: a Python caller would
+    # reach them through CDLL's attribute fallback (default c_int restype,
+    # unchecked args) — require either a declaration or an UNBOUND_OK
+    # entry saying the symbol is native-harness-only.
+    for sym in sorted(set(symbols) - all_bound - UNBOUND_OK):
+        findings.append(Finding(
+            "abi", "unbound-symbol", "brpc_tpu/native/__init__.py",
+            f"{sym} is exported but has no ctypes argtypes/restype "
+            f"declaration — declare it (or add to abi.UNBOUND_OK if it "
+            f"is consumed only through nat_api.h)"))
+    return findings
+
+
+def run(binding_paths: Optional[List[str]] = None,
+        native_dir: str = NATIVE_DIR) -> List[Finding]:
+    """Build manifest + .so, then cross-check. Raises on build failure."""
+    manifest = build_manifest(native_dir)
+    subprocess.run(["make", "-C", native_dir, "libbrpc_tpu_native.so"],
+                   check=True, capture_output=True, timeout=600)
+    exports = so_exports(os.path.join(native_dir, "libbrpc_tpu_native.so"))
+    return check_abi(manifest, binding_paths or DEFAULT_BINDINGS, exports)

@@ -1,0 +1,294 @@
+"""Concurrency lint — repo invariants over native/src/, regex/clang-agnostic.
+
+Rules (suppress a line with ``// natcheck:allow(<rule>): why`` on the same
+or the preceding line — the why is mandatory review surface, like a
+sanitizer suppressions entry):
+
+- ``atomic-order``: every std::atomic load/store/RMW must name an explicit
+  std::memory_order. Implicit seq_cst hides the author's intent and makes
+  the cheap-on-x86/expensive-on-ARM distinction invisible in review (the
+  single-writer stat cells and Dekker parking-lot patterns here depend on
+  exactly which order each access uses).
+
+- ``static-dtor``: in any file that spawns threads which can outlive
+  ``exit()`` (the runtime's workers/dispatchers/drainers are never joined
+  at process exit), no function-local or namespace-scope ``static`` object
+  of a nontrivially-destructible type. __cxa_atexit destroys such statics
+  while detached threads still use them — the PR-1 bench-exit SIGSEGV
+  class. Leak intentionally instead: ``static T* x = new T;``.
+
+- ``seqlock-recheck``: a reader that loads a seqlock sequence counter and
+  then copies the protected payload must re-load the counter to validate
+  the copy (torn reads are the whole point of the pattern).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+from tools.natcheck import Finding, REPO_ROOT
+
+SRC_DIR = os.path.join(REPO_ROOT, "native", "src")
+
+_ALLOW = re.compile(r"natcheck:allow\(([a-z-]+)\)")
+
+_ATOMIC_METHODS = (
+    r"load|store|exchange|fetch_add|fetch_sub|fetch_and|fetch_or|"
+    r"fetch_xor|compare_exchange_weak|compare_exchange_strong")
+_ATOMIC_CALL = re.compile(r"(?:\.|->)\s*(%s)\s*\(" % _ATOMIC_METHODS)
+
+# thread-spawning file: constructs a std::thread (joined-at-stop or not,
+# the process can always exit() while it runs) or detaches one.
+_SPAWNS_THREAD = re.compile(
+    r"new\s+std::thread|std::thread\s*\(|\.detach\s*\(\s*\)")
+
+_STD_NONTRIVIAL = (
+    r"string|vector|deque|list|map|unordered_map|set|unordered_set|queue|"
+    r"function|shared_ptr|unique_ptr|thread|condition_variable|"
+    r"condition_variable_any|f?stream|ofstream|ifstream|stringstream")
+
+# `static [const] TYPE name ...` where TYPE is a nontrivial std:: type by
+# value (no * / & between type and name). thread_local statics are a
+# different lifetime (thread exit, not process exit) and are not this rule.
+_STATIC_STD = re.compile(
+    r"\bstatic\s+(?:const\s+)?(std::(?:%s)\b(?:<[^;()]*>)?)\s*(?![\w:<])"
+    r"[^;*&()=]*\s+\w+\s*([;({=\[])" % _STD_NONTRIVIAL)
+_STATIC_ANY = re.compile(
+    r"\bstatic\s+(?:const\s+)?([A-Z]\w*)(?:<[^;()]*>)?\s+\w+\s*([;({=\[])")
+_THREAD_LOCAL = re.compile(r"\bthread_local\b")
+
+_SEQ_LOAD = re.compile(r"([A-Za-z_]\w*)\s*(?:\.|->)\s*seq\s*(?:\.|->)\s*"
+                       r"load\s*\(")
+
+
+def _strip_comments_and_strings(line: str) -> str:
+    """Good-enough single-line scrub so tokens in comments/strings don't
+    trip rules (block comments spanning lines are rare in this tree and
+    the suppression mechanism covers stragglers)."""
+    line = re.sub(r'"(?:[^"\\]|\\.)*"', '""', line)
+    line = re.sub(r"'(?:[^'\\]|\\.)*'", "''", line)
+    line = re.sub(r"/\*.*?\*/", "", line)  # single-line block comments
+    line = re.sub(r"//.*", "", line)
+    return line
+
+
+def _allowed(lines: List[str], i: int, rule: str) -> bool:
+    for j in (i, i - 1):
+        if 0 <= j < len(lines):
+            m = _ALLOW.search(lines[j])
+            if m and m.group(1) == rule:
+                return True
+    return False
+
+
+def _balanced_args(text: str, open_idx: int) -> str:
+    """Text inside the paren group opening at open_idx (best effort)."""
+    depth = 0
+    for k in range(open_idx, len(text)):
+        if text[k] == "(":
+            depth += 1
+        elif text[k] == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_idx + 1:k]
+    return text[open_idx + 1:]
+
+
+def _class_bodies(sources: Dict[str, str]) -> Dict[str, str]:
+    """Map class/struct name -> body text, across all sources (crude brace
+    matcher; nested classes fold into the parent, which is fine here)."""
+    bodies: Dict[str, str] = {}
+    decl = re.compile(r"\b(?:class|struct)\s+(\w+)\s*(?:final\s*)?"
+                      r"(?::[^{;]*)?\{")
+    for text in sources.values():
+        for m in decl.finditer(text):
+            depth = 0
+            for k in range(m.end() - 1, len(text)):
+                if text[k] == "{":
+                    depth += 1
+                elif text[k] == "}":
+                    depth -= 1
+                    if depth == 0:
+                        bodies.setdefault(m.group(1),
+                                          text[m.end():k])
+                        break
+    return bodies
+
+
+def _nontrivial_classes(sources: Dict[str, str]) -> set:
+    """Repo-defined types whose static-storage destruction at exit is a
+    hazard: user-declared dtor, or a nontrivially-destructible member
+    held BY VALUE (a pointer/reference member, function parameter, or
+    return type mentioning the type does not make the holder's destructor
+    nontrivial)."""
+    # by-value member declaration: type, whitespace, identifier, then a
+    # declarator terminator — `std::vector<int>* p;` (no whitespace after
+    # the type) and `void f(std::vector<int> v)` (')' terminator) don't
+    # match.
+    member = re.compile(r"\bstd::(?:%s)\b(?:<[^;()]*>)?\s+\w+\s*[;={\[]"
+                        % _STD_NONTRIVIAL)
+    out = set()
+    bodies = _class_bodies(sources)
+    for name, body in bodies.items():
+        if re.search(r"~\s*%s\s*\(" % re.escape(name), body) or \
+                member.search(body):
+            out.add(name)
+    # transitive closure: a class holding a nontrivial class by value
+    changed = True
+    while changed:
+        changed = False
+        for name, body in bodies.items():
+            if name in out:
+                continue
+            if any(re.search(r"\b%s\s+\w+\s*[;={\[]" % re.escape(c), body)
+                   for c in out):
+                out.add(name)
+                changed = True
+    return out
+
+
+def _function_blocks(text: str) -> List[Tuple[int, str]]:
+    """(start_lineno, body) for each top-level brace block following a
+    ')' — i.e. function definitions (crude but effective for this tree)."""
+    blocks = []
+    sig = re.compile(r"\)\s*(?:const\s*)?(?:noexcept\s*)?\{")
+    depth = 0
+    i = 0
+    while i < len(text):
+        m = sig.search(text, i)
+        if not m:
+            break
+        start = m.end() - 1
+        depth = 0
+        for k in range(start, len(text)):
+            if text[k] == "{":
+                depth += 1
+            elif text[k] == "}":
+                depth -= 1
+                if depth == 0:
+                    blocks.append((text.count("\n", 0, start) + 1,
+                                   text[start:k]))
+                    i = k
+                    break
+        else:
+            break
+        i = max(i, m.end())
+    return blocks
+
+
+def lint_file(path: str, text: str, nontrivial: set) -> List[Finding]:
+    findings: List[Finding] = []
+    rel = os.path.relpath(path, REPO_ROOT)
+    lines = text.splitlines()
+    stripped = [_strip_comments_and_strings(ln) for ln in lines]
+
+    # ---- atomic-order -----------------------------------------------------
+    # scan the joined scrubbed text: argument lists often span lines
+    scrubbed = "\n".join(stripped)
+    for m in _ATOMIC_CALL.finditer(scrubbed):
+        args = _balanced_args(scrubbed, m.end() - 1)
+        if "memory_order" in args:
+            continue
+        i = scrubbed.count("\n", 0, m.start())
+        # `.load()`-style calls on non-atomics (IOBuf etc.) don't use
+        # these method names in this tree; exceptions use allow().
+        if _allowed(lines, i, "atomic-order"):
+            continue
+        findings.append(Finding(
+            "lint", "atomic-order", f"{rel}:{i + 1}",
+            f"atomic {m.group(1)}() without an explicit "
+            f"std::memory_order"))
+
+    # ---- static-dtor ------------------------------------------------------
+    def _is_function_def(m) -> bool:
+        # `static std::string helper(args...) {` is a function returning
+        # the type, not a static object: a paren group whose close is
+        # followed by `{` (or by `;` with a parameter-list-shaped inside,
+        # i.e. a forward declaration) is not a variable.
+        if m.group(2) != "(":
+            return False
+        open_idx = m.end() - 1
+        depth, k = 0, open_idx
+        for k in range(open_idx, min(open_idx + 4000, len(scrubbed))):
+            if scrubbed[k] == "(":
+                depth += 1
+            elif scrubbed[k] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+        tail = scrubbed[k + 1:k + 40].lstrip()
+        inside = scrubbed[open_idx + 1:k]
+        if tail.startswith("{"):
+            return True
+        # parameter-list shapes: `const X&`, `int a, int b`, `void`
+        if tail.startswith(";") and re.search(
+                r"(\bconst\b|&|\*|\w+\s+\w+|^\s*void\s*$)", inside):
+            return True
+        return False
+
+    if _SPAWNS_THREAD.search(text):
+        for m in list(_STATIC_STD.finditer(scrubbed)) + \
+                list(_STATIC_ANY.finditer(scrubbed)):
+            hit = m.group(1)
+            if not hit.startswith("std::") and hit not in nontrivial:
+                continue
+            i = scrubbed.count("\n", 0, m.start())
+            if _THREAD_LOCAL.search(stripped[i]):
+                continue
+            if _is_function_def(m):
+                continue
+            if _allowed(lines, i, "static-dtor"):
+                continue
+            findings.append(Finding(
+                "lint", "static-dtor", f"{rel}:{i + 1}",
+                f"static {hit} has a nontrivial destructor in a "
+                f"thread-spawning file — __cxa_atexit destroys it while "
+                f"detached threads may still use it (PR-1 bench-exit "
+                f"SIGSEGV class); leak it instead: static T* x = new T;"))
+
+    # ---- seqlock-recheck --------------------------------------------------
+    for start_line, body in _function_blocks(scrubbed):
+        loads: Dict[str, List[int]] = {}
+        for m in _SEQ_LOAD.finditer(body):
+            loads.setdefault(m.group(1), []).append(m.start())
+        for obj, offs in loads.items():
+            if len(offs) >= 2:
+                continue
+            # payload access on the same object, other than .seq itself
+            if not re.search(r"\b%s\s*(?:\.|->)\s*(?!seq\b)\w+"
+                             % re.escape(obj), body):
+                continue
+            # anchor at the seq.load match itself so the reported line is
+            # right and the allow() escape on/above that line works
+            lineno = start_line + body[:offs[0]].count("\n")
+            if _allowed(lines, lineno - 1, "seqlock-recheck"):
+                continue
+            findings.append(Finding(
+                "lint", "seqlock-recheck", f"{rel}:{lineno}",
+                f"{obj}.seq is loaded once but {obj}'s payload is read — "
+                f"a seqlock reader must re-load the sequence after the "
+                f"copy to reject torn reads"))
+    return findings
+
+
+def _scrub(text: str) -> str:
+    return "\n".join(_strip_comments_and_strings(ln)
+                     for ln in text.splitlines())
+
+
+def run(src_dir: str = SRC_DIR) -> List[Finding]:
+    sources: Dict[str, str] = {}
+    for name in sorted(os.listdir(src_dir)):
+        if name.endswith((".cpp", ".h", ".cc", ".hpp")):
+            p = os.path.join(src_dir, name)
+            with open(p, "r", encoding="utf-8", errors="replace") as f:
+                sources[p] = f.read()
+    # class-body analysis must not see comments/strings: a comment that
+    # merely mentions a nontrivial class name must not taint the type
+    nontrivial = _nontrivial_classes(
+        {p: _scrub(t) for p, t in sources.items()})
+    findings: List[Finding] = []
+    for path, text in sources.items():
+        findings.extend(lint_file(path, text, nontrivial))
+    return findings

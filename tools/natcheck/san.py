@@ -1,0 +1,78 @@
+"""Sanitizer lane — build the .so under ASan+UBSan / TSan, run the smoke.
+
+``make -C native asan`` / ``make -C native tsan`` build the instrumented
+library plus ``nat_smoke_{asan,tsan}``, a driver that links the .so
+through the public C API and exercises the smoke subset: echo (native
+framework calls), http (native HTTP lane round trips), stats (counters +
+span drain), clean exit (the PR-1 static-destructor class — the process
+must return 0 with runtime threads still live).
+
+Suppressions live in native/*.supp; every entry carries a comment saying
+why it is a false positive. An unsuppressed report fails the lane.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import List, Tuple
+
+from tools.natcheck import Finding, REPO_ROOT
+
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+
+_BAD_MARKERS = (
+    "ERROR: AddressSanitizer",
+    "ERROR: LeakSanitizer",
+    "WARNING: ThreadSanitizer",
+    "runtime error:",          # UBSan
+    "SUMMARY: UndefinedBehaviorSanitizer",
+)
+
+
+def _env(kind: str) -> dict:
+    env = dict(os.environ)
+    if kind == "asan":
+        env["ASAN_OPTIONS"] = "abort_on_error=0:exitcode=87"
+        env["UBSAN_OPTIONS"] = "print_stacktrace=1"
+        env["LSAN_OPTIONS"] = (
+            "suppressions=%s" % os.path.join(NATIVE_DIR, "lsan.supp"))
+    else:
+        env["TSAN_OPTIONS"] = (
+            "suppressions=%s:halt_on_error=0:exitcode=86"
+            % os.path.join(NATIVE_DIR, "tsan.supp"))
+    return env
+
+
+def build_and_run(kind: str, timeout: int = 900) -> Tuple[int, str]:
+    """Build the `kind` lane ('asan'|'tsan') and run its smoke binary.
+    Returns (exit code, combined output); raises on build failure."""
+    assert kind in ("asan", "tsan")
+    subprocess.run(["make", "-C", NATIVE_DIR, kind], check=True,
+                   capture_output=True, timeout=timeout)
+    proc = subprocess.run(
+        [os.path.join(NATIVE_DIR, f"nat_smoke_{kind}")],
+        capture_output=True, timeout=timeout, env=_env(kind))
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    return proc.returncode, out
+
+
+def run(kinds=("asan", "tsan")) -> List[Finding]:
+    findings: List[Finding] = []
+    for kind in kinds:
+        try:
+            rc, out = build_and_run(kind)
+        except subprocess.CalledProcessError as e:
+            findings.append(Finding(
+                "san", f"{kind}-build", "native/Makefile",
+                "build failed: " +
+                (e.stderr or b"").decode(errors="replace")[-800:]))
+            continue
+        bad = [ln for ln in out.splitlines()
+               if any(mk in ln for mk in _BAD_MARKERS)]
+        if rc != 0 or bad:
+            head = "; ".join(bad[:3]) if bad else out.strip()[-400:]
+            findings.append(Finding(
+                "san", kind, f"native/nat_smoke_{kind}",
+                f"smoke exited rc={rc}: {head}"))
+    return findings
