@@ -696,6 +696,50 @@ def device_lane_bench() -> dict:
     # still RECOVERING (improving >15% every 2s), bounded at 45s.
     _loopback_stabilize()
 
+    # zero-copy descriptor-ring lane (nat_shm_lane.cpp): two-process push
+    # through the lock-free descriptor rings + blob arena — the native
+    # transport the shm usercode lane and bulk-tensor staging ride
+    # (nat_shm_push_tensor). The small/large record split separates
+    # per-record overhead from raw staging bandwidth: the round-4 byte
+    # rings paid a robust-mutex lock + double memcpy + futex wake per
+    # record, which is exactly what the small-record number would expose.
+    try:
+        import subprocess
+        import sys
+
+        from brpc_tpu import native
+
+        lib = native.load()
+        lib.nat_shm_lane_enable(0)  # retire any earlier lane/segment
+        if lib.nat_shm_lane_create(16 << 20) == 0:
+            name = lib.nat_shm_lane_name().decode()
+            import os as _os
+
+            repo_root = _os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__)))
+            child = subprocess.Popen(
+                [sys.executable, "-c", (
+                    "import sys; sys.path.insert(0, '.')\n"
+                    "from brpc_tpu import native\n"
+                    "lib = native.load()\n"
+                    f"assert lib.nat_shm_worker_attach("
+                    f"{name!r}.encode()) == 0\n"
+                    "lib.nat_shm_worker_drain_bench(8000)\n")],
+                cwd=repo_root)
+            deadline = time.time() + 30
+            while (lib.nat_shm_lane_workers() < 1
+                   and time.time() < deadline):
+                time.sleep(0.05)
+            if lib.nat_shm_lane_workers() >= 1:
+                small = native.shm_push_bench(16 << 10, 1.0)
+                large = native.shm_push_bench(1 << 20, 1.5)
+                out["shm_desc_small_GBps"] = round(small["GBps"], 3)
+                out["shm_desc_GBps"] = round(large["GBps"], 3)
+            lib.nat_shm_lane_enable(0)  # shutdown: child drain exits
+            child.wait(timeout=20)
+    except Exception:
+        pass
+
     # two-process shm push: full RPC + arena descriptor path. Runs
     # FIRST among the tunnel-DMA lanes so h2d/d2h can't depress it.
     try:

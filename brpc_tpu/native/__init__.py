@@ -265,6 +265,17 @@ def load() -> ctypes.CDLL:
         lib.nat_shm_lane_set_timeout_ms.argtypes = [ctypes.c_int]
         lib.nat_shm_lane_set_timeout_ms.restype = ctypes.c_int
         lib.nat_shm_lane_workers.restype = ctypes.c_int
+        lib.nat_shm_lane_max_workers.restype = ctypes.c_int
+        lib.nat_shm_lane_recover_probe.restype = ctypes.c_int
+        lib.nat_shm_push_tensor.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
+        lib.nat_shm_push_tensor.restype = ctypes.c_int
+        lib.nat_shm_push_bench.argtypes = [
+            ctypes.c_size_t, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.nat_shm_push_bench.restype = ctypes.c_double
+        lib.nat_shm_worker_drain_bench.argtypes = [ctypes.c_int]
+        lib.nat_shm_worker_drain_bench.restype = ctypes.c_uint64
         # -- native observability (nat_stats.cpp: per-thread stat cells,
         #    log2 latency histograms, rpcz span ring) --
         lib.nat_stats_counter_count.restype = ctypes.c_int
@@ -792,6 +803,27 @@ def rpc_client_bench(ip: str, port: int, nconn: int = 2,
                                       fibers_per_conn, seconds, payload,
                                       ctypes.byref(out_requests))
     return {"qps": qps, "requests": out_requests.value}
+
+
+# -- shm descriptor-ring lane (nat_shm_lane.cpp) ----------------------------
+
+def shm_push_bench(record_bytes: int, seconds: float = 1.0) -> dict:
+    """Parent-side descriptor-ring throughput probe: push fixed-size
+    records into the blob arena against live worker drains. Returns
+    {"GBps": float, "records": int}. Requires a created lane with at
+    least one attached worker (see nat_shm_worker_attach /
+    shm_worker_drain_bench)."""
+    out = ctypes.c_uint64(0)
+    gbps = load().nat_shm_push_bench(record_bytes, seconds,
+                                     ctypes.byref(out))
+    return {"GBps": gbps, "records": out.value}
+
+
+def shm_worker_drain_bench(idle_exit_ms: int = 1000) -> int:
+    """Worker-side native drain loop: pops descriptors and releases their
+    arena spans in place until the lane shuts down or `idle_exit_ms`
+    passes with no data. Returns the number of records drained."""
+    return load().nat_shm_worker_drain_bench(idle_exit_ms)
 
 
 # -- native observability (nat_stats.cpp) -----------------------------------

@@ -279,6 +279,17 @@ class NativeRuntimeMount:
         import sys
 
         lib = native.load()
+        # the descriptor-ring lane pre-carves per-worker rings/arenas:
+        # slots are bounded (asked of the library, not hand-mirrored) and
+        # extra workers would fail attach and exit silently — clamp loudly
+        max_workers = lib.nat_shm_lane_max_workers()
+        if n > max_workers:
+            import logging
+
+            logging.getLogger("brpc_tpu.native").warning(
+                "py_workers=%d exceeds the shm lane's %d worker slots; "
+                "clamping", n, max_workers)
+            n = max_workers
         if lib.nat_shm_lane_create(0) != 0:
             raise RuntimeError("shm lane creation failed")
         name = lib.nat_shm_lane_name().decode()

@@ -1,10 +1,19 @@
 """Usercode worker process for the shm lane (nat_shm_lane.cpp).
 
 The parent's native runtime parses HTTP/gRPC requests and fans kind-3/4
-dispatch across N of these processes over shared-memory rings — Python
+dispatch across N of these processes over the zero-copy descriptor-ring
+transport — fixed 64-byte descriptors on lock-free per-worker rings,
+payload bytes written once into a shared blob arena and handed to this
+process as views (nat_req_field points straight into the arena; the copy
+below into Python bytes is the only one on the worker side). Python
 usercode scales past one interpreter's GIL the way the reference runs
 usercode on all N workers (server.h:59-285 num_threads,
 details/usercode_backup_pool.h:29-72).
+
+This process holds its slot's ROBUST lifetime fence from attach until
+death; a SIGKILL here surfaces as EOWNERDEAD on the parent's recovery
+probe, which drains what this worker already answered, reaps what it
+consumed, and frees the slot for a replacement.
 
 Invocation (by brpc_tpu.rpc.server, not by hand):
 
@@ -77,13 +86,18 @@ def main(shm_name: str, factory_spec: str) -> int:
                 return 0
             continue
         kind = lib.nat_req_kind(h)
+        if kind == 8:
+            # bulk tensor record (nat_shm_push_tensor): no usercode hook
+            # registered in the default worker — release the span
+            lib.nat_req_free(h)
+            continue
         sock_id = lib.nat_req_sock_id(h)
         seq = lib.nat_req_cid(h)
         verb_or_blank = field(h, 0)
         path = field(h, 1)
         headers = field(h, 4)
         payload = field(h, 2)
-        lib.nat_req_free(h)
+        lib.nat_req_free(h)  # field() copied out: the arena span frees
         try:
             if kind == 3:
                 mount._handle_http(verb_or_blank, path, headers, payload,

@@ -120,6 +120,25 @@ int nat_iobuf_selftest() {
   if (d.length() != big.size() || d.to_string() != big) return 4;
   d.pop_front(99999);
   if (d.length() != 1) return 5;
+  // arena-backed user blocks: foreign memory rides the IOBuf zero-copy;
+  // the release action fires exactly once, on the LAST ref drop
+  static int user_frees = 0;
+  user_frees = 0;
+  std::string arena(70000, 'u');
+  {
+    IOBuf e;
+    e.append("hdr:", 4);
+    e.append_user(arena.data(), arena.size(),
+                  [](void*) { user_frees++; }, nullptr);
+    if (e.length() != 4 + arena.size()) return 6;
+    IOBuf f;
+    e.cut_into(&f, 40000);  // split mid-user-block: shared refs
+    if (user_frees != 0) return 7;
+    if (f.to_string() != "hdr:" + arena.substr(0, 39996)) return 8;
+    f.clear();
+    if (user_frees != 0) return 9;  // e still holds the tail ref
+  }
+  if (user_frees != 1) return 10;
   return 0;
 }
 

@@ -35,12 +35,31 @@ IOBlock* IOBlock::create() {
 }
 
 void IOBlock::recycle(IOBlock* b) {
+  if (b->user_ptr != nullptr) {
+    // arena-backed user block: run the release action (arena span free,
+    // device buffer unpin) and strip the user fields so the header can
+    // re-enter the cache as a normal block
+    if (b->user_free != nullptr) b->user_free(b->user_arg);
+    b->user_ptr = nullptr;
+    b->user_free = nullptr;
+    b->user_arg = nullptr;
+  }
   TlsBlockCache& c = tls_cache;
   if (c.n < TlsBlockCache::kCap) {
     c.blocks[c.n++] = b;
     return;
   }
   delete b;
+}
+
+IOBlock* IOBlock::create_user(const char* p, size_t len,
+                              void (*free_fn)(void*), void* arg) {
+  IOBlock* b = create();
+  b->user_ptr = const_cast<char*>(p);
+  b->user_free = free_fn;
+  b->user_arg = arg;
+  b->size = len;
+  return b;
 }
 
 static thread_local IOBlock* tls_block = nullptr;  // share_tls_block analog
@@ -117,6 +136,17 @@ void IOBuf::append(const void* data, size_t n) {
   }
 }
 
+void IOBuf::append_user(const char* p, size_t n, void (*free_fn)(void*),
+                        void* arg) {
+  if (n == 0) {
+    if (free_fn != nullptr) free_fn(arg);
+    return;
+  }
+  IOBlock* b = IOBlock::create_user(p, n, free_fn, arg);
+  push_back({b, 0, (uint32_t)n});  // creator ref transfers to the IOBuf
+  length_ += n;
+}
+
 // Below this, splicing refs costs more than copying the bytes: every
 // spliced ref is two atomic RMWs (add_ref now, release later), a ref-slot
 // push, and one more iovec for the eventual writev — while a short memcpy
@@ -135,7 +165,7 @@ void IOBuf::append_flat_from(const IOBuf& src, size_t n) {
   for (uint32_t i = 0; i < src.count_ && left > 0; i++) {
     const BlockRef& r = src.at(i);
     size_t take = std::min((size_t)r.length, left);
-    append(r.block->data + r.offset, take);
+    append(r.block->payload() + r.offset, take);
     left -= take;
   }
 }
@@ -235,7 +265,7 @@ size_t IOBuf::copy_to_slow(void* out, size_t n, size_t pos) const {
       continue;
     }
     size_t take = std::min((size_t)r.length - skip, n - copied);
-    memcpy(dst + copied, r.block->data + r.offset + skip, take);
+    memcpy(dst + copied, r.block->payload() + r.offset + skip, take);
     copied += take;
     skip = 0;
   }
@@ -272,7 +302,7 @@ ssize_t IOBuf::cut_into_fd(int fd, size_t max_bytes) {
     const BlockRef& r = at(i);
     if (niov >= 64 || queued >= max_bytes) break;
     size_t take = std::min((size_t)r.length, max_bytes - queued);
-    iov[niov].iov_base = r.block->data + r.offset;
+    iov[niov].iov_base = r.block->payload() + r.offset;
     iov[niov].iov_len = take;
     niov++;
     queued += take;

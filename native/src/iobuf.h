@@ -24,15 +24,31 @@ struct IOBlock {
   static constexpr size_t kSize = 8192;  // iobuf.h:70
   std::atomic<int> ref{1};
   size_t size = 0;  // filled prefix
+  // Arena-backed USER block (the registered-arena seam of the reference's
+  // rdma docs: payloads live in registered memory and IOBuf carries refs
+  // into it): when user_ptr is set the payload lives in FOREIGN memory —
+  // a shm blob-arena span, a device staging buffer — and user_free(
+  // user_arg) runs on the last release instead of the TLS-cache recycle.
+  // User blocks are read-only to the append paths (left() == 0) and may
+  // be larger than kSize.
+  char* user_ptr = nullptr;
+  void (*user_free)(void*) = nullptr;
+  void* user_arg = nullptr;
   char data[kSize];
 
   static IOBlock* create();   // TLS-cached (share_tls_block discipline)
+  static IOBlock* create_user(const char* p, size_t len,
+                              void (*free_fn)(void*), void* arg);
   static void recycle(IOBlock* b);
   void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
   void release() {
     if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) recycle(this);
   }
-  size_t left() const { return kSize - size; }
+  size_t left() const { return user_ptr != nullptr ? 0 : kSize - size; }
+  char* payload() { return user_ptr != nullptr ? user_ptr : data; }
+  const char* payload() const {
+    return user_ptr != nullptr ? user_ptr : data;
+  }
 };
 
 struct BlockRef {
@@ -83,6 +99,11 @@ class IOBuf {
   void append(const IOBuf& other);  // ref share (short buffers flat-copy)
   void append(IOBuf&& other);       // ref splice (short buffers flat-copy)
   void append_flat_from(const IOBuf& src, size_t n);  // forced flat copy
+  // Zero-copy append of foreign memory (blob-arena span): the bytes are
+  // NOT copied; free_fn(arg) runs when the last ref releases (after the
+  // socket writev consumed them, or on clear()).
+  void append_user(const char* p, size_t n, void (*free_fn)(void*),
+                   void* arg);
 
   // move first n bytes of this into out (zero-copy)
   size_t cut_into(IOBuf* out, size_t n);
@@ -105,7 +126,7 @@ class IOBuf {
     if (count_ > 0) {
       const BlockRef& r = refs_[begin_];
       if (pos + n <= r.length) {  // entirely inside the front block
-        memcpy(out, r.block->data + r.offset + pos, n);
+        memcpy(out, r.block->payload() + r.offset + pos, n);
         return n;
       }
     }
@@ -119,7 +140,7 @@ class IOBuf {
   const char* fetch(char* scratch, size_t n) const {
     if (count_ > 0) {
       const BlockRef& r = refs_[begin_];
-      if (r.length >= n) return r.block->data + r.offset;
+      if (r.length >= n) return r.block->payload() + r.offset;
     }
     copy_to(scratch, n);
     return scratch;

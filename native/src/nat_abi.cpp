@@ -213,13 +213,18 @@ int main() {
       NAT_SYM(nat_grpc_channel_bench),
       NAT_SYM(nat_http_channel_bench),
       NAT_SYM(nat_shm_lane_create),
+      NAT_SYM(nat_shm_lane_max_workers),
       NAT_SYM(nat_shm_lane_workers),
       NAT_SYM(nat_shm_lane_name),
       NAT_SYM(nat_shm_lane_enable),
       NAT_SYM(nat_shm_lane_set_timeout_ms),
+      NAT_SYM(nat_shm_lane_recover_probe),
       NAT_SYM(nat_shm_worker_attach),
       NAT_SYM(nat_shm_take_request),
       NAT_SYM(nat_shm_respond),
+      NAT_SYM(nat_shm_push_tensor),
+      NAT_SYM(nat_shm_push_bench),
+      NAT_SYM(nat_shm_worker_drain_bench),
       NAT_SYM(nat_stats_counter_count),
       NAT_SYM(nat_stats_now_ns),
       NAT_SYM(nat_stats_counter_name),

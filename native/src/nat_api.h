@@ -159,17 +159,31 @@ double nat_http_channel_bench(const char* ip, int port, int nconn,
                               const char* body, size_t body_len,
                               uint64_t* out_requests);
 
-// ---- shm usercode worker lane (nat_shm_lane.cpp) ----
+// ---- shm usercode worker lane: zero-copy descriptor rings + blob
+// arenas (nat_shm_lane.cpp) ----
 int nat_shm_lane_create(size_t ring_bytes);
+int nat_shm_lane_max_workers(void);
 int nat_shm_lane_workers(void);
 const char* nat_shm_lane_name(void);
 int nat_shm_lane_enable(int enable);
 int nat_shm_lane_set_timeout_ms(int ms);
+// probe worker lifetime fences once; recover dead slots (the drainer
+// does this continuously while the lane is enabled)
+int nat_shm_lane_recover_probe(void);
 int nat_shm_worker_attach(const char* name);
 void* nat_shm_take_request(int timeout_ms);
 int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
                     const char* payload, size_t payload_len, int32_t status,
                     const char* message, int close_after);
+// bulk-tensor entry: stage bytes straight into a worker's blob arena and
+// publish one kind-8 descriptor (the HostArena / device-lane staging
+// seam); -1 = every ring full (caller owns backpressure policy)
+int nat_shm_push_tensor(const char* data, size_t len, uint64_t tag);
+// transport microbenchmarks (bench.py shm_desc lanes): parent-side push
+// loop (returns GB/s) and worker-side native drain loop (returns records)
+double nat_shm_push_bench(size_t record_bytes, double seconds,
+                          uint64_t* out_records);
+uint64_t nat_shm_worker_drain_bench(int idle_exit_ms);
 
 // ---- observability snapshot surface (nat_stats.cpp) ----
 int nat_stats_counter_count(void);

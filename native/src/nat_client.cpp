@@ -34,17 +34,60 @@ struct HttpCliSessN {
     bool head;  // HEAD request: the response has headers but NO body
   };
   std::deque<Req> fifo;  // calls awaiting responses, request order
-  // incremental response-parse state (reading thread only): phase 1
-  // means the head response's headers are consumed and `body_left`
-  // bytes of its content-length body are still owed — body bytes are
-  // cut straight out of in_buf into body_acc (refcounted blocks, no
-  // rescans). The pending call is only claimed at COMPLETION, so the
+  // incremental response-parse state: phase 1 means the head response's
+  // headers are consumed and `body_left` bytes of its content-length
+  // body are still owed — body bytes are cut straight out of in_buf
+  // into body_acc (refcounted blocks, no rescans). phase 2 is a
+  // READ-UNTIL-CLOSE body (HTTP/1.0 or Connection: close with no
+  // framing): everything until EOF is the body, and the call completes
+  // from http_cli_on_socket_fail when the peer closes. Phases 0/1 are
+  // reading-thread state; phase-2 mutations (and body_acc/status while
+  // in it) happen under mu because the EOF hook may run on another
+  // thread. The pending call is only claimed at COMPLETION, so the
   // deadline timer keeps working while a body trickles in.
-  int phase = 0;  // 0 = scanning headers, 1 = draining body
+  std::atomic<int> phase{0};  // 0 = headers, 1 = sized body, 2 = to-EOF
   int status = 0;
   size_t body_left = 0;
   IOBuf body_acc;
 };
+
+// EOF on a client socket: a phase-2 (close-delimited) body is complete —
+// claim the FIFO-head call and finish it with the accumulated bytes
+// BEFORE fail_all turns it into an error. Called from set_failed.
+void http_cli_on_socket_fail(NatSocket* s) {
+  HttpCliSessN* c = s->httpc;
+  if (c == nullptr) return;
+  // cheap pre-check, then TRY-lock: set_failed can fire on a thread that
+  // already holds c->mu (http_cli_send's write failing synchronously) —
+  // blocking here would self-deadlock, and in that doomed-socket race
+  // fail_all's error completion is the correct outcome anyway
+  if (c->phase.load(std::memory_order_acquire) != 2) return;
+  int status;
+  IOBuf body;
+  int64_t cid = 0;
+  {
+    std::unique_lock<std::mutex> g(c->mu, std::try_to_lock);
+    if (!g.owns_lock()) return;
+    if (c->phase.load(std::memory_order_acquire) != 2) return;
+    c->phase.store(0, std::memory_order_release);
+    status = c->status;
+    body = std::move(c->body_acc);
+    if (c->fifo.empty()) return;
+    cid = c->fifo.front().cid;
+    c->fifo.pop_front();
+  }
+  NatChannel* ch = s->channel;
+  PendingCall* pc = ch != nullptr ? ch->take_pending(cid) : nullptr;
+  if (pc == nullptr) return;
+  pc->aux_status = status;
+  pc->response.append(std::move(body));
+  if (pc->cb != nullptr) {
+    pc->cb(pc, pc->cb_arg);
+  } else {
+    pc->done.value.store(1, std::memory_order_release);
+    Scheduler::butex_wake(&pc->done, INT32_MAX);
+  }
+}
 
 void http_cli_free(HttpCliSessN* c) { delete c; }
 
@@ -81,9 +124,18 @@ static void http_cli_finish(PendingCall* pc) {
 int http_client_process(NatSocket* s) {
   HttpCliSessN* c = s->httpc;
   while (true) {
+    // phase 2: close-delimited body — every byte until EOF belongs to
+    // the head response (completion happens in http_cli_on_socket_fail)
+    if (c->phase.load(std::memory_order_acquire) == 2) {
+      std::lock_guard<std::mutex> g(c->mu);
+      if (s->in_buf.length() > 0) {
+        s->in_buf.cut_into(&c->body_acc, s->in_buf.length());
+      }
+      return 1;
+    }
     // phase 1: drain the current response's body straight out of in_buf
     // (no header rescans; block refs, not copies, for big bodies)
-    if (c->phase == 1) {
+    if (c->phase.load(std::memory_order_acquire) == 1) {
       size_t take = s->in_buf.length() < c->body_left ? s->in_buf.length()
                                                       : c->body_left;
       if (take > 0) {
@@ -99,7 +151,7 @@ int http_client_process(NatSocket* s) {
         http_cli_finish(pc);
       }
       c->body_acc.clear();
-      c->phase = 0;
+      c->phase.store(0, std::memory_order_release);
     }
     size_t buffered = s->in_buf.length();
     if (buffered == 0) return 1;
@@ -131,6 +183,24 @@ int http_client_process(NatSocket* s) {
     // headers we care about (lowercase the copy in place)
     std::string hdrs(scan, hdr_len);
     for (char& ch : hdrs) ch = (char)tolower((unsigned char)ch);
+    // close-delimited detection (read-until-close bodies): HTTP/1.0
+    // defaults to close unless keep-alive; 1.1 closes when asked to
+    bool http10 = scan[7] == '0';
+    bool conn_close = false, conn_keepalive = false;
+    // anchored to line start: a bare substring would match
+    // "proxy-connection:" (the status line always precedes, so a real
+    // Connection header is always after a \n)
+    size_t cpos = hdrs.find("\nconnection:");
+    if (cpos != std::string::npos) {
+      cpos += 1;
+      size_t ceol = hdrs.find('\r', cpos);
+      std::string cval = hdrs.substr(
+          cpos + 11, (ceol == std::string::npos ? hdrs.size() : ceol) -
+                         cpos - 11);
+      conn_close = cval.find("close") != std::string::npos;
+      conn_keepalive = cval.find("keep-alive") != std::string::npos;
+    }
+    bool close_delim_ok = conn_close || (http10 && !conn_keepalive);
     size_t content_length = 0;
     bool has_cl = false, chunked = false;
     size_t clpos = hdrs.find("content-length:");
@@ -212,10 +282,25 @@ int http_client_process(NatSocket* s) {
       if (!c->fifo.empty()) was_head = c->fifo.front().head;
     }
     bool head_like = was_head || status == 204 || status == 304;
-    size_t body_len = (head_like || !has_cl) ? 0 : content_length;
-    // a keep-alive response needs content-length (or chunked above);
-    // close-delimited bodies would hang the pipeline — treat absent
-    // length as empty body (our peers always frame responses)
+    if (!head_like && !has_cl) {
+      // no framing at all: legal ONLY when the server delimits the body
+      // by closing (HTTP/1.0, or Connection: close) — accumulate until
+      // EOF and complete from the socket-failure hook (ADVICE r5). A
+      // keep-alive response with no framing is undecodable: fail the
+      // socket explicitly instead of silently handing back empty bytes
+      // (fail_all reports the error to the caller).
+      if (!close_delim_ok) return 0;
+      s->in_buf.pop_front(body_start);
+      std::lock_guard<std::mutex> g(c->mu);
+      c->status = status;
+      c->body_acc.clear();
+      if (s->in_buf.length() > 0) {
+        s->in_buf.cut_into(&c->body_acc, s->in_buf.length());
+      }
+      c->phase.store(2, std::memory_order_release);
+      return 1;
+    }
+    size_t body_len = head_like ? 0 : content_length;
     s->in_buf.pop_front(body_start);
     if (body_len <= 4096 && s->in_buf.length() >= body_len) {
       // fast path: small fully-buffered body completes inline
@@ -236,7 +321,7 @@ int http_client_process(NatSocket* s) {
       http_cli_finish(pc);
     } else {
       // collect (large or not-yet-buffered) body incrementally
-      c->phase = 1;
+      c->phase.store(1, std::memory_order_release);
       c->status = status;
       c->body_left = body_len;
       c->body_acc.clear();

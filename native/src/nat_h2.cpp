@@ -531,6 +531,29 @@ static void hp_enc_header_dyn(H2SessionN* h, std::string* out,
   h->enc.add(name, value);
 }
 
+// Emit the RFC 7541 §4.2 dynamic-table size update(s) owed after a
+// SETTINGS_HEADER_TABLE_SIZE change, and settle the encoder bookkeeping.
+// Requires h->mu; the update bytes MUST lead the next header block that
+// reaches the wire (whoever emits first — reading thread or py thread —
+// carries them; see the pending_resize checks in h2_respond).
+static void hp_emit_resize_locked(H2SessionN* h, std::string* out) {
+  if (h->enc.lowest < h->enc.max_size) {
+    hp_enc_int(out, h->enc.lowest, 5, 0x20);
+    // the decoder evicts at `lowest` (a grow does NOT restore its
+    // entries) — the encoder must drop the same entries or later
+    // indexed refs point at ghosts
+    h->enc.max_size = h->enc.lowest;
+    h->enc.evict();
+  }
+  if (h->enc.target != h->enc.lowest) {
+    hp_enc_int(out, h->enc.target, 5, 0x20);
+  }
+  h->enc.max_size = h->enc.target;
+  h->enc.lowest = h->enc.target;
+  h->enc.pending_resize = false;
+  h->enc.evict();
+}
+
 static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
                        size_t payload_len, int grpc_status,
                        const char* grpc_message, IOBuf* batch_out) {
@@ -573,25 +596,21 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
     if (batch_out != nullptr) {
       // reading-thread block: encode under mu with the dynamic table
       if (h->enc.pending_resize) {  // peer changed the table cap
-        if (h->enc.lowest < h->enc.max_size) {
-          hp_enc_int(&hdr_block, h->enc.lowest, 5, 0x20);
-          // the decoder evicts at `lowest` (a grow does NOT restore its
-          // entries) — the encoder must drop the same entries or later
-          // indexed refs point at ghosts
-          h->enc.max_size = h->enc.lowest;
-          h->enc.evict();
-        }
-        if (h->enc.target != h->enc.lowest) {
-          hp_enc_int(&hdr_block, h->enc.target, 5, 0x20);
-        }
-        h->enc.max_size = h->enc.target;
-        h->enc.lowest = h->enc.target;
-        h->enc.pending_resize = false;
-        h->enc.evict();
+        hp_emit_resize_locked(h, &hdr_block);
       }
       hp_enc_int(&hdr_block, 8, 7, 0x80);  // :status 200
       hp_enc_header_dyn(h, &hdr_block, "content-type",
                         "application/grpc");
+    } else if (h->enc.pending_resize) {
+      // py-thread static block racing a pending resize: the §4.2 update
+      // must lead the NEXT block on the wire, and this block (written
+      // under mu, below) may well be it — carry the update at its front
+      // instead of letting a strict decoder see a block with the update
+      // missing (COMPRESSION_ERROR). Static encoding stays valid: the
+      // update only evicts, it indexes nothing.
+      std::string resize;
+      hp_emit_resize_locked(h, &resize);
+      hdr_block.insert(0, resize);
     }
     frame_header(&out, hdr_block.size(), kFHeaders, kFlagEndHeaders, sid);
     out.append(hdr_block);
@@ -815,6 +834,18 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
                          ((uint32_t)p[i + 3] << 16) |
                          ((uint32_t)p[i + 4] << 8) | p[i + 5];
           if (id == 1) {  // HEADER_TABLE_SIZE: bounds OUR encoder table
+            // Flush every already-assembled block in this round's
+            // accumulators to the socket BEFORE arming the resize:
+            // whoever carries the §4.2 update next (reading thread OR a
+            // py-thread static block, which writes immediately under
+            // h->mu) must not overtake blocks encoded against the old
+            // table — the update's eviction would turn their indexed
+            // refs into ghosts on the decoder.
+            if (!out.empty()) {
+              batch_out->append(out.data(), out.size());
+              out.clear();
+            }
+            if (!batch_out->empty()) s->write(std::move(*batch_out));
             std::lock_guard<std::mutex> g(h->mu);
             size_t cap = val > 4096 ? 4096 : (size_t)val;
             h->enc.target = cap;

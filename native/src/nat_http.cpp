@@ -27,8 +27,10 @@ struct HttpSessionN {
   // thread both emit).
   std::mutex mu;
   uint64_t next_resp_seq = 1;
+  // IOBuf (not std::string) so parked responses can carry arena-backed
+  // user blocks (the shm drainer's zero-copy emit) without a copy
   struct Resp {
-    std::string data;
+    IOBuf data;
     bool close = false;
   };
   std::map<uint64_t, Resp> parked;
@@ -60,11 +62,11 @@ int http_sniff(const char* p, size_t n) {
 // Write any now-in-order parked responses. Requires h->mu. Appends into
 // out (the caller writes outside the lock).
 static void http_emit_locked(NatSocket* s, HttpSessionN* h,
-                             std::string* out, bool* want_close) {
+                             IOBuf* out, bool* want_close) {
   while (true) {
     auto it = h->parked.find(h->next_resp_seq);
     if (it == h->parked.end()) break;
-    out->append(it->second.data);
+    out->append(std::move(it->second.data));
     bool close = it->second.close;
     if (!close) {
       for (uint64_t cs : h->close_seqs) {
@@ -85,12 +87,12 @@ static void http_emit_locked(NatSocket* s, HttpSessionN* h,
 
 // Queue a complete response for `seq`, preserving request order. Called
 // from the reading thread (native handlers) and from py pthreads.
-static void http_emit_response(NatSocket* s, uint64_t seq, std::string data,
+static void http_emit_response(NatSocket* s, uint64_t seq, IOBuf data,
                                bool close, IOBuf* batch_out) {
   HttpSessionN* h = s->http;
   if (h == nullptr) return;
   nat_counter_add(NS_HTTP_RESPONSES_OUT, 1);
-  std::string out;
+  IOBuf out;
   bool want_close = false;
   bool wrote = false;
   {
@@ -111,14 +113,12 @@ static void http_emit_response(NatSocket* s, uint64_t seq, std::string data,
       if (batch_out != nullptr) {
         // single-producer: batch_out is the reading thread's per-round
         // accumulator; only reading-thread emissions use it
-        batch_out->append(out.data(), out.size());
+        batch_out->append(std::move(out));
       } else {
         // the socket write happens UNDER h->mu: two py responders that
         // drain consecutive seqs must hit the write queue in that order
         // (emitting outside the lock let the later seq overtake)
-        IOBuf buf;
-        buf.append(out.data(), out.size());
-        s->write(std::move(buf));
+        s->write(std::move(out));
         wrote = true;
       }
     } else if (want_close) {
@@ -385,7 +385,9 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
       s->in_buf.pop_front(total);
       uint32_t req_bytes = (uint32_t)ctx.body.size();
       uint32_t out_bytes = (uint32_t)resp_bytes.size();
-      http_emit_response(s, seq, std::move(resp_bytes), false, batch_out);
+      IOBuf resp_buf;
+      resp_buf.append(resp_bytes.data(), resp_bytes.size());
+      http_emit_response(s, seq, std::move(resp_buf), false, batch_out);
       uint64_t t_write = nat_now_ns();
       nat_lat_record(NL_HTTP, t_write - t_parse);
       if (take_span) {
@@ -406,7 +408,9 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
       build_http_response(&resp_bytes, 404, "text/plain", kBody,
                           sizeof(kBody) - 1, head_only);
       s->in_buf.pop_front(total);
-      http_emit_response(s, seq, std::move(resp_bytes), conn_close,
+      IOBuf resp_buf;
+      resp_buf.append(resp_bytes.data(), resp_bytes.size());
+      http_emit_response(s, seq, std::move(resp_buf), conn_close,
                          batch_out);
       continue;
     }
@@ -446,17 +450,32 @@ void http_session_free(HttpSessionN* h) { delete h; }
 void http_round_end(NatSocket* s) {
   HttpSessionN* h = s->http;
   if (h == nullptr) return;
-  std::string out;
+  IOBuf out;
   bool want_close = false;
   std::lock_guard<std::mutex> g(h->mu);
   http_emit_locked(s, h, &out, &want_close);
   h->round_active = false;
   if (want_close) s->close_after_drain.store(true, std::memory_order_release);
   if (!out.empty()) {
-    IOBuf f;
-    f.append(out.data(), out.size());
-    s->write(std::move(f));  // under h->mu: ordered vs py emitters
+    s->write(std::move(out));  // under h->mu: ordered vs py emitters
   }
+}
+
+// Zero-copy emit for the shm drainer: `data` is the complete serialized
+// response (possibly arena-backed user blocks); the reorder window parks
+// the IOBuf itself, and the socket writev consumes the refs in place.
+int http_respond_iobuf(uint64_t sock_id, int64_t seq, IOBuf&& data,
+                       int close_after) {
+  NatSocket* s = sock_address(sock_id);
+  if (s == nullptr) return -1;
+  if (s->http == nullptr) {
+    s->release();
+    return -1;
+  }
+  http_emit_response(s, (uint64_t)seq, std::move(data), close_after != 0,
+                     nullptr);
+  s->release();
+  return 0;
 }
 
 extern "C" {
@@ -473,8 +492,10 @@ int nat_http_respond(uint64_t sock_id, int64_t seq, const char* data,
     s->release();
     return -1;
   }
-  http_emit_response(s, (uint64_t)seq, std::string(data, len),
-                     close_after != 0, nullptr);
+  IOBuf buf;
+  buf.append(data, len);
+  http_emit_response(s, (uint64_t)seq, std::move(buf), close_after != 0,
+                     nullptr);
   s->release();
   return 0;
 }

@@ -296,7 +296,14 @@ using HttpHandlerN = std::function<void(HttpHandlerCtxN&)>;
 // gRPC-over-h2 request (method = ":path", payload = de-framed message,
 // meta_bytes = header lines, cid = h2 stream id); 5 = streaming frame
 // (aux = dest stream id, compress_type = frame type DATA/FEEDBACK/CLOSE,
-// cid = per-socket sequence for ordered delivery, payload = frame body).
+// cid = per-socket sequence for ordered delivery, payload = frame body);
+// 8 = bulk tensor record (shm descriptor lane, aux = caller tag).
+struct PyRequest;
+
+// shm descriptor lane (nat_shm_lane.cpp): release the blob-arena span an
+// arena-backed PyRequest's field views point into (no-op otherwise).
+void shm_req_span_release(PyRequest* r);
+
 struct PyRequest {
   int32_t kind = 0;
   uint64_t sock_id = 0;
@@ -318,7 +325,18 @@ struct PyRequest {
   char* big_payload = nullptr;
   size_t big_len = 0;  // final payload size (frame-declared)
   size_t big_cap = 0;  // currently allocated
-  ~PyRequest() { ::free(big_payload); }
+  // shm descriptor-ring backing (nat_shm_lane.cpp): slot >= 0 marks an
+  // arena-resident record — the field views below point INTO the mapped
+  // blob arena (read in place, no per-record copy) and stay valid until
+  // this request is freed, which releases the span back to the arena.
+  int32_t shm_slot = -1;
+  uint64_t shm_span = 0;   // span-start offset (monotone) for the release
+  const char* shm_view[5] = {nullptr, nullptr, nullptr, nullptr, nullptr};
+  size_t shm_view_len[5] = {0, 0, 0, 0, 0};
+  ~PyRequest() {
+    ::free(big_payload);
+    if (shm_slot >= 0) shm_req_span_release(this);
+  }
 };
 
 // shm usercode lane (nat_shm_lane.cpp): true = request consumed by the
@@ -723,6 +741,12 @@ bool drain_socket_inline(NatSocket* s);
 int http_try_process(NatSocket* s, IOBuf* batch_out);
 void http_round_end(NatSocket* s);
 void http_session_free(HttpSessionN* h);
+// Zero-copy variant of nat_http_respond: `data` is the complete serialized
+// response, possibly carried by arena-backed user blocks (the shm drainer's
+// large-payload path) — the reorder window parks the IOBuf itself and the
+// socket writev consumes the refs without copying.
+int http_respond_iobuf(uint64_t sock_id, int64_t seq, IOBuf&& data,
+                       int close_after);
 // Sniff a few leading bytes: 1 = HTTP verb, 2 = could become one (need
 // more bytes), 0 = definitely not HTTP.
 int http_sniff(const char* p, size_t n);
@@ -752,6 +776,10 @@ int redis_sniff(const char* p, size_t n);
 // could, 0 = protocol error (socket dies).
 int http_client_process(NatSocket* s);
 int h2_client_process(NatSocket* s, IOBuf* batch_out);
+// EOF hook for read-until-close response bodies (HTTP/1.0 / Connection:
+// close with no framing): called by set_failed BEFORE fail_all so the
+// FIFO-head call completes successfully with the accumulated body.
+void http_cli_on_socket_fail(NatSocket* s);
 void http_cli_free(HttpCliSessN* c);
 void h2_cli_free(H2CliSessN* c);
 // Fail ONLY the pending calls whose streams still ride this socket's h2

@@ -439,6 +439,16 @@ int nat_take_request_batch(void** out, int max, int timeout_ms) {
 
 const char* nat_req_field(void* h, int which, size_t* len) {
   PyRequest* r = (PyRequest*)h;
+  if (r->shm_slot >= 0) {
+    // shm descriptor-lane request: fields are views straight into the
+    // mapped blob arena (valid until nat_req_free releases the span)
+    if (which < 0 || which > 4) {
+      *len = 0;
+      return nullptr;
+    }
+    *len = r->shm_view_len[which];
+    return r->shm_view[which];
+  }
   const std::string* s = nullptr;
   switch (which) {
     case 0: s = &r->service; break;

@@ -1,24 +1,57 @@
-// Shared-memory usercode lane — kind-3/4 (HTTP / gRPC) py-lane requests
-// fan out to N WORKER PROCESSES over a pair of shm rings, so Python
-// usercode scales past one interpreter's GIL the way the reference's
-// usercode runs on all N workers (server.h:59-285 num_threads,
-// details/usercode_backup_pool.h:29-72 — usercode concurrency is the
-// product, not the port).
+// Shared-memory usercode lane — zero-copy descriptor-ring transport.
 //
-//   parent (native runtime)                worker processes (Python)
-//   cut loop parses request  ──req ring──▶ nat_shm_take_request()
-//                                          dispatch via user services
-//   response drainer thread  ◀─resp ring── nat_shm_respond_{http,grpc}()
-//   emits via the ordered
-//   reorder windows (seq)
+// kind-3/4 (HTTP / gRPC) py-lane requests fan out to N WORKER PROCESSES,
+// so Python usercode scales past one interpreter's GIL (the reference's
+// usercode-on-all-N-workers concurrency, server.h:59-285 +
+// details/usercode_backup_pool.h:29-72). This file is the same-host leg
+// of the registered-arena north star (docs/cn/rdma.md): payload bytes are
+// written ONCE into a shared blob arena and read in place on the other
+// side; only fixed 64-byte descriptors cross the rings.
 //
-// The rings live in one shm_open segment; both sides use THIS library's
-// helpers (the workers load the same .so), so the record layout never
-// crosses a language boundary. Mutexes are PTHREAD_PROCESS_SHARED +
-// ROBUST: a worker dying mid-ring marks the lock consistent instead of
-// wedging the server.
+//   parent (native runtime)                 worker processes (Python)
+//   reactor threads serialize the          nat_shm_take_request(): pops a
+//   request INTO the worker's blob   ──▶   descriptor, hands out VIEWS
+//   arena + publish one descriptor         into the arena (no copy);
+//   (lock-free slot claim, waiter-         nat_req_free releases the span
+//   gated doorbell)
+//   response drainer + scheduler     ◀──   nat_shm_respond_*: payload into
+//   idle hooks pop descriptors,            the worker's resp arena + one
+//   emit via the ordered reorder           descriptor; one doorbell per
+//   windows (big payloads ride             burst (waiter-gated futex)
+//   arena-backed IOBuf user blocks
+//   straight into writev)
+//
+// Concurrency design (replaces the round-4 byte rings, which paid a
+// robust-mutex lock, a double memcpy and a futex wake PER RECORD):
+//
+//   * per-worker descriptor rings — fixed 64B seq-numbered slots (the
+//     Vyukov bounded-queue discipline): the producer side is serialized
+//     by a PROCESS-LOCAL mutex (parent reactor threads for request
+//     rings, the worker's own threads for its response ring), consumers
+//     pop lock-free with a CAS on the dequeue cursor (the parent drains
+//     response rings from both the drainer thread and scheduler idle
+//     hooks). Nothing on the hot path takes a cross-process lock.
+//   * per-ring blob arenas — ring allocators whose spans carry an
+//     8-byte header (alloc_len | released bit). Producers claim at the
+//     tail (wrap spans never straddle: a released filler pads to the
+//     edge), consumers set the released bit when done — possibly out of
+//     order (user-block emits) — and the producer lazily reclaims
+//     released spans from the head on the next claim.
+//   * batched doorbells — futex wakes are WAITER-GATED: the producer
+//     bumps a doorbell counter per record but issues the futex syscall
+//     only when the consumer has registered itself as parked, so a
+//     draining consumer costs zero wakes and a parked one costs one
+//     wake per burst.
+//   * robust-mutex recovery FENCE (slow path only): each worker holds
+//     its slot's PTHREAD_PROCESS_SHARED|ROBUST mutex for its lifetime.
+//     A worker dying with SIGKILL surfaces as EOWNERDEAD on the
+//     drainer's periodic trylock probe; recovery drains the dead
+//     worker's published responses, scrubs both arenas, discards its
+//     queued requests and reaps their in-flight entries immediately
+//     (no 30s timeout wait), then frees the slot for a fresh worker.
 #include <linux/futex.h>
 #include <signal.h>
+#include <stdlib.h>
 #include <sys/prctl.h>
 #include <pthread.h>
 #include <sys/syscall.h>
@@ -31,169 +64,81 @@ namespace brpc_tpu {
 
 namespace {
 
-struct ShmRing {
-  // Mutation is guarded by a ROBUST process-shared mutex (a worker dying
-  // mid-record recovers the lock). Blocking uses RAW FUTEXES on the seq
-  // counters, NOT pthread condvars: process-shared condvars are not
-  // robust — a waiter killed with SIGKILL can wedge every later
-  // waiter/broadcaster forever (observed: the response drainer hung in
-  // the condvar's internal futex after test_worker_crash_recovers).
-  // A futex-on-counter has no shared internal state to corrupt.
-  pthread_mutex_t mu;
-  std::atomic<uint32_t> seq_data{0};   // bumped on put  (wakes readers)
-  std::atomic<uint32_t> seq_space{0};  // bumped on take (wakes writers)
-  uint64_t head = 0;  // read offset  (monotone, mod cap)
-  uint64_t tail = 0;  // write offset (monotone, mod cap)
-  uint64_t cap = 0;
-  std::atomic<int> shutdown{0};
-  char data[1];  // cap bytes follow
+constexpr int kMaxWorkers = 8;
+constexpr uint32_t kRingSlots = 1024;  // power of two
+constexpr uint64_t kSpanReleased = 1ull << 63;
+constexpr uint64_t kSpanLenMask = 0xffffffffull;
+// responses at least this big ride arena-backed IOBuf user blocks into
+// the socket writev instead of being copied out of the arena
+constexpr size_t kUserBlockMin = 64u << 10;
 
-  size_t used() const { return (size_t)(tail - head); }
-  size_t room() const { return (size_t)(cap - used()); }
+struct ShmCell {  // one descriptor slot (a cache line)
+  std::atomic<uint64_t> seq;  // Vyukov: pos = empty, pos+1 = filled,
+                              // pos+kRingSlots = free for the next lap
+  uint64_t sock_id;
+  int64_t cid;
+  uint64_t span_off;  // monotone span-start offset in the blob arena
+  uint64_t aux;       // tensor tag (kind 8)
+  uint32_t payload_len;
+  int32_t status;
+  uint8_t kind;
+  uint8_t flags;  // bit0: close_after
+  char pad[14];
+};
+static_assert(sizeof(ShmCell) == 64, "descriptor must be one cache line");
 
-  void put_bytes(const char* p, size_t n) {  // requires mu, room
-    size_t off = (size_t)(tail % cap);
-    size_t first = cap - off < n ? cap - off : n;
-    memcpy(data + off, p, first);
-    if (n > first) memcpy(data, p + first, n - first);
-    tail += n;
-  }
-  void get_bytes(char* p, size_t n) {  // requires mu, used
-    size_t off = (size_t)(head % cap);
-    size_t first = cap - off < n ? cap - off : n;
-    memcpy(p + 0, data + off, first);
-    if (n > first) memcpy(p + first, data, n - first);
-    head += n;
-  }
+// plain snapshot of a popped descriptor (ShmCell minus the atomic)
+struct CellView {
+  uint64_t sock_id;
+  int64_t cid;
+  uint64_t span_off;
+  uint64_t aux;
+  uint32_t payload_len;
+  int32_t status;
+  uint8_t kind;
+  uint8_t flags;
 };
 
-// robust-mutex lock: a dead owner's lock is recovered, not inherited
-int ring_lock(ShmRing* r) {
-  int rc = pthread_mutex_lock(&r->mu);
-  if (rc == EOWNERDEAD) {
-    pthread_mutex_consistent(&r->mu);
-    rc = 0;
-  }
-  return rc;
-}
+struct ShmRing {
+  std::atomic<uint64_t> enq_pos;  // producer cursor (producer-side lock)
+  char pad0[56];
+  std::atomic<uint64_t> deq_pos;  // consumer cursor (CAS, multi-consumer)
+  char pad1[56];
+  // blob-arena cursors: tail bumps at claim (producer), head is the
+  // producer's lazy reclaim cursor over released span headers
+  std::atomic<uint64_t> arena_head;
+  std::atomic<uint64_t> arena_tail;
+  char pad2[48];
+  ShmCell cells[kRingSlots];
+};
 
-// shared (non-PRIVATE) futex wait/wake on a ring seq counter
-void futex_wait_shared(std::atomic<uint32_t>* a, uint32_t expect,
-                       int timeout_ms) {
-  struct timespec ts;
-  ts.tv_sec = timeout_ms / 1000;
-  ts.tv_nsec = (long)(timeout_ms % 1000) * 1000000L;
-  syscall(SYS_futex, (uint32_t*)a, FUTEX_WAIT, expect, &ts, nullptr, 0);
-}
-void futex_wake_shared(std::atomic<uint32_t>* a) {
-  syscall(SYS_futex, (uint32_t*)a, FUTEX_WAKE, INT32_MAX, nullptr, nullptr,
-          0);
-}
+struct ShmWorkerHdr {
+  std::atomic<uint32_t> state;  // 0 free, 1 active, 2 recovering
+  std::atomic<int32_t> pid;
+  std::atomic<uint32_t> req_doorbell;
+  std::atomic<uint32_t> req_waiters;
+  // lifetime fence: locked by the worker at attach, held until death —
+  // EOWNERDEAD on the parent's trylock probe IS the death notification
+  pthread_mutex_t fence;
+  char pad[64];
+};
 
-void ring_init(ShmRing* r, size_t cap) {
-  pthread_mutexattr_t ma;
-  pthread_mutexattr_init(&ma);
-  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
-  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
-  pthread_mutex_init(&r->mu, &ma);
-  r->seq_data.store(0, std::memory_order_relaxed);
-  r->seq_space.store(0, std::memory_order_relaxed);
-  r->head = r->tail = 0;
-  r->cap = cap;
-  r->shutdown.store(0, std::memory_order_relaxed);
-}
-
-// Blocking record put/take. Records are u32 length + payload. False on
-// shutdown (put also fails when the record can never fit).
-// timeout_ms semantics: <0 = try-put (never blocks), >0 = one bounded
-// wait, 0 = keep waiting (bounded 1s slices, rechecking shutdown).
-bool ring_put(ShmRing* r, const std::string& rec, int timeout_ms) {
-  if (rec.size() + 4 > r->cap) return false;
-  // loop: check under the lock, block OUTSIDE it on the seq futex
-  for (int attempt = 0;; attempt++) {
-    if (ring_lock(r) != 0) return false;
-    if (r->used() > r->cap) r->head = r->tail = 0;  // desynced: reset
-    if (r->shutdown.load(std::memory_order_relaxed) != 0) {
-      pthread_mutex_unlock(&r->mu);
-      return false;
-    }
-    if (r->room() >= rec.size() + 4) {
-      char len[4];
-      uint32_t n = (uint32_t)rec.size();
-      memcpy(len, &n, 4);
-      r->put_bytes(len, 4);
-      r->put_bytes(rec.data(), rec.size());
-      r->seq_data.fetch_add(1, std::memory_order_release);
-      pthread_mutex_unlock(&r->mu);
-      futex_wake_shared(&r->seq_data);
-      return true;
-    }
-    uint32_t seq = r->seq_space.load(std::memory_order_acquire);
-    pthread_mutex_unlock(&r->mu);
-    if (timeout_ms < 0) return false;  // try-put: reactor threads
-    if (timeout_ms > 0 && attempt >= 1) return false;  // bounded: gave up
-    futex_wait_shared(&r->seq_space, seq,
-                      timeout_ms > 0 ? timeout_ms : 1000);
-  }
-}
-
-bool ring_take(ShmRing* r, std::string* out, int timeout_ms) {
-  for (int attempt = 0;; attempt++) {
-    if (ring_lock(r) != 0) return false;
-    // A worker killed mid-put/take recovers the LOCK (robust mutex) but
-    // not byte-stream consistency: validate before trusting anything. A
-    // desynced ring (head past tail, or a record length that can't be
-    // in the ring) is reset empty — losing parked records is the
-    // recoverable outcome; chasing a garbage length into resize/memcpy
-    // is a parent crash.
-    if (r->used() > r->cap) r->head = r->tail = 0;
-    if (r->used() >= 4) {
-      char len[4];
-      r->get_bytes(len, 4);
-      uint32_t n;
-      memcpy(&n, len, 4);
-      bool ok = false;
-      if (n > r->used()) {
-        r->head = r->tail = 0;  // corrupt record: reset
-      } else {
-        out->resize(n);
-        if (n > 0) r->get_bytes(&(*out)[0], n);
-        ok = true;
-      }
-      r->seq_space.fetch_add(1, std::memory_order_release);
-      pthread_mutex_unlock(&r->mu);
-      futex_wake_shared(&r->seq_space);
-      if (ok) return true;
-      continue;  // corrupt record consumed; look again
-    }
-    if (r->shutdown.load(std::memory_order_relaxed) != 0) {
-      pthread_mutex_unlock(&r->mu);
-      return false;
-    }
-    uint32_t seq = r->seq_data.load(std::memory_order_acquire);
-    pthread_mutex_unlock(&r->mu);
-    if (attempt >= 1) return false;  // one bounded wait per call
-    futex_wait_shared(&r->seq_data, seq, timeout_ms > 0 ? timeout_ms : 200);
-  }
-}
-
-void ring_shutdown(ShmRing* r) {
-  r->shutdown.store(1, std::memory_order_relaxed);
-  r->seq_data.fetch_add(1, std::memory_order_release);
-  r->seq_space.fetch_add(1, std::memory_order_release);
-  futex_wake_shared(&r->seq_data);
-  futex_wake_shared(&r->seq_space);
-}
-
-// segment = header + request ring + response ring
+// segment = header + kMaxWorkers * (hdr + req ring + req arena +
+//                                   resp ring + resp arena)
 struct ShmSeg {
   uint64_t magic;
-  uint64_t ring_bytes;  // per ring, data capacity
-  std::atomic<int32_t> attached{0};  // workers that completed attach
+  uint32_t version;
+  uint32_t nslots;
+  uint64_t arena_bytes;  // per ring
+  std::atomic<int32_t> attached;  // live attached workers
+  std::atomic<int32_t> shutdown;
   // liveness heartbeat: stamped (CLOCK_MONOTONIC ms) by every worker
   // take-loop pass, so the parent can detect all-workers-dead and fall
   // back to the in-process lane instead of 503ing via the reaper
   std::atomic<int64_t> last_worker_poll_ms{0};
+  // parent-side drain doorbell, shared by every response ring
+  std::atomic<uint32_t> resp_doorbell;
+  std::atomic<uint32_t> resp_waiters;
 };
 
 int64_t mono_ms() {
@@ -201,7 +146,7 @@ int64_t mono_ms() {
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return (int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
 }
-constexpr uint64_t kShmMagic = 0x62727063746C616EULL;  // "brpctlan"
+constexpr uint64_t kShmMagic = 0x62727063646C6EULL ^ 0x2ULL;  // v2 lane
 
 ShmSeg* g_seg = nullptr;
 size_t g_seg_total = 0;
@@ -215,13 +160,225 @@ std::thread* g_resp_drainer = nullptr;
 std::atomic<bool> g_lane_enabled{false};
 std::atomic<bool> g_drainer_stop{false};
 
-// In-flight table: every request handed to the rings is tracked until a
-// worker answers it — a worker dying mid-request (or a request stuck in
-// the ring with no workers left) is reaped with an error response after
-// the deadline, so a pipelined connection's reorder window can never
-// wedge on a seq nobody will answer. The drainer only emits responses
-// whose entry is still present, so a straggler worker answering after
-// the reaper cannot double-respond.
+// parent-local producer locks (one per worker request ring) + routing
+std::mutex* g_req_mu = new std::mutex[kMaxWorkers];  // leaked: exit order
+std::atomic<uint32_t> g_rr{0};
+// parent-local: outstanding arena-backed user blocks per slot (responses
+// in flight through socket write queues) + a recovery epoch so a release
+// that outlives a slot recovery cannot scribble on the recycled arena
+std::atomic<int> g_user_spans[kMaxWorkers] = {};
+std::atomic<uint32_t> g_slot_epoch[kMaxWorkers] = {};
+
+// worker-local identity + response-ring producer lock
+int g_my_slot = -1;
+std::mutex* g_resp_mu = new std::mutex;  // leaked: exit order
+
+// every sub-block is 64-byte aligned: the segment base is page-aligned,
+// the header/rings round up to 64, and arena_bytes is page-rounded
+size_t whdr_bytes() { return (sizeof(ShmWorkerHdr) + 63) & ~(size_t)63; }
+size_t worker_block_bytes() {
+  return whdr_bytes() + 2 * (sizeof(ShmRing) + (size_t)g_seg->arena_bytes);
+}
+char* worker_base(int i) {
+  return (char*)g_seg + ((sizeof(ShmSeg) + 63) & ~(size_t)63) +
+         (size_t)i * worker_block_bytes();
+}
+ShmWorkerHdr* whdr(int i) { return (ShmWorkerHdr*)worker_base(i); }
+ShmRing* wreq(int i) {
+  return (ShmRing*)(worker_base(i) + whdr_bytes());
+}
+char* req_arena(int i) { return (char*)wreq(i) + sizeof(ShmRing); }
+ShmRing* wresp(int i) {
+  return (ShmRing*)(req_arena(i) + g_seg->arena_bytes);
+}
+char* resp_arena(int i) { return (char*)wresp(i) + sizeof(ShmRing); }
+
+// shared (non-PRIVATE) futex wait/wake on a doorbell counter
+void futex_wait_shared(std::atomic<uint32_t>* a, uint32_t expect,
+                       int timeout_ms) {
+  struct timespec ts;
+  ts.tv_sec = timeout_ms / 1000;
+  ts.tv_nsec = (long)(timeout_ms % 1000) * 1000000L;
+  syscall(SYS_futex, (uint32_t*)a, FUTEX_WAIT, expect, &ts, nullptr, 0);
+}
+void futex_wake_shared(std::atomic<uint32_t>* a) {
+  syscall(SYS_futex, (uint32_t*)a, FUTEX_WAKE, INT32_MAX, nullptr, nullptr,
+          0);
+}
+
+// ---------------------------------------------------------------------------
+// blob arena — ring allocator with released-bit span headers
+// ---------------------------------------------------------------------------
+
+std::atomic<uint64_t>* span_hdr(char* arena, uint64_t span_off) {
+  return (std::atomic<uint64_t>*)(arena +
+                                  (size_t)(span_off % g_seg->arena_bytes));
+}
+
+// reclaim released spans from the head (producer side; requires the
+// producer lock of the ring that owns `arena`)
+void arena_reclaim(ShmRing* r, char* arena) {
+  uint64_t head = r->arena_head.load(std::memory_order_relaxed);
+  uint64_t tail = r->arena_tail.load(std::memory_order_relaxed);
+  while (head < tail) {
+    uint64_t h = span_hdr(arena, head)->load(std::memory_order_acquire);
+    uint64_t len = h & kSpanLenMask;
+    if (!(h & kSpanReleased)) break;
+    if (len == 0 || (len & 63) != 0 || len > g_seg->arena_bytes) {
+      break;  // desynced header: recovery scrubs, never chase garbage
+    }
+    head += len;
+  }
+  r->arena_head.store(head, std::memory_order_release);
+}
+
+// Claim a span able to hold `payload` bytes after its 8-byte header,
+// 64-byte aligned, never straddling the arena edge (a released filler
+// pads to it). Returns the monotone span offset or UINT64_MAX when full.
+// Requires the producer lock.
+uint64_t arena_claim(ShmRing* r, char* arena, size_t payload) {
+  uint64_t asize = g_seg->arena_bytes;
+  uint64_t need = ((uint64_t)payload + 8 + 63) & ~63ull;
+  if (need + 64 > asize) return UINT64_MAX;  // can never fit
+  arena_reclaim(r, arena);
+  uint64_t tail = r->arena_tail.load(std::memory_order_relaxed);
+  uint64_t head = r->arena_head.load(std::memory_order_relaxed);
+  uint64_t off = tail % asize;
+  uint64_t fill = (off + need > asize) ? (asize - off) : 0;
+  if (tail + fill + need - head > asize) return UINT64_MAX;  // full
+  if (fill != 0) {
+    span_hdr(arena, tail)->store(fill | kSpanReleased,
+                                 std::memory_order_release);
+    tail += fill;
+  }
+  span_hdr(arena, tail)->store(need, std::memory_order_relaxed);
+  r->arena_tail.store(tail + need, std::memory_order_release);
+  return tail;
+}
+
+char* span_payload(char* arena, uint64_t span_off) {
+  return arena + (size_t)(span_off % g_seg->arena_bytes) + 8;
+}
+
+void span_release(char* arena, uint64_t span_off) {
+  span_hdr(arena, span_off)->fetch_or(kSpanReleased,
+                                      std::memory_order_acq_rel);
+}
+
+// ---------------------------------------------------------------------------
+// descriptor ring — serialized producers, lock-free (CAS) consumers
+// ---------------------------------------------------------------------------
+
+void ring_init(ShmRing* r) {
+  r->enq_pos.store(0, std::memory_order_relaxed);
+  r->deq_pos.store(0, std::memory_order_relaxed);
+  r->arena_head.store(0, std::memory_order_relaxed);
+  r->arena_tail.store(0, std::memory_order_relaxed);
+  for (uint32_t i = 0; i < kRingSlots; i++) {
+    r->cells[i].seq.store(i, std::memory_order_relaxed);
+  }
+}
+
+// Claim a slot + an arena span (requires the producer lock); the caller
+// memcpys into *dst and then publishes with ring_publish (which may run
+// OUTSIDE the lock — the claimed cell is private until its seq store).
+bool ring_begin_push(ShmRing* r, char* arena, size_t len, uint64_t* pos_out,
+                     uint64_t* span_out, char** dst) {
+  uint64_t pos = r->enq_pos.load(std::memory_order_relaxed);
+  ShmCell* c = &r->cells[pos & (kRingSlots - 1)];
+  if (c->seq.load(std::memory_order_acquire) != pos) return false;  // full
+  uint64_t span = arena_claim(r, arena, len);
+  if (span == UINT64_MAX) return false;  // arena full (backpressure)
+  r->enq_pos.store(pos + 1, std::memory_order_relaxed);
+  *pos_out = pos;
+  *span_out = span;
+  *dst = span_payload(arena, span);
+  return true;
+}
+
+void ring_publish(ShmRing* r, uint64_t pos, uint8_t kind, uint8_t flags,
+                  uint64_t sock_id, int64_t cid, int32_t status,
+                  uint64_t span, uint32_t payload_len, uint64_t aux) {
+  ShmCell* c = &r->cells[pos & (kRingSlots - 1)];
+  c->kind = kind;
+  c->flags = flags;
+  c->sock_id = sock_id;
+  c->cid = cid;
+  c->status = status;
+  c->span_off = span;
+  c->payload_len = payload_len;
+  c->aux = aux;
+  c->seq.store(pos + 1, std::memory_order_release);
+}
+
+bool ring_pop(ShmRing* r, CellView* out) {
+  for (;;) {
+    uint64_t pos = r->deq_pos.load(std::memory_order_acquire);
+    ShmCell* c = &r->cells[pos & (kRingSlots - 1)];
+    // Not a seqlock — a Vyukov bounded queue: the deq_pos CAS below
+    // grants EXCLUSIVE ownership of the cell before its payload is
+    // read, and the producer cannot rewrite it until our seq store
+    // frees the slot for the next lap.
+    // natcheck:allow(seqlock-recheck): Vyukov cell, CAS-owned (above)
+    uint64_t s = c->seq.load(std::memory_order_acquire);
+    if (s == pos + 1) {  // filled
+      if (!r->deq_pos.compare_exchange_weak(pos, pos + 1,
+                                            std::memory_order_acq_rel,
+                                            std::memory_order_acquire)) {
+        continue;  // another consumer won this slot
+      }
+      out->sock_id = c->sock_id;
+      out->cid = c->cid;
+      out->span_off = c->span_off;
+      out->aux = c->aux;
+      out->payload_len = c->payload_len;
+      out->status = c->status;
+      out->kind = c->kind;
+      out->flags = c->flags;
+      // fields snapshotted: free the slot for the producer's next lap
+      c->seq.store(pos + kRingSlots, std::memory_order_release);
+      return true;
+    }
+    if (s < pos + 1) return false;  // empty
+    // s > pos + 1: a concurrent consumer advanced deq_pos; retry
+  }
+}
+
+bool ring_has_data(ShmRing* r) {
+  uint64_t pos = r->deq_pos.load(std::memory_order_acquire);
+  return r->cells[pos & (kRingSlots - 1)].seq.load(
+             std::memory_order_acquire) == pos + 1;
+}
+
+void put_u32(char*& p, uint32_t v) {
+  memcpy(p, &v, 4);
+  p += 4;
+}
+void put_blob(char*& p, const char* d, size_t n) {
+  put_u32(p, (uint32_t)n);
+  if (n != 0) memcpy(p, d, n);
+  p += n;
+}
+bool get_blob(const char*& p, const char* end, const char** d, size_t* n) {
+  if (end - p < 4) return false;
+  uint32_t len;
+  memcpy(&len, p, 4);
+  p += 4;
+  if ((size_t)(end - p) < len) return false;
+  *d = p;
+  *n = len;
+  p += len;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// in-flight table (reaper): every request handed to the rings is tracked
+// until a worker answers it, so a worker dying mid-request can never
+// wedge a pipelined connection's reorder window (the drainer only emits
+// responses whose entry is still present — a straggler answering after
+// the reaper cannot double-respond).
+// ---------------------------------------------------------------------------
+
 struct InflightKey {
   uint64_t sock_id;
   int64_t seq;
@@ -231,6 +388,7 @@ struct InflightKey {
 };
 struct InflightEntry {
   uint8_t kind;
+  int8_t slot;  // worker the request was routed to (crash fast-reap)
   std::chrono::steady_clock::time_point deadline;
 };
 std::mutex g_inflight_mu;
@@ -238,30 +396,6 @@ std::mutex g_inflight_mu;
 std::map<InflightKey, InflightEntry>& g_inflight =
     *new std::map<InflightKey, InflightEntry>();
 std::atomic<int> g_reap_timeout_ms{30000};
-
-ShmRing* req_ring() {
-  return (ShmRing*)((char*)g_seg + sizeof(ShmSeg));
-}
-ShmRing* resp_ring() {
-  return (ShmRing*)((char*)g_seg + sizeof(ShmSeg) + sizeof(ShmRing) +
-                    g_seg->ring_bytes);
-}
-
-void put_str(std::string* out, const std::string& s) {
-  uint32_t n = (uint32_t)s.size();
-  out->append((const char*)&n, 4);
-  out->append(s);
-}
-bool get_str(const std::string& in, size_t* pos, std::string* s) {
-  if (*pos + 4 > in.size()) return false;
-  uint32_t n;
-  memcpy(&n, in.data() + *pos, 4);
-  *pos += 4;
-  if (*pos + n > in.size()) return false;
-  s->assign(in.data() + *pos, n);
-  *pos += n;
-  return true;
-}
 
 // Emit the error response that unwedges a reaped request's window slot.
 void emit_reaped(uint8_t kind, uint64_t sock_id, int64_t seq) {
@@ -293,49 +427,359 @@ void reap_expired() {
   for (auto& d : dead) emit_reaped(d.second, d.first.sock_id, d.first.seq);
 }
 
+// Reap every in-flight request routed to `slot` NOW (its worker is dead:
+// no answer is coming — waiting out the 30s timeout just serves 503s
+// slower).
+void reap_slot_inflight(int slot) {
+  std::vector<std::pair<InflightKey, uint8_t>> dead;
+  {
+    std::lock_guard<std::mutex> g(g_inflight_mu);
+    for (auto it = g_inflight.begin(); it != g_inflight.end();) {
+      if (it->second.slot == slot) {
+        dead.emplace_back(it->first, it->second.kind);
+        it = g_inflight.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (auto& d : dead) emit_reaped(d.second, d.first.sock_id, d.first.seq);
+}
+
+// ---------------------------------------------------------------------------
+// parent: response drain (drainer thread + scheduler idle hooks)
+// ---------------------------------------------------------------------------
+
+struct UserSpanCtx {
+  int slot;
+  uint32_t epoch;
+  uint64_t span_off;
+};
+
+void user_span_free(void* raw) {
+  UserSpanCtx* ctx = (UserSpanCtx*)raw;
+  // a release outliving a slot recovery (epoch bump) must not scribble
+  // the released bit onto arena bytes a fresh worker now owns
+  if (g_seg != nullptr &&
+      g_slot_epoch[ctx->slot].load(std::memory_order_acquire) ==
+          ctx->epoch) {
+    span_release(resp_arena(ctx->slot), ctx->span_off);
+  }
+  g_user_spans[ctx->slot].fetch_sub(1, std::memory_order_acq_rel);
+  delete ctx;
+}
+
+// A descriptor's span/length must stay inside the arena (spans never
+// straddle the edge by construction): a corrupt cell — a buggy worker
+// scribbling shared memory — must be DROPPED, never chased into a read
+// past the mapping (the parent-crash class the old byte rings validated
+// against).
+bool span_sane(const CellView& c) {
+  uint64_t asize = g_seg->arena_bytes;
+  uint64_t off = c.span_off % asize;
+  return (off & 63) == 0 && (uint64_t)c.payload_len <= asize &&
+         off + 8 + (uint64_t)c.payload_len <= asize;
+}
+
+// Emit one popped response descriptor through the ordered emitters.
+void emit_response(int slot, const CellView& c) {
+  if (!span_sane(c)) return;  // corrupt cell: drop (reaper answers it)
+  char* arena = resp_arena(slot);
+  const char* p = span_payload(arena, c.span_off);
+  const char* end = p + c.payload_len;
+  const char *payload = nullptr, *message = nullptr;
+  size_t payload_len = 0, message_len = 0;
+  if (!get_blob(p, end, &payload, &payload_len) ||
+      !get_blob(p, end, &message, &message_len)) {
+    span_release(arena, c.span_off);
+    return;  // corrupt record: drop (reaper answers the request)
+  }
+  {
+    // already reaped (worker answered late): drop — emitting twice
+    // would poison the session reorder windows
+    std::lock_guard<std::mutex> g(g_inflight_mu);
+    auto it = g_inflight.find(InflightKey{c.sock_id, c.cid});
+    if (it == g_inflight.end()) {
+      span_release(arena, c.span_off);
+      return;
+    }
+    g_inflight.erase(it);
+  }
+  if (c.kind == 3 && payload_len >= kUserBlockMin) {
+    // zero-copy emit: the response IOBuf references the arena span via a
+    // user block; the span releases when the socket writev consumed it
+    UserSpanCtx* ctx = new UserSpanCtx{
+        slot, g_slot_epoch[slot].load(std::memory_order_acquire),
+        c.span_off};
+    g_user_spans[slot].fetch_add(1, std::memory_order_acq_rel);
+    IOBuf body;
+    body.append_user(payload, payload_len, user_span_free, ctx);
+    http_respond_iobuf(c.sock_id, c.cid, std::move(body),
+                       (c.flags & 1) != 0);
+    return;
+  }
+  if (c.kind == 3) {
+    nat_http_respond(c.sock_id, c.cid, payload, payload_len,
+                     (c.flags & 1) != 0);
+  } else if (c.kind == 4) {
+    char mbuf[256];
+    const char* msg = nullptr;
+    if (message_len != 0) {
+      size_t n = message_len < sizeof(mbuf) - 1 ? message_len
+                                                : sizeof(mbuf) - 1;
+      memcpy(mbuf, message, n);
+      mbuf[n] = '\0';
+      msg = mbuf;
+    }
+    nat_grpc_respond(c.sock_id, c.cid, payload, payload_len, c.status, msg);
+  }
+  span_release(arena, c.span_off);
+}
+
+// Per-slot consumer handshake with recovery: a consumer marks itself
+// busy, then RE-CHECKS the slot state before popping; recovery flips the
+// state to 2 first and then waits for busy to clear — so either the
+// consumer backs off, or recovery waits out its in-flight emit (which
+// includes the user-span bookkeeping a mid-emit pop would otherwise
+// register after recovery's quiesce check).
+std::atomic<int> g_emit_busy[kMaxWorkers] = {};
+
+// One sweep over every ACTIVE response ring; true when anything drained.
+// (state==2 slots are recovery-owned: recover_slot drains them itself.)
+bool drain_resp_once() {
+  if (g_seg == nullptr) return false;
+  bool any = false;
+  for (int i = 0; i < kMaxWorkers; i++) {
+    if (whdr(i)->state.load(std::memory_order_seq_cst) != 1) continue;
+    g_emit_busy[i].fetch_add(1, std::memory_order_seq_cst);
+    if (whdr(i)->state.load(std::memory_order_seq_cst) == 1) {
+      CellView c;
+      while (ring_pop(wresp(i), &c)) {
+        any = true;
+        emit_response(i, c);
+      }
+    }
+    g_emit_busy[i].fetch_sub(1, std::memory_order_seq_cst);
+  }
+  return any;
+}
+
+bool resp_any_ready() {
+  for (int i = 0; i < kMaxWorkers; i++) {
+    if (whdr(i)->state.load(std::memory_order_acquire) != 0 &&
+        ring_has_data(wresp(i))) {
+      return true;
+    }
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// recovery (the robust-fence slow path)
+// ---------------------------------------------------------------------------
+
+// Scrub every span header in [head, tail): after the slot's responses
+// are drained and in-flight user blocks released, anything unreleased is
+// the dead worker's half-claimed garbage.
+void scrub_arena(ShmRing* r, char* arena) {
+  uint64_t head = r->arena_head.load(std::memory_order_relaxed);
+  uint64_t tail = r->arena_tail.load(std::memory_order_relaxed);
+  while (head < tail) {
+    uint64_t h = span_hdr(arena, head)->load(std::memory_order_acquire);
+    uint64_t len = h & kSpanLenMask;
+    if (len == 0 || (len & 63) != 0 || len > g_seg->arena_bytes) {
+      // desynced header chain: drop the whole region (nothing references
+      // it any more — cells are drained and user blocks released)
+      r->arena_head.store(tail, std::memory_order_release);
+      return;
+    }
+    span_hdr(arena, head)->store(len | kSpanReleased,
+                                 std::memory_order_release);
+    head += len;
+  }
+  r->arena_head.store(head, std::memory_order_release);
+}
+
+// Force-free a ring's claimed-but-unpublished cells (a producer died
+// between claim and publish): without this the consumer can never pop
+// past the unpublished seq and the ring wedges forever.
+void ring_discard_claims(ShmRing* r) {
+  uint64_t enq = r->enq_pos.load(std::memory_order_relaxed);
+  uint64_t deq = r->deq_pos.load(std::memory_order_relaxed);
+  for (; deq < enq; deq++) {
+    r->cells[deq & (kRingSlots - 1)].seq.store(
+        deq + kRingSlots, std::memory_order_relaxed);
+  }
+  r->deq_pos.store(enq, std::memory_order_release);
+}
+
+// Recover a dead worker's slot. Requires the fence (EOWNERDEAD, made
+// consistent) to be held by the caller.
+void recover_slot(int i) {
+  ShmWorkerHdr* w = whdr(i);
+  w->state.store(2, std::memory_order_seq_cst);  // offers/drains back off
+  // wait out consumers already mid-drain on this slot (drainer thread /
+  // idle hooks): after busy clears, every pop's user-span bookkeeping is
+  // registered, so the quiesce wait below sees the true count
+  while (g_emit_busy[i].load(std::memory_order_seq_cst) > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  bool spans_quiesced;
+  {
+    std::lock_guard<std::mutex> g(g_req_mu[i]);  // flush in-flight offers
+    // late responses the dead worker DID publish are still valid: emit
+    CellView c;
+    while (ring_pop(wresp(i), &c)) emit_response(i, c);
+    // a worker killed between claim and publish leaves the response ring
+    // wedged on an unpublished cell: free the claimed range (anything
+    // published-but-unreachable behind it is lost — its request 503s)
+    ring_discard_claims(wresp(i));
+    // wait (bounded) for arena-backed user blocks still riding socket
+    // write queues; the epoch bump below fences any straggler
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(5);
+    while (g_user_spans[i].load(std::memory_order_acquire) > 0 &&
+           std::chrono::steady_clock::now() < deadline) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+    spans_quiesced = g_user_spans[i].load(std::memory_order_acquire) == 0;
+    g_slot_epoch[i].fetch_add(1, std::memory_order_acq_rel);
+    // discard queued requests the worker never took
+    ShmRing* rq = wreq(i);
+    ring_discard_claims(rq);
+    scrub_arena(rq, req_arena(i));
+    if (spans_quiesced) {
+      scrub_arena(wresp(i), resp_arena(i));
+    }
+    // else: a response is STILL queued on some glacial socket past the
+    // deadline — leak the unreleased spans (the epoch bump stops the
+    // eventual release from touching them) rather than hand bytes a
+    // live writev still reads to the replacement worker
+  }
+  // answer everything that was routed to this worker NOW
+  reap_slot_inflight(i);
+  g_seg->attached.fetch_sub(1, std::memory_order_acq_rel);
+  w->pid.store(0, std::memory_order_relaxed);
+  w->state.store(0, std::memory_order_seq_cst);  // slot reusable
+}
+
+// Probe every active slot's lifetime fence; recover the dead. Returns
+// the number of slots recovered. Parent-side only (drainer thread or an
+// explicit nat_shm_lane_recover_probe call); g_probe_mu serializes the
+// two against each other.
+std::mutex g_probe_mu;
+int probe_fences() {
+  if (g_seg == nullptr) return 0;
+  std::lock_guard<std::mutex> pg(g_probe_mu);
+  int recovered = 0;
+  for (int i = 0; i < kMaxWorkers; i++) {
+    ShmWorkerHdr* w = whdr(i);
+    if (w->state.load(std::memory_order_acquire) != 1) continue;
+    int rc = pthread_mutex_trylock(&w->fence);
+    if (rc == EBUSY) continue;  // worker alive, holding its fence
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&w->fence);
+    if (rc == EOWNERDEAD || rc == 0) {
+      // rc == 0 (unlocked while active) is the same condition: a live
+      // worker holds its fence for its whole lifetime
+      recover_slot(i);
+      recovered++;
+    }
+    if (rc == EOWNERDEAD || rc == 0) pthread_mutex_unlock(&w->fence);
+  }
+  return recovered;
+}
+
 // parent: response records -> the ordered per-session emitters
 void resp_drainer_loop() {
   while (!g_drainer_stop.load(std::memory_order_relaxed)) {
-    std::string rec;
-    bool got = ring_take(resp_ring(), &rec, 200);
+    bool any = drain_resp_once();
     reap_expired();
-    if (!got) continue;
-    size_t pos = 0;
-    if (rec.size() < 1 + 8 + 8 + 4 + 1) continue;
-    uint8_t kind = (uint8_t)rec[pos++];
-    uint64_t sock_id;
-    int64_t seq;
-    int32_t status;
-    memcpy(&sock_id, rec.data() + pos, 8);
-    pos += 8;
-    memcpy(&seq, rec.data() + pos, 8);
-    pos += 8;
-    memcpy(&status, rec.data() + pos, 4);
-    pos += 4;
-    uint8_t close_after = (uint8_t)rec[pos++];
-    std::string payload, message;
-    if (!get_str(rec, &pos, &payload) || !get_str(rec, &pos, &message)) {
-      continue;
-    }
-    {
-      // already reaped (worker answered late): drop — emitting twice
-      // would poison the session reorder windows
-      std::lock_guard<std::mutex> g(g_inflight_mu);
-      auto it = g_inflight.find(InflightKey{sock_id, seq});
-      if (it == g_inflight.end()) continue;
-      g_inflight.erase(it);
-    }
-    if (kind == 3) {
-      nat_http_respond(sock_id, seq, payload.data(), payload.size(),
-                       close_after);
-    } else if (kind == 4) {
-      nat_grpc_respond(sock_id, seq, payload.data(), payload.size(),
-                       status, message.empty() ? nullptr : message.c_str());
+    probe_fences();
+    if (!any) {
+      // waiter-gated park: producers only pay the futex wake while this
+      // flag is up (one wake per burst, not per record)
+      uint32_t db = g_seg->resp_doorbell.load(std::memory_order_seq_cst);
+      g_seg->resp_waiters.fetch_add(1, std::memory_order_seq_cst);
+      if (!resp_any_ready() &&
+          !g_drainer_stop.load(std::memory_order_relaxed)) {
+        futex_wait_shared(&g_seg->resp_doorbell, db, 200);
+      }
+      g_seg->resp_waiters.fetch_sub(1, std::memory_order_seq_cst);
     }
   }
 }
 
+// scheduler idle hook: parked fiber workers drain response rings instead
+// of sleeping — the doorbell's fast path on a busy host
+bool shm_idle_drain() {
+  if (!g_lane_enabled.load(std::memory_order_acquire)) return false;
+  return drain_resp_once();
+}
+
+// serialize a kind-3/4 request record into `dst`
+size_t request_blob_bytes(const PyRequest* r) {
+  return 16 + r->service.size() + r->method.size() + r->meta_bytes.size() +
+         r->payload.size();
+}
+void serialize_request(char* dst, const PyRequest* r) {
+  char* p = dst;
+  put_blob(p, r->service.data(), r->service.size());
+  put_blob(p, r->method.data(), r->method.size());
+  put_blob(p, r->meta_bytes.data(), r->meta_bytes.size());
+  put_blob(p, r->payload.data(), r->payload.size());
+}
+
+// Route one record to some live worker: claim, serialize and publish
+// under the per-worker producer lock (recovery takes the same lock, so
+// a slot can never be scrubbed with an offer mid-flight — a late
+// publish/memcpy would otherwise land on cells/spans the replacement
+// worker already owns), then ring the doorbell (waiter-gated) outside
+// it. Contended workers are skipped via try_lock, so holding the lock
+// across the memcpy spreads load instead of convoying producers.
+// fill(dst) writes exactly `blob_len` bytes.
+template <typename Fill>
+bool push_to_some_worker(uint8_t kind, uint8_t flags, uint64_t sock_id,
+                         int64_t cid, int32_t status, size_t blob_len,
+                         uint64_t aux, const Fill& fill, int* slot_out) {
+  uint32_t start = g_rr.fetch_add(1, std::memory_order_relaxed);
+  for (int k = 0; k < kMaxWorkers; k++) {
+    int i = (int)((start + (uint32_t)k) % kMaxWorkers);
+    ShmWorkerHdr* w = whdr(i);
+    if (w->state.load(std::memory_order_seq_cst) != 1) continue;
+    {
+      std::unique_lock<std::mutex> lk(g_req_mu[i], std::try_to_lock);
+      if (!lk.owns_lock()) continue;  // contended: spread to the next
+      if (w->state.load(std::memory_order_seq_cst) != 1) continue;
+      uint64_t pos, span;
+      char* dst;
+      if (!ring_begin_push(wreq(i), req_arena(i), blob_len, &pos, &span,
+                           &dst)) {
+        continue;  // ring/arena full: try the next worker (backpressure)
+      }
+      fill(dst);
+      ring_publish(wreq(i), pos, kind, flags, sock_id, cid, status, span,
+                   (uint32_t)blob_len, aux);
+    }
+    w->req_doorbell.fetch_add(1, std::memory_order_seq_cst);
+    if (w->req_waiters.load(std::memory_order_seq_cst) != 0) {
+      futex_wake_shared(&w->req_doorbell);
+    }
+    if (slot_out != nullptr) *slot_out = i;
+    return true;
+  }
+  return false;
+}
+
 }  // namespace
+
+// release hook for arena-backed PyRequests (declared in nat_internal.h,
+// called from ~PyRequest in whichever process owns the request)
+void shm_req_span_release(PyRequest* r) {
+  if (g_seg == nullptr || r->shm_slot < 0 || r->shm_slot >= kMaxWorkers) {
+    return;
+  }
+  span_release(req_arena(r->shm_slot), r->shm_span);
+}
 
 // enqueue hook used by the cut loops: true = the request was routed to
 // the shm worker lane (consumed), false = keep the in-process py lane.
@@ -346,33 +790,30 @@ bool shm_lane_offer(PyRequest* r) {
   // in-process instead of queueing requests for the reaper to 503
   int64_t last = g_seg->last_worker_poll_ms.load(std::memory_order_relaxed);
   if (last == 0 || mono_ms() - last > 2000) return false;
-  std::string rec;
-  rec.reserve(64 + r->service.size() + r->method.size() +
-              r->payload.size() + r->meta_bytes.size());
-  rec.push_back((char)r->kind);
-  rec.append((const char*)&r->sock_id, 8);
-  rec.append((const char*)&r->cid, 8);
-  put_str(&rec, r->service);
-  put_str(&rec, r->method);
-  put_str(&rec, r->meta_bytes);
-  put_str(&rec, r->payload);
-  // track BEFORE the put: once the record is visible a worker may
-  // answer instantly, and the drainer drops responses with no entry
+  size_t blob_len = request_blob_bytes(r);
+  // track BEFORE the publish: once the descriptor is visible a worker
+  // may answer instantly, and the drainer drops responses with no entry
   {
     std::lock_guard<std::mutex> g(g_inflight_mu);
     g_inflight[InflightKey{r->sock_id, r->cid}] = InflightEntry{
-        (uint8_t)r->kind,
+        (uint8_t)r->kind, (int8_t)-1,
         std::chrono::steady_clock::now() +
             std::chrono::milliseconds(
                 g_reap_timeout_ms.load(std::memory_order_relaxed))};
   }
-  // ring full / shutdown: fall back to the in-process lane. TRY-put —
-  // this runs on the reactor thread, which must never park on a futex
-  // (a stalled worker pool would freeze every connection it serves)
-  if (!ring_put(req_ring(), rec, -1)) {
+  int slot = -1;
+  bool ok = push_to_some_worker(
+      (uint8_t)r->kind, 0, r->sock_id, r->cid, 0, blob_len, 0,
+      [&](char* dst) { serialize_request(dst, r); }, &slot);
+  if (!ok) {
     std::lock_guard<std::mutex> g(g_inflight_mu);
     g_inflight.erase(InflightKey{r->sock_id, r->cid});
-    return false;
+    return false;  // every ring full / no live worker: in-process lane
+  }
+  {
+    std::lock_guard<std::mutex> g(g_inflight_mu);
+    auto it = g_inflight.find(InflightKey{r->sock_id, r->cid});
+    if (it != g_inflight.end()) it->second.slot = (int8_t)slot;
   }
   delete r;
   return true;
@@ -386,14 +827,30 @@ extern "C" {
 int nat_shm_lane_create(size_t ring_bytes) {
   if (g_seg != nullptr && !g_seg_unlinked) return 0;
   if (g_seg != nullptr) {  // previous lane fully shut down: replace
-    munmap(g_seg, g_seg_total);
+    // fence stragglers first: an arena-backed user block still riding a
+    // socket write queue must not release its span into the NEW segment
+    for (int i = 0; i < kMaxWorkers; i++) {
+      g_slot_epoch[i].fetch_add(1, std::memory_order_acq_rel);
+    }
+    // LEAK the old mapping rather than munmap it: the scheduler idle
+    // hook, a reactor mid-offer, or a late user-block release may still
+    // be dereferencing the old pointers (only the lane-enabled flag
+    // gates them, not a rendezvous) — a stray touch of an unlinked,
+    // still-mapped segment is harmless, a touch of an unmapped one is a
+    // SIGSEGV. Stop->start cycles are rare; the cost is bounded virtual
+    // address space, not RAM that matters.
     g_seg = nullptr;
+    g_my_slot = -1;
   }
   if (ring_bytes == 0) ring_bytes = 8u << 20;
+  ring_bytes = (ring_bytes + 4095) & ~(size_t)4095;
   static std::atomic<int> counter{0};
   snprintf(g_seg_name, sizeof(g_seg_name), "/brpc_tpu_lane_%d_%d",
            (int)getpid(), counter.fetch_add(1, std::memory_order_relaxed));
-  size_t total = sizeof(ShmSeg) + 2 * (sizeof(ShmRing) + ring_bytes);
+  size_t block = ((sizeof(ShmWorkerHdr) + 63) & ~(size_t)63) +
+                 2 * (sizeof(ShmRing) + ring_bytes);
+  size_t total =
+      ((sizeof(ShmSeg) + 63) & ~(size_t)63) + (size_t)kMaxWorkers * block;
   shm_unlink(g_seg_name);
   int fd = shm_open(g_seg_name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return -1;
@@ -413,23 +870,51 @@ int nat_shm_lane_create(size_t ring_bytes) {
   g_seg_total = total;
   g_seg_unlinked = false;
   g_seg->magic = kShmMagic;
-  g_seg->ring_bytes = ring_bytes;
+  g_seg->version = 2;
+  g_seg->nslots = kMaxWorkers;
+  g_seg->arena_bytes = ring_bytes;
   g_seg->attached.store(0, std::memory_order_relaxed);
-  ring_init(req_ring(), ring_bytes);
-  ring_init(resp_ring(), ring_bytes);
+  g_seg->shutdown.store(0, std::memory_order_relaxed);
+  g_seg->last_worker_poll_ms.store(0, std::memory_order_relaxed);
+  g_seg->resp_doorbell.store(0, std::memory_order_relaxed);
+  g_seg->resp_waiters.store(0, std::memory_order_relaxed);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  for (int i = 0; i < kMaxWorkers; i++) {
+    ShmWorkerHdr* w = whdr(i);
+    w->state.store(0, std::memory_order_relaxed);
+    w->pid.store(0, std::memory_order_relaxed);
+    w->req_doorbell.store(0, std::memory_order_relaxed);
+    w->req_waiters.store(0, std::memory_order_relaxed);
+    pthread_mutex_init(&w->fence, &ma);
+    ring_init(wreq(i));
+    ring_init(wresp(i));
+    g_user_spans[i].store(0, std::memory_order_relaxed);
+  }
+  pthread_mutexattr_destroy(&ma);
   return 0;
 }
 
-// Parent: how many workers have completed attach (readiness barrier —
+// Worker-slot capacity of the lane (the per-worker rings/arenas are
+// pre-carved at create): the Python mount clamps py_workers against
+// this instead of hand-mirroring the constant.
+int nat_shm_lane_max_workers() { return kMaxWorkers; }
+
+// Parent: how many workers are attached and live (readiness barrier —
 // a short reap timeout must not fire while workers are still booting).
 int nat_shm_lane_workers() {
-  return g_seg != nullptr ? g_seg->attached.load(std::memory_order_acquire) : 0;
+  return g_seg != nullptr
+             ? g_seg->attached.load(std::memory_order_acquire)
+             : 0;
 }
 
 const char* nat_shm_lane_name() { return g_seg != nullptr ? g_seg_name : ""; }
 
 // Parent: route kind-3/4 py-lane requests to the workers + start the
-// response drainer. Disable unlinks the shm name (the RAM-backed
+// response drainer and the scheduler idle-hook drain. Disable signals
+// shutdown, stops the drainer and unlinks the shm name (the RAM-backed
 // segment must not outlive the server run); the mapping stays until a
 // later create replaces it.
 int nat_shm_lane_enable(int enable) {
@@ -439,16 +924,26 @@ int nat_shm_lane_enable(int enable) {
       std::lock_guard<std::mutex> g(g_inflight_mu);
       g_inflight.clear();
     }
+    g_seg->shutdown.store(0, std::memory_order_release);
     g_drainer_stop.store(false, std::memory_order_relaxed);
     delete g_resp_drainer;
     g_resp_drainer = new std::thread(resp_drainer_loop);
+    static std::atomic<bool> hook_added{false};
+    if (!hook_added.exchange(true, std::memory_order_acq_rel)) {
+      Scheduler::instance()->add_idle_hook([] { return shm_idle_drain(); });
+    }
     g_lane_enabled.store(true, std::memory_order_release);
-  } else if (enable == 0 &&
-             g_lane_enabled.load(std::memory_order_acquire)) {
+  } else if (enable == 0) {
     g_lane_enabled.store(false, std::memory_order_release);
-    ring_shutdown(req_ring());
-    ring_shutdown(resp_ring());
+    g_seg->shutdown.store(1, std::memory_order_release);
     g_drainer_stop.store(true, std::memory_order_relaxed);
+    // wake every parked consumer so shutdown is observed promptly
+    g_seg->resp_doorbell.fetch_add(1, std::memory_order_seq_cst);
+    futex_wake_shared(&g_seg->resp_doorbell);
+    for (int i = 0; i < kMaxWorkers; i++) {
+      whdr(i)->req_doorbell.fetch_add(1, std::memory_order_seq_cst);
+      futex_wake_shared(&whdr(i)->req_doorbell);
+    }
     if (g_resp_drainer != nullptr && g_resp_drainer->joinable()) {
       g_resp_drainer->join();
     }
@@ -468,76 +963,276 @@ int nat_shm_lane_set_timeout_ms(int ms) {
   return 0;
 }
 
-// Worker: map the parent's segment. Also arms parent-death delivery of
-// SIGTERM so a hard parent crash cannot leave orphan workers polling
-// the (leaked) segment forever.
+// Ops/test entry: probe every worker fence once and recover dead slots
+// (the drainer does this continuously while the lane is enabled).
+// Returns the number of slots recovered.
+int nat_shm_lane_recover_probe(void) { return probe_fences(); }
+
+// Worker: map the parent's segment (same-process callers reuse the
+// existing mapping) and claim a worker slot by locking its lifetime
+// fence. Also arms parent-death delivery of SIGTERM so a hard parent
+// crash cannot leave orphan workers polling the (leaked) segment.
 int nat_shm_worker_attach(const char* name) {
-  if (g_seg != nullptr) return 0;
-  prctl(PR_SET_PDEATHSIG, SIGTERM);
-  int fd = shm_open(name, O_RDWR, 0600);
-  if (fd < 0) return -1;
-  struct stat st;
-  if (fstat(fd, &st) != 0) {
+  if (g_my_slot >= 0) return 0;
+  if (g_seg == nullptr) {
+    prctl(PR_SET_PDEATHSIG, SIGTERM);
+    int fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return -1;
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      ::close(fd);
+      return -1;
+    }
+    void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
     ::close(fd);
-    return -1;
+    if (mem == MAP_FAILED) return -1;
+    if (((ShmSeg*)mem)->magic != kShmMagic) {
+      munmap(mem, (size_t)st.st_size);
+      return -1;
+    }
+    g_seg = (ShmSeg*)mem;
+    g_seg_total = (size_t)st.st_size;
   }
-  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
-                   MAP_SHARED, fd, 0);
-  ::close(fd);
-  if (mem == MAP_FAILED) return -1;
-  g_seg = (ShmSeg*)mem;
-  if (g_seg->magic != kShmMagic) return -1;
-  // the attach IS the first heartbeat: requests arriving between attach
-  // and the worker's first take must route to the ring, not fall back
-  g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
-  g_seg->attached.fetch_add(1, std::memory_order_release);
-  return 0;
+  for (int i = 0; i < kMaxWorkers; i++) {
+    ShmWorkerHdr* w = whdr(i);
+    uint32_t expect = 0;
+    if (!w->state.compare_exchange_strong(expect, 3,
+                                          std::memory_order_acq_rel,
+                                          std::memory_order_acquire)) {
+      continue;
+    }
+    int rc = pthread_mutex_lock(&w->fence);
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(&w->fence);
+      rc = 0;
+    }
+    if (rc != 0) {
+      w->state.store(0, std::memory_order_release);
+      return -1;
+    }
+    w->pid.store((int32_t)getpid(), std::memory_order_relaxed);
+    g_my_slot = i;
+    // the attach IS the first heartbeat: requests arriving between
+    // attach and the worker's first take must route to the ring
+    g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
+    w->state.store(1, std::memory_order_release);
+    g_seg->attached.fetch_add(1, std::memory_order_acq_rel);
+    return 0;
+  }
+  return -1;  // every slot taken
 }
 
 // Worker: take one request; returns a PyRequest* handle compatible with
-// the nat_req_* accessors (+ nat_req_free), or null on timeout.
+// the nat_req_* accessors (+ nat_req_free), or null on timeout. The
+// string fields are VIEWS into the blob arena (zero-copy); freeing the
+// request releases the span.
 void* nat_shm_take_request(int timeout_ms) {
-  if (g_seg == nullptr) return nullptr;
+  if (g_seg == nullptr || g_my_slot < 0) return nullptr;
+  ShmWorkerHdr* w = whdr(g_my_slot);
+  ShmRing* r = wreq(g_my_slot);
   // liveness heartbeat for the parent's all-workers-dead fallback
   g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
-  std::string rec;
-  if (!ring_take(req_ring(), &rec, timeout_ms)) return nullptr;
-  if (rec.size() < 17) return nullptr;
-  PyRequest* r = new PyRequest();
-  size_t pos = 0;
-  r->kind = (int32_t)(uint8_t)rec[pos++];
-  memcpy(&r->sock_id, rec.data() + pos, 8);
-  pos += 8;
-  memcpy(&r->cid, rec.data() + pos, 8);
-  pos += 8;
-  if (!get_str(rec, &pos, &r->service) ||
-      !get_str(rec, &pos, &r->method) ||
-      !get_str(rec, &pos, &r->meta_bytes) ||
-      !get_str(rec, &pos, &r->payload)) {
-    delete r;
-    return nullptr;
+  for (int attempt = 0;; attempt++) {
+    CellView c;
+    while (ring_pop(r, &c)) {
+      g_seg->last_worker_poll_ms.store(mono_ms(),
+                                       std::memory_order_relaxed);
+      if (!span_sane(c)) continue;  // corrupt cell: drop, look again
+      PyRequest* req = new PyRequest();
+      req->kind = (int32_t)c.kind;
+      req->sock_id = c.sock_id;
+      req->cid = c.cid;
+      req->aux = c.aux;
+      req->shm_slot = g_my_slot;
+      req->shm_span = c.span_off;
+      char* arena = req_arena(g_my_slot);
+      const char* p = span_payload(arena, c.span_off);
+      const char* end = p + c.payload_len;
+      if (c.kind == 8) {  // bulk tensor record: raw blob, no framing
+        req->shm_view[2] = p;
+        req->shm_view_len[2] = c.payload_len;
+        return req;
+      }
+      const char *svc, *mth, *meta, *pay;
+      size_t svc_n, mth_n, meta_n, pay_n;
+      if (!get_blob(p, end, &svc, &svc_n) ||
+          !get_blob(p, end, &mth, &mth_n) ||
+          !get_blob(p, end, &meta, &meta_n) ||
+          !get_blob(p, end, &pay, &pay_n)) {
+        delete req;  // corrupt record (releases the span); look again
+        continue;
+      }
+      req->shm_view[0] = svc;
+      req->shm_view_len[0] = svc_n;
+      req->shm_view[1] = mth;
+      req->shm_view_len[1] = mth_n;
+      req->shm_view[4] = meta;
+      req->shm_view_len[4] = meta_n;
+      req->shm_view[2] = pay;
+      req->shm_view_len[2] = pay_n;
+      return req;
+    }
+    if (g_seg->shutdown.load(std::memory_order_acquire) != 0) {
+      return nullptr;
+    }
+    if (attempt >= 1) return nullptr;  // one bounded wait per call
+    uint32_t db = w->req_doorbell.load(std::memory_order_seq_cst);
+    w->req_waiters.fetch_add(1, std::memory_order_seq_cst);
+    if (!ring_has_data(r) &&
+        g_seg->shutdown.load(std::memory_order_acquire) == 0) {
+      futex_wait_shared(&w->req_doorbell, db,
+                        timeout_ms > 0 ? timeout_ms : 200);
+    }
+    w->req_waiters.fetch_sub(1, std::memory_order_seq_cst);
   }
-  return r;
 }
 
 // Worker: push a response record (kind 3 = serialized HTTP response,
-// kind 4 = gRPC payload + status + message).
+// kind 4 = gRPC payload + status + message). Blocks (bounded backoff)
+// while the descriptor ring or blob arena is full — the arena IS the
+// backpressure bound on worker output.
 int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
                     const char* payload, size_t payload_len, int32_t status,
                     const char* message, int close_after) {
+  if (g_seg == nullptr || g_my_slot < 0) return -1;
+  size_t msg_len = message != nullptr ? strlen(message) : 0;
+  size_t blob_len = 8 + payload_len + msg_len;
+  // can NEVER fit (response larger than the whole blob arena): fail now
+  // instead of spinning on backpressure that cannot clear — the parent
+  // reaper answers the request
+  if (blob_len + 8 + 128 > g_seg->arena_bytes) return -1;
+  ShmRing* r = wresp(g_my_slot);
+  char* arena = resp_arena(g_my_slot);
+  // BOUNDED backpressure: the arena normally frees within a drain pass,
+  // but a client that stops reading can pin a user-block span (and so
+  // the ring arena behind it) indefinitely — a worker must not wedge its
+  // whole take loop on one glacial connection; give up and let the
+  // parent's reaper answer this request
+  auto give_up =
+      std::chrono::steady_clock::now() + std::chrono::seconds(10);
+  for (;;) {
+    if (g_seg->shutdown.load(std::memory_order_acquire) != 0) return -1;
+    uint64_t pos, span;
+    char* dst;
+    bool ok;
+    {
+      std::lock_guard<std::mutex> g(*g_resp_mu);
+      ok = ring_begin_push(r, arena, blob_len, &pos, &span, &dst);
+    }
+    if (!ok) {  // ring/arena full: bounded backoff until the drain frees
+      if (std::chrono::steady_clock::now() >= give_up) return -1;
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+      continue;
+    }
+    char* p = dst;
+    put_blob(p, payload, payload_len);
+    put_blob(p, message, msg_len);
+    ring_publish(r, pos, (uint8_t)kind, close_after != 0 ? 1 : 0, sock_id,
+                 seq, status, span, (uint32_t)blob_len, 0);
+    g_seg->resp_doorbell.fetch_add(1, std::memory_order_seq_cst);
+    if (g_seg->resp_waiters.load(std::memory_order_seq_cst) != 0) {
+      futex_wake_shared(&g_seg->resp_doorbell);
+    }
+    return 0;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// bulk-tensor entry + transport microbenchmarks
+// ---------------------------------------------------------------------------
+
+// Parent: stage `len` tensor/user bytes straight into a worker's blob
+// arena and publish one kind-8 descriptor (aux = tag). This is the seam
+// the device lane / future ICI transport stages through: one memcpy into
+// registered shared memory, a 64-byte descriptor on the ring, and the
+// consumer reads in place. Returns 0, or -1 when every ring is full (the
+// caller owns backpressure policy).
+int nat_shm_push_tensor(const char* data, size_t len, uint64_t tag) {
   if (g_seg == nullptr) return -1;
-  std::string rec;
-  rec.reserve(32 + payload_len);
-  rec.push_back((char)kind);
-  rec.append((const char*)&sock_id, 8);
-  rec.append((const char*)&seq, 8);
-  rec.append((const char*)&status, 4);
-  rec.push_back((char)(close_after != 0));
-  std::string p(payload, payload_len);
-  put_str(&rec, p);
-  std::string m(message != nullptr ? message : "");
-  put_str(&rec, m);
-  return ring_put(resp_ring(), rec, 0) ? 0 : -1;
+  bool ok = push_to_some_worker(
+      8, 0, 0, 0, 0, len, tag,
+      [&](char* dst) {
+        if (len != 0) memcpy(dst, data, len);
+      },
+      nullptr);
+  return ok ? 0 : -1;
+}
+
+// Parent-side throughput probe: push fixed-size records for `seconds`
+// against live worker drains; returns GB/s (and the record count).
+double nat_shm_push_bench(size_t record_bytes, double seconds,
+                          uint64_t* out_records) {
+  if (out_records != nullptr) *out_records = 0;
+  if (g_seg == nullptr || record_bytes == 0) return 0.0;
+  char* buf = (char*)malloc(record_bytes);
+  if (buf == nullptr) return 0.0;
+  memset(buf, 0x5a, record_bytes);
+  uint64_t records = 0;
+  auto t0 = std::chrono::steady_clock::now();
+  auto deadline =
+      t0 + std::chrono::microseconds((int64_t)(seconds * 1e6));
+  for (;;) {
+    if (nat_shm_push_tensor(buf, record_bytes, records) == 0) {
+      records++;
+      // amortize the clock read over bursts of successful pushes
+      if ((records & 0x3f) != 0) continue;
+    } else {
+      // full: brief backoff before re-checking the clock
+      std::this_thread::sleep_for(std::chrono::microseconds(50));
+    }
+    if (std::chrono::steady_clock::now() >= deadline) break;
+  }
+  double dt =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  free(buf);
+  if (out_records != nullptr) *out_records = records;
+  return dt > 0 ? (double)records * (double)record_bytes / dt / 1e9 : 0.0;
+}
+
+// Worker-side native drain loop (the bench consumer): pops descriptors
+// and releases their spans in place (no PyRequest, no FFI per record).
+// Returns the number of records drained; exits after `idle_exit_ms`
+// without data or on lane shutdown.
+uint64_t nat_shm_worker_drain_bench(int idle_exit_ms) {
+  if (g_seg == nullptr || g_my_slot < 0) return 0;
+  ShmWorkerHdr* w = whdr(g_my_slot);
+  ShmRing* r = wreq(g_my_slot);
+  char* arena = req_arena(g_my_slot);
+  uint64_t drained = 0;
+  if (idle_exit_ms <= 0) idle_exit_ms = 200;
+  auto last_work = std::chrono::steady_clock::now();
+  for (;;) {
+    CellView c;
+    bool got = false;
+    while (ring_pop(r, &c)) {
+      if (span_sane(c)) span_release(arena, c.span_off);
+      drained++;
+      got = true;
+    }
+    g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
+    if (got) {
+      last_work = std::chrono::steady_clock::now();
+      continue;
+    }
+    if (g_seg->shutdown.load(std::memory_order_acquire) != 0) break;
+    // exit only after a FULL quiet window: futex returns early on wakes,
+    // EAGAIN and EINTR, none of which mean the producer is done
+    if (std::chrono::steady_clock::now() - last_work >=
+        std::chrono::milliseconds(idle_exit_ms)) {
+      break;
+    }
+    uint32_t db = w->req_doorbell.load(std::memory_order_seq_cst);
+    w->req_waiters.fetch_add(1, std::memory_order_seq_cst);
+    if (!ring_has_data(r) &&
+        g_seg->shutdown.load(std::memory_order_acquire) == 0) {
+      futex_wait_shared(&w->req_doorbell, db,
+                        idle_exit_ms < 50 ? idle_exit_ms : 50);
+    }
+    w->req_waiters.fetch_sub(1, std::memory_order_seq_cst);
+  }
+  return drained;
 }
 
 }  // extern "C"

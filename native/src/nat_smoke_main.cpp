@@ -146,6 +146,57 @@ int main() {
     nat_channel_close(hch);
   }
 
+  // ---- shm descriptor-ring lane: push/respond under concurrent drain
+  // (same-process worker: the rings/arena/doorbells/robust fence are the
+  // same shm words the cross-process lane uses, so the sanitizer lanes
+  // see every producer/consumer overlap) ----
+  CHECK(nat_shm_lane_create(1u << 20) == 0, "shm lane create");
+  CHECK(nat_shm_worker_attach(nat_shm_lane_name()) == 0, "shm attach");
+  CHECK(nat_shm_lane_enable(1) == 0, "shm enable");
+  CHECK(nat_shm_lane_set_timeout_ms(2000) == 0, "shm timeout knob");
+  {
+    std::atomic<bool> shm_stop{false};
+    std::atomic<int> shm_taken{0};
+    std::thread shm_worker([&] {
+      while (!shm_stop.load(std::memory_order_acquire)) {
+        void* h = nat_shm_take_request(50);
+        if (h == nullptr) continue;
+        size_t n = 0;
+        const char* p = nat_req_field(h, 2, &n);
+        // answer through the response ring: the parent drainer (and the
+        // scheduler idle hooks) pop it concurrently with these pushes
+        nat_shm_respond(3, nat_req_sock_id(h), nat_req_cid(h), p, n, 0,
+                        nullptr, 0);
+        nat_req_free(h);
+        shm_taken.fetch_add(1, std::memory_order_relaxed);
+      }
+    });
+    size_t rec = 300u << 10;  // wraps the 1MB arena repeatedly
+    char* tb = (char*)malloc(rec);
+    memset(tb, 7, rec);
+    int shm_pushed = 0;
+    for (int i = 0; i < 200; i++) {
+      if (nat_shm_push_tensor(tb, rec, (uint64_t)i) == 0) {
+        shm_pushed++;
+      } else {  // arena backpressure: let the worker drain
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+      }
+    }
+    free(tb);
+    CHECK(shm_pushed >= 100, "shm pushes moved under drain");
+    auto shm_deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(20);
+    while (shm_taken.load(std::memory_order_relaxed) < shm_pushed &&
+           std::chrono::steady_clock::now() < shm_deadline) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    }
+    CHECK(shm_taken.load(std::memory_order_relaxed) == shm_pushed,
+          "shm records all delivered");
+    shm_stop.store(true, std::memory_order_release);
+    shm_worker.join();
+    CHECK(nat_shm_lane_enable(0) == 0, "shm disable");
+  }
+
   // ---- redis lane: native store under pipelined load ----
   uint64_t redis_reqs = 0;
   double redis_qps = nat_redis_client_bench("127.0.0.1", port, 1, 8, 0.2,

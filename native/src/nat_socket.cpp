@@ -231,6 +231,9 @@ void NatSocket::set_failed() {
     server->enqueue_py(r);
   }
   if (channel != nullptr) {
+    // read-until-close HTTP bodies: EOF IS the response terminator —
+    // complete the accumulated call before fail_all can error it
+    if (httpc != nullptr) http_cli_on_socket_fail(this);
     if (channel->sock_id.load(std::memory_order_acquire) == id) {
       channel->fail_all(kEFAILEDSOCKET, "socket failed");
       if (channel->health_check_interval_ms > 0 &&

@@ -15,3 +15,24 @@ def make():
             done()
 
     return [EchoService()]
+
+
+def make_slow():
+    """Echo that parks ~400ms per request — lets the crash tests SIGKILL
+    a worker deterministically MID-RECORD (descriptor consumed from the
+    ring, response not yet published), exercising the robust-fence
+    recovery path rather than the idle-worker one."""
+    from brpc_tpu import rpc
+    from brpc_tpu.rpc.proto import echo_pb2
+
+    class EchoService(rpc.Service):
+        @rpc.rpc_method(echo_pb2.EchoRequest, echo_pb2.EchoResponse)
+        def Echo(self, cntl, request, response, done):
+            import os
+            import time
+
+            time.sleep(0.4)
+            response.message = f"{request.message}@{os.getpid()}"
+            done()
+
+    return [EchoService()]

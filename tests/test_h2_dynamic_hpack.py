@@ -170,3 +170,77 @@ def test_interleaved_native_and_py_responses(mixed_server):
             assert rn.message == f"n{i}"
             rp = py(echo_pb2.EchoRequest(message=f"p{i}"), timeout=10)
             assert rp.message == f"py:p{i}"
+
+
+def test_mid_connection_shrink_update_leads_next_block(mixed_server):
+    """SETTINGS_HEADER_TABLE_SIZE shrink mid-connection: the §4.2 size
+    update must lead the NEXT header block on the wire even when that
+    block is a py-thread STATIC response (ADVICE r5) — a strict decoder
+    treats a block without the owed update as COMPRESSION_ERROR."""
+    import socket as pysock
+    import struct
+
+    port = mixed_server.listen_endpoint.port
+
+    def frame(ftype, flags, sid, payload):
+        return (struct.pack(">I", len(payload))[1:] +
+                bytes([ftype, flags]) + struct.pack(">I", sid) + payload)
+
+    def req_block(path):
+        blk = b"\x83\x86"  # :method POST, :scheme http
+        return blk + b"\x04" + bytes([len(path)]) + path
+
+    body = b"\x00\x00\x00\x00\x00"  # empty gRPC message
+
+    def read_headers_frames(sk, buf, want_streams):
+        """Drain frames until every stream in want_streams delivered at
+        least one HEADERS; returns ({sid: [payload, ...]}, leftover)."""
+        import time as _time
+
+        got = {}
+        deadline = _time.time() + 10
+        while (_time.time() < deadline and
+               not all(s in got for s in want_streams)):
+            pos = 0
+            while pos + 9 <= len(buf):
+                ln = int.from_bytes(buf[pos:pos + 3], "big")
+                if pos + 9 + ln > len(buf):
+                    break
+                ftype = buf[pos + 3]
+                sid = int.from_bytes(buf[pos + 5:pos + 9], "big") & 0x7FFFFFFF
+                if ftype == 1:
+                    got.setdefault(sid, []).append(buf[pos + 9:pos + 9 + ln])
+                pos += 9 + ln
+            buf = buf[pos:]
+            if all(s in got for s in want_streams):
+                break
+            chunk = sk.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        return got, buf
+
+    sk = pysock.create_connection(("127.0.0.1", port), timeout=10)
+    try:
+        # default table; a NATIVE response warms the dynamic encoder
+        sk.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" +
+                   frame(4, 0, 0, b"") +
+                   frame(1, 0x4, 1, req_block(b"/EchoService/Echo")) +
+                   frame(0, 0x1, 1, body))
+        sk.settimeout(10)
+        got, buf = read_headers_frames(sk, b"", {1})
+        assert 1 in got
+        # shrink to 0, then a PY-LANE request (static response path):
+        # whichever block goes out next must carry the update in front
+        sk.sendall(frame(4, 0, 0, struct.pack(">HI", 1, 0)) +
+                   frame(1, 0x4, 3, req_block(b"/PyEchoService/Echo")) +
+                   frame(0, 0x1, 3, body))
+        got, buf = read_headers_frames(sk, buf, {3})
+        assert 3 in got, "no py response HEADERS seen"
+        first_block = got[3][0]
+        ops = _hpack_ops(first_block)
+        assert ops and ops[0] == "resize", (ops, first_block.hex())
+        # shrunk to 0: nothing may incrementally index afterwards
+        assert "incr" not in ops, (ops, first_block.hex())
+    finally:
+        sk.close()

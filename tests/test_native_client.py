@@ -325,3 +325,89 @@ def test_client_lane_bench_smoke(native_server):
                                    seconds=0.5, path="/EchoService/Echo",
                                    body=b'{"message": "b"}')
     assert r2["requests"] > 100, r2
+
+
+def _one_shot_http_server(response_bytes, close_after=True):
+    """Raw-socket HTTP server: accepts one connection, reads the request
+    head, writes `response_bytes`, then closes (or lingers)."""
+    import socket as pysock
+
+    lsock = pysock.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def serve():
+        conn, _ = lsock.accept()
+        try:
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            conn.sendall(response_bytes)
+            if close_after:
+                conn.shutdown(pysock.SHUT_WR)
+                # linger until the client saw EOF and hung up
+                conn.settimeout(5)
+                try:
+                    while conn.recv(4096):
+                        pass
+                except OSError:
+                    pass
+        finally:
+            conn.close()
+            lsock.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return port, t
+
+
+def test_http_client_read_until_close_body():
+    """A response with no Content-Length and no chunked framing but
+    Connection: close is CLOSE-DELIMITED (ADVICE r5): the client must
+    accumulate until EOF and complete with the full body — not report a
+    silent empty 200."""
+    body = b"close-delimited " * 700  # ~11KB, several read rounds
+    port, t = _one_shot_http_server(
+        b"HTTP/1.1 200 OK\r\nConnection: close\r\n\r\n" + body)
+    h = native.channel_open_http("127.0.0.1", port)
+    try:
+        status, out = native.http_call(h, "GET", "/blob", timeout_ms=5000)
+        assert status == 200
+        assert out == body
+    finally:
+        native.channel_close(h)
+        t.join(timeout=5)
+
+
+def test_http_client_read_until_close_http10():
+    """HTTP/1.0 with no framing headers defaults to close-delimited."""
+    body = b"ten-dot-zero body"
+    port, t = _one_shot_http_server(b"HTTP/1.0 200 OK\r\n\r\n" + body)
+    h = native.channel_open_http("127.0.0.1", port)
+    try:
+        status, out = native.http_call(h, "GET", "/", timeout_ms=5000)
+        assert status == 200
+        assert out == body
+    finally:
+        native.channel_close(h)
+        t.join(timeout=5)
+
+
+def test_http_client_unframed_keepalive_fails_explicitly():
+    """A keep-alive response with NO framing at all is undecodable: the
+    call must fail explicitly (failed socket), never complete with wrong
+    (empty) data — the ADVICE r5 'silently empty body' half."""
+    port, t = _one_shot_http_server(
+        b"HTTP/1.1 200 OK\r\nConnection: keep-alive\r\n\r\nstealth-body",
+        close_after=False)
+    h = native.channel_open_http("127.0.0.1", port)
+    try:
+        with pytest.raises(ConnectionError):
+            native.http_call(h, "GET", "/", timeout_ms=5000)
+    finally:
+        native.channel_close(h)
+        t.join(timeout=5)

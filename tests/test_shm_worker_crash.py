@@ -1,0 +1,83 @@
+"""Worker-crash fast-reap (descriptor-ring robust fence), end to end.
+
+Lives in its own module: it needs a server of its OWN (py_workers=1 with
+the slow factory), and the native runtime hosts one server per process —
+test_shm_workers.py's module fixture must not be live concurrently.
+"""
+import time
+
+import pytest
+
+from brpc_tpu import rpc
+from brpc_tpu.rpc.proto import echo_pb2
+
+native = pytest.importorskip("brpc_tpu.native")
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+def _grpc_stub(port):
+    grpc = pytest.importorskip("grpc")
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    return chan, chan.unary_unary(
+        "/EchoService/Echo",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=echo_pb2.EchoResponse.FromString)
+
+
+def test_worker_sigkill_mid_request_fast_reap():
+    """SIGKILL the ONLY worker while it is processing (descriptor
+    consumed, response unpublished): the robust-fence recovery must reap
+    the in-flight request promptly — an UNAVAILABLE answer (or an
+    in-process retry success) well before the 30s reaper deadline — and
+    the server must keep serving via the in-process fallback."""
+    grpc = pytest.importorskip("grpc")
+    from tests.shm_worker_factory import make_slow
+
+    srv = rpc.Server(rpc.ServerOptions(
+        num_threads=2, use_native_runtime=True, py_workers=1,
+        py_worker_factory="tests.shm_worker_factory:make_slow"))
+    for s in make_slow():
+        srv.add_service(s)
+    assert srv.start("127.0.0.1:0") == 0
+    lib = native.load()
+    # deliberately LONG reaper deadline: the pass condition is that the
+    # crash-recovery path answers, not the timeout reaper
+    lib.nat_shm_lane_set_timeout_ms(30000)
+    try:
+        port = srv.listen_endpoint.port
+        mount = srv._native_mount
+        chan, call = _grpc_stub(port)
+        try:
+            fut = call.future(echo_pb2.EchoRequest(message="boom"),
+                              timeout=25)
+            time.sleep(0.15)  # worker consumed it, parked in usercode
+            victim = mount._shm_workers[0]
+            victim.kill()
+            victim.wait(timeout=5)
+            t0 = time.time()
+            try:
+                r = fut.result(timeout=20)
+                assert r.message.startswith("boom@")
+            except grpc.RpcError as e:
+                assert e.code() == grpc.StatusCode.UNAVAILABLE, e
+            # recovery (fence probe + immediate slot reap) answered it —
+            # nowhere near the 30s reaper deadline
+            assert time.time() - t0 < 10
+            # the lane falls back in-process (sole worker dead) and
+            # keeps serving
+            deadline = time.time() + 15
+            ok = 0
+            while time.time() < deadline and ok < 3:
+                try:
+                    r = call(echo_pb2.EchoRequest(message="after"),
+                             timeout=5)
+                    ok += 1 if r.message.startswith("after@") else 0
+                except Exception:
+                    time.sleep(0.2)
+            assert ok >= 3, "server did not keep serving after the kill"
+        finally:
+            chan.close()
+    finally:
+        lib.nat_shm_lane_set_timeout_ms(2000)  # module-fixture setting
+        srv.stop()

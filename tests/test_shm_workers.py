@@ -160,3 +160,4 @@ def test_pipelined_http_order_through_workers(worker_server):
         assert positions == sorted(positions)
     finally:
         sk.close()
+
