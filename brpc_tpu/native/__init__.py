@@ -17,7 +17,13 @@ from typing import Optional
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libbrpc_tpu_native.so")
+# BRPC_TPU_NATIVE_SO points the whole Python surface at an alternate
+# build of the same library — the sanitizer soak (tools/check.sh --soak)
+# runs the full pytest native matrix against
+# libbrpc_tpu_native_asan.so this way (with libasan LD_PRELOADed).
+_SO_PATH = os.environ.get(
+    "BRPC_TPU_NATIVE_SO",
+    os.path.join(_NATIVE_DIR, "libbrpc_tpu_native.so"))
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
@@ -76,12 +82,17 @@ def load() -> ctypes.CDLL:
             return _lib
         # incremental make keeps a cached .so in sync with newer sources
         # (a stale library would miss newly-exported symbols); harmless
-        # no-op when up to date, ignored when only a prebuilt .so exists
-        built = _build()
-        if not os.path.exists(_SO_PATH):
-            if not built:
+        # no-op when up to date, ignored when only a prebuilt .so exists.
+        # An explicit BRPC_TPU_NATIVE_SO override is loaded AS IS — the
+        # soak driver builds its instrumented library itself.
+        if "BRPC_TPU_NATIVE_SO" in os.environ:
+            if not os.path.exists(_SO_PATH):
                 raise NativeUnavailable(
-                    "native core not built and toolchain unavailable")
+                    "BRPC_TPU_NATIVE_SO points at a missing library: " +
+                    _SO_PATH)
+        elif not _build() and not os.path.exists(_SO_PATH):
+            raise NativeUnavailable(
+                "native core not built and toolchain unavailable")
         lib = ctypes.CDLL(_SO_PATH)
         lib.nat_sched_start.argtypes = [ctypes.c_int]
         lib.nat_sched_start.restype = ctypes.c_int

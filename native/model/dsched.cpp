@@ -1,0 +1,660 @@
+// See dsched.h. ucontext fibers (model-only code: the two rt_sigprocmask
+// syscalls per swap that scheduler.cpp's fctx asm avoids are irrelevant
+// here), one OS thread, every schedule decision made by the controller.
+#include "dsched.h"
+
+#include <ucontext.h>
+
+#include <algorithm>
+#include <atomic>  // std::memory_order constants
+#include <cstdio>
+#include <cstdlib>
+
+namespace dsched {
+
+namespace {
+
+constexpr size_t kStackSize = 256 * 1024;
+constexpr int kOpLog = 48;
+
+struct Store {
+  uint64_t val = 0;
+  uint32_t seq = 0;
+  VC vc;        // writer's clock at the store (visibility/supersession)
+  VC rel;       // release clock acquire loads join (release sequences)
+  bool has_rel = false;
+};
+
+struct Loc {
+  int id = -1;
+  uint32_t next_seq = 0;
+  std::deque<Store> hist;
+};
+
+struct ThreadM {
+  ucontext_t ctx{};
+  std::vector<char> stack;
+  std::function<void()> fn;
+  enum State { RUNNABLE, BLOCKED, DONE } state = RUNNABLE;
+  VC vc;
+  int id = 0;
+  const void* wait_addr = nullptr;  // futex park address
+  int wait_mutex = -1;
+  std::map<int, uint32_t> last_read;  // loc id -> newest seq read
+};
+
+struct MutexM {
+  int owner = -1;
+  VC rel_vc;  // last unlocker's clock: lock() acquires it (pthread hb)
+};
+
+struct Choice {
+  uint32_t n;
+  uint32_t picked;
+};
+
+struct OpRec {
+  int8_t tid;
+  char kind;  // L S R C F Y M W K  (load store rmw cas fence yield
+              //                     mutex wait wake)
+  int16_t loc;
+  uint64_t val;
+};
+
+struct Sim {
+  const Config* cfg = nullptr;
+  std::vector<ThreadM*> threads;
+  std::vector<MutexM> mutexes;
+  int current = -1;
+  ucontext_t main_ctx{};
+  std::map<const void*, Loc> locs;
+  int next_loc_id = 0;
+  VC sc_vc;
+
+  std::vector<Choice> trace;
+  std::vector<uint32_t> forced;
+  size_t choice_idx = 0;
+  uint64_t rng = 0;
+  bool random_mode = false;
+  int preemptions = 0;
+  uint64_t steps = 0;
+  bool failed = false;
+  bool yield_flag = false;  // explicit yield: must switch if possible
+  std::string fail_msg;
+  OpRec oplog[kOpLog];
+  int oplog_n = 0;
+  uint64_t hash = 1469598103934665603ull;
+};
+
+Sim* g_sim = nullptr;
+
+uint64_t xorshift(uint64_t& s) {
+  s ^= s << 13;
+  s ^= s >> 7;
+  s ^= s << 17;
+  return s;
+}
+
+void mix(uint64_t v) {
+  g_sim->hash = (g_sim->hash ^ v) * 1099511628211ull;
+}
+
+ThreadM* cur() {
+  Sim& S = *g_sim;
+  return S.current >= 0 ? S.threads[S.current] : nullptr;
+}
+
+void fail_now(const std::string& msg);
+
+void oprec(char kind, int loc, uint64_t val) {
+  Sim& S = *g_sim;
+  S.oplog[S.oplog_n % kOpLog] = {(int8_t)S.current, kind, (int16_t)loc,
+                                 val};
+  S.oplog_n++;
+}
+
+uint32_t choose(uint32_t n) {
+  Sim& S = *g_sim;
+  if (n <= 1) {
+    return 0;
+  }
+  uint32_t pick;
+  if (S.choice_idx < S.forced.size()) {
+    pick = std::min(S.forced[S.choice_idx], n - 1);
+  } else if (S.random_mode) {
+    pick = (uint32_t)(xorshift(S.rng) % n);
+  } else {
+    pick = 0;  // DFS default branch
+  }
+  S.trace.push_back({n, pick});
+  S.choice_idx++;
+  mix(((uint64_t)n << 32) | pick);
+  return pick;
+}
+
+// Transfer control to the controller; returns when this thread is
+// scheduled again. No-op from the controller context (validate etc.).
+void schedule_point() {
+  Sim& S = *g_sim;
+  ThreadM* t = cur();
+  if (t == nullptr) return;
+  S.steps++;
+  if (S.steps > (uint64_t)S.cfg->max_steps) {
+    fail_now("schedule-point budget exceeded (livelock?)");
+    return;
+  }
+  swapcontext(&t->ctx, &S.main_ctx);
+}
+
+void block_current() {
+  Sim& S = *g_sim;
+  ThreadM* t = cur();
+  t->state = ThreadM::BLOCKED;
+  swapcontext(&t->ctx, &S.main_ctx);
+}
+
+void fail_now(const std::string& msg) {
+  Sim& S = *g_sim;
+  if (!S.failed) {
+    S.failed = true;
+    S.fail_msg = msg;
+  }
+  ThreadM* t = cur();
+  if (t != nullptr) {
+    t->state = ThreadM::DONE;  // abandon: controller stops the run
+    swapcontext(&t->ctx, &S.main_ctx);
+  }
+}
+
+void tick() {
+  ThreadM* t = cur();
+  if (t != nullptr) t->vc.c[t->id]++;
+}
+
+Loc& locof(const void* addr) {
+  Sim& S = *g_sim;
+  Loc& l = S.locs[addr];
+  if (l.id < 0) {
+    l.id = S.next_loc_id++;
+    // implicit zero-initialized store (raw shared memory / fresh cells)
+    l.hist.push_back(Store{0, ++l.next_seq, VC{}, VC{}, false});
+  }
+  return l;
+}
+
+bool ord_acquire(int o) {
+  return o == (int)std::memory_order_acquire ||
+         o == (int)std::memory_order_acq_rel ||
+         o == (int)std::memory_order_seq_cst ||
+         o == (int)std::memory_order_consume;
+}
+bool ord_release(int o) {
+  return o == (int)std::memory_order_release ||
+         o == (int)std::memory_order_acq_rel ||
+         o == (int)std::memory_order_seq_cst;
+}
+bool ord_sc(int o) { return o == (int)std::memory_order_seq_cst; }
+
+void sc_sync(ThreadM* t) {
+  Sim& S = *g_sim;
+  t->vc.join(S.sc_vc);
+  S.sc_vc.join(t->vc);
+}
+
+void push_store(Loc& l, uint64_t v, ThreadM* t, int order,
+                const Store* prev_for_rmw) {
+  Store st;
+  st.val = v;
+  st.seq = ++l.next_seq;
+  st.vc = t->vc;
+  bool rel = ord_release(order);
+  if (prev_for_rmw != nullptr && prev_for_rmw->has_rel) {
+    // an RMW continues the release sequence headed by the store it read
+    st.rel = prev_for_rmw->rel;
+    st.has_rel = true;
+  }
+  if (rel) {
+    st.rel.join(t->vc);
+    st.has_rel = true;
+  }
+  l.hist.push_back(st);
+  while ((int)l.hist.size() > g_sim->cfg->history_depth + 1) {
+    l.hist.pop_front();
+  }
+  t->last_read[l.id] = st.seq;
+}
+
+void thread_tramp() {
+  Sim& S = *g_sim;
+  ThreadM* t = S.threads[S.current];
+  t->fn();
+  t->state = ThreadM::DONE;
+  // uc_link resumes the controller
+}
+
+}  // namespace
+
+// ---- scenario API ------------------------------------------------------
+
+void spawn(std::function<void()> fn) {
+  Sim& S = *g_sim;
+  if ((int)S.threads.size() >= kMaxThreads) {
+    fail_now("too many model threads");
+    return;
+  }
+  ThreadM* t = new ThreadM();
+  t->id = (int)S.threads.size();
+  t->fn = std::move(fn);
+  t->stack.resize(kStackSize);
+  getcontext(&t->ctx);
+  t->ctx.uc_stack.ss_sp = t->stack.data();
+  t->ctx.uc_stack.ss_size = t->stack.size();
+  t->ctx.uc_link = &S.main_ctx;
+  makecontext(&t->ctx, thread_tramp, 0);
+  // creation order seeds happens-before: the spawner's writes so far are
+  // visible to the new thread (pthread_create semantics)
+  if (cur() != nullptr) t->vc.join(cur()->vc);
+  S.threads.push_back(t);
+}
+
+void yield() {
+  oprec('Y', -1, 0);
+  // sched_yield semantics: the thread VOLUNTEERS the cpu — the
+  // controller must run someone else when anyone else is runnable,
+  // or spin-with-yield backoff loops livelock the model
+  g_sim->yield_flag = true;
+  schedule_point();
+  g_sim->yield_flag = false;
+}
+
+int self() { return g_sim != nullptr ? g_sim->current : -1; }
+
+void check(bool cond, const char* msg) {
+  if (!cond) fail_now(std::string("check failed: ") + msg);
+}
+
+mutex::mutex() {
+  Sim& S = *g_sim;
+  id_ = (int)S.mutexes.size();
+  S.mutexes.push_back(MutexM{});
+}
+
+void mutex::lock() {
+  Sim& S = *g_sim;
+  for (;;) {
+    oprec('M', id_, 0);
+    schedule_point();
+    if (S.failed) return;
+    if (S.mutexes[id_].owner == -1) {
+      S.mutexes[id_].owner = S.current;
+      cur()->vc.join(S.mutexes[id_].rel_vc);  // unlock->lock edge
+      return;
+    }
+    cur()->wait_mutex = id_;
+    block_current();
+    cur()->wait_mutex = -1;
+  }
+}
+
+bool mutex::try_lock() {
+  Sim& S = *g_sim;
+  oprec('M', id_, 1);
+  schedule_point();
+  if (S.mutexes[id_].owner == -1) {
+    S.mutexes[id_].owner = S.current;
+    cur()->vc.join(S.mutexes[id_].rel_vc);  // unlock->lock edge
+    return true;
+  }
+  return false;
+}
+
+void mutex::unlock() {
+  Sim& S = *g_sim;
+  S.mutexes[id_].rel_vc.join(cur()->vc);
+  S.mutexes[id_].owner = -1;
+  for (ThreadM* t : S.threads) {
+    if (t->state == ThreadM::BLOCKED && t->wait_mutex == id_) {
+      t->state = ThreadM::RUNNABLE;  // retries the claim loop
+    }
+  }
+  oprec('M', id_, 2);
+  schedule_point();
+}
+
+void futex_wait(void* addr, uint64_t expected) {
+  Sim& S = *g_sim;
+  oprec('W', locof(addr).id, expected);
+  schedule_point();
+  if (S.failed) return;
+  Loc& l = locof(addr);
+  // kernel compare: an atomic read of the NEWEST value under the futex
+  // bucket lock — stale user-space reads are the caller's problem (and
+  // exactly what the doorbell protocols must tolerate)
+  if (l.hist.back().val != expected) {
+    // kernel compare observed the newest store: syscall-grade barrier
+    cur()->vc.join(l.hist.back().vc);
+    return;
+  }
+  cur()->wait_addr = addr;
+  block_current();
+  cur()->wait_addr = nullptr;
+}
+
+void futex_wake(void* addr) {
+  Sim& S = *g_sim;
+  oprec('K', locof(addr).id, 0);
+  for (ThreadM* t : S.threads) {
+    if (t->state == ThreadM::BLOCKED && t->wait_addr == addr) {
+      t->state = ThreadM::RUNNABLE;
+      t->wait_addr = nullptr;
+      // futex wake -> wakee is a synchronization edge (the kernel's
+      // bucket lock): the woken thread sees the waker's writes
+      t->vc.join(cur()->vc);
+    }
+  }
+  schedule_point();
+}
+
+// ---- atomic hooks ------------------------------------------------------
+
+void on_init(void* addr, uint64_t v, unsigned) {
+  if (g_sim == nullptr) return;  // statics constructed outside run()
+  Sim& S = *g_sim;
+  Loc& l = S.locs[addr];
+  l.id = l.id < 0 ? S.next_loc_id++ : l.id;
+  l.hist.clear();
+  l.hist.push_back(Store{v, ++l.next_seq, VC{}, VC{}, false});
+}
+
+uint64_t on_load(const void* addr, int order, unsigned) {
+  Sim& S = *g_sim;
+  ThreadM* t = cur();
+  if (t == nullptr) {  // controller context (validate): direct read
+    Loc& l = locof(addr);
+    return l.hist.back().val;
+  }
+  schedule_point();
+  if (S.failed) return 0;
+  tick();
+  if (ord_sc(order)) t->vc.join(S.sc_vc);
+  Loc& l = locof(addr);
+  uint32_t floor_seq = 0;
+  auto it = t->last_read.find(l.id);
+  if (it != t->last_read.end()) floor_seq = it->second;
+  // candidates, newest first: not read-coherence-stale and not
+  // superseded by a later store that happens-before this load
+  std::vector<const Store*> cands;
+  for (auto rit = l.hist.rbegin(); rit != l.hist.rend(); ++rit) {
+    const Store& s = *rit;
+    if (s.seq < floor_seq) break;
+    bool superseded = false;
+    for (const Store& later : l.hist) {
+      if (later.seq > s.seq && later.vc.leq(t->vc)) {
+        superseded = true;
+        break;
+      }
+    }
+    if (!superseded) cands.push_back(&s);
+  }
+  const Store* s = cands[choose((uint32_t)cands.size())];
+  if (ord_acquire(order) && s->has_rel) t->vc.join(s->rel);
+  uint32_t prev = t->last_read.count(l.id) ? t->last_read[l.id] : 0;
+  if (s->seq > prev) t->last_read[l.id] = s->seq;
+  oprec('L', l.id, s->val);
+  mix(s->val);
+  return s->val;
+}
+
+void on_store(void* addr, uint64_t v, int order, unsigned) {
+  Sim& S = *g_sim;
+  ThreadM* t = cur();
+  if (t == nullptr) {
+    Loc& l = locof(addr);
+    l.hist.back().val = v;  // controller context: direct poke
+    return;
+  }
+  schedule_point();
+  if (S.failed) return;
+  tick();
+  if (ord_sc(order)) sc_sync(t);
+  Loc& l = locof(addr);
+  push_store(l, v, t, order, nullptr);
+  oprec('S', l.id, v);
+  mix(v);
+}
+
+uint64_t on_rmw(void* addr, uint64_t (*f)(uint64_t, uint64_t),
+                uint64_t operand, int order, unsigned) {
+  Sim& S = *g_sim;
+  ThreadM* t = cur();
+  if (t == nullptr) {
+    Loc& l = locof(addr);
+    uint64_t old = l.hist.back().val;
+    l.hist.back().val = f(old, operand);
+    return old;
+  }
+  schedule_point();
+  if (S.failed) return 0;
+  tick();
+  if (ord_sc(order)) sc_sync(t);
+  Loc& l = locof(addr);
+  Store prev = l.hist.back();  // RMW reads the NEWEST store (atomicity)
+  if (ord_acquire(order) && prev.has_rel) t->vc.join(prev.rel);
+  push_store(l, f(prev.val, operand), t, order, &prev);
+  oprec('R', l.id, prev.val);
+  mix(prev.val);
+  return prev.val;
+}
+
+bool on_cas(void* addr, uint64_t* expected, uint64_t desired, int ok_order,
+            int fail_order, unsigned) {
+  Sim& S = *g_sim;
+  ThreadM* t = cur();
+  if (t == nullptr) {
+    Loc& l = locof(addr);
+    if (l.hist.back().val == *expected) {
+      l.hist.back().val = desired;
+      return true;
+    }
+    *expected = l.hist.back().val;
+    return false;
+  }
+  schedule_point();
+  if (S.failed) return false;
+  tick();
+  if (ord_sc(ok_order) || ord_sc(fail_order)) sc_sync(t);
+  Loc& l = locof(addr);
+  Store prev = l.hist.back();
+  if (prev.val == *expected) {
+    if (ord_acquire(ok_order) && prev.has_rel) t->vc.join(prev.rel);
+    push_store(l, desired, t, ok_order, &prev);
+    oprec('C', l.id, 1);
+    mix(prev.val ^ desired);
+    return true;
+  }
+  if (ord_acquire(fail_order) && prev.has_rel) t->vc.join(prev.rel);
+  if (prev.seq > (t->last_read.count(l.id) ? t->last_read[l.id] : 0)) {
+    t->last_read[l.id] = prev.seq;
+  }
+  *expected = prev.val;
+  oprec('C', l.id, 0);
+  mix(prev.val);
+  return false;
+}
+
+void on_fence(int) {
+  Sim& S = *g_sim;
+  ThreadM* t = cur();
+  if (t == nullptr) return;
+  schedule_point();
+  if (S.failed) return;
+  tick();
+  // every standalone fence is modeled as seq_cst (conservative: fewer
+  // stale candidates downstream, never an impossible behavior)
+  sc_sync(t);
+  oprec('F', -1, 0);
+}
+
+// ---- controller --------------------------------------------------------
+
+namespace {
+
+std::string format_trace(const Sim& S) {
+  std::string out = "choices=";
+  for (size_t i = 0; i < S.trace.size(); i++) {
+    if (i) out += ",";
+    out += std::to_string(S.trace[i].picked);
+  }
+  out += "\n  last ops (tid op loc val):";
+  int n = S.oplog_n < kOpLog ? S.oplog_n : kOpLog;
+  for (int i = 0; i < n; i++) {
+    const OpRec& r = S.oplog[(S.oplog_n - n + i) % kOpLog];
+    char buf[64];
+    snprintf(buf, sizeof(buf), "\n    t%d %c a%d %llu", (int)r.tid,
+             r.kind, (int)r.loc, (unsigned long long)r.val);
+    out += buf;
+  }
+  return out;
+}
+
+// one execution under the current forced/random settings;
+// returns false when the execution failed
+bool run_one(Sim& S, const std::function<void()>& body,
+             const std::function<bool(std::string*)>& validate) {
+  S.threads.clear();
+  S.mutexes.clear();
+  S.locs.clear();
+  S.next_loc_id = 0;
+  S.sc_vc = VC{};
+  S.trace.clear();
+  S.choice_idx = 0;
+  S.preemptions = 0;
+  S.steps = 0;
+  S.failed = false;
+  S.yield_flag = false;
+  S.fail_msg.clear();
+  S.oplog_n = 0;
+  S.current = -1;
+
+  spawn(body);  // thread 0 is the scenario driver
+
+  while (!S.failed) {
+    // candidate order: current-first (DFS branch 0 = keep running the
+    // same thread = zero preemptions), then ids ascending
+    std::vector<int> runnable;
+    bool cur_runnable = S.current >= 0 &&
+                        S.threads[S.current]->state == ThreadM::RUNNABLE;
+    if (cur_runnable) runnable.push_back(S.current);
+    for (int i = 0; i < (int)S.threads.size(); i++) {
+      if (i != S.current && S.threads[i]->state == ThreadM::RUNNABLE) {
+        runnable.push_back(i);
+      }
+    }
+    if (runnable.empty()) {
+      bool all_done = true;
+      for (ThreadM* t : S.threads) {
+        if (t->state != ThreadM::DONE) all_done = false;
+      }
+      if (all_done) break;
+      std::string who;
+      for (ThreadM* t : S.threads) {
+        if (t->state == ThreadM::BLOCKED) {
+          who += " t" + std::to_string(t->id) +
+                 (t->wait_addr != nullptr ? "(futex)" : "(mutex)");
+        }
+      }
+      S.failed = true;
+      S.fail_msg = "deadlock: every live thread is blocked —" + who +
+                   " (lost wake?)";
+      break;
+    }
+    bool yielded = S.yield_flag && cur_runnable;
+    if (yielded && runnable.size() > 1) {
+      runnable.erase(runnable.begin());  // volunteer: someone else runs
+    }
+    uint32_t pick;
+    if (!S.random_mode && !yielded && cur_runnable &&
+        S.preemptions >= S.cfg->preemption_bound) {
+      pick = 0;  // bound reached: no preemption choice offered
+    } else {
+      pick = choose((uint32_t)runnable.size());
+    }
+    int next = runnable[pick];
+    // a volunteered switch is not a preemption
+    if (cur_runnable && next != S.current && !yielded) S.preemptions++;
+    S.current = next;
+    ThreadM* t = S.threads[next];
+    swapcontext(&S.main_ctx, &t->ctx);
+  }
+  int last_current = S.current;
+  S.current = -1;
+  (void)last_current;
+  bool ok = !S.failed;
+  if (ok && validate) {
+    std::string why;
+    if (!validate(&why)) {
+      S.failed = true;
+      S.fail_msg = "validate failed: " + why;
+      ok = false;
+    }
+  }
+  for (ThreadM* t : S.threads) delete t;
+  S.threads.clear();
+  return ok;
+}
+
+}  // namespace
+
+Result run(const char* name, std::function<void()> body,
+           const Config& cfg, std::function<bool(std::string*)> validate) {
+  Result res;
+  Sim S;
+  S.cfg = &cfg;
+  g_sim = &S;
+  S.random_mode = cfg.mode == Mode::RANDOM;
+
+  if (S.random_mode) {
+    for (int e = 0; e < cfg.executions; e++) {
+      uint64_t seed = cfg.seed + (uint64_t)e;
+      S.rng = seed != 0 ? seed : 0x9e3779b97f4a7c15ull;
+      S.forced.clear();
+      bool ok = run_one(S, body, validate);
+      res.executions++;
+      res.schedule_points += S.steps;
+      if (!ok) {
+        res.ok = false;
+        res.fail_msg = S.fail_msg;
+        res.fail_seed = seed;
+        if (cfg.trace_on_fail) res.fail_trace = format_trace(S);
+        break;
+      }
+    }
+  } else {
+    S.forced.clear();
+    for (int e = 0; e < cfg.executions; e++) {
+      bool ok = run_one(S, body, validate);
+      res.executions++;
+      res.schedule_points += S.steps;
+      if (!ok) {
+        res.ok = false;
+        res.fail_msg = S.fail_msg;
+        if (cfg.trace_on_fail) res.fail_trace = format_trace(S);
+        break;
+      }
+      // DFS backtrack: bump the deepest choice with an untried branch
+      std::vector<Choice>& T = S.trace;
+      int i = (int)T.size() - 1;
+      while (i >= 0 && T[i].picked + 1 >= T[i].n) i--;
+      if (i < 0) break;  // space (under the preemption bound) exhausted
+      S.forced.assign(T.size() ? (size_t)i + 1 : 0, 0);
+      for (int j = 0; j < i; j++) S.forced[j] = T[j].picked;
+      S.forced[i] = T[i].picked + 1;
+    }
+  }
+  res.trace_hash = S.hash;
+  g_sim = nullptr;
+  (void)name;
+  return res;
+}
+
+}  // namespace dsched

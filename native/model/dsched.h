@@ -1,0 +1,134 @@
+// dsched — deterministic interleaving checker for the lock-free hot
+// paths (tools/natcheck model pass).
+//
+// A cooperative virtual-thread scheduler: every atomic operation, mutex
+// acquisition, futex wait/wake and explicit yield is a SCHEDULE POINT
+// where a controller chooses which runnable thread runs next. The
+// lock-free primitives (wsq.h, nat_desc_ring.h) compile unmodified —
+// their nat::atomic<T> resolves to dsched::atomic<T> under -DNAT_MODEL=1
+// (see src/nat_atomic.h) — so the code explored IS the code shipped.
+//
+// Exploration modes:
+//   * exhaustive DFS over schedule (and load-value) choices with a
+//     preemption bound — the CHESS discipline: most bugs need few
+//     preemptions, so bounding them tames the state space while the
+//     bound stays configurable;
+//   * seeded random walks (xorshift64): same seed => same schedule =>
+//     same trace, so a failing seed is a replayable artifact.
+//
+// Weak memory: each atomic location keeps a bounded store history with
+// the writer's vector clock per store. A load may read any store not
+// superseded by a happens-before-visible later store (relaxed loads can
+// therefore return STALE values, exactly what real hardware permits);
+// acquire loads of release stores join clocks; seq_cst ops additionally
+// synchronize through a global SC clock, and standalone fences are
+// modeled as seq_cst fences (conservative: fewer behaviors explored,
+// never false positives). RMWs always read the newest store (atomicity).
+//
+// A failed check() or a deadlock (every live thread blocked — e.g. a
+// lost futex wake) aborts the execution and reports the seed/choice
+// trace for replay.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace dsched {
+
+constexpr int kMaxThreads = 8;
+
+struct VC {
+  uint64_t c[kMaxThreads] = {};
+  void join(const VC& o) {
+    for (int i = 0; i < kMaxThreads; i++) {
+      if (o.c[i] > c[i]) c[i] = o.c[i];
+    }
+  }
+  bool leq(const VC& o) const {
+    for (int i = 0; i < kMaxThreads; i++) {
+      if (c[i] > o.c[i]) return false;
+    }
+    return true;
+  }
+};
+
+enum class Mode { RANDOM, DFS };
+
+struct Config {
+  Mode mode = Mode::RANDOM;
+  uint64_t seed = 1;
+  int executions = 200;       // random walks / DFS execution cap
+  int preemption_bound = 3;   // DFS only
+  int max_steps = 200000;     // per-execution schedule-point budget
+  int history_depth = 3;      // store history (stale-read window)
+  bool trace_on_fail = true;
+};
+
+struct Result {
+  bool ok = true;
+  uint64_t executions = 0;
+  uint64_t schedule_points = 0;
+  uint64_t trace_hash = 0;     // FNV over every execution's choices
+  std::string fail_msg;
+  uint64_t fail_seed = 0;      // RANDOM: seed that failed
+  std::string fail_trace;      // replayable choice/op listing
+};
+
+// ---- scenario-facing API (valid only inside run()) ---------------------
+
+// spawn a virtual thread; all threads must be spawned before they run
+// (the scenario body runs as thread 0 and may spawn at any point).
+void spawn(std::function<void()> fn);
+
+void yield();  // explicit schedule point
+
+// model check: on failure the execution aborts and the run reports it
+void check(bool cond, const char* msg);
+
+int self();  // current virtual thread id
+
+// cooperative mutex (process-local producer locks in the scenarios)
+class mutex {
+ public:
+  mutex();
+  void lock();
+  bool try_lock();
+  void unlock();
+
+ private:
+  int id_;
+};
+
+// futex-shaped blocking on a modeled atomic<uint32_t>/<int32_t> word:
+// blocks iff the word still reads `expected` (kernel compare semantics);
+// wake unblocks every waiter on the address. No timeouts: a lost wake is
+// a deadlock the checker reports.
+void futex_wait(void* addr, uint64_t expected);
+void futex_wake(void* addr);
+
+// ---- controller hooks used by dsched::atomic (dsched_atomic.h) ---------
+
+uint64_t on_load(const void* addr, int order, unsigned size);
+void on_store(void* addr, uint64_t v, int order, unsigned size);
+void on_init(void* addr, uint64_t v, unsigned size);
+uint64_t on_rmw(void* addr, uint64_t (*f)(uint64_t, uint64_t),
+                uint64_t operand, int order, unsigned size);
+bool on_cas(void* addr, uint64_t* expected, uint64_t desired,
+            int ok_order, int fail_order, unsigned size);
+void on_fence(int order);
+
+// ---- harness -----------------------------------------------------------
+
+// Run `body` (as virtual thread 0) under every explored schedule.
+// `validate`, when set, runs after each completed execution (plain code,
+// no schedule points) — return false/message via check-style bool.
+Result run(const char* name, std::function<void()> body,
+           const Config& cfg,
+           std::function<bool(std::string*)> validate = nullptr);
+
+}  // namespace dsched

@@ -1,0 +1,123 @@
+// dsched::atomic — std::atomic-shaped wrapper whose every operation is a
+// dsched schedule point (included via src/nat_atomic.h when NAT_MODEL is
+// defined; see dsched.h for the model semantics).
+//
+// Layout discipline: sizeof(atomic<T>) == sizeof(T) and the value state
+// lives in the controller's address-keyed side table, so raw shared
+// memory (the blob arena's span headers) can be cast to atomic<T>*
+// exactly like production code casts to std::atomic<T>*.
+#pragma once
+
+#include <atomic>  // std::memory_order only
+#include <cstdint>
+#include <cstring>
+
+#include "dsched.h"
+
+namespace dsched {
+
+template <typename T>
+inline uint64_t to_u64(T v) {
+  uint64_t r = 0;
+  std::memcpy(&r, &v, sizeof(T));
+  return r;
+}
+template <typename T>
+inline T from_u64(uint64_t r) {
+  T v;
+  std::memcpy(&v, &r, sizeof(T));
+  return v;
+}
+
+template <typename T>
+struct atomic {
+  static_assert(sizeof(T) <= 8, "model atomics are <= 8 bytes");
+  T v_;  // placeholder for layout only; truth lives in the side table
+
+  atomic() noexcept { on_init((void*)this, 0, sizeof(T)); }
+  explicit atomic(T v) noexcept {
+    on_init((void*)this, to_u64(v), sizeof(T));
+  }
+  atomic(const atomic&) = delete;
+  atomic& operator=(const atomic&) = delete;
+
+  T load(std::memory_order o = std::memory_order_seq_cst) const {
+    return from_u64<T>(on_load((const void*)this, (int)o, sizeof(T)));
+  }
+  void store(T v, std::memory_order o = std::memory_order_seq_cst) {
+    on_store((void*)this, to_u64(v), (int)o, sizeof(T));
+  }
+  T exchange(T v, std::memory_order o = std::memory_order_seq_cst) {
+    return from_u64<T>(on_rmw(
+        (void*)this, [](uint64_t, uint64_t nv) { return nv; }, to_u64(v),
+        (int)o, sizeof(T)));
+  }
+
+  // integer RMWs operate on the T-typed value (sign-correct), then
+  // round-trip through the 64-bit side table
+  T fetch_add(T d, std::memory_order o = std::memory_order_seq_cst) {
+    return from_u64<T>(
+        on_rmw((void*)this, &atomic::op_add, to_u64(d), (int)o,
+               sizeof(T)));
+  }
+  T fetch_sub(T d, std::memory_order o = std::memory_order_seq_cst) {
+    return from_u64<T>(
+        on_rmw((void*)this, &atomic::op_sub, to_u64(d), (int)o,
+               sizeof(T)));
+  }
+  T fetch_or(T d, std::memory_order o = std::memory_order_seq_cst) {
+    return from_u64<T>(
+        on_rmw((void*)this, &atomic::op_or, to_u64(d), (int)o,
+               sizeof(T)));
+  }
+  T fetch_and(T d, std::memory_order o = std::memory_order_seq_cst) {
+    return from_u64<T>(
+        on_rmw((void*)this, &atomic::op_and, to_u64(d), (int)o,
+               sizeof(T)));
+  }
+
+  bool compare_exchange_strong(
+      T& expected, T desired,
+      std::memory_order ok = std::memory_order_seq_cst,
+      std::memory_order fail = std::memory_order_seq_cst) {
+    uint64_t e = to_u64(expected);
+    bool r = on_cas((void*)this, &e, to_u64(desired), (int)ok, (int)fail,
+                    sizeof(T));
+    expected = from_u64<T>(e);
+    return r;
+  }
+  bool compare_exchange_weak(
+      T& expected, T desired,
+      std::memory_order ok = std::memory_order_seq_cst,
+      std::memory_order fail = std::memory_order_seq_cst) {
+    // no spurious failure in the model: every real interleaving a
+    // spurious failure could produce is reachable as a lost CAS race
+    return compare_exchange_strong(expected, desired, ok, fail);
+  }
+
+ private:
+  static uint64_t op_add(uint64_t a, uint64_t b) {
+    return to_u64<T>((T)(from_u64<T>(a) + from_u64<T>(b)));
+  }
+  static uint64_t op_sub(uint64_t a, uint64_t b) {
+    return to_u64<T>((T)(from_u64<T>(a) - from_u64<T>(b)));
+  }
+  static uint64_t op_or(uint64_t a, uint64_t b) {
+    return to_u64<T>((T)(from_u64<T>(a) | from_u64<T>(b)));
+  }
+  static uint64_t op_and(uint64_t a, uint64_t b) {
+    return to_u64<T>((T)(from_u64<T>(a) & from_u64<T>(b)));
+  }
+};
+
+inline void atomic_thread_fence(std::memory_order o) {
+  on_fence((int)o);
+}
+
+}  // namespace dsched
+
+namespace nat {
+template <typename T>
+using atomic = dsched::atomic<T>;
+using dsched::atomic_thread_fence;
+}  // namespace nat

@@ -1,0 +1,556 @@
+// nat_model — dsched scenario harness over the shipped lock-free
+// primitives (tools/natcheck model pass; `make -C native model`).
+//
+// Scenarios (each explored exhaustively with a preemption bound AND by
+// seeded random walks; same seed => same trace => same hash):
+//
+//   wsq      owner push/pop vs thieves on wsq.h's Chase-Lev deque;
+//            every pushed item must be consumed exactly once
+//   ring     producer offer (lock + claim + publish + doorbell) vs
+//            lock-free consumer pop on nat_desc_ring.h, geometry small
+//            enough that the ring AND the blob arena wrap; payload
+//            canaries must arrive untorn, nothing lost
+//   arena    out-of-order span release + lazy head reclaim: a live
+//            span's bytes must survive releases around it, and a
+//            full-arena claim must succeed after reclaim
+//   butex    the waiter-count-gated wake protocol (scheduler.cpp /
+//            shm doorbells): the seq_cst publish fence is load-bearing —
+//            dropping it (--bug butex-no-fence) lets the waker read a
+//            stale 0 waiter count and strand the waiter (deadlock)
+//   recover  EOWNERDEAD recovery (drain + discard claims + scrub) vs a
+//            mid-flight producer: publish-under-lock means recovery can
+//            never observe a half-offered record; publishing outside
+//            the lock (--bug recover-late-publish) is caught
+//
+// A failing schedule prints the scenario, seed (random mode) or the
+// choice string (DFS), and the tail of the operation trace; re-running
+// with the same arguments replays it.
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "nat_atomic.h"
+#include "nat_desc_ring.h"
+#include "wsq.h"
+
+using brpc_tpu::DescCellView;
+using brpc_tpu::DescRingT;
+
+namespace {
+
+// ---- wsq ---------------------------------------------------------------
+
+struct WsqState {
+  WorkStealingQueue<int>* q = nullptr;
+  static constexpr int kItems = 3;
+  int seen[kItems + 1] = {};
+  int pushed = 0;
+};
+WsqState* g_wsq = nullptr;
+
+void wsq_body_n(int nthieves) {
+  g_wsq = new WsqState();
+  WsqState* st = g_wsq;
+  st->q = new WorkStealingQueue<int>(8);
+  for (int t = 0; t < nthieves; t++) {
+    dsched::spawn([st] {
+      for (int a = 0; a < WsqState::kItems * 3; a++) {
+        int v = 0;
+        if (st->q->steal(&v)) {
+          dsched::check(v >= 1 && v <= WsqState::kItems,
+                        "stolen value out of range");
+          st->seen[v]++;
+        }
+      }
+    });
+  }
+  for (int i = 1; i <= WsqState::kItems; i++) {
+    dsched::check(st->q->push(i), "push must fit");
+    st->pushed++;
+  }
+  int v = 0;
+  while (st->q->pop(&v)) {
+    dsched::check(v >= 1 && v <= WsqState::kItems,
+                  "popped value out of range");
+    st->seen[v]++;
+  }
+}
+
+bool wsq_validate(std::string* why) {
+  WsqState* st = g_wsq;
+  bool ok = true;
+  for (int i = 1; i <= WsqState::kItems; i++) {
+    if (st->seen[i] > 1) {
+      *why = "item " + std::to_string(i) + " consumed twice (count " +
+             std::to_string(st->seen[i]) + ")";
+      ok = false;
+    }
+  }
+  // a thief may exhaust its bounded attempts while the owner still
+  // holds the item — but the owner drains to empty, so every item must
+  // land SOMEWHERE exactly once
+  for (int i = 1; ok && i <= WsqState::kItems; i++) {
+    if (st->seen[i] == 0) {
+      *why = "item " + std::to_string(i) + " lost";
+      ok = false;
+    }
+  }
+  delete st->q;
+  delete st;
+  g_wsq = nullptr;
+  return ok;
+}
+
+// ---- ring offer/drain/wrap --------------------------------------------
+
+using MRing = DescRingT<4>;
+constexpr uint64_t kAsize = 512;  // 4 x 128B spans per arena lap
+constexpr size_t kPay = 120;
+constexpr int kRecs = 6;  // wraps both the 4-slot ring and the arena
+
+struct RingState {
+  MRing* ring = nullptr;
+  char* arena = nullptr;
+  dsched::mutex* mu = nullptr;
+  dsched::atomic<uint32_t>* db = nullptr;  // doorbell
+  int produced = 0;
+  int consumed = 0;
+};
+RingState* g_ring_st = nullptr;
+
+void ring_body() {
+  g_ring_st = new RingState();
+  RingState* st = g_ring_st;
+  st->ring = new MRing();
+  st->arena = new char[kAsize]();
+  st->mu = new dsched::mutex();
+  st->db = new dsched::atomic<uint32_t>(0);
+  desc_ring_init(st->ring);
+
+  dsched::spawn([st] {  // producer
+    for (int i = 0; i < kRecs; i++) {
+      for (;;) {
+        uint64_t pos, span;
+        char* dst = nullptr;
+        st->mu->lock();
+        bool ok = desc_ring_begin_push(st->ring, st->arena, kPay, kAsize,
+                                       &pos, &span, &dst);
+        if (ok) {
+          memset(dst, 0x40 + i, kPay);
+          desc_ring_publish(st->ring, pos, 3, 0, (uint64_t)i, i, 0, span,
+                            (uint32_t)kPay, (uint64_t)i);
+        }
+        st->mu->unlock();
+        if (ok) {
+          st->db->fetch_add(1, std::memory_order_seq_cst);
+          dsched::futex_wake(st->db);
+          break;
+        }
+        dsched::yield();  // ring/arena full: consumer will drain
+      }
+      st->produced++;
+    }
+  });
+
+  // consumer (this thread): waiter-gated doorbell park, lock-free pop
+  while (st->consumed < kRecs) {
+    DescCellView c;
+    if (desc_ring_pop(st->ring, &c)) {
+      const char* p =
+          brpc_tpu::desc_span_payload(st->arena, c.span_off, kAsize);
+      dsched::check(c.payload_len == kPay, "payload length survived");
+      bool clean = true;
+      for (size_t b = 0; b < kPay; b++) {
+        if (p[b] != (char)(0x40 + c.aux)) clean = false;
+      }
+      dsched::check(clean, "payload canary untorn");
+      dsched::check((int)c.aux == st->consumed,
+                    "single-producer records arrive in order");
+      brpc_tpu::desc_span_release(st->arena, c.span_off, kAsize);
+      st->consumed++;
+      continue;
+    }
+    uint32_t v = st->db->load(std::memory_order_seq_cst);
+    if (!desc_ring_has_data(st->ring)) {
+      dsched::futex_wait(st->db, v);
+    }
+  }
+}
+
+bool ring_validate(std::string* why) {
+  RingState* st = g_ring_st;
+  bool ok = st->consumed == kRecs && st->produced == kRecs;
+  if (!ok) {
+    *why = "produced " + std::to_string(st->produced) + " consumed " +
+           std::to_string(st->consumed);
+  }
+  delete st->ring;
+  delete[] st->arena;
+  delete st->mu;
+  delete st->db;
+  delete st;
+  g_ring_st = nullptr;
+  return ok;
+}
+
+// ---- arena out-of-order release + reclaim ------------------------------
+
+struct ArenaState {
+  MRing* ring = nullptr;
+  char* arena = nullptr;
+  dsched::mutex* mu = nullptr;
+  dsched::atomic<uint32_t>* done = nullptr;
+  uint64_t span_a = 0, span_b = 0, span_c = 0;
+};
+ArenaState* g_ar = nullptr;
+
+void arena_body() {
+  g_ar = new ArenaState();
+  ArenaState* st = g_ar;
+  st->ring = new MRing();
+  st->arena = new char[kAsize]();
+  st->mu = new dsched::mutex();
+  st->done = new dsched::atomic<uint32_t>(0);
+  desc_ring_init(st->ring);
+
+  st->mu->lock();
+  st->span_a = desc_arena_claim(st->ring, st->arena, kPay, kAsize);
+  st->span_b = desc_arena_claim(st->ring, st->arena, kPay, kAsize);
+  st->span_c = desc_arena_claim(st->ring, st->arena, kPay, kAsize);
+  st->mu->unlock();
+  dsched::check(st->span_a != UINT64_MAX && st->span_b != UINT64_MAX &&
+                    st->span_c != UINT64_MAX,
+                "three spans fit an empty arena");
+  char* pa = brpc_tpu::desc_span_payload(st->arena, st->span_a, kAsize);
+  memset(pa, 0x77, kPay);  // live-span canary
+
+  dsched::spawn([st] {  // releases C then B — out of claim order
+    brpc_tpu::desc_span_release(st->arena, st->span_c, kAsize);
+    brpc_tpu::desc_span_release(st->arena, st->span_b, kAsize);
+    st->done->fetch_add(1, std::memory_order_seq_cst);
+    dsched::futex_wake(st->done);
+  });
+  dsched::spawn([st] {  // concurrent claim pressure while A pins head
+    st->mu->lock();
+    // A (the arena head) is unreleased: reclaim must stop AT it, so a
+    // claim needing the whole arena must fail while A is live
+    uint64_t big =
+        desc_arena_claim(st->ring, st->arena, kAsize - 80, kAsize);
+    dsched::check(big == UINT64_MAX,
+                  "full-arena claim must fail while the head span lives");
+    st->mu->unlock();
+    st->done->fetch_add(1, std::memory_order_seq_cst);
+    dsched::futex_wake(st->done);
+  });
+
+  for (;;) {
+    uint32_t v = st->done->load(std::memory_order_seq_cst);
+    if (v >= 2) break;
+    dsched::futex_wait(st->done, v);
+  }
+  bool canary_ok = true;
+  for (size_t b = 0; b < kPay; b++) {
+    if (pa[b] != 0x77) canary_ok = false;
+  }
+  dsched::check(canary_ok,
+                "live head span untouched by out-of-order releases");
+  brpc_tpu::desc_span_release(st->arena, st->span_a, kAsize);
+  st->mu->lock();
+  uint64_t big = desc_arena_claim(st->ring, st->arena, 256, kAsize);
+  st->mu->unlock();
+  dsched::check(big != UINT64_MAX,
+                "claim succeeds after all spans released (lazy reclaim)");
+}
+
+bool arena_validate(std::string* why) {
+  (void)why;
+  ArenaState* st = g_ar;
+  delete st->ring;
+  delete[] st->arena;
+  delete st->mu;
+  delete st->done;
+  delete st;
+  g_ar = nullptr;
+  return true;
+}
+
+// ---- butex waiter-gated wake ------------------------------------------
+
+bool g_butex_bug = false;  // --bug butex-no-fence
+
+struct BxState {
+  dsched::atomic<int32_t>* value = nullptr;
+  dsched::atomic<int>* nwaiters = nullptr;
+};
+BxState* g_bx = nullptr;
+
+void butex_body() {
+  g_bx = new BxState();
+  BxState* st = g_bx;
+  st->value = new dsched::atomic<int32_t>(0);
+  st->nwaiters = new dsched::atomic<int>(0);
+
+  dsched::spawn([st] {  // waiter (butex_wait discipline)
+    // publish the waiter BEFORE checking the value: the seq_cst RMW is
+    // the waiter's half of the Dekker pairing
+    st->nwaiters->fetch_add(1, std::memory_order_seq_cst);
+    if (st->value->load(std::memory_order_acquire) == 0) {
+      dsched::futex_wait(st->value, 0);
+    }
+    dsched::check(st->value->load(std::memory_order_acquire) == 1,
+                  "woken waiter observes the published value");
+    st->nwaiters->fetch_sub(1, std::memory_order_relaxed);
+  });
+  dsched::spawn([st] {  // waker (butex_wake fast path)
+    st->value->store(1, std::memory_order_release);
+    if (!g_butex_bug) {
+      // the load-bearing fence: pairs with the waiter's RMW so a zero
+      // snapshot proves no waiter can be parked on the OLD value
+      nat::atomic_thread_fence(std::memory_order_seq_cst);
+    }
+    if (st->nwaiters->load(std::memory_order_relaxed) != 0) {
+      dsched::futex_wake(st->value);
+    }
+  });
+}
+
+bool butex_validate(std::string* why) {
+  (void)why;
+  BxState* st = g_bx;
+  delete st->value;
+  delete st->nwaiters;
+  delete st;
+  g_bx = nullptr;
+  return true;  // the property IS deadlock-freedom (lost wake => hang)
+}
+
+// ---- EOWNERDEAD recovery vs mid-flight offer ---------------------------
+
+bool g_recover_bug = false;  // --bug recover-late-publish
+
+struct RecState {
+  MRing* ring = nullptr;
+  char* arena = nullptr;
+  dsched::mutex* mu = nullptr;
+  dsched::atomic<uint32_t>* state = nullptr;  // 1 active, 2 recovering
+  int drained = 0;
+};
+RecState* g_rec = nullptr;
+
+void recover_body() {
+  g_rec = new RecState();
+  RecState* st = g_rec;
+  st->ring = new MRing();
+  st->arena = new char[kAsize]();
+  st->mu = new dsched::mutex();
+  st->state = new dsched::atomic<uint32_t>(1);
+  desc_ring_init(st->ring);
+
+  dsched::spawn([st] {  // producer: offers under the producer lock
+    for (int i = 0; i < 4; i++) {
+      uint64_t pos = 0, span = 0;
+      char* dst = nullptr;
+      bool ok = false;
+      st->mu->lock();
+      if (st->state->load(std::memory_order_seq_cst) != 1) {
+        st->mu->unlock();
+        return;  // slot recovering: offers back off (shm_lane_offer)
+      }
+      ok = desc_ring_begin_push(st->ring, st->arena, kPay, kAsize, &pos,
+                                &span, &dst);
+      if (ok) {
+        memset(dst, 0x5a, kPay);
+        if (!g_recover_bug) {
+          desc_ring_publish(st->ring, pos, 3, 0, 1, i, 0, span,
+                            (uint32_t)kPay, (uint64_t)i);
+        }
+      }
+      st->mu->unlock();
+      if (ok && g_recover_bug) {
+        // seeded defect: the publish escapes the lock — recovery can
+        // discard the claim and scrub while this store is in flight
+        dsched::yield();
+        desc_ring_publish(st->ring, pos, 3, 0, 1, i, 0, span,
+                          (uint32_t)kPay, (uint64_t)i);
+      }
+      if (!ok) return;  // backpressure: enough offered for the model
+    }
+  });
+
+  dsched::spawn([st] {  // recovery (recover_slot discipline)
+    st->state->store(2, std::memory_order_seq_cst);
+    st->mu->lock();  // flush in-flight offers
+    DescCellView c;
+    while (desc_ring_pop(st->ring, &c)) {
+      const char* p =
+          brpc_tpu::desc_span_payload(st->arena, c.span_off, kAsize);
+      bool clean = true;
+      for (size_t b = 0; b < kPay; b++) {
+        if (p[b] != 0x5a) clean = false;
+      }
+      dsched::check(clean, "recovery drained an untorn record");
+      brpc_tpu::desc_span_release(st->arena, c.span_off, kAsize);
+      st->drained++;
+    }
+    desc_ring_discard_claims(st->ring);
+    desc_scrub_arena(st->ring, st->arena, kAsize);
+    st->mu->unlock();
+    // the slot is clean: nothing may surface in the recovered ring, and
+    // a fresh worker's claim must find a fully-reclaimed arena
+    DescCellView late;
+    dsched::check(!desc_ring_pop(st->ring, &late),
+                  "no descriptor may surface after recovery");
+    st->mu->lock();
+    // one span (not the whole arena: a wrap filler burned by a partial
+    // producer run legitimately costs up to a lap of virtual space —
+    // the dsched explorer found exactly that when this asserted more)
+    uint64_t span = desc_arena_claim(st->ring, st->arena, kPay, kAsize);
+    dsched::check(span != UINT64_MAX,
+                  "recovered arena accepts a fresh span");
+    if (span != UINT64_MAX) {
+      brpc_tpu::desc_span_release(st->arena, span, kAsize);
+    }
+    st->mu->unlock();
+  });
+}
+
+bool recover_validate(std::string* why) {
+  RecState* st = g_rec;
+  // refill probe: a recovered ring must accept a FULL lap of fresh
+  // offers. A publish that escaped the producer lock corrupts one
+  // cell's seq after discard_claims — invisible to an immediate pop,
+  // but the next lap's claim of that cell wedges exactly here (the
+  // late-publish defect --bug recover-late-publish seeds).
+  for (int i = 0; i < (int)MRing::kSlots; i++) {
+    uint64_t pos = 0, span = 0;
+    char* dst = nullptr;
+    if (!desc_ring_begin_push(st->ring, st->arena, kPay, kAsize, &pos,
+                              &span, &dst)) {
+      *why = "recovered ring refused fresh offer " + std::to_string(i) +
+             " of " + std::to_string((int)MRing::kSlots) +
+             " (wedged cell: publish escaped the producer lock?)";
+      delete st->ring;
+      delete[] st->arena;
+      delete st->mu;
+      delete st->state;
+      delete st;
+      g_rec = nullptr;
+      return false;
+    }
+    desc_ring_publish(st->ring, pos, 3, 0, 1, i, 0, span, (uint32_t)kPay,
+                      0);
+  }
+  delete st->ring;
+  delete[] st->arena;
+  delete st->mu;
+  delete st->state;
+  delete st;
+  g_rec = nullptr;
+  return true;
+}
+
+// ---- harness -----------------------------------------------------------
+
+struct Scenario {
+  const char* name;
+  void (*body)();
+  bool (*validate)(std::string*);
+  int dfs_execs;     // DFS execution cap (smoke)
+  int rand_execs;    // random walks (smoke)
+  int preempt;       // DFS preemption bound
+};
+
+void wsq_body1() { wsq_body_n(1); }
+void wsq_body2() { wsq_body_n(2); }
+
+const Scenario kScenarios[] = {
+    {"wsq", wsq_body1, wsq_validate, 4000, 400, 3},
+    {"wsq2", wsq_body2, wsq_validate, 2500, 300, 2},
+    {"ring", ring_body, ring_validate, 2500, 300, 2},
+    {"arena", arena_body, arena_validate, 2500, 300, 3},
+    {"butex", butex_body, butex_validate, 4000, 400, 4},
+    {"recover", recover_body, recover_validate, 2500, 300, 3},
+};
+
+int run_scenario(const Scenario& sc, dsched::Mode mode, uint64_t seed,
+                 int execs, int preempt) {
+  dsched::Config cfg;
+  cfg.mode = mode;
+  cfg.seed = seed;
+  cfg.executions = execs > 0 ? execs
+                   : mode == dsched::Mode::DFS ? sc.dfs_execs
+                                               : sc.rand_execs;
+  cfg.preemption_bound = preempt > 0 ? preempt : sc.preempt;
+  dsched::Result r = dsched::run(sc.name, sc.body, cfg, sc.validate);
+  printf("model %-8s %-6s execs=%-6llu points=%-8llu hash=%016llx %s\n",
+         sc.name, mode == dsched::Mode::DFS ? "dfs" : "random",
+         (unsigned long long)r.executions,
+         (unsigned long long)r.schedule_points,
+         (unsigned long long)r.trace_hash, r.ok ? "ok" : "FAIL");
+  if (!r.ok) {
+    printf("  %s\n", r.fail_msg.c_str());
+    if (mode == dsched::Mode::RANDOM) {
+      printf("  replay: ./nat_model --scenario %s --mode random --seed "
+             "%llu --execs 1\n",
+             sc.name, (unsigned long long)r.fail_seed);
+    }
+    printf("  %s\n", r.fail_trace.c_str());
+    return 1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string scenario = "all";
+  std::string mode = "both";
+  uint64_t seed = 1;
+  int execs = 0;
+  int preempt = 0;
+  bool smoke = false;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* {
+      return i + 1 < argc ? argv[++i] : "";
+    };
+    if (a == "--scenario") scenario = next();
+    else if (a == "--mode") mode = next();
+    else if (a == "--seed") seed = strtoull(next(), nullptr, 0);
+    else if (a == "--execs") execs = atoi(next());
+    else if (a == "--preempt") preempt = atoi(next());
+    else if (a == "--smoke") smoke = true;
+    else if (a == "--bug") {
+      std::string b = next();
+      if (b == "butex-no-fence") g_butex_bug = true;
+      else if (b == "recover-late-publish") g_recover_bug = true;
+      else {
+        fprintf(stderr, "unknown --bug %s\n", b.c_str());
+        return 2;
+      }
+    } else if (a == "--list") {
+      for (const Scenario& sc : kScenarios) printf("%s\n", sc.name);
+      return 0;
+    } else {
+      fprintf(stderr,
+              "usage: nat_model [--smoke] [--scenario NAME|all] "
+              "[--mode dfs|random|both] [--seed N] [--execs N] "
+              "[--preempt N] [--bug butex-no-fence|recover-late-publish] "
+              "[--list]\n");
+      return 2;
+    }
+  }
+  (void)smoke;  // --smoke == defaults: all scenarios, both modes
+  int rc = 0;
+  for (const Scenario& sc : kScenarios) {
+    if (scenario != "all" && scenario != sc.name) continue;
+    if (mode == "dfs" || mode == "both") {
+      rc |= run_scenario(sc, dsched::Mode::DFS, seed, execs, preempt);
+    }
+    if (mode == "random" || mode == "both") {
+      rc |= run_scenario(sc, dsched::Mode::RANDOM, seed, execs, preempt);
+    }
+  }
+  return rc;
+}

@@ -75,7 +75,7 @@ NatSocket* channel_socket(NatChannel* ch, int max_dial_ms) {
   if (max_dial_ms > 0 && max_dial_ms < t_ms) t_ms = max_dial_ms;
   int fd = dial_nonblocking(ch->peer_ip.c_str(), ch->peer_port, t_ms);
   if (fd < 0) return nullptr;
-  std::lock_guard<std::mutex> g(ch->reconnect_mu);
+  std::lock_guard g(ch->reconnect_mu);
   s = sock_address(ch->sock_id.load(std::memory_order_acquire));
   if (s != nullptr || ch->closed.load(std::memory_order_acquire)) {
     ::close(fd);  // lost the race (or the channel closed mid-dial)
@@ -238,7 +238,7 @@ void nat_channel_close(void* h) {
     // serialize against an in-flight reconnect: once we hold
     // reconnect_mu, any racing channel_socket has either published its
     // new socket (we fail it below) or will see closed and not dial
-    std::lock_guard<std::mutex> g(ch->reconnect_mu);
+    std::lock_guard g(ch->reconnect_mu);
     ch->closed.store(true, std::memory_order_release);
   }
   NatSocket* s = sock_address(ch->sock_id);

@@ -28,7 +28,7 @@ struct HttpCliSessN {
   // mu orders request writes with FIFO registration: cid push and the
   // socket write happen under one lock, so wire order == fifo order even
   // with concurrent callers (the pipelining correlation invariant).
-  std::mutex mu;
+  NatMutex<kLockRankHttpCli> httpc_mu;
   struct Req {
     int64_t cid;
     bool head;  // HEAD request: the response has headers but NO body
@@ -58,7 +58,7 @@ void http_cli_on_socket_fail(NatSocket* s) {
   HttpCliSessN* c = s->httpc;
   if (c == nullptr) return;
   // cheap pre-check, then TRY-lock: set_failed can fire on a thread that
-  // already holds c->mu (http_cli_send's write failing synchronously) —
+  // already holds c->httpc_mu (http_cli_send's write failing synchronously) —
   // blocking here would self-deadlock, and in that doomed-socket race
   // fail_all's error completion is the correct outcome anyway
   if (c->phase.load(std::memory_order_acquire) != 2) return;
@@ -66,7 +66,7 @@ void http_cli_on_socket_fail(NatSocket* s) {
   IOBuf body;
   int64_t cid = 0;
   {
-    std::unique_lock<std::mutex> g(c->mu, std::try_to_lock);
+    std::unique_lock g(c->httpc_mu, std::try_to_lock);
     if (!g.owns_lock()) return;
     if (c->phase.load(std::memory_order_acquire) != 2) return;
     c->phase.store(0, std::memory_order_release);
@@ -99,7 +99,7 @@ static PendingCall* http_cli_take_head(NatSocket* s, bool* head_out) {
   HttpCliSessN* c = s->httpc;
   int64_t cid = 0;
   {
-    std::lock_guard<std::mutex> g(c->mu);
+    std::lock_guard g(c->httpc_mu);
     if (c->fifo.empty()) {
       *head_out = false;
       return nullptr;
@@ -127,7 +127,7 @@ int http_client_process(NatSocket* s) {
     // phase 2: close-delimited body — every byte until EOF belongs to
     // the head response (completion happens in http_cli_on_socket_fail)
     if (c->phase.load(std::memory_order_acquire) == 2) {
-      std::lock_guard<std::mutex> g(c->mu);
+      std::lock_guard g(c->httpc_mu);
       if (s->in_buf.length() > 0) {
         s->in_buf.cut_into(&c->body_acc, s->in_buf.length());
       }
@@ -278,7 +278,7 @@ int http_client_process(NatSocket* s) {
     // response completes, so the deadline timer can still win.
     bool was_head = false;
     {
-      std::lock_guard<std::mutex> g(c->mu);
+      std::lock_guard g(c->httpc_mu);
       if (!c->fifo.empty()) was_head = c->fifo.front().head;
     }
     bool head_like = was_head || status == 204 || status == 304;
@@ -291,7 +291,7 @@ int http_client_process(NatSocket* s) {
       // (fail_all reports the error to the caller).
       if (!close_delim_ok) return 0;
       s->in_buf.pop_front(body_start);
-      std::lock_guard<std::mutex> g(c->mu);
+      std::lock_guard g(c->httpc_mu);
       c->status = status;
       c->body_acc.clear();
       if (s->in_buf.length() > 0) {
@@ -349,10 +349,10 @@ struct H2CliSessN {
   ~H2CliSessN() {
     if (dec != nullptr) hpack_decoder_free(dec);
   }
-  // mu guards everything below AND orders stream writes on the socket
+  // h2c_mu guards everything below AND orders stream writes on the socket
   // (sender threads and the reading-thread window flush both write
   // under it, so per-stream frame order is total).
-  std::mutex mu;
+  NatMutex<kLockRankH2Cli> h2c_mu;
   uint32_t next_sid = 1;
   int64_t conn_send_window = 65535;
   int64_t peer_initial_window = 65535;
@@ -388,7 +388,7 @@ struct H2CliSessN {
 // without this, every timed-out call leaks an St and its parked request
 // bytes forever, and the window flush keeps transmitting for the dead.
 // Emits RST_STREAM for each so the server can free its half. Requires
-// h->mu.
+// h->h2c_mu.
 static void h2c_sweep_dead_locked(NatChannel* ch, H2CliSessN* h,
                                   std::string* out) {
   for (auto it = h->streams.begin(); it != h->streams.end();) {
@@ -407,7 +407,7 @@ static void h2c_sweep_dead_locked(NatChannel* ch, H2CliSessN* h,
 
 void h2_cli_free(H2CliSessN* c) { delete c; }
 
-// Frame as much of st->pend as the windows allow; requires h->mu.
+// Frame as much of st->pend as the windows allow; requires h->h2c_mu.
 // Emits the END_STREAM flag on the frame that drains pend.
 static void h2c_pump_locked(H2CliSessN* h, H2CliSessN::St* st, uint32_t sid,
                             std::string* out) {
@@ -431,7 +431,7 @@ static void h2c_pump_locked(H2CliSessN* h, H2CliSessN::St* st, uint32_t sid,
 }
 
 // Start a request stream: HEADERS + as much DATA as the windows allow,
-// written under h->mu (wire order == sid order for the HEADERS).
+// written under h->h2c_mu (wire order == sid order for the HEADERS).
 // Returns 0 on success, else an error code.
 static int h2c_send_request(NatChannel* ch, NatSocket* s,
                             const char* path, const char* payload,
@@ -448,11 +448,11 @@ static int h2c_send_request(NatChannel* ch, NatSocket* s,
   data.push_back((char)(payload_len & 0xff));
   if (payload_len > 0) data.append(payload, payload_len);
 
-  std::unique_lock<std::mutex> g(h->mu);
+  std::unique_lock g(h->h2c_mu);
   // stream-id space exhausted: fail the connection so the channel
   // re-dials fresh (the reference marks the connection unwritable too).
   // set_failed may sweep this session's streams (h2c_fail_own_streams
-  // locks h->mu), so it must run AFTER the unlock.
+  // locks h->h2c_mu), so it must run AFTER the unlock.
   if (h->next_sid > 0x7ffffffd) {
     g.unlock();
     s->set_failed();
@@ -508,16 +508,45 @@ static int h2c_send_request(NatChannel* ch, NatSocket* s,
   return 0;
 }
 
+static void h2c_complete_cids(NatChannel* ch,
+                              const std::vector<int64_t>& cids,
+                              int32_t code, const char* text);
+
 void h2c_fail_own_streams(NatSocket* s, int32_t code, const char* text) {
   H2CliSessN* h = s->h2c;
   NatChannel* ch = s->channel;
   if (h == nullptr || ch == nullptr) return;
   std::vector<int64_t> cids;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->h2c_mu);
     for (auto& kv : h->streams) cids.push_back(kv.second.cid);
     h->streams.clear();
   }
+  h2c_complete_cids(ch, cids, code, text);
+}
+
+// Teardown variant (set_failed with the scheduler stopped: no sweep
+// fiber possible, and no running thread can hold h2c_mu). try_lock on
+// purpose — it cannot deadlock, and if the lock is somehow contended
+// during teardown, backing off beats wedging the exit path.
+void h2c_fail_own_streams_teardown(NatSocket* s, int32_t code,
+                                   const char* text) {
+  H2CliSessN* h = s->h2c;
+  NatChannel* ch = s->channel;
+  if (h == nullptr || ch == nullptr) return;
+  std::vector<int64_t> cids;
+  {
+    std::unique_lock g(h->h2c_mu, std::try_to_lock);
+    if (!g.owns_lock()) return;
+    for (auto& kv : h->streams) cids.push_back(kv.second.cid);
+    h->streams.clear();
+  }
+  h2c_complete_cids(ch, cids, code, text);
+}
+
+static void h2c_complete_cids(NatChannel* ch,
+                              const std::vector<int64_t>& cids,
+                              int32_t code, const char* text) {
   for (int64_t cid : cids) {
     PendingCall* pc = ch->take_pending(cid, /*ok=*/false);
     if (pc == nullptr) continue;
@@ -538,7 +567,7 @@ static void h2c_complete(NatSocket* s, H2CliSessN* h, uint32_t sid) {
   std::string flat, data;
   bool drained = false;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->h2c_mu);
     auto it = h->streams.find(sid);
     if (it == h->streams.end()) return;
     cid = it->second.cid;
@@ -613,7 +642,7 @@ static bool h2c_headers_complete(NatSocket* s, H2CliSessN* h, uint32_t sid,
     return false;
   }
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->h2c_mu);
     auto it = h->streams.find(sid);
     if (it == h->streams.end()) return true;  // stale (timed out): drop
     if (it->second.flat.size() + flat.size() > kCMaxHeaderBlock) {
@@ -627,12 +656,12 @@ static bool h2c_headers_complete(NatSocket* s, H2CliSessN* h, uint32_t sid,
 }
 
 // Window opened: pump every parked request stream that fits. Writes
-// under h->mu (ordering with senders).
+// under h->h2c_mu (ordering with senders).
 static void h2c_flush_parked(NatSocket* s, H2CliSessN* h) {
   NatChannel* ch = s->channel;
   std::string out;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->h2c_mu);
     for (auto it = h->streams.begin(); it != h->streams.end();) {
       if (!it->second.pend.empty()) {
         // a parked stream whose caller is gone must not burn window
@@ -690,7 +719,7 @@ int h2_client_process(NatSocket* s, IOBuf* batch_out) {
                          ((uint32_t)p[i + 3] << 16) |
                          ((uint32_t)p[i + 4] << 8) | p[i + 5];
           if (id == 4) {
-            std::lock_guard<std::mutex> g(h->mu);
+            std::lock_guard g(h->h2c_mu);
             int64_t delta = (int64_t)val - h->peer_initial_window;
             h->peer_initial_window = val;
             for (auto& kv : h->streams) kv.second.send_window += delta;
@@ -718,7 +747,7 @@ int h2_client_process(NatSocket* s, IOBuf* batch_out) {
                        ((uint32_t)p[1] << 16) | ((uint32_t)p[2] << 8) |
                        p[3];
         {
-          std::lock_guard<std::mutex> g(h->mu);
+          std::lock_guard g(h->h2c_mu);
           if (sid == 0) {
             h->conn_send_window += inc;
           } else {
@@ -733,7 +762,7 @@ int h2_client_process(NatSocket* s, IOBuf* batch_out) {
         if (flen != 4) return 0;
         int64_t cid = 0;
         {
-          std::lock_guard<std::mutex> g(h->mu);
+          std::lock_guard g(h->h2c_mu);
           auto it = h->streams.find(sid);
           if (it == h->streams.end()) break;
           cid = it->second.cid;
@@ -769,7 +798,7 @@ int h2_client_process(NatSocket* s, IOBuf* batch_out) {
         std::vector<int64_t> refused;
         bool drained;
         {
-          std::lock_guard<std::mutex> g(h->mu);
+          std::lock_guard g(h->h2c_mu);
           // repeated GOAWAYs may only shrink the permitted window
           // (RFC 7540 §6.8: last_sid must not increase across frames)
           h->goaway_last_sid =
@@ -870,7 +899,7 @@ int h2_client_process(NatSocket* s, IOBuf* batch_out) {
         bool end_stream = (flags & kCFlagEndStream) != 0;
         bool known = false;
         {
-          std::lock_guard<std::mutex> g(h->mu);
+          std::lock_guard g(h->h2c_mu);
           auto it = h->streams.find(sid);
           if (it != h->streams.end()) {
             known = true;
@@ -943,7 +972,7 @@ static int http_cli_send(NatChannel* ch, NatSocket* s, const char* verb,
   }
   f.append("\r\n", 2);
   if (body_len > 0) f.append(body, body_len);
-  std::lock_guard<std::mutex> g(c->mu);
+  std::lock_guard g(c->httpc_mu);
   c->fifo.push_back({cid, strcmp(verb, "HEAD") == 0});
   if (s->write(std::move(f)) != 0) {
     // the failed write swept pending calls via fail_all; drop the fifo

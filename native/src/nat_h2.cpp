@@ -452,7 +452,7 @@ struct H2SessionN {
   // encoder table for reading-thread response HEADERS (under mu)
   HpackEncTableN enc;
   // everything below is shared with py-lane responders: mu guards it
-  std::mutex mu;
+  NatMutex<kLockRankH2Sess> h2_mu;
   int64_t conn_send_window = 65535;
   std::map<uint32_t, H2StreamN> streams;
   // responses blocked on flow control: (sid, remaining DATA payload,
@@ -476,7 +476,7 @@ int h2_sniff(const char* p, size_t n) {
   return n >= kPrefaceLen ? 1 : 2;
 }
 
-// Frame as many DATA bytes as the windows allow (requires h->mu); the
+// Frame as many DATA bytes as the windows allow (requires h->h2_mu); the
 // remainder stays in `data`. Appends frames to out.
 static void h2_send_data_locked(H2SessionN* h, H2StreamN* st, uint32_t sid,
                                 std::string* data, std::string* out) {
@@ -500,7 +500,7 @@ static void h2_send_data_locked(H2SessionN* h, H2StreamN* st, uint32_t sid,
 // trailers (grpc-status). Flow-control leftovers park on the session.
 // Called from the reading thread (native handlers, batch_out != nullptr)
 // and from py pthreads (batch_out == nullptr).
-// Encode one header with the session dynamic table (requires h->mu;
+// Encode one header with the session dynamic table (requires h->h2_mu;
 // reading-thread blocks only — see HpackEncTableN).
 static void hp_enc_header_dyn(H2SessionN* h, std::string* out,
                               std::string_view name,
@@ -533,7 +533,7 @@ static void hp_enc_header_dyn(H2SessionN* h, std::string* out,
 
 // Emit the RFC 7541 §4.2 dynamic-table size update(s) owed after a
 // SETTINGS_HEADER_TABLE_SIZE change, and settle the encoder bookkeeping.
-// Requires h->mu; the update bytes MUST lead the next header block that
+// Requires h->h2_mu; the update bytes MUST lead the next header block that
 // reaches the wire (whoever emits first — reading thread or py thread —
 // carries them; see the pending_resize checks in h2_respond).
 static void hp_emit_resize_locked(H2SessionN* h, std::string* out) {
@@ -592,7 +592,7 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
 
   std::string out;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->h2_mu);
     if (batch_out != nullptr) {
       // reading-thread block: encode under mu with the dynamic table
       if (h->enc.pending_resize) {  // peer changed the table cap
@@ -632,7 +632,7 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
       if (it != h->streams.end()) h->streams.erase(it);
     }
     if (batch_out == nullptr) {
-      // Write while still holding h->mu: a WINDOW_UPDATE handled
+      // Write while still holding h->h2_mu: a WINDOW_UPDATE handled
       // concurrently by the reading thread flushes the parked remainder
       // under this same lock, so releasing before the write could put
       // DATA/trailers on the wire ahead of these HEADERS (the overtake
@@ -649,9 +649,9 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
 }
 
 // WINDOW_UPDATE arrived: flush parked responses that now fit. Requires
-// h->mu NOT held. Appends to out.
+// h->h2_mu NOT held. Appends to out.
 static void h2_flush_pending(NatSocket* s, H2SessionN* h, std::string* out) {
-  std::lock_guard<std::mutex> g(h->mu);
+  std::lock_guard g(h->h2_mu);
   while (!h->pending.empty()) {
     auto& p = h->pending.front();
     auto it = h->streams.find(p.sid);
@@ -673,7 +673,7 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
   std::string path, flat, data;
   uint64_t t_recv;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->h2_mu);
     auto it = h->streams.find(sid);
     if (it == h->streams.end()) return;
     if (it->second.dispatched) return;  // e.g. a second END_STREAM DATA
@@ -756,7 +756,7 @@ static bool h2_headers_complete(NatSocket* s, H2SessionN* h, uint32_t sid,
   std::string flat, path;
   if (!h->dec.decode(block, len, &flat, &path)) return false;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->h2_mu);
     if (h->streams.size() >= kMaxConcurrentStreams &&
         h->streams.find(sid) == h->streams.end()) {
       return false;  // connection error: stream table full
@@ -838,7 +838,7 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
             // accumulators to the socket BEFORE arming the resize:
             // whoever carries the §4.2 update next (reading thread OR a
             // py-thread static block, which writes immediately under
-            // h->mu) must not overtake blocks encoded against the old
+            // h->h2_mu) must not overtake blocks encoded against the old
             // table — the update's eviction would turn their indexed
             // refs into ghosts on the decoder.
             if (!out.empty()) {
@@ -846,14 +846,14 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
               out.clear();
             }
             if (!batch_out->empty()) s->write(std::move(*batch_out));
-            std::lock_guard<std::mutex> g(h->mu);
+            std::lock_guard g(h->h2_mu);
             size_t cap = val > 4096 ? 4096 : (size_t)val;
             h->enc.target = cap;
             if (cap < h->enc.lowest) h->enc.lowest = cap;
             h->enc.pending_resize = (h->enc.target != h->enc.max_size ||
                                      h->enc.lowest < h->enc.max_size);
           } else if (id == 4) {  // INITIAL_WINDOW_SIZE
-            std::lock_guard<std::mutex> g(h->mu);
+            std::lock_guard g(h->h2_mu);
             int64_t delta = (int64_t)val - h->peer_initial_window;
             h->peer_initial_window = val;
             for (auto& kv : h->streams) kv.second.send_window += delta;
@@ -879,7 +879,7 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
                        ((uint32_t)p[1] << 16) | ((uint32_t)p[2] << 8) |
                        p[3];
         {
-          std::lock_guard<std::mutex> g(h->mu);
+          std::lock_guard g(h->h2_mu);
           if (sid == 0) {
             h->conn_send_window += inc;
           } else {
@@ -893,7 +893,7 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
       case kFPriority:
         break;  // advisory; ignored
       case kFRstStream: {
-        std::lock_guard<std::mutex> g(h->mu);
+        std::lock_guard g(h->h2_mu);
         h->streams.erase(sid);
         break;
       }
@@ -964,7 +964,7 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
         if (sid == 0 || (sid & 1) == 0) return 0;
         bool drop = false;
         {
-          std::lock_guard<std::mutex> g(h->mu);
+          std::lock_guard g(h->h2_mu);
           // DATA must land on a stream HEADERS opened — never auto-create
           // a table entry (remote memory growth). An unknown sid is NOT a
           // connection error though: in-flight DATA racing our processing

@@ -25,7 +25,7 @@ struct HttpSessionN {
   // request order; only the response matching next_resp_seq is written,
   // later ones park. mu guards everything below (py pthreads + reading
   // thread both emit).
-  std::mutex mu;
+  NatMutex<kLockRankHttpSess> http_mu;
   uint64_t next_resp_seq = 1;
   // IOBuf (not std::string) so parked responses can carry arena-backed
   // user blocks (the shm drainer's zero-copy emit) without a copy
@@ -59,7 +59,7 @@ int http_sniff(const char* p, size_t n) {
   return 0;
 }
 
-// Write any now-in-order parked responses. Requires h->mu. Appends into
+// Write any now-in-order parked responses. Requires h->http_mu. Appends into
 // out (the caller writes outside the lock).
 static void http_emit_locked(NatSocket* s, HttpSessionN* h,
                              IOBuf* out, bool* want_close) {
@@ -96,7 +96,7 @@ static void http_emit_response(NatSocket* s, uint64_t seq, IOBuf data,
   bool want_close = false;
   bool wrote = false;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->http_mu);
     auto& slot = h->parked[seq];
     slot.data = std::move(data);
     slot.close = close;
@@ -115,7 +115,7 @@ static void http_emit_response(NatSocket* s, uint64_t seq, IOBuf data,
         // accumulator; only reading-thread emissions use it
         batch_out->append(std::move(out));
       } else {
-        // the socket write happens UNDER h->mu: two py responders that
+        // the socket write happens UNDER h->http_mu: two py responders that
         // drain consecutive seqs must hit the write queue in that order
         // (emitting outside the lock let the later seq overtake)
         s->write(std::move(out));
@@ -130,7 +130,7 @@ static void http_emit_response(NatSocket* s, uint64_t seq, IOBuf data,
     // visible to it — re-check now
     bool empty;
     {
-      std::lock_guard<std::mutex> g(s->write_mu);
+      std::lock_guard g(s->write_mu);
       empty = s->write_q.empty() && !s->ring_sending && !s->writing;
     }
     if (empty) s->set_failed();
@@ -163,7 +163,7 @@ static void http_maybe_send_continue(HttpSessionN* h, bool expect_continue,
                                      IOBuf* batch_out) {
   if (!expect_continue || h->continue_sent) return;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->http_mu);
     if (!h->parked.empty() || h->next_resp_seq != h->next_req_seq) return;
   }
   batch_out->append("HTTP/1.1 100 Continue\r\n\r\n", 25);
@@ -186,7 +186,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
   NatServer* srv = s->server;
   HttpSessionN* h = s->http;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->http_mu);
     h->round_active = true;
   }
   while (true) {
@@ -368,7 +368,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
       build_http_response(&resp_bytes, ctx.status, ctx.content_type,
                           resp_body.data(), resp_body.size(), head_only);
       if (conn_close) {
-        std::lock_guard<std::mutex> g(h->mu);
+        std::lock_guard g(h->http_mu);
         h->close_seqs.push_back(seq);
       }
       // capture the span method BEFORE pop_front: `path` may view into
@@ -433,7 +433,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
       }
     }
     if (conn_close) {
-      std::lock_guard<std::mutex> g(h->mu);
+      std::lock_guard g(h->http_mu);
       h->close_seqs.push_back(seq);
     }
     s->in_buf.pop_front(total);
@@ -452,12 +452,12 @@ void http_round_end(NatSocket* s) {
   if (h == nullptr) return;
   IOBuf out;
   bool want_close = false;
-  std::lock_guard<std::mutex> g(h->mu);
+  std::lock_guard g(h->http_mu);
   http_emit_locked(s, h, &out, &want_close);
   h->round_active = false;
   if (want_close) s->close_after_drain.store(true, std::memory_order_release);
   if (!out.empty()) {
-    s->write(std::move(out));  // under h->mu: ordered vs py emitters
+    s->write(std::move(out));  // under h->http_mu: ordered vs py emitters
   }
 }
 
@@ -508,7 +508,7 @@ int nat_sock_graceful_close(uint64_t sock_id) {
   s->close_after_drain.store(true, std::memory_order_release);
   bool empty;
   {
-    std::lock_guard<std::mutex> g(s->write_mu);
+    std::lock_guard g(s->write_mu);
     empty = s->write_q.empty() && !s->ring_sending && !s->writing;
   }
   if (empty) s->set_failed();

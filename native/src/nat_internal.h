@@ -39,6 +39,7 @@
 
 #include "iobuf.h"
 #include "nat_api.h"
+#include "nat_lockrank.h"
 #include "nat_stats.h"
 #include "ring_listener.h"
 #include "rpc_meta.h"
@@ -104,7 +105,7 @@ struct NatSocket {
   IOBuf in_buf;
 
   // write side
-  std::mutex write_mu;
+  NatMutex<kLockRankSockWrite> write_mu;
   IOBuf write_q;        // queued-but-unwritten bytes (frames are appended
                         // whole, so content never interleaves)
   bool writing = false; // a writer (inline or KeepWrite fiber) is active
@@ -198,7 +199,7 @@ inline constexpr uint32_t kSockSlabs = 1024;                    // 1M max
 // release store that a concurrent sock_at (server-stop scan) acquires —
 // no reader can observe a half-constructed NatSocket (ADVICE r3 #1)
 extern std::atomic<std::atomic<NatSocket*>*> g_sock_slab[kSockSlabs];
-extern std::mutex g_sock_alloc_mu;
+extern NatMutex<kLockRankSockAlloc> g_sock_alloc_mu;
 extern std::vector<uint32_t>& g_sock_free;  // leaked: see nat_socket.cpp
 extern uint32_t g_sock_next_idx;
 
@@ -233,7 +234,7 @@ class Dispatcher {
   std::thread thread;
   std::atomic<bool> stop{false};
   // listen sockets: fd -> server
-  std::mutex listen_mu;
+  NatMutex<kLockRankListen> listen_mu;
   std::unordered_map<int, NatServer*> listeners;
 
   int start();
@@ -253,7 +254,7 @@ class Dispatcher {
 extern std::vector<Dispatcher*>& g_disps;  // leaked: see nat_server.cpp
 extern Dispatcher* g_disp;  // g_disps[0]: listeners + console
 extern NatServer* g_rpc_server;
-extern std::mutex g_rt_mu;
+extern NatMutex<kLockRankRuntime> g_rt_mu;
 
 Dispatcher* pick_dispatcher();
 int ensure_runtime(int nworkers);
@@ -407,8 +408,8 @@ class NatServer {
   // session; plaintext peers keep working on the same port.
   void* ssl_ctx = nullptr;
 
-  // Python lane MPSC queue
-  std::mutex py_mu;
+  // Python lane MPSC queue (py_cv waits under py_mu: stays std::mutex)
+  std::mutex py_mu;  // natcheck:rank(server.py, 57)
   std::condition_variable py_cv;
   std::deque<PyRequest*> py_q;
   bool py_stopping = false;
@@ -421,14 +422,14 @@ class NatServer {
     // across N interpreters instead of behind this process's GIL
     if ((r->kind == 3 || r->kind == 4) && shm_lane_offer(r)) return;
     {
-      std::lock_guard<std::mutex> g(py_mu);
+      std::lock_guard g(py_mu);
       py_q.push_back(r);
     }
     py_cv.notify_one();
   }
 
   PyRequest* take_py(int timeout_ms) {
-    std::unique_lock<std::mutex> lk(py_mu);
+    std::unique_lock lk(py_mu);
     if (py_q.empty() && !py_stopping) {
       nat_cv_wait_for(py_cv, lk, std::chrono::milliseconds(timeout_ms));
     }
@@ -441,7 +442,7 @@ class NatServer {
   // Batch take: one condvar round + one FFI crossing covers a whole
   // burst (the py lane's per-item wakeup was measurable at qps scale).
   int take_py_batch(PyRequest** out, int max, int timeout_ms) {
-    std::unique_lock<std::mutex> lk(py_mu);
+    std::unique_lock lk(py_mu);
     if (py_q.empty() && !py_stopping) {
       nat_cv_wait_for(py_cv, lk, std::chrono::milliseconds(timeout_ms));
     }
@@ -524,7 +525,7 @@ class NatChannel {
   bool defer_writes_flag = false;
   std::atomic<bool> closed{false};
   std::atomic<bool> hc_pending{false};
-  std::mutex reconnect_mu;
+  NatMutex<kLockRankReconnect> reconnect_mu;
   // Lifetime: the owning socket holds one reference (released in
   // ~NatSocket) and the opener holds one (released in nat_channel_close),
   // so a reader fiber mid-process_input can never see a freed channel.
@@ -638,7 +639,7 @@ class NatChannel {
   std::atomic<PendingCall*> slabs_[kMaxSlabs] = {};
   std::atomic<uint32_t> nslots_{0};
   std::atomic<uint64_t> free_head_{0};  // (aba_tag<<32) | (idx+1)
-  std::mutex grow_mu_;
+  NatMutex<kLockRankChanGrow> grow_mu_;
   // Consumer-side cache: pop_free grabs the WHOLE free chain in one
   // exchange and walks it privately, so steady-state allocation costs no
   // CAS at all (completions still CAS-push). pop_cache_lock_ arbitrates
@@ -693,7 +694,7 @@ class NatChannel {
   }
 
   bool grow() {
-    std::lock_guard<std::mutex> g(grow_mu_);
+    std::lock_guard g(grow_mu_);
     uint32_t n = nslots_.load(std::memory_order_acquire);
     if ((uint32_t)free_head_.load(std::memory_order_acquire) != 0) {
       return true;  // another thread grew while we waited
@@ -787,6 +788,10 @@ void h2_cli_free(H2CliSessN* c);
 // channel has already moved to a replacement — a channel-wide fail_all
 // would spuriously kill calls in flight on the new socket).
 void h2c_fail_own_streams(NatSocket* s, int32_t code, const char* text);
+// Teardown variant (try_lock sweep): for set_failed when the scheduler
+// is stopped and no sweep fiber can run.
+void h2c_fail_own_streams_teardown(NatSocket* s, int32_t code,
+                                   const char* text);
 // Attach the channel's protocol session to a (re)dialed socket; for h2
 // this also queues the connection preface + SETTINGS.
 void channel_attach_client_session(NatChannel* ch, NatSocket* s);

@@ -1,0 +1,135 @@
+// Lock ranks — the repo's total lock order, checked two ways:
+//
+//   * statically by tools/natcheck/lockorder.py, which parses every
+//     NatMutex<kLockRank...> declaration (and the `natcheck:rank` comment
+//     annotations on the few raw mutexes below), builds the
+//     acquires-while-holding graph across all TUs and requires the rank
+//     to strictly increase on every nested acquisition;
+//   * at runtime under -DNAT_LOCKRANK=1 (`make -C native lockrank`, run
+//     by `make -C native check`): every NatMutex::lock pushes its rank
+//     on a thread-local held stack and aborts if the new rank is not
+//     strictly greater than the deepest held one. try_lock acquisitions
+//     are exempt from the order assert (a failed try_lock cannot
+//     deadlock — that is exactly why the hot paths use them) but still
+//     tracked while held.
+//
+// The discipline re-grows brpc's strict lock ranks around Socket/bthread
+// internals as checkable tooling: outer control-plane locks rank low,
+// per-session protocol locks mid, socket/ring/stat leaves high, and the
+// scheduler's own locks highest (anything may wake a fiber while holding
+// its own lock, never the reverse).
+//
+// Raw (non-NatMutex) locks and their ranks — condition-variable partners
+// must stay std::mutex (std::condition_variable demands it), and the shm
+// lifetime fence is a cross-process robust pthread mutex:
+//
+//   15  shm.fence    ShmWorkerHdr::fence   (nat_shm_lane.cpp)
+//   57  server.py    NatServer::py_mu      (nat_internal.h)
+//   86  timer.run    TimerThread::run_mu_  (timer_thread.h)
+//   90  butex        Butex::mu             (scheduler.h)
+//   94  sched.park   Worker::park_mu       (scheduler.h)
+#pragma once
+
+#include <mutex>
+
+namespace brpc_tpu {
+
+enum : int {
+  kLockRankShmProbe = 10,     // g_probe_mu: fence probing, outermost
+  // 15: shm.fence (raw robust pthread mutex, see header comment)
+  kLockRankShmReq = 20,       // g_req_mu[i]: per-worker request producer
+  kLockRankShmResp = 22,      // g_resp_mu: worker-side response producer
+  kLockRankRuntime = 30,      // g_rt_mu: runtime/server registry
+  kLockRankListen = 34,       // Dispatcher::listen_mu
+  kLockRankReconnect = 36,    // NatChannel::reconnect_mu
+  kLockRankHttpSess = 40,     // HttpSessionN::http_mu
+  kLockRankH2Sess = 42,       // H2SessionN::h2_mu
+  kLockRankRedisSess = 44,    // RedisSessN::redis_mu
+  kLockRankRedisStore = 46,   // RedisStoreN::store_mu
+  kLockRankHttpCli = 50,      // HttpCliSessN::httpc_mu
+  kLockRankH2Cli = 52,        // H2CliSessN::h2c_mu
+  kLockRankSslSess = 54,      // SslSessionN::ssl_mu (sessions write
+                              // through the TLS session: session < ssl)
+  kLockRankChanGrow = 56,     // NatChannel::grow_mu_
+  // 57: server.py (raw, cv partner)
+  kLockRankShmInflight = 58,  // g_inflight_mu: reaper table
+  kLockRankSockAlloc = 60,    // g_sock_alloc_mu: registry slab/freelist
+  kLockRankSockWrite = 62,    // NatSocket::write_mu
+  kLockRankRingRetry = 64,    // g_ring_retry_mu
+  kLockRankRingFiles = 66,    // RingListener::files_mu_
+  kLockRankRingSq = 68,       // RingListener::sq_mu_
+  kLockRankRingSend = 70,     // RingListener::send_mu_ (the SQ-full
+                              // failure path returns its send buffer
+                              // while still holding sq_mu_)
+  kLockRankRingComp = 72,     // RingListener::comp_mu_
+  kLockRankRingBuf = 74,      // RingListener::buf_mu_
+  kLockRankStatsSpan = 76,    // g_span_drain_mu: span-ring drain (its
+                              // dropped-span accounting can enter the
+                              // cell registry: span < cell)
+  kLockRankStatsCell = 78,    // g_cell_mu: stat-cell registry
+  kLockRankTimerStart = 80,   // TimerThread::start_mu_
+  kLockRankTimerBucket = 82,  // TimerThread::Bucket::bucket_mu
+  kLockRankTimerCancel = 84,  // TimerThread::cancel_mu_
+  // 86: timer.run (raw, cv partner)
+  kLockRankSchedHooks = 88,   // Scheduler::hooks_mu_
+  // 90: butex (raw, cv partner)
+  kLockRankSchedRemote = 92,  // Worker::remote_mu
+  // 94: sched.park (raw, cv partner)
+  kLockRankStackPool = 96,    // g_stack_pool_mu, innermost
+};
+
+#if defined(NAT_LOCKRANK)
+namespace lockrank {
+// Blocking acquisition about to happen: assert rank > deepest held,
+// then push. Called BEFORE the underlying lock so an actual inversion
+// aborts with a report instead of deadlocking silently.
+void note_acquire(int rank);
+// Successful try_lock: push without the order assert (non-blocking
+// acquisitions cannot deadlock; brpc's try_lock-out-of-rank idiom).
+void note_acquired(int rank);
+void note_release(int rank);
+// Fiber-switch hook (scheduler.cpp): no NatMutex may be held across a
+// context switch — the fiber can resume on another thread while this
+// thread's TLS still claims the rank.
+void assert_none_held(const char* where);
+}  // namespace lockrank
+#endif
+
+// Drop-in std::mutex wrapper carrying its declared rank. Zero overhead
+// unless NAT_LOCKRANK is defined. Use with CTAD guards:
+//   NatMutex<kLockRankSockWrite> write_mu;
+//   std::lock_guard g(write_mu);
+template <int Rank>
+class NatMutex {
+ public:
+  static constexpr int kRank = Rank;
+
+  void lock() {
+#if defined(NAT_LOCKRANK)
+    lockrank::note_acquire(Rank);
+#endif
+    m_.lock();
+  }
+
+  bool try_lock() {
+    if (!m_.try_lock()) return false;
+#if defined(NAT_LOCKRANK)
+    lockrank::note_acquired(Rank);
+#endif
+    return true;
+  }
+
+  void unlock() {
+    m_.unlock();
+#if defined(NAT_LOCKRANK)
+    lockrank::note_release(Rank);
+#endif
+  }
+
+ private:
+  // natcheck:allow(lock-undeclared): NatMutex's own backing mutex — the
+  // rank lives in the template parameter of each declaration site
+  std::mutex m_;
+};
+
+}  // namespace brpc_tpu

@@ -637,7 +637,7 @@ bool drain_socket_inline(NatSocket* s) {
     acc.clear();
   }
   if (!acc.empty() && !dead) {
-    std::lock_guard<std::mutex> g(s->write_mu);
+    std::lock_guard g(s->write_mu);
     if (!s->failed.load(std::memory_order_acquire)) {
       s->write_q.append(std::move(acc));
       queued = true;

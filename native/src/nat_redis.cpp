@@ -24,7 +24,7 @@ struct RedisSessN {
   // buffer every read burst while a big bulk value trickles in
   // (reading thread only).
   size_t need_bytes = 0;
-  std::mutex mu;  // guards everything below (py pthreads + reading thread)
+  NatMutex<kLockRankRedisSess> redis_mu;  // guards everything below (py pthreads + reading thread)
   uint64_t next_resp_seq = 1;
   std::map<uint64_t, std::string> parked;
   // The reading thread is mid-round with possibly-unflushed replies in
@@ -46,7 +46,7 @@ static void redis_arm_close(NatSocket* s) {
   s->close_after_drain.store(true, std::memory_order_release);
   bool empty;
   {
-    std::lock_guard<std::mutex> g(s->write_mu);
+    std::lock_guard g(s->write_mu);
     empty = s->write_q.empty() && !s->ring_sending && !s->writing;
   }
   if (empty) s->set_failed();
@@ -55,7 +55,7 @@ static void redis_arm_close(NatSocket* s) {
 void redis_session_free(RedisSessN* h) { delete h; }
 
 struct RedisStoreN {
-  std::mutex mu;
+  NatMutex<kLockRankRedisStore> store_mu;
   std::unordered_map<std::string, std::string> kv;
 };
 
@@ -90,7 +90,7 @@ static void r_nil(std::string* out) { out->append("$-1\r\n"); }
 
 // -- ordered emission -------------------------------------------------------
 
-// Drain in-order parked replies. Requires h->mu. Appends to out;
+// Drain in-order parked replies. Requires h->redis_mu. Appends to out;
 // *want_close set when the QUIT reply drained.
 static void redis_drain_locked(RedisSessN* h, std::string* out,
                                bool* want_close) {
@@ -115,7 +115,7 @@ static void redis_emit(NatSocket* s, RedisSessN* h, uint64_t seq,
   std::string out;
   bool want_close = false;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->redis_mu);
     h->parked[seq] = std::move(reply);
     if (batch_out == nullptr && h->round_active) {
       // the reading thread holds unflushed earlier replies in its round
@@ -187,7 +187,7 @@ static bool store_execute(RedisStoreN* st,
     // plain SET k v only; SET with options (EX/NX/...) goes to py
     if (nargs != 2) return false;
     {
-      std::lock_guard<std::mutex> g(st->mu);
+      std::lock_guard g(st->store_mu);
       st->kv[argv[1]] = argv[2];
     }
     r_status(out, "OK");
@@ -198,7 +198,7 @@ static bool store_execute(RedisStoreN* st,
       r_error(out, "ERR wrong number of arguments for 'get' command");
       return true;
     }
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     auto it = st->kv.find(argv[1]);
     if (it == st->kv.end()) {
       r_nil(out);
@@ -209,14 +209,14 @@ static bool store_execute(RedisStoreN* st,
   }
   if (ieq(cmd, "del") || ieq(cmd, "unlink")) {
     int64_t n = 0;
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     for (size_t i = 1; i < argv.size(); i++) n += st->kv.erase(argv[i]);
     r_int(out, n);
     return true;
   }
   if (ieq(cmd, "exists")) {
     int64_t n = 0;
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     for (size_t i = 1; i < argv.size(); i++) {
       n += st->kv.count(argv[i]) ? 1 : 0;
     }
@@ -242,7 +242,7 @@ static bool store_execute(RedisStoreN* st,
       return true;
     }
     if (ieq(cmd, "decr") || ieq(cmd, "decrby")) delta = -delta;
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     std::string& v = st->kv[argv[1]];
     char* endp = nullptr;
     int64_t cur = v.empty() ? 0 : strtoll(v.c_str(), &endp, 10);
@@ -262,7 +262,7 @@ static bool store_execute(RedisStoreN* st,
       r_error(out, "ERR wrong number of arguments");
       return true;
     }
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     std::string& v = st->kv[argv[1]];
     v += argv[2];
     r_int(out, (int64_t)v.size());
@@ -273,7 +273,7 @@ static bool store_execute(RedisStoreN* st,
       r_error(out, "ERR wrong number of arguments");
       return true;
     }
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     auto it = st->kv.find(argv[1]);
     r_int(out, it == st->kv.end() ? 0 : (int64_t)it->second.size());
     return true;
@@ -283,7 +283,7 @@ static bool store_execute(RedisStoreN* st,
       r_error(out, "ERR wrong number of arguments for 'mset' command");
       return true;
     }
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     for (size_t i = 1; i + 1 < argv.size(); i += 2) {
       st->kv[argv[i]] = argv[i + 1];
     }
@@ -294,7 +294,7 @@ static bool store_execute(RedisStoreN* st,
     char buf[32];
     snprintf(buf, sizeof(buf), "*%zu\r\n", nargs);
     out->append(buf);
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     for (size_t i = 1; i < argv.size(); i++) {
       auto it = st->kv.find(argv[i]);
       if (it == st->kv.end()) {
@@ -306,12 +306,12 @@ static bool store_execute(RedisStoreN* st,
     return true;
   }
   if (ieq(cmd, "dbsize")) {
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     r_int(out, (int64_t)st->kv.size());
     return true;
   }
   if (ieq(cmd, "flushdb") || ieq(cmd, "flushall")) {
-    std::lock_guard<std::mutex> g(st->mu);
+    std::lock_guard g(st->store_mu);
     st->kv.clear();
     r_status(out, "OK");
     return true;
@@ -341,7 +341,7 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
   }
   RedisSessN* h = s->redis;
   {
-    std::lock_guard<std::mutex> g(h->mu);
+    std::lock_guard g(h->redis_mu);
     h->round_active = true;
   }
   int rc = 1;
@@ -439,7 +439,7 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
     // QUIT: +OK, then close once that reply has drained to the socket
     if (ieq(argv[0], "quit")) {
       {
-        std::lock_guard<std::mutex> g(h->mu);
+        std::lock_guard g(h->redis_mu);
         h->close_after_seq = seq;
       }
       std::string ok;
@@ -507,7 +507,7 @@ void redis_round_end(NatSocket* s) {
   if (h == nullptr) return;
   std::string out;
   bool want_close = false;
-  std::lock_guard<std::mutex> g(h->mu);
+  std::lock_guard g(h->redis_mu);
   redis_drain_locked(h, &out, &want_close);
   want_close = want_close || h->close_pending;
   h->close_pending = false;
@@ -515,7 +515,7 @@ void redis_round_end(NatSocket* s) {
   if (!out.empty()) {
     IOBuf f;
     f.append(out.data(), out.size());
-    s->write(std::move(f));  // under h->mu: ordered vs py emitters
+    s->write(std::move(f));  // under h->redis_mu: ordered vs py emitters
   }
   if (want_close) redis_arm_close(s);
 }

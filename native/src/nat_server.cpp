@@ -55,7 +55,7 @@ void Dispatcher::add_consumer(NatSocket* s) {
 
 void Dispatcher::add_listener(int fd, NatServer* srv) {
   {
-    std::lock_guard<std::mutex> g(listen_mu);
+    std::lock_guard g(listen_mu);
     listeners[fd] = srv;
   }
   struct epoll_event ev;
@@ -109,7 +109,7 @@ void Dispatcher::run() {
         int lfd = (int)data;
         NatServer* srv;
         {
-          std::lock_guard<std::mutex> g(listen_mu);
+          std::lock_guard g(listen_mu);
           auto it = listeners.find(lfd);
           srv = (it == listeners.end()) ? nullptr : it->second;
           // ref taken UNDER the lock: a racing server_stop erases the
@@ -142,7 +142,7 @@ void Dispatcher::run() {
     for (NatSocket* s : flush_list) {
       bool become_writer = false;
       {
-        std::lock_guard<std::mutex> g(s->write_mu);
+        std::lock_guard g(s->write_mu);
         if (!s->write_q.empty() && !s->writing &&
             !s->failed.load(std::memory_order_acquire)) {
           s->writing = true;
@@ -174,7 +174,7 @@ void Dispatcher::run() {
 std::vector<Dispatcher*>& g_disps = *new std::vector<Dispatcher*>();
 Dispatcher* g_disp = nullptr;  // g_disps[0]: listeners + console
 NatServer* g_rpc_server = nullptr;
-std::mutex g_rt_mu;
+NatMutex<kLockRankRuntime> g_rt_mu;
 static std::atomic<uint32_t> g_disp_rr{0};
 static int g_disp_count = 0;  // 0 = auto (set before first runtime use)
 
@@ -185,7 +185,7 @@ Dispatcher* pick_dispatcher() {
 }
 
 int ensure_runtime(int nworkers) {
-  std::lock_guard<std::mutex> g(g_rt_mu);
+  std::lock_guard g(g_rt_mu);
   if (!Scheduler::instance()->started()) {
     if (nworkers <= 0) {
       unsigned hw = std::thread::hardware_concurrency();
@@ -221,7 +221,7 @@ extern "C" {
 // runtime starts (0 = auto from hardware_concurrency). Returns the count
 // in effect.
 int nat_rpc_set_dispatchers(int n) {
-  std::lock_guard<std::mutex> g(g_rt_mu);
+  std::lock_guard g(g_rt_mu);
   if (g_disps.empty() && n >= 0) g_disp_count = n;
   return g_disps.empty() ? g_disp_count : (int)g_disps.size();
 }
@@ -230,10 +230,10 @@ int nat_rpc_set_dispatchers(int n) {
 // py-lane queue at snapshot time. Called only from the stats C API with
 // no runtime locks held.
 static uint64_t py_queue_depth_gauge() {
-  std::lock_guard<std::mutex> g(g_rt_mu);
+  std::lock_guard g(g_rt_mu);
   NatServer* srv = g_rpc_server;
   if (srv == nullptr) return 0;
-  std::lock_guard<std::mutex> g2(srv->py_mu);
+  std::lock_guard g2(srv->py_mu);
   return (uint64_t)srv->py_q.size();
 }
 
@@ -243,7 +243,7 @@ static uint64_t py_queue_depth_gauge() {
 int nat_rpc_server_start(const char* ip, int port, int nworkers,
                          int enable_native_echo) {
   {
-    std::lock_guard<std::mutex> g(g_rt_mu);
+    std::lock_guard g(g_rt_mu);
     if (g_rpc_server != nullptr) return -1;
   }
   if (ensure_runtime(nworkers) != 0) return -1;
@@ -293,7 +293,7 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
     // publish AND register the listener in ONE critical section: a
     // concurrent stop can then never observe the published server while
     // missing its listener registration (ADVICE r3 #2)
-    std::lock_guard<std::mutex> g(g_rt_mu);
+    std::lock_guard g(g_rt_mu);
     if (g_rpc_server != nullptr) {  // lost a concurrent-start race
       ::close(fd);
       srv->release();
@@ -308,7 +308,7 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
 void nat_rpc_server_stop() {
   NatServer* srv;
   {
-    std::lock_guard<std::mutex> g(g_rt_mu);
+    std::lock_guard g(g_rt_mu);
     srv = g_rpc_server;
     if (srv == nullptr) return;
     g_rpc_server = nullptr;
@@ -316,13 +316,13 @@ void nat_rpc_server_stop() {
     // (the start path registers under g_rt_mu too, so no listener of a
     // published server can be missed here)
     epoll_ctl(g_disp->epfd, EPOLL_CTL_DEL, srv->listen_fd, nullptr);
-    std::lock_guard<std::mutex> lg(g_disp->listen_mu);
+    std::lock_guard lg(g_disp->listen_mu);
     g_disp->listeners.erase(srv->listen_fd);
   }
   ::close(srv->listen_fd);
   // stop the python lane (wakes all waiters empty-handed)
   {
-    std::lock_guard<std::mutex> g(srv->py_mu);
+    std::lock_guard g(srv->py_mu);
     srv->py_stopping = true;
   }
   srv->py_cv.notify_all();
@@ -330,7 +330,7 @@ void nat_rpc_server_stop() {
   // by the high-water mark) and take a safe reference before failing
   uint32_t hwm;
   {
-    std::lock_guard<std::mutex> g(g_sock_alloc_mu);
+    std::lock_guard g(g_sock_alloc_mu);
     hwm = g_sock_next_idx;
   }
   for (uint32_t idx = 0; idx < hwm; idx++) {
@@ -344,7 +344,7 @@ void nat_rpc_server_stop() {
   }
   // drain queued python-lane requests under the lane lock
   {
-    std::lock_guard<std::mutex> g(srv->py_mu);
+    std::lock_guard g(srv->py_mu);
     for (PyRequest* r : srv->py_q) delete r;
     srv->py_q.clear();
   }
@@ -357,7 +357,7 @@ void nat_rpc_server_stop() {
 // stack as ordered raw chunks instead of failing the socket. Call right
 // after nat_rpc_server_start, before clients connect.
 int nat_rpc_server_enable_raw_fallback(int enable) {
-  std::lock_guard<std::mutex> g(g_rt_mu);
+  std::lock_guard g(g_rt_mu);
   NatServer* srv = g_rpc_server;
   if (srv == nullptr) return -1;
   srv->raw_fallback = (enable != 0);
@@ -369,7 +369,7 @@ int nat_rpc_server_enable_raw_fallback(int enable) {
 // to the py lane as kind-3/kind-4 requests (parse native, execute Python)
 // instead of riding the raw chunk lane. Call right after start.
 int nat_rpc_server_native_http(int enable) {
-  std::lock_guard<std::mutex> g(g_rt_mu);
+  std::lock_guard g(g_rt_mu);
   NatServer* srv = g_rpc_server;
   if (srv == nullptr) return -1;
   srv->native_http = (enable != 0);
@@ -382,7 +382,7 @@ int nat_rpc_server_native_http(int enable) {
 // GET/SET command family against a native in-memory store (unknown
 // commands still reach the Python handlers). Call right after start.
 int nat_rpc_server_redis(int mode) {
-  std::lock_guard<std::mutex> g(g_rt_mu);
+  std::lock_guard g(g_rt_mu);
   NatServer* srv = g_rpc_server;
   if (srv == nullptr) return -1;
   srv->native_redis = mode;
@@ -395,14 +395,14 @@ int nat_rpc_server_redis(int mode) {
 int32_t nat_req_kind(void* h) { return ((PyRequest*)h)->kind; }
 
 uint64_t nat_rpc_server_requests() {
-  std::lock_guard<std::mutex> g(g_rt_mu);
+  std::lock_guard g(g_rt_mu);
   return g_rpc_server
              ? g_rpc_server->requests.load(std::memory_order_relaxed)
              : 0;
 }
 
 uint64_t nat_rpc_server_connections() {
-  std::lock_guard<std::mutex> g(g_rt_mu);
+  std::lock_guard g(g_rt_mu);
   return g_rpc_server
              ? g_rpc_server->connections.load(std::memory_order_relaxed)
              : 0;
@@ -413,7 +413,7 @@ uint64_t nat_rpc_server_connections() {
 void* nat_take_request(int timeout_ms) {
   NatServer* srv;
   {
-    std::lock_guard<std::mutex> g(g_rt_mu);
+    std::lock_guard g(g_rt_mu);
     srv = g_rpc_server;
     if (srv == nullptr) return nullptr;
     srv->add_ref();  // keeps the server alive across the blocking wait
@@ -427,7 +427,7 @@ void* nat_take_request(int timeout_ms) {
 int nat_take_request_batch(void** out, int max, int timeout_ms) {
   NatServer* srv;
   {
-    std::lock_guard<std::mutex> g(g_rt_mu);
+    std::lock_guard g(g_rt_mu);
     srv = g_rpc_server;
     if (srv == nullptr) return 0;
     srv->add_ref();
@@ -527,7 +527,7 @@ int nat_rpc_use_io_uring(int enable) {
   }
   if (ensure_runtime(0) != 0) return -1;
   {
-    std::lock_guard<std::mutex> g(g_rt_mu);
+    std::lock_guard g(g_rt_mu);
     if (g_ring == nullptr) {
       RingListener* ring = new RingListener();
       // wake a parked worker per completion batch (ExtWakeup role);
@@ -546,6 +546,9 @@ int nat_rpc_use_io_uring(int enable) {
         Scheduler::instance()->flush_wake_batch();
         return did;
       });
+      // natcheck:allow(lock-switch): one-time ring bring-up under the
+      // runtime lock (cold path, caller thread); init's failure path
+      // joins a poller that never touches g_rt_mu
       if (!ring->init()) {
         delete ring;
         return 0;  // io_uring unavailable here: keep epoll

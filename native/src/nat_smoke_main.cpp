@@ -203,6 +203,43 @@ int main() {
                                             &redis_reqs);
   CHECK(redis_qps > 0 && redis_reqs > 0, "redis bench lane");
 
+  // ---- soak extension (NAT_SOAK=1, tools/check.sh --soak): the h2/gRPC
+  // client+server lane in pure C, so the TSan soak covers it without a
+  // Python TLS client. (The ssl lane needs a TLS client and rides the
+  // ASan python matrix instead — see native/SOAK.md.) ----
+  if (getenv("NAT_SOAK") != nullptr) {
+    void* gch = nat_channel_open_proto("127.0.0.1", port, 0, 0, 0, 0, 2,
+                                       nullptr);
+    CHECK(gch != nullptr, "grpc channel open");
+    if (gch != nullptr) {
+      for (int i = 0; i < 25; i++) {
+        int gst = -1;
+        char* resp = nullptr;
+        size_t rlen = 0;
+        char* err = nullptr;
+        int rc = nat_grpc_call(gch, "/EchoService/Echo", "grpc-soak", 9,
+                               2000, &gst, &resp, &rlen, &err);
+        CHECK(rc == 0 && gst == 0, "grpc call");
+        CHECK(rlen == 9 && resp != nullptr &&
+                  memcmp(resp, "grpc-soak", 9) == 0,
+              "grpc echo payload");
+        if (resp != nullptr) nat_buf_free(resp);
+        if (err != nullptr) nat_buf_free(err);
+      }
+      nat_channel_close(gch);
+    }
+    uint64_t greqs = 0;
+    double gqps = nat_grpc_client_bench("127.0.0.1", port, 2, 16, 0.3,
+                                        "/EchoService/Echo", "grpc-soak",
+                                        9, &greqs);
+    CHECK(gqps > 0 && greqs > 0, "grpc bench lane");
+    uint64_t hreqs = 0;
+    double hqps = nat_http_client_bench("127.0.0.1", port, 2, 8, 0.3,
+                                        "/echo", "soak-body", 9, nullptr,
+                                        &hreqs);
+    CHECK(hqps > 0 && hreqs > 0, "http pipelined bench lane");
+  }
+
   // ---- stats surface: counters, histograms, spans ----
   int nc = nat_stats_counter_count();
   CHECK(nc > 0, "counter count");

@@ -15,7 +15,7 @@ namespace brpc_tpu {
 // ---------------------------------------------------------------------------
 
 std::atomic<std::atomic<NatSocket*>*> g_sock_slab[kSockSlabs];
-std::mutex g_sock_alloc_mu;
+NatMutex<kLockRankSockAlloc> g_sock_alloc_mu;
 // Leaked on purpose: fibers on detached workers allocate/release socket
 // slots through exit(); a destructed free list here is a use-after-free.
 std::vector<uint32_t>& g_sock_free = *new std::vector<uint32_t>();
@@ -28,7 +28,7 @@ NatSocket* sock_create() {
   uint32_t idx;
   NatSocket* s = nullptr;
   {
-    std::lock_guard<std::mutex> g(g_sock_alloc_mu);
+    std::lock_guard g(g_sock_alloc_mu);
     if (!g_sock_free.empty()) {
       idx = g_sock_free.back();
       g_sock_free.pop_back();
@@ -102,7 +102,7 @@ void sock_unregister(NatSocket* s) {
 RingListener* g_ring = nullptr;
 std::atomic<bool> g_use_ring{false};
 std::atomic<bool> g_ring_draining{false};
-static std::mutex g_ring_retry_mu;
+static NatMutex<kLockRankRingRetry> g_ring_retry_mu;
 // sockets w/ unsubmitted sends; leaked — the ring poller and workers may
 // still push retries while exit() destroys statics
 static std::vector<uint64_t>& g_ring_retry = *new std::vector<uint64_t>();
@@ -158,11 +158,11 @@ void NatSocket::release() {
     }
     in_buf.clear();
     {
-      std::lock_guard<std::mutex> g(write_mu);
+      std::lock_guard g(write_mu);
       write_q.clear();
     }
     uint32_t idx = (uint32_t)(id & 0xffffffffu);
-    std::lock_guard<std::mutex> g(g_sock_alloc_mu);
+    std::lock_guard g(g_sock_alloc_mu);
     g_sock_free.push_back(idx);
   }
 }
@@ -206,7 +206,7 @@ void NatSocket::set_failed() {
     }
   }
   {
-    std::lock_guard<std::mutex> g(write_mu);
+    std::lock_guard g(write_mu);
     write_q.clear();
     writing = false;
     ring_sending = false;
@@ -246,8 +246,28 @@ void NatSocket::set_failed() {
     } else {
       // already detached (GOAWAY drain): the channel's other pendings
       // ride the replacement socket and must survive — fail only the
-      // streams this socket still owns
-      h2c_fail_own_streams(this, kEFAILEDSOCKET, "socket failed");
+      // streams this socket still owns. DEFERRED to a fiber: set_failed
+      // can fire on a thread already inside h2c_mu (the reading thread's
+      // window flush writing on a dying socket), and the sweep locks
+      // h2c_mu — sweeping inline would self-deadlock (found by
+      // tools/natcheck lockorder). With the scheduler stopped no such
+      // thread exists (no fibers, no dispatchers feeding this socket),
+      // so the inline sweep is both safe and the only way the pendings
+      // still complete.
+      if (Scheduler::instance()->started()) {
+        add_ref();  // released by the sweep fiber
+        // natcheck:allow(lock-switch): runs on a fresh fiber stack
+        Scheduler::instance()->spawn_detached(
+            [](void* raw) {
+              NatSocket* s = (NatSocket*)raw;
+              h2c_fail_own_streams(s, kEFAILEDSOCKET, "socket failed");
+              s->release();
+            },
+            this);
+      } else {
+        h2c_fail_own_streams_teardown(this, kEFAILEDSOCKET,
+                                      "socket failed");
+      }
     }
   }
   if (server != nullptr) server->connections.fetch_sub(1, std::memory_order_relaxed);
@@ -256,7 +276,7 @@ void NatSocket::set_failed() {
 }
 
 void NatSocket::arm_epollout() {
-  std::lock_guard<std::mutex> g(write_mu);
+  std::lock_guard g(write_mu);
   if (failed.load(std::memory_order_acquire)) return;
   uint32_t want = EPOLLIN | EPOLLET | EPOLLOUT;
   if (epoll_events == want) return;
@@ -267,7 +287,7 @@ void NatSocket::arm_epollout() {
 }
 
 void NatSocket::disarm_epollout() {
-  std::lock_guard<std::mutex> g(write_mu);
+  std::lock_guard g(write_mu);
   if (failed.load(std::memory_order_acquire)) return;
   uint32_t want = EPOLLIN | EPOLLET;
   if (epoll_events == want) return;
@@ -281,7 +301,7 @@ bool NatSocket::flush_some() {
   while (true) {
     IOBuf batch;
     {
-      std::lock_guard<std::mutex> g(write_mu);
+      std::lock_guard g(write_mu);
       if (write_q.empty()) {
         writing = false;
         if (close_after_drain.load(std::memory_order_acquire) &&
@@ -301,7 +321,7 @@ bool NatSocket::flush_some() {
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
           // put leftovers back at the FRONT (later writes are behind us)
-          std::lock_guard<std::mutex> g(write_mu);
+          std::lock_guard g(write_mu);
           batch.append(std::move(write_q));
           write_q = std::move(batch);
           return false;
@@ -355,7 +375,7 @@ static bool ring_submit_locked(NatSocket* s) {
 }
 
 static void ring_retry_later(uint64_t sock_id) {
-  std::lock_guard<std::mutex> g(g_ring_retry_mu);
+  std::lock_guard g(g_ring_retry_mu);
   g_ring_retry.push_back(sock_id);
 }
 
@@ -375,7 +395,7 @@ int NatSocket::write_raw(IOBuf&& frame) {
     // is kept by the single-in-flight discipline.
     bool need_retry;
     {
-      std::lock_guard<std::mutex> g(write_mu);
+      std::lock_guard g(write_mu);
       if (failed.load(std::memory_order_acquire)) return -1;
       write_q.append(std::move(frame));
       need_retry = !ring_submit_locked(this);
@@ -385,7 +405,7 @@ int NatSocket::write_raw(IOBuf&& frame) {
   }
   bool become_writer = false;
   {
-    std::lock_guard<std::mutex> g(write_mu);
+    std::lock_guard g(write_mu);
     if (failed.load(std::memory_order_acquire)) return -1;
     write_q.append(std::move(frame));
     if (!writing) {
@@ -420,7 +440,7 @@ int NatSocket::write_raw(IOBuf&& frame) {
 void kick_epoll_writer_if_stranded(NatSocket* s) {
   bool kick = false;
   {
-    std::lock_guard<std::mutex> g(s->write_mu);
+    std::lock_guard g(s->write_mu);
     if (s->ring_ref.load(std::memory_order_acquire) < 0 &&
         !s->write_q.empty() && !s->writing && !s->ring_sending &&
         !s->failed.load(std::memory_order_acquire)) {
@@ -529,7 +549,7 @@ bool ring_drain() {
           bool need_retry;
           bool drained_close = false;
           {
-            std::lock_guard<std::mutex> g(s->write_mu);
+            std::lock_guard g(s->write_mu);
             size_t done = (size_t)c.res;
             if (done > s->ring_inflight) done = s->ring_inflight;
             nat_counter_add(NS_SOCK_WRITE_BYTES, done);
@@ -557,7 +577,7 @@ bool ring_drain() {
   // retry sends that couldn't get a buffer/SQE earlier
   std::vector<uint64_t> retry;
   {
-    std::lock_guard<std::mutex> g(g_ring_retry_mu);
+    std::lock_guard g(g_ring_retry_mu);
     retry.swap(g_ring_retry);
   }
   for (uint64_t sid : retry) {
@@ -565,7 +585,7 @@ bool ring_drain() {
     if (s == nullptr) continue;
     bool again;
     {
-      std::lock_guard<std::mutex> g(s->write_mu);
+      std::lock_guard g(s->write_mu);
       again = !ring_submit_locked(s);
     }
     if (again) ring_retry_later(sid);

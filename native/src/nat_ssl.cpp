@@ -111,7 +111,7 @@ static Lib& lib() {
 // ---------------------------------------------------------------------------
 
 struct SslSessionN {
-  std::mutex mu;  // feed (reading thread) vs SSL_write (any responder)
+  NatMutex<kLockRankSslSess> ssl_mu;  // feed (reading thread) vs SSL_write (any responder)
   ossl::SSL* ssl = nullptr;
   ossl::BIO* rbio = nullptr;  // ciphertext in (we write, SSL reads)
   ossl::BIO* wbio = nullptr;  // ciphertext out (SSL writes, we drain)
@@ -127,7 +127,7 @@ struct SslSessionN {
 
 void ssl_session_free(SslSessionN* s) { delete s; }
 
-// Requires sess->mu. Drains handshake/record output into *out.
+// Requires sess->ssl_mu. Drains handshake/record output into *out.
 static void ssl_drain_wbio_locked(SslSessionN* sess, IOBuf* out) {
   ossl::Lib& l = ossl::lib();
   char buf[16384];
@@ -138,7 +138,7 @@ static void ssl_drain_wbio_locked(SslSessionN* sess, IOBuf* out) {
   }
 }
 
-// Requires sess->mu. Encrypts `plain` (fully — memory BIOs always accept)
+// Requires sess->ssl_mu. Encrypts `plain` (fully — memory BIOs always accept)
 // into *cipher_out. Returns false on TLS failure.
 static bool ssl_encrypt_locked(NatSocket* s, SslSessionN* sess,
                                IOBuf&& plain, IOBuf* cipher_out) {
@@ -174,7 +174,7 @@ bool ssl_feed(NatSocket* s, const char* data, size_t n) {
   ossl::Lib& l = ossl::lib();
   IOBuf out;
   {
-    std::lock_guard<std::mutex> g(sess->mu);
+    std::lock_guard g(sess->ssl_mu);
     if (sess->failed) return false;
     size_t off = 0;
     while (off < n) {
@@ -211,9 +211,9 @@ bool ssl_feed(NatSocket* s, const char* data, size_t n) {
         return false;
       }
     }
-    // queue while still holding sess->mu: record order on the wire must
+    // queue while still holding sess->ssl_mu: record order on the wire must
     // match production order even against concurrent encrypt_and_write
-    // callers (lock order sess->mu -> write_mu, never inverted)
+    // callers (lock order sess->ssl_mu -> write_mu, never inverted)
     if (!out.empty()) s->write_raw(std::move(out));
   }
   return true;
@@ -222,7 +222,7 @@ bool ssl_feed(NatSocket* s, const char* data, size_t n) {
 // Public encrypt entry for the write path (takes the session lock).
 bool ssl_encrypt(NatSocket* s, IOBuf&& plain, IOBuf* cipher_out) {
   SslSessionN* sess = s->ssl_sess;
-  std::lock_guard<std::mutex> g(sess->mu);
+  std::lock_guard g(sess->ssl_mu);
   if (sess->failed) return false;
   return ssl_encrypt_locked(s, sess, std::move(plain), cipher_out);
 }
@@ -230,11 +230,11 @@ bool ssl_encrypt(NatSocket* s, IOBuf&& plain, IOBuf* cipher_out) {
 // Encrypt AND queue under ONE session lock: record order on the wire
 // must match encryption order, and two concurrent writers that encrypt
 // A-then-B but queue B-then-A would corrupt the record stream (the peer
-// MACs records sequentially). Lock order sess->mu -> write_mu; nothing
+// MACs records sequentially). Lock order sess->ssl_mu -> write_mu; nothing
 // takes them inversely.
 int ssl_encrypt_and_write(NatSocket* s, IOBuf&& plain) {
   SslSessionN* sess = s->ssl_sess;
-  std::lock_guard<std::mutex> g(sess->mu);
+  std::lock_guard g(sess->ssl_mu);
   if (sess->failed) return -1;
   IOBuf cipher;
   if (!ssl_encrypt_locked(s, sess, std::move(plain), &cipher)) return -1;
@@ -295,7 +295,7 @@ extern "C" {
 int nat_rpc_server_ssl(const char* cert_path, const char* key_path) {
   ossl::Lib& l = ossl::lib();
   if (!l.ok) return -2;
-  std::lock_guard<std::mutex> g(g_rt_mu);
+  std::lock_guard g(g_rt_mu);
   NatServer* srv = g_rpc_server;
   if (srv == nullptr) return -1;
   ossl::SSL_CTX* ctx = l.ctx_new(l.tls_server_method());

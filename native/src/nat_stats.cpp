@@ -6,6 +6,7 @@
 #include "nat_stats.h"
 
 #include <mutex>
+#include "nat_lockrank.h"
 
 namespace brpc_tpu {
 
@@ -18,7 +19,7 @@ namespace brpc_tpu {
 static constexpr int kMaxCells = 512;
 static std::atomic<NatStatCell*> g_cells[kMaxCells];
 static std::atomic<int> g_ncells{0};
-static std::mutex g_cell_mu;
+static NatMutex<kLockRankStatsCell> g_cell_mu;
 // overflow cell: thread #513+ shares one cell; the relaxed load+store
 // write discipline makes sharing lossy under contention, but 512
 // registered threads means the process has bigger problems
@@ -27,7 +28,7 @@ static NatStatCell g_overflow_cell;
 thread_local NatStatCell* tls_nat_cell = nullptr;
 
 NatStatCell* nat_cell_slow() {
-  std::lock_guard<std::mutex> g(g_cell_mu);
+  std::lock_guard g(g_cell_mu);
   int n = g_ncells.load(std::memory_order_relaxed);
   NatStatCell* c;
   if (n < kMaxCells) {
@@ -103,7 +104,7 @@ struct SpanSlot {
 };
 static SpanSlot g_span_ring[kNatSpanRing];
 static std::atomic<uint64_t> g_span_head{0};  // next ticket
-static std::mutex g_span_drain_mu;
+static NatMutex<kLockRankStatsSpan> g_span_drain_mu;
 static uint64_t g_span_next_read = 0;  // under g_span_drain_mu
 
 bool nat_span_tick() {
@@ -255,7 +256,7 @@ void nat_stats_enable_spans(int every) {
 // Returns the number copied. Records overwritten before this drain are
 // counted into nat_spans_dropped.
 int nat_stats_drain_spans(NatSpanRec* out, int max) {
-  std::lock_guard<std::mutex> g(g_span_drain_mu);
+  std::lock_guard g(g_span_drain_mu);
   uint64_t head = g_span_head.load(std::memory_order_acquire);
   if (head - g_span_next_read > kNatSpanRing) {
     uint64_t dropped = head - g_span_next_read - kNatSpanRing;
@@ -289,7 +290,7 @@ void nat_stats_reset() {
   // its dropped-span accounting can enter nat_cell_slow (g_cell_mu), so
   // nesting here would be an ABBA deadlock
   {
-    std::lock_guard<std::mutex> g(g_cell_mu);
+    std::lock_guard g(g_cell_mu);
     int n = g_ncells.load(std::memory_order_acquire);
     for (int i = 0; i <= n; i++) {
       NatStatCell* c = i < n ? g_cells[i].load(std::memory_order_acquire)
@@ -305,7 +306,7 @@ void nat_stats_reset() {
       }
     }
   }
-  std::lock_guard<std::mutex> g2(g_span_drain_mu);
+  std::lock_guard g2(g_span_drain_mu);
   g_span_next_read = g_span_head.load(std::memory_order_acquire);
 }
 

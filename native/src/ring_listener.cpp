@@ -168,7 +168,7 @@ void RingListener::shutdown() {
   stop_.store(true, std::memory_order_relaxed);
   // a NOP submission breaks the poller out of GETEVENTS
   {
-    std::lock_guard<std::mutex> g(sq_mu_);
+    std::lock_guard g(sq_mu_);
     struct io_uring_sqe* sqe = get_sqe_locked();
     if (sqe != nullptr) {
       memset(sqe, 0, sizeof(*sqe));
@@ -231,7 +231,7 @@ int RingListener::register_file(int fd, uint32_t* gen_out) {
   // files_mu_ is held across the kernel update AND gen read so a stale
   // rearm/send (which also takes files_mu_) can never interleave with
   // re-registration of a recycled slot.
-  std::lock_guard<std::mutex> g(files_mu_);
+  std::lock_guard g(files_mu_);
   int idx;
   if (!free_files_.empty()) {
     idx = free_files_.back();
@@ -254,7 +254,7 @@ int RingListener::register_file(int fd, uint32_t* gen_out) {
 }
 
 void RingListener::unregister_file(int file_index) {
-  std::lock_guard<std::mutex> g(files_mu_);
+  std::lock_guard g(files_mu_);
   int minus_one = -1;
   struct io_uring_files_update upd;
   memset(&upd, 0, sizeof(upd));
@@ -269,12 +269,12 @@ void RingListener::unregister_file(int file_index) {
 }
 
 bool RingListener::rearm_recv(int file_index, uint32_t gen, uint64_t tag) {
-  std::lock_guard<std::mutex> fg(files_mu_);
+  std::lock_guard fg(files_mu_);
   if ((size_t)file_index >= file_gen_.size() ||
       file_gen_[file_index] != gen) {
     return false;  // slot recycled under us: caller demotes
   }
-  std::lock_guard<std::mutex> g(sq_mu_);
+  std::lock_guard g(sq_mu_);
   struct io_uring_sqe* sqe = get_sqe_locked();
   if (sqe == nullptr) return false;
   memset(sqe, 0, sizeof(*sqe));
@@ -289,7 +289,7 @@ bool RingListener::rearm_recv(int file_index, uint32_t gen, uint64_t tag) {
 }
 
 char* RingListener::acquire_send_buffer(uint16_t* buf_out) {
-  std::lock_guard<std::mutex> g(send_mu_);
+  std::lock_guard g(send_mu_);
   if (send_free_.empty()) return nullptr;
   *buf_out = send_free_.back();
   send_free_.pop_back();
@@ -297,24 +297,24 @@ char* RingListener::acquire_send_buffer(uint16_t* buf_out) {
 }
 
 void RingListener::release_send_buffer(uint16_t buf) {
-  std::lock_guard<std::mutex> g(send_mu_);
+  std::lock_guard g(send_mu_);
   send_free_.push_back(buf);
 }
 
 bool RingListener::submit_send(int file_index, uint32_t gen, uint64_t tag,
                                uint16_t buf, size_t len) {
-  std::lock_guard<std::mutex> fg(files_mu_);
+  std::lock_guard fg(files_mu_);
   if ((size_t)file_index >= file_gen_.size() ||
       file_gen_[file_index] != gen) {
     release_send_buffer(buf);
     return false;  // slot recycled under us: caller demotes
   }
   {
-    std::lock_guard<std::mutex> g(send_mu_);
+    std::lock_guard g(send_mu_);
     send_tag_[buf] = tag;  // full 64-bit id rides the tag table
   }
   char* dst = send_base_ + (size_t)buf * kSendBufSize;
-  std::lock_guard<std::mutex> g(sq_mu_);
+  std::lock_guard g(sq_mu_);
   struct io_uring_sqe* sqe = get_sqe_locked();
   if (sqe == nullptr) {
     release_send_buffer(buf);
@@ -335,7 +335,7 @@ bool RingListener::submit_send(int file_index, uint32_t gen, uint64_t tag,
 }
 
 void RingListener::recycle_buffer(uint16_t buf_id) {
-  std::lock_guard<std::mutex> g(buf_mu_);
+  std::lock_guard g(buf_mu_);
   struct io_uring_buf* b = ring_entry(buf_ring_tail_ & buf_mask_);
   b->addr = (uint64_t)(uintptr_t)(buf_base_ + (size_t)buf_id * kBufSize);
   b->len = kBufSize;
@@ -345,7 +345,7 @@ void RingListener::recycle_buffer(uint16_t buf_id) {
 }
 
 void RingListener::recycle_send_buffer(uint16_t idx) {
-  std::lock_guard<std::mutex> g(send_mu_);
+  std::lock_guard g(send_mu_);
   send_free_.push_back(idx);
 }
 
@@ -353,7 +353,7 @@ void RingListener::poller_loop() {
   while (!stop_.load(std::memory_order_acquire)) {
     {
       // flush SQEs stranded by EAGAIN/EBUSY on the submit path
-      std::lock_guard<std::mutex> g(sq_mu_);
+      std::lock_guard g(sq_mu_);
       flush_unsubmitted_locked();
     }
     int rc = sys_enter(ring_fd_, 0, 1, IORING_ENTER_GETEVENTS);
@@ -378,7 +378,7 @@ void RingListener::poller_loop() {
       if (c.kind == (int)kKindSend) {
         c.send_buf = ud_aux(ud);
         {
-          std::lock_guard<std::mutex> g(send_mu_);
+          std::lock_guard g(send_mu_);
           c.tag = send_tag_[c.send_buf];
         }
         n_send_.fetch_add(1, std::memory_order_relaxed);
@@ -387,7 +387,7 @@ void RingListener::poller_loop() {
       }
       head++;
       if (c.kind <= 1) {
-        std::lock_guard<std::mutex> g(comp_mu_);
+        std::lock_guard g(comp_mu_);
         comp_q_.push_back(c);
         got = true;
       }

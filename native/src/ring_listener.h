@@ -31,6 +31,7 @@
 #include <mutex>
 #include <thread>
 #include <vector>
+#include "nat_lockrank.h"
 
 // uAPI compat: pre-5.19 build hosts lack the provided-buffer-ring ABI in
 // <linux/io_uring.h>. The values below are the kernel wire ABI (not host
@@ -158,7 +159,7 @@ class RingListener {
   // Pops one harvested completion; the scheduler idle hook loops this
   // (the wait_task drain, task_group.cpp:158-169).
   bool pop_completion(RingCompletion* out) {
-    std::lock_guard<std::mutex> g(comp_mu_);
+    std::lock_guard g(comp_mu_);
     if (comp_q_.empty()) return false;
     *out = comp_q_.front();
     comp_q_.pop_front();
@@ -209,22 +210,22 @@ class RingListener {
   char* buf_base_ = nullptr;  // kNumBufs * kBufSize payload arena
   unsigned buf_mask_ = 0;
   uint16_t buf_ring_tail_ = 0;
-  std::mutex buf_mu_;
+  NatMutex<kLockRankRingBuf> buf_mu_;
 
   // fixed send buffers (IORING_REGISTER_BUFFERS)
   char* send_base_ = nullptr;
   std::vector<uint16_t> send_free_;
   std::vector<uint64_t> send_tag_;  // buf index -> in-flight tag
-  std::mutex send_mu_;
+  NatMutex<kLockRankRingSend> send_mu_;
 
-  std::mutex sq_mu_;
-  std::mutex comp_mu_;
+  NatMutex<kLockRankRingSq> sq_mu_;
+  NatMutex<kLockRankRingComp> comp_mu_;
   std::deque<RingCompletion> comp_q_;
   std::thread poller_;
   std::atomic<bool> stop_{false};
   std::atomic<uint64_t> n_recv_{0};
   std::atomic<uint64_t> n_send_{0};
-  std::mutex files_mu_;
+  NatMutex<kLockRankRingFiles> files_mu_;
   unsigned next_file_ = 0;         // high-water mark
   std::vector<int> free_files_;    // recycled slots
   std::vector<uint32_t> file_gen_;  // slot generation (bumped on unregister)

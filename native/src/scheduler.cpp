@@ -87,14 +87,14 @@ static const size_t kStackSize = 256 * 1024;
 // (BENCH_r05 rc 139 — a ~vector here would free the pool under a worker
 // still reaping fibers), and trivially-destructible globals stay valid
 // for the whole process lifetime.
-static std::mutex g_stack_pool_mu;
+static NatMutex<kLockRankStackPool> g_stack_pool_mu;
 static const size_t kStackPoolCap = 256;
 static char* g_stack_pool[kStackPoolCap];
 static size_t g_stack_pool_n = 0;
 
 static char* alloc_stack(size_t size) {
   {
-    std::lock_guard<std::mutex> g(g_stack_pool_mu);
+    std::lock_guard g(g_stack_pool_mu);
     if (g_stack_pool_n > 0) {
       return g_stack_pool[--g_stack_pool_n];
     }
@@ -108,7 +108,7 @@ static char* alloc_stack(size_t size) {
 
 static void free_stack(char* stack, size_t size) {
   {
-    std::lock_guard<std::mutex> g(g_stack_pool_mu);
+    std::lock_guard g(g_stack_pool_mu);
     if (g_stack_pool_n < kStackPoolCap) {
       g_stack_pool[g_stack_pool_n++] = stack;
       return;
@@ -124,7 +124,7 @@ void Worker::signal() {
   park_signal.fetch_add(1, std::memory_order_seq_cst);
   if (parked.load(std::memory_order_seq_cst) > 0) {
     {
-      std::lock_guard<std::mutex> g(park_mu);
+      std::lock_guard g(park_mu);
     }
     park_cv.notify_one();
   }
@@ -181,6 +181,12 @@ void Scheduler::stop() {
 // stack is released instead of saved.
 static inline void switch_out_to_main(Worker* w, Fiber* f,
                                       bool terminal = false) {
+#if defined(NAT_LOCKRANK)
+  // a NatMutex held across a switch would be "held" by a TLS stack the
+  // fiber is about to leave — the rank validator's runtime twin of the
+  // static lock-switch rule
+  lockrank::assert_none_held("switch_out_to_main");
+#endif
 #if defined(__SANITIZE_ADDRESS__)
   __sanitizer_start_switch_fiber(terminal ? nullptr : &f->asan_fake_stack,
                                  w->pthread_stack_bottom,
@@ -202,6 +208,9 @@ static inline void switch_out_to_main(Worker* w, Fiber* f,
 #endif
 }
 static inline void switch_into_fiber(Worker* w, Fiber* f) {
+#if defined(NAT_LOCKRANK)
+  lockrank::assert_none_held("switch_into_fiber");
+#endif
 #if defined(__SANITIZE_ADDRESS__)
   __sanitizer_start_switch_fiber(&w->asan_fake_stack, f->stack,
                                  f->stack_size);
@@ -285,7 +294,7 @@ void Scheduler::spawn_detached_back(FiberFn fn, void* arg) {
       next_worker_.fetch_add(1, std::memory_order_relaxed) % workers_.size();
   Worker* target = workers_[idx];
   {
-    std::lock_guard<std::mutex> g(target->remote_mu);
+    std::lock_guard g(target->remote_mu);
     target->remote_rq.push_back(f);
   }
   target->signal();
@@ -309,7 +318,7 @@ void Scheduler::flush_wake_batch() {
     size_t take = n / chunks + (c < n % chunks ? 1 : 0);
     Worker* t = workers_[(base + c) % nw];
     {
-      std::lock_guard<std::mutex> g(t->remote_mu);
+      std::lock_guard g(t->remote_mu);
       for (size_t i = 0; i < take; i++) {
         t->remote_rq.push_back((*batch)[idx++]);
       }
@@ -341,7 +350,7 @@ void Scheduler::ready_fiber(Fiber* f) {
       next_worker_.fetch_add(1, std::memory_order_relaxed) % workers_.size();
   Worker* target = workers_[idx];
   {
-    std::lock_guard<std::mutex> g(target->remote_mu);
+    std::lock_guard g(target->remote_mu);
     target->remote_rq.push_back(f);
   }
   target->signal();
@@ -351,7 +360,7 @@ Fiber* Scheduler::next_task(Worker* w) {
   Fiber* f = nullptr;
   if (w->rq.pop(&f)) return f;
   {
-    std::lock_guard<std::mutex> g(w->remote_mu);
+    std::lock_guard g(w->remote_mu);
     if (!w->remote_rq.empty()) {
       f = w->remote_rq.front();
       w->remote_rq.pop_front();
@@ -368,7 +377,7 @@ Fiber* Scheduler::next_task(Worker* w) {
       if (v == w) continue;
       if (v->rq.steal(&f)) return f;
       {
-        std::lock_guard<std::mutex> g(v->remote_mu);
+        std::lock_guard g(v->remote_mu);
         if (!v->remote_rq.empty()) {
           f = v->remote_rq.front();
           v->remote_rq.pop_front();
@@ -423,7 +432,7 @@ void Scheduler::run_fiber(Worker* w, Fiber* f) {
       Butex* b = w->remained_butex;
       int32_t expected = w->remained_expected;
       w->remained_op = Worker::RemainedOp::NONE;
-      std::unique_lock<std::mutex> g(b->mu);
+      std::unique_lock g(b->mu);
       // publish-then-check (Dekker): the RMW increment is a full barrier
       // that pairs with butex_wake's fence-then-load — incrementing
       // AFTER the value check would let a concurrent waker miss both
@@ -488,7 +497,7 @@ void Scheduler::worker_loop(Worker* w) {
       if ((++w->boundary_ticks & 63) == 0) {
         std::shared_ptr<std::vector<std::function<bool()>>> hooks;
         {
-          std::lock_guard<std::mutex> g(hooks_mu_);
+          std::lock_guard g(hooks_mu_);
           hooks = idle_hooks_;
         }
         if (hooks) {
@@ -504,14 +513,14 @@ void Scheduler::worker_loop(Worker* w) {
     bool did_work = false;
     std::shared_ptr<std::vector<std::function<bool()>>> hooks;
     {
-      std::lock_guard<std::mutex> g(hooks_mu_);
+      std::lock_guard g(hooks_mu_);
       hooks = idle_hooks_;
     }
     if (hooks) {
       for (auto& h : *hooks) did_work |= h();
     }
     if (did_work) continue;
-    std::unique_lock<std::mutex> lk(w->park_mu);
+    std::unique_lock lk(w->park_mu);
     // Publish parked BEFORE the final recheck (Dekker pairing with
     // signal()'s bump-then-load): a signaler that misses parked>0 must
     // have bumped before our recheck, which then sees it and skips.
@@ -548,7 +557,7 @@ bool Scheduler::butex_wait(Butex* b, int32_t expected) {
     // pthread waiter (reference: real futex path, butex.cpp:297): block on
     // the butex's condvar; butex_wake notifies it. Recheck under the lock
     // so a change-then-wake between the load and the wait is never missed.
-    std::unique_lock<std::mutex> g(b->mu);
+    std::unique_lock g(b->mu);
     // publish the waiter BEFORE checking the value (the RMW is a full
     // barrier): pairs with butex_wake's fence-then-load so at least one
     // side observes the other — no missed pthread wake
@@ -585,7 +594,7 @@ int Scheduler::butex_wake(Butex* b, int n) {
   if (b->nwaiters.load(std::memory_order_relaxed) == 0) return 0;
   std::deque<Fiber*> woken;
   {
-    std::lock_guard<std::mutex> g(b->mu);
+    std::lock_guard g(b->mu);
     while (!b->waiters.empty() && n-- > 0) {
       woken.push_back(b->waiters.front());
       b->waiters.pop_front();
@@ -606,7 +615,7 @@ void Scheduler::join(Fiber* f) {
   }
   // Synchronize with the completion wake: once we hold/release the butex
   // mutex, the finishing worker is done touching the waiter list.
-  { std::lock_guard<std::mutex> g(f->join_butex.mu); }
+  { std::lock_guard g(f->join_butex.mu); }
   sanitize_fiber_destroy(f);
   free_stack(f->stack, f->stack_size);
   delete f;

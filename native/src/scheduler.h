@@ -22,6 +22,7 @@
 #include <ucontext.h>
 #include <vector>
 
+#include "nat_lockrank.h"
 #include "wsq.h"
 
 namespace brpc_tpu {
@@ -52,7 +53,8 @@ class Scheduler;
 
 struct Butex {
   std::atomic<int32_t> value{0};
-  std::mutex mu;
+  // cv partner (pthread_cv below waits under it): must stay std::mutex.
+  std::mutex mu;  // natcheck:rank(butex, 90)
   std::deque<Fiber*> waiters;
   // pthread waiters (the real-futex path of butex.cpp:297) block here
   // instead of spinning; butex_wake notifies when any are parked.
@@ -100,10 +102,11 @@ struct Fiber {
 class Worker {
  public:
   WorkStealingQueue<Fiber*> rq;
-  std::mutex remote_mu;
+  NatMutex<kLockRankSchedRemote> remote_mu;
   std::deque<Fiber*> remote_rq;
-  // parking lot (per worker, as in the fork: task_control.h:123-126)
-  std::mutex park_mu;
+  // parking lot (per worker, as in the fork: task_control.h:123-126);
+  // park_cv waits under park_mu, so it must stay std::mutex.
+  std::mutex park_mu;  // natcheck:rank(sched.park, 94)
   std::condition_variable park_cv;
   std::atomic<uint32_t> park_signal{0};
   std::atomic<int> parked{0};  // gate: skip notify when nobody sleeps
@@ -172,7 +175,7 @@ class Scheduler {
   static int butex_wake(Butex* b, int n);
 
   void add_idle_hook(std::function<bool()> hook) {
-    std::lock_guard<std::mutex> g(hooks_mu_);
+    std::lock_guard g(hooks_mu_);
     auto next = std::make_shared<std::vector<std::function<bool()>>>(
         idle_hooks_ ? *idle_hooks_ : std::vector<std::function<bool()>>());
     next->push_back(std::move(hook));
@@ -204,7 +207,7 @@ class Scheduler {
   std::atomic<bool> stopping_{false};
   bool started_ = false;
   std::atomic<uint32_t> next_worker_{0};
-  std::mutex hooks_mu_;
+  NatMutex<kLockRankSchedHooks> hooks_mu_;
   std::shared_ptr<std::vector<std::function<bool()>>> idle_hooks_;
   std::atomic<uint32_t> wake_rr_{0};
 

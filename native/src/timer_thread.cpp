@@ -21,7 +21,7 @@ TimerThread* TimerThread::instance() {
 }
 
 void TimerThread::start() {
-  std::lock_guard<std::mutex> g(start_mu_);
+  std::lock_guard g(start_mu_);
   if (started_.load(std::memory_order_acquire)) return;
   stop_.store(false, std::memory_order_relaxed);
   thread_ = std::thread([this] { run(); });
@@ -29,10 +29,12 @@ void TimerThread::start() {
 }
 
 void TimerThread::stop() {
-  std::lock_guard<std::mutex> g(start_mu_);
+  std::lock_guard g(start_mu_);
   if (!started_.load(std::memory_order_acquire)) return;
   stop_.store(true, std::memory_order_release);
   run_cv_.notify_all();
+  // natcheck:allow(lock-switch): start_mu_ serializes start/stop and the
+  // runner never takes it — joining under it cannot deadlock (cold path)
   if (thread_.joinable()) thread_.join();
   started_.store(false, std::memory_order_release);
 }
@@ -43,7 +45,7 @@ uint64_t TimerThread::schedule(TimerFn fn, void* arg, int64_t delay_ms) {
   Entry e{now_us() + delay_ms * 1000, id, fn, arg};
   Bucket& b = buckets_[id % kBuckets];
   {
-    std::lock_guard<std::mutex> g(b.mu);
+    std::lock_guard g(b.bucket_mu);
     b.staged.push_back(e);
   }
   // earlier-than-known deadline: poke the runner so it re-sleeps
@@ -54,7 +56,7 @@ uint64_t TimerThread::schedule(TimerFn fn, void* arg, int64_t delay_ms) {
       // lock-then-notify pairs with the runner's locked recheck of
       // nearest_us_, so a wake between its recheck and its wait is
       // never lost
-      { std::lock_guard<std::mutex> g(run_mu_); }
+      { std::lock_guard g(run_mu_); }
       run_cv_.notify_one();
       break;
     }
@@ -63,7 +65,7 @@ uint64_t TimerThread::schedule(TimerFn fn, void* arg, int64_t delay_ms) {
 }
 
 bool TimerThread::unschedule(uint64_t id) {
-  std::lock_guard<std::mutex> g(cancel_mu_);
+  std::lock_guard g(cancel_mu_);
   return cancelled_.insert(id).second;
 }
 
@@ -71,7 +73,7 @@ void TimerThread::run() {
   while (!stop_.load(std::memory_order_acquire)) {
     // drain the staged buckets into the private heap
     for (Bucket& b : buckets_) {
-      std::lock_guard<std::mutex> g(b.mu);
+      std::lock_guard g(b.bucket_mu);
       for (Entry& e : b.staged) heap_.push(e);
       b.staged.clear();
     }
@@ -81,14 +83,14 @@ void TimerThread::run() {
       heap_.pop();
       bool skip = false;
       {
-        std::lock_guard<std::mutex> g(cancel_mu_);
+        std::lock_guard g(cancel_mu_);
         skip = cancelled_.erase(e.id) > 0;
       }
       if (!skip) e.fn(e.arg);
     }
     int64_t next = heap_.empty() ? INT64_MAX : heap_.top().when_us;
     nearest_us_.store(next, std::memory_order_release);
-    std::unique_lock<std::mutex> lk(run_mu_);
+    std::unique_lock lk(run_mu_);
     if (stop_.load(std::memory_order_acquire)) break;
     if (nearest_us_.load(std::memory_order_acquire) < next) {
       continue;  // an earlier timer landed while we were unlocked

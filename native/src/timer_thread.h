@@ -16,6 +16,7 @@
 #include <thread>
 #include <unordered_set>
 #include <vector>
+#include "nat_lockrank.h"
 
 namespace brpc_tpu {
 
@@ -47,7 +48,7 @@ class TimerThread {
 
   static const int kBuckets = 8;
   struct Bucket {
-    std::mutex mu;
+    NatMutex<kLockRankTimerBucket> bucket_mu;
     std::vector<Entry> staged;
   };
 
@@ -57,17 +58,17 @@ class TimerThread {
   std::atomic<uint64_t> next_id_{1};
   std::atomic<int64_t> nearest_us_{INT64_MAX};
 
-  std::mutex run_mu_;
+  std::mutex run_mu_;  // natcheck:rank(timer.run, 86) — run_cv_ partner
   std::condition_variable run_cv_;
   std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap_;
 
-  std::mutex cancel_mu_;
+  NatMutex<kLockRankTimerCancel> cancel_mu_;
   std::unordered_set<uint64_t> cancelled_;
 
   std::thread thread_;
   std::atomic<bool> stop_{false};
   std::atomic<bool> started_{false};
-  std::mutex start_mu_;
+  NatMutex<kLockRankTimerStart> start_mu_;
 };
 
 }  // namespace brpc_tpu

@@ -11,6 +11,8 @@
 #include <cstdint>
 #include <vector>
 
+#include "nat_atomic.h"
+
 template <typename T>
 class WorkStealingQueue {
  public:
@@ -35,7 +37,7 @@ class WorkStealingQueue {
     if (t >= b) return false;
     b -= 1;
     bottom_.store(b, std::memory_order_relaxed);
-    std::atomic_thread_fence(std::memory_order_seq_cst);
+    nat::atomic_thread_fence(std::memory_order_seq_cst);
     t = top_.load(std::memory_order_relaxed);
     if (t > b) {  // emptied by a thief
       bottom_.store(b + 1, std::memory_order_relaxed);
@@ -57,7 +59,7 @@ class WorkStealingQueue {
   // Any thread: FIFO steal.
   bool steal(T* out) {
     uint64_t t = top_.load(std::memory_order_acquire);
-    std::atomic_thread_fence(std::memory_order_seq_cst);
+    nat::atomic_thread_fence(std::memory_order_seq_cst);
     uint64_t b = bottom_.load(std::memory_order_acquire);
     if (t >= b) return false;
     T item = buf_[t & mask_];
@@ -83,5 +85,5 @@ class WorkStealingQueue {
   }
   size_t cap_, mask_;
   std::vector<T> buf_;
-  std::atomic<uint64_t> top_, bottom_;
+  nat::atomic<uint64_t> top_, bottom_;
 };

@@ -17,7 +17,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from tools.natcheck import abi, lint  # noqa: E402
+from tools.natcheck import abi, lint, lockorder  # noqa: E402
 
 BINDINGS = os.path.join(REPO, "brpc_tpu", "native", "__init__.py")
 
@@ -290,11 +290,126 @@ long read_ok() {
 
 
 # ---------------------------------------------------------------------------
+# lockorder pass (pure Python, no toolchain needed)
+# ---------------------------------------------------------------------------
+
+_LOCKORDER_PRELUDE = """
+#include <mutex>
+template <int R> struct NatMutex { void lock(); void unlock(); };
+"""
+
+
+def _lockorder_one(tmp_path, text):
+    (tmp_path / "seed.cpp").write_text(_LOCKORDER_PRELUDE + text)
+    return lockorder.check(str(tmp_path))
+
+
+def test_lockorder_clean_on_shipped_tree():
+    findings = lockorder.run()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_lockorder_flags_rank_cycle(tmp_path):
+    # f1 nests a->b, f2 nests b->a: with ranks total, at least one edge
+    # must violate monotonicity — the seeded-cycle detection contract
+    findings = _lockorder_one(tmp_path, """
+NatMutex<10> mu_a;
+NatMutex<20> mu_b;
+void f1() { std::lock_guard g1(mu_a); std::lock_guard g2(mu_b); }
+void f2() { std::lock_guard g1(mu_b); std::lock_guard g2(mu_a); }
+""")
+    assert any(f.rule == "lock-order" and "mu_a" in f.message
+               for f in findings), findings
+
+
+def test_lockorder_flags_undeclared_lock(tmp_path):
+    findings = _lockorder_one(tmp_path, """
+std::mutex naked_mu;
+void g() { std::lock_guard g1(naked_mu); }
+""")
+    assert any(f.rule == "lock-undeclared" and "naked_mu" in f.message
+               for f in findings), findings
+
+
+def test_lockorder_rank_comment_declares_raw_mutex(tmp_path):
+    findings = _lockorder_one(tmp_path, """
+std::mutex cv_mu;  // natcheck:rank(test.cv, 40)
+void g() { std::lock_guard g1(cv_mu); }
+""")
+    assert findings == [], findings
+
+
+def test_lockorder_flags_lock_held_across_switch(tmp_path):
+    findings = _lockorder_one(tmp_path, """
+NatMutex<30> mu_c;
+void h() { std::lock_guard g1(mu_c); yield(); }
+""")
+    assert any(f.rule == "lock-switch" for f in findings), findings
+
+
+def test_lockorder_switch_allow_escape(tmp_path):
+    findings = _lockorder_one(tmp_path, """
+NatMutex<30> mu_c;
+void h() {
+  std::lock_guard g1(mu_c);
+  // natcheck:allow(lock-switch): test reason
+  yield();
+}
+""")
+    assert findings == [], findings
+
+
+def test_lockorder_guard_unlock_ends_held_range(tmp_path):
+    # the tree's discipline: unlock deliberately before a blocking call
+    findings = _lockorder_one(tmp_path, """
+NatMutex<30> mu_c;
+void h() {
+  std::unique_lock g1(mu_c);
+  g1.unlock();
+  yield();
+}
+""")
+    assert findings == [], findings
+
+
+def test_lockorder_try_lock_exempt_from_rank_order(tmp_path):
+    # a failed try_lock cannot deadlock: out-of-rank try acquisitions
+    # are the hot paths' deliberate idiom (push_to_some_worker)
+    findings = _lockorder_one(tmp_path, """
+NatMutex<10> mu_a;
+NatMutex<20> mu_b;
+void f() {
+  std::lock_guard g1(mu_b);
+  std::unique_lock g2(mu_a, std::try_to_lock);
+}
+""")
+    assert findings == [], findings
+
+
+def test_lockorder_interprocedural_edge(tmp_path):
+    findings = _lockorder_one(tmp_path, """
+NatMutex<10> mu_a;
+NatMutex<20> mu_b;
+void inner() { std::lock_guard g(mu_a); }
+void outer() { std::lock_guard g(mu_b); inner(); }
+""")
+    assert any(f.rule == "lock-order" and "via inner" in f.message
+               for f in findings), findings
+
+
+# ---------------------------------------------------------------------------
 # entrypoint wiring
 # ---------------------------------------------------------------------------
 
 def test_cli_lint_exits_zero_on_clean_tree():
     proc = subprocess.run(
         [sys.executable, "-m", "tools.natcheck", "lint"],
+        cwd=REPO, capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_lockorder_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.natcheck", "lockorder"],
         cwd=REPO, capture_output=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr

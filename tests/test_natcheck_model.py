@@ -1,0 +1,98 @@
+"""dsched model-pass golden tests (slow: compiles native/model/).
+
+The interleaving checker must (1) run green and deterministically on
+the shipped lock-free primitives, and (2) catch each seeded defect: a
+relaxed-order bug in a WSQ copy (the fence dropped from pop/steal — the
+classic Chase-Lev weakening, caught through dsched's stale-read
+modeling), a butex waker missing its publish fence (lost wake =>
+deadlock), and a descriptor-ring publish escaping the producer lock
+(recovery wedges a cell; caught by the post-recovery refill probe).
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.natcheck import model  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+NATIVE = os.path.join(REPO, "native")
+
+
+def _have_toolchain():
+    return shutil.which("make") and shutil.which("g++")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def toolchain():
+    if not _have_toolchain():
+        pytest.skip("native toolchain unavailable")
+    yield
+    # leave a CLEAN nat_model behind no matter which test ran last (the
+    # seeded-bug test builds against a doctored header)
+    subprocess.run(["make", "-C", NATIVE, "nat_model", "-B"],
+                   capture_output=True, timeout=600)
+
+
+def test_model_clean_and_deterministic():
+    rc1, out1 = model.build_and_run(
+        args=("--mode", "random", "--seed", "7", "--execs", "150"))
+    assert rc1 == 0, out1
+    assert "FAIL" not in out1, out1
+    rc2, out2 = model.build_and_run(
+        args=("--mode", "random", "--seed", "7", "--execs", "150"))
+    assert rc2 == 0
+    # same seed => same schedules => same trace hashes, line for line
+    assert out1 == out2
+
+
+def test_model_dfs_explores_shipped_tree_green():
+    rc, out = model.build_and_run(
+        args=("--mode", "dfs", "--execs", "600"))
+    assert rc == 0, out
+    assert out.count("ok") >= 6, out
+
+
+def test_model_catches_relaxed_order_wsq_bug(tmp_path):
+    # weaken a COPY of wsq.h: drop the seq_cst fences from pop/steal.
+    # The model must observe a stale top_/bottom_ read and report an
+    # item consumed twice (or lost).
+    src = os.path.join(NATIVE, "src", "wsq.h")
+    with open(src) as f:
+        text = f.read()
+    assert "nat::atomic_thread_fence(std::memory_order_seq_cst);" in text
+    (tmp_path / "wsq.h").write_text(text.replace(
+        "nat::atomic_thread_fence(std::memory_order_seq_cst);",
+        "/* seeded bug: fence dropped */"))
+    try:
+        rc, out = model.build_and_run(
+            args=("--scenario", "wsq", "--mode", "random", "--seed", "1",
+                  "--execs", "2000"),
+            model_inc=f"-I{tmp_path}")
+        assert rc != 0, out
+        assert "FAIL" in out, out
+        assert "consumed twice" in out or "lost" in out or \
+            "check failed" in out, out
+    finally:
+        subprocess.run(["make", "-C", NATIVE, "nat_model", "-B"],
+                       capture_output=True, timeout=600)
+
+
+def test_model_catches_butex_lost_wake():
+    rc, out = model.build_and_run(
+        args=("--scenario", "butex", "--bug", "butex-no-fence"))
+    assert rc != 0, out
+    assert "deadlock" in out, out
+
+
+def test_model_catches_recovery_late_publish():
+    rc, out = model.build_and_run(
+        args=("--scenario", "recover", "--bug", "recover-late-publish"))
+    assert rc != 0, out
+    assert "refused fresh offer" in out or "FAIL" in out, out

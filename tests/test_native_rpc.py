@@ -200,6 +200,12 @@ def test_rss_flat_under_sustained_load():
     import ctypes
     import os
 
+    if os.environ.get("BRPC_TPU_SANITIZED"):
+        # ASan's quarantine + redzones keep RSS climbing by design; leak
+        # detection under instrumentation is LSan's job (the C smoke leg
+        # of tools/check.sh --soak), not this gate's
+        pytest.skip("RSS-flatness gate is meaningless under ASan")
+
     def current_rss_mb() -> float:
         # CURRENT rss, not ru_maxrss: the high-water mark passes vacuously
         # when an earlier test already peaked higher

@@ -148,5 +148,9 @@ def test_stream_throughput_sanity(native_stream_server):
     # Low floor: correctness gate only — the 1-core CI box runs client,
     # native loop and py lane on one core; the real figure is the bench
     # artifact's stream_GBps.
-    assert total / dt > 0.05e9, f"{total / dt / 1e9:.3f} GB/s"
+    import os
+    floor = 0.05e9
+    if os.environ.get("BRPC_TPU_SANITIZED"):
+        floor = 0.005e9  # ASan costs ~2-5x; keep only a liveness floor
+    assert total / dt > floor, f"{total / dt / 1e9:.3f} GB/s"
     stream.close()

@@ -1,18 +1,63 @@
 #!/bin/sh
 # tools/check.sh — the natcheck gate (also `make -C native check`).
 #
-# Always runs the fast passes: concurrency lint + ABI/FFI contract check.
-# With NATCHECK_SLOW=1 it adds the sanitizer lane (ASan+UBSan and TSan
-# builds of the .so + smoke run under each; several minutes of compile).
+# Runs the fast static passes first (concurrency lint + ABI/FFI contract
+# + lock-order verification — pure Python, seconds), then the lock-rank
+# runtime validator (NAT_LOCKRANK build of the .so driven by the smoke —
+# a rank inversion or a NatMutex held across a fiber switch aborts it;
+# skipped with a note when the toolchain is absent).
+#
+# NATCHECK_SLOW=1 adds the sanitizer lane (ASan+UBSan and TSan builds +
+# smoke; several minutes of compile) and the dsched interleaving smoke.
+# --soak (or NATCHECK_SOAK=1) additionally runs the full sanitizer soak
+# matrix and writes native/SOAK.md (see tools/natcheck/soak.py).
 # Exits nonzero on any finding.
-set -eu
+set -u
 
 cd "$(dirname "$0")/.."
 
 PY="${PYTHON:-python3}"
+RC=0
 
-if [ "${NATCHECK_SLOW:-0}" = "1" ]; then
-    exec "$PY" -m tools.natcheck lint abi san
+SOAK="${NATCHECK_SOAK:-0}"
+for arg in "$@"; do
+    case "$arg" in
+        --soak) SOAK=1 ;;
+    esac
+done
+
+# static passes first: they need no toolchain and must report even when
+# the compile below cannot run
+if [ "$SOAK" = "1" ] || [ "${NATCHECK_SLOW:-0}" = "1" ]; then
+    "$PY" -m tools.natcheck lint abi lockorder model san || RC=1
 else
-    exec "$PY" -m tools.natcheck lint abi
+    "$PY" -m tools.natcheck lint abi lockorder || RC=1
 fi
+
+# lock-rank runtime validator: build + drive the smoke under it
+if command -v g++ >/dev/null 2>&1; then
+    if make -C native lockrank >/dev/null 2>&1 &&
+           native/nat_smoke_lockrank >/dev/null; then
+        echo "natcheck: lockrank: clean"
+    else
+        echo "natcheck: lockrank: FAILED (rank inversion or smoke error)"
+        RC=1
+    fi
+else
+    echo "natcheck: lockrank: skipped (no g++)"
+fi
+
+if [ "$SOAK" = "1" ]; then
+    "$PY" - <<'EOF' || RC=1
+import sys
+sys.path.insert(0, ".")
+from tools.natcheck import print_findings, soak
+findings = soak.run()
+print("natcheck: soak: %s (log: native/SOAK.md)"
+      % ("clean" if not findings else "%d finding(s)" % len(findings)))
+print_findings(findings)
+sys.exit(1 if findings else 0)
+EOF
+fi
+
+exit $RC

@@ -1,6 +1,6 @@
 """natcheck — standing correctness tooling for the native runtime.
 
-Three passes over the C++ core and its FFI boundary (see README.md here):
+Five passes over the C++ core and its FFI boundary (see README.md here):
 
 - ``abi``  — cross-checks the compiler-generated ABI manifest
   (native/nat_abi, built from nat_api.h) against the ctypes declarations
@@ -8,8 +8,19 @@ Three passes over the C++ core and its FFI boundary (see README.md here):
 - ``lint`` — regex/clang-agnostic concurrency lint over native/src/
   enforcing repo invariants (explicit memory_order, no racy exit-time
   statics in thread-spawning files, seqlock readers re-check).
+- ``lockorder`` — lock-rank verification: every mutex carries a declared
+  rank (NatMutex<kLockRank...> / natcheck:rank comments); the static
+  acquires-while-holding graph must be rank-monotone and no lock may be
+  held across a fiber-switch/blocking point. Runtime twin: the
+  NAT_LOCKRANK build (``make -C native lockrank``) asserts the same
+  order on a TLS held-rank stack during nat_smoke runs.
+- ``model`` — dsched deterministic interleaving checker (native/model/):
+  exhaustive + seeded-random exploration of the lock-free primitives
+  (wsq, descriptor ring, arena, butex protocol, EOWNERDEAD recovery)
+  with stale-read weak-memory modeling; replayable failing schedules.
 - ``san``  — builds the .so under ASan+UBSan and TSan and runs the native
-  smoke driver (echo, http, stats, clean exit) under each.
+  smoke driver under each; ``soak`` (tools/check.sh --soak) extends this
+  to the full native matrix and logs native/SOAK.md.
 
 Entry points: ``python -m tools.natcheck`` or ``make -C native check``
 (which delegates to tools/check.sh).

@@ -1,6 +1,8 @@
-"""CLI: ``python -m tools.natcheck [abi] [lint] [san]``.
+"""CLI: ``python -m tools.natcheck [abi] [lint] [lockorder] [san] [model]``.
 
-With no pass named, runs the fast pair (lint + abi). ``san`` (or
+With no pass named, runs the fast static trio (lint + abi + lockorder).
+``--model`` (or naming ``model``) adds the dsched interleaving smoke
+(compiles native/model/, bounded exploration); ``san`` (or
 NATCHECK_SLOW=1 in tools/check.sh) adds the sanitizer lane. Exits 1 on
 any finding, 2 when a pass could not run at all.
 """
@@ -16,13 +18,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from tools.natcheck import print_findings  # noqa: E402
 
+DEFAULT_PASSES = ["lint", "abi", "lockorder"]
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tools.natcheck")
-    ap.add_argument("passes", nargs="*", choices=["abi", "lint", "san", []],
-                    help="passes to run (default: lint abi)")
+    ap.add_argument("passes", nargs="*",
+                    choices=["abi", "lint", "lockorder", "san", "model",
+                             []],
+                    help="passes to run (default: lint abi lockorder)")
+    ap.add_argument("--model", action="store_true",
+                    help="also run the dsched interleaving smoke")
     args = ap.parse_args(argv)
-    passes = args.passes or ["lint", "abi"]
+    passes = args.passes or list(DEFAULT_PASSES)
+    if args.model and "model" not in passes:
+        passes.append("model")
 
     findings = []
     broken = False
@@ -34,6 +44,12 @@ def main(argv=None) -> int:
             elif p == "abi":
                 from tools.natcheck import abi
                 got = abi.run()
+            elif p == "lockorder":
+                from tools.natcheck import lockorder
+                got = lockorder.run()
+            elif p == "model":
+                from tools.natcheck import model
+                got = model.run()
             else:
                 from tools.natcheck import san
                 got = san.run()

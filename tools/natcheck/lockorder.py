@@ -1,0 +1,680 @@
+"""Lock-order verification — static acquires-while-holding analysis.
+
+Every mutex in ``native/src/`` must carry a declared rank, either by
+being a ``NatMutex<kLockRank...>`` (ranks defined in
+``native/src/nat_lockrank.h``, validated at runtime under
+``-DNAT_LOCKRANK=1``) or — for the few locks that must stay raw
+(condition-variable partners, the robust shm fence) — by a
+``// natcheck:rank(name, N)`` comment on or above the declaration.
+
+The pass parses every TU, finds lock acquisition sites
+(``lock_guard``/``unique_lock``/``scoped_lock``/``.lock()``/
+``pthread_mutex_lock``), scopes each acquisition to its enclosing brace
+block, and builds the acquires-while-holding graph, including one level
+of interprocedural closure: a call made while holding L contributes
+edges L -> every lock the callee (transitively) acquires, and a callee
+that can hit a fiber-switch/blocking point makes the call site a
+hold-across-switch finding.
+
+Rules (suppress with ``// natcheck:allow(<rule>): why``):
+
+- ``lock-undeclared``: a mutex declaration with no rank, or an
+  acquisition of an expression that resolves to no declared lock.
+- ``lock-order``: acquiring a lock whose rank is <= the rank of a lock
+  already held (rank order is total, so monotonicity implies the
+  acquisition graph is acyclic; a seeded cycle always has at least one
+  edge that violates monotonicity and is reported here).
+- ``lock-switch``: a fiber-switch point or blocking wait reached while
+  holding a lock. Condition-variable waits are exempt for the lock the
+  wait itself releases (``cv.wait(lk)``), but not for any OTHER held
+  lock.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+if __package__ in (None, ""):  # `python tools/natcheck/lockorder.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.natcheck import Finding, REPO_ROOT  # noqa: E402
+
+SRC_DIR = os.path.join(REPO_ROOT, "native", "src")
+RANK_HEADER = "nat_lockrank.h"
+
+_ALLOW = re.compile(r"natcheck:allow\(([a-z-]+)\)")
+_RANK_COMMENT = re.compile(r"natcheck:rank\(\s*([\w.\-]+)\s*,\s*(\d+)\s*\)")
+_RANK_CONST = re.compile(r"\b(kLockRank\w+)\s*=\s*(\d+)")
+
+# declaration forms
+_NATMUTEX_DECL = re.compile(
+    r"\bNatMutex<\s*(kLockRank\w+|\d+)\s*>\s*(?:\*\s*)?(\w+)\s*"
+    r"[;={\[(]")
+_RAW_DECL = re.compile(
+    r"\b(?:std::mutex|std::recursive_mutex|pthread_mutex_t)\s*"
+    r"(?:\*\s*)?(\w+)\s*[;={\[]")
+
+# acquisition forms (scrubbed text)
+_GUARD = re.compile(
+    r"\bstd::(lock_guard|unique_lock|scoped_lock)\s*(?:<[^>]*>)?\s+"
+    r"(\w+)\s*[({]\s*([^;]*?)\s*[)}]\s*;")
+_METHOD_LOCK = re.compile(r"([\w>.\[\]\*\-]+?)\s*(?:\.|->)\s*"
+                          r"(lock|try_lock)\s*\(\s*\)")
+_PTHREAD_LOCK = re.compile(
+    r"\bpthread_mutex_(?:lock|trylock)\s*\(\s*([^)]+?)\s*\)")
+_UNLOCK = re.compile(r"([\w>.\[\]\*\-]+?)\s*(?:\.|->)\s*unlock\s*\(\s*\)")
+
+# fiber-switch / blocking-wait points (extends lint's switch-point
+# knowledge: the scheduler's switch primitives, the shm futex wait, and
+# plain sleeps). Condition-variable waits are handled separately so the
+# lock the wait releases is exempt.
+SWITCH_POINTS = {
+    "yield", "butex_wait", "switch_out_to_main", "switch_into_fiber",
+    "fctx_swap", "swapcontext", "futex_wait_shared", "sleep_for",
+    "sleep_until", "usleep", "nanosleep", "epoll_wait", "join",
+}
+CV_WAITS = {"wait", "wait_for", "wait_until", "nat_cv_wait_for"}
+
+# call-name stoplist: generic container/atomic method names that would
+# otherwise collide with repo function summaries
+_CALL_STOP = {
+    "load", "store", "exchange", "fetch_add", "fetch_sub", "fetch_or",
+    "fetch_and", "compare_exchange_weak", "compare_exchange_strong",
+    "push_back", "pop_front", "pop_back", "emplace_back", "push",
+    "front", "back", "size", "empty", "begin", "end", "clear", "find",
+    "erase", "insert", "count", "reserve", "resize", "data", "c_str",
+    "append", "substr", "get", "reset", "release", "lock", "unlock",
+    "try_lock", "notify_one", "notify_all", "owns_lock", "str",
+    "if", "for", "while", "switch", "return", "sizeof", "assert",
+    "defined", "memcpy", "memset", "memcmp", "snprintf", "printf",
+    "fprintf", "malloc", "free", "calloc", "min", "max", "move",
+    "forward", "make_shared", "make_unique", "static_cast",
+    "reinterpret_cast", "const_cast", "emplace",
+}
+
+_CALL = re.compile(r"\b([A-Za-z_]\w*)\s*\(")
+
+
+def _strip_comments_and_strings(line: str) -> str:
+    line = re.sub(r'"(?:[^"\\]|\\.)*"', '""', line)
+    line = re.sub(r"'(?:[^'\\]|\\.)*'", "''", line)
+    line = re.sub(r"/\*.*?\*/", "", line)
+    line = re.sub(r"//.*", "", line)
+    return line
+
+
+class Lock:
+    def __init__(self, var: str, name: str, rank: Optional[int],
+                 where: str):
+        self.var = var        # C++ variable / member name
+        self.name = name      # declared lock name (rank table key)
+        self.rank = rank      # None = undeclared
+        self.where = where
+
+    def __repr__(self):
+        return f"Lock({self.name}, rank={self.rank})"
+
+
+class Acq:
+    """One acquisition: lock, offset range it is held over, guard var."""
+
+    def __init__(self, lock: Lock, pos: int, end: int, line: int,
+                 guard: Optional[str], expr: str, blocking: bool = True):
+        self.lock = lock
+        self.pos = pos
+        self.end = end
+        self.line = line
+        self.guard = guard
+        self.expr = expr
+        # try_lock acquisitions cannot deadlock and are exempt from the
+        # rank-monotonicity rule as the ACQUIRED side (they still rank-
+        # constrain what is acquired while they are held)
+        self.blocking = blocking
+
+
+class FuncInfo:
+    def __init__(self, name: str, path: str, start_line: int, body: str,
+                 body_off: int):
+        self.name = name
+        self.path = path
+        self.start_line = start_line
+        self.body = body
+        self.body_off = body_off
+        self.acqs: List[Acq] = []
+        self.calls: List[Tuple[str, int]] = []  # (callee, offset)
+        self.direct_blocking: List[Tuple[str, int, List[str]]] = []
+        # transitive summaries (filled by _propagate)
+        self.trans_acquires: Set[str] = set()
+        self.may_block = False
+        self.block_via: str = ""
+
+
+def parse_rank_table(src_dir: str) -> Dict[str, int]:
+    """kLockRank* constants from nat_lockrank.h (if present)."""
+    table: Dict[str, int] = {}
+    p = os.path.join(src_dir, RANK_HEADER)
+    if not os.path.exists(p):
+        p = os.path.join(SRC_DIR, RANK_HEADER)
+    if os.path.exists(p):
+        with open(p, "r", encoding="utf-8", errors="replace") as f:
+            for m in _RANK_CONST.finditer(f.read()):
+                table[m.group(1)] = int(m.group(2))
+    return table
+
+
+def _block_end(text: str, pos: int) -> int:
+    """End offset of the innermost brace block containing `pos`
+    (text is a function body starting at its opening '{')."""
+    depth = 0
+    opens: List[int] = []
+    for k, ch in enumerate(text):
+        if ch == "{":
+            opens.append(k)
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if opens:
+                start = opens.pop()
+                if start <= pos < k:
+                    # first close whose open precedes pos and that
+                    # brackets pos: since we pop innermost-first, the
+                    # first such match IS the innermost block
+                    return k
+            if depth <= 0:
+                return k
+    return len(text)
+
+
+def _last_ident(expr: str) -> Optional[str]:
+    """`*g_resp_mu` -> g_resp_mu, `g_req_mu[i]` -> g_req_mu,
+    `h->mu` -> mu, `w->fence` -> fence, `&w->fence` -> fence."""
+    expr = expr.strip()
+    # drop trailing index
+    expr = re.sub(r"\[[^\]]*\]\s*$", "", expr).strip()
+    m = re.search(r"([A-Za-z_]\w*)\s*$", expr)
+    return m.group(1) if m else None
+
+
+def collect_sources(src_dir: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for name in sorted(os.listdir(src_dir)):
+        if name.endswith((".cpp", ".h", ".cc", ".hpp")):
+            p = os.path.join(src_dir, name)
+            with open(p, "r", encoding="utf-8", errors="replace") as f:
+                out[p] = f.read()
+    return out
+
+
+def collect_locks(sources: Dict[str, str],
+                  rank_table: Dict[str, int],
+                  findings: List[Finding]) -> Dict[str, Lock]:
+    """Map C++ variable name -> Lock. Duplicate variable names with
+    different ranks are a finding (lock names must stay unique for the
+    cross-TU graph to be meaningful)."""
+    locks: Dict[str, Lock] = {}
+    for path, text in sources.items():
+        rel = os.path.relpath(path, REPO_ROOT)
+        lines = text.splitlines()
+        stripped = [_strip_comments_and_strings(ln) for ln in lines]
+        for i, ln in enumerate(stripped):
+            for m in _NATMUTEX_DECL.finditer(ln):
+                const, var = m.group(1), m.group(2)
+                if const.isdigit():
+                    rank: Optional[int] = int(const)
+                    lname = var
+                else:
+                    rank = rank_table.get(const)
+                    lname = const[len("kLockRank"):]
+                    if rank is None:
+                        findings.append(Finding(
+                            "lockorder", "lock-undeclared",
+                            f"{rel}:{i + 1}",
+                            f"NatMutex rank constant {const} not found "
+                            f"in {RANK_HEADER}"))
+                _register(locks, var, lname, rank, f"{rel}:{i + 1}",
+                          findings)
+            for m in _RAW_DECL.finditer(ln):
+                var = m.group(1)
+                if ln.lstrip().startswith("extern"):
+                    continue  # defined (and ranked) elsewhere
+                rank_m = None
+                for j in (i, i - 1):
+                    if 0 <= j < len(lines):
+                        rm = _RANK_COMMENT.search(lines[j])
+                        if rm:
+                            rank_m = rm
+                            break
+                if rank_m:
+                    _register(locks, var, rank_m.group(1),
+                              int(rank_m.group(2)), f"{rel}:{i + 1}",
+                              findings)
+                else:
+                    if _allowed(lines, i, "lock-undeclared"):
+                        continue
+                    findings.append(Finding(
+                        "lockorder", "lock-undeclared", f"{rel}:{i + 1}",
+                        f"mutex `{var}` has no declared rank: make it a "
+                        f"NatMutex<kLockRank...> or annotate "
+                        f"`// natcheck:rank(name, N)`"))
+                    _register(locks, var, var, None, f"{rel}:{i + 1}",
+                              findings)
+    return locks
+
+
+def _register(locks: Dict[str, Lock], var: str, name: str,
+              rank: Optional[int], where: str,
+              findings: List[Finding]) -> None:
+    prev = locks.get(var)
+    if prev is not None and rank is not None and prev.rank is not None \
+            and prev.rank != rank:
+        findings.append(Finding(
+            "lockorder", "lock-undeclared", where,
+            f"lock variable `{var}` redeclared with a different rank "
+            f"({prev.rank} at {prev.where} vs {rank}): lock variable "
+            f"names must be unique across native/src"))
+        return
+    if prev is None or (prev.rank is None and rank is not None):
+        locks[var] = Lock(var, name, rank, where)
+
+
+def _allowed(lines: List[str], i: int, rule: str) -> bool:
+    """allow() on the same line or anywhere in the contiguous comment
+    block immediately above it (multi-line justifications are the norm
+    for this rule set)."""
+    if 0 <= i < len(lines):
+        m = _ALLOW.search(lines[i])
+        if m and m.group(1) == rule:
+            return True
+    j = i - 1
+    while j >= 0 and i - j <= 8:
+        stripped = lines[j].strip()
+        if not stripped.startswith("//") and not stripped.startswith("#"):
+            break
+        m = _ALLOW.search(lines[j])
+        if m and m.group(1) == rule:
+            return True
+        j -= 1
+    return False
+
+
+_SIG = re.compile(
+    r"(?:^|[;}\n])\s*(?:[\w:<>,&*~\s]+?\s)?"
+    r"([A-Za-z_~]\w*(?:::[A-Za-z_~]\w*)*)\s*\(")
+_KEYWORDS = {"if", "for", "while", "switch", "catch", "return",
+             "sizeof", "else", "do", "new", "delete", "defined"}
+
+
+_LAMBDA = re.compile(r"\[[&=]?(?:this|[&=\w,\s]*)\]\s*(?:\([^)]*\)\s*)?"
+                     r"(?:mutable\s*)?(?:->\s*[\w:<>]+\s*)?\{")
+
+
+def _extract_lambdas(body: str) -> Tuple[str, List[Tuple[int, str]]]:
+    """Blank out lambda literals from `body` (a thread/hook body runs
+    CONCURRENTLY or later — it must not contribute acquisitions or
+    blocking calls to the enclosing function's summary) and return them
+    as (offset, text) so they can be checked as anonymous functions."""
+    out = []
+    while True:
+        m = _LAMBDA.search(body)
+        if not m:
+            break
+        start = m.end() - 1
+        depth = 0
+        k = start
+        while k < len(body):
+            if body[k] == "{":
+                depth += 1
+            elif body[k] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            k += 1
+        out.append((m.start(), body[start:k + 1]))
+        blank = "".join(c if c == "\n" else " "
+                        for c in body[m.start():k + 1])
+        body = body[:m.start()] + blank + body[k + 1:]
+    return body, out
+
+
+def parse_functions(path: str, text: str) -> List[FuncInfo]:
+    """Function definitions: name + brace-matched body. Crude but
+    effective for this tree's style (same discipline as lint.py)."""
+    scrubbed = "\n".join(_strip_comments_and_strings(ln)
+                         for ln in text.splitlines())
+    out: List[FuncInfo] = []
+    i = 0
+    while i < len(scrubbed):
+        m = _SIG.search(scrubbed, i)
+        if not m:
+            break
+        name = m.group(1).split("::")[-1]
+        if name in _KEYWORDS:
+            i = m.end()
+            continue
+        # match the parameter parens
+        depth = 0
+        k = m.end() - 1
+        while k < len(scrubbed):
+            if scrubbed[k] == "(":
+                depth += 1
+            elif scrubbed[k] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            k += 1
+        if k >= len(scrubbed):
+            break
+        tail = scrubbed[k + 1:k + 80]
+        tm = re.match(r"\s*(?:const)?\s*(?:noexcept)?\s*"
+                      r"(?:->\s*[\w:<>]+\s*)?\{", tail)
+        if not tm:
+            i = m.end()
+            continue
+        body_start = k + 1 + tm.end() - 1  # offset of '{'
+        # brace-match the body
+        depth = 0
+        j = body_start
+        while j < len(scrubbed):
+            if scrubbed[j] == "{":
+                depth += 1
+            elif scrubbed[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        body = scrubbed[body_start:j + 1]
+        body, lambdas = _extract_lambdas(body)
+        start_line = scrubbed.count("\n", 0, body_start) + 1
+        out.append(FuncInfo(name, path, start_line, body, body_start))
+        for off, ltext in lambdas:
+            out.append(FuncInfo(
+                f"{name}<lambda>", path,
+                start_line + body[:off].count("\n"), ltext,
+                body_start + off))
+        i = j + 1 if j > i else m.end()
+    return out
+
+
+def analyze_function(fn: FuncInfo, locks: Dict[str, Lock],
+                     findings: List[Finding], rel: str,
+                     lines: List[str]) -> None:
+    body = fn.body
+
+    def lineno(off: int) -> int:
+        return fn.start_line + body[:off].count("\n")
+
+    guards: Dict[str, Acq] = {}
+    # guard-style acquisitions
+    for m in _GUARD.finditer(body):
+        kind, gvar, args = m.group(1), m.group(2), m.group(3)
+        if "defer_lock" in args or "adopt_lock" in args:
+            continue
+        first = args.split(",")[0]
+        ident = _last_ident(first)
+        if ident is None:
+            continue
+        lk = locks.get(ident)
+        ln = lineno(m.start())
+        if lk is None:
+            if not _allowed(lines, ln - 1, "lock-undeclared"):
+                findings.append(Finding(
+                    "lockorder", "lock-undeclared", f"{rel}:{ln}",
+                    f"acquisition of `{first.strip()}` resolves to no "
+                    f"declared lock"))
+            continue
+        # the guard holds to the end of its block, or to an explicit
+        # guard.unlock() (the tree unlocks deliberately before calling
+        # set_failed and friends — that discipline must be visible here)
+        end = _block_end(body, m.start())
+        um = re.search(r"\b%s\s*\.\s*unlock\s*\(" % re.escape(gvar),
+                       body[m.end():end])
+        if um:
+            end = m.end() + um.start()
+        acq = Acq(lk, m.start(), end, ln, gvar, first.strip(),
+                  blocking="try_to_lock" not in args)
+        fn.acqs.append(acq)
+        guards[gvar] = acq
+    # manual .lock() / pthread_mutex_lock
+    for m in list(_METHOD_LOCK.finditer(body)):
+        obj = m.group(1)
+        ident = _last_ident(obj)
+        if ident is None or ident in guards:
+            # guard.lock()/unlock() on a unique_lock var: treat the
+            # guard's own range as authoritative (re-lock windows are
+            # rare and the coarse range is the conservative direction)
+            continue
+        lk = locks.get(ident)
+        if lk is None:
+            continue  # `.lock()` on a non-mutex (unique_lock var etc.)
+        end = len(body)
+        um = re.search(re.escape(obj) + r"\s*(?:\.|->)\s*unlock\s*\(",
+                       body[m.end():])
+        if um:
+            end = m.end() + um.start()
+        fn.acqs.append(Acq(lk, m.start(), end, lineno(m.start()), None,
+                           obj, blocking=m.group(2) == "lock"))
+    for m in _PTHREAD_LOCK.finditer(body):
+        ident = _last_ident(m.group(1))
+        lk = locks.get(ident) if ident else None
+        if lk is None:
+            continue
+        end = len(body)
+        um = re.search(r"pthread_mutex_unlock\s*\(\s*" +
+                       re.escape(m.group(1).strip()), body[m.end():])
+        if um:
+            end = m.end() + um.start()
+        fn.acqs.append(Acq(
+            lk, m.start(), end, lineno(m.start()), None,
+            m.group(1).strip(),
+            blocking="trylock" not in body[m.start():m.start() + 24]))
+
+    # call sites + direct switch points
+    for m in _CALL.finditer(body):
+        name = m.group(1)
+        if name in _CALL_STOP:
+            continue
+        # `::shutdown(fd, ...)` / `::close(fd)` are libc syscalls, not
+        # the repo methods that share their names
+        if body[max(0, m.start() - 2):m.start()] == "::" and (
+                m.start() < 3 or not (body[m.start() - 3].isalnum() or
+                                      body[m.start() - 3] == "_")):
+            continue
+        args_end = body.find(")", m.end())
+        args = body[m.end():args_end] if args_end > 0 else ""
+        if name in SWITCH_POINTS:
+            fn.direct_blocking.append((name, m.start(), []))
+        elif name in CV_WAITS:
+            # the lock(s) this wait releases are exempt: collect guard
+            # vars named in the args
+            exempt = [g for g in guards if re.search(
+                r"\b%s\b" % re.escape(g), args)]
+            fn.direct_blocking.append((name, m.start(), exempt))
+        else:
+            fn.calls.append((name, m.start()))
+
+
+def _propagate(funcs: Dict[str, List[FuncInfo]]) -> None:
+    """Fixpoint transitive closure of acquires + may-block over the
+    by-name call graph."""
+    for fns in funcs.values():
+        for fn in fns:
+            fn.trans_acquires = {a.lock.name for a in fn.acqs
+                                 if a.blocking}
+            fn.may_block = bool(fn.direct_blocking)
+            if fn.direct_blocking:
+                fn.block_via = fn.direct_blocking[0][0]
+    changed = True
+    while changed:
+        changed = False
+        for fns in funcs.values():
+            for fn in fns:
+                for callee, _ in fn.calls:
+                    for cf in funcs.get(callee, []):
+                        extra = cf.trans_acquires - fn.trans_acquires
+                        if extra:
+                            fn.trans_acquires |= extra
+                            changed = True
+                        if cf.may_block and not fn.may_block:
+                            fn.may_block = True
+                            fn.block_via = f"{callee} -> {cf.block_via}"
+                            changed = True
+
+
+def check(src_dir: str = SRC_DIR,
+          dump: bool = False) -> List[Finding]:
+    findings: List[Finding] = []
+    sources = collect_sources(src_dir)
+    rank_table = parse_rank_table(src_dir)
+    locks = collect_locks(sources, rank_table, findings)
+
+    funcs: Dict[str, List[FuncInfo]] = {}
+    per_file: Dict[str, List[FuncInfo]] = {}
+    file_lines: Dict[str, List[str]] = {}
+    for path, text in sources.items():
+        rel = os.path.relpath(path, REPO_ROOT)
+        lines = text.splitlines()
+        file_lines[path] = lines
+        flist = parse_functions(path, text)
+        per_file[path] = flist
+        for fn in flist:
+            analyze_function(fn, locks, findings, rel, lines)
+            funcs.setdefault(fn.name, []).append(fn)
+    _propagate(funcs)
+
+    edges: List[Tuple[str, str, str, str]] = []  # (held, acquired, where, via)
+    for path, flist in per_file.items():
+        rel = os.path.relpath(path, REPO_ROOT)
+        lines = file_lines[path]
+        for fn in flist:
+            body = fn.body
+
+            def lineno(off: int) -> int:
+                return fn.start_line + body[:off].count("\n")
+
+            for acq in fn.acqs:
+                held = acq.lock
+                # direct nested acquisitions
+                for other in fn.acqs:
+                    if other is acq or not other.blocking:
+                        continue
+                    if acq.pos < other.pos < acq.end:
+                        edges.append((held.name, other.lock.name,
+                                      f"{rel}:{other.line}", "direct"))
+                        _check_edge(held, other.lock,
+                                    f"{rel}:{other.line}", "direct",
+                                    lines, other.line, findings)
+                # calls while held
+                for callee, off in fn.calls:
+                    if not (acq.pos < off < acq.end):
+                        continue
+                    for cf in funcs.get(callee, []):
+                        ln = lineno(off)
+                        for lname in sorted(cf.trans_acquires):
+                            tgt = _lock_by_name(locks, lname)
+                            if tgt is None:
+                                continue
+                            edges.append((held.name, lname,
+                                          f"{rel}:{ln}",
+                                          f"via {callee}()"))
+                            _check_edge(held, tgt, f"{rel}:{ln}",
+                                        f"via {callee}()", lines, ln,
+                                        findings)
+                        if cf.may_block:
+                            ln = lineno(off)
+                            if not _allowed(lines, ln - 1,
+                                            "lock-switch"):
+                                findings.append(Finding(
+                                    "lockorder", "lock-switch",
+                                    f"{rel}:{ln}",
+                                    f"`{held.name}` (rank "
+                                    f"{held.rank}) held across a "
+                                    f"blocking/switch point: "
+                                    f"{callee} -> {cf.block_via}"))
+                        break  # one summary per callee name is enough
+                # direct switch points while held
+                for bname, off, exempt in fn.direct_blocking:
+                    if not (acq.pos < off < acq.end):
+                        continue
+                    if acq.guard is not None and acq.guard in exempt:
+                        continue  # cv wait releases THIS lock
+                    ln = lineno(off)
+                    if _allowed(lines, ln - 1, "lock-switch"):
+                        continue
+                    findings.append(Finding(
+                        "lockorder", "lock-switch", f"{rel}:{ln}",
+                        f"`{held.name}` (rank {held.rank}) held across "
+                        f"fiber-switch/blocking point `{bname}()`"))
+
+    if dump:
+        seen = set()
+        print("== lock rank table ==")
+        for var, lk in sorted(locks.items(),
+                              key=lambda kv: (kv[1].rank is None,
+                                              kv[1].rank or 0)):
+            print(f"  {lk.rank if lk.rank is not None else '??':>4} "
+                  f" {lk.name:<24} ({var}, {lk.where})")
+        print("== acquires-while-holding edges ==")
+        for held, acquired, where, via in edges:
+            key = (held, acquired, via.split(" ")[0])
+            if key in seen:
+                continue
+            seen.add(key)
+            print(f"  {held} -> {acquired}  [{via}] at {where}")
+    return _dedupe(findings)
+
+
+def _lock_by_name(locks: Dict[str, Lock], name: str) -> Optional[Lock]:
+    for lk in locks.values():
+        if lk.name == name:
+            return lk
+    return None
+
+
+def _check_edge(held: Lock, acquired: Lock, where: str, via: str,
+                lines: List[str], line: int,
+                findings: List[Finding]) -> None:
+    if held.rank is None or acquired.rank is None:
+        return  # undeclared is its own finding
+    if held.name == acquired.name or acquired.rank <= held.rank:
+        if _allowed(lines, line - 1, "lock-order"):
+            return
+        findings.append(Finding(
+            "lockorder", "lock-order", where,
+            f"acquires `{acquired.name}` (rank {acquired.rank}) while "
+            f"holding `{held.name}` (rank {held.rank}) [{via}] — rank "
+            f"must strictly increase on nested acquisition"))
+
+
+def _dedupe(findings: List[Finding]) -> List[Finding]:
+    seen = set()
+    out = []
+    for f in findings:
+        key = (f.rule, f.where, f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(f)
+    return out
+
+
+def run(src_dir: str = SRC_DIR) -> List[Finding]:
+    return check(src_dir)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    src = SRC_DIR
+    dump = "--dump" in sys.argv
+    for a in sys.argv[1:]:
+        if a != "--dump":
+            src = a
+    fs = check(src, dump=dump)
+    for f in fs:
+        print(f)
+    sys.exit(1 if fs else 0)

@@ -1,0 +1,61 @@
+"""Model pass — build + run the dsched deterministic interleaving
+checker (native/model/) over the lock-free primitives.
+
+``make -C native nat_model`` compiles wsq.h + nat_desc_ring.h against
+the dsched virtual-thread shim (-DNAT_MODEL=1, src/nat_atomic.h seam)
+and ``nat_model --smoke`` explores every scenario (wsq, ring, arena,
+butex, recovery-vs-offer) exhaustively under a preemption bound plus
+seeded random walks. Deterministic: same seed => same trace => same
+hash, and a failing schedule prints a replayable seed / choice string.
+
+The pass fails on any FAIL line or nonzero exit; build failures are
+raised (natcheck reports the pass as broken, exit 2).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import List, Tuple
+
+from tools.natcheck import Finding, REPO_ROOT
+
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+
+
+def build_and_run(args=("--smoke",), timeout: int = 900,
+                  model_inc: str = "") -> Tuple[int, str]:
+    """Build nat_model (optionally with MODEL_INC include overrides so a
+    doctored header can shadow a shipped one — the golden tests' seam)
+    and run it. Returns (exit code, combined output)."""
+    make_cmd = ["make", "-C", NATIVE_DIR, "nat_model"]
+    if model_inc:
+        # force a rebuild: the include override changes what's compiled
+        make_cmd += [f"MODEL_INC={model_inc}", "-B"]
+    subprocess.run(make_cmd, check=True, capture_output=True,
+                   timeout=timeout)
+    proc = subprocess.run(
+        [os.path.join(NATIVE_DIR, "nat_model"), *args],
+        capture_output=True, timeout=timeout)
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    return proc.returncode, out
+
+
+def run() -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        rc, out = build_and_run()
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            "nat_model build failed: " +
+            (e.stderr or b"").decode(errors="replace")[-800:])
+    for line in out.splitlines():
+        if "FAIL" in line:
+            findings.append(Finding(
+                "model", "interleaving", "native/nat_model",
+                line.strip()))
+    if rc != 0 and not findings:
+        findings.append(Finding(
+            "model", "interleaving", "native/nat_model",
+            f"nat_model exited rc={rc}: {out.strip()[-400:]}"))
+    return findings
