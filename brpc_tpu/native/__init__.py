@@ -207,6 +207,26 @@ def load() -> ctypes.CDLL:
         lib.nat_grpc_respond.restype = ctypes.c_int
         lib.nat_rpc_server_ssl.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.nat_rpc_server_ssl.restype = ctypes.c_int
+        # -- overload protection (nat_overload.cpp) --
+        lib.nat_rpc_server_limiter.argtypes = [ctypes.c_char_p]
+        lib.nat_rpc_server_limiter.restype = ctypes.c_int
+        lib.nat_rpc_server_queue_deadline_ms.argtypes = [ctypes.c_int]
+        lib.nat_rpc_server_queue_deadline_ms.restype = ctypes.c_int
+        lib.nat_rpc_server_inflight.restype = ctypes.c_int
+        lib.nat_rpc_server_limit.restype = ctypes.c_int
+        # -- deterministic fault injection (nat_fault.cpp) --
+        lib.nat_fault_configure.argtypes = [ctypes.c_char_p]
+        lib.nat_fault_configure.restype = ctypes.c_int
+        lib.nat_fault_enabled.restype = ctypes.c_int
+        lib.nat_fault_injected.restype = ctypes.c_uint64
+        # -- client circuit breaker + retry budget (nat_channel.cpp) --
+        lib.nat_channel_set_breaker.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int]
+        lib.nat_channel_set_breaker.restype = ctypes.c_int
+        lib.nat_channel_breaker_state.argtypes = [ctypes.c_void_p]
+        lib.nat_channel_breaker_state.restype = ctypes.c_int
+        lib.nat_channel_retry_budget.argtypes = [ctypes.c_void_p]
+        lib.nat_channel_retry_budget.restype = ctypes.c_int
         lib.nat_take_request_batch.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int]
         lib.nat_take_request_batch.restype = ctypes.c_int
@@ -545,6 +565,70 @@ def http_respond(sock_id: int, seq: int, data: bytes,
     response; ordering across pipelined requests is enforced natively."""
     return load().nat_http_respond(sock_id, seq, data, len(data),
                                    1 if close_after else 0)
+
+
+def fault_configure(spec: str = "") -> int:
+    """Install (or clear, with "") the deterministic fault table — see
+    native/src/nat_fault.h for the grammar. 0 = ok, -1 = parse error.
+    Same seed + same per-site op sequence = same fault schedule. The
+    NAT_FAULT env var arms the table at library load (workers inherit
+    it); restore the env spec with fault_configure(os.environ.get(
+    "NAT_FAULT", ""))."""
+    return load().nat_fault_configure(spec.encode() or None)
+
+
+def fault_enabled() -> bool:
+    return bool(load().nat_fault_enabled())
+
+
+def fault_injected() -> int:
+    """Total faults injected in THIS process since load (also exported
+    as the nat_faults_injected counter)."""
+    return load().nat_fault_injected()
+
+
+def rpc_server_limiter(spec: str = "") -> int:
+    """Native server admission control: "" / "none" = off, "auto" =
+    gradient limiter (concurrency_limiter.py's AutoLimiter ported to the
+    C++ lane), "constant:N" / "N" = fixed limit. Rejections answer
+    ELIMIT(2004) / HTTP 503 / gRPC RESOURCE_EXHAUSTED on the wire."""
+    return load().nat_rpc_server_limiter(spec.encode() or None)
+
+
+def rpc_server_queue_deadline_ms(ms: int) -> int:
+    """Queue-deadline drop: py-lane requests older than `ms` when a
+    worker would take them are rejected with ELIMIT before dispatch
+    (bounded accepted-request tail latency). <= 0 disables."""
+    return load().nat_rpc_server_queue_deadline_ms(ms)
+
+
+def rpc_server_inflight() -> int:
+    """Currently admitted in-flight work requests (observability)."""
+    return load().nat_rpc_server_inflight()
+
+
+def rpc_server_limit() -> int:
+    """Effective concurrency limit (auto: the computed one); 0 = off."""
+    return load().nat_rpc_server_limit()
+
+
+def channel_set_breaker(handle, enable: bool = True) -> int:
+    """Per-channel circuit breaker (two-EMA-window isolation mirroring
+    rpc/circuit_breaker.py): errored completions trip it, the socket is
+    failed, calls fail fast through the isolation window, and the
+    health-check chain revives + resets it once the peer answers."""
+    return load().nat_channel_set_breaker(handle, 1 if enable else 0)
+
+
+def channel_breaker_state(handle) -> int:
+    """0 = closed (healthy), 1 = broken (isolated/awaiting revival)."""
+    return load().nat_channel_breaker_state(handle)
+
+
+def channel_retry_budget(handle) -> int:
+    """Remaining channel retry budget in deci-tokens (a retry costs 10;
+    every success replenishes 1, capped)."""
+    return load().nat_channel_retry_budget(handle)
 
 
 def rpc_server_redis(mode: int = 1) -> int:

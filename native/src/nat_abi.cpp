@@ -193,12 +193,22 @@ int main() {
       NAT_SYM(nat_grpc_respond),
       NAT_SYM(nat_redis_respond),
       NAT_SYM(nat_rpc_server_ssl),
+      NAT_SYM(nat_rpc_server_limiter),
+      NAT_SYM(nat_rpc_server_queue_deadline_ms),
+      NAT_SYM(nat_rpc_server_inflight),
+      NAT_SYM(nat_rpc_server_limit),
+      NAT_SYM(nat_fault_configure),
+      NAT_SYM(nat_fault_enabled),
+      NAT_SYM(nat_fault_injected),
       NAT_SYM(nat_channel_open),
       NAT_SYM(nat_channel_open_proto),
       NAT_SYM(nat_channel_close),
       NAT_SYM(nat_channel_call),
       NAT_SYM(nat_channel_call_full),
       NAT_SYM(nat_channel_acall),
+      NAT_SYM(nat_channel_set_breaker),
+      NAT_SYM(nat_channel_breaker_state),
+      NAT_SYM(nat_channel_retry_budget),
       NAT_SYM(nat_buf_free),
       NAT_SYM(nat_http_call),
       NAT_SYM(nat_http_acall),

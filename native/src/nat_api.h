@@ -93,6 +93,23 @@ int nat_redis_respond(uint64_t sock_id, int64_t seq, const char* data,
 // TLS on the native port (nat_ssl.cpp)
 int nat_rpc_server_ssl(const char* cert_path, const char* key_path);
 
+// ---- overload protection: native server admission control
+// (nat_overload.cpp) ----
+// limiter spec: "" / "none" / "0" = off, "auto" = gradient limiter,
+// "constant:N" or "N" = fixed max in-flight work requests. Rejections
+// answer ELIMIT(2004) / HTTP 503 / gRPC RESOURCE_EXHAUSTED on the wire.
+int nat_rpc_server_limiter(const char* spec);
+int nat_rpc_server_queue_deadline_ms(int ms);
+int nat_rpc_server_inflight(void);
+int nat_rpc_server_limit(void);
+
+// ---- deterministic fault injection (nat_fault.cpp) ----
+// spec grammar in nat_fault.h; also armed from the NAT_FAULT env var at
+// library load. NULL/"" clears. Same seed => same fault schedule.
+int nat_fault_configure(const char* spec);
+int nat_fault_enabled(void);
+uint64_t nat_fault_injected(void);
+
 // ---- native RPC runtime: client side (nat_channel.cpp / nat_client.cpp) ----
 void* nat_channel_open(const char* ip, int port, int nworkers,
                        int batch_writes, int connect_timeout_ms,
@@ -114,6 +131,11 @@ int nat_channel_acall(void* h, const char* service, const char* method,
                       const char* payload, size_t payload_len, int timeout_ms,
                       nat_acall_cb cb, void* arg);
 void nat_buf_free(char* p);
+// circuit breaker (two-EMA-window isolation mirroring
+// brpc_tpu/rpc/circuit_breaker.py) + channel-wide retry budget
+int nat_channel_set_breaker(void* h, int enable);
+int nat_channel_breaker_state(void* h);
+int nat_channel_retry_budget(void* h);
 int nat_http_call(void* h, const char* verb, const char* path,
                   const char* extra_headers, const char* body,
                   size_t body_len, int timeout_ms, int* status_out,

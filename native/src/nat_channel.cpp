@@ -21,6 +21,15 @@ void pc_free(PendingCall* pc) {
 // (bthread/fd.cpp:119-170): EINPROGRESS, poll for writability, then
 // SO_ERROR. Returns a connected nonblocking fd (TCP_NODELAY set) or -1.
 int dial_nonblocking(const char* ip, int port, int timeout_ms) {
+  // natfault connect site: injected dial delay (a blackholed-peer
+  // stand-in that exercises the connect-timeout clamps) or refusal.
+  NatFaultAct fca = NAT_FAULT_POINT(NF_CONNECT);
+  if (fca.action == NF_DELAY) {
+    nat_fault_delay_ms(fca.delay_ms);
+  } else if (fca.action == NF_ERR) {
+    errno = fca.err != 0 ? fca.err : ECONNREFUSED;
+    return -1;
+  }
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -1;
   struct sockaddr_in addr;
@@ -65,6 +74,15 @@ NatSocket* channel_socket(NatChannel* ch, int max_dial_ms) {
       ch->peer_port == 0) {
     return s;
   }
+  // Circuit breaker: while isolated, fail fast — no dial, no syscall.
+  // After the isolation window the re-dial below runs; success resets
+  // the breaker (the revival half of circuit_breaker.py's contract).
+  if (ch->breaker_enabled.load(std::memory_order_relaxed) &&
+      ch->breaker_broken.load(std::memory_order_acquire) &&
+      (int64_t)(nat_now_ns() / 1000000ull) <
+          ch->breaker_until_ms.load(std::memory_order_acquire)) {
+    return nullptr;
+  }
   // Dial OUTSIDE reconnect_mu — poll() can block up to the connect
   // timeout, and close()/other callers must not wait behind it. The
   // publish step below re-checks under the lock; a losing racer just
@@ -98,7 +116,81 @@ NatSocket* channel_socket(NatChannel* ch, int max_dial_ms) {
   ns->disp->add_consumer(ns);  // client sockets stay on epoll (measured
                                // slower on the ring: one-in-flight sends
                                // throttle request pipelining)
+  if (ch->breaker_broken.load(std::memory_order_acquire)) {
+    ch->breaker_reset(/*revived=*/true);  // isolation served + peer back
+  }
   return ns;
+}
+
+// ---------------------------------------------------------------------------
+// circuit breaker (two-EMA-window port of rpc/circuit_breaker.py)
+// ---------------------------------------------------------------------------
+
+// Window shapes mirror the Python flags' defaults: short window 128
+// samples / 10% error budget, long window 1024 / 5%; isolation starts
+// at 100ms and doubles (capped at 30s) when re-tripped within 30s.
+static constexpr double kBrkShortAlpha = 2.0 / (128 + 1);
+static constexpr double kBrkLongAlpha = 2.0 / (1024 + 1);
+static constexpr double kBrkShortThreshold = 0.10;
+static constexpr double kBrkLongThreshold = 0.05;
+static constexpr int kBrkMinIsolationMs = 100;
+static constexpr int kBrkMaxIsolationMs = 30000;
+
+void NatChannel::breaker_on_call_end(bool call_ok) {
+  bool trip = false;
+  {
+    std::lock_guard g(breaker_mu);
+    if (breaker_broken.load(std::memory_order_relaxed)) return;
+    double sample = call_ok ? 0.0 : 1.0;
+    brk_short_ema =
+        (1.0 - kBrkShortAlpha) * brk_short_ema + kBrkShortAlpha * sample;
+    brk_long_ema =
+        (1.0 - kBrkLongAlpha) * brk_long_ema + kBrkLongAlpha * sample;
+    if (brk_short_ema >= kBrkShortThreshold ||
+        brk_long_ema >= kBrkLongThreshold) {
+      int64_t now_ms = (int64_t)(nat_now_ns() / 1000000ull);
+      if (brk_last_isolation_ms != 0 &&
+          now_ms - brk_last_isolation_ms < 30000) {
+        brk_isolation_ms = brk_isolation_ms * 2 < kBrkMaxIsolationMs
+                               ? brk_isolation_ms * 2
+                               : kBrkMaxIsolationMs;
+      } else {
+        brk_isolation_ms = kBrkMinIsolationMs;
+      }
+      if (brk_isolation_ms < kBrkMinIsolationMs) {
+        brk_isolation_ms = kBrkMinIsolationMs;
+      }
+      brk_last_isolation_ms = now_ms;
+      breaker_until_ms.store(now_ms + brk_isolation_ms,
+                             std::memory_order_release);
+      breaker_broken.store(true, std::memory_order_release);
+      trip = true;
+    }
+  }
+  if (trip) {
+    nat_counter_add(NS_BREAKER_ISOLATIONS, 1);
+    // isolate OUTSIDE breaker_mu: set_failed sweeps pendings and arms
+    // the health-check revival chain, which owns bringing the node back
+    NatSocket* s = sock_address(sock_id.load(std::memory_order_acquire));
+    if (s != nullptr) {
+      s->set_failed();
+      s->release();
+    }
+  }
+}
+
+void NatChannel::breaker_reset(bool revived) {
+  bool was_broken;
+  {
+    std::lock_guard g(breaker_mu);
+    brk_short_ema = 0.0;
+    brk_long_ema = 0.0;
+    // exchange under the mutex: concurrent post-isolation dialers both
+    // see broken==true before the reset, but exactly one wins the
+    // revival (the counter must advance once per actual revival)
+    was_broken = breaker_broken.exchange(false, std::memory_order_acq_rel);
+  }
+  if (revived && was_broken) nat_counter_add(NS_BREAKER_REVIVALS, 1);
 }
 
 // Background revival of a failed channel connection (the health-check
@@ -116,12 +208,33 @@ static void health_check_dial_fiber(void* raw) {
   NatSocket* s = channel_socket(ch);
   if (s != nullptr) {  // revived (or never died)
     s->release();
+    ch->hc_backoff_shift.store(0, std::memory_order_relaxed);
     ch->hc_pending.store(false, std::memory_order_release);
     ch->release();
     return;
   }
-  TimerThread::instance()->schedule(health_check_fire, ch,
-                                    ch->health_check_interval_ms);
+  // Exponential backoff with jitter: a dead peer must not be hammered
+  // at a fixed rate by every client holding a channel to it. The first
+  // retry fired at the base interval (set_failed resets the shift);
+  // failures double the delay up to min(64x interval, 30s), and a
+  // ±25% deterministic dither decorrelates channels that failed
+  // together (the retry-dispersal concern, applied to revival probes).
+  int shift = ch->hc_backoff_shift.load(std::memory_order_relaxed);
+  int64_t base = ch->health_check_interval_ms > 0
+                     ? ch->health_check_interval_ms
+                     : 1;
+  int64_t cap = base * 64 < 30000 ? base * 64 : 30000;
+  if (cap < base) cap = base;
+  int64_t delay = base << (shift < 6 ? shift : 6);
+  if (delay > cap) delay = cap;
+  uint64_t h =
+      nat_mix64((uint64_t)(uintptr_t)ch ^ ((uint64_t)(shift + 1) << 48));
+  int64_t jitter = (int64_t)(h % (uint64_t)(delay / 2 + 1)) - delay / 4;
+  delay += jitter;
+  if (delay < 1) delay = 1;
+  ch->hc_backoff_shift.store(shift < 6 ? shift + 1 : 6,
+                             std::memory_order_relaxed);
+  TimerThread::instance()->schedule(health_check_fire, ch, (int)delay);
 }
 
 void health_check_fire(void* raw) {
@@ -281,6 +394,20 @@ static void backup_fire(void* raw) {
   Scheduler::instance()->spawn_detached(backup_fire_work, raw);
 }
 
+// Channel-wide retry clamp: a retry costs 10 deci-tokens from the
+// budget successes replenish (note_call_success), so an injected
+// failure burst cannot amplify into a retry storm — once the budget is
+// dry, failures surface instead of multiplying wire attempts.
+static bool take_retry_token(NatChannel* ch) {
+  int v = ch->retry_budget_decis.fetch_sub(10, std::memory_order_acq_rel);
+  if (v < 10) {
+    ch->retry_budget_decis.fetch_add(10, std::memory_order_acq_rel);
+    nat_counter_add(NS_RETRY_BUDGET_EXHAUSTED, 1);
+    return false;
+  }
+  return true;
+}
+
 // One wire attempt: build, (optionally) arm deadline + backup, write,
 // park, harvest. Returns the RPC error code.
 static int call_attempt(NatChannel* ch, NatSocket* s, const char* service,
@@ -390,8 +517,17 @@ int nat_channel_call_full(void* h, const char* service, const char* method,
     // slow dial can't stretch the overall deadline.
     NatSocket* s = channel_socket(ch, remaining_ms);
     if (s == nullptr) {
+      // breaker isolation: fail fast — no dial happened, so spinning
+      // the retry loop (and spending budget tokens on zero wire
+      // attempts) would only starve the budget for real retries when
+      // the peer revives
+      if (ch->breaker_enabled.load(std::memory_order_relaxed) &&
+          ch->breaker_broken.load(std::memory_order_acquire)) {
+        return kEFAILEDSOCKET;
+      }
       if (attempt++ < max_retry &&
-          !ch->closed.load(std::memory_order_acquire)) {
+          !ch->closed.load(std::memory_order_acquire) &&
+          take_retry_token(ch)) {
         continue;  // the next channel_socket re-dials
       }
       return kEFAILEDSOCKET;
@@ -412,7 +548,8 @@ int nat_channel_call_full(void* h, const char* service, const char* method,
                           err_text_out);
     s->release();
     if (rc != kEFAILEDSOCKET || attempt++ >= max_retry ||
-        ch->closed.load(std::memory_order_acquire)) {
+        ch->closed.load(std::memory_order_acquire) ||
+        !take_retry_token(ch)) {
       return rc;
     }
     if (err_text_out != nullptr && *err_text_out != nullptr) {
@@ -432,6 +569,29 @@ int nat_channel_call(void* h, const char* service, const char* method,
 }
 
 void nat_buf_free(char* p) { free(p); }
+
+// Per-channel circuit breaker toggle (default off — single-connection
+// channels in tests would otherwise isolate themselves on deliberate
+// failure storms). Disabling also clears a live isolation.
+int nat_channel_set_breaker(void* h, int enable) {
+  NatChannel* ch = (NatChannel*)h;
+  ch->breaker_enabled.store(enable != 0, std::memory_order_release);
+  if (enable == 0) ch->breaker_reset(/*revived=*/false);
+  return 0;
+}
+
+// 0 = closed (healthy), 1 = broken (isolated or awaiting revival).
+int nat_channel_breaker_state(void* h) {
+  return ((NatChannel*)h)->breaker_broken.load(std::memory_order_acquire)
+             ? 1
+             : 0;
+}
+
+// Remaining retry budget in deci-tokens (one retry costs 10).
+int nat_channel_retry_budget(void* h) {
+  return ((NatChannel*)h)
+      ->retry_budget_decis.load(std::memory_order_relaxed);
+}
 
 // Asynchronous call for embedders (the done-closure surface): cb runs on
 // a framework thread/fiber when the response (or failure) arrives —

@@ -51,6 +51,8 @@ struct HttpCliSessN {
   IOBuf body_acc;
 };
 
+static void http_cli_finish(PendingCall* pc);
+
 // EOF on a client socket: a phase-2 (close-delimited) body is complete —
 // claim the FIFO-head call and finish it with the accumulated bytes
 // BEFORE fail_all turns it into an error. Called from set_failed.
@@ -81,12 +83,7 @@ void http_cli_on_socket_fail(NatSocket* s) {
   if (pc == nullptr) return;
   pc->aux_status = status;
   pc->response.append(std::move(body));
-  if (pc->cb != nullptr) {
-    pc->cb(pc, pc->cb_arg);
-  } else {
-    pc->done.value.store(1, std::memory_order_release);
-    Scheduler::butex_wake(&pc->done, INT32_MAX);
-  }
+  http_cli_finish(pc);
 }
 
 void http_cli_free(HttpCliSessN* c) { delete c; }
@@ -113,6 +110,16 @@ static PendingCall* http_cli_take_head(NatSocket* s, bool* head_out) {
 }
 
 static void http_cli_finish(PendingCall* pc) {
+  // verdict for the HTTP client lane: transport errors and 5xx count
+  // against the peer, only real successes replenish the retry budget
+  // (the take_pending ok-arm defers to this layer, which knows status)
+  if (pc->owner != nullptr) {
+    bool call_ok = pc->error_code == 0 && pc->aux_status < 500;
+    if (call_ok) pc->owner->note_call_success();
+    if (pc->owner->breaker_enabled.load(std::memory_order_relaxed)) {
+      pc->owner->breaker_on_call_end(call_ok);
+    }
+  }
   if (pc->cb != nullptr) {
     pc->cb(pc, pc->cb_arg);
   } else {
@@ -623,6 +630,18 @@ static void h2c_complete(NatSocket* s, H2CliSessN* h, uint32_t sid) {
           pc->response.append(data.data() + 5, mlen);
         }
       }
+    }
+  }
+  // verdict for the h2/gRPC client lane: transport failures and
+  // server-stress statuses (RESOURCE_EXHAUSTED, UNAVAILABLE) count
+  // against the peer; application-level statuses do not. Only real
+  // successes replenish the retry budget.
+  {
+    bool call_ok = pc->error_code == 0 &&
+                   pc->aux_status != 8 && pc->aux_status != 14;
+    if (call_ok) ch->note_call_success();
+    if (ch->breaker_enabled.load(std::memory_order_relaxed)) {
+      ch->breaker_on_call_end(call_ok);
     }
   }
   if (pc->cb != nullptr) {

@@ -39,6 +39,7 @@
 
 #include "iobuf.h"
 #include "nat_api.h"
+#include "nat_fault.h"
 #include "nat_lockrank.h"
 #include "nat_stats.h"
 #include "ring_listener.h"
@@ -53,6 +54,7 @@ inline constexpr int kENOSERVICE = 1001;
 inline constexpr int kENOMETHOD = 1002;
 inline constexpr int kERPCTIMEDOUT = 1008;
 inline constexpr int kEFAILEDSOCKET = 1009;
+inline constexpr int kELIMIT = 2004;  // max concurrency reached
 
 inline constexpr char kMagicRpc[4] = {'T', 'R', 'P', 'C'};
 
@@ -305,6 +307,34 @@ struct PyRequest;
 // arena-backed PyRequest's field views point into (no-op otherwise).
 void shm_req_span_release(PyRequest* r);
 
+// ---------------------------------------------------------------------------
+// overload protection (nat_overload.cpp): native server admission control
+// — constant + gradient ("auto") limiters ported from
+// brpc_tpu/rpc/concurrency_limiter.py, real ELIMIT wire responses, and a
+// queue-deadline drop (expired requests rejected before dispatch).
+// ---------------------------------------------------------------------------
+
+// Nonzero while a limiter OR a queue deadline is configured: the
+// enqueue-side gate is one relaxed load when everything is off.
+extern std::atomic<uint32_t> g_overload_on;
+
+// Admission gate for one work request (kinds 0/3/4/6): stamps
+// enqueue_ns, and when the limiter votes to reject, emits the per-lane
+// ELIMIT wire response, frees `r` and returns false. On admit, marks
+// r->admitted (the accounting token released by admission_on_complete).
+bool overload_admit(PyRequest* r);
+// True when a configured queue deadline has expired for `r`.
+bool overload_expired(const PyRequest* r, uint64_t now_ns);
+// Reject an expired queued request: ELIMIT response, accounting, free.
+// Must be called with NO server/session locks held (it writes responses).
+void overload_expire(PyRequest* r);
+// One admitted request left the system; `latency_ns` feeds the gradient
+// limiter when ok. Callers: ~PyRequest (in-process lane), the shm
+// in-flight table's erase sites, overload_expire.
+void admission_on_complete(uint64_t latency_ns, bool ok);
+// Server (re)start hygiene: zero the in-flight count.
+void overload_server_reset();
+
 struct PyRequest {
   int32_t kind = 0;
   uint64_t sock_id = 0;
@@ -334,9 +364,23 @@ struct PyRequest {
   uint64_t shm_span = 0;   // span-start offset (monotone) for the release
   const char* shm_view[5] = {nullptr, nullptr, nullptr, nullptr, nullptr};
   size_t shm_view_len[5] = {0, 0, 0, 0, 0};
+  // overload accounting (nat_overload.cpp): enqueue_ns stamped when a
+  // limiter/deadline is configured; admitted = this request holds one
+  // in-flight slot, released exactly once (dtor, or transferred to the
+  // shm in-flight table when the request rides the worker rings).
+  // admit_ok mirrors AutoLimiter.on_response's error filter: responders
+  // that complete a request with an error clear it so the failure-storm
+  // latency profile never inflates the gradient limiter's window.
+  uint64_t enqueue_ns = 0;
+  bool admitted = false;
+  bool admit_ok = true;
   ~PyRequest() {
     ::free(big_payload);
     if (shm_slot >= 0) shm_req_span_release(this);
+    if (admitted) {
+      admission_on_complete(
+          enqueue_ns != 0 ? nat_now_ns() - enqueue_ns : 0, admit_ok);
+    }
   }
 };
 
@@ -415,8 +459,17 @@ class NatServer {
   bool py_stopping = false;
 
   void enqueue_py(PyRequest* r) {
-    // kind 2 is a connection-drop control message, not work handed to
-    // Python usercode — it must not inflate nat_py_dispatches
+    // admission control (nat_overload.cpp): one relaxed load when off;
+    // a rejected request already answered ELIMIT on the wire and is gone
+    if (g_overload_on.load(std::memory_order_relaxed) != 0 &&
+        !overload_admit(r)) {
+      return;
+    }
+    // counted AFTER the gate: kind 2 is a connection-drop control
+    // message and admission-rejected requests never enter the lane —
+    // neither inflates nat_py_dispatches. (Queue-deadline drops DO
+    // count: they entered the lane and expired inside it; the drop
+    // shows up in nat_queue_deadline_drops.)
     if (r->kind != 2) nat_counter_add(NS_PY_DISPATCHES, 1);
     // worker-process lane first (kinds 3/4 when enabled): usercode runs
     // across N interpreters instead of behind this process's GIL
@@ -429,28 +482,68 @@ class NatServer {
   }
 
   PyRequest* take_py(int timeout_ms) {
-    std::unique_lock lk(py_mu);
-    if (py_q.empty() && !py_stopping) {
-      nat_cv_wait_for(py_cv, lk, std::chrono::milliseconds(timeout_ms));
+    // queue-deadline drop: requests that sat longer than the configured
+    // budget are rejected HERE, before a Python worker spends usercode
+    // time on them — the ELIMIT emits happen after py_mu is released
+    // (the responders take session locks that rank below it).
+    PyRequest* r = nullptr;
+    PyRequest* expired[8];
+    int nexp = 0;
+    {
+      std::unique_lock lk(py_mu);
+      if (py_q.empty() && !py_stopping) {
+        nat_cv_wait_for(py_cv, lk, std::chrono::milliseconds(timeout_ms));
+      }
+      uint64_t now = g_overload_on.load(std::memory_order_relaxed) != 0
+                         ? nat_now_ns()
+                         : 0;
+      while (!py_q.empty()) {
+        PyRequest* f = py_q.front();
+        if (now == 0 || !overload_expired(f, now)) {
+          py_q.pop_front();
+          r = f;
+          break;
+        }
+        // expired: never hand it to usercode — when this call's drop
+        // budget is spent, leave the rest queued for the next take
+        if (nexp >= 8) break;
+        py_q.pop_front();
+        expired[nexp++] = f;
+      }
     }
-    if (py_q.empty()) return nullptr;
-    PyRequest* r = py_q.front();
-    py_q.pop_front();
+    for (int i = 0; i < nexp; i++) overload_expire(expired[i]);
     return r;
   }
 
   // Batch take: one condvar round + one FFI crossing covers a whole
   // burst (the py lane's per-item wakeup was measurable at qps scale).
   int take_py_batch(PyRequest** out, int max, int timeout_ms) {
-    std::unique_lock lk(py_mu);
-    if (py_q.empty() && !py_stopping) {
-      nat_cv_wait_for(py_cv, lk, std::chrono::milliseconds(timeout_ms));
-    }
+    PyRequest* expired[16];
+    int nexp = 0;
     int n = 0;
-    while (n < max && !py_q.empty()) {
-      out[n++] = py_q.front();
-      py_q.pop_front();
+    {
+      std::unique_lock lk(py_mu);
+      if (py_q.empty() && !py_stopping) {
+        nat_cv_wait_for(py_cv, lk, std::chrono::milliseconds(timeout_ms));
+      }
+      uint64_t now = g_overload_on.load(std::memory_order_relaxed) != 0
+                         ? nat_now_ns()
+                         : 0;
+      while (n < max && !py_q.empty()) {
+        PyRequest* f = py_q.front();
+        if (now != 0 && overload_expired(f, now)) {
+          // expired work never reaches usercode; once this call's drop
+          // budget is spent, stop (the rest drains on the next take)
+          if (nexp >= 16) break;
+          py_q.pop_front();
+          expired[nexp++] = f;
+          continue;
+        }
+        py_q.pop_front();
+        out[n++] = f;
+      }
     }
+    for (int i = 0; i < nexp; i++) overload_expire(expired[i]);
     return n;
   }
 };
@@ -525,6 +618,29 @@ class NatChannel {
   bool defer_writes_flag = false;
   std::atomic<bool> closed{false};
   std::atomic<bool> hc_pending{false};
+  // Health-check re-dial backoff: the CURRENT chain's exponent (reset to
+  // 0 when a chain starts and on revival, so the first retry stays fast;
+  // only the single hc fiber advances it — atomic for the cross-thread
+  // reset from set_failed).
+  std::atomic<int> hc_backoff_shift{0};
+  // Retry budget (brpc retry-dispersal discipline in token form): deci-
+  // tokens; a retry spends 10, every success replenishes 1 up to the
+  // cap, so an injected failure burst can spend at most budget/10
+  // retries before new retries need fresh successes to pay for them.
+  static const int kRetryBudgetCap = 100;
+  std::atomic<int> retry_budget_decis{100};
+  // Circuit breaker (two-EMA-window port of rpc/circuit_breaker.py):
+  // default off; enabled via nat_channel_set_breaker. While broken and
+  // inside the isolation window, channel_socket fails fast (no dial);
+  // the health-check chain re-dials after expiry and resets the breaker.
+  std::atomic<bool> breaker_enabled{false};
+  std::atomic<bool> breaker_broken{false};
+  std::atomic<int64_t> breaker_until_ms{0};  // CLOCK_MONOTONIC ms
+  NatMutex<kLockRankBreaker> breaker_mu;
+  double brk_short_ema = 0.0;          // under breaker_mu
+  double brk_long_ema = 0.0;           // under breaker_mu
+  int brk_isolation_ms = 0;            // under breaker_mu
+  int64_t brk_last_isolation_ms = 0;   // under breaker_mu
   NatMutex<kLockRankReconnect> reconnect_mu;
   // Lifetime: the owning socket holds one reference (released in
   // ~NatSocket) and the opener holds one (released in nat_channel_close),
@@ -603,13 +719,35 @@ class NatChannel {
         if (pc->start_ns != 0) {
           nat_lat_record(NL_CLIENT, nat_now_ns() - pc->start_ns);
         }
+        // breaker verdict + retry-budget replenish are fed by the
+        // protocol layers (messenger / client-lane finishers), which
+        // inspect the response's ACTUAL status — a transport-level
+        // "ok" here may still be a server error frame / 5xx / grpc 8
       } else {
         nat_counter_add(NS_CLIENT_ERRORS, 1);
+        if (breaker_enabled.load(std::memory_order_relaxed)) {
+          breaker_on_call_end(false);
+        }
       }
       return pc;
     }
     return nullptr;
   }
+
+  // Retry-budget replenish: +1 deci-token per success, capped. At the
+  // cap (steady state) this is one relaxed load, no RMW.
+  void note_call_success() {
+    int v = retry_budget_decis.load(std::memory_order_relaxed);
+    while (v < kRetryBudgetCap &&
+           !retry_budget_decis.compare_exchange_weak(
+               v, v + 1, std::memory_order_relaxed)) {
+    }
+  }
+
+  // Circuit-breaker surface (nat_channel.cpp): feed one finished call;
+  // a trip fails the socket and arms the health-check revival chain.
+  void breaker_on_call_end(bool call_ok);
+  void breaker_reset(bool revived);
 
   void fail_all(int32_t code, const char* text) {
     uint32_t n = nslots_.load(std::memory_order_acquire);
@@ -622,6 +760,12 @@ class NatChannel {
         continue;  // a response beat us to it
       }
       nat_counter_add(NS_CLIENT_ERRORS, 1);
+      // every swept call is an error sample for the breaker (brpc feeds
+      // OnCallEnd from socket sweeps too); a trip from here re-enters
+      // set_failed, which is idempotent via its failed.exchange
+      if (breaker_enabled.load(std::memory_order_relaxed)) {
+        breaker_on_call_end(false);
+      }
       pc->error_code = code;
       pc->error_text = text;
       if (pc->cb != nullptr) {

@@ -50,9 +50,14 @@ enum : int {
   kLockRankH2Cli = 52,        // H2CliSessN::h2c_mu
   kLockRankSslSess = 54,      // SslSessionN::ssl_mu (sessions write
                               // through the TLS session: session < ssl)
+  kLockRankBreaker = 55,      // NatChannel::breaker_mu (fed from
+                              // take_pending, which client-lane readers
+                              // may reach while holding session locks)
   kLockRankChanGrow = 56,     // NatChannel::grow_mu_
   // 57: server.py (raw, cv partner)
   kLockRankShmInflight = 58,  // g_inflight_mu: reaper table
+  kLockRankOverload = 59,     // g_adm_mu: auto-limiter window (completion
+                              // accounting runs under py_mu/inflight)
   kLockRankSockAlloc = 60,    // g_sock_alloc_mu: registry slab/freelist
   kLockRankSockWrite = 62,    // NatSocket::write_mu
   kLockRankRingRetry = 64,    // g_ring_retry_mu

@@ -466,6 +466,15 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
         s->in_buf.cut_into(&pc->response, payload_size);
         s->in_buf.cut_into(&pc->attachment, att_size);
       }
+      // tpu_std verdict: error frames (incl. ELIMIT shed) count against
+      // the peer for the breaker and do not replenish the retry budget
+      {
+        bool call_ok = pc->error_code == 0;
+        if (call_ok) s->channel->note_call_success();
+        if (s->channel->breaker_enabled.load(std::memory_order_relaxed)) {
+          s->channel->breaker_on_call_end(call_ok);
+        }
+      }
       if (pc->cb != nullptr) {
         pc->cb(pc, pc->cb_arg);  // async completion; cb owns pc
       } else {
@@ -578,17 +587,32 @@ bool drain_socket_inline(NatSocket* s) {
   bool dead = false;
   while (!s->failed.load(std::memory_order_acquire)) {
     ssize_t n;
+    // natfault read site: injected errno (ECONNRESET kills the socket
+    // and drives the reconnect/health-check machinery; EINTR/EAGAIN
+    // exercise the drain loop's retry arms), short reads (1 byte —
+    // every parser must stay incremental), EOF, delays. One op per
+    // read syscall, whichever of the three paths below performs it.
+    NatFaultAct fra = NAT_FAULT_POINT(NF_READ);
+    if (fra.action == NF_DELAY) nat_fault_delay_ms(fra.delay_ms);
     if (s->fill_req != nullptr && s->ssl_sess == nullptr) {
       // large-payload fill: the read syscall writes STRAIGHT into the
       // request buffer — zero userspace copies for the payload bytes
       PyRequest* r = s->fill_req;
       size_t want = r->big_len - s->fill_off;
       if (want > (4u << 20)) want = 4u << 20;  // grow-as-received slice
+      if (fra.action == NF_SHORT) want = 1;
       if (!stream_fill_reserve(r, s->fill_off + want)) {
         dead = true;
         break;
       }
-      n = ::read(s->fd, r->big_payload + s->fill_off, want);
+      if (fra.action == NF_ERR) {
+        errno = fra.err;
+        n = -1;
+      } else if (fra.action == NF_EOF) {
+        n = 0;
+      } else {
+        n = ::read(s->fd, r->big_payload + s->fill_off, want);
+      }
       if (n > 0) {
         nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)n);
         s->fill_off += (size_t)n;
@@ -608,13 +632,26 @@ bool drain_socket_inline(NatSocket* s) {
       // TLS lane: ciphertext goes through the session; plaintext lands
       // in in_buf inside ssl_feed
       char tmp[65536];
-      n = ::read(s->fd, tmp, sizeof(tmp));
+      if (fra.action == NF_ERR) {
+        errno = fra.err;
+        n = -1;
+      } else if (fra.action == NF_EOF) {
+        n = 0;
+      } else {
+        n = ::read(s->fd, tmp, fra.action == NF_SHORT ? 1 : sizeof(tmp));
+      }
       if (n > 0 && !ssl_feed(s, tmp, (size_t)n)) {
         dead = true;
         break;
       }
+    } else if (fra.action == NF_ERR) {
+      errno = fra.err;
+      n = -1;
+    } else if (fra.action == NF_EOF) {
+      n = 0;
     } else {
-      n = s->in_buf.append_from_fd(s->fd, 65536);
+      n = s->in_buf.append_from_fd(s->fd,
+                                   fra.action == NF_SHORT ? 1 : 65536);
     }
     if (n > 0) {
       nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)n);

@@ -248,6 +248,8 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
   }
   if (ensure_runtime(nworkers) != 0) return -1;
   nat_stats_register_gauge(NS_PY_QUEUE_DEPTH, py_queue_depth_gauge);
+  overload_server_reset();  // stale admission tokens die with the old
+                            // server; the limiter config itself persists
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -1;
   int one = 1;
@@ -501,6 +503,9 @@ int nat_respond(void* h, int32_t error_code, const char* error_text,
                 const char* payload, size_t payload_len, const char* att,
                 size_t att_len) {
   PyRequest* r = (PyRequest*)h;
+  // error completions must not feed the gradient limiter's latency
+  // window as capacity samples (AutoLimiter.on_response's filter)
+  if (error_code != 0) r->admit_ok = false;
   NatSocket* s = sock_address(r->sock_id);
   int rc = -1;
   if (s != nullptr) {

@@ -188,6 +188,13 @@ void futex_wait_shared(std::atomic<uint32_t>* a, uint32_t expect,
 #endif
 }
 void futex_wake_shared(std::atomic<uint32_t>* a) {
+  // natfault doorbell site: a dropped wake verifies the waiter-gated
+  // protocol degrades to bounded-timeout polls (200ms waits
+  // everywhere), never to a lost record or a wedged consumer. A drop
+  // IS the delay fault here (the consumer wakes on its poll timeout);
+  // an inline sleep is not allowed — wake paths may hold producer locks.
+  NatFaultAct fda = NAT_FAULT_POINT(NF_DOORBELL);
+  if (fda.action == NF_DROP || fda.action == NF_DELAY) return;
 #if defined(__SANITIZE_THREAD__)
   __tsan_release((void*)a);  // everything published is visible to wakees
 #endif
@@ -266,7 +273,21 @@ struct InflightEntry {
   uint8_t kind;
   int8_t slot;  // worker the request was routed to (crash fast-reap)
   std::chrono::steady_clock::time_point deadline;
+  // admission accounting (nat_overload.cpp): the in-flight token moves
+  // from the PyRequest onto this entry when the request rides the rings
+  // (shm_lane_offer), and is released exactly once at whichever erase
+  // site retires the entry (response emit, reap, crash fast-reap).
+  bool admitted = false;
+  uint64_t enqueue_ns = 0;
 };
+
+// Release an erased entry's admission token (call with g_inflight_mu
+// NOT held; the limiter window has its own lock).
+void inflight_entry_complete(const InflightEntry& e, bool ok) {
+  if (!e.admitted) return;
+  admission_on_complete(
+      ok && e.enqueue_ns != 0 ? nat_now_ns() - e.enqueue_ns : 0, ok);
+}
 NatMutex<kLockRankShmInflight> g_inflight_mu;
 // leaked: the reaper/drainer may outrun static destruction at exit()
 std::map<InflightKey, InflightEntry>& g_inflight =
@@ -288,38 +309,44 @@ void emit_reaped(uint8_t kind, uint64_t sock_id, int64_t seq) {
 
 void reap_expired() {
   auto now = std::chrono::steady_clock::now();
-  std::vector<std::pair<InflightKey, uint8_t>> dead;
+  std::vector<std::pair<InflightKey, InflightEntry>> dead;
   {
     std::lock_guard g(g_inflight_mu);
     for (auto it = g_inflight.begin(); it != g_inflight.end();) {
       if (it->second.deadline <= now) {
-        dead.emplace_back(it->first, it->second.kind);
+        dead.emplace_back(it->first, it->second);
         it = g_inflight.erase(it);
       } else {
         ++it;
       }
     }
   }
-  for (auto& d : dead) emit_reaped(d.second, d.first.sock_id, d.first.seq);
+  for (auto& d : dead) {
+    emit_reaped(d.second.kind, d.first.sock_id, d.first.seq);
+    inflight_entry_complete(d.second, /*ok=*/false);
+  }
 }
 
 // Reap every in-flight request routed to `slot` NOW (its worker is dead:
 // no answer is coming — waiting out the 30s timeout just serves 503s
 // slower).
 void reap_slot_inflight(int slot) {
-  std::vector<std::pair<InflightKey, uint8_t>> dead;
+  std::vector<std::pair<InflightKey, InflightEntry>> dead;
   {
     std::lock_guard g(g_inflight_mu);
     for (auto it = g_inflight.begin(); it != g_inflight.end();) {
       if (it->second.slot == slot) {
-        dead.emplace_back(it->first, it->second.kind);
+        dead.emplace_back(it->first, it->second);
         it = g_inflight.erase(it);
       } else {
         ++it;
       }
     }
   }
-  for (auto& d : dead) emit_reaped(d.second, d.first.sock_id, d.first.seq);
+  for (auto& d : dead) {
+    emit_reaped(d.second.kind, d.first.sock_id, d.first.seq);
+    inflight_entry_complete(d.second, /*ok=*/false);
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -370,6 +397,7 @@ void emit_response(int slot, const CellView& c) {
     span_release(arena, c.span_off);
     return;  // corrupt record: drop (reaper answers the request)
   }
+  InflightEntry done_entry;
   {
     // already reaped (worker answered late): drop — emitting twice
     // would poison the session reorder windows
@@ -379,8 +407,16 @@ void emit_response(int slot, const CellView& c) {
       span_release(arena, c.span_off);
       return;
     }
+    done_entry = it->second;
     g_inflight.erase(it);
   }
+  // errored worker responses must not feed the gradient limiter's
+  // capacity window (the in-process lane's admit_ok filter, mirrored):
+  // gRPC status rides the descriptor; HTTP status is the serialized
+  // head's first digit ("HTTP/1.1 5xx")
+  bool resp_ok = !(c.kind == 4 && c.status != 0) &&
+                 !(c.kind == 3 && payload_len >= 10 && payload[9] == '5');
+  inflight_entry_complete(done_entry, resp_ok);
   if (c.kind == 3 && payload_len >= kUserBlockMin) {
     // zero-copy emit: the response IOBuf references the arena span via a
     // user block; the span releases when the socket writev consumed it
@@ -654,11 +690,15 @@ bool shm_lane_offer(PyRequest* r) {
   // may answer instantly, and the drainer drops responses with no entry
   {
     std::lock_guard g(g_inflight_mu);
+    // admitted stays false until the push lands: the failure path below
+    // erases this entry and the request continues on the in-process
+    // lane, which still owns the admission token
     g_inflight[InflightKey{r->sock_id, r->cid}] = InflightEntry{
         (uint8_t)r->kind, (int8_t)-1,
         std::chrono::steady_clock::now() +
             std::chrono::milliseconds(
-                g_reap_timeout_ms.load(std::memory_order_relaxed))};
+                g_reap_timeout_ms.load(std::memory_order_relaxed)),
+        false, 0};
   }
   int slot = -1;
   bool ok = push_to_some_worker(
@@ -672,7 +712,21 @@ bool shm_lane_offer(PyRequest* r) {
   {
     std::lock_guard g(g_inflight_mu);
     auto it = g_inflight.find(InflightKey{r->sock_id, r->cid});
-    if (it != g_inflight.end()) it->second.slot = (int8_t)slot;
+    if (it != g_inflight.end()) {
+      it->second.slot = (int8_t)slot;
+      // transfer the admission token onto the entry: the erase sites
+      // (emit/reap) release it, not ~PyRequest
+      it->second.admitted = r->admitted;
+      it->second.enqueue_ns = r->enqueue_ns;
+      r->admitted = false;
+    }
+  }
+  if (r->admitted) {
+    // the worker answered (and the entry was erased) before the token
+    // could transfer: release it here — exactly once either way
+    r->admitted = false;
+    admission_on_complete(
+        r->enqueue_ns != 0 ? nat_now_ns() - r->enqueue_ns : 0, true);
   }
   delete r;
   return true;
@@ -898,6 +952,17 @@ void* nat_shm_take_request(int timeout_ms) {
       g_seg->last_worker_poll_ms.store(mono_ms(),
                                        std::memory_order_relaxed);
       if (!span_sane(c)) continue;  // corrupt cell: drop, look again
+      // natfault worker site: die or stall EXACTLY here — descriptor
+      // consumed, response unpublished — the window the robust-fence
+      // recovery (EOWNERDEAD probe, drain, scrub, fast-reap) exists
+      // for. worker:kill@N drives test_shm_worker_crash's SIGKILL
+      // scenario through the fault table.
+      NatFaultAct fwk = NAT_FAULT_POINT(NF_WORKER);
+      if (fwk.action == NF_KILL) {
+        raise(SIGKILL);
+      } else if (fwk.action == NF_STALL || fwk.action == NF_DELAY) {
+        nat_fault_delay_ms(fwk.delay_ms);
+      }
       PyRequest* req = new PyRequest();
       req->kind = (int32_t)c.kind;
       req->sock_id = c.sock_id;

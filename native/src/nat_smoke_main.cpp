@@ -203,6 +203,51 @@ int main() {
                                             &redis_reqs);
   CHECK(redis_qps > 0 && redis_reqs > 0, "redis bench lane");
 
+  // ---- natfault round: echo + retry under semantics-preserving faults
+  // (short reads/writes fragment I/O, EINTR exercises the requeue arms)
+  // — the sanitizer lanes see the fault table and every hook site hot.
+  CHECK(nat_fault_configure(
+            "seed=11;read:short:p=0.2;write:short:p=0.2;"
+            "read:err=EINTR:p=0.05;write:err=EINTR:p=0.05") == 0,
+        "fault configure");
+  CHECK(nat_fault_enabled() == 1, "fault gate armed");
+  {
+    void* fch = nat_channel_open("127.0.0.1", port, 0, 0, 0, 0);
+    CHECK(fch != nullptr, "faulted channel open");
+    if (fch != nullptr) {
+      for (int i = 0; i < 15; i++) {
+        char* resp = nullptr;
+        size_t rlen = 0;
+        char* err = nullptr;
+        int rc = nat_channel_call_full(fch, "EchoService", "Echo",
+                                       "chaos-echo-payload", 18, 5000, 2,
+                                       0, &resp, &rlen, &err);
+        CHECK(rc == 0, "faulted echo rc");
+        CHECK(rlen == 18 && resp != nullptr &&
+                  memcmp(resp, "chaos-echo-payload", 18) == 0,
+              "faulted echo payload");
+        if (resp != nullptr) nat_buf_free(resp);
+        if (err != nullptr) nat_buf_free(err);
+      }
+      nat_channel_close(fch);
+    }
+    CHECK(nat_fault_injected() > 0, "faults actually injected");
+    CHECK(nat_fault_configure(nullptr) == 0, "fault clear");
+    CHECK(nat_fault_enabled() == 0, "fault gate disarmed");
+  }
+
+  // ---- overload round: limiter config surface + ELIMIT path compiled
+  // hot under instrumentation (the py lane itself rides the pytest
+  // matrix; here the knobs and the inflight accounting are exercised)
+  CHECK(nat_rpc_server_limiter("constant:8") == 0, "limiter constant");
+  CHECK(nat_rpc_server_limit() == 8, "limiter limit");
+  CHECK(nat_rpc_server_limiter("auto") == 0, "limiter auto");
+  CHECK(nat_rpc_server_limit() > 0, "auto limit seeded");
+  CHECK(nat_rpc_server_queue_deadline_ms(100) == 0, "queue deadline set");
+  CHECK(nat_rpc_server_inflight() == 0, "inflight zero at idle");
+  CHECK(nat_rpc_server_limiter("") == 0, "limiter off");
+  CHECK(nat_rpc_server_queue_deadline_ms(0) == 0, "queue deadline off");
+
   // ---- soak extension (NAT_SOAK=1, tools/check.sh --soak): the h2/gRPC
   // client+server lane in pure C, so the TSan soak covers it without a
   // Python TLS client. (The ssl lane needs a TLS client and rides the

@@ -240,6 +240,9 @@ void NatSocket::set_failed() {
           !channel->closed.load(std::memory_order_acquire) &&
           !channel->hc_pending.exchange(true, std::memory_order_acq_rel)) {
         channel->add_ref();  // held by the revival chain
+        // fresh chain: the FIRST retry fires at the base interval; the
+        // dial fiber grows the delay exponentially from there
+        channel->hc_backoff_shift.store(0, std::memory_order_relaxed);
         TimerThread::instance()->schedule(health_check_fire, channel,
                                           channel->health_check_interval_ms);
       }
@@ -316,7 +319,24 @@ bool NatSocket::flush_some() {
                                          // batching across responses
     }
     while (!batch.empty()) {
-      ssize_t n = batch.cut_into_fd(fd);
+      // natfault write site: injected errno (EPIPE/ECONNRESET fail the
+      // socket; EINTR/EAGAIN exercise the requeue + KeepWrite path),
+      // short writes (1-byte truncation), dropped batches (bytes vanish
+      // — the retry/backup machinery must recover). NF_DELAY is NOT
+      // honored here: flush_some runs under session locks on the py
+      // responder paths, and no NatMutex may be held across a sleep
+      // (express slow-writer scenarios as read delays on the peer).
+      NatFaultAct fwa = NAT_FAULT_POINT(NF_WRITE);
+      ssize_t n;
+      if (fwa.action == NF_ERR) {
+        errno = fwa.err;
+        n = -1;
+      } else if (fwa.action == NF_DROP) {
+        n = (ssize_t)batch.length();  // pretend the kernel took it all
+        batch.clear();
+      } else {
+        n = batch.cut_into_fd(fd, fwa.action == NF_SHORT ? 1 : SIZE_MAX);
+      }
       if (n > 0) nat_counter_add(NS_SOCK_WRITE_BYTES, (uint64_t)n);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {

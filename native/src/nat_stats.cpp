@@ -84,6 +84,12 @@ static const char* kCounterNames[NS_COUNTER_COUNT] = {
     "nat_py_dispatches",
     "nat_py_queue_depth",
     "nat_spans_dropped",
+    "nat_faults_injected",
+    "nat_elimit_rejects",
+    "nat_queue_deadline_drops",
+    "nat_retry_budget_exhausted",
+    "nat_breaker_isolations",
+    "nat_breaker_revivals",
 };
 
 static const char* kLaneNames[NL_LANE_COUNT] = {

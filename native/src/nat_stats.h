@@ -46,6 +46,12 @@ enum NatCounterId : int {
   NS_PY_DISPATCHES,         // requests handed to the Python lane
   NS_PY_QUEUE_DEPTH,        // gauge: py-lane MPSC queue depth right now
   NS_SPANS_DROPPED,         // span ring overwrites before a drain
+  NS_FAULTS_INJECTED,       // natfault table hits (all sites)
+  NS_ELIMIT_REJECTS,        // admission-control ELIMIT wire rejections
+  NS_QUEUE_DEADLINE_DROPS,  // requests expired in the py queue (ELIMIT)
+  NS_RETRY_BUDGET_EXHAUSTED,// retries suppressed by the channel budget
+  NS_BREAKER_ISOLATIONS,    // native circuit-breaker trips
+  NS_BREAKER_REVIVALS,      // breaker resets after a successful re-dial
   NS_COUNTER_COUNT,
 };
 
@@ -89,6 +95,16 @@ inline uint64_t nat_now_ns() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+// splitmix64 finalizer — the one mixing function for everything that
+// needs a cheap deterministic hash (fault-schedule decisions, backoff
+// jitter dither). Pure function of its input.
+inline uint64_t nat_mix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
 }
 
 inline int nat_hist_bucket(uint64_t ns) {

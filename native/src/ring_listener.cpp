@@ -6,6 +6,8 @@
 #include <stdlib.h>
 #include <sys/uio.h>
 
+#include "nat_fault.h"
+
 namespace brpc_tpu {
 
 namespace {
@@ -399,7 +401,14 @@ void RingListener::poller_loop() {
         drained = drain_fn_();  // inline on the poller (no handoff)
       }
       if (!drained && wake_fn_) {
-        wake_fn_();  // skipped/unset: unpark a worker to drain
+        // natfault doorbell site: a dropped wake must only cost latency
+        // (the idle-hook drain and the next harvest recover), never a
+        // lost completion
+        NatFaultAct fda = NAT_FAULT_POINT(NF_DOORBELL);
+        if (fda.action == NF_DELAY) nat_fault_delay_ms(fda.delay_ms);
+        if (fda.action != NF_DROP) {
+          wake_fn_();  // skipped/unset: unpark a worker to drain
+        }
       }
     }
   }

@@ -274,6 +274,45 @@ void start() { std::thread([] {}).detach(); }
     assert findings == [], findings
 
 
+def test_lint_flags_ungated_fault_hook(tmp_path):
+    # a seeded hook that calls the fault table directly (skipping the
+    # NAT_FAULT_POINT one-branch gate) must be flagged
+    findings = _lint_one(tmp_path, "hook.cpp", """
+#include "nat_fault.h"
+long do_read(int fd) {
+  brpc_tpu::NatFaultAct fa = brpc_tpu::nat_fault_hit(brpc_tpu::NF_READ);
+  (void)fa;
+  return 0;
+}
+""")
+    assert any(f.rule == "fault-gate" for f in findings), findings
+
+
+def test_lint_gated_fault_hook_passes(tmp_path):
+    # the sanctioned macro shape (and the definition site itself, which
+    # lives in nat_fault.h and is exempt) must come back clean
+    findings = _lint_one(tmp_path, "hook2.cpp", """
+#include "nat_fault.h"
+long do_read(int fd) {
+  brpc_tpu::NatFaultAct fa = NAT_FAULT_POINT(brpc_tpu::NF_READ);
+  (void)fa;
+  return 0;
+}
+""")
+    assert findings == [], findings
+
+
+def test_lint_fault_gate_allow_escape(tmp_path):
+    findings = _lint_one(tmp_path, "hook3.cpp", """
+#include "nat_fault.h"
+long probe() {
+  // natcheck:allow(fault-gate): cold diagnostics path, gate irrelevant
+  return brpc_tpu::nat_fault_hit(brpc_tpu::NF_READ).action;
+}
+""")
+    assert findings == [], findings
+
+
 def test_lint_seqlock_reader_with_recheck_passes(tmp_path):
     findings = _lint_one(tmp_path, "g.cpp", """
 #include <atomic>

@@ -1,0 +1,228 @@
+"""Native server overload protection + client circuit breaker.
+
+The native server lane's admission control (nat_overload.cpp: constant +
+gradient limiters ported from rpc/concurrency_limiter.py, queue-deadline
+drop, real ELIMIT wire responses) and the native client circuit breaker
+(two-EMA-window isolation mirroring rpc/circuit_breaker.py, revived by
+the health-check chain).
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import native
+from brpc_tpu.rpc.errors import ELIMIT
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+class PyLaneEcho:
+    """Minimal py-lane consumer: echoes payloads after `delay` seconds;
+    `serving` gates whether requests are taken at all."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+        self.stop = False
+        self.serving = threading.Event()
+        self.serving.set()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self.stop:
+            if not self.serving.is_set():
+                time.sleep(0.01)
+                continue
+            r = native.take_request(50)
+            if r is None:
+                continue
+            h, kind = r[0], r[1]
+            if kind != 0:
+                native.req_free(h)
+                continue
+            if self.delay:
+                time.sleep(self.delay)
+            native.respond(h, 0, "", r[3])
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop = True
+        self.thread.join()
+
+
+@pytest.fixture
+def server():
+    port = native.rpc_server_start()
+    yield port
+    native.rpc_server_limiter("")
+    native.rpc_server_queue_deadline_ms(0)
+    native.fault_configure(os.environ.get("NAT_FAULT", ""))
+    native.rpc_server_stop()
+
+
+def _flood(port, n, timeout_ms=5000, payload=b"p"):
+    results = []
+    lock = threading.Lock()
+
+    def one():
+        ch = native.channel_open("127.0.0.1", port)
+        r = native.channel_call(ch, "S", "M", payload,
+                                timeout_ms=timeout_ms)
+        with lock:
+            results.append(r)
+        native.channel_close(ch)
+
+    threads = [threading.Thread(target=one) for _ in range(n)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, time.time() - t0
+
+
+def test_constant_limiter_sheds_with_elimit(server):
+    """Flooding past the limit yields real ELIMIT(2004) rejections on
+    the wire, the accepted requests complete promptly (no hang, no
+    unbounded queue), and the server keeps serving afterwards."""
+    assert native.rpc_server_limiter("constant:2") == 0
+    assert native.rpc_server_limit() == 2
+    before = native.stats_counters()["nat_elimit_rejects"]
+    with PyLaneEcho(delay=0.05):
+        results, dt = _flood(server, 12)
+        rcs = [r[0] for r in results]
+        assert rcs.count(0) >= 2, rcs          # admitted work completed
+        assert ELIMIT in rcs, rcs              # and the rest was shed
+        assert dt < 3.0, dt                    # bounded, not queued
+        # the rejected calls carry the reference error text
+        texts = [r[2] for r in results if r[0] == ELIMIT]
+        assert any("concurrency" in t for t in texts), texts
+        # post-storm: a fresh call sails through
+        ch = native.channel_open("127.0.0.1", server)
+        rc, body, _ = native.channel_call(ch, "S", "M", b"after",
+                                          timeout_ms=5000)
+        assert rc == 0 and body == b"after"
+        native.channel_close(ch)
+    assert native.stats_counters()["nat_elimit_rejects"] > before
+    assert native.rpc_server_inflight() == 0  # accounting drained
+
+
+def test_queue_deadline_drops_expired_before_dispatch(server):
+    """Requests that sat in the py queue past the deadline are rejected
+    with ELIMIT when a worker would take them — stale work never reaches
+    usercode, so accepted-request latency stays bounded."""
+    native.rpc_server_queue_deadline_ms(100)
+    before = native.stats_counters()["nat_queue_deadline_drops"]
+    consumer = PyLaneEcho()
+    consumer.serving.clear()  # stall: let the queue age
+    with consumer:
+        done = []
+
+        def caller():
+            ch = native.channel_open("127.0.0.1", server)
+            done.append(native.channel_call(ch, "S", "M", b"q",
+                                            timeout_ms=5000)[0])
+            native.channel_close(ch)
+
+        threads = [threading.Thread(target=caller) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # all four are now older than the deadline
+        consumer.serving.set()
+        for t in threads:
+            t.join()
+        assert all(rc == ELIMIT for rc in done), done
+        assert native.stats_counters()["nat_queue_deadline_drops"] - \
+            before >= 4
+        # fresh (young) requests still go through
+        ch = native.channel_open("127.0.0.1", server)
+        rc, body, _ = native.channel_call(ch, "S", "M", b"fresh",
+                                          timeout_ms=5000)
+        assert rc == 0 and body == b"fresh"
+        native.channel_close(ch)
+
+
+def test_auto_limiter_converges_and_serves(server):
+    """The gradient limiter measures capacity from the 1s windows and
+    keeps serving; the computed limit is exposed for observability."""
+    assert native.rpc_server_limiter("auto") == 0
+    assert native.rpc_server_limit() > 0  # seeded initial limit
+    with PyLaneEcho(delay=0.001):
+        ch = native.channel_open("127.0.0.1", server)
+        deadline = time.time() + 4.0
+        ok = 0
+        while time.time() < deadline:
+            rc, _, _ = native.channel_call(ch, "S", "M", b"a",
+                                           timeout_ms=5000)
+            ok += 1 if rc == 0 else 0
+        native.channel_close(ch)
+        assert ok > 100
+    assert native.rpc_server_limit() >= 4  # window rollover computed one
+
+
+def test_breaker_trips_fails_fast_and_revives(server):
+    """The native circuit breaker isolates a peer that stops answering
+    (timeout storm trips the short EMA window), calls fail fast through
+    the isolation, and the health-check chain revives + resets it once
+    the peer serves again."""
+    consumer = PyLaneEcho()
+    consumer.serving.clear()  # nobody answers: every call times out
+    with consumer:
+        ch = native.channel_open("127.0.0.1", server, health_check_ms=50)
+        native.channel_set_breaker(ch, True)
+        before = native.stats_counters()["nat_breaker_isolations"]
+        for _ in range(30):
+            native.channel_call(ch, "S", "M", b"t", timeout_ms=40)
+            if native.channel_breaker_state(ch) == 1:
+                break
+        assert native.channel_breaker_state(ch) == 1
+        assert native.stats_counters()["nat_breaker_isolations"] > before
+        # isolated: fail fast, no dial, no 40ms timeout wait
+        t0 = time.time()
+        rc, _, _ = native.channel_call(ch, "S", "M", b"ff",
+                                       timeout_ms=2000)
+        assert rc != 0
+        assert time.time() - t0 < 0.05
+        # peer comes back: isolation (>=100ms) expires, the hc chain
+        # re-dials, the breaker resets, calls flow again
+        consumer.serving.set()
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                native.channel_breaker_state(ch) == 1:
+            time.sleep(0.05)
+        assert native.channel_breaker_state(ch) == 0, "no revival"
+        rc, body, _ = native.channel_call(ch, "S", "M", b"back",
+                                          timeout_ms=5000, max_retry=2)
+        assert rc == 0 and body == b"back"
+        assert native.stats_counters()["nat_breaker_revivals"] >= 1
+        native.channel_close(ch)
+
+
+def test_breaker_isolates_fault_injected_flapping_peer(server):
+    """The acceptance scenario: a fault-injected flapping connection
+    (every write EPIPEs, so every call errors) trips the breaker; after
+    the faults clear the health-check chain brings the node back."""
+    with PyLaneEcho():
+        ch = native.channel_open("127.0.0.1", server, health_check_ms=50)
+        native.channel_set_breaker(ch, True)
+        native.fault_configure("seed=21;write:err=EPIPE:p=1")
+        for _ in range(40):
+            native.channel_call(ch, "S", "M", b"f", timeout_ms=500)
+            if native.channel_breaker_state(ch) == 1:
+                break
+        assert native.channel_breaker_state(ch) == 1
+        native.fault_configure("")  # faults clear: revival chain works
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                native.channel_breaker_state(ch) == 1:
+            time.sleep(0.05)
+        assert native.channel_breaker_state(ch) == 0
+        rc, body, _ = native.channel_call(ch, "S", "M", b"healed",
+                                          timeout_ms=5000, max_retry=2)
+        assert rc == 0 and body == b"healed"
+        native.channel_close(ch)

@@ -204,11 +204,23 @@ def test_short_command_on_fresh_connection(store_redis_server):
         time.sleep(0.05)
         sk.sendall(b"G\r\n")
         sk.settimeout(2)
-        assert sk.recv(64) == b"+PONG\r\n"
+        # read to the reply terminator: TCP guarantees no message
+        # boundaries (the chaos lane's short-write faults legitimately
+        # deliver the reply one byte at a time)
+        buf = b""
+        while not buf.endswith(b"\r\n"):
+            got = sk.recv(64)
+            assert got, buf
+            buf += got
+        assert buf == b"+PONG\r\n"
         # genuinely sub-12-byte complete command via DBSIZE? shortest is
         # e.g. *1\r\n$1\r\n? -> unknown; use an 11-byte unknown command
         sk.sendall(b"*1\r\n$1\r\nX\r\n")
-        buf = sk.recv(256)
+        buf = b""
+        while not buf.endswith(b"\r\n"):
+            got = sk.recv(256)
+            assert got, buf
+            buf += got
         assert buf.startswith(b"-ERR")  # answered, not hung
     finally:
         sk.close()

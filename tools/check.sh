@@ -11,6 +11,9 @@
 # smoke; several minutes of compile) and the dsched interleaving smoke.
 # --soak (or NATCHECK_SOAK=1) additionally runs the full sanitizer soak
 # matrix and writes native/SOAK.md (see tools/natcheck/soak.py).
+# --chaos (or NATCHECK_CHAOS=1) runs the fixed-seed fault-injection soak
+# (C smoke + pytest native matrix under the documented NAT_FAULT spec)
+# and writes native/CHAOS.md (see tools/natcheck/chaos.py).
 # Exits nonzero on any finding.
 set -u
 
@@ -20,9 +23,11 @@ PY="${PYTHON:-python3}"
 RC=0
 
 SOAK="${NATCHECK_SOAK:-0}"
+CHAOS="${NATCHECK_CHAOS:-0}"
 for arg in "$@"; do
     case "$arg" in
         --soak) SOAK=1 ;;
+        --chaos) CHAOS=1 ;;
     esac
 done
 
@@ -54,6 +59,19 @@ sys.path.insert(0, ".")
 from tools.natcheck import print_findings, soak
 findings = soak.run()
 print("natcheck: soak: %s (log: native/SOAK.md)"
+      % ("clean" if not findings else "%d finding(s)" % len(findings)))
+print_findings(findings)
+sys.exit(1 if findings else 0)
+EOF
+fi
+
+if [ "$CHAOS" = "1" ]; then
+    "$PY" - <<'EOF' || RC=1
+import sys
+sys.path.insert(0, ".")
+from tools.natcheck import print_findings, chaos
+findings = chaos.run()
+print("natcheck: chaos: %s (log: native/CHAOS.md)"
       % ("clean" if not findings else "%d finding(s)" % len(findings)))
 print_findings(findings)
 sys.exit(1 if findings else 0)

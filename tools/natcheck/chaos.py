@@ -1,0 +1,155 @@
+"""Chaos soak — fixed-seed fault-injection run (``tools/check.sh --chaos``).
+
+Two legs, each a Finding on failure:
+
+1. C smoke (uninstrumented ``nat_smoke``) under ``CHAOS_SPEC`` in the
+   ``NAT_FAULT`` environment — the whole smoke (echo sync/async, http,
+   redis, shm descriptor rings, its own internal natfault round) runs
+   with the ambient fault table armed.
+2. The pytest native matrix under the same spec, plus the dedicated
+   fault/overload suites (which install their own destructive specs at
+   runtime via ``nat_fault_configure`` and restore the env spec after).
+
+``CHAOS_SPEC`` deliberately uses only **semantics-preserving** faults:
+short reads/writes (every parser must stay incremental), EINTR on both
+directions (the drain/requeue retry arms), connect delays (timeout-clamp
+paths) and dropped doorbells (the waiter-gated wake protocol must degrade
+to its bounded-timeout polls). Destructive faults — ECONNRESET/EPIPE,
+dropped writes, worker SIGKILL — change observable outcomes by design,
+so they live in tests that assert the *recovery*, not the absence of the
+fault: tests/test_native_fault.py, tests/test_native_overload.py and the
+fault-table SIGKILL test in tests/test_shm_worker_crash.py.
+
+Determinism: the fault schedule is a pure function of (seed, site, rule
+index, per-site op index) — re-running the lane with the same seed over
+the same op sequence replays the same faults. The op *ordering* across
+sockets still depends on thread interleaving; the seed pins the
+schedule, not the scheduler.
+
+``BRPC_TPU_SANITIZED=1`` is set for the pytest leg so the matrix's
+perf/RSS gates loosen — a perturbed run is not a perf regression.
+
+The combined log is written to ``native/CHAOS.md`` — commit it clean.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import List, Tuple
+
+from tools.natcheck import Finding, REPO_ROOT
+
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+CHAOS_MD = os.path.join(NATIVE_DIR, "CHAOS.md")
+
+# The documented fixed-seed chaos spec (see module docstring for why
+# only semantics-preserving faults ride the ambient environment).
+CHAOS_SPEC = ("seed=42;"
+              "read:short:p=0.05;read:err=EINTR:p=0.02;"
+              "write:short:p=0.05;write:err=EINTR:p=0.02;"
+              "connect:delay_ms=20:p=0.2;"
+              "doorbell:drop:p=0.05")
+
+# The native-lane matrix (the soak set) + the fault/overload suites.
+PYTEST_MATRIX = [
+    "tests/test_native.py", "tests/test_native_rpc.py",
+    "tests/test_native_client.py", "tests/test_native_http.py",
+    "tests/test_native_h2.py", "tests/test_native_redis.py",
+    "tests/test_native_streaming.py", "tests/test_native_stats.py",
+    "tests/test_shm_workers.py", "tests/test_shm_desc_ring.py",
+    "tests/test_shm_worker_crash.py",
+    "tests/test_native_fault.py", "tests/test_native_overload.py",
+]
+
+
+def _smoke_leg() -> Tuple[List[Finding], str]:
+    findings: List[Finding] = []
+    try:
+        subprocess.run(["make", "-C", NATIVE_DIR, "nat_smoke"], check=True,
+                       capture_output=True, timeout=900)
+    except subprocess.CalledProcessError as e:
+        findings.append(Finding(
+            "chaos", "smoke-build", "native/Makefile",
+            "build failed: " +
+            (e.stderr or b"").decode(errors="replace")[-800:]))
+        return findings, "chaos smoke: BUILD FAILED"
+    env = dict(os.environ)
+    env["NAT_FAULT"] = CHAOS_SPEC
+    try:
+        proc = subprocess.run(
+            [os.path.join(NATIVE_DIR, "nat_smoke")], capture_output=True,
+            timeout=600, env=env)
+    except subprocess.TimeoutExpired:
+        # a hang under injected faults IS the defect class this hunts
+        findings.append(Finding(
+            "chaos", "smoke-hang", "native/nat_smoke",
+            "chaos smoke timed out under NAT_FAULT (hang/deadlock?)"))
+        return findings, "chaos smoke: TIMED OUT"
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    if proc.returncode != 0:
+        findings.append(Finding(
+            "chaos", "smoke", "native/nat_smoke",
+            f"chaos smoke exited rc={proc.returncode}: "
+            f"{out.strip()[-400:]}"))
+    return findings, out
+
+
+def _pytest_leg() -> Tuple[List[Finding], str]:
+    findings: List[Finding] = []
+    env = dict(os.environ)
+    env["NAT_FAULT"] = CHAOS_SPEC
+    env["BRPC_TPU_SANITIZED"] = "1"  # loosen perf/RSS gates: perturbed
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", *PYTEST_MATRIX, "-q", "-m",
+             "not slow", "-p", "no:cacheprovider"],
+            capture_output=True, timeout=1800, env=env, cwd=REPO_ROOT)
+    except subprocess.TimeoutExpired:
+        return [Finding("chaos", "pytest-hang", "tests/",
+                        "chaos python matrix timed out")], \
+            "chaos pytest: TIMED OUT"
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    if proc.returncode != 0:
+        tail = out.strip().splitlines()[-1] if out.strip() else "?"
+        findings.append(Finding(
+            "chaos", "pytest", "tests/",
+            f"chaos python matrix rc={proc.returncode}: {tail}"))
+    return findings, out
+
+
+def run(write_log: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    sections = []
+    t0 = time.time()
+    got, out = _smoke_leg()
+    findings.extend(got)
+    sections.append(("C smoke under NAT_FAULT", out))
+    got, out = _pytest_leg()
+    findings.extend(got)
+    sections.append(("pytest native matrix under NAT_FAULT", out))
+
+    if write_log:
+        with open(CHAOS_MD, "w", encoding="utf-8") as f:
+            f.write("# native chaos soak log\n\n")
+            f.write("Produced by `tools/check.sh --chaos` "
+                    "(tools/natcheck/chaos.py). The C smoke and the\n"
+                    "pytest native matrix run with the fixed-seed fault "
+                    "spec below armed via the\n`NAT_FAULT` environment; "
+                    "the dedicated fault/overload suites additionally\n"
+                    "install destructive specs at runtime and assert the "
+                    "recovery paths.\n\n")
+            f.write("Spec: `%s`\n\n" % CHAOS_SPEC)
+            f.write("Result: %s (%d finding(s), %.0fs)\n\n" %
+                    ("CLEAN" if not findings else "FAILING",
+                     len(findings), time.time() - t0))
+            for f2 in findings:
+                f.write("- FINDING: %s\n" % f2)
+            for title, body in sections:
+                tail = "\n".join(body.strip().splitlines()[-25:])
+                f.write("\n## %s\n\n```\n%s\n```\n" % (title, tail))
+    return findings

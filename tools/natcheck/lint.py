@@ -20,6 +20,11 @@ sanitizer suppressions entry):
 - ``seqlock-recheck``: a reader that loads a seqlock sequence counter and
   then copies the protected payload must re-load the counter to validate
   the copy (torn reads are the whole point of the pattern).
+
+- ``fault-gate``: outside nat_fault.{h,cpp}, fault hooks must go through
+  the ``NAT_FAULT_POINT`` macro — a direct ``nat_fault_hit()`` call
+  skips the one-predictable-branch gate and puts a function call (plus a
+  per-site op-counter RMW) on the disabled hot path.
 """
 from __future__ import annotations
 
@@ -246,6 +251,21 @@ def lint_file(path: str, text: str, nontrivial: set) -> List[Finding]:
                 f"thread-spawning file — __cxa_atexit destroys it while "
                 f"detached threads may still use it (PR-1 bench-exit "
                 f"SIGSEGV class); leak it instead: static T* x = new T;"))
+
+    # ---- fault-gate -------------------------------------------------------
+    # nat_fault.h holds the macro definition and nat_fault.cpp the
+    # implementation; everywhere else the gate macro is the only legal
+    # way to reach the fault table.
+    if os.path.basename(path) not in ("nat_fault.h", "nat_fault.cpp"):
+        for m in re.finditer(r"\bnat_fault_hit\s*\(", scrubbed):
+            i = scrubbed.count("\n", 0, m.start())
+            if _allowed(lines, i, "fault-gate"):
+                continue
+            findings.append(Finding(
+                "lint", "fault-gate", f"{rel}:{i + 1}",
+                "direct nat_fault_hit() call — fault hooks must go "
+                "through NAT_FAULT_POINT so the disabled hot path costs "
+                "one predictable branch (no call, no op-counter RMW)"))
 
     # ---- seqlock-recheck --------------------------------------------------
     for start_line, body in _function_blocks(scrubbed):
