@@ -440,6 +440,20 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # flight recorder: BRPC_TPU_BENCH_PROF=1 attaches the in-process
+    # native profiler (nat_prof) to the loopback lanes — the standing
+    # replacement for the hand-run PROFILE_r*.md rounds; the gate
+    # (tools/check.sh --bench) stores the flat profile in the artifact
+    # so a lane regression arrives with its own profile attached.
+    import os as _os
+
+    prof_attached = False
+    if _os.environ.get("BRPC_TPU_BENCH_PROF") == "1":
+        try:
+            prof_attached = native.prof_start(99) == 0
+        except Exception:
+            prof_attached = False
+
     def _async_lane(port_, conns, window=256):
         """One async-windowed measurement; (qps, requests)."""
         out = ctypes.c_uint64(0)
@@ -592,6 +606,21 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # the profiler window covers exactly the loopback lanes above (the
+    # device/model sections below are DMA + XLA, a different profile)
+    nat_prof = {}
+    if prof_attached:
+        try:
+            native.prof_stop()
+            flat = native.prof_report(collapsed=False)
+            nat_prof = {
+                "samples": native.prof_samples(),
+                "flat": flat.splitlines()[:48],
+            }
+            native.prof_reset()
+        except Exception:
+            nat_prof = {}
+
     # device-transport bandwidth (the rdma_performance analog): tracked
     # round over round in the artifact. Runs AFTER the loopback lanes
     # (its DMA sections poison them); shm_push runs first inside it.
@@ -665,6 +694,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             "python_framework_qps": round(python_qps, 1),
             "bypass_ceiling_qps": round(bypass_qps, 1),
             "native_latency_us": native_latency_us,
+            **({"nat_prof": nat_prof} if nat_prof else {}),
             "device_lanes": device_lanes,
             **http_lanes,
             **redis_lanes,

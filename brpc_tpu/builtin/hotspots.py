@@ -66,9 +66,47 @@ def thread_dump() -> str:
     return "\n".join(out) + "\n"
 
 
+# one /hotspots/native window at a time: a concurrent request's
+# stop/reset must not wipe another window's samples mid-flight (the
+# second request waits and then gets its own full window)
+_native_prof_lock = threading.Lock()
+
+
+def sample_native(seconds: float = 1.0, hz: int = 99,
+                  collapsed: bool = True) -> str:
+    """Native-runtime CPU profile via nat_prof (the in-process SIGPROF
+    sampler, native/src/nat_prof.cpp): samples every thread actually
+    burning CPU — fiber workers, dispatcher loops, py-lane pthreads —
+    with frame-pointer unwind through the C++ core, where the Python
+    sampler above only sees interpreter frames."""
+    try:
+        from brpc_tpu import native
+
+        if not native.available():
+            return "native runtime unavailable\n"
+    except Exception as e:
+        return f"native runtime unavailable: {e}\n"
+    seconds = max(0.1, min(30.0, seconds))
+    with _native_prof_lock:
+        rc = native.prof_start(hz)
+        owns = rc == 0
+        if rc == -2:
+            return "nat_prof: could not install SIGPROF handler/timer\n"
+        # rc == -1: a bench/embedder already runs the profiler — report
+        # the window without stealing ownership of start/stop/reset
+        time.sleep(seconds)
+        if owns:
+            native.prof_stop()
+        report = native.prof_report(collapsed=collapsed)
+        if owns:
+            native.prof_reset()
+    return report or "nat_prof: no samples (no native CPU burned?)\n"
+
+
 def hotspots_handler(server, req):
-    """/hotspots/{cpu,heap,growth,contention,tpu} — the full profiler
-    surface of hotspots_service.h:38-68 (+ the XProf TPU translation)."""
+    """/hotspots/{cpu,native,heap,growth,contention,tpu} — the full
+    profiler surface of hotspots_service.h:38-68 (+ the XProf TPU
+    translation and the nat_prof native sampler)."""
     from brpc_tpu.builtin import profilers
 
     parts = [p for p in req.path.split("/") if p]
@@ -76,6 +114,10 @@ def hotspots_handler(server, req):
     seconds = float(req.query.get("seconds", "1") or 1)
     if kind == "cpu":
         return 200, "text/plain", sample_cpu(seconds)
+    if kind == "native":
+        collapsed = req.query.get("flat", "") in ("", "0")
+        return 200, "text/plain", sample_native(seconds,
+                                                collapsed=collapsed)
     if kind == "heap":
         return 200, "text/plain", profilers.heap_profile()
     if kind == "growth":

@@ -104,15 +104,25 @@ def _stats_quantile_us(lane: int, q: float) -> float:
     return native.stats_quantile(lane, q) / 1e3
 
 
-def native_status_lines() -> List[str]:
+# the PR-5 robustness counters, summarized on /status as one line the
+# moment any of them moves (a fault injection round, an overload shed or
+# a breaker trip should be visible at a glance, not only in /vars)
+_OVERLOAD_KEYS = ("nat_faults_injected", "nat_elimit_rejects",
+                  "nat_queue_deadline_drops", "nat_retry_budget_exhausted",
+                  "nat_breaker_isolations", "nat_breaker_revivals")
+
+
+def native_status_lines(snap: Optional[Dict[str, int]] = None) -> List[str]:
     """The /status page's native section: per-protocol traffic counters
-    and tail latency, empty when the native runtime never carried any."""
+    and tail latency, empty when the native runtime never carried any.
+    `snap` overrides the live counter snapshot (tests)."""
     try:
         from brpc_tpu import native
 
         if not native.available():
             return []
-        snap = native.stats_counters()
+        if snap is None:
+            snap = native.stats_counters()
         lanes = native.stats_lane_names()
     except Exception:
         return []
@@ -137,6 +147,9 @@ def native_status_lines() -> List[str]:
         lines.append(
             f"  {label}: in={msgs} out={snap.get(f'{pfx}_{s_out}', 0)} "
             f"errors={snap.get(f'{pfx}_{s_err}', 0)}")
+    if any(snap.get(k, 0) for k in _OVERLOAD_KEYS):
+        lines.append("  overload/faults: " + " ".join(
+            f"{k[4:]}={snap.get(k, 0)}" for k in _OVERLOAD_KEYS))
     for idx, lane in enumerate(lanes):
         try:
             from brpc_tpu import native as _n

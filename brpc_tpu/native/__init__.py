@@ -40,6 +40,7 @@ class NatSpanRec(ctypes.Structure):
     _fields_ = [
         ("trace_id", ctypes.c_uint64),
         ("span_id", ctypes.c_uint64),
+        ("parent_span_id", ctypes.c_uint64),
         ("sock_id", ctypes.c_uint64),
         ("recv_ns", ctypes.c_uint64),
         ("parse_ns", ctypes.c_uint64),
@@ -332,6 +333,19 @@ def load() -> ctypes.CDLL:
         lib.nat_stats_drain_spans.restype = ctypes.c_int
         lib.nat_stats_reset.restype = None
         lib.nat_stats_now_ns.restype = ctypes.c_uint64
+        # -- trace context + in-process sampling profiler (nat_prof.cpp) --
+        lib.nat_trace_set.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+        lib.nat_trace_set.restype = None
+        lib.nat_prof_start.argtypes = [ctypes.c_int]
+        lib.nat_prof_start.restype = ctypes.c_int
+        lib.nat_prof_stop.restype = ctypes.c_int
+        lib.nat_prof_running.restype = ctypes.c_int
+        lib.nat_prof_samples.restype = ctypes.c_uint64
+        lib.nat_prof_reset.restype = None
+        lib.nat_prof_report.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.nat_prof_report.restype = ctypes.c_int
         _lib = lib
         return lib
 
@@ -991,6 +1005,7 @@ def stats_drain_spans(max_spans: int = 4096) -> list:
         out.append({
             "trace_id": r.trace_id,
             "span_id": r.span_id,
+            "parent_span_id": r.parent_span_id,
             "sock_id": r.sock_id,
             "recv_ns": r.recv_ns,
             "parse_ns": r.parse_ns,
@@ -1010,3 +1025,84 @@ def stats_reset():
     """Zero every stat cell and forget undrained spans (test/bench
     hygiene only)."""
     load().nat_stats_reset()
+
+
+# Python-side shadow of the C-side thread-local trace context (the
+# Python wrappers are the only setters from this interpreter), so
+# trace_scope can RESTORE the enclosing context on exit instead of
+# clobbering it to (0,0) — nested scopes / scopes inside an already
+# traced request keep propagating after they close.
+_trace_tls = threading.local()
+
+
+def trace_set(trace_id: int = 0, span_id: int = 0):
+    """Arm (or clear, with 0,0) this thread's ambient trace context:
+    native client calls issued on this thread propagate (trace_id,
+    span_id) on the wire — tpu_std meta trace fields, HTTP x-bd-trace-*
+    headers, gRPC metadata, kind-8 shm descriptors — so the receiving
+    side's spans chain under span_id in /rpcz find_trace."""
+    load().nat_trace_set(trace_id, span_id)
+    _trace_tls.ctx = (trace_id, span_id)
+
+
+class trace_scope:
+    """with native.trace_scope(trace_id, span_id): ... — arm the ambient
+    trace context for the calls inside, restoring the PREVIOUS context
+    (not bare zero) on exit."""
+
+    def __init__(self, trace_id: int, span_id: int):
+        self._ctx = (trace_id, span_id)
+        self._prev = (0, 0)
+
+    def __enter__(self):
+        self._prev = getattr(_trace_tls, "ctx", (0, 0))
+        trace_set(*self._ctx)
+        return self
+
+    def __exit__(self, *exc):
+        trace_set(*self._prev)
+
+
+# -- in-process sampling profiler (nat_prof.cpp) ----------------------------
+
+def prof_start(hz: int = 99) -> int:
+    """Start SIGPROF/CPU-time stack sampling at `hz` (frame-pointer
+    unwind into lock-free per-thread rings). 0 = ok, -1 = already
+    running, -2 = handler/timer install failed."""
+    return load().nat_prof_start(hz)
+
+
+def prof_stop() -> int:
+    """Stop sampling; accumulated samples stay reportable."""
+    return load().nat_prof_stop()
+
+
+def prof_running() -> bool:
+    return bool(load().nat_prof_running())
+
+
+def prof_samples() -> int:
+    """Samples captured since start/reset."""
+    return load().nat_prof_samples()
+
+
+def prof_reset():
+    """Forget everything sampled so far."""
+    load().nat_prof_reset()
+
+
+def prof_report(collapsed: bool = False) -> str:
+    """Render the accumulated profile: flat self-sample symbol table
+    (default, the PROFILE_r*.md shape) or collapsed stacks
+    (flamegraph.pl / speedscope compatible)."""
+    lib = load()
+    out = ctypes.c_char_p()
+    n = ctypes.c_size_t(0)
+    rc = lib.nat_prof_report(1 if collapsed else 0, ctypes.byref(out),
+                             ctypes.byref(n))
+    if rc != 0 or not out:
+        return ""
+    try:
+        return ctypes.string_at(out, n.value).decode(errors="replace")
+    finally:
+        lib.nat_buf_free(out)

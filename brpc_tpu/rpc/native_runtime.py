@@ -65,9 +65,27 @@ class _NativeHttpShim(NativeSocketShim):
     def __init__(self, sock_id: int, seq: int):
         super().__init__(sock_id)
         self.seq = seq
+        # rpcz: when the dispatch armed a server span, the RESPONSE write
+        # is the completion point (handlers may respond long after the
+        # handler function returned) — end it here with the real status
+        self.span = None
+
+    def _end_span(self, data: bytes):
+        span, self.span = self.span, None
+        if span is None:
+            return
+        try:
+            status = int(data[9:12]) if data[:5] == b"HTTP/" else 0
+        except ValueError:
+            status = 0
+        try:
+            span.end(status if status >= 400 else 0)
+        except Exception:
+            pass
 
     def write(self, buf, id_wait=None) -> int:
         data = buf.copy_to_bytes(len(buf))
+        self._end_span(data)
         return native.http_respond(self.sock_id, self.seq, data)
 
     def set_failed(self, error_code=0, error_text: str = ""):
@@ -76,6 +94,14 @@ class _NativeHttpShim(NativeSocketShim):
         self._failed = True
         if error_code == errors.ECLOSE:
             return  # native close_seqs closes after this response flushes
+        # a request failed without a response write is exactly what the
+        # trace exists to debug: submit the armed span with the error
+        span, self.span = self.span, None
+        if span is not None:
+            try:
+                span.end(error_code or 500)
+            except Exception:
+                pass
         native.sock_set_failed(self.sock_id)
 
 
@@ -459,6 +485,21 @@ class NativeRuntimeMount:
                 if line:
                     k, _, v = line.partition(": ")
                     headers[k] = v
+            # rpcz: chain this dispatch under the caller's span when the
+            # request carried x-bd-trace-* gRPC metadata (the native
+            # client lane stamps it; values hex)
+            span = None
+            try:
+                tid = headers.get("x-bd-trace-id")
+                if tid:
+                    from brpc_tpu import rpcz as _rpcz
+
+                    span = _rpcz.Span(
+                        "server", f"grpc {pstr}", trace_id=int(tid, 16),
+                        parent_span_id=int(
+                            headers.get("x-bd-span-id") or "0", 16))
+            except Exception:
+                span = None
             cntl = Controller()
             cntl.server = server
             cntl.service_name, cntl.method_name = parts[0], parts[1]
@@ -494,9 +535,26 @@ class NativeRuntimeMount:
                             cntl.error_text_value)
                 else:
                     respond(response.SerializeToString(), GRPC_OK)
+                # the span ends when the CALL completes (done may fire
+                # from another thread long after the handler returned —
+                # the async-done contract tpu_std_protocol documents),
+                # so latency/error reflect the real completion
+                if span is not None:
+                    try:
+                        span.end(cntl.error_code_value)
+                    except Exception:
+                        pass
 
             try:
-                minfo.handler(service_obj, cntl, request, response, done)
+                if span is not None:
+                    from brpc_tpu import rpcz as _rpcz
+
+                    with _rpcz.parent_scope(span):
+                        minfo.handler(service_obj, cntl, request, response,
+                                      done)
+                else:
+                    minfo.handler(service_obj, cntl, request, response,
+                                  done)
             except Exception as e:
                 if not responded[0]:
                     cntl.set_failed(errors.EINVAL, f"method raised: {e}")
@@ -553,6 +611,8 @@ class NativeRuntimeMount:
             process_request as http_process_request,
         )
 
+        span = None
+        shim = None
         try:
             req = HttpRequest(verb.decode("latin-1"), uri.decode("latin-1"))
             hd = req.headers._headers
@@ -563,10 +623,46 @@ class NativeRuntimeMount:
             if body:
                 req.body = _IOBuf(body)
             msg = HttpInputMessage(req)
-            msg.socket = _NativeHttpShim(sock_id, seq)
+            shim = _NativeHttpShim(sock_id, seq)
+            msg.socket = shim
             msg.arg = self.server
-            http_process_request(msg)
+            # rpcz: chain under the caller's span when the request carried
+            # x-bd-trace-* headers (hex; stamped by the native client lane)
+            try:
+                tid = hd.get("x-bd-trace-id")
+                if tid:
+                    from brpc_tpu import rpcz as _rpcz
+
+                    span = _rpcz.Span(
+                        "server",
+                        f"{verb.decode('latin-1')} {uri.decode('latin-1')}",
+                        trace_id=int(tid, 16),
+                        parent_span_id=int(hd.get("x-bd-span-id") or "0",
+                                           16))
+            except Exception:
+                span = None
+            if span is not None:
+                from brpc_tpu import rpcz as _rpcz
+
+                # armed on the shim: the span ends at the RESPONSE write
+                # (handlers may respond asynchronously long after this
+                # function returns — ending at handler-return would
+                # record phantom latency/status for them)
+                shim.span = span
+                with _rpcz.parent_scope(span):
+                    http_process_request(msg)
+            else:
+                http_process_request(msg)
         except Exception as e:
+            # the dispatch itself blew up before a response reached the
+            # shim: the span must still submit — a failing request is
+            # exactly what the trace exists to debug
+            if shim is not None and shim.span is not None:
+                shim.span = None
+                try:
+                    span.end(500)
+                except Exception:
+                    pass
             body = f"{e}\n".encode()
             resp = (f"HTTP/1.1 500 Internal Server Error\r\n"
                     f"Content-Length: {len(body)}\r\n\r\n").encode() + body

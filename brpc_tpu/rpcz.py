@@ -346,7 +346,8 @@ def drain_native_spans() -> int:
     for r in recs:
         kind = "client" if r["lane"] == "client" else "server"
         span = Span(kind, r["method"] or f"native.{r['lane']}",
-                    trace_id=r["trace_id"])
+                    trace_id=r["trace_id"],
+                    parent_span_id=r.get("parent_span_id", 0))
         span.span_id = r["span_id"]
         span.remote_side = f"native:{r['lane']}/sock={r['sock_id']}"
         span.start_time = offset + r["recv_ns"] / 1e9

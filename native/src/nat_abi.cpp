@@ -129,6 +129,7 @@ int main() {
                {
                    NAT_FIELD(NatSpanRec, trace_id),
                    NAT_FIELD(NatSpanRec, span_id),
+                   NAT_FIELD(NatSpanRec, parent_span_id),
                    NAT_FIELD(NatSpanRec, sock_id),
                    NAT_FIELD(NatSpanRec, recv_ns),
                    NAT_FIELD(NatSpanRec, parse_ns),
@@ -247,6 +248,13 @@ int main() {
       NAT_SYM(nat_stats_enable_spans),
       NAT_SYM(nat_stats_drain_spans),
       NAT_SYM(nat_stats_reset),
+      NAT_SYM(nat_trace_set),
+      NAT_SYM(nat_prof_start),
+      NAT_SYM(nat_prof_stop),
+      NAT_SYM(nat_prof_running),
+      NAT_SYM(nat_prof_samples),
+      NAT_SYM(nat_prof_reset),
+      NAT_SYM(nat_prof_report),
 #undef NAT_SYM
   };
   for (size_t i = 0; i < syms.size(); i++) {

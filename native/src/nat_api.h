@@ -220,5 +220,21 @@ double nat_stats_hist_quantile(int lane, double q);
 void nat_stats_enable_spans(int every);
 int nat_stats_drain_spans(brpc_tpu::NatSpanRec* out, int max);
 void nat_stats_reset(void);
+// thread-local trace context (rpcz stitching): client calls issued on
+// this thread propagate (trace_id, span_id) on the wire — tpu_std meta
+// trace fields, HTTP x-bd-trace-* headers, gRPC metadata and kind-8 shm
+// descriptors. (0, 0) clears.
+void nat_trace_set(uint64_t trace_id, uint64_t span_id);
+
+// ---- in-process sampling profiler (nat_prof.cpp) ----
+// SIGPROF/CPU-time stack sampling with frame-pointer unwind into
+// lock-free per-thread rings; reports are flat symbol tables (mode 0)
+// or collapsed stacks (mode 1), malloc'd (free with nat_buf_free).
+int nat_prof_start(int hz);
+int nat_prof_stop(void);
+int nat_prof_running(void);
+uint64_t nat_prof_samples(void);
+void nat_prof_reset(void);
+int nat_prof_report(int mode, char** out, size_t* out_len);
 
 }  // extern "C"

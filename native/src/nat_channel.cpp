@@ -415,15 +415,17 @@ static int call_attempt(NatChannel* ch, NatSocket* s, const char* service,
                         size_t payload_len, int timeout_ms, int backup_ms,
                         char** resp_out, size_t* resp_len,
                         char** err_text_out) {
+  NatCallTrace tr = nat_begin_call_trace();
+  tr.set_label(service, ".", method);
   int64_t cid = 0;
-  PendingCall* pc = ch->begin_call(&cid);
+  PendingCall* pc = ch->begin_call(&cid, nullptr, nullptr, &tr);
   if (pc == nullptr) {
     return kEFAILEDSOCKET;  // 1M calls already in flight on this channel
   }
   if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
   IOBuf frame;
   build_request_frame(&frame, cid, service, method, payload, payload_len,
-                      nullptr, 0);
+                      nullptr, 0, tr.trace_id, tr.span_id);
   if (backup_ms > 0 && (timeout_ms <= 0 || backup_ms < timeout_ms)) {
     ch->add_ref();
     BackupCtx* b = new BackupCtx{ch, cid, frame.to_string()};
@@ -623,8 +625,10 @@ int nat_channel_acall(void* h, const char* service, const char* method,
   NatSocket* s = channel_socket(ch);
   if (s == nullptr) return kEFAILEDSOCKET;
   AcallCtx* ctx = new AcallCtx{cb, arg};
+  NatCallTrace tr = nat_begin_call_trace();
+  tr.set_label(service, ".", method);
   int64_t cid = 0;
-  if (ch->begin_call(&cid, acall_complete, ctx) == nullptr) {
+  if (ch->begin_call(&cid, acall_complete, ctx, &tr) == nullptr) {
     s->release();
     delete ctx;
     return kEFAILEDSOCKET;
@@ -632,7 +636,7 @@ int nat_channel_acall(void* h, const char* service, const char* method,
   if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
   IOBuf frame;
   build_request_frame(&frame, cid, service, method, payload, payload_len,
-                      nullptr, 0);
+                      nullptr, 0, tr.trace_id, tr.span_id);
   if (s->write(std::move(frame)) != 0) {
     PendingCall* mine = ch->take_pending(cid, /*ok=*/false);  // s still pins the channel
     if (mine != nullptr) {

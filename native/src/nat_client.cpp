@@ -442,7 +442,8 @@ static void h2c_pump_locked(H2CliSessN* h, H2CliSessN::St* st, uint32_t sid,
 // Returns 0 on success, else an error code.
 static int h2c_send_request(NatChannel* ch, NatSocket* s,
                             const char* path, const char* payload,
-                            size_t payload_len, int64_t cid) {
+                            size_t payload_len, int64_t cid,
+                            const NatCallTrace* tr) {
   H2CliSessN* h = s->h2c;
   if (h == nullptr) return kEFAILEDSOCKET;
   // gRPC message framing: flag + 4B BE length + payload
@@ -494,7 +495,20 @@ static int h2c_send_request(NatChannel* ch, NatSocket* s,
     hp_enc_header(&h->cached_block, "content-type", "application/grpc");
     hp_enc_header(&h->cached_block, "te", "trailers");
   }
-  const std::string& hdr_block = h->cached_block;
+  const std::string* hdr_block = &h->cached_block;
+  std::string traced_block;
+  if (tr != nullptr && tr->trace_id != 0) {
+    // trace metadata (static literal encoding: order-independent, no
+    // dynamic-table state) — the server lane reads x-bd-trace-* back.
+    // Untraced calls keep the zero-copy cached block.
+    char tb[20], sb[20];
+    snprintf(tb, sizeof(tb), "%llx", (unsigned long long)tr->trace_id);
+    snprintf(sb, sizeof(sb), "%llx", (unsigned long long)tr->span_id);
+    traced_block = h->cached_block;
+    hp_enc_header(&traced_block, "x-bd-trace-id", tb);
+    hp_enc_header(&traced_block, "x-bd-span-id", sb);
+    hdr_block = &traced_block;
+  }
   uint32_t sid = h->next_sid;
   h->next_sid += 2;
   H2CliSessN::St& st = h->streams[sid];
@@ -503,8 +517,9 @@ static int h2c_send_request(NatChannel* ch, NatSocket* s,
   st.pend = std::move(data);
   st.pend_end = true;
   std::string out;
-  h2_frame_header(&out, hdr_block.size(), kCFHeaders, kCFlagEndHeaders, sid);
-  out.append(hdr_block);
+  h2_frame_header(&out, hdr_block->size(), kCFHeaders, kCFlagEndHeaders,
+                  sid);
+  out.append(*hdr_block);
   h2c_pump_locked(h, &st, sid, &out);
   IOBuf f;
   f.append(out.data(), out.size());
@@ -976,14 +991,25 @@ void channel_attach_client_session(NatChannel* ch, NatSocket* s) {
 // the pipeline FIFO. extra_headers: raw "Name: value\r\n" lines or null.
 static int http_cli_send(NatChannel* ch, NatSocket* s, const char* verb,
                          const char* path, const char* extra_headers,
-                         const char* body, size_t body_len, int64_t cid) {
+                         const char* body, size_t body_len, int64_t cid,
+                         const NatCallTrace* tr) {
   HttpCliSessN* c = s->httpc;
   if (c == nullptr) return kEFAILEDSOCKET;
-  char head[512];
+  char head[576];
   int n = snprintf(head, sizeof(head),
                    "%s %s HTTP/1.1\r\nHost: %s\r\nContent-Length: %zu\r\n",
                    verb, path, ch->authority.c_str(), body_len);
   if (n < 0 || (size_t)n >= sizeof(head)) return kEFAILEDSOCKET;
+  if (tr != nullptr && tr->trace_id != 0) {
+    // trace headers (hex): the server lane's x-bd-trace-* parse chains
+    // its span under this call's span in /rpcz find_trace
+    int m = snprintf(head + n, sizeof(head) - (size_t)n,
+                     "x-bd-trace-id: %llx\r\nx-bd-span-id: %llx\r\n",
+                     (unsigned long long)tr->trace_id,
+                     (unsigned long long)tr->span_id);
+    if (m < 0 || (size_t)(n + m) >= sizeof(head)) return kEFAILEDSOCKET;
+    n += m;
+  }
   IOBuf f;
   f.append(head, (size_t)n);
   if (extra_headers != nullptr && extra_headers[0] != '\0') {
@@ -1085,15 +1111,17 @@ int nat_http_call(void* h, const char* verb, const char* path,
   if (status_out != nullptr) *status_out = 0;
   NatSocket* s = channel_socket(ch, timeout_ms);
   if (s == nullptr) return kEFAILEDSOCKET;
+  NatCallTrace tr = nat_begin_call_trace();
+  tr.set_label(verb, " ", path);
   int64_t cid = 0;
-  PendingCall* pc = ch->begin_call(&cid);
+  PendingCall* pc = ch->begin_call(&cid, nullptr, nullptr, &tr);
   if (pc == nullptr) {
     s->release();
     return kEFAILEDSOCKET;
   }
   if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
   int rc = http_cli_send(ch, s, verb, path, extra_headers, body, body_len,
-                         cid);
+                         cid, &tr);
   if (rc != 0) {
     reap_failed_send(ch, pc, cid);
     s->release();
@@ -1111,15 +1139,17 @@ int nat_http_acall(void* h, const char* verb, const char* path,
   NatSocket* s = channel_socket(ch);
   if (s == nullptr) return kEFAILEDSOCKET;
   Acall2Ctx* ctx = new Acall2Ctx{cb, arg};
+  NatCallTrace tr = nat_begin_call_trace();
+  tr.set_label(verb, " ", path);
   int64_t cid = 0;
-  if (ch->begin_call(&cid, acall2_complete, ctx) == nullptr) {
+  if (ch->begin_call(&cid, acall2_complete, ctx, &tr) == nullptr) {
     s->release();
     delete ctx;
     return kEFAILEDSOCKET;
   }
   if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
   int rc = http_cli_send(ch, s, verb, path, extra_headers, body, body_len,
-                         cid);
+                         cid, &tr);
   if (rc != 0) {
     // complete through the callback exactly once (unless fail_all
     // already swept the cid and fired it)
@@ -1141,14 +1171,16 @@ int nat_grpc_call(void* h, const char* path, const char* payload,
   if (grpc_status_out != nullptr) *grpc_status_out = -1;
   NatSocket* s = channel_socket(ch, timeout_ms);
   if (s == nullptr) return kEFAILEDSOCKET;
+  NatCallTrace tr = nat_begin_call_trace();
+  tr.set_label(path, "", "");
   int64_t cid = 0;
-  PendingCall* pc = ch->begin_call(&cid);
+  PendingCall* pc = ch->begin_call(&cid, nullptr, nullptr, &tr);
   if (pc == nullptr) {
     s->release();
     return kEFAILEDSOCKET;
   }
   if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
-  int rc = h2c_send_request(ch, s, path, payload, payload_len, cid);
+  int rc = h2c_send_request(ch, s, path, payload, payload_len, cid, &tr);
   if (rc != 0) {
     reap_failed_send(ch, pc, cid);
     s->release();
@@ -1166,14 +1198,16 @@ int nat_grpc_acall(void* h, const char* path, const char* payload,
   NatSocket* s = channel_socket(ch);
   if (s == nullptr) return kEFAILEDSOCKET;
   Acall2Ctx* ctx = new Acall2Ctx{cb, arg};
+  NatCallTrace tr = nat_begin_call_trace();
+  tr.set_label(path, "", "");
   int64_t cid = 0;
-  if (ch->begin_call(&cid, acall2_complete, ctx) == nullptr) {
+  if (ch->begin_call(&cid, acall2_complete, ctx, &tr) == nullptr) {
     s->release();
     delete ctx;
     return kEFAILEDSOCKET;
   }
   if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
-  int rc = h2c_send_request(ch, s, path, payload, payload_len, cid);
+  int rc = h2c_send_request(ch, s, path, payload, payload_len, cid, &tr);
   if (rc != 0) {
     // complete through the callback exactly once (unless fail_all did)
     PendingCall* mine = ch->take_pending(cid, /*ok=*/false);

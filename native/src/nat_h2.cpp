@@ -665,6 +665,21 @@ static void h2_flush_pending(NatSocket* s, H2SessionN* h, std::string* out) {
   }
 }
 
+// Trace context from a decoded flat header block ("name: value\n",
+// names lowercased): the x-bd-trace-id / x-bd-span-id gRPC metadata the
+// native client lane stamps (values hex, matching the HTTP lane).
+static void trace_from_flat(const std::string& flat, uint64_t* trace_id,
+                            uint64_t* parent_span) {
+  size_t p = flat.find("x-bd-trace-id: ");
+  if (p != std::string::npos && (p == 0 || flat[p - 1] == '\n')) {
+    *trace_id = strtoull(flat.c_str() + p + 15, nullptr, 16);
+  }
+  p = flat.find("x-bd-span-id: ");
+  if (p != std::string::npos && (p == 0 || flat[p - 1] == '\n')) {
+    *parent_span = strtoull(flat.c_str() + p + 14, nullptr, 16);
+  }
+}
+
 // A stream finished (END_STREAM): dispatch to a native handler
 // ("/Service/Method" -> "Service.Method") or the py lane (kind 4).
 static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
@@ -724,10 +739,12 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
           uint64_t t_write = nat_now_ns();
           nat_lat_record(NL_GRPC, t_write - t_parse);
           if (nat_span_tick()) {
+            uint64_t trace_id = 0, parent_span = 0;
+            trace_from_flat(flat, &trace_id, &parent_span);
             nat_span_record(NL_GRPC, s->id, path.data(), path.size(),
                             t_recv != 0 ? t_recv : t_parse, t_parse,
                             t_dispatch, t_write, ctx.error_code, req_bytes,
-                            (uint32_t)resp.size());
+                            (uint32_t)resp.size(), trace_id, parent_span);
           }
           return;
         }
@@ -744,6 +761,7 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
   r->sock_id = s->id;
   r->cid = (int64_t)sid;
   r->method = std::move(path);
+  trace_from_flat(flat, &r->trace_id, &r->parent_span_id);
   r->meta_bytes = std::move(flat);
   r->payload = std::move(data);
   srv->enqueue_py(r);

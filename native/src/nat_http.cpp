@@ -237,6 +237,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
     bool chunked = false;
     bool conn_close = false;
     bool expect_continue = false;
+    uint64_t trace_id = 0, parent_span = 0;  // x-bd-trace-* (hex)
     const char* line = (const char*)memchr(scan, '\n', hdr_len);
     line = line == nullptr ? hdr_end : line + 1;
     while (line < hdr_end) {
@@ -269,6 +270,10 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         } else if (key == "expect") {
           expect_continue =
               val.find("100-continue") != std::string_view::npos;
+        } else if (key == "x-bd-trace-id") {
+          trace_id = strtoull(std::string(val).c_str(), nullptr, 16);
+        } else if (key == "x-bd-span-id") {
+          parent_span = strtoull(std::string(val).c_str(), nullptr, 16);
         }
         flat.push_back(':');
         flat.push_back(' ');
@@ -394,7 +399,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         nat_span_record(NL_HTTP, s->id, span_path, span_path_n, t_recv,
                         t_parse, t_dispatch, t_write,
                         ctx.status >= 400 ? ctx.status : 0, req_bytes,
-                        out_bytes);
+                        out_bytes, trace_id, parent_span);
       }
       if (s->failed.load(std::memory_order_acquire) ||
           s->close_after_drain.load(std::memory_order_acquire)) {
@@ -422,6 +427,8 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
     r->service.assign(verb.data(), verb.size());
     r->method.assign(uri.data(), uri.size());
     r->meta_bytes = std::move(flat);
+    r->trace_id = trace_id;
+    r->parent_span_id = parent_span;
     if (chunked) {
       r->payload = std::move(body);
     } else if (content_length > 0) {

@@ -300,7 +300,9 @@ using HttpHandlerN = std::function<void(HttpHandlerCtxN&)>;
 // meta_bytes = header lines, cid = h2 stream id); 5 = streaming frame
 // (aux = dest stream id, compress_type = frame type DATA/FEEDBACK/CLOSE,
 // cid = per-socket sequence for ordered delivery, payload = frame body);
-// 8 = bulk tensor record (shm descriptor lane, aux = caller tag).
+// 8 = bulk tensor record (shm descriptor lane, aux = caller tag; the
+// connection-less sock_id/cid fields carry the pusher's ambient trace
+// context: sock_id = trace_id, cid = parent span id).
 struct PyRequest;
 
 // shm descriptor lane (nat_shm_lane.cpp): release the blob-arena span an
@@ -364,6 +366,12 @@ struct PyRequest {
   uint64_t shm_span = 0;   // span-start offset (monotone) for the release
   const char* shm_view[5] = {nullptr, nullptr, nullptr, nullptr, nullptr};
   size_t shm_view_len[5] = {0, 0, 0, 0, 0};
+  // trace context parsed off the wire (RpcMeta trace fields /
+  // x-bd-trace-* headers / gRPC metadata): trace_id = the caller's
+  // trace, parent_span_id = the caller's span — consumed by the shm
+  // lane's server-span records (shm_lane_offer / emit_response)
+  uint64_t trace_id = 0;
+  uint64_t parent_span_id = 0;
   // overload accounting (nat_overload.cpp): enqueue_ns stamped when a
   // limiter/deadline is configured; admitted = this request holds one
   // in-flight slot, released exactly once (dtor, or transferred to the
@@ -590,7 +598,54 @@ struct PendingCall {
   // call-begin timestamp (nat_stats client-lane latency: the round trip
   // lands in NL_CLIENT when the completion wins take_pending)
   uint64_t start_ns = 0;
+  // client-span state (rpcz): copied from the caller's NatCallTrace by
+  // begin_call BEFORE the pending bit publishes (after publish a racing
+  // fail_all may complete + recycle this slot, so nothing may touch
+  // these fields post-publish); the protocol lanes stamp the SAME
+  // NatCallTrace's ids into the wire metadata, and the ok-completion in
+  // take_pending submits the span.
+  uint64_t trace_id = 0;        // 0 = no trace propagation for this call
+  uint64_t span_id = 0;         // THIS call's span (the callee's parent)
+  uint64_t parent_span_id = 0;  // the ambient span this call nests under
+  bool span_sampled = false;
+  uint8_t span_method_len = 0;
+  char span_method[40];
 };
+
+// Per-call trace decision, taken ONCE on the caller's stack before
+// begin_call: sampling stride + this thread's ambient context
+// (tls_nat_trace) + the span label the lane knows. The lanes read wire
+// ids from THIS struct (never from the PendingCall after publish).
+struct NatCallTrace {
+  uint64_t trace_id = 0;
+  uint64_t span_id = 0;
+  uint64_t parent_span_id = 0;
+  bool sampled = false;
+  uint8_t label_len = 0;
+  char label[40];
+
+  // "a<sep>b" span label (only when sampled: the snprintf is off the
+  // untraced hot path)
+  void set_label(const char* a, const char* sep, const char* b) {
+    if (!sampled) return;
+    int n = snprintf(label, sizeof(label), "%s%s%s", a, sep, b);
+    label_len = (uint8_t)(n <= 0 ? 0
+                          : (n < (int)sizeof(label) ? n
+                                                    : (int)sizeof(label) - 1));
+  }
+};
+
+inline NatCallTrace nat_begin_call_trace() {
+  NatCallTrace tr;
+  tr.sampled = nat_span_tick();
+  const NatTraceCtx& tc = tls_nat_trace;
+  if (tr.sampled || tc.trace_id != 0) {
+    tr.trace_id = tc.trace_id != 0 ? tc.trace_id : nat_span_id63();
+    tr.span_id = nat_span_id63();
+    tr.parent_span_id = tc.span_id;
+  }
+  return tr;
+}
 
 void pc_free(PendingCall* pc);  // returns the slot to its channel
 
@@ -666,7 +721,8 @@ class NatChannel {
 
   PendingCall* begin_call(int64_t* cid_out,
                           void (*cb)(PendingCall*, void*) = nullptr,
-                          void* cb_arg = nullptr) {
+                          void* cb_arg = nullptr,
+                          const NatCallTrace* tr = nullptr) {
     uint32_t idx = pop_free();
     if (idx == UINT32_MAX) return nullptr;  // slot space exhausted
     PendingCall* pc = slot_at(idx);
@@ -684,6 +740,31 @@ class NatChannel {
     pc->owner = this;
     pc->slot_idx = idx;
     pc->start_ns = nat_now_ns();
+    // client span + trace propagation, fully written BEFORE the pending
+    // bit publishes (a racing fail_all may complete and recycle the
+    // slot the instant the bit is visible). Callers that pass no trace
+    // (bench harnesses) fall back to the stride decision with no label.
+    if (tr != nullptr) {
+      pc->span_sampled = tr->sampled;
+      pc->trace_id = tr->trace_id;
+      pc->span_id = tr->span_id;
+      pc->parent_span_id = tr->parent_span_id;
+      pc->span_method_len = tr->label_len;
+      memcpy(pc->span_method, tr->label, tr->label_len);
+    } else {
+      pc->span_sampled = nat_span_tick();
+      pc->span_method_len = 0;
+      const NatTraceCtx& tc = tls_nat_trace;
+      if (pc->span_sampled || tc.trace_id != 0) {
+        pc->trace_id = tc.trace_id != 0 ? tc.trace_id : nat_span_id63();
+        pc->span_id = nat_span_id63();
+        pc->parent_span_id = tc.span_id;
+      } else {
+        pc->trace_id = 0;
+        pc->span_id = 0;
+        pc->parent_span_id = 0;
+      }
+    }
     nat_counter_add(NS_CLIENT_CALLS, 1);
     // everything above must be visible before the pending bit: a racing
     // fail_all completes through cb/butex the instant it sees the bit
@@ -716,8 +797,28 @@ class NatChannel {
                                           std::memory_order_acq_rel)) {
       if (ok) {
         nat_counter_add(NS_CLIENT_RESPONSES, 1);
+        uint64_t now = nat_now_ns();
         if (pc->start_ns != 0) {
-          nat_lat_record(NL_CLIENT, nat_now_ns() - pc->start_ns);
+          nat_lat_record(NL_CLIENT, now - pc->start_ns);
+        }
+        if (pc->span_sampled) {
+          // the caller still owns pc here (the CAS handed it to us), so
+          // the span fields are stable; error/status details land after
+          // take_pending, so the client span records the round trip only
+          NatSpanRec rec;
+          memset(&rec, 0, sizeof(rec));
+          rec.trace_id = pc->trace_id;
+          rec.span_id = pc->span_id;
+          rec.parent_span_id = pc->parent_span_id;
+          rec.recv_ns = pc->start_ns;
+          rec.parse_ns = pc->start_ns;
+          rec.dispatch_ns = now;
+          rec.write_ns = now;
+          rec.protocol = NL_CLIENT;
+          size_t n = pc->span_method_len;
+          memcpy(rec.method, pc->span_method, n);
+          rec.method[n] = '\0';
+          nat_span_submit(rec);
         }
         // breaker verdict + retry-budget replenish are fed by the
         // protocol layers (messenger / client-lane finishers), which
@@ -876,7 +977,8 @@ void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
                           IOBuf&& attachment);
 void build_request_frame(IOBuf* out, int64_t cid, const std::string& service,
                          const std::string& method, const char* payload,
-                         size_t payload_len, const char* att, size_t att_len);
+                         size_t payload_len, const char* att, size_t att_len,
+                         uint64_t trace_id = 0, uint64_t span_id = 0);
 bool process_input(NatSocket* s, IOBuf* defer_out = nullptr);
 bool drain_socket_inline(NatSocket* s);
 

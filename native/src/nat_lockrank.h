@@ -35,6 +35,13 @@
 namespace brpc_tpu {
 
 enum : int {
+  kLockRankProfCtl = 6,       // nat_prof g_ctl_mu: start/stop/reset
+                              // serialization (control path only; held
+                              // across the collector join, which takes
+                              // g_report_mu on its own thread)
+  kLockRankProfReport = 8,    // nat_prof g_report_mu: collector/report
+                              // serialization (holds no other lock while
+                              // symbolizing), outermost
   kLockRankShmProbe = 10,     // g_probe_mu: fence probing, outermost
   // 15: shm.fence (raw robust pthread mutex, see header comment)
   kLockRankShmReq = 20,       // g_req_mu[i]: per-worker request producer

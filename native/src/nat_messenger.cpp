@@ -33,14 +33,15 @@ void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
 
 void build_request_frame(IOBuf* out, int64_t cid, const std::string& service,
                          const std::string& method, const char* payload,
-                         size_t payload_len, const char* att,
-                         size_t att_len) {
+                         size_t payload_len, const char* att, size_t att_len,
+                         uint64_t trace_id, uint64_t span_id) {
   size_t bound = 12 + request_meta_bound(service.size(), method.size());
   char stack_buf[320];
   char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
   size_t mlen = encode_request_meta_to(buf + 12, service.data(),
                                        service.size(), method.data(),
-                                       method.size(), cid, (int64_t)att_len);
+                                       method.size(), cid, (int64_t)att_len,
+                                       trace_id, span_id);
   memcpy(buf, kMagicRpc, 4);
   wr_be32(buf + 4, (uint32_t)(mlen + payload_len + att_len));
   wr_be32(buf + 8, (uint32_t)mlen);
@@ -519,7 +520,8 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
           }
           nat_span_record(NL_ECHO, s->id, m, ml, t_recv, t_parse,
                           t_dispatch, t_write, ctx.error_code, req_bytes,
-                          resp_bytes);
+                          resp_bytes, (uint64_t)meta.request.trace_id,
+                          (uint64_t)meta.request.span_id);
         }
       } else if (srv->py_lane_enabled) {
         PyRequest* r = new PyRequest();
@@ -531,6 +533,8 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
         r->payload = payload.to_string();
         r->attachment = attachment.to_string();
         r->meta_bytes = std::move(meta_copy);
+        r->trace_id = (uint64_t)meta.request.trace_id;
+        r->parent_span_id = (uint64_t)meta.request.span_id;
         srv->enqueue_py(r);
       } else {
         build_response_frame(&batch_out, meta.correlation_id, kENOSERVICE,

@@ -1,0 +1,452 @@
+// nat_prof — SIGPROF-driven stack sampler. Design map in nat_prof.h.
+//
+// Data path: signal handler (any thread the kernel picks as "running on
+// CPU") -> per-tid ProfCell claimed by CAS from a fixed pool -> seqlock
+// sample slots (the span-ring discipline: busy mark, payload, publish)
+// -> collector drains into an aggregated stack->count map under the
+// report mutex -> flat / collapsed text reports.
+#include "nat_prof.h"
+
+#include <cxxabi.h>
+#include <dlfcn.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <sys/uio.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <map>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "nat_api.h"
+#include "nat_lockrank.h"
+#include "nat_stats.h"
+
+namespace brpc_tpu {
+namespace {
+
+struct ProfSample {
+  std::atomic<uint64_t> seq{0};  // 2t+1 = busy, 2t+2 = published
+  uint32_t depth;
+  uintptr_t pc[kProfMaxFrames];
+};
+
+struct ProfCell {
+  std::atomic<int32_t> tid{0};     // 0 = free; CAS-claimed by the handler
+  std::atomic<uint64_t> head{0};   // next ticket (handler-only writer)
+  uint64_t next_read = 0;          // collector cursor (under report mu)
+  ProfSample ring[kProfRing];
+};
+
+// fixed pool, zero-initialized BSS: the handler may claim but never
+// allocates (cells persist across start/stop; a thread keeps its cell)
+ProfCell g_cells[kProfCells];
+
+std::atomic<bool> g_on{false};
+std::atomic<uint64_t> g_samples{0};   // samples captured
+std::atomic<uint64_t> g_dropped{0};   // cell pool exhausted / unwind empty
+bool g_handler_installed = false;     // installed ONCE, never restored:
+// a SIGPROF generated just before setitimer(0) can be DELIVERED after a
+// handler restore, and the default SIGPROF action terminates the
+// process — so stop() only disarms the timer and flips g_on; the
+// installed handler is a no-op while off (the gperftools discipline)
+// background collector: drains the bounded per-thread rings into the
+// aggregate while sampling runs, so a minutes-long profile window does
+// not overwrite its own early samples (rings hold kProfRing each).
+// Heap-held + joined in stop — never a static std::thread (the
+// static-dtor exit-crash class).
+std::thread* g_collector = nullptr;
+std::atomic<bool> g_collector_stop{false};
+
+// control-path serialization: two concurrent /hotspots/native requests
+// must not both win start (double collector spawn / mid-window stop)
+NatMutex<kLockRankProfCtl> g_ctl_mu;
+// aggregate since start/reset: leaf-first pc stack -> sample count
+// (collector-side only, under g_report_mu)
+NatMutex<kLockRankProfReport> g_report_mu;
+std::map<std::vector<uintptr_t>, uint64_t>& g_stacks =
+    *new std::map<std::vector<uintptr_t>, uint64_t>();
+
+// ---------------------------------------------------------------------------
+// signal side — async-signal-safe only (natcheck sigsafe rule)
+// ---------------------------------------------------------------------------
+
+// Probe-read two frame words via process_vm_readv on ourselves: a raw
+// syscall (async-signal-safe) that validates readability instead of
+// faulting on a garbage frame pointer mid-prologue.
+bool prof_safe_read(uintptr_t addr, uintptr_t out[2]) {
+  struct iovec lio;
+  lio.iov_base = out;
+  lio.iov_len = 2 * sizeof(uintptr_t);
+  struct iovec rio;
+  rio.iov_base = (void*)addr;
+  rio.iov_len = 2 * sizeof(uintptr_t);
+  return syscall(SYS_process_vm_readv, (pid_t)syscall(SYS_getpid), &lio, 1,
+                 &rio, 1, 0) == (ssize_t)(2 * sizeof(uintptr_t));
+}
+
+// Frame-pointer unwind from the interrupted context: [fp] = caller fp,
+// [fp + 8] = return address (x86_64 / aarch64 frame records; the build
+// keeps frame pointers). Bounded, monotone, probe-read — a corrupt
+// chain terminates the walk, never the process.
+int prof_unwind(void* ucv, uintptr_t* out) {
+  uintptr_t pc = 0, fp = 0;
+#if defined(__x86_64__)
+  ucontext_t* uc = (ucontext_t*)ucv;
+  pc = (uintptr_t)uc->uc_mcontext.gregs[REG_RIP];
+  fp = (uintptr_t)uc->uc_mcontext.gregs[REG_RBP];
+#elif defined(__aarch64__)
+  ucontext_t* uc = (ucontext_t*)ucv;
+  pc = (uintptr_t)uc->uc_mcontext.pc;
+  fp = (uintptr_t)uc->uc_mcontext.regs[29];
+#else
+  (void)ucv;
+  fp = (uintptr_t)__builtin_frame_address(0);
+#endif
+  int n = 0;
+  if (pc != 0) out[n++] = pc;
+  int hops = 0;
+  while (n < kProfMaxFrames && fp != 0 &&
+         (fp & (sizeof(uintptr_t) - 1)) == 0 && hops++ < 64) {
+    uintptr_t frame[2];
+    if (!prof_safe_read(fp, frame)) break;
+    if (frame[1] < 4096) break;  // return address in the zero page: junk
+    out[n++] = frame[1];
+    // stacks grow down: the caller's frame is strictly above, and a sane
+    // frame step is bounded (a giant jump means the chain left the stack)
+    if (frame[0] <= fp || frame[0] - fp > (1u << 20)) break;
+    fp = frame[0];
+  }
+  return n;
+}
+
+// Claim (or find) the cell for `tid`: open addressing over the fixed
+// pool, CAS on the tid word. No allocation, no locks.
+ProfCell* prof_cell(int32_t tid) {
+  uint32_t h = (uint32_t)(nat_mix64((uint64_t)tid) % kProfCells);
+  for (int probe = 0; probe < kProfCells; probe++) {
+    ProfCell* c = &g_cells[(h + (uint32_t)probe) % kProfCells];
+    int32_t cur = c->tid.load(std::memory_order_acquire);
+    if (cur == tid) return c;
+    if (cur == 0) {
+      int32_t expect = 0;
+      if (c->tid.compare_exchange_strong(expect, tid,
+                                         std::memory_order_acq_rel)) {
+        return c;
+      }
+      if (expect == tid) return c;  // lost to ourselves? (impossible) —
+                                    // lost to another tid: keep probing
+    }
+  }
+  return nullptr;  // pool full: drop the sample
+}
+
+// The SIGPROF handler. natcheck:sigsafe — only syscalls, lock-free
+// atomics and memcpy into preallocated rings are legal in this function
+// (tools/natcheck lint `sigsafe` rule scans *_sighandler bodies).
+void prof_sighandler(int, siginfo_t*, void* ucv) {
+  int saved_errno = errno;  // syscalls below clobber it
+  if (g_on.load(std::memory_order_relaxed)) {
+    uintptr_t pcs[kProfMaxFrames];
+    int depth = prof_unwind(ucv, pcs);
+    if (depth <= 0) {
+      g_dropped.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      ProfCell* cell = prof_cell((int32_t)syscall(SYS_gettid));
+      if (cell == nullptr) {
+        g_dropped.fetch_add(1, std::memory_order_relaxed);
+      } else {
+        uint64_t t = cell->head.load(std::memory_order_relaxed);
+        ProfSample& s = cell->ring[t & (kProfRing - 1)];
+        s.seq.store(2 * t + 1, std::memory_order_relaxed);  // busy
+        // payload stores must not become visible before the busy mark
+        // (the span-ring seqlock discipline, nat_stats.cpp)
+        std::atomic_thread_fence(std::memory_order_seq_cst);
+        s.depth = (uint32_t)depth;
+        memcpy(s.pc, pcs, (size_t)depth * sizeof(uintptr_t));
+        s.seq.store(2 * t + 2, std::memory_order_release);   // published
+        cell->head.store(t + 1, std::memory_order_release);
+        g_samples.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+  }
+  errno = saved_errno;
+}
+
+// ---------------------------------------------------------------------------
+// collector side — normal code, runs outside signal context
+// ---------------------------------------------------------------------------
+
+// Drain published samples from every cell into the aggregate map.
+// Requires g_report_mu.
+void prof_drain_locked() {
+  for (int i = 0; i < kProfCells; i++) {
+    ProfCell* c = &g_cells[i];
+    if (c->tid.load(std::memory_order_acquire) == 0) continue;
+    uint64_t head = c->head.load(std::memory_order_acquire);
+    if (head - c->next_read > kProfRing) {
+      // overwritten before this drain: account and skip forward
+      g_dropped.fetch_add(head - c->next_read - kProfRing,
+                          std::memory_order_relaxed);
+      c->next_read = head - kProfRing;
+    }
+    std::vector<uintptr_t> stack;
+    while (c->next_read < head) {
+      ProfSample& s = c->ring[c->next_read & (kProfRing - 1)];
+      uint64_t want = 2 * c->next_read + 2;
+      bool kept = false;
+      if (s.seq.load(std::memory_order_acquire) == want) {
+        uint32_t depth = s.depth;
+        if (depth > (uint32_t)kProfMaxFrames) depth = kProfMaxFrames;
+        stack.assign(s.pc, s.pc + depth);
+        // the copy must complete before the validation re-load (seqlock
+        // reader recipe — the handler may be overwriting concurrently)
+        std::atomic_thread_fence(std::memory_order_acquire);
+        if (s.seq.load(std::memory_order_relaxed) == want) {
+          g_stacks[stack] += 1;
+          kept = true;
+        }
+      }
+      // torn/overwritten mid-copy: every claimed ticket < head was
+      // published once, so a mismatch IS a lost sample — account it
+      // (the report's dropped figure must not undercount)
+      if (!kept) g_dropped.fetch_add(1, std::memory_order_relaxed);
+      c->next_read++;
+    }
+  }
+}
+
+// Collector loop: periodic ring drain while sampling runs (started by
+// nat_prof_start, joined by nat_prof_stop).
+void prof_collector_loop() {
+  while (!g_collector_stop.load(std::memory_order_acquire)) {
+    {
+      std::lock_guard g(g_report_mu);
+      prof_drain_locked();
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(250));
+  }
+}
+
+// pc -> "symbol" via dladdr (cached); demangled when possible, else
+// "module+0xoff" so JIT/unknown regions still aggregate stably.
+std::string prof_symbolize(uintptr_t pc,
+                           std::map<uintptr_t, std::string>* cache) {
+  auto it = cache->find(pc);
+  if (it != cache->end()) return it->second;
+  std::string name;
+  Dl_info info;
+  // the RETURN address points one past the call site: resolve pc-1 so a
+  // call ending a function does not symbolize as its successor
+  if (dladdr((void*)(pc - 1), &info) != 0 && info.dli_sname != nullptr) {
+    int status = 0;
+    char* dem = abi::__cxa_demangle(info.dli_sname, nullptr, nullptr,
+                                    &status);
+    if (status == 0 && dem != nullptr) {
+      name = dem;
+      // strip template/arg noise for the flat table's readability
+      size_t lt = name.find('<');
+      size_t par = name.find('(');
+      size_t cut = lt < par ? lt : par;
+      if (cut != std::string::npos && cut > 0) name.resize(cut);
+    } else {
+      name = info.dli_sname;
+    }
+    free(dem);
+  } else if (dladdr((void*)(pc - 1), &info) != 0 &&
+             info.dli_fname != nullptr) {
+    const char* base = strrchr(info.dli_fname, '/');
+    char buf[160];
+    snprintf(buf, sizeof(buf), "%s+0x%zx",
+             base != nullptr ? base + 1 : info.dli_fname,
+             (size_t)(pc - (uintptr_t)info.dli_fbase));
+    name = buf;
+  } else {
+    char buf[32];
+    snprintf(buf, sizeof(buf), "0x%zx", (size_t)pc);
+    name = buf;
+  }
+  (*cache)[pc] = name;
+  return name;
+}
+
+}  // namespace
+}  // namespace brpc_tpu
+
+using namespace brpc_tpu;
+
+extern "C" {
+
+// Start sampling at `hz` (<= 0 -> 99). SIGPROF fires on process CPU
+// time, so idle threads cost nothing and busy ones are sampled in
+// proportion to the cycles they burn. Returns 0, -1 when already
+// running, -2 when the handler/timer could not be installed.
+int nat_prof_start(int hz) {
+  // serialize the whole control op: a concurrent start must lose with -1
+  // (not spawn a second collector), and a start racing a stop must see
+  // a fully-torn-down profiler
+  std::lock_guard ctl(g_ctl_mu);
+  if (g_on.load(std::memory_order_acquire)) return -1;
+  if (hz <= 0) hz = 99;
+  if (hz > 1000) hz = 1000;
+  if (!g_handler_installed) {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = prof_sighandler;
+    sa.sa_flags = SA_SIGINFO | SA_RESTART;
+    sigemptyset(&sa.sa_mask);
+    if (sigaction(SIGPROF, &sa, nullptr) != 0) return -2;
+    g_handler_installed = true;
+  }
+  // reclaim cells whose threads are gone (no handler can run: g_on is
+  // false and the ctl mutex is held) — a churny embedder would otherwise
+  // exhaust the fixed pool across profiling windows
+  {
+    std::lock_guard g(g_report_mu);
+    prof_drain_locked();  // keep any still-undrained samples
+    for (int i = 0; i < kProfCells; i++) {
+      int32_t tid = g_cells[i].tid.load(std::memory_order_acquire);
+      if (tid == 0) continue;
+      char path[64];
+      snprintf(path, sizeof(path), "/proc/self/task/%d", tid);
+      if (access(path, F_OK) != 0) {
+        g_cells[i].next_read =
+            g_cells[i].head.load(std::memory_order_acquire);
+        g_cells[i].tid.store(0, std::memory_order_release);
+      }
+    }
+  }
+  g_on.store(true, std::memory_order_release);
+  struct itimerval it;
+  it.it_interval.tv_sec = hz == 1 ? 1 : 0;
+  it.it_interval.tv_usec = hz == 1 ? 0 : 1000000 / hz;
+  it.it_value = it.it_interval;
+  if (setitimer(ITIMER_PROF, &it, nullptr) != 0) {
+    g_on.store(false, std::memory_order_release);
+    return -2;
+  }
+  g_collector_stop.store(false, std::memory_order_release);
+  g_collector = new std::thread(prof_collector_loop);
+  return 0;
+}
+
+// Stop sampling (samples stay drainable for nat_prof_report). Safe to
+// call when not running.
+int nat_prof_stop(void) {
+  std::lock_guard ctl(g_ctl_mu);
+  if (!g_on.exchange(false, std::memory_order_acq_rel)) return 0;
+  struct itimerval off;
+  memset(&off, 0, sizeof(off));
+  setitimer(ITIMER_PROF, &off, nullptr);
+  // the handler stays installed (no-op while g_on is false): restoring
+  // the previous disposition here could hand a still-pending SIGPROF to
+  // the DEFAULT action, which terminates the process
+  if (g_collector != nullptr) {
+    g_collector_stop.store(true, std::memory_order_release);
+    // natcheck:allow(lock-switch): control path on embedder threads
+    // (never a fiber); g_ctl_mu is held ON PURPOSE so a concurrent
+    // start cannot spawn a second collector while this one is joining
+    g_collector->join();
+    delete g_collector;
+    g_collector = nullptr;
+  }
+  return 0;
+}
+
+int nat_prof_running(void) {
+  return g_on.load(std::memory_order_acquire) ? 1 : 0;
+}
+
+uint64_t nat_prof_samples(void) {
+  return g_samples.load(std::memory_order_relaxed);
+}
+
+// Forget everything sampled so far (aggregate + undrained ring content).
+void nat_prof_reset(void) {
+  std::lock_guard g(g_report_mu);
+  for (int i = 0; i < kProfCells; i++) {
+    g_cells[i].next_read = g_cells[i].head.load(std::memory_order_acquire);
+  }
+  g_stacks.clear();
+  g_samples.store(0, std::memory_order_relaxed);
+  g_dropped.store(0, std::memory_order_relaxed);
+}
+
+// Render the profile accumulated since start/reset. mode 0 = flat
+// self-sample symbol table (the PROFILE_r*.md shape), mode 1 = collapsed
+// stacks (root;...;leaf count — flamegraph.pl / speedscope compatible).
+// *out is malloc'd (free with nat_buf_free); returns 0, -1 on OOM.
+int nat_prof_report(int mode, char** out, size_t* out_len) {
+  if (out == nullptr || out_len == nullptr) return -1;
+  std::string text;
+  {
+    std::lock_guard g(g_report_mu);
+    prof_drain_locked();
+    std::map<uintptr_t, std::string> symcache;
+    uint64_t total = 0;
+    for (const auto& kv : g_stacks) total += kv.second;
+    char hdr[160];
+    snprintf(hdr, sizeof(hdr),
+             "# nat_prof: %llu samples (%llu dropped), %s\n",
+             (unsigned long long)total,
+             (unsigned long long)g_dropped.load(std::memory_order_relaxed),
+             mode == 0 ? "flat self samples"
+                       : "collapsed stacks (root..leaf count)");
+    text += hdr;
+    if (mode == 0) {
+      // flat: self samples per leaf symbol, descending
+      std::map<std::string, uint64_t> flat;
+      for (const auto& kv : g_stacks) {
+        flat[prof_symbolize(kv.first.front(), &symcache)] += kv.second;
+      }
+      std::vector<std::pair<uint64_t, const std::string*>> rows;
+      rows.reserve(flat.size());
+      for (const auto& kv : flat) rows.emplace_back(kv.second, &kv.first);
+      std::sort(rows.begin(), rows.end(),
+                [](const auto& a, const auto& b) { return a.first > b.first; });
+      for (const auto& r : rows) {
+        char line[256];
+        snprintf(line, sizeof(line), "%8llu %5.1f%%  %s\n",
+                 (unsigned long long)r.first,
+                 total != 0 ? 100.0 * (double)r.first / (double)total : 0.0,
+                 r.second->c_str());
+        text += line;
+      }
+    } else {
+      // collapsed: samples are leaf-first; flamegraph wants root..leaf
+      std::map<std::string, uint64_t> folded;
+      std::string key;
+      for (const auto& kv : g_stacks) {
+        key.clear();
+        for (size_t i = kv.first.size(); i-- > 0;) {
+          if (!key.empty()) key += ';';
+          key += prof_symbolize(kv.first[i], &symcache);
+        }
+        folded[key] += kv.second;
+      }
+      for (const auto& kv : folded) {
+        text += kv.first;
+        char cnt[32];
+        snprintf(cnt, sizeof(cnt), " %llu\n",
+                 (unsigned long long)kv.second);
+        text += cnt;
+      }
+    }
+  }
+  char* buf = (char*)malloc(text.size() + 1);
+  if (buf == nullptr) return -1;
+  memcpy(buf, text.data(), text.size());
+  buf[text.size()] = '\0';
+  *out = buf;
+  *out_len = text.size();
+  return 0;
+}
+
+}  // extern "C"

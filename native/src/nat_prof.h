@@ -1,0 +1,33 @@
+// nat_prof — in-process sampling profiler for the native runtime.
+//
+// The /hotspots/cpu role (SURVEY §5: hotspots_service.h + gperftools'
+// ProfileHandler) done TPU-serving-shaped: a SIGPROF interval timer
+// drives CPU-time sampling of whichever threads are actually burning
+// cycles; the signal handler walks the frame-pointer chain (the build
+// keeps -fno-omit-frame-pointer for exactly this) into a lock-free
+// per-thread sample ring, and collection/symbolization (dladdr +
+// __cxa_demangle) happens entirely OUTSIDE signal context. Reports come
+// out two ways: a flat self-sample symbol table (the PROFILE_r*.md
+// shape) and collapsed stacks (flamegraph.pl / speedscope ingestible).
+//
+// Signal-handler discipline: the handler is restricted to
+// async-signal-safe operations — raw syscalls (gettid,
+// process_vm_readv to probe frame words without faulting), lock-free
+// atomics and memcpy into preallocated rings. No allocation, no locks,
+// no TLS with lazy init. tools/natcheck's `sigsafe` lint rule enforces
+// this over every *_sighandler function in native/src.
+//
+// Exports (nat_api.h): nat_prof_start(hz) / nat_prof_stop() /
+// nat_prof_running() / nat_prof_samples() / nat_prof_report(mode,...) /
+// nat_prof_reset().
+#pragma once
+
+#include <stdint.h>
+
+namespace brpc_tpu {
+
+inline constexpr int kProfMaxFrames = 24;   // pcs kept per sample
+inline constexpr uint32_t kProfRing = 256;  // samples buffered per thread
+inline constexpr int kProfCells = 64;       // concurrent sampled threads
+
+}  // namespace brpc_tpu

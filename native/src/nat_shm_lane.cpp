@@ -145,6 +145,11 @@ std::atomic<uint32_t> g_slot_epoch[kMaxWorkers] = {};
 
 // worker-local identity + response-ring producer lock
 int g_my_slot = -1;
+// worker-local: when THIS thread's latest take_request popped its record
+// (the sequential take -> handle -> respond worker loop's handling-start
+// anchor); nat_shm_respond ships it back so the parent can stitch the
+// worker span without any cross-process span ring.
+thread_local uint64_t tls_take_ns = 0;
 NatMutex<kLockRankShmResp>* g_resp_mu =
     new NatMutex<kLockRankShmResp>;  // leaked: exit order
 
@@ -279,6 +284,17 @@ struct InflightEntry {
   // site retires the entry (response emit, reap, crash fast-reap).
   bool admitted = false;
   uint64_t enqueue_ns = 0;
+  // rpcz span state (sampled at offer time): the PARENT records the
+  // server span when the worker's response is emitted, and stitches the
+  // worker-process span under it from the timing blob the response
+  // record carries — find_trace then shows the full client -> native
+  // server -> shm worker chain with no cross-process span ring.
+  bool span_sampled = false;
+  uint64_t trace_id = 0;        // incoming (or freshly started) trace
+  uint64_t parent_span_id = 0;  // the CLIENT's span id off the wire
+  uint64_t span_id = 0;         // this request's server span id
+  uint64_t offer_ns = 0;        // request entered the worker rings
+  char method[40] = {0};
 };
 
 // Release an erased entry's admission token (call with g_inflight_mu
@@ -397,6 +413,18 @@ void emit_response(int slot, const CellView& c) {
     span_release(arena, c.span_off);
     return;  // corrupt record: drop (reaper answers the request)
   }
+  // optional worker-timing blob (16B: take_ns, respond_ns) appended by
+  // nat_shm_respond — CLOCK_MONOTONIC is machine-wide, so the worker
+  // process's timestamps are directly comparable with the parent's
+  uint64_t wk_take_ns = 0, wk_resp_ns = 0;
+  {
+    const char* tb = nullptr;
+    size_t tb_len = 0;
+    if (get_blob(p, end, &tb, &tb_len) && tb_len == 16) {
+      memcpy(&wk_take_ns, tb, 8);
+      memcpy(&wk_resp_ns, tb + 8, 8);
+    }
+  }
   InflightEntry done_entry;
   {
     // already reaped (worker answered late): drop — emitting twice
@@ -417,6 +445,50 @@ void emit_response(int slot, const CellView& c) {
   bool resp_ok = !(c.kind == 4 && c.status != 0) &&
                  !(c.kind == 3 && payload_len >= 10 && payload[9] == '5');
   inflight_entry_complete(done_entry, resp_ok);
+  if (wk_take_ns != 0 && wk_resp_ns >= wk_take_ns) {
+    nat_lat_record(NL_WORKER, wk_resp_ns - wk_take_ns);
+  }
+  if (done_entry.span_sampled) {
+    uint64_t now = nat_now_ns();
+    size_t mn = strnlen(done_entry.method, sizeof(done_entry.method));
+    // server span: request offered to the rings -> response emitted
+    NatSpanRec rec;
+    memset(&rec, 0, sizeof(rec));
+    rec.trace_id = done_entry.trace_id;
+    rec.span_id = done_entry.span_id;
+    rec.parent_span_id = done_entry.parent_span_id;
+    rec.sock_id = c.sock_id;
+    rec.recv_ns = done_entry.offer_ns;
+    rec.parse_ns = done_entry.offer_ns;
+    rec.dispatch_ns = wk_resp_ns != 0 ? wk_resp_ns : now;
+    rec.write_ns = now;
+    rec.protocol = c.kind == 4 ? NL_GRPC : NL_HTTP;
+    rec.error_code = resp_ok ? 0 : (c.kind == 4 ? c.status : 503);
+    rec.resp_bytes = (uint32_t)payload_len;
+    memcpy(rec.method, done_entry.method, mn);
+    rec.method[mn] = '\0';
+    nat_span_submit(rec);
+    // worker span: the usercode leg inside the worker process, chained
+    // under the server span (take -> respond, worker-stamped clocks)
+    if (wk_take_ns != 0) {
+      NatSpanRec wrec;
+      memset(&wrec, 0, sizeof(wrec));
+      wrec.trace_id = done_entry.trace_id;
+      wrec.span_id = nat_span_id63();
+      wrec.parent_span_id = done_entry.span_id;
+      wrec.sock_id = (uint64_t)slot;
+      wrec.recv_ns = wk_take_ns;
+      wrec.parse_ns = wk_take_ns;
+      wrec.dispatch_ns = wk_resp_ns;
+      wrec.write_ns = wk_resp_ns;
+      wrec.protocol = NL_WORKER;
+      wrec.error_code = resp_ok ? 0 : -1;
+      wrec.resp_bytes = (uint32_t)payload_len;
+      memcpy(wrec.method, done_entry.method, mn);
+      wrec.method[mn] = '\0';
+      nat_span_submit(wrec);
+    }
+  }
   if (c.kind == 3 && payload_len >= kUserBlockMin) {
     // zero-copy emit: the response IOBuf references the arena span via a
     // user block; the span releases when the socket writev consumed it
@@ -688,17 +760,31 @@ bool shm_lane_offer(PyRequest* r) {
   size_t blob_len = request_blob_bytes(r);
   // track BEFORE the publish: once the descriptor is visible a worker
   // may answer instantly, and the drainer drops responses with no entry
+  InflightEntry entry;
+  entry.kind = (uint8_t)r->kind;
+  entry.slot = -1;
+  entry.deadline = std::chrono::steady_clock::now() +
+                   std::chrono::milliseconds(
+                       g_reap_timeout_ms.load(std::memory_order_relaxed));
+  // span sampling decided HERE (the wire parse's trace context rides the
+  // PyRequest): the emit side records the server + worker spans when the
+  // response comes back
+  if ((entry.span_sampled = nat_span_tick())) {
+    entry.trace_id = r->trace_id != 0 ? r->trace_id : nat_span_id63();
+    entry.parent_span_id = r->parent_span_id;
+    entry.span_id = nat_span_id63();
+    entry.offer_ns = nat_now_ns();
+    size_t mn = r->method.size() < sizeof(entry.method) - 1
+                    ? r->method.size()
+                    : sizeof(entry.method) - 1;
+    memcpy(entry.method, r->method.data(), mn);
+  }
   {
     std::lock_guard g(g_inflight_mu);
     // admitted stays false until the push lands: the failure path below
     // erases this entry and the request continues on the in-process
     // lane, which still owns the admission token
-    g_inflight[InflightKey{r->sock_id, r->cid}] = InflightEntry{
-        (uint8_t)r->kind, (int8_t)-1,
-        std::chrono::steady_clock::now() +
-            std::chrono::milliseconds(
-                g_reap_timeout_ms.load(std::memory_order_relaxed)),
-        false, 0};
+    g_inflight[InflightKey{r->sock_id, r->cid}] = entry;
   }
   int slot = -1;
   bool ok = push_to_some_worker(
@@ -968,6 +1054,7 @@ void* nat_shm_take_request(int timeout_ms) {
       req->sock_id = c.sock_id;
       req->cid = c.cid;
       req->aux = c.aux;
+      tls_take_ns = nat_now_ns();  // handling-start anchor (worker span)
       req->shm_slot = g_my_slot;
       req->shm_span = c.span_off;
       char* arena = req_arena(g_my_slot);
@@ -1021,7 +1108,9 @@ int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
                     const char* message, int close_after) {
   if (g_seg == nullptr || g_my_slot < 0) return -1;
   size_t msg_len = message != nullptr ? strlen(message) : 0;
-  size_t blob_len = 8 + payload_len + msg_len;
+  // + the 16B worker-timing blob (take_ns, respond_ns) the parent's
+  // emit stitches into the worker span
+  size_t blob_len = 12 + payload_len + msg_len + 16;
   // can NEVER fit (response larger than the whole blob arena): fail now
   // instead of spinning on backpressure that cannot clear — the parent
   // reaper answers the request
@@ -1052,6 +1141,8 @@ int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
     char* p = dst;
     put_blob(p, payload, payload_len);
     put_blob(p, message, msg_len);
+    uint64_t times[2] = {tls_take_ns, nat_now_ns()};
+    put_blob(p, (const char*)times, sizeof(times));
     ring_publish(r, pos, (uint8_t)kind, close_after != 0 ? 1 : 0, sock_id,
                  seq, status, span, (uint32_t)blob_len, 0);
     g_seg->resp_doorbell.fetch_add(1, std::memory_order_seq_cst);
@@ -1074,8 +1165,13 @@ int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
 // caller owns backpressure policy).
 int nat_shm_push_tensor(const char* data, size_t len, uint64_t tag) {
   if (g_seg == nullptr) return -1;
+  // kind-8 descriptors have no connection, so the sock_id/cid fields are
+  // free: they carry this thread's ambient trace context (nat_trace_set)
+  // across the process boundary — the consumer reads them back through
+  // nat_req_sock_id (trace_id) / nat_req_cid (parent span id).
+  const NatTraceCtx& tc = tls_nat_trace;
   bool ok = push_to_some_worker(
-      8, 0, 0, 0, 0, len, tag,
+      8, 0, tc.trace_id, (int64_t)tc.span_id, 0, len, tag,
       [&](char* dst) {
         if (len != 0) memcpy(dst, data, len);
       },

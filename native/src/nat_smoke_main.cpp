@@ -197,6 +197,35 @@ int main() {
     CHECK(nat_shm_lane_enable(0) == 0, "shm disable");
   }
 
+  // ---- profiler round: SIGPROF sampling + fp unwind + seqlock sample
+  // rings under instrumentation (the handler races the collector; the
+  // sanitizer lanes must see both sides hot) ----
+  {
+    CHECK(nat_prof_start(250) == 0, "prof start");
+    CHECK(nat_prof_running() == 1, "prof running");
+    // burn CPU across scheduler fibers so SIGPROF lands on real stacks
+    (void)nat_bench_spawn_join(64, 200);
+    uint64_t burn_reqs = 0;
+    (void)nat_rpc_client_bench("127.0.0.1", port, 1, 8, 0.3, 16,
+                               &burn_reqs);
+    CHECK(nat_prof_stop() == 0, "prof stop");
+    CHECK(nat_prof_running() == 0, "prof stopped");
+    CHECK(nat_prof_samples() > 0, "prof captured samples");
+    char* rep = nullptr;
+    size_t rep_len = 0;
+    CHECK(nat_prof_report(0, &rep, &rep_len) == 0 && rep != nullptr,
+          "prof flat report");
+    CHECK(rep_len > 0 && strstr(rep, "nat_prof:") != nullptr,
+          "prof report header");
+    if (rep != nullptr) nat_buf_free(rep);
+    rep = nullptr;
+    CHECK(nat_prof_report(1, &rep, &rep_len) == 0 && rep != nullptr,
+          "prof collapsed report");
+    if (rep != nullptr) nat_buf_free(rep);
+    nat_prof_reset();
+    CHECK(nat_prof_samples() == 0, "prof reset");
+  }
+
   // ---- redis lane: native store under pipelined load ----
   uint64_t redis_reqs = 0;
   double redis_qps = nat_redis_client_bench("127.0.0.1", port, 1, 8, 0.2,

@@ -93,8 +93,10 @@ static const char* kCounterNames[NS_COUNTER_COUNT] = {
 };
 
 static const char* kLaneNames[NL_LANE_COUNT] = {
-    "echo", "http", "redis", "grpc", "client",
+    "echo", "http", "redis", "grpc", "client", "worker",
 };
+
+thread_local NatTraceCtx tls_nat_trace;
 
 // ---------------------------------------------------------------------------
 // span ring — seqlock slots under a monotonically-increasing ticket: the
@@ -145,14 +147,18 @@ void nat_span_submit(const NatSpanRec& rec) {
   slot.seq.store(2 * ticket + 2, std::memory_order_release);  // published
 }
 
+uint64_t nat_span_id63() { return span_rand() & 0x7fffffffffffffffull; }
+
 void nat_span_record(int lane, uint64_t sock_id, const char* method,
                      size_t method_len, uint64_t recv_ns, uint64_t parse_ns,
                      uint64_t dispatch_ns, uint64_t write_ns,
                      int32_t error_code, uint32_t req_bytes,
-                     uint32_t resp_bytes) {
+                     uint32_t resp_bytes, uint64_t trace_id,
+                     uint64_t parent_span_id) {
   NatSpanRec rec;
-  rec.trace_id = span_rand();
-  rec.span_id = span_rand();
+  rec.trace_id = trace_id != 0 ? trace_id : nat_span_id63();
+  rec.span_id = nat_span_id63();
+  rec.parent_span_id = parent_span_id;
   rec.sock_id = sock_id;
   rec.recv_ns = recv_ns;
   rec.parse_ns = parse_ns;
@@ -250,6 +256,16 @@ double nat_stats_hist_quantile(int lane, double q) {
     acc += (double)buckets[b];
   }
   return (double)(1ull << (nb - 1));
+}
+
+// Arm (or clear, with 0,0) this thread's ambient trace context: client
+// calls issued on this thread propagate (trace_id, span_id) on the wire
+// (tpu_std RpcMeta trace fields, HTTP x-bd-trace-* headers, gRPC
+// metadata, kind-8 shm descriptors), so the receiving side's spans chain
+// under span_id in /rpcz find_trace.
+void nat_trace_set(uint64_t trace_id, uint64_t span_id) {
+  tls_nat_trace.trace_id = trace_id;
+  tls_nat_trace.span_id = span_id;
 }
 
 // 0 = spans off; N = sample one of every N native-handled calls.

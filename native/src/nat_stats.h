@@ -62,6 +62,7 @@ enum NatLatLane : int {
   NL_REDIS,     // native redis store command execution
   NL_GRPC,      // native-handler gRPC-over-h2 calls
   NL_CLIENT,    // client call round trip (begin_call -> completion)
+  NL_WORKER,    // shm worker-process usercode (take -> respond)
   NL_LANE_COUNT,
 };
 
@@ -130,6 +131,7 @@ inline constexpr uint32_t kNatSpanRing = 1u << kNatSpanRingBits;  // 4096
 struct NatSpanRec {
   uint64_t trace_id;
   uint64_t span_id;
+  uint64_t parent_span_id;  // 0 = root (no known parent)
   uint64_t sock_id;
   // monotonic ns timeline: recv <= parse <= dispatch <= write
   uint64_t recv_ns;      // request fully buffered / stream complete
@@ -153,12 +155,34 @@ extern std::atomic<uint32_t> g_nat_span_every;
 bool nat_span_tick();
 void nat_span_submit(const NatSpanRec& rec);
 
-// Fill + submit helper for the server-side lanes.
+// 63-bit xorshift id (random.getrandbits(63) analog): span/trace ids are
+// masked to 63 bits so they survive the proto int64 varint round trip
+// without flipping sign on the Python side.
+uint64_t nat_span_id63();
+
+// Fill + submit helper for the server-side lanes. trace_id == 0 starts a
+// fresh trace; parent_span_id is the CALLER's span id from the wire (the
+// RpcMeta trace fields / x-bd-trace-* headers / gRPC metadata).
 void nat_span_record(int lane, uint64_t sock_id, const char* method,
                      size_t method_len, uint64_t recv_ns, uint64_t parse_ns,
                      uint64_t dispatch_ns, uint64_t write_ns,
                      int32_t error_code, uint32_t req_bytes,
-                     uint32_t resp_bytes);
+                     uint32_t resp_bytes, uint64_t trace_id = 0,
+                     uint64_t parent_span_id = 0);
+
+// ---------------------------------------------------------------------------
+// trace context — thread-local (trace_id, span_id) armed by the embedder
+// (nat_trace_set) before issuing client calls on this thread; the client
+// lanes stamp it into the wire metadata so /rpcz find_trace can stitch
+// client -> server -> worker chains across processes (span.h:76,116's
+// tls_bls parenting, carried over the FFI boundary).
+// ---------------------------------------------------------------------------
+
+struct NatTraceCtx {
+  uint64_t trace_id = 0;  // 0 = no ambient trace
+  uint64_t span_id = 0;   // parent span for calls issued on this thread
+};
+extern thread_local NatTraceCtx tls_nat_trace;
 
 // Gauges: computed at snapshot time (PassiveStatus discipline) — cells
 // contribute nothing; the registered callback is the value.

@@ -16,6 +16,11 @@ namespace brpc_tpu {
 struct RpcRequestMetaN {
   std::string service_name;
   std::string method_name;
+  // trace propagation (rpc_meta.proto RpcRequestMeta fields 4/5/6): the
+  // caller's trace context, consumed by the server-side span records
+  int64_t trace_id = 0;
+  int64_t span_id = 0;
+  int64_t parent_span_id = 0;
 };
 
 struct RpcResponseMetaN {
@@ -136,17 +141,20 @@ inline size_t varint_len(uint64_t v) {
 }
 
 inline size_t request_meta_bound(size_t slen, size_t mlen) {
-  return slen + mlen + 48;
+  return slen + mlen + 72;  // fixed fields + the two 10-byte trace varints
 }
 
 inline size_t encode_request_meta_to(char* buf, const char* service,
                                      size_t slen, const char* method,
                                      size_t mlen, int64_t cid,
-                                     int64_t att_size) {
+                                     int64_t att_size, uint64_t trace_id = 0,
+                                     uint64_t span_id = 0) {
   char* p = buf;
   size_t sub = 0;
   if (slen) sub += 1 + varint_len(slen) + slen;
   if (mlen) sub += 1 + varint_len(mlen) + mlen;
+  if (trace_id) sub += 1 + varint_len(trace_id);
+  if (span_id) sub += 1 + varint_len(span_id);
   *p++ = (char)(1 << 3 | 2);  // request submessage
   p = raw_varint(p, sub);
   if (slen) {
@@ -160,6 +168,14 @@ inline size_t encode_request_meta_to(char* buf, const char* service,
     p = raw_varint(p, mlen);
     memcpy(p, method, mlen);
     p += mlen;
+  }
+  if (trace_id) {  // RpcRequestMeta.trace_id = 4
+    *p++ = (char)(4 << 3 | 0);
+    p = raw_varint(p, trace_id);
+  }
+  if (span_id) {  // RpcRequestMeta.span_id = 5 (the CALLER's span)
+    *p++ = (char)(5 << 3 | 0);
+    p = raw_varint(p, span_id);
   }
   if (cid != 0) {
     *p++ = (char)(4 << 3 | 0);
@@ -247,6 +263,12 @@ inline bool decode_submessage(const char* p, const char* end, RpcMetaN* m,
       if (!get_varint(p, end, &len) || (uint64_t)(end - p) < len) return false;
       m->request.method_name.assign(p, len);
       p += len;
+    } else if (is_request && field >= 4 && field <= 6 && wire == 0) {
+      uint64_t v;
+      if (!get_varint(p, end, &v)) return false;
+      if (field == 4) m->request.trace_id = (int64_t)v;
+      else if (field == 5) m->request.span_id = (int64_t)v;
+      else m->request.parent_span_id = (int64_t)v;
     } else if (!is_request && field == 1 && wire == 0) {
       uint64_t v;
       if (!get_varint(p, end, &v)) return false;

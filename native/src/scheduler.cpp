@@ -452,6 +452,9 @@ void Scheduler::run_fiber(Worker* w, Fiber* f) {
       w->remained_op = Worker::RemainedOp::NONE;
       rf->join_butex.value.store(1, std::memory_order_release);
       Scheduler::butex_wake(&rf->join_butex, INT32_MAX);
+      // only NOW may join() delete rf: the wake above is fully done
+      // touching the butex that lives inside the fiber
+      rf->join_wake_done.store(1, std::memory_order_release);
       break;
     }
     case Worker::RemainedOp::FINISH_DETACHED: {
@@ -614,8 +617,15 @@ void Scheduler::join(Fiber* f) {
     butex_wait(&f->join_butex, 0);
   }
   // Synchronize with the completion wake: once we hold/release the butex
-  // mutex, the finishing worker is done touching the waiter list.
+  // mutex, the finishing worker is done touching the waiter list...
   { std::lock_guard g(f->join_butex.mu); }
+  // ...but butex_wake's lock-free fast path (fence + nwaiters probe)
+  // touches the butex WITHOUT the mutex — spin out the tail of the wake
+  // before freeing the memory it reads (nanoseconds; the waker needs no
+  // cooperation from this thread to finish).
+  while (f->join_wake_done.load(std::memory_order_acquire) == 0) {
+    std::this_thread::yield();
+  }
   sanitize_fiber_destroy(f);
   free_stack(f->stack, f->stack_size);
   delete f;

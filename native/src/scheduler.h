@@ -96,6 +96,11 @@ struct Fiber {
   void* arg = nullptr;
   std::atomic<FiberState> state{FiberState::READY};
   Butex join_butex;  // value 0 = running, 1 = done
+  // set AFTER the completion butex_wake returns: join() must not free
+  // this fiber (the butex lives inside it) while the waker may still be
+  // in butex_wake's lock-free nwaiters probe — the use-after-free
+  // window the PR-2 bench leak worked around, closed at the source
+  std::atomic<uint32_t> join_wake_done{0};
   bool detached = false;  // self-reaping; never joined
 };
 

@@ -1,0 +1,195 @@
+"""Golden tests for the perf regression gate (tools/natcheck/benchgate).
+
+The gate's verdict logic is a pure function over two schema'd artifacts,
+so every contract is pinned with seeded artifact pairs: clean run,
+one-lane regression (hard fail, with the regressing run's profile
+attached), silently-missing lane, schema drift, a failed bench process,
+and the wider tolerance bands on the documented-noisy lanes. The
+shipped tree must be green: the committed BENCH_r06 baseline compared
+against itself produces no findings.
+"""
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.natcheck import REPO_ROOT, benchgate  # noqa: E402
+
+
+def _bench_result():
+    """A plausible bench.py output covering every headline lane."""
+    return {
+        "metric": "echo_qps_framework_native",
+        "value": 2300000.0,
+        "unit": "qps",
+        "vs_baseline": 4.6,
+        "extra": {
+            "epoll_qps": 880000.0,
+            "io_uring_qps": 900000.0,
+            "io_uring_async_qps": 2300000.0,
+            "async_windowed_qps": 2070000.0,
+            "http_qps": 604000.0,
+            "http_py_qps": 8400.0,
+            "grpc_qps": 491000.0,
+            "grpc_py_qps": 15800.0,
+            "grpc_client_qps": 257000.0,
+            "http_client_qps": 364000.0,
+            "redis_qps": 1430000.0,
+            "redis_py_qps": 39700.0,
+            "http_py_workers_qps": 2051.0,
+            "stream_GBps": 0.86,
+            "native_bulk_GBps": 1.66,
+            "shm_desc_GBps": 1.45,
+            "shm_desc_small_GBps": 0.19,
+            "native_latency_us": {"echo": {"p50": 10.0, "p99": 50.0,
+                                           "p999": 200.0}},
+            "nat_prof": {"samples": 1234,
+                         "flat": ["     100  10.0%  drain_socket_inline",
+                                  "      80   8.0%  process_input"]},
+        },
+    }
+
+
+@pytest.fixture()
+def pair():
+    base = benchgate.make_artifact(_bench_result(), round_n=6,
+                                   git_sha="abc123")
+    cur = copy.deepcopy(base)
+    return base, cur
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+def test_clean_pair_passes(pair):
+    base, cur = pair
+    assert benchgate.compare(base, cur) == []
+
+
+def test_improvement_passes(pair):
+    base, cur = pair
+    cur["lanes"]["http_qps"] *= 1.5
+    assert benchgate.compare(base, cur) == []
+
+
+def test_one_lane_regression_fails_with_profile_attached(pair):
+    base, cur = pair
+    cur["lanes"]["http_qps"] *= 0.80  # -20% > the 15% band
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["regression"]
+    msg = findings[0].message
+    assert "http_qps" in msg and "20.0%" in msg
+    # the regressing run's nat_prof flat profile rides the report
+    assert "drain_socket_inline" in msg
+
+
+def test_within_band_regression_passes(pair):
+    base, cur = pair
+    cur["lanes"]["http_qps"] *= 0.90  # -10% < the 15% band
+    assert benchgate.compare(base, cur) == []
+
+
+def test_noisy_lane_wider_band(pair):
+    base, cur = pair
+    # worker lane documented at 50%: -40% passes, -60% fails
+    cur["lanes"]["http_py_workers_qps"] = \
+        base["lanes"]["http_py_workers_qps"] * 0.60
+    assert benchgate.compare(base, cur) == []
+    cur["lanes"]["http_py_workers_qps"] = \
+        base["lanes"]["http_py_workers_qps"] * 0.40
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["regression"]
+
+
+def test_missing_lane_fails(pair):
+    base, cur = pair
+    del cur["lanes"]["grpc_qps"]
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["missing-lane"]
+    assert "grpc_qps" in findings[0].message
+
+
+def test_zero_baseline_lane_is_skipped(pair):
+    """An unmeasurable baseline lane (io_uring refused by the kernel)
+    holds nothing against later runs."""
+    base, cur = pair
+    base["lanes"]["io_uring_qps"] = 0.0
+    del cur["lanes"]["io_uring_qps"]
+    assert benchgate.compare(base, cur) == []
+
+
+def test_schema_drift_fails(pair):
+    base, cur = pair
+    cur["schema"] = "brpc_tpu-bench-artifact/999"
+    findings = benchgate.compare(base, cur)
+    assert "schema-drift" in _rules(findings)
+
+
+def test_failed_bench_process_fails(pair):
+    base, cur = pair
+    cur["rc"] = 139  # the BENCH_r05 class
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["bench-failed"]
+    assert "139" in findings[0].message
+
+
+def test_artifact_schema_fields():
+    art = benchgate.make_artifact(_bench_result(), round_n=6,
+                                  git_sha="abc123")
+    assert art["schema"] == benchgate.SCHEMA
+    assert art["git_sha"] == "abc123"
+    assert art["lanes"]["value"] == 2300000.0
+    assert art["rpcz_percentiles"]["echo"]["p99"] == 50.0
+    assert art["nat_prof"]["samples"] == 1234
+
+
+def test_make_baseline_takes_lane_floor(pair):
+    """The committed baseline is the per-lane MINIMUM over N clean runs
+    (the host's credible floor against shared-container noise)."""
+    a, b = pair
+    b = copy.deepcopy(b)
+    b["lanes"]["http_qps"] = a["lanes"]["http_qps"] * 0.7
+    b["lanes"]["grpc_qps"] = a["lanes"]["grpc_qps"] * 1.4
+    base = benchgate.make_baseline([a, b], round_n=6)
+    assert base["n"] == 6
+    assert base["baseline_runs"] == 2
+    assert base["lanes"]["http_qps"] == b["lanes"]["http_qps"]
+    assert base["lanes"]["grpc_qps"] == a["lanes"]["grpc_qps"]
+    # failed runs are excluded from the floor
+    dead = copy.deepcopy(a)
+    dead["rc"] = 139
+    dead["lanes"]["http_qps"] = 1.0
+    base2 = benchgate.make_baseline([a, b, dead], round_n=6)
+    assert base2["lanes"]["http_qps"] == b["lanes"]["http_qps"]
+    with pytest.raises(ValueError):
+        benchgate.make_baseline([dead], round_n=6)
+
+
+def test_committed_baseline_is_green():
+    """The shipped tree: the newest committed BENCH_r*.json speaks the
+    artifact schema and passes the gate against itself (the baseline the
+    next round diffs against)."""
+    path = benchgate.find_baseline()
+    assert path is not None, \
+        "no schema'd BENCH_r*.json committed (expected BENCH_r06.json)"
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["rc"] == 0
+    assert doc["lanes"], "baseline carries no headline lanes"
+    assert benchgate.compare(doc, doc) == []
+
+
+def test_old_artifacts_are_not_baselines():
+    """Pre-gate rounds (BENCH_r05 and earlier) have no schema field and
+    must never be picked as the diff baseline."""
+    path = benchgate.find_baseline()
+    if path is None:
+        pytest.skip("no schema'd baseline committed yet")
+    n = int(os.path.basename(path)[len("BENCH_r"):-len(".json")])
+    assert n >= 6

@@ -1,0 +1,89 @@
+"""nat_prof — the in-process native sampling profiler (nat_prof.cpp).
+
+SIGPROF/CPU-time sampling with frame-pointer unwind into lock-free
+per-thread rings; flat + collapsed reports; surfaced at
+/hotspots/native. The sampler must capture real native stacks while the
+scheduler burns CPU, and must be inert (zero samples, no handler) when
+stopped.
+"""
+import threading
+import time
+
+import pytest
+
+native = pytest.importorskip("brpc_tpu.native")
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+def _burn_native(ms=400):
+    """Burn CPU inside the native scheduler so SIGPROF lands on real
+    C++ stacks (spawn/join churn + a python loop for interpreter
+    frames)."""
+    native.sched_start(2)
+    deadline = time.time() + ms / 1000.0
+    while time.time() < deadline:
+        native.bench_spawn_join(32, 50)
+
+
+def test_start_sample_report_stop_cycle():
+    native.prof_reset()
+    assert native.prof_start(250) == 0
+    assert native.prof_running()
+    # double-start is refused while running
+    assert native.prof_start(250) == -1
+    _burn_native()
+    assert native.prof_stop() == 0
+    assert not native.prof_running()
+    n = native.prof_samples()
+    assert n > 0, "no samples captured while burning CPU"
+
+    flat = native.prof_report(collapsed=False)
+    assert flat.startswith("# nat_prof:")
+    assert "flat self samples" in flat
+    # at least one non-comment row: "count pct% symbol"
+    rows = [ln for ln in flat.splitlines() if not ln.startswith("#")]
+    assert rows
+    assert "%" in rows[0]
+
+    collapsed = native.prof_report(collapsed=True)
+    assert "collapsed stacks" in collapsed.splitlines()[0]
+    body = [ln for ln in collapsed.splitlines() if not ln.startswith("#")]
+    assert body
+    # each folded line ends with the sample count
+    assert body[0].rsplit(" ", 1)[1].isdigit()
+
+    native.prof_reset()
+    assert native.prof_samples() == 0
+    # a report after reset is just the header
+    post = [ln for ln in native.prof_report().splitlines()
+            if not ln.startswith("#")]
+    assert post == []
+
+
+def test_stop_without_start_is_noop():
+    assert native.prof_stop() == 0
+    assert not native.prof_running()
+
+
+def test_hotspots_native_console_page():
+    """/hotspots/native renders a nat_prof report (collapsed by default,
+    ?flat=1 for the symbol table)."""
+    from brpc_tpu.builtin.hotspots import sample_native
+
+    stop = threading.Event()
+
+    def burner():
+        while not stop.is_set():
+            native.bench_spawn_join(32, 50)
+
+    native.sched_start(2)
+    th = threading.Thread(target=burner, daemon=True)
+    th.start()
+    try:
+        out = sample_native(seconds=0.4, hz=250, collapsed=False)
+    finally:
+        stop.set()
+        th.join(5)
+    assert "nat_prof" in out
+    assert "flat self samples" in out

@@ -313,6 +313,98 @@ long probe() {
     assert findings == [], findings
 
 
+def test_lint_sigsafe_flags_malloc_in_handler(tmp_path):
+    # allocation inside a *_sighandler body is the canonical
+    # signal-handler deadlock (interrupted allocator lock)
+    findings = _lint_one(tmp_path, "sig.cpp", """
+#include <cstdlib>
+void prof_sighandler(int sig) {
+  void* p = malloc(64);
+  (void)p;
+}
+""")
+    assert any(f.rule == "sigsafe" for f in findings), findings
+
+
+def test_lint_sigsafe_follows_infile_callees(tmp_path):
+    # the forbidden op hides one call down: the closure scan must reach it
+    findings = _lint_one(tmp_path, "sig2.cpp", """
+#include <cstdio>
+static void helper(int n) {
+  printf("%d", n);
+}
+void timer_sighandler(int sig) {
+  helper(sig);
+}
+""")
+    assert any(f.rule == "sigsafe" and "helper" in f.message
+               for f in findings), findings
+
+
+def test_lint_sigsafe_clean_handler_passes(tmp_path):
+    # syscalls + lock-free atomics + mem* are the legal vocabulary
+    findings = _lint_one(tmp_path, "sig3.cpp", """
+#include <atomic>
+#include <cstring>
+static std::atomic<unsigned long> g_n{0};
+void prof_sighandler(int sig) {
+  char buf[16];
+  memset(buf, 0, sizeof(buf));
+  g_n.fetch_add(1, std::memory_order_relaxed);
+}
+""")
+    assert findings == [], findings
+
+
+def test_lint_sigsafe_keywords_are_not_callees(tmp_path):
+    # `if (...)` / `while (...)` inside the handler must not resolve to
+    # the file's lexically-first if-block as a "callee": the malloc in
+    # the UNRELATED function below must not be attributed to the handler
+    findings = _lint_one(tmp_path, "sig6.cpp", """
+#include <cstdlib>
+#include <atomic>
+static std::atomic<int> g_x{0};
+void* unrelated(unsigned long n) {
+  if (n > 0) {
+    return malloc(n);
+  }
+  return nullptr;
+}
+void prof_sighandler(int sig) {
+  if (sig > 0) {
+    g_x.fetch_add(1, std::memory_order_relaxed);
+  }
+  while (g_x.load(std::memory_order_relaxed) < 0) {
+    g_x.store(0, std::memory_order_relaxed);
+  }
+}
+""")
+    assert not any(f.rule == "sigsafe" for f in findings), findings
+
+
+def test_lint_sigsafe_ignores_non_handlers(tmp_path):
+    # malloc in ordinary functions is none of this rule's business
+    findings = _lint_one(tmp_path, "sig4.cpp", """
+#include <cstdlib>
+void* grow(unsigned long n) {
+  return malloc(n);
+}
+""")
+    assert not any(f.rule == "sigsafe" for f in findings), findings
+
+
+def test_lint_sigsafe_allow_escape(tmp_path):
+    findings = _lint_one(tmp_path, "sig5.cpp", """
+#include <cstdlib>
+void dump_sighandler(int sig) {
+  // natcheck:allow(sigsafe): crash-path dump, process is dying anyway
+  void* p = malloc(64);
+  (void)p;
+}
+""")
+    assert findings == [], findings
+
+
 def test_lint_seqlock_reader_with_recheck_passes(tmp_path):
     findings = _lint_one(tmp_path, "g.cpp", """
 #include <atomic>

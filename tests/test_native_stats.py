@@ -124,6 +124,47 @@ def test_brpc_metrics_prometheus_exposition(server):
     assert "# TYPE nat_tpu_std_msgs_in gauge" in body
 
 
+def test_every_counter_enum_in_prometheus_exposition(server):
+    """Drift guard (ISSUE 6): EVERY NatStats counter enum must appear in
+    the /brpc_metrics Prometheus exposition — a counter added to the C++
+    enum without surfacing here is a silent observability hole (the PR-5
+    sextet was the motivating case)."""
+    srv, port = server
+    status, body = _get(port, "/brpc_metrics")
+    assert status == 200
+    exposed = {line.partition(" ")[0] for line in body.splitlines()
+               if line and not line.startswith("#")}
+    missing = [n for n in native.stats_counter_names() if n not in exposed]
+    assert not missing, f"counters absent from /brpc_metrics: {missing}"
+    # the PR-5 robustness counters specifically (the ISSUE 6 satellite)
+    for name in ("nat_faults_injected", "nat_elimit_rejects",
+                 "nat_queue_deadline_drops", "nat_retry_budget_exhausted",
+                 "nat_breaker_isolations", "nat_breaker_revivals"):
+        assert name in exposed, name
+
+
+def test_status_summarizes_overload_counters(server):
+    """/status carries a one-line overload/faults summary the moment any
+    of the PR-5 counters moves (snapshot injected: the formatting
+    contract, not the traffic)."""
+    from brpc_tpu.bvar.native_vars import native_status_lines
+
+    snap = {"nat_socket_read_bytes": 1, "nat_faults_injected": 7,
+            "nat_elimit_rejects": 3, "nat_breaker_isolations": 1}
+    joined = "\n".join(native_status_lines(snap=snap))
+    assert "overload/faults:" in joined
+    assert "faults_injected=7" in joined
+    assert "elimit_rejects=3" in joined
+    assert "breaker_isolations=1" in joined
+    # all six keys render (zeros included once the line triggers)
+    assert "queue_deadline_drops=0" in joined
+    assert "breaker_revivals=0" in joined
+    # quiet counters -> no line
+    quiet = "\n".join(native_status_lines(
+        snap={"nat_socket_read_bytes": 1}))
+    assert "overload/faults:" not in quiet
+
+
 def test_rpcz_shows_native_spans_with_ordered_timeline(server):
     from brpc_tpu import rpcz
 
@@ -151,7 +192,7 @@ def test_rpcz_shows_native_spans_with_ordered_timeline(server):
 
 def test_histogram_percentiles_monotone(server):
     lanes = native.stats_lane_names()
-    assert lanes == ["echo", "http", "redis", "grpc", "client"]
+    assert lanes == ["echo", "http", "redis", "grpc", "client", "worker"]
     nonempty = 0
     for idx, lane in enumerate(lanes):
         hist = native.stats_hist(idx)

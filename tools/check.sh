@@ -14,6 +14,11 @@
 # --chaos (or NATCHECK_CHAOS=1) runs the fixed-seed fault-injection soak
 # (C smoke + pytest native matrix under the documented NAT_FAULT spec)
 # and writes native/CHAOS.md (see tools/natcheck/chaos.py).
+# --bench (or NATCHECK_BENCH=1) runs the perf regression gate: bench.py
+# with the nat_prof flight recorder attached, a schema'd artifact
+# (BENCH_latest.json), and a headline-lane diff against the last
+# committed BENCH_r*.json — >15% regression on a stable lane hard-fails
+# with that lane's profile attached (see tools/natcheck/benchgate.py).
 # Exits nonzero on any finding.
 set -u
 
@@ -24,10 +29,12 @@ RC=0
 
 SOAK="${NATCHECK_SOAK:-0}"
 CHAOS="${NATCHECK_CHAOS:-0}"
+BENCH="${NATCHECK_BENCH:-0}"
 for arg in "$@"; do
     case "$arg" in
         --soak) SOAK=1 ;;
         --chaos) CHAOS=1 ;;
+        --bench) BENCH=1 ;;
     esac
 done
 
@@ -59,6 +66,19 @@ sys.path.insert(0, ".")
 from tools.natcheck import print_findings, soak
 findings = soak.run()
 print("natcheck: soak: %s (log: native/SOAK.md)"
+      % ("clean" if not findings else "%d finding(s)" % len(findings)))
+print_findings(findings)
+sys.exit(1 if findings else 0)
+EOF
+fi
+
+if [ "$BENCH" = "1" ]; then
+    "$PY" - <<'EOF' || RC=1
+import sys
+sys.path.insert(0, ".")
+from tools.natcheck import print_findings, benchgate
+findings = benchgate.run()
+print("natcheck: bench: %s (artifact: BENCH_latest.json)"
       % ("clean" if not findings else "%d finding(s)" % len(findings)))
 print_findings(findings)
 sys.exit(1 if findings else 0)

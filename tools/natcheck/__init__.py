@@ -22,6 +22,11 @@ Five passes over the C++ core and its FFI boundary (see README.md here):
   smoke driver under each; ``soak`` (tools/check.sh --soak) extends this
   to the full native matrix and logs native/SOAK.md.
 
+Standing check.sh-only lanes: ``--chaos`` (fixed-seed fault-injection
+soak, chaos.py) and ``--bench`` (the perf regression gate, benchgate.py:
+bench.py + nat_prof profile -> schema'd artifact -> headline-lane diff
+against the last committed BENCH_r*.json with tolerance bands).
+
 Entry points: ``python -m tools.natcheck`` or ``make -C native check``
 (which delegates to tools/check.sh).
 """

@@ -1,0 +1,280 @@
+"""benchgate — the standing perf regression gate (tools/check.sh --bench).
+
+The flight recorder's third leg (ROADMAP item 5): every gated run
+executes ``bench.py`` with the native profiler attached
+(BRPC_TPU_BENCH_PROF=1), writes a schema'd artifact — headline lane
+values, git sha, the nat_prof flat profile of the loopback lanes, and
+the rpcz/native-histogram latency percentiles — then diffs the headline
+lanes against the LAST COMMITTED ``BENCH_r*.json`` baseline with
+per-lane tolerance bands. A regression beyond a lane's band hard-fails
+the gate, and the failure report carries the current run's profile so
+the regression arrives with its own flame data attached (the un-blinding
+the multicore/fan-out refactors of ROADMAP items 1-2 need).
+
+Tolerance bands: the default band is 15% (the hard-fail contract).
+Lanes with measured round-over-round noise on the 1-CPU dev host carry
+wider bands (Python-usercode lanes bounce with GIL scheduling; the
+worker lane doubled between r04 and r05 from boot-timing alone) — a
+wider band is a documented noise floor, not a licence to regress.
+
+Baseline discipline: a COMMITTED ``BENCH_r*.json`` baseline records,
+per lane, the MINIMUM over several clean rounds on the recording host —
+the credible floor, not one sample. Shared-container scheduling moves
+single-run lane values by tens of percent in both directions (r06
+measured ±50% between identical back-to-back runs); banding against the
+floor keeps the gate quiet on that noise while a real regression (a
+code change that halves a lane) still lands far below floor - band.
+``make_baseline(artifacts)`` composes the floor from N gated runs.
+
+``compare(baseline, current)`` is a pure function over two artifact
+dicts so the golden tests (tests/test_bench_gate.py) can exercise the
+clean / one-lane-regressed / missing-lane / schema-drift verdicts
+without running a single benchmark.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from tools.natcheck import Finding, REPO_ROOT
+
+SCHEMA = "brpc_tpu-bench-artifact/1"
+
+# artifact written by every gated run (gitignored; the committed
+# baseline is the newest BENCH_r*.json carrying the schema field)
+DEFAULT_OUT = os.path.join(REPO_ROOT, "BENCH_latest.json")
+
+# headline lane -> relative tolerance band (fraction of the baseline
+# value the current run may fall short by before the gate fails)
+DEFAULT_TOL = 0.15
+HEADLINE_LANES: Dict[str, float] = {
+    # native data-path lanes: stable round over round — the 15% contract
+    "value": DEFAULT_TOL,               # the headline echo qps
+    "epoll_qps": DEFAULT_TOL,
+    "async_windowed_qps": DEFAULT_TOL,
+    "http_qps": DEFAULT_TOL,
+    "grpc_qps": DEFAULT_TOL,
+    "redis_qps": DEFAULT_TOL,
+    "grpc_client_qps": DEFAULT_TOL,
+    "http_client_qps": DEFAULT_TOL,
+    # io_uring availability depends on the kernel; when present it is
+    # stable, and a 0 baseline (ring refused) skips the row entirely
+    "io_uring_qps": DEFAULT_TOL,
+    "io_uring_async_qps": DEFAULT_TOL,
+    # Python-usercode lanes: GIL scheduling noise on the 1-CPU host
+    "http_py_qps": 0.30,
+    "grpc_py_qps": 0.30,
+    "redis_py_qps": 0.30,
+    # worker processes add boot/attach timing on top (r04->r05: 2x swing)
+    "http_py_workers_qps": 0.50,
+    # bulk/transport lanes: dominated by host memcpy bandwidth, which
+    # the axon-tunnel cooldown perturbs (BENCH_r04's 0.04 GB/s artifact)
+    "stream_GBps": 0.30,
+    "native_bulk_GBps": 0.30,
+    "shm_desc_GBps": 0.30,
+    "shm_desc_small_GBps": 0.50,
+}
+
+
+def extract_lanes(bench: dict) -> Dict[str, float]:
+    """Headline lane values out of one bench.py result dict (transport
+    lanes live nested under extra.device_lanes)."""
+    lanes: Dict[str, float] = {}
+    extra = bench.get("extra", {}) or {}
+    device = extra.get("device_lanes", {}) or {}
+    for key in HEADLINE_LANES:
+        if key == "value":
+            v = bench.get("value")
+        else:
+            v = extra.get(key, device.get(key))
+        if isinstance(v, (int, float)):
+            lanes[key] = float(v)
+    return lanes
+
+
+def make_artifact(bench: dict, round_n: int, rc: int = 0,
+                  git_sha: str = "") -> dict:
+    """Wrap one bench.py result into the schema'd artifact of record."""
+    extra = bench.get("extra", {}) or {}
+    return {
+        "schema": SCHEMA,
+        "n": round_n,
+        "rc": rc,
+        "git_sha": git_sha or _git_sha(),
+        "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime()),
+        "lanes": extract_lanes(bench),
+        "rpcz_percentiles": extra.get("native_latency_us", {}),
+        "nat_prof": extra.get("nat_prof", {}),
+        "bench": bench,
+    }
+
+
+def make_baseline(artifacts: List[dict], round_n: int) -> dict:
+    """Compose a committable baseline from N clean gated runs: the
+    newest run's record (bench/profile/percentiles) with each headline
+    lane replaced by its MINIMUM over the runs (the host's credible
+    floor — see the module docstring)."""
+    clean = [a for a in artifacts if a.get("rc", 0) == 0]
+    if not clean:
+        raise ValueError("no clean (rc=0) artifacts to compose")
+    base = dict(clean[-1])
+    floor: Dict[str, float] = {}
+    for art in clean:
+        for lane, v in (art.get("lanes") or {}).items():
+            if lane not in floor or float(v) < floor[lane]:
+                floor[lane] = float(v)
+    base["lanes"] = floor
+    base["n"] = round_n
+    base["baseline_runs"] = len(clean)
+    return base
+
+
+def _git_sha() -> str:
+    try:
+        out = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO_ROOT,
+                             capture_output=True, text=True, timeout=30)
+        return out.stdout.strip() if out.returncode == 0 else ""
+    except OSError:
+        return ""
+
+
+def find_baseline(repo_root: str = REPO_ROOT) -> Optional[str]:
+    """Newest committed BENCH_r*.json that speaks the artifact schema."""
+    best_n, best = -1, None
+    for name in os.listdir(repo_root):
+        m = re.match(r"BENCH_r(\d+)\.json$", name)
+        if not m:
+            continue
+        path = os.path.join(repo_root, name)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("schema") != SCHEMA:
+            continue  # pre-gate rounds (r01..r05) have no lane schema
+        if int(m.group(1)) > best_n:
+            best_n, best = int(m.group(1)), path
+    return best
+
+
+def _profile_excerpt(current: dict, lines: int = 12) -> str:
+    flat = (current.get("nat_prof") or {}).get("flat") or []
+    if not flat:
+        return " (no profile attached: run with BRPC_TPU_BENCH_PROF=1)"
+    return "; profile of the regressing run:\n      " + "\n      ".join(
+        flat[:lines])
+
+
+def compare(baseline: dict, current: dict) -> List[Finding]:
+    """Diff two artifacts' headline lanes. Pure function (golden-tested:
+    clean / one-lane-regressed / missing-lane / schema-drift)."""
+    findings: List[Finding] = []
+    where = "tools/check.sh --bench"
+    for doc, label in ((baseline, "baseline"), (current, "current")):
+        if doc.get("schema") != SCHEMA:
+            findings.append(Finding(
+                "bench", "schema-drift", where,
+                f"{label} artifact schema is "
+                f"{doc.get('schema')!r}, expected {SCHEMA!r} — regenerate "
+                f"it with the gate (artifacts of a different schema are "
+                f"not comparable)"))
+    if findings:
+        return findings
+    if current.get("rc", 0) != 0:
+        findings.append(Finding(
+            "bench", "bench-failed", where,
+            f"bench.py exited rc={current.get('rc')} — the artifact of "
+            f"record is untrustworthy (the BENCH_r05 rc-139 class)"))
+        return findings
+    base_lanes = baseline.get("lanes", {})
+    cur_lanes = current.get("lanes", {})
+    for lane, tol in HEADLINE_LANES.items():
+        if lane not in base_lanes:
+            continue  # lane did not exist at baseline time: nothing to hold
+        base_v = float(base_lanes[lane])
+        if base_v <= 0:
+            continue  # unmeasurable at baseline (e.g. io_uring refused)
+        if lane not in cur_lanes:
+            findings.append(Finding(
+                "bench", "missing-lane", where,
+                f"lane {lane!r} present in the baseline "
+                f"({base_v:.1f}) but missing from the current run — a "
+                f"silently-dropped lane is a regression, not a skip"
+                + _profile_excerpt(current)))
+            continue
+        cur_v = float(cur_lanes[lane])
+        floor = base_v * (1.0 - tol)
+        if cur_v < floor:
+            drop = 100.0 * (1.0 - cur_v / base_v)
+            findings.append(Finding(
+                "bench", "regression", where,
+                f"lane {lane!r} regressed {drop:.1f}%: {base_v:.1f} -> "
+                f"{cur_v:.1f} (tolerance band {tol * 100:.0f}%)"
+                + _profile_excerpt(current)))
+    return findings
+
+
+def run_bench(timeout_s: int = 2400) -> dict:
+    """Execute bench.py with the profiler attached; returns the current
+    artifact (rc recorded; the last stdout line is the result JSON)."""
+    env = dict(os.environ)
+    env["BRPC_TPU_BENCH_PROF"] = "1"
+    try:
+        proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO_ROOT,
+                              capture_output=True, text=True, env=env,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # a wedged bench is the failure class the gate exists to catch:
+        # report it through the bench-failed contract, not a traceback
+        # (rc mirrors subprocess's killed-by-SIGKILL convention)
+        return make_artifact({}, round_n=0, rc=-9)
+    bench: dict = {}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                bench = json.loads(line)
+                break
+            except ValueError:
+                continue
+    return make_artifact(bench, round_n=0, rc=proc.returncode)
+
+
+def run(out_path: str = "") -> List[Finding]:
+    """The gate: bench -> artifact -> diff vs the committed baseline."""
+    out_path = out_path or os.environ.get("BENCH_GATE_OUT", DEFAULT_OUT)
+    baseline_path = find_baseline()
+    current = run_bench()
+    try:
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(current, f, indent=1, sort_keys=False)
+            f.write("\n")
+        print(f"benchgate: artifact written to "
+              f"{os.path.relpath(out_path, REPO_ROOT)}")
+    except OSError as e:
+        print(f"benchgate: could not write artifact: {e}", file=sys.stderr)
+    if baseline_path is None:
+        # first gated round: nothing schema-comparable committed yet —
+        # a failed bench still fails, a clean one records the artifact
+        if current.get("rc", 0) != 0:
+            return [Finding(
+                "bench", "bench-failed", "tools/check.sh --bench",
+                f"bench.py exited rc={current.get('rc')} (and no "
+                f"schema'd BENCH_r*.json baseline exists yet)")]
+        print("benchgate: no schema'd BENCH_r*.json baseline committed "
+              "yet — artifact recorded, nothing to diff")
+        return []
+    with open(baseline_path, "r", encoding="utf-8") as f:
+        baseline = json.load(f)
+    print(f"benchgate: baseline "
+          f"{os.path.relpath(baseline_path, REPO_ROOT)} "
+          f"(round {baseline.get('n')}, sha "
+          f"{str(baseline.get('git_sha'))[:12]})")
+    return compare(baseline, current)

@@ -25,6 +25,14 @@ sanitizer suppressions entry):
   the ``NAT_FAULT_POINT`` macro — a direct ``nat_fault_hit()`` call
   skips the one-predictable-branch gate and puts a function call (plus a
   per-site op-counter RMW) on the disabled hot path.
+
+- ``sigsafe``: a function named ``*_sighandler`` (and every in-file
+  function it reaches) is a signal handler body and must stay
+  async-signal-safe: no allocation (malloc/new/std:: containers), no
+  locks, no stdio, no symbolization. Raw syscalls, lock-free atomics and
+  mem* are the legal vocabulary (nat_prof's SIGPROF sampler is the
+  motivating case — a malloc in a signal handler deadlocks against the
+  interrupted allocator).
 """
 from __future__ import annotations
 
@@ -65,6 +73,15 @@ _THREAD_LOCAL = re.compile(r"\bthread_local\b")
 
 _SEQ_LOAD = re.compile(r"([A-Za-z_]\w*)\s*(?:\.|->)\s*seq\s*(?:\.|->)\s*"
                        r"load\s*\(")
+
+# async-signal-UNSAFE vocabulary for *_sighandler bodies: allocation,
+# locks, stdio/formatting, C++ container types, symbolization
+_SIGSAFE_FORBID = re.compile(
+    r"\bmalloc\s*\(|\bcalloc\s*\(|\brealloc\s*\(|\bfree\s*\(|"
+    r"\bnew\s+\w|\bdelete\s+|\bs?n?printf\s*\(|\bfprintf\s*\(|"
+    r"std::(?:string|vector|map|unordered_map|deque|set|function)\b|"
+    r"lock_guard|unique_lock|(?:\.|->)\s*lock\s*\(|\bpthread_mutex|"
+    r"\bmutex\b|\bdladdr\s*\(|__cxa_demangle|\bfopen\s*\(|\bthrow\b")
 
 
 def _strip_comments_and_strings(line: str) -> str:
@@ -182,6 +199,56 @@ def _function_blocks(text: str) -> List[Tuple[int, str]]:
     return blocks
 
 
+# control-flow keywords also match `name (...) {` — they are not
+# function definitions, and treating them as callees would attribute the
+# file's lexically-first if/while block to signal context
+_CPP_KEYWORDS = {"if", "for", "while", "switch", "catch", "return",
+                 "sizeof", "alignof", "decltype", "else", "do", "new",
+                 "delete", "throw", "static_assert"}
+
+
+def _named_function_bodies(scrubbed: str) -> Dict[str, Tuple[int, str]]:
+    """name -> (start_lineno, body) for function DEFINITIONS (a paren
+    group whose close is followed by an opening brace; crude but right
+    for this tree — declarations end in ';' and are skipped)."""
+    out: Dict[str, Tuple[int, str]] = {}
+    for m in re.finditer(r"\b(\w+)\s*\(", scrubbed):
+        if m.group(1) in _CPP_KEYWORDS:
+            continue
+        open_idx = m.end() - 1
+        depth = 0
+        close = -1
+        for k in range(open_idx, min(open_idx + 4000, len(scrubbed))):
+            if scrubbed[k] == "(":
+                depth += 1
+            elif scrubbed[k] == ")":
+                depth -= 1
+                if depth == 0:
+                    close = k
+                    break
+        if close < 0:
+            continue
+        tail = scrubbed[close + 1:close + 48].lstrip()
+        if not re.match(r"(?:const\s*)?(?:noexcept\s*)?\{", tail):
+            continue
+        body_open = scrubbed.index("{", close)
+        depth = 0
+        for k in range(body_open, len(scrubbed)):
+            if scrubbed[k] == "{":
+                depth += 1
+            elif scrubbed[k] == "}":
+                depth -= 1
+                if depth == 0:
+                    out.setdefault(
+                        m.group(1),
+                        (scrubbed.count("\n", 0, body_open) + 1,
+                         scrubbed[body_open:k]))
+                    break
+        else:
+            continue
+    return out
+
+
 def lint_file(path: str, text: str, nontrivial: set) -> List[Finding]:
     findings: List[Finding] = []
     rel = os.path.relpath(path, REPO_ROOT)
@@ -266,6 +333,40 @@ def lint_file(path: str, text: str, nontrivial: set) -> List[Finding]:
                 "direct nat_fault_hit() call — fault hooks must go "
                 "through NAT_FAULT_POINT so the disabled hot path costs "
                 "one predictable branch (no call, no op-counter RMW)"))
+
+    # ---- sigsafe ----------------------------------------------------------
+    # *_sighandler bodies (and the in-file functions they reach) must stay
+    # async-signal-safe: BFS the in-file call closure from each handler,
+    # then scan every reached body for the forbidden vocabulary.
+    if "_sighandler" in scrubbed:
+        bodies = _named_function_bodies(scrubbed)
+        handler_roots = [n for n in bodies if n.endswith("_sighandler")]
+        for root in handler_roots:
+            reached = []
+            seen = {root}
+            queue = [root]
+            while queue:
+                fn = queue.pop()
+                reached.append(fn)
+                for cm in re.finditer(r"\b(\w+)\s*\(", bodies[fn][1]):
+                    callee = cm.group(1)
+                    if callee in bodies and callee not in seen:
+                        seen.add(callee)
+                        queue.append(callee)
+            for fn in reached:
+                start_line, body = bodies[fn]
+                for fm in _SIGSAFE_FORBID.finditer(body):
+                    lineno = start_line + body[:fm.start()].count("\n")
+                    if _allowed(lines, lineno - 1, "sigsafe"):
+                        continue
+                    via = "" if fn == root else f" (reached from {root})"
+                    findings.append(Finding(
+                        "lint", "sigsafe", f"{rel}:{lineno}",
+                        f"{fn}{via} runs in signal context but uses "
+                        f"async-signal-UNSAFE operation "
+                        f"{fm.group(0).strip()!r} — signal handlers may "
+                        f"only use raw syscalls, lock-free atomics and "
+                        f"mem* (an interrupted malloc/lock deadlocks)"))
 
     # ---- seqlock-recheck --------------------------------------------------
     for start_line, body in _function_blocks(scrubbed):
