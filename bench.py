@@ -15,9 +15,17 @@ JSON schema (one line on stdout):
       microseconds measured parse-complete -> response-write (server
       lanes) or call-begin -> completion (client lane)
   extra.device_lanes                   — device-transport GB/s rows
+  extra.scaling                        — with --cpus N: the per-core
+      scaling curve {"1": qps, ..., "N": qps, "cpu_sets": ...} from
+      taskset-pinned two-process echo runs; server and client runtimes
+      are pinned to DISJOINT cpu sets from 2 cpus up (schema note: the
+      in-process lanes above keep sharing cores — the curve is the
+      interference-free measurement). The bench gate derives
+      cpus2_scaling_x = qps(2)/qps(1) and bands it like any lane.
 The process must exit 0: the artifact of record is untrustworthy if the
 bench dies at teardown (BENCH_r05 rc 139).
 """
+import argparse
 import json
 import sys
 import time
@@ -70,6 +78,12 @@ def bench_model_fwd():
 
 
 def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cpus", type=int, default=0, metavar="N",
+                    help="record a per-core scaling curve at {1..N} cpus "
+                         "(taskset-pinned two-process echo lane) into "
+                         "extra.scaling")
+    args = ap.parse_args()
     try:
         result = bench_echo()
     except (ImportError, ModuleNotFoundError):
@@ -90,6 +104,18 @@ def main():
             result.setdefault("extra", {})["allreduce_GBps"] = coll["value"]
     except Exception:
         pass
+    # multicore scaling curve (--cpus N): qps at {1..N} cpus, pinned
+    # server/client processes — sublinear scaling is a bench-gate finding
+    if args.cpus > 0:
+        try:
+            from brpc_tpu import native
+            from brpc_tpu.bench import scaling_bench
+
+            if native.available():
+                result.setdefault("extra", {})["scaling"] = \
+                    scaling_bench(args.cpus)
+        except Exception:
+            pass
     print(json.dumps(result))
 
 

@@ -58,7 +58,7 @@ class _CounterSource:
 
 # gauges / bookkeeping counters whose per-second delta is meaningless
 _NO_RATE = {"nat_py_queue_depth", "nat_spans_dropped",
-            "nat_connections_accepted"}
+            "nat_connections_accepted", "nat_sqpoll_rings"}
 
 _PCTS = (("p50", 0.50), ("p99", 0.99), ("p999", 0.999))
 
@@ -69,6 +69,11 @@ def register_native_bvars() -> bool:
     global _registered
     with _lock:
         if _registered:
+            # the counter/lane surface is static, but the dispatcher
+            # pool may have started AFTER the first registration (e.g.
+            # the /vars server came up before any native runtime use):
+            # top up the per-dispatcher rows
+            _register_dispatcher_rows()
             return True
         try:
             from brpc_tpu import native
@@ -94,8 +99,49 @@ def register_native_bvars() -> bool:
                     _vars.append(PassiveStatus(
                         lambda i=idx, qq=q: round(
                             _stats_quantile_us(i, qq), 1), vname))
+        # per-dispatcher rows (multicore scale-out observability): one
+        # gauge triple per epoll/io_uring loop — connections owned now,
+        # event-delivering wakeup rounds, SQPOLL on/off on its ring
+        _register_dispatcher_rows()
         _registered = True
         return True
+
+
+def _register_dispatcher_rows():
+    """Expose nat_dispatcher_<i>_* rows for every loop that exists NOW;
+    called again on later register_native_bvars() calls so a runtime
+    started after the first registration still gets its rows (must be
+    called with _lock held)."""
+    try:
+        from brpc_tpu import native
+
+        ndisp = native.dispatcher_count() if native.available() else 0
+    except Exception:
+        ndisp = 0
+    for i in range(ndisp):
+        for field in ("sockets", "wakeups", "sqpoll"):
+            vname = f"nat_dispatcher_{i}_{field}"
+            if find_exposed(vname) is None:
+                _vars.append(PassiveStatus(
+                    lambda di=i, f=field: _disp_field(di, f), vname))
+
+
+def _disp_field(idx: int, field: str):
+    # one FFI call for the one requested row (a full dispatcher_stats()
+    # refetch per field made a /vars render O(ndisp^2) crossings)
+    import ctypes
+
+    from brpc_tpu import native
+
+    lib = native.load()
+    sockets = ctypes.c_uint64()
+    wakeups = ctypes.c_uint64()
+    sqpoll = ctypes.c_int()
+    if lib.nat_disp_stat(idx, ctypes.byref(sockets), ctypes.byref(wakeups),
+                         ctypes.byref(sqpoll)) != 0:
+        return 0
+    return {"sockets": sockets.value, "wakeups": wakeups.value,
+            "sqpoll": sqpoll.value}[field]
 
 
 def _stats_quantile_us(lane: int, q: float) -> float:

@@ -193,6 +193,12 @@ def load() -> ctypes.CDLL:
         lib.nat_ring_counters.argtypes = [
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
         lib.nat_ring_counters.restype = None
+        lib.nat_disp_count.argtypes = []
+        lib.nat_disp_count.restype = ctypes.c_int
+        lib.nat_disp_stat.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int)]
+        lib.nat_disp_stat.restype = ctypes.c_int
         # -- native HTTP/1.1 lane --
         lib.nat_rpc_server_native_http.argtypes = [ctypes.c_int]
         lib.nat_rpc_server_native_http.restype = ctypes.c_int
@@ -428,6 +434,29 @@ def ring_counters():
     send = ctypes.c_uint64()
     load().nat_ring_counters(ctypes.byref(recv), ctypes.byref(send))
     return recv.value, send.value
+
+
+def dispatcher_count() -> int:
+    """Number of epoll/io_uring dispatcher loops in the pool (the
+    event_dispatcher_num analog; default min(cores, 4))."""
+    return load().nat_disp_count()
+
+
+def dispatcher_stats() -> list:
+    """Per-dispatcher rows: [{'sockets': owned-now, 'wakeups': rounds
+    that delivered events, 'sqpoll': -1 no ring / 0 / 1}, ...]."""
+    lib = load()
+    rows = []
+    for i in range(lib.nat_disp_count()):
+        sockets = ctypes.c_uint64()
+        wakeups = ctypes.c_uint64()
+        sqpoll = ctypes.c_int()
+        if lib.nat_disp_stat(i, ctypes.byref(sockets), ctypes.byref(wakeups),
+                             ctypes.byref(sqpoll)) != 0:
+            break
+        rows.append({"sockets": sockets.value, "wakeups": wakeups.value,
+                     "sqpoll": sqpoll.value})
+    return rows
 
 
 def rpc_server_stop():

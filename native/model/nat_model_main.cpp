@@ -32,12 +32,116 @@
 
 #include "nat_atomic.h"
 #include "nat_desc_ring.h"
+#include "nat_wstack.h"
 #include "wsq.h"
 
 using brpc_tpu::DescCellView;
 using brpc_tpu::DescRingT;
 
 namespace {
+
+// ---- wait-free MPSC write stack (nat_wstack.h) -------------------------
+//
+// The NatSocket write discipline: producers push with one exchange, the
+// empty-head winner becomes the single drainer and releases the role
+// only through grab_more's CAS. Properties checked under every explored
+// interleaving (incl. the drainer-exit vs concurrent-enqueue race and
+// weak-memory stale loads): every pushed value is consumed EXACTLY once,
+// per-producer FIFO order survives, and the stack ends empty (head ==
+// nullptr) — a value drained twice, lost, or stranded after all
+// producers exit is a model failure.
+
+struct WsNode {
+  nat::atomic<WsNode*> wnext{nullptr};
+  int val = 0;
+};
+
+struct WstackState {
+  brpc_tpu::WStack<WsNode>* st = nullptr;
+  static constexpr int kPerProducer = 2;
+  int seen[2 * WstackState::kPerProducer + 1] = {};
+  std::vector<int> order;  // role-serialized: only the drainer appends
+};
+WstackState* g_wst = nullptr;
+
+// The drain loop a push-winner runs — the exact shape of NatSocket's
+// wgather/wrefill: walk FIFO links, keep the terminator alive until
+// grab_more's CAS decides (freeing it earlier is the ABA the header
+// comment forbids).
+void wstack_drain(WstackState* st, WsNode* r) {
+  while (true) {
+    if (r->val != 0) {
+      if (r->val <= 2 * WstackState::kPerProducer) st->seen[r->val]++;
+      st->order.push_back(r->val);
+      r->val = 0;
+    }
+    WsNode* next = r->wnext.load(std::memory_order_acquire);
+    if (next != nullptr) {
+      delete r;
+      r = next;
+      continue;
+    }
+    WsNode* more = st->st->grab_more(r);
+    delete r;
+    if (more == nullptr) return;  // role released (stack empty)
+    r = more;
+  }
+}
+
+void wstack_body() {
+  g_wst = new WstackState();
+  WstackState* st = g_wst;
+  st->st = new brpc_tpu::WStack<WsNode>();
+  dsched::spawn([st] {  // producer B: values 3, 4
+    for (int i = 0; i < WstackState::kPerProducer; i++) {
+      WsNode* n = new WsNode();
+      n->val = WstackState::kPerProducer + 1 + i;
+      if (st->st->push(n)) wstack_drain(st, n);
+    }
+  });
+  for (int i = 0; i < WstackState::kPerProducer; i++) {  // producer A: 1, 2
+    WsNode* n = new WsNode();
+    n->val = 1 + i;
+    if (st->st->push(n)) wstack_drain(st, n);
+  }
+}
+
+bool wstack_validate(std::string* why) {
+  WstackState* st = g_wst;
+  bool ok = true;
+  for (int v = 1; v <= 2 * WstackState::kPerProducer; v++) {
+    if (st->seen[v] != 1) {
+      *why = "value " + std::to_string(v) + " consumed " +
+             std::to_string(st->seen[v]) + " times (want exactly once)";
+      ok = false;
+      break;
+    }
+  }
+  if (ok && !st->st->empty()) {
+    *why = "stack not empty after all producers exited (stranded node "
+           "or leaked drain role)";
+    ok = false;
+  }
+  if (ok) {
+    // per-producer FIFO: a later push from one producer may never be
+    // written before an earlier one (wire-order corruption on a socket)
+    int posA1 = -1, posA2 = -1, posB1 = -1, posB2 = -1;
+    for (int i = 0; i < (int)st->order.size(); i++) {
+      if (st->order[i] == 1) posA1 = i;
+      if (st->order[i] == 2) posA2 = i;
+      if (st->order[i] == 3) posB1 = i;
+      if (st->order[i] == 4) posB2 = i;
+    }
+    if (posA1 > posA2 || posB1 > posB2) {
+      *why = "per-producer FIFO violated (drain order reversed pushes)";
+      ok = false;
+    }
+  }
+  delete st->st;
+  delete st;
+  g_wst = nullptr;
+  return ok;
+}
 
 // ---- wsq ---------------------------------------------------------------
 
@@ -465,6 +569,7 @@ void wsq_body1() { wsq_body_n(1); }
 void wsq_body2() { wsq_body_n(2); }
 
 const Scenario kScenarios[] = {
+    {"wstack", wstack_body, wstack_validate, 4000, 400, 3},
     {"wsq", wsq_body1, wsq_validate, 4000, 400, 3},
     {"wsq2", wsq_body2, wsq_validate, 2500, 300, 2},
     {"ring", ring_body, ring_validate, 2500, 300, 2},

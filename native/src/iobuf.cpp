@@ -1,6 +1,7 @@
 #include "iobuf.h"
 
 #include "nat_api.h"
+#include "nat_lockrank.h"
 
 #include <errno.h>
 #include <stdlib.h>
@@ -9,15 +10,69 @@
 
 namespace brpc_tpu {
 
-// Per-thread block cache (the share_tls_block/release_tls_block discipline,
-// reference iobuf.cpp:323-445): blocks freed on this thread are kept for
-// reuse instead of round-tripping the allocator. The destructor frees the
-// cache at thread exit.
+// ---------------------------------------------------------------------------
+// Block pool — two tiers (the reference's share_tls_block + global
+// free-chunk pool, iobuf.cpp:217-445), the multicore lever: a block freed
+// by a dispatcher thread on core B re-enters circulation through an
+// 8-block BATCH transfer instead of `delete` (malloc arena locks) or a
+// per-block shared freelist (one contended cache line per block). The
+// amortized cross-core cost is one short lock hold per 8 blocks; within
+// a thread, create/recycle stay pure TLS pointer ops.
+// ---------------------------------------------------------------------------
+
+static constexpr size_t kBlockBatch = 8;
+
+// central pool of 8-block chains (linked via IOBlock::pool_next), leaked
+// like every runtime static (threads run through exit())
+struct CentralBlockPool {
+  NatMutex<kLockRankBlockPool> pool_mu;
+  std::vector<IOBlock*> batches;       // each entry: chain of kBlockBatch
+  static constexpr size_t kMaxBatches = 64;  // 4MB cap; beyond -> delete
+};
+static CentralBlockPool& g_block_pool = *new CentralBlockPool();
+
+// Per-thread block cache: blocks freed on this thread are kept for reuse;
+// overflow returns WHOLE BATCHES to the central pool, refill steals them.
 struct TlsBlockCache {
   static const size_t kCap = 64;  // 512KB per thread, bounded
   IOBlock* blocks[kCap];
   size_t n = 0;
+  // this thread's shared tail block (share_tls_block analog); lives in
+  // the cache struct so thread exit releases the creator reference —
+  // short-lived writer threads used to leak exactly this block
+  IOBlock* share = nullptr;
   ~TlsBlockCache() {
+    if (share != nullptr) {
+      // drop the creator ref WITHOUT IOBlock::release(): a zero refcount
+      // must not recycle into this half-destroyed cache
+      if (share->ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        delete share;
+      }
+      share = nullptr;
+    }
+    // thread exit: hand complete batches back to the central pool (they
+    // stay reachable through the leaked pool — warm for other threads);
+    // the sub-batch remainder is freed.
+    while (n >= kBlockBatch) {
+      IOBlock* head = nullptr;
+      for (size_t i = 0; i < kBlockBatch; i++) {
+        IOBlock* b = blocks[--n];
+        b->pool_next = head;
+        head = b;
+      }
+      std::lock_guard g(g_block_pool.pool_mu);
+      if (g_block_pool.batches.size() < CentralBlockPool::kMaxBatches) {
+        g_block_pool.batches.push_back(head);
+        head = nullptr;
+      }
+      if (head != nullptr) {
+        while (head != nullptr) {
+          IOBlock* next = head->pool_next;
+          delete head;
+          head = next;
+        }
+      }
+    }
     for (size_t i = 0; i < n; i++) delete blocks[i];
   }
 };
@@ -25,6 +80,23 @@ static thread_local TlsBlockCache tls_cache;
 
 IOBlock* IOBlock::create() {
   TlsBlockCache& c = tls_cache;
+  if (c.n == 0) {
+    // refill: steal one batch (8 blocks for one lock hold)
+    IOBlock* head = nullptr;
+    {
+      std::lock_guard g(g_block_pool.pool_mu);
+      if (!g_block_pool.batches.empty()) {
+        head = g_block_pool.batches.back();
+        g_block_pool.batches.pop_back();
+      }
+    }
+    while (head != nullptr) {
+      IOBlock* next = head->pool_next;
+      head->pool_next = nullptr;
+      c.blocks[c.n++] = head;
+      head = next;
+    }
+  }
   if (c.n > 0) {
     IOBlock* b = c.blocks[--c.n];
     b->ref.store(1, std::memory_order_relaxed);
@@ -38,18 +110,40 @@ void IOBlock::recycle(IOBlock* b) {
   if (b->user_ptr != nullptr) {
     // arena-backed user block: run the release action (arena span free,
     // device buffer unpin) and strip the user fields so the header can
-    // re-enter the cache as a normal block
+    // re-enter the cache as a normal block. The HEADER recycles into the
+    // RELEASING thread's cache (below) — the span itself returns to its
+    // owner arena's freelist inside user_free, so neither side bounces
+    // the other's cache lines.
     if (b->user_free != nullptr) b->user_free(b->user_arg);
     b->user_ptr = nullptr;
     b->user_free = nullptr;
     b->user_arg = nullptr;
   }
   TlsBlockCache& c = tls_cache;
-  if (c.n < TlsBlockCache::kCap) {
-    c.blocks[c.n++] = b;
-    return;
+  if (c.n >= TlsBlockCache::kCap) {
+    // overflow: return one batch to the central pool so a hot freeing
+    // thread (a dispatcher draining another core's responses) feeds the
+    // allocating threads instead of the allocator
+    IOBlock* head = nullptr;
+    for (size_t i = 0; i < kBlockBatch; i++) {
+      IOBlock* ob = c.blocks[--c.n];
+      ob->pool_next = head;
+      head = ob;
+    }
+    {
+      std::lock_guard g(g_block_pool.pool_mu);
+      if (g_block_pool.batches.size() < CentralBlockPool::kMaxBatches) {
+        g_block_pool.batches.push_back(head);
+        head = nullptr;
+      }
+    }
+    while (head != nullptr) {  // central pool full: free the batch
+      IOBlock* next = head->pool_next;
+      delete head;
+      head = next;
+    }
   }
-  delete b;
+  c.blocks[c.n++] = b;
 }
 
 IOBlock* IOBlock::create_user(const char* p, size_t len,
@@ -62,14 +156,13 @@ IOBlock* IOBlock::create_user(const char* p, size_t len,
   return b;
 }
 
-static thread_local IOBlock* tls_block = nullptr;  // share_tls_block analog
-
 static IOBlock* tls_share_block() {
-  if (tls_block == nullptr || tls_block->left() == 0) {
-    if (tls_block) tls_block->release();
-    tls_block = IOBlock::create();
+  TlsBlockCache& c = tls_cache;
+  if (c.share == nullptr || c.share->left() == 0) {
+    if (c.share) c.share->release();
+    c.share = IOBlock::create();
   }
-  return tls_block;
+  return c.share;
 }
 
 void IOBuf::make_room() {
@@ -361,8 +454,8 @@ ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes) {
       if (sb->left() > 0) {
         // partially-filled spare becomes the new share block so the
         // next append continues filling it
-        if (tls_block != nullptr) tls_block->release();
-        tls_block = sb;  // transfers our creator reference
+        if (tls_cache.share != nullptr) tls_cache.share->release();
+        tls_cache.share = sb;  // transfers our creator reference
       } else {
         sb->release();  // full: only the IOBuf ref keeps it
       }

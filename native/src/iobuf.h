@@ -34,6 +34,12 @@ struct IOBlock {
   char* user_ptr = nullptr;
   void (*user_free)(void*) = nullptr;
   void* user_arg = nullptr;
+  // free-pool linkage (iobuf.cpp): while a block sits in a thread cache
+  // or the central batch pool this links it to the next free block —
+  // blocks migrate between cores in batches of 8 instead of through
+  // malloc's arena locks (the reference's block-pool free_chunk shape,
+  // iobuf.cpp:217-319).
+  IOBlock* pool_next = nullptr;
   char data[kSize];
 
   static IOBlock* create();   // TLS-cached (share_tls_block discipline)

@@ -177,6 +177,8 @@ int main() {
       NAT_SYM(nat_rpc_server_connections),
       NAT_SYM(nat_rpc_use_io_uring),
       NAT_SYM(nat_ring_counters),
+      NAT_SYM(nat_disp_count),
+      NAT_SYM(nat_disp_stat),
       NAT_SYM(nat_take_request),
       NAT_SYM(nat_take_request_batch),
       NAT_SYM(nat_req_kind),

@@ -63,6 +63,12 @@ uint64_t nat_rpc_server_requests(void);
 uint64_t nat_rpc_server_connections(void);
 int nat_rpc_use_io_uring(int enable);
 void nat_ring_counters(uint64_t* recv_out, uint64_t* send_out);
+// multicore observability: per-dispatcher rows (sockets owned right now,
+// epoll rounds that delivered events, SQPOLL on the loop's ring:
+// -1 = no ring). Returns -1 for an out-of-range index.
+int nat_disp_count(void);
+int nat_disp_stat(int idx, uint64_t* sockets_out, uint64_t* wakeups_out,
+                  int* sqpoll_out);
 
 // py-lane request handoff
 void* nat_take_request(int timeout_ms);

@@ -175,7 +175,7 @@ static void async_bench_fiber(void* a) {
     NatSocket* s = sock_address(ch->sock_id);
     if (s == nullptr) break;
     // Burst fill: build every frame the window allows into ONE buffer,
-    // then one socket write — the whole burst costs one write_mu pass
+    // then one socket write — the whole burst is one wait-free push
     // and one (eventual) writev instead of per-call queue traffic.
     int room = ab->window - in_flight;
     IOBuf burst;

@@ -105,7 +105,8 @@ NatSocket* channel_socket(NatChannel* ch, int max_dial_ms) {
     return nullptr;
   }
   ns->fd = fd;
-  ns->disp = pick_dispatcher();
+  ns->disp = pick_dispatcher(/*client_side=*/true);
+  ns->disp->sockets_owned.fetch_add(1, std::memory_order_relaxed);
   ns->channel = ch;
   ch->add_ref();  // the socket's channel reference
   ns->defer_writes = ch->defer_writes_flag;
@@ -314,7 +315,8 @@ static void* channel_open_impl(const char* ip, int port, int nworkers,
     return nullptr;
   }
   s->fd = fd;
-  s->disp = pick_dispatcher();
+  s->disp = pick_dispatcher(/*client_side=*/true);
+  s->disp->sockets_owned.fetch_add(1, std::memory_order_relaxed);
   s->channel = ch;
   ch->add_ref();  // the socket's reference, dropped in NatSocket::release
   s->defer_writes = (batch_writes != 0);

@@ -50,7 +50,7 @@ namespace brpc_tpu {
 // hook sites (one op counter each; keep in sync with kFaultSiteNames)
 enum NatFaultSite : int {
   NF_READ = 0,   // socket reads (epoll drain / fill / TLS feed)
-  NF_WRITE,      // socket write batches (flush_some)
+  NF_WRITE,      // socket write batches (flush_chain)
   NF_CONNECT,    // client dials (dial_nonblocking)
   NF_DOORBELL,   // shm futex wakes + ring poller wake_fn
   NF_WORKER,     // shm worker request takes

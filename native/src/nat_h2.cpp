@@ -636,7 +636,7 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
       // concurrently by the reading thread flushes the parked remainder
       // under this same lock, so releasing before the write could put
       // DATA/trailers on the wire ahead of these HEADERS (the overtake
-      // class 8ddf64e fixed for HTTP). Lock order sess mu -> write_mu
+      // class 8ddf64e fixed for HTTP). Writes push under the sess mu
       // is the established order.
       IOBuf buf;
       buf.append(out.data(), out.size());

@@ -127,13 +127,8 @@ static void http_emit_response(NatSocket* s, uint64_t seq, IOBuf data,
   }
   if (wrote && want_close) {
     // the write may have drained synchronously before the flag was
-    // visible to it — re-check now
-    bool empty;
-    {
-      std::lock_guard g(s->write_mu);
-      empty = s->write_q.empty() && !s->ring_sending && !s->writing;
-    }
-    if (empty) s->set_failed();
+    // visible to it — re-arm with the Dekker-paired recheck
+    s->arm_close_after_drain();
   }
 }
 
@@ -512,13 +507,7 @@ int nat_http_respond(uint64_t sock_id, int64_t seq, const char* data,
 int nat_sock_graceful_close(uint64_t sock_id) {
   NatSocket* s = sock_address(sock_id);
   if (s == nullptr) return -1;
-  s->close_after_drain.store(true, std::memory_order_release);
-  bool empty;
-  {
-    std::lock_guard g(s->write_mu);
-    empty = s->write_q.empty() && !s->ring_sending && !s->writing;
-  }
-  if (empty) s->set_failed();
+  s->arm_close_after_drain();
   s->release();
   return 0;
 }

@@ -42,6 +42,7 @@
 #include "nat_fault.h"
 #include "nat_lockrank.h"
 #include "nat_stats.h"
+#include "nat_wstack.h"
 #include "ring_listener.h"
 #include "rpc_meta.h"
 #include "scheduler.h"
@@ -85,6 +86,16 @@ struct PyRequest;
 // NatSocket + versioned-id registry (socket_inl.h:28-185 shape)
 // ---------------------------------------------------------------------------
 
+// One queued socket write — a node of the wait-free MPSC write stack
+// (the reference's WriteRequest, socket.cpp:115). Pooled per thread.
+struct WriteReq {
+  nat::atomic<WriteReq*> wnext{nullptr};
+  IOBuf data;
+};
+
+WriteReq* wreq_alloc();
+void wreq_free(WriteReq* r);
+
 struct NatSocket {
   int fd = -1;
   // atomic: the server-stop scan reads ids of slots that sock_create may
@@ -106,12 +117,26 @@ struct NatSocket {
   // reader per socket by construction)
   IOBuf in_buf;
 
-  // write side
-  NatMutex<kLockRankSockWrite> write_mu;
-  IOBuf write_q;        // queued-but-unwritten bytes (frames are appended
-                        // whole, so content never interleaves)
-  bool writing = false; // a writer (inline or KeepWrite fiber) is active
+  // write side — the wait-free MPSC write stack (nat_wstack.h, the
+  // reference Socket's write discipline): writers push whole frames with
+  // one atomic exchange; the empty-head winner becomes the SINGLE
+  // drainer. The fields below the stack are DRAINER-OWNED — only the
+  // current role holder (inline caller, KeepWrite fiber, ring-send
+  // completion, retry pass) touches them, and role handoffs synchronize
+  // through scheduler queues / the ring completion queue, so they need
+  // no lock at all.
+  WStack<WriteReq> wstack;
+  WriteReq* wcur = nullptr;   // FIFO-chain terminator (== last observed
+                              // stack head); kept alive for grab_more
+  IOBuf wbuf;                 // gathered-but-unwritten bytes (drainer)
+  bool ring_sending = false;  // a fixed-buffer send is in flight (the
+                              // role is parked on its completion)
+  size_t ring_inflight = 0;   // bytes submitted, awaiting completion
   Butex epollout;       // bumped by the dispatcher on EPOLLOUT
+  // epoll_ctl MOD arbitration for EPOLLOUT arm/disarm — COLD path only
+  // (kernel socket buffer full); guards epoll_events so a finished
+  // KeepWrite fiber's disarm cannot clobber its successor's arm.
+  NatMutex<kLockRankSockEpoll> epollctl_mu;
   uint32_t epoll_events = 0;  // currently-armed event mask
   // Deferred-write mode (the fork's io_uring submission-batching
   // discipline, ring_listener.h): write() only queues; a writer fiber
@@ -166,23 +191,44 @@ struct NatSocket {
   SslSessionN* ssl_sess = nullptr;
   bool ssl_declined = false;
 
-  // io_uring datapath (RingListener): (generation<<32 | file index) when
-  // this socket's reads ride the provided-buffer ring (-1 = epoll lane);
-  // the generation lets the ring reject stale rearms/sends after the
-  // slot is recycled. Fixed-send state: one in-flight fixed-buffer send
-  // at a time keeps ordering (the fork's io_uring_write_req_,
-  // socket.h:632-636).
+  // io_uring datapath: (generation<<32 | file index) on the OWNING
+  // dispatcher's ring when this socket's reads ride the provided-buffer
+  // ring (-1 = epoll lane); the generation lets the ring reject stale
+  // rearms/sends after the slot is recycled. `ring` is the per-loop
+  // RingListener the slot lives in (loops never share a ring). Send
+  // state lives in the drainer-owned block above: one in-flight
+  // fixed-buffer send at a time keeps ordering (the fork's
+  // io_uring_write_req_, socket.h:632-636).
   std::atomic<int64_t> ring_ref{-1};  // atomic: drain workers read it
                                       // while accept/set_failed write it
-  bool ring_sending = false;   // under write_mu
-  size_t ring_inflight = 0;    // bytes submitted, awaiting completion
+  RingListener* ring = nullptr;  // set at adopt, before ring_ref publishes
 
   void add_ref() { versioned_ref.fetch_add(1, std::memory_order_relaxed); }
   void release();
   void reset_for_reuse();
   int write(IOBuf&& frame);      // encrypts first on TLS sockets
   int write_raw(IOBuf&& frame);  // wire bytes as-is (TLS records)
-  bool flush_some();  // true = drained/failed-and-drained, false = EAGAIN
+  // wait-free enqueue only (no drain): true = the caller became the
+  // drainer and MUST follow up with wdrive()/flush_chain(). The ordered
+  // protocol lanes push under their session locks (order on the wire ==
+  // emission order) and drive the drain after unlocking.
+  bool write_push(IOBuf&& frame);
+  // head == nullptr: nothing queued, nobody draining — the "everything
+  // flushed" predicate of the graceful-close paths.
+  bool write_idle() const { return wstack.empty(); }
+  // Graceful close, race-free against the drain role's release: store
+  // the flag, seq_cst fence, THEN check idleness — pairs with the
+  // role-release side (fence between grab_more's head CAS and its
+  // close_after_drain load), so one side always sees the other (the
+  // Dekker pairing write_mu used to provide). Idempotent.
+  void arm_close_after_drain();
+  // role-holder entries (see nat_socket.cpp)
+  void wdrive();            // dispatch: ring submit / inline writev
+  bool flush_chain();       // epoll lane; false = EAGAIN (role retained)
+  void wring_continue();    // ring lane submission step
+  void write_release_all(); // failed socket: free chain + release role
+  void wgather();           // fold linked nodes into wbuf (keep terminator)
+  bool wrefill();           // true = role released (stack empty)
   void set_failed();
   void arm_epollout();
   void disarm_epollout();
@@ -216,14 +262,18 @@ NatSocket* sock_create();
 NatSocket* sock_address(uint64_t id);
 void sock_unregister(NatSocket* s);
 
-// ring datapath seams (defined in nat_socket.cpp)
-extern RingListener* g_ring;
+// ring datapath seams (defined in nat_socket.cpp). One RingListener per
+// dispatcher loop (the event_dispatcher_num x io_uring product of the
+// fork): loops never share an SQ, so submissions from different cores
+// never contend on one sq_mu_. g_rings is leaked for the usual exit
+// reasons; entries are created once under g_rt_mu and never removed.
+extern std::vector<RingListener*>& g_rings;
+extern std::atomic<bool> g_rings_ready;  // build complete; gates readers
 extern std::atomic<bool> g_use_ring;
-extern std::atomic<bool> g_ring_draining;
-bool ring_drain();
+bool ring_drain();                         // drain every ring (idle hook)
+bool ring_drain_one(RingListener* ring);   // poller inline drain
 bool try_ring_adopt(NatSocket* s);
 void keep_write_fiber(void* arg);
-void kick_epoll_writer_if_stranded(NatSocket* s);
 
 // ---------------------------------------------------------------------------
 // Dispatcher — one epoll loop feeding the fiber scheduler
@@ -238,6 +288,12 @@ class Dispatcher {
   // listen sockets: fd -> server
   NatMutex<kLockRankListen> listen_mu;
   std::unordered_map<int, NatServer*> listeners;
+  // per-loop io_uring instance (nullptr = epoll only); owned by g_rings
+  RingListener* ring = nullptr;
+  // observability (/vars nat_dispatcher_* rows): connections this loop
+  // owns right now, and epoll_wait rounds that delivered events
+  std::atomic<int64_t> sockets_owned{0};
+  std::atomic<uint64_t> wakeups{0};
 
   int start();
   void shutdown();
@@ -258,7 +314,12 @@ extern Dispatcher* g_disp;  // g_disps[0]: listeners + console
 extern NatServer* g_rpc_server;
 extern NatMutex<kLockRankRuntime> g_rt_mu;
 
-Dispatcher* pick_dispatcher();
+// Shard a new socket across the loop pool. With >= 2 loops, accepted
+// (server) and dialed (client) sockets round-robin over DISJOINT halves
+// of the pool so an in-process loopback bench never multiplexes both
+// runtimes' hot sockets through one loop (the cross-runtime
+// interference the single-core bench lanes used to include).
+Dispatcher* pick_dispatcher(bool client_side = false);
 int ensure_runtime(int nworkers);
 
 // ---------------------------------------------------------------------------

@@ -66,7 +66,10 @@ enum : int {
   kLockRankOverload = 59,     // g_adm_mu: auto-limiter window (completion
                               // accounting runs under py_mu/inflight)
   kLockRankSockAlloc = 60,    // g_sock_alloc_mu: registry slab/freelist
-  kLockRankSockWrite = 62,    // NatSocket::write_mu
+  kLockRankSockEpoll = 62,    // NatSocket::epollctl_mu: EPOLLOUT
+                              // arm/disarm arbitration (cold path; the
+                              // write hot path itself is the wait-free
+                              // MPSC stack of nat_wstack.h — lockless)
   kLockRankRingRetry = 64,    // g_ring_retry_mu
   kLockRankRingFiles = 66,    // RingListener::files_mu_
   kLockRankRingSq = 68,       // RingListener::sq_mu_
@@ -87,6 +90,8 @@ enum : int {
   // 90: butex (raw, cv partner)
   kLockRankSchedRemote = 92,  // Worker::remote_mu
   // 94: sched.park (raw, cv partner)
+  kLockRankBlockPool = 95,    // iobuf central block pool (batch steal/
+                              // return under ANY runtime lock: leaf)
   kLockRankStackPool = 96,    // g_stack_pool_mu, innermost
 };
 
@@ -109,8 +114,8 @@ void assert_none_held(const char* where);
 
 // Drop-in std::mutex wrapper carrying its declared rank. Zero overhead
 // unless NAT_LOCKRANK is defined. Use with CTAD guards:
-//   NatMutex<kLockRankSockWrite> write_mu;
-//   std::lock_guard g(write_mu);
+//   NatMutex<kLockRankSockEpoll> epollctl_mu;
+//   std::lock_guard g(epollctl_mu);
 template <int Rank>
 class NatMutex {
  public:

@@ -98,8 +98,13 @@ static int try_process_http(NatSocket* s, IOBuf* batch_out) {
     body = "brpc_tpu_native/1\n";
   } else if (path == "/status" || path == "/vars") {
     char buf[512];
-    uint64_t ring_recv = g_ring != nullptr ? g_ring->recv_completions() : 0;
-    uint64_t ring_send = g_ring != nullptr ? g_ring->send_completions() : 0;
+    uint64_t ring_recv = 0, ring_send = 0;
+    if (g_rings_ready.load(std::memory_order_acquire)) {
+      for (RingListener* r : g_rings) {
+        ring_recv += r->recv_completions();
+        ring_send += r->send_completions();
+      }
+    }
     snprintf(buf, sizeof(buf),
              "nat_server_requests : %llu\n"
              "nat_server_connections : %llu\n"
@@ -670,7 +675,7 @@ bool drain_socket_inline(NatSocket* s) {
     dead = true;  // EOF or hard error
     break;
   }
-  bool queued = false;
+  bool hold_role = false;
   if (!acc.empty() && !dead && s->ssl_sess != nullptr) {
     // TLS: encrypt + queue atomically (ssl_encrypt_and_write) — a py
     // responder encrypting concurrently must not interleave records
@@ -678,11 +683,11 @@ bool drain_socket_inline(NatSocket* s) {
     acc.clear();
   }
   if (!acc.empty() && !dead) {
-    std::lock_guard g(s->write_mu);
-    if (!s->failed.load(std::memory_order_acquire)) {
-      s->write_q.append(std::move(acc));
-      queued = true;
-    }
+    // wait-free enqueue; when the push wins the drain role, the CALLER
+    // (the epoll dispatcher) holds it until its end-of-round flush —
+    // cross-burst syscall batching with zero lock traffic. A racing
+    // set_failed is fine: the role holder's flush_chain cleans up.
+    hold_role = s->write_push(std::move(acc));
   }
   if (!dead) {
     // this drain's accumulator is queued: end the ordered-lane rounds
@@ -690,10 +695,14 @@ bool drain_socket_inline(NatSocket* s) {
     if (s->http != nullptr) http_round_end(s);
   }
   if (dead || s->failed.load(std::memory_order_acquire)) {
+    if (hold_role) {
+      s->write_release_all();  // we hold the drain role: clean it up
+      hold_role = false;
+    }
     s->set_failed();
     return false;
   }
-  return queued;
+  return hold_role;
 }
 
 }  // namespace brpc_tpu

@@ -43,13 +43,10 @@ struct RedisSessN {
 // the reply's write may have drained synchronously before the flag was
 // visible to it, in which case nothing else will ever check the flag.
 static void redis_arm_close(NatSocket* s) {
-  s->close_after_drain.store(true, std::memory_order_release);
-  bool empty;
-  {
-    std::lock_guard g(s->write_mu);
-    empty = s->write_q.empty() && !s->ring_sending && !s->writing;
-  }
-  if (empty) s->set_failed();
+  // flag + seq_cst fence + idle recheck, Dekker-paired with the drain
+  // role's release (the reply's write may have drained synchronously
+  // before the flag was visible)
+  s->arm_close_after_drain();
 }
 
 void redis_session_free(RedisSessN* h) { delete h; }

@@ -79,6 +79,7 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
     }
     s->fd = cfd;
     s->disp = pick_dispatcher();  // shard across the loop pool
+    s->disp->sockets_owned.fetch_add(1, std::memory_order_relaxed);
     s->server = srv;
     srv->add_ref();  // released when the socket slot is recycled
     srv->connections.fetch_add(1, std::memory_order_relaxed);
@@ -90,10 +91,16 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
 
 void Dispatcher::run() {
   std::vector<struct epoll_event> events(256);
-  std::vector<NatSocket*> flush_list;  // queued output; flushed per round
+  std::vector<NatSocket*> flush_list;  // drain roles held; flushed per round
   std::vector<Fiber*> wake_batch;      // fibers readied this round
   while (!stop.load(std::memory_order_acquire)) {
     int n = epoll_wait(epfd, events.data(), (int)events.size(), 100);
+    if (n > 0) {
+      // one event-delivering round: the per-loop gauge row and the
+      // aggregate counter move together (the stats test relies on it)
+      wakeups.fetch_add(1, std::memory_order_relaxed);
+      nat_counter_add(NS_DISP_WAKEUPS, 1);
+    }
     // every butex wake / spawn from this round coalesces into one
     // remote-queue push + one signal per worker (not per completion)
     Scheduler::instance()->arm_wake_batch(&wake_batch);
@@ -138,18 +145,11 @@ void Dispatcher::run() {
       s->release();
     }
     // End-of-round flush: one writev per socket covering every burst the
-    // round produced (cross-burst syscall batching).
+    // round produced (cross-burst syscall batching). The drain role was
+    // acquired by drain_socket_inline's push — this loop is its
+    // continuation; EAGAIN leftovers ride a KeepWrite fiber.
     for (NatSocket* s : flush_list) {
-      bool become_writer = false;
-      {
-        std::lock_guard g(s->write_mu);
-        if (!s->write_q.empty() && !s->writing &&
-            !s->failed.load(std::memory_order_acquire)) {
-          s->writing = true;
-          become_writer = true;
-        }
-      }
-      if (become_writer && !s->flush_some()) {
+      if (!s->flush_chain()) {
         s->add_ref();
         Scheduler::instance()->spawn_detached(keep_write_fiber, s);
       }
@@ -176,12 +176,41 @@ Dispatcher* g_disp = nullptr;  // g_disps[0]: listeners + console
 NatServer* g_rpc_server = nullptr;
 NatMutex<kLockRankRuntime> g_rt_mu;
 static std::atomic<uint32_t> g_disp_rr{0};
+static std::atomic<uint32_t> g_disp_rr_cli{0};
 static int g_disp_count = 0;  // 0 = auto (set before first runtime use)
 
-Dispatcher* pick_dispatcher() {
-  if (g_disps.size() == 1) return g_disps[0];
-  uint32_t i = g_disp_rr.fetch_add(1, std::memory_order_relaxed);
-  return g_disps[i % g_disps.size()];
+// Dispatcher split (NAT_DISP_SPLIT=1): accepted sockets round-robin over
+// the even loop indices, dialed (client) sockets over the odd ones — an
+// IN-PROCESS loopback bench then stops multiplexing both runtimes' hot
+// sockets through one loop (the cross-runtime interference the
+// single-core bench numbers used to include; bench.py sets it for its
+// in-process lanes). Default OFF: a dedicated server or client process
+// must shard over the WHOLE pool — partitioning there would idle half
+// the loops (measured: a 2-loop server process lost ~30% at 2 cpus).
+static std::atomic<int> g_disp_split{-1};  // -1 = unread
+
+Dispatcher* pick_dispatcher(bool client_side) {
+  size_t n = g_disps.size();
+  if (n == 1) return g_disps[0];
+  int split = g_disp_split.load(std::memory_order_relaxed);
+  if (split < 0) {
+    const char* env = getenv("NAT_DISP_SPLIT");
+    split = (env != nullptr && env[0] == '1') ? 1 : 0;
+    g_disp_split.store(split, std::memory_order_relaxed);
+  }
+  if (split == 1) {
+    if (client_side) {
+      uint32_t i = g_disp_rr_cli.fetch_add(1, std::memory_order_relaxed);
+      return g_disps[1 + 2 * (i % (n / 2))];
+    }
+    uint32_t i = g_disp_rr.fetch_add(1, std::memory_order_relaxed);
+    return g_disps[2 * (i % ((n + 1) / 2))];
+  }
+  // unsplit: independent round-robin per side over the whole pool
+  uint32_t i = client_side
+                   ? g_disp_rr_cli.fetch_add(1, std::memory_order_relaxed)
+                   : g_disp_rr.fetch_add(1, std::memory_order_relaxed);
+  return g_disps[i % n];
 }
 
 int ensure_runtime(int nworkers) {
@@ -198,8 +227,16 @@ int ensure_runtime(int nworkers) {
   if (g_disps.empty()) {
     int n = g_disp_count;
     if (n <= 0) {
-      unsigned hw = std::thread::hardware_concurrency();
-      n = hw >= 16 ? 4 : hw >= 4 ? 2 : 1;
+      // NAT_DISPATCHERS overrides; default = min(cores, 4) — the
+      // event_dispatcher_num sweet spot: one epoll/io_uring loop per
+      // core up to the point where loops start stealing usercode time
+      const char* env = getenv("NAT_DISPATCHERS");
+      if (env != nullptr && env[0] != '\0') n = atoi(env);
+      if (n <= 0) {
+        unsigned hw = std::thread::hardware_concurrency();
+        n = hw >= 1 ? (int)hw : 1;
+        if (n > 4) n = 4;
+      }
     }
     for (int i = 0; i < n; i++) {
       Dispatcher* d = new Dispatcher();
@@ -522,9 +559,21 @@ int nat_respond(void* h, int32_t error_code, const char* error_text,
   return rc;
 }
 
+// SQPOLL gauge: rings currently running with a kernel SQ poller.
+static uint64_t sqpoll_rings_gauge() {
+  if (!g_rings_ready.load(std::memory_order_acquire)) return 0;
+  uint64_t n = 0;
+  for (RingListener* r : g_rings) {
+    if (r->sqpoll_active()) n++;
+  }
+  return n;
+}
+
 // Enables the RingListener datapath for subsequently-accepted server
-// connections. Returns 1 when the ring is live, 0 when the kernel/sandbox
-// refuses io_uring (the runtime stays on epoll), -1 on runtime failure.
+// connections — ONE ring per dispatcher loop, so loops never share an
+// SQ (the event_dispatcher_num x io_uring product). Returns 1 when at
+// least one ring is live, 0 when the kernel/sandbox refuses io_uring
+// (the runtime stays on epoll), -1 on runtime failure.
 int nat_rpc_use_io_uring(int enable) {
   if (!enable) {
     g_use_ring.store(false, std::memory_order_release);
@@ -533,46 +582,88 @@ int nat_rpc_use_io_uring(int enable) {
   if (ensure_runtime(0) != 0) return -1;
   {
     std::lock_guard g(g_rt_mu);
-    if (g_ring == nullptr) {
-      RingListener* ring = new RingListener();
-      // wake a parked worker per completion batch (ExtWakeup role);
-      // installed before init() so the poller never runs without it
-      ring->set_wake_fn([] { Scheduler::instance()->wake_one(); });
-      // the poller drains its own harvest inline (every completion
-      // consumer is non-blocking), with butex wakes batched per drain —
-      // the worker idle hook below stays as a backup drain path
-      ring->set_drain_fn([]() -> bool {
-        static thread_local std::vector<Fiber*> batch;
-        if (g_ring_draining.load(std::memory_order_acquire)) {
-          return false;  // a worker holds the baton: let the poller
-        }                // wake one instead of silently dropping
-        Scheduler::instance()->arm_wake_batch(&batch);
-        bool did = ring_drain();
-        Scheduler::instance()->flush_wake_batch();
-        return did;
-      });
-      // natcheck:allow(lock-switch): one-time ring bring-up under the
-      // runtime lock (cold path, caller thread); init's failure path
-      // joins a poller that never touches g_rt_mu
-      if (!ring->init()) {
-        delete ring;
-        return 0;  // io_uring unavailable here: keep epoll
+    if (g_rings.empty()) {
+      for (Dispatcher* d : g_disps) {
+        RingListener* ring = new RingListener();
+        // wake a parked worker per completion batch (ExtWakeup role);
+        // installed before init() so the poller never runs without it
+        ring->set_wake_fn([] { Scheduler::instance()->wake_one(); });
+        // the poller drains its own harvest inline (every completion
+        // consumer is non-blocking), with butex wakes batched per drain
+        // — the worker idle hook below stays as a backup drain path
+        ring->set_drain_fn([ring]() -> bool {
+          static thread_local std::vector<Fiber*> batch;
+          if (ring->draining.load(std::memory_order_acquire)) {
+            return false;  // a worker holds the baton: let the poller
+          }                // wake one instead of silently dropping
+          Scheduler::instance()->arm_wake_batch(&batch);
+          bool did = ring_drain_one(ring);
+          Scheduler::instance()->flush_wake_batch();
+          return did;
+        });
+        // natcheck:allow(lock-switch): one-time ring bring-up under the
+        // runtime lock (cold path, caller thread); init's failure path
+        // joins a poller that never touches g_rt_mu
+        if (!ring->init()) {
+          delete ring;
+          break;  // kernel refuses: later loops would refuse too
+        }
+        d->ring = ring;
+        g_rings.push_back(ring);
       }
-      g_ring = ring;
+      if (g_rings.empty()) return 0;  // io_uring unavailable: keep epoll
+      // publish: the vector never mutates again — lock-free readers
+      // (ring_drain, counters, gauges, /status) gate on this flag
+      g_rings_ready.store(true, std::memory_order_release);
       // the wait_task drain seam (task_group.cpp:158-169)
       Scheduler::instance()->add_idle_hook(ring_drain);
+      nat_stats_register_gauge(NS_SQPOLL_RINGS, sqpoll_rings_gauge);
     }
   }
   g_use_ring.store(true, std::memory_order_release);
   return 1;
 }
 
-// Ring observability for tests/bench: completion counts.
+// Ring observability for tests/bench: completion counts over all rings.
 void nat_ring_counters(uint64_t* recv_out, uint64_t* send_out) {
-  if (recv_out != nullptr)
-    *recv_out = g_ring != nullptr ? g_ring->recv_completions() : 0;
-  if (send_out != nullptr)
-    *send_out = g_ring != nullptr ? g_ring->send_completions() : 0;
+  uint64_t recv = 0, send = 0;
+  if (g_rings_ready.load(std::memory_order_acquire)) {
+    for (RingListener* r : g_rings) {
+      recv += r->recv_completions();
+      send += r->send_completions();
+    }
+  }
+  if (recv_out != nullptr) *recv_out = recv;
+  if (send_out != nullptr) *send_out = send;
+}
+
+// ---- multicore observability (per-dispatcher rows in /vars) ----
+
+int nat_disp_count(void) { return (int)g_disps.size(); }
+
+// Per-dispatcher snapshot: connections the loop owns right now, epoll
+// rounds that delivered events, and whether its ring runs SQPOLL
+// (sqpoll_out: -1 = no ring, 0/1 otherwise).
+int nat_disp_stat(int idx, uint64_t* sockets_out, uint64_t* wakeups_out,
+                  int* sqpoll_out) {
+  if (idx < 0 || (size_t)idx >= g_disps.size()) return -1;
+  Dispatcher* d = g_disps[idx];
+  if (sockets_out != nullptr) {
+    int64_t v = d->sockets_owned.load(std::memory_order_relaxed);
+    *sockets_out = v > 0 ? (uint64_t)v : 0;
+  }
+  if (wakeups_out != nullptr) {
+    *wakeups_out = d->wakeups.load(std::memory_order_relaxed);
+  }
+  if (sqpoll_out != nullptr) {
+    // d->ring is written during the one-time ring build; only read it
+    // once the build has published (plain pointer otherwise racy)
+    RingListener* r = g_rings_ready.load(std::memory_order_acquire)
+                          ? d->ring
+                          : nullptr;
+    *sqpoll_out = r == nullptr ? -1 : r->sqpoll_active() ? 1 : 0;
+  }
+  return 0;
 }
 
 }  // extern "C"

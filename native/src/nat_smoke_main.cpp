@@ -118,6 +118,61 @@ int main() {
                                     &reqs);
   CHECK(qps > 0 && reqs > 0, "echo bench lane");
 
+  // ---- concurrent-writers round: N pthreads hammer ONE channel socket
+  // (sync + async calls) so the sanitizer lanes see the wait-free MPSC
+  // write stack hot from many cores at once — enqueue exchanges racing
+  // the drainer's grab_more CAS, role handoffs to KeepWrite fibers, and
+  // the drainer-exit vs fresh-push window the dsched `wstack` scenario
+  // models. Every call must still complete exactly once.
+  {
+    void* wch = nat_channel_open("127.0.0.1", port, 0, 0, 0, 0);
+    CHECK(wch != nullptr, "concurrent-writers channel open");
+    if (wch != nullptr) {
+      constexpr int kWriters = 4;
+      constexpr int kCallsPer = 30;
+      std::atomic<int> ok_calls{0};
+      std::thread writers[kWriters];
+      for (int t = 0; t < kWriters; t++) {
+        writers[t] = std::thread([&, t] {
+          for (int i = 0; i < kCallsPer; i++) {
+            char* resp = nullptr;
+            size_t rlen = 0;
+            char* err = nullptr;
+            int rc = nat_channel_call_full(wch, "EchoService", "Echo",
+                                           "mpsc-writer-burst", 17, 5000,
+                                           0, 0, &resp, &rlen, &err);
+            if (rc == 0 && rlen == 17 && resp != nullptr &&
+                memcmp(resp, "mpsc-writer-burst", 17) == 0) {
+              ok_calls.fetch_add(1, std::memory_order_relaxed);
+            }
+            if (resp != nullptr) nat_buf_free(resp);
+            if (err != nullptr) nat_buf_free(err);
+            (void)t;
+          }
+        });
+      }
+      // async burst from the main thread rides the same socket's stack
+      for (int i = 0; i < 16; i++) {
+        (void)nat_channel_acall(wch, "EchoService", "Echo",
+                                "abcdefghijklmnop", 16, 5000, acall_done,
+                                nullptr);
+      }
+      for (auto& th : writers) th.join();
+      CHECK(ok_calls.load(std::memory_order_relaxed) ==
+                kWriters * kCallsPer,
+            "concurrent writers all echoed");
+      auto wdeadline = std::chrono::steady_clock::now() +
+                       std::chrono::seconds(30);
+      while (g_acall_done.load(std::memory_order_relaxed) < 32 &&
+             std::chrono::steady_clock::now() < wdeadline) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(5));
+      }
+      CHECK(g_acall_done.load(std::memory_order_relaxed) == 32,
+            "concurrent async burst completed");
+      nat_channel_close(wch);
+    }
+  }
+
   // ---- http lane: native parse + native usercode ----
   void* hch = nat_channel_open_proto("127.0.0.1", port, 0, 0, 0, 0, 1,
                                      nullptr);

@@ -1,11 +1,21 @@
 // NatSocket + versioned-id registry + the io_uring datapath seam.
 //
 // This is the native counterpart of brpc::Socket (socket.cpp): a
-// versioned-id registry (socket_inl.h:28-185), a single-writer write queue
-// with inline first attempt + KeepWrite fiber on partial writes (the
-// lock+deque rendition of the wait-free design, socket.h:293-333),
-// SetFailed draining queued writes, and the RingListener fixed-buffer send
-// lane (the fork's io_uring discipline).
+// versioned-id registry (socket_inl.h:28-185), the WAIT-FREE MPSC write
+// stack (socket.h:293-333 — one atomic exchange enqueues, the empty-head
+// winner becomes the single drainer; inline writev first attempt,
+// leftovers to a KeepWrite fiber), SetFailed handing cleanup to the role
+// holder, and the per-dispatcher RingListener fixed-buffer send lane (the
+// fork's io_uring discipline).
+//
+// Drain-role ledger (who continues the drain after each transition):
+//   push() == true           the pushing thread (write_raw/wdrive)
+//   inline writev EAGAIN     a KeepWrite fiber parked on EPOLLOUT
+//   ring send submitted      that send's completion (ring_drain)
+//   ring SQE/buffer missing  a g_ring_retry entry (holds a socket ref)
+//   socket failed            whoever holds the role: write_release_all
+// The role is released ONLY by grab_more's head CAS to nullptr, so
+// wstack.empty() is exactly the "all flushed, nobody writing" predicate.
 #include "nat_internal.h"
 
 namespace brpc_tpu {
@@ -96,16 +106,73 @@ void sock_unregister(NatSocket* s) {
 }
 
 // ---------------------------------------------------------------------------
+// WriteReq pool — per-thread freelist (ObjectPool discipline): the per-
+// write allocation on the hot path is a TLS pop, and a node freed by the
+// drainer on another core re-enters THAT core's cache.
+// ---------------------------------------------------------------------------
+
+namespace {
+struct WreqCache {
+  static const int kCap = 64;
+  WriteReq* head = nullptr;
+  int n = 0;
+  ~WreqCache() {
+    while (head != nullptr) {
+      WriteReq* next = head->wnext.load(std::memory_order_relaxed);
+      delete head;
+      head = next;
+    }
+  }
+};
+thread_local WreqCache tls_wreq;
+}  // namespace
+
+WriteReq* wreq_alloc() {
+  WreqCache& c = tls_wreq;
+  if (c.head != nullptr) {
+    WriteReq* r = c.head;
+    c.head = r->wnext.load(std::memory_order_relaxed);
+    c.n--;
+    return r;
+  }
+  return new WriteReq();
+}
+
+void wreq_free(WriteReq* r) {
+  r->data.clear();
+  WreqCache& c = tls_wreq;
+  if (c.n >= WreqCache::kCap) {
+    delete r;
+    return;
+  }
+  r->wnext.store(c.head, std::memory_order_relaxed);
+  c.head = r;
+  c.n++;
+}
+
+// ---------------------------------------------------------------------------
 // NatSocket
 // ---------------------------------------------------------------------------
 
-RingListener* g_ring = nullptr;
+std::vector<RingListener*>& g_rings = *new std::vector<RingListener*>();
+// g_rings is built ONCE (under g_rt_mu, only when empty) and never
+// mutated again; every lock-free reader gates on this flag (release
+// store after the build, acquire loads) so no iteration can race the
+// vector's growth reallocations.
+std::atomic<bool> g_rings_ready{false};
 std::atomic<bool> g_use_ring{false};
-std::atomic<bool> g_ring_draining{false};
 static NatMutex<kLockRankRingRetry> g_ring_retry_mu;
-// sockets w/ unsubmitted sends; leaked — the ring poller and workers may
-// still push retries while exit() destroys statics
-static std::vector<uint64_t>& g_ring_retry = *new std::vector<uint64_t>();
+// sockets whose parked drain role waits for a free SQE/send buffer; each
+// entry holds a socket reference AND the drain role. Leaked — the ring
+// pollers and workers may still push retries while exit() destroys
+// statics.
+static std::vector<NatSocket*>& g_ring_retry = *new std::vector<NatSocket*>();
+
+static void ring_retry_park(NatSocket* s) {
+  s->add_ref();  // released by the retry pass (which inherits the role)
+  std::lock_guard g(g_ring_retry_mu);
+  g_ring_retry.push_back(s);
+}
 
 void NatSocket::release() {
   uint64_t prev = versioned_ref.fetch_sub(1, std::memory_order_acq_rel);
@@ -157,10 +224,10 @@ void NatSocket::release() {
       h2c = nullptr;
     }
     in_buf.clear();
-    {
-      std::lock_guard g(write_mu);
-      write_q.clear();
-    }
+    // refcount zero: no writer and no drainer can still reference this
+    // socket, so any leftover drain state (a failed socket whose role
+    // holder already cleaned up leaves none) is safely reclaimed here.
+    wbuf.clear();
     uint32_t idx = (uint32_t)(id & 0xffffffffu);
     std::lock_guard g(g_sock_alloc_mu);
     g_sock_free.push_back(idx);
@@ -173,11 +240,13 @@ void NatSocket::reset_for_reuse() {
   server = nullptr;
   channel = nullptr;
   failed.store(false, std::memory_order_relaxed);
-  writing = false;
+  wcur = nullptr;
+  wbuf.clear();
   defer_writes = false;
   epoll_events = 0;
   epollout.value.store(0, std::memory_order_relaxed);
   ring_ref.store(-1, std::memory_order_relaxed);
+  ring = nullptr;
   ring_sending = false;
   ring_inflight = 0;
   py_raw.store(false, std::memory_order_relaxed);
@@ -201,17 +270,14 @@ void NatSocket::set_failed() {
   if (was) return;
   {
     int64_t rr = ring_ref.exchange(-1, std::memory_order_acq_rel);
-    if (rr >= 0 && g_ring != nullptr) {
-      g_ring->unregister_file((int)(rr & 0xffffffff));  // cancels recv
+    if (rr >= 0 && ring != nullptr) {
+      ring->unregister_file((int)(rr & 0xffffffff));  // cancels recv
     }
   }
-  {
-    std::lock_guard g(write_mu);
-    write_q.clear();
-    writing = false;
-    ring_sending = false;
-    ring_inflight = 0;
-  }
+  // Queued writes are NOT touched here: the drain role holder (inline
+  // writer, KeepWrite fiber, ring completion, retry entry) observes
+  // `failed` and runs write_release_all — cleanup follows the role, so
+  // no lock is needed and no chain can leak.
   if (fd >= 0) {
     epoll_ctl(disp->epfd, EPOLL_CTL_DEL, fd, nullptr);
     // shutdown (not close): in-flight reader/KeepWrite syscalls return
@@ -274,12 +340,29 @@ void NatSocket::set_failed() {
     }
   }
   if (server != nullptr) server->connections.fetch_sub(1, std::memory_order_relaxed);
+  if (disp != nullptr) {
+    disp->sockets_owned.fetch_sub(1, std::memory_order_relaxed);
+  }
   sock_unregister(this);
   release();  // drop the registry's reference
 }
 
+// Connection-close arming — the store-buffer (Dekker) pairing with the
+// drain-role release: we STORE the flag then LOAD the stack head; the
+// role holder STORES the head (grab_more's CAS to nullptr) then LOADS
+// the flag — with a seq_cst fence between each side's store and load,
+// at least one side must observe the other, so a Connection: close can
+// never be missed by both (the atomicity the old write_mu provided).
+void NatSocket::arm_close_after_drain() {
+  close_after_drain.store(true, std::memory_order_seq_cst);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  if (write_idle() && !failed.load(std::memory_order_acquire)) {
+    set_failed();
+  }
+}
+
 void NatSocket::arm_epollout() {
-  std::lock_guard g(write_mu);
+  std::lock_guard g(epollctl_mu);
   if (failed.load(std::memory_order_acquire)) return;
   uint32_t want = EPOLLIN | EPOLLET | EPOLLOUT;
   if (epoll_events == want) return;
@@ -290,8 +373,13 @@ void NatSocket::arm_epollout() {
 }
 
 void NatSocket::disarm_epollout() {
-  std::lock_guard g(write_mu);
+  std::lock_guard g(epollctl_mu);
   if (failed.load(std::memory_order_acquire)) return;
+  // a non-idle stack means a SUCCESSOR role holder exists (this fiber
+  // already released the role) — it may just have armed EPOLLOUT for
+  // its own park; disarming here would strand it without a wake (a
+  // pre-existing race the role ledger makes checkable)
+  if (!write_idle()) return;
   uint32_t want = EPOLLIN | EPOLLET;
   if (epoll_events == want) return;
   struct epoll_event ev;
@@ -300,104 +388,213 @@ void NatSocket::disarm_epollout() {
   if (epoll_ctl(disp->epfd, EPOLL_CTL_MOD, fd, &ev) == 0) epoll_events = want;
 }
 
-bool NatSocket::flush_some() {
+// ---------------------------------------------------------------------------
+// drain-role machinery (all functions below: role holder only)
+// ---------------------------------------------------------------------------
+
+// Fold every FIFO-linked node's bytes into wbuf, freeing the nodes as
+// they empty — EXCEPT the chain terminator (wnext == nullptr), whose
+// address doubles as the stack-head identity grab_more needs. Safe to
+// call repeatedly: already-folded nodes are empty, new nodes linked by
+// grab_more (or late-arriving pushers behind the terminator... which
+// cannot happen — pushers go through the head) are appended in order.
+void NatSocket::wgather() {
+  WriteReq* r = wcur;
   while (true) {
-    IOBuf batch;
-    {
-      std::lock_guard g(write_mu);
-      if (write_q.empty()) {
-        writing = false;
-        if (close_after_drain.load(std::memory_order_acquire) &&
-            !failed.load(std::memory_order_acquire)) {
-          // Connection: close — everything flushed; FIN follows the
-          // last response byte (shutdown flushes kernel-buffered data)
-          break;
-        }
-        return true;
-      }
-      batch.append(std::move(write_q));  // take the whole queue: syscall
-                                         // batching across responses
+    wbuf.append(std::move(r->data));
+    WriteReq* next = r->wnext.load(std::memory_order_acquire);
+    if (next == nullptr) {
+      wcur = r;
+      return;
     }
-    while (!batch.empty()) {
+    wreq_free(r);
+    r = next;
+  }
+}
+
+// wbuf is empty: try to release the role. True = released (stack empty,
+// terminator freed). False = fresh pushes arrived; they are gathered
+// into wbuf and the drain continues.
+bool NatSocket::wrefill() {
+  WriteReq* last = wcur;
+  // null BEFORE the role-releasing CAS: the next push-winner's plain
+  // wcur store is ordered after the CAS (see write_push) — nulling
+  // after would race it
+  wcur = nullptr;
+  WriteReq* more = wstack.grab_more(last);
+  if (more == nullptr) {
+    wreq_free(last);
+    return true;
+  }
+  wcur = more;
+  wreq_free(last);
+  wgather();
+  return false;
+}
+
+// Failed socket: free everything queued (including pushes racing in) and
+// release the role. A writer that pushes AFTER this released checks
+// `failed` post-push and cleans up after itself (write_raw).
+void NatSocket::write_release_all() {
+  wbuf.clear();
+  ring_sending = false;
+  ring_inflight = 0;
+  if (wcur == nullptr) return;
+  while (true) {
+    wgather();
+    wbuf.clear();
+    if (wrefill()) return;
+  }
+}
+
+// Epoll-lane drain: gather + writev until empty (role released), EAGAIN
+// (false: role retained, caller parks on EPOLLOUT) or failure (cleaned).
+bool NatSocket::flush_chain() {
+  while (true) {
+    if (failed.load(std::memory_order_acquire)) {
+      write_release_all();
+      return true;
+    }
+    wgather();
+    while (!wbuf.empty()) {
       // natfault write site: injected errno (EPIPE/ECONNRESET fail the
-      // socket; EINTR/EAGAIN exercise the requeue + KeepWrite path),
-      // short writes (1-byte truncation), dropped batches (bytes vanish
-      // — the retry/backup machinery must recover). NF_DELAY is NOT
-      // honored here: flush_some runs under session locks on the py
-      // responder paths, and no NatMutex may be held across a sleep
-      // (express slow-writer scenarios as read delays on the peer).
+      // socket; EINTR/EAGAIN exercise the KeepWrite path), short writes
+      // (1-byte truncation), dropped batches (bytes vanish — the
+      // retry/backup machinery must recover). NF_DELAY is NOT honored
+      // here: the inline first attempt runs under protocol session
+      // locks on the py responder paths (express slow-writer scenarios
+      // as read delays on the peer).
       NatFaultAct fwa = NAT_FAULT_POINT(NF_WRITE);
       ssize_t n;
       if (fwa.action == NF_ERR) {
         errno = fwa.err;
         n = -1;
       } else if (fwa.action == NF_DROP) {
-        n = (ssize_t)batch.length();  // pretend the kernel took it all
-        batch.clear();
+        n = (ssize_t)wbuf.length();  // pretend the kernel took it all
+        wbuf.clear();
       } else {
-        n = batch.cut_into_fd(fd, fwa.action == NF_SHORT ? 1 : SIZE_MAX);
+        n = wbuf.cut_into_fd(fd, fwa.action == NF_SHORT ? 1 : SIZE_MAX);
       }
       if (n > 0) nat_counter_add(NS_SOCK_WRITE_BYTES, (uint64_t)n);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
-          // put leftovers back at the FRONT (later writes are behind us)
-          std::lock_guard g(write_mu);
-          batch.append(std::move(write_q));
-          write_q = std::move(batch);
-          return false;
+          return false;  // role retained; caller parks on EPOLLOUT
         }
         set_failed();
+        write_release_all();
         return true;
       }
     }
+    if (wrefill()) {
+      // role released: fence pairs with arm_close_after_drain (its
+      // flag store + fence precede its head load — Dekker)
+      std::atomic_thread_fence(std::memory_order_seq_cst);
+      if (close_after_drain.load(std::memory_order_seq_cst) &&
+          !failed.load(std::memory_order_acquire)) {
+        // Connection: close — everything flushed; FIN follows the last
+        // response byte (shutdown flushes kernel-buffered data)
+        set_failed();
+      }
+      return true;
+    }
   }
-  set_failed();  // close_after_drain: queue empty, bytes flushed
-  return true;
 }
 
 void keep_write_fiber(void* arg) {
   NatSocket* s = (NatSocket*)arg;
-  while (!s->failed.load(std::memory_order_acquire)) {
-    if (s->flush_some()) break;  // common case: drained, no epoll_ctl
+  while (true) {
+    if (s->flush_chain()) break;  // drained or failed-and-cleaned
     int32_t expected = s->epollout.value.load(std::memory_order_acquire);
     s->arm_epollout();
     // second attempt covers a became-writable-before-arm race
-    if (s->flush_some()) break;
+    if (s->flush_chain()) break;
     Scheduler::butex_wait(&s->epollout, expected);
   }
   s->disarm_epollout();
   s->release();
 }
 
-// Submits the front of write_q as one fixed-buffer send. Requires
-// write_mu. Returns false when no buffer/SQE was free (retry later via
-// the drain loop's retry list).
-static bool ring_submit_locked(NatSocket* s) {
-  if (s->ring_sending || s->write_q.empty()
-      || s->failed.load(std::memory_order_acquire)) {
-    return true;
+// Ring-lane submission step — entered by a fresh drainer, a send
+// completion, or the retry pass; the role holder either parks (send in
+// flight / retry list) or finishes (released / failed / demoted-to-
+// epoll continuation).
+void NatSocket::wring_continue() {
+  while (true) {
+    if (failed.load(std::memory_order_acquire)) {
+      write_release_all();
+      return;
+    }
+    if (ring_sending) return;  // the completion continues the role
+    wgather();
+    int64_t rr = ring_ref.load(std::memory_order_acquire);
+    if (rr < 0 || ring == nullptr) {
+      // demoted mid-drain: the bytes continue on the epoll lane
+      if (!flush_chain()) {
+        add_ref();
+        Scheduler::instance()->spawn_detached(keep_write_fiber, this);
+      }
+      return;
+    }
+    if (wbuf.empty()) {
+      if (wrefill()) {
+        // role released: Dekker fence vs arm_close_after_drain
+        std::atomic_thread_fence(std::memory_order_seq_cst);
+        if (close_after_drain.load(std::memory_order_seq_cst) &&
+            !failed.load(std::memory_order_acquire)) {
+          set_failed();
+        }
+        return;
+      }
+      continue;
+    }
+    uint16_t buf;
+    char* dst = ring->acquire_send_buffer(&buf);
+    if (dst == nullptr) {
+      ring_retry_park(this);
+      return;
+    }
+    size_t n = wbuf.length();
+    if (n > RingListener::kSendBufSize) n = RingListener::kSendBufSize;
+    wbuf.copy_to(dst, n);  // straight into registered memory
+    // in-flight state published BEFORE the submit: the completion (the
+    // role's next holder) may run the instant the SQE is visible, and
+    // nothing here may be touched after a successful submit. The send
+    // owns a reference AND the drain role; its tag is the socket
+    // POINTER (slabs are never freed, and the ref pins the slot against
+    // recycling), so the completion needs no id lookup.
+    ring_sending = true;
+    ring_inflight = n;
+    add_ref();
+    if (!ring->submit_send((int)(rr & 0xffffffff), (uint32_t)(rr >> 32),
+                           (uint64_t)(uintptr_t)this, buf, n)) {
+      ring_sending = false;  // no completion will come: undo + park
+      ring_inflight = 0;
+      release();
+      ring_retry_park(this);
+      return;
+    }
+    return;
   }
-  int64_t rr = s->ring_ref.load(std::memory_order_acquire);
-  if (rr < 0) return true;  // demoted/failed; bytes drain elsewhere
-  uint16_t buf;
-  char* dst = g_ring->acquire_send_buffer(&buf);
-  if (dst == nullptr) return false;
-  size_t n = s->write_q.length();
-  if (n > RingListener::kSendBufSize) n = RingListener::kSendBufSize;
-  s->write_q.copy_to(dst, n);  // straight into registered memory
-  if (!g_ring->submit_send((int)(rr & 0xffffffff), (uint32_t)(rr >> 32),
-                           s->id, buf, n)) {
-    return false;
-  }
-  s->ring_sending = true;
-  s->ring_inflight = n;
-  return true;
 }
 
-static void ring_retry_later(uint64_t sock_id) {
-  std::lock_guard g(g_ring_retry_mu);
-  g_ring_retry.push_back(sock_id);
+// A push just made the caller the drainer: drive the drain one step on
+// the right lane.
+void NatSocket::wdrive() {
+  if (ring_ref.load(std::memory_order_acquire) >= 0 && ring != nullptr) {
+    wring_continue();
+    return;
+  }
+  // Inline first attempt on the caller's thread/fiber (socket.cpp:1287);
+  // leftovers go to a KeepWrite fiber waiting on EPOLLOUT.
+  if (!flush_chain()) {
+    add_ref();
+    Scheduler::instance()->spawn_detached(keep_write_fiber, this);
+  }
 }
+
+// ---------------------------------------------------------------------------
+// write entries
+// ---------------------------------------------------------------------------
 
 int NatSocket::write(IOBuf&& frame) {
   if (ssl_sess != nullptr) {
@@ -408,32 +605,35 @@ int NatSocket::write(IOBuf&& frame) {
   return write_raw(std::move(frame));
 }
 
+// Enqueue only (wait-free). True = caller became the drainer (wcur is
+// set to the pushed node) and must drive the drain — after releasing any
+// session locks it holds: order on the wire is fixed at PUSH time, so
+// the drain itself needs no lock.
+bool NatSocket::write_push(IOBuf&& frame) {
+  WriteReq* r = wreq_alloc();
+  r->data = std::move(frame);
+  if (wstack.push(r)) {
+    // safe plain store: the push exchange that made us the drainer
+    // happens-after the previous drainer's role-releasing CAS, which
+    // happens-after it nulled wcur (wrefill nulls BEFORE the CAS)
+    wcur = r;
+    return true;
+  }
+  return false;
+}
+
 int NatSocket::write_raw(IOBuf&& frame) {
   if (failed.load(std::memory_order_acquire)) return -1;
-  if (ring_ref.load(std::memory_order_acquire) >= 0) {
-    // io_uring lane: queue + submit from registered send memory; ordering
-    // is kept by the single-in-flight discipline.
-    bool need_retry;
-    {
-      std::lock_guard g(write_mu);
-      if (failed.load(std::memory_order_acquire)) return -1;
-      write_q.append(std::move(frame));
-      need_retry = !ring_submit_locked(this);
-    }
-    if (need_retry) ring_retry_later(id);
-    return 0;
+  if (!write_push(std::move(frame))) {
+    return 0;  // active drainer will take it
   }
-  bool become_writer = false;
-  {
-    std::lock_guard g(write_mu);
-    if (failed.load(std::memory_order_acquire)) return -1;
-    write_q.append(std::move(frame));
-    if (!writing) {
-      writing = true;
-      become_writer = true;
-    }
+  // became the drainer; a failure that raced the pre-push check is OUR
+  // cleanup now (the failed side's release_all has already run or never
+  // held the role)
+  if (failed.load(std::memory_order_acquire)) {
+    write_release_all();
+    return -1;
   }
-  if (!become_writer) return 0;  // active writer will drain us
   if (defer_writes) {
     // Batch mode: the writer fiber runs AFTER the currently-ready fibers,
     // so their appends coalesce into one writev.
@@ -441,12 +641,7 @@ int NatSocket::write_raw(IOBuf&& frame) {
     Scheduler::instance()->spawn_detached_back(keep_write_fiber, this);
     return 0;
   }
-  // Inline first attempt on the caller's thread/fiber (socket.cpp:1287);
-  // leftovers go to a KeepWrite fiber waiting on EPOLLOUT.
-  if (!flush_some()) {
-    add_ref();
-    Scheduler::instance()->spawn_detached(keep_write_fiber, this);
-  }
+  wdrive();
   return 0;
 }
 
@@ -454,74 +649,56 @@ int NatSocket::write_raw(IOBuf&& frame) {
 // ring lane (completion drain, demotion, adoption)
 // ---------------------------------------------------------------------------
 
-// After a socket leaves the ring lane with bytes still queued, no sender
-// owns them (ring_submit_locked no-ops on demoted sockets): hand them to
-// the epoll KeepWrite lane or the peer hangs waiting for a response.
-void kick_epoll_writer_if_stranded(NatSocket* s) {
-  bool kick = false;
-  {
-    std::lock_guard g(s->write_mu);
-    if (s->ring_ref.load(std::memory_order_acquire) < 0 &&
-        !s->write_q.empty() && !s->writing && !s->ring_sending &&
-        !s->failed.load(std::memory_order_acquire)) {
-      s->writing = true;
-      kick = true;
-    }
-  }
-  if (kick) {
-    s->add_ref();
-    Scheduler::instance()->spawn_detached(keep_write_fiber, s);
-  }
-}
-
 // Moves a ring socket to the epoll lane (rearm impossible / multishot
 // unsupported); the CAS makes demotion and set_failed mutually exclusive.
+// Queued bytes need no hand-off: the drain role is continuous, and every
+// role holder re-checks ring_ref before submitting (a parked role on the
+// retry list or an in-flight completion continues on the epoll lane).
 static void ring_demote_to_epoll(NatSocket* s, int64_t rr) {
   if (s->ring_ref.compare_exchange_strong(rr, -1,
                                           std::memory_order_seq_cst)) {
-    g_ring->unregister_file((int)(rr & 0xffffffff));
+    s->ring->unregister_file((int)(rr & 0xffffffff));
     s->disp->add_consumer(s);
-    kick_epoll_writer_if_stranded(s);
   }
 }
 
-// Drains harvested ring completions — the wait_task drain of the fork
-// (task_group.cpp:158-169): recv bytes feed the SAME cut loop the epoll
-// readers use; send completions recycle fixed buffers and launch the next
-// chunk. Registered as a scheduler idle hook; one worker drains at a time
-// so per-socket completion order is preserved.
-bool ring_drain() {
-  if (g_ring == nullptr) return false;
-  if (g_ring_draining.exchange(true, std::memory_order_acquire)) {
+// Drains one ring's harvested completions — the wait_task drain of the
+// fork (task_group.cpp:158-169): recv bytes feed the SAME cut loop the
+// epoll readers use; send completions recycle fixed buffers and continue
+// the owning socket's drain role. One drainer per ring at a time (the
+// per-ring baton) keeps per-socket completion order.
+bool ring_drain_one(RingListener* ring) {
+  if (ring == nullptr) return false;
+  if (ring->draining.exchange(true, std::memory_order_acquire)) {
     return false;
   }
   bool did = false;
   RingCompletion c;
-  while (g_ring->pop_completion(&c)) {
+  while (ring->pop_completion(&c)) {
     did = true;
-    NatSocket* s = sock_address(c.tag);
     if (c.kind == 0) {  // recv
+      NatSocket* s = sock_address(c.tag);
       if (c.res > 0) {
         if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
           nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)c.res);
           if (s->ssl_sess != nullptr) {
             // TLS: ciphertext feeds the session; plaintext lands in
             // in_buf inside ssl_feed
-            if (!ssl_feed(s, g_ring->buffer_data(c.buf_id),
+            if (!ssl_feed(s, ring->buffer_data(c.buf_id),
                           (size_t)c.res)) {
-              g_ring->recycle_buffer(c.buf_id);
+              ring->recycle_buffer(c.buf_id);
               s->set_failed();
               s->release();
               continue;
             }
           } else {
-            const char* src = g_ring->buffer_data(c.buf_id);
+            const char* src = ring->buffer_data(c.buf_id);
             size_t len = (size_t)c.res;
             if (s->fill_req != nullptr) {
               // stream fill mode: payload bytes skip in_buf entirely
               size_t took = stream_fill_feed(s, src, len);
               if (took == SIZE_MAX) {  // allocation failed
-                g_ring->recycle_buffer(c.buf_id);
+                ring->recycle_buffer(c.buf_id);
                 s->set_failed();
                 s->release();
                 continue;
@@ -531,25 +708,25 @@ bool ring_drain() {
             }
             if (len > 0) s->in_buf.append(src, len);
           }
-          g_ring->recycle_buffer(c.buf_id);
+          ring->recycle_buffer(c.buf_id);
           int64_t rr = s->ring_ref.load(std::memory_order_acquire);
           if (!process_input(s)) {
             s->set_failed();
           } else if (!c.more && rr >= 0 &&
-                     !g_ring->rearm_recv((int)(rr & 0xffffffff),
-                                         (uint32_t)(rr >> 32), s->id)) {
+                     !ring->rearm_recv((int)(rr & 0xffffffff),
+                                       (uint32_t)(rr >> 32), s->id)) {
             ring_demote_to_epoll(s, rr);  // SQ full: don't go deaf
           }
         } else {
-          g_ring->recycle_buffer(c.buf_id);  // owner gone: recycle only
+          ring->recycle_buffer(c.buf_id);  // owner gone: recycle only
         }
       } else if (s != nullptr) {
         int64_t rr = s->ring_ref.load(std::memory_order_acquire);
         if (c.res == -ENOBUFS) {
           // provided buffers were exhausted; they're recycled as we
           // drain, so re-arm and keep going
-          if (rr >= 0 && !g_ring->rearm_recv((int)(rr & 0xffffffff),
-                                             (uint32_t)(rr >> 32), s->id)) {
+          if (rr >= 0 && !ring->rearm_recv((int)(rr & 0xffffffff),
+                                           (uint32_t)(rr >> 32), s->id)) {
             ring_demote_to_epoll(s, rr);
           }
         } else if (c.res == -EINVAL && rr >= 0) {
@@ -560,77 +737,66 @@ bool ring_drain() {
           s->set_failed();  // EOF (0) or hard error
         }
       }
-    } else {  // send
-      g_ring->recycle_send_buffer(c.send_buf);
+      if (s != nullptr) s->release();
+    } else {  // send: the completion IS the drain-role continuation
+      ring->recycle_send_buffer(c.send_buf);
+      NatSocket* s = (NatSocket*)(uintptr_t)c.tag;
       if (s != nullptr) {
+        s->ring_sending = false;
         if (c.res < 0) {
           s->set_failed();
+          s->write_release_all();
         } else {
-          bool need_retry;
-          bool drained_close = false;
-          {
-            std::lock_guard g(s->write_mu);
-            size_t done = (size_t)c.res;
-            if (done > s->ring_inflight) done = s->ring_inflight;
-            nat_counter_add(NS_SOCK_WRITE_BYTES, done);
-            s->write_q.pop_front(done);
-            s->ring_sending = false;
-            s->ring_inflight = 0;
-            need_retry = !ring_submit_locked(s);
-            drained_close =
-                s->write_q.empty() &&
-                s->close_after_drain.load(std::memory_order_acquire);
-          }
-          if (drained_close) {
-            s->set_failed();  // Connection: close — all bytes flushed
-          } else {
-            if (need_retry) ring_retry_later(s->id);
-            // a demotion landing between completions leaves queued bytes
-            // with no sender: hand them to the epoll write lane
-            kick_epoll_writer_if_stranded(s);
-          }
+          size_t done = (size_t)c.res;
+          if (done > s->ring_inflight) done = s->ring_inflight;
+          nat_counter_add(NS_SOCK_WRITE_BYTES, done);
+          s->wbuf.pop_front(done);
+          s->ring_inflight = 0;
+          s->wring_continue();  // next chunk / refill / release / close
         }
+        s->release();  // the in-flight send's reference
       }
     }
-    if (s != nullptr) s->release();
   }
-  // retry sends that couldn't get a buffer/SQE earlier
-  std::vector<uint64_t> retry;
+  // resume drains parked for a free SQE/send buffer (every entry owns
+  // its socket's drain role and a reference)
+  std::vector<NatSocket*> retry;
   {
     std::lock_guard g(g_ring_retry_mu);
     retry.swap(g_ring_retry);
   }
-  for (uint64_t sid : retry) {
-    NatSocket* s = sock_address(sid);
-    if (s == nullptr) continue;
-    bool again;
-    {
-      std::lock_guard g(s->write_mu);
-      again = !ring_submit_locked(s);
-    }
-    if (again) ring_retry_later(sid);
-    kick_epoll_writer_if_stranded(s);
+  for (NatSocket* s : retry) {
+    s->wring_continue();
     s->release();
   }
-  g_ring_draining.store(false, std::memory_order_release);
+  ring->draining.store(false, std::memory_order_release);
   return did;
 }
 
-// Put a freshly-connected fd on the ring lane when it is enabled (both
-// directions then ride io_uring and drain on the poller — the accept
-// path's twin). Returns true when the ring owns the socket's reads.
+// Idle-hook drain: every per-dispatcher ring in turn.
+bool ring_drain() {
+  if (!g_rings_ready.load(std::memory_order_acquire)) return false;
+  bool did = false;
+  for (RingListener* r : g_rings) did |= ring_drain_one(r);
+  return did;
+}
+
+// Put a freshly-connected fd on its dispatcher's ring when the lane is
+// enabled (both directions then ride io_uring and drain on the poller —
+// the accept path's twin). Returns true when the ring owns the reads.
 bool try_ring_adopt(NatSocket* s) {
-  if (!g_use_ring.load(std::memory_order_acquire) || g_ring == nullptr) {
-    return false;
-  }
+  if (!g_use_ring.load(std::memory_order_acquire)) return false;
+  RingListener* ring = s->disp != nullptr ? s->disp->ring : nullptr;
+  if (ring == nullptr) return false;
   uint32_t gen = 0;
-  int fidx = g_ring->register_file(s->fd, &gen);
+  int fidx = ring->register_file(s->fd, &gen);
   if (fidx < 0) return false;
+  s->ring = ring;  // published before ring_ref: completions read it
   int64_t rr = ((int64_t)gen << 32) | (uint32_t)fidx;
   s->ring_ref.store(rr, std::memory_order_release);
-  if (g_ring->rearm_recv(fidx, gen, s->id)) return true;
+  if (ring->rearm_recv(fidx, gen, s->id)) return true;
   s->ring_ref.store(-1, std::memory_order_release);
-  g_ring->unregister_file(fidx);
+  ring->unregister_file(fidx);
   return false;
 }
 

@@ -213,7 +213,7 @@ bool ssl_feed(NatSocket* s, const char* data, size_t n) {
     }
     // queue while still holding sess->ssl_mu: record order on the wire must
     // match production order even against concurrent encrypt_and_write
-    // callers (lock order sess->ssl_mu -> write_mu, never inverted)
+    // callers (the wait-free push keeps wire order == queue order)
     if (!out.empty()) s->write_raw(std::move(out));
   }
   return true;
@@ -230,8 +230,8 @@ bool ssl_encrypt(NatSocket* s, IOBuf&& plain, IOBuf* cipher_out) {
 // Encrypt AND queue under ONE session lock: record order on the wire
 // must match encryption order, and two concurrent writers that encrypt
 // A-then-B but queue B-then-A would corrupt the record stream (the peer
-// MACs records sequentially). Lock order sess->ssl_mu -> write_mu; nothing
-// takes them inversely.
+// MACs records sequentially). The MPSC write push happens under ssl_mu,
+// so wire order is fixed here; the drain itself is lock-free.
 int ssl_encrypt_and_write(NatSocket* s, IOBuf&& plain) {
   SslSessionN* sess = s->ssl_sess;
   std::lock_guard g(sess->ssl_mu);

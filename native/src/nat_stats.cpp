@@ -90,6 +90,10 @@ static const char* kCounterNames[NS_COUNTER_COUNT] = {
     "nat_retry_budget_exhausted",
     "nat_breaker_isolations",
     "nat_breaker_revivals",
+    "nat_dispatcher_wakeups",
+    "nat_wsq_steals",
+    "nat_worker_parks",
+    "nat_sqpoll_rings",
 };
 
 static const char* kLaneNames[NL_LANE_COUNT] = {

@@ -52,6 +52,10 @@ enum NatCounterId : int {
   NS_RETRY_BUDGET_EXHAUSTED,// retries suppressed by the channel budget
   NS_BREAKER_ISOLATIONS,    // native circuit-breaker trips
   NS_BREAKER_REVIVALS,      // breaker resets after a successful re-dial
+  NS_DISP_WAKEUPS,          // dispatcher epoll rounds that delivered events
+  NS_WSQ_STEALS,            // fiber runqueue steals (cross-core balance)
+  NS_WORKER_PARKS,          // scheduler worker park attempts (idle shape)
+  NS_SQPOLL_RINGS,          // gauge: io_uring rings running SQPOLL now
   NS_COUNTER_COUNT,
 };
 

@@ -48,8 +48,23 @@ inline int sys_register(int fd, unsigned opcode, void* arg,
 
 bool RingListener::setup_rings(unsigned entries) {
   struct io_uring_params p;
-  memset(&p, 0, sizeof(p));
-  ring_fd_ = sys_setup(entries, &p);
+  // SQPOLL probe: a kernel SQ poller makes steady-state submission a
+  // tail store (no io_uring_enter unless the poller idled out and set
+  // NEED_WAKEUP). Unprivileged SQPOLL needs 5.11+; refused setups fall
+  // back to a plain ring. NAT_SQPOLL=0 force-disables the probe.
+  const char* sq_env = getenv("NAT_SQPOLL");
+  if (sq_env == nullptr || sq_env[0] != '0') {
+    memset(&p, 0, sizeof(p));
+    p.flags = IORING_SETUP_SQPOLL;
+    p.sq_thread_idle = 50;  // ms before the kernel poller sleeps
+    ring_fd_ = sys_setup(entries, &p);
+    if (ring_fd_ >= 0) sqpoll_ = true;
+  }
+  if (ring_fd_ < 0) {
+    memset(&p, 0, sizeof(p));
+    ring_fd_ = sys_setup(entries, &p);
+    sqpoll_ = false;
+  }
   if (ring_fd_ < 0) return false;
 
   sq_ring_sz_ = p.sq_off.array + p.sq_entries * sizeof(unsigned);
@@ -70,6 +85,7 @@ bool RingListener::setup_rings(unsigned entries) {
   char* sq = (char*)sq_ring_;
   sq_head_ = (std::atomic<unsigned>*)(sq + p.sq_off.head);
   sq_tail_ = (std::atomic<unsigned>*)(sq + p.sq_off.tail);
+  sq_flags_ = (std::atomic<unsigned>*)(sq + p.sq_off.flags);
   sq_mask_ = (unsigned*)(sq + p.sq_off.ring_mask);
   sq_array_ = (unsigned*)(sq + p.sq_off.array);
   char* cq = (char*)cq_ring_;
@@ -206,6 +222,18 @@ struct io_uring_sqe* RingListener::get_sqe_locked() {
 }
 
 void RingListener::flush_unsubmitted_locked() {
+  // SQPOLL: the kernel poller consumes published SQEs by itself — the
+  // only syscall needed is a wakeup when it idled out (NEED_WAKEUP).
+  // This is the ~zero-syscall steady state: under load the flag stays
+  // clear and submission is the tail store alone.
+  if (sqpoll_) {
+    unsubmitted_ = 0;
+    if (sq_flags_->load(std::memory_order_acquire) &
+        IORING_SQ_NEED_WAKEUP) {
+      sys_enter(ring_fd_, 0, 0, IORING_ENTER_SQ_WAKEUP);
+    }
+    return;
+  }
   // EINTR/EAGAIN/EBUSY must not strand published SQEs: unsubmitted_
   // carries leftovers; the poller also flushes each iteration so a
   // stranded SQE never waits for the next submission.

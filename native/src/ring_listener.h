@@ -59,6 +59,16 @@ struct io_uring_buf_reg {
 #ifndef IORING_RECV_MULTISHOT
 #define IORING_RECV_MULTISHOT (1U << 1)
 #endif
+// SQPOLL ABI (5.1+; values are kernel wire ABI, probed at runtime)
+#ifndef IORING_SETUP_SQPOLL
+#define IORING_SETUP_SQPOLL (1U << 1)
+#endif
+#ifndef IORING_SQ_NEED_WAKEUP
+#define IORING_SQ_NEED_WAKEUP (1U << 0)
+#endif
+#ifndef IORING_ENTER_SQ_WAKEUP
+#define IORING_ENTER_SQ_WAKEUP (1U << 1)
+#endif
 
 namespace brpc_tpu {
 
@@ -96,8 +106,22 @@ class RingListener {
 
   // Sets up the ring, provided-buffer ring, file table, send buffers and
   // the poller thread. False when the kernel/sandbox refuses io_uring.
+  // SQPOLL is probed first (unless NAT_SQPOLL=0): with a kernel SQ
+  // poller thread the steady-state submit path is a tail store + a
+  // need-wakeup check — ~zero syscalls — and registered files/buffers
+  // (which SQPOLL requires anyway) are already the only ops submitted.
+  // Unprivileged SQPOLL needs a 5.11+ kernel; older/denied setups fall
+  // back to plain io_uring, then to epoll.
   bool init(unsigned entries = kEntries);
   void shutdown();
+
+  // True when this ring runs with a kernel SQ poller (IORING_SETUP_SQPOLL
+  // accepted at init) — surfaced per dispatcher in /vars.
+  bool sqpoll_active() const { return sqpoll_; }
+
+  // Per-ring drain baton: one completion drainer at a time preserves
+  // per-socket completion order (held by ring_drain_one).
+  std::atomic<bool> draining{false};
 
   // Registers fd into the fixed-file table WITHOUT arming recv; the
   // caller publishes the returned index (and generation) on its socket
@@ -183,11 +207,13 @@ class RingListener {
   void poller_loop();
 
   int ring_fd_ = -1;
+  bool sqpoll_ = false;
   // SQ mmap
   void* sq_ring_ = nullptr;
   size_t sq_ring_sz_ = 0;
   std::atomic<unsigned>* sq_head_ = nullptr;
   std::atomic<unsigned>* sq_tail_ = nullptr;
+  std::atomic<unsigned>* sq_flags_ = nullptr;  // NEED_WAKEUP under SQPOLL
   unsigned* sq_mask_ = nullptr;
   unsigned* sq_array_ = nullptr;
   struct io_uring_sqe* sqes_ = nullptr;

@@ -3,6 +3,8 @@
 // guard page like bthread's StackPool (stack_inl.h:36-105).
 #include "scheduler.h"
 
+#include "nat_stats.h"
+
 #include <sys/mman.h>
 #include <cassert>
 #include <chrono>
@@ -375,7 +377,10 @@ Fiber* Scheduler::next_task(Worker* w) {
     for (size_t i = 0; i < n; i++) {
       Worker* v = workers_[(start + i) % n];
       if (v == w) continue;
-      if (v->rq.steal(&f)) return f;
+      if (v->rq.steal(&f)) {
+        nat_counter_add(NS_WSQ_STEALS, 1);  // /vars: cross-core balance
+        return f;
+      }
       {
         std::lock_guard g(v->remote_mu);
         if (!v->remote_rq.empty()) {
@@ -523,6 +528,10 @@ void Scheduler::worker_loop(Worker* w) {
       for (auto& h : *hooks) did_work |= h();
     }
     if (did_work) continue;
+    // /vars idle-vs-busy shape: counted BEFORE park_mu — the first add
+    // on a thread registers its stat cell (g_cell_mu, rank 78), which
+    // must not nest inside the rank-94 parking lot
+    nat_counter_add(NS_WORKER_PARKS, 1);
     std::unique_lock lk(w->park_mu);
     // Publish parked BEFORE the final recheck (Dekker pairing with
     // signal()'s bump-then-load): a signaler that misses parked>0 must

@@ -51,6 +51,12 @@ def _bench_result():
             "nat_prof": {"samples": 1234,
                          "flat": ["     100  10.0%  drain_socket_inline",
                                   "      80   8.0%  process_input"]},
+            "scaling": {"1": 250000.0, "2": 437500.0,
+                        "host_parallel_x": 1.9,
+                        "cpu_sets": {"1": {"server": [0],
+                                           "clients": [[0]]},
+                                     "2": {"server": [0, 1],
+                                           "clients": [[0], [1]]}}},
         },
     }
 
@@ -169,6 +175,62 @@ def test_make_baseline_takes_lane_floor(pair):
     assert base2["lanes"]["http_qps"] == b["lanes"]["http_qps"]
     with pytest.raises(ValueError):
         benchgate.make_baseline([dead], round_n=6)
+
+
+def test_scaling_lane_derived_from_curve():
+    """The cpus2_scaling_x lane = qps(2)/qps(1) out of extra.scaling,
+    and the raw curve rides the artifact for the record."""
+    art = benchgate.make_artifact(_bench_result(), round_n=7)
+    assert art["lanes"]["cpus2_scaling_x"] == pytest.approx(1.75)
+    assert art["scaling"]["host_parallel_x"] == 1.9
+    assert art["scaling"]["1"] == 250000.0
+
+
+def test_scaling_regression_beyond_band_fails(pair):
+    base, cur = pair
+    # 1.75x baseline, 35% band -> floor 1.1375; a 1.0x run fails
+    cur["lanes"]["cpus2_scaling_x"] = 1.0
+    cur["scaling"] = dict(cur["scaling"], host_parallel_x=1.3)  # host capped:
+    # only the banded comparison fires, not the absolute floor
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["regression"]
+    assert "cpus2_scaling_x" in findings[0].message
+
+
+def test_sublinear_scaling_with_host_headroom_fails(pair):
+    """The absolute floor: host probe shows real parallel capacity but
+    the runtime scaled < 1.15x — fails EVEN when within the baseline
+    band (and even with no baseline scaling lane at all)."""
+    base, cur = pair
+    del base["lanes"]["cpus2_scaling_x"]  # pre-scaling baseline (r06)
+    cur["lanes"]["cpus2_scaling_x"] = 1.05
+    cur["scaling"] = dict(cur["scaling"], host_parallel_x=1.9)
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["sublinear-scaling"]
+    assert "1.05x" in findings[0].message
+
+
+def test_sublinear_scaling_on_overcommitted_host_passes(pair):
+    """No parallel headroom on the host (shared-container probe below
+    the bar): a flat curve is the host's fault, not a finding."""
+    base, cur = pair
+    del base["lanes"]["cpus2_scaling_x"]
+    cur["lanes"]["cpus2_scaling_x"] = 1.05
+    cur["scaling"] = dict(cur["scaling"], host_parallel_x=1.4)
+    assert benchgate.compare(base, cur) == []
+
+
+def test_make_baseline_takes_scaling_best():
+    """Scaling ratios bake the best ACHIEVED ratio into the baseline
+    (min would enshrine a crushed shared-host round as the bar)."""
+    a = benchgate.make_artifact(_bench_result(), round_n=7)
+    b = copy.deepcopy(a)
+    b["lanes"]["cpus2_scaling_x"] = 1.02
+    b["lanes"]["http_qps"] = a["lanes"]["http_qps"] * 0.9
+    base = benchgate.make_baseline([a, b], round_n=7)
+    assert base["lanes"]["cpus2_scaling_x"] == \
+        a["lanes"]["cpus2_scaling_x"]  # max for ratios
+    assert base["lanes"]["http_qps"] == b["lanes"]["http_qps"]  # min for qps
 
 
 def test_committed_baseline_is_green():

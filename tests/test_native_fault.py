@@ -87,6 +87,86 @@ def test_echo_survives_short_reads_writes_eintr(echo_server):
     native.channel_close(ch)
 
 
+def test_multiwriter_burst_survives_write_faults(echo_server):
+    """The wait-free MPSC write-stack enqueue path under injected write
+    faults (ISSUE 7 chaos satellite): N threads hammer ONE channel
+    socket while write:short truncates every drain to 1 byte and
+    write:err=EINTR/EAGAIN bounces the drainer into the KeepWrite
+    handoff. Concurrent pushes race the drainer's role-release CAS on
+    every call; the assertion is 100% exactly-once completion — a lost
+    node, a double drain, or wire reordering would fail/corrupt calls."""
+    ch = native.channel_open("127.0.0.1", echo_server)
+    native.fault_configure(
+        "seed=21;write:short:p=0.4;write:err=EINTR:p=0.1;"
+        "write:err=EAGAIN:p=0.1")
+    errs = []
+    done = [0] * 4
+
+    def writer(idx):
+        payload = b"w%d-" % idx + b"z" * 120
+        for _ in range(40):
+            rc, body, text = native.channel_call(
+                ch, "EchoService", "Echo", payload, timeout_ms=8000)
+            if rc != 0 or body != payload:
+                errs.append((idx, rc, text))
+                return
+            done[idx] += 1
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert done == [40] * 4, done
+    assert native.fault_injected() > 0
+    native.fault_configure("")
+    native.channel_close(ch)
+
+
+def test_multiwriter_socket_fail_mid_drain(echo_server):
+    """Write faults that KILL the socket mid-drain while a burst of
+    writers is still pushing (the release_all arm of the drain role):
+    every in-flight call must complete exactly once — as an error (the
+    fail_all sweep) or via retry on the re-dialed socket — and the
+    channel must come back clean once faults clear. Exercises the
+    drainer-exit vs fresh-push window the dsched `wstack` scenario
+    models, with real sockets dying under it."""
+    ch = native.channel_open("127.0.0.1", echo_server)
+    native.fault_configure("seed=33;write:err=EPIPE:p=0.03;"
+                           "write:short:p=0.3")
+    outcomes = []
+    lock = threading.Lock()
+
+    def writer(idx):
+        for i in range(30):
+            rc, body, _ = native.channel_call(
+                ch, "EchoService", "Echo", b"k%d-%d" % (idx, i),
+                timeout_ms=8000, max_retry=3)
+            with lock:
+                outcomes.append(rc)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(outcomes) == 120  # every call completed exactly once
+    native.fault_configure("")
+    # the channel recovers: the write stack of the dead socket was fully
+    # released (a leaked drain role would wedge every later call)
+    for _ in range(5):
+        rc, body, _ = native.channel_call(ch, "EchoService", "Echo",
+                                          b"post", timeout_ms=5000,
+                                          max_retry=2)
+        if rc == 0:
+            break
+    assert rc == 0 and body == b"post"
+    native.channel_close(ch)
+
+
 def test_backup_request_wins_after_dropped_primary(echo_server):
     """The backup-request lifecycle under an injected fault: the primary
     write VANISHES (write:drop@1), the backup timer re-sends the same

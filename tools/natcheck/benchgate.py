@@ -78,18 +78,40 @@ HEADLINE_LANES: Dict[str, float] = {
     "native_bulk_GBps": 0.30,
     "shm_desc_GBps": 0.30,
     "shm_desc_small_GBps": 0.50,
+    # multicore scaling efficiency (bench.py --cpus N): qps(2cpus) /
+    # qps(1cpu) from the pinned two-process lane. On the shared dev
+    # container the HOST's own parallel capacity swings 1.3-2.2x run
+    # over run (extra.scaling.host_parallel_x records it), so the band
+    # is wide; the absolute sublinear check below is the hard floor.
+    "cpus2_scaling_x": 0.35,
 }
+
+# Hard sublinear-scaling floor: when the host probe shows real parallel
+# headroom (host_parallel_x >= the MIN_HOST bar) and the runtime still
+# scales below MIN_X, that is a failing finding regardless of baseline —
+# a shared-state bottleneck reintroduced into the write/dispatch path,
+# exactly what ROADMAP item 1 forbids. On an overcommitted host (probe
+# below the bar) the check is moot: nothing can scale there.
+SCALING_ABS_MIN_X = 1.15
+SCALING_MIN_HOST_X = 1.6
 
 
 def extract_lanes(bench: dict) -> Dict[str, float]:
     """Headline lane values out of one bench.py result dict (transport
-    lanes live nested under extra.device_lanes)."""
+    lanes live nested under extra.device_lanes; the scaling ratio is
+    derived from the extra.scaling curve)."""
     lanes: Dict[str, float] = {}
     extra = bench.get("extra", {}) or {}
     device = extra.get("device_lanes", {}) or {}
     for key in HEADLINE_LANES:
         if key == "value":
             v = bench.get("value")
+        elif key == "cpus2_scaling_x":
+            scaling = extra.get("scaling", {}) or {}
+            q1, q2 = scaling.get("1"), scaling.get("2")
+            v = round(float(q2) / float(q1), 3) \
+                if isinstance(q1, (int, float)) and \
+                isinstance(q2, (int, float)) and q1 > 0 else None
         else:
             v = extra.get(key, device.get(key))
         if isinstance(v, (int, float)):
@@ -109,6 +131,7 @@ def make_artifact(bench: dict, round_n: int, rc: int = 0,
         "generated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                        time.gmtime()),
         "lanes": extract_lanes(bench),
+        "scaling": extra.get("scaling", {}),
         "rpcz_percentiles": extra.get("native_latency_us", {}),
         "nat_prof": extra.get("nat_prof", {}),
         "bench": bench,
@@ -127,7 +150,13 @@ def make_baseline(artifacts: List[dict], round_n: int) -> dict:
     floor: Dict[str, float] = {}
     for art in clean:
         for lane, v in (art.get("lanes") or {}).items():
-            if lane not in floor or float(v) < floor[lane]:
+            if lane.endswith("_scaling_x"):
+                # scaling ratios record the best ACHIEVED ratio (a
+                # crushed shared-host round would otherwise bake an
+                # unachievably-low scaling bar into the baseline)
+                if lane not in floor or float(v) > floor[lane]:
+                    floor[lane] = float(v)
+            elif lane not in floor or float(v) < floor[lane]:
                 floor[lane] = float(v)
     base["lanes"] = floor
     base["n"] = round_n
@@ -218,6 +247,19 @@ def compare(baseline: dict, current: dict) -> List[Finding]:
                 f"lane {lane!r} regressed {drop:.1f}%: {base_v:.1f} -> "
                 f"{cur_v:.1f} (tolerance band {tol * 100:.0f}%)"
                 + _profile_excerpt(current)))
+    # absolute sublinear-scaling floor (independent of any baseline):
+    # the host probe proved parallel headroom, the runtime didn't use it
+    scaling_x = cur_lanes.get("cpus2_scaling_x")
+    host_x = (current.get("scaling") or {}).get("host_parallel_x")
+    if isinstance(scaling_x, (int, float)) and \
+            isinstance(host_x, (int, float)) and \
+            host_x >= SCALING_MIN_HOST_X and scaling_x < SCALING_ABS_MIN_X:
+        findings.append(Finding(
+            "bench", "sublinear-scaling", where,
+            f"2-cpu scaling is {scaling_x:.2f}x while the host's own "
+            f"parallel capacity probe measured {host_x:.2f}x — the "
+            f"runtime left real cores idle (shared-state bottleneck in "
+            f"the write/dispatch path?)" + _profile_excerpt(current)))
     return findings
 
 
@@ -226,10 +268,14 @@ def run_bench(timeout_s: int = 2400) -> dict:
     artifact (rc recorded; the last stdout line is the result JSON)."""
     env = dict(os.environ)
     env["BRPC_TPU_BENCH_PROF"] = "1"
+    # scaling curve up to 2 cpus (or however many the host has): the
+    # cpus2_scaling_x lane + sublinear check need the {1,2} points
+    ncpus = min(2, len(os.sched_getaffinity(0)))
     try:
-        proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO_ROOT,
-                              capture_output=True, text=True, env=env,
-                              timeout=timeout_s)
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--cpus", str(ncpus)],
+            cwd=REPO_ROOT, capture_output=True, text=True, env=env,
+            timeout=timeout_s)
     except subprocess.TimeoutExpired:
         # a wedged bench is the failure class the gate exists to catch:
         # report it through the bench-failed contract, not a traceback
