@@ -221,6 +221,10 @@ def load() -> ctypes.CDLL:
         lib.nat_rpc_server_queue_deadline_ms.restype = ctypes.c_int
         lib.nat_rpc_server_inflight.restype = ctypes.c_int
         lib.nat_rpc_server_limit.restype = ctypes.c_int
+        # -- graceful quiesce/drain lifecycle (nat_quiesce.cpp) --
+        lib.nat_server_quiesce.argtypes = [ctypes.c_int]
+        lib.nat_server_quiesce.restype = ctypes.c_int
+        lib.nat_server_draining.restype = ctypes.c_int
         # -- deterministic fault injection (nat_fault.cpp) --
         lib.nat_fault_configure.argtypes = [ctypes.c_char_p]
         lib.nat_fault_configure.restype = ctypes.c_int
@@ -653,6 +657,24 @@ def rpc_server_inflight() -> int:
 def rpc_server_limit() -> int:
     """Effective concurrency limit (auto: the computed one); 0 = off."""
     return load().nat_rpc_server_limit()
+
+
+def server_quiesce(timeout_ms: int = 5000) -> int:
+    """Graceful quiesce of the running native server (the Server::Stop
+    (timeout)/Join lifecycle): stop accepting, lame-duck every live
+    connection per protocol (h2 GOAWAY, HTTP Connection: close, tpu_std
+    SHUTDOWN meta bit, RESP close-after-reply), drain admitted work
+    (incl. shm-worker in-flight) under the deadline while rejecting new
+    arrivals with ELIMIT/503/RESOURCE_EXHAUSTED, then close sockets only
+    once their write stacks are idle. Returns 0 (drained clean), 1
+    (deadline expired — stragglers were 503'd), -1 (no running server).
+    Call rpc_server_stop() afterwards."""
+    return load().nat_server_quiesce(timeout_ms)
+
+
+def server_draining() -> bool:
+    """True from quiesce start until the server stops/restarts."""
+    return bool(load().nat_server_draining())
 
 
 def channel_set_breaker(handle, enable: bool = True) -> int:

@@ -286,7 +286,10 @@ class Channel:
         with self._single_lock:
             if self._single_sid is not None:
                 sock = Socket.address(self._single_sid)
-                if sock is not None and not sock.failed():
+                # lame-duck (peer draining): fall through to the
+                # SocketMap, which hands out a FRESH shared connection —
+                # in-flight RPCs keep completing on the old one
+                if sock is not None and sock.usable_for_new_calls():
                     # health-check revival resets matched_protocol
                     self._pin_protocol(sock)
                     return sock, 0
@@ -340,6 +343,11 @@ class Channel:
     def _feed_circuit_breaker(self, sock: Socket, cntl: Controller):
         from brpc_tpu.rpc.circuit_breaker import CircuitBreaker
 
+        if getattr(sock, "lame_duck", False):
+            # planned drain: errors on a draining connection (ELIMIT
+            # rejections, the eventual close) are routine churn, not a
+            # health signal — no breaker sample
+            return
         with self._cb_lock:
             cb = self._circuit_breakers.get(sock.socket_id)
             if cb is None:

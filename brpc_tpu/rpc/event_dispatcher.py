@@ -51,6 +51,26 @@ class EventDispatcher:
     def remove_consumer(self, fd: int):
         self._enqueue(("remove", fd, None))
 
+    def remove_and_close(self, fd: int, fileobj):
+        """Unregister fd and close `fileobj` ON THE LOOP THREAD, in that
+        order. Closing on the caller thread races the loop two ways: the
+        selector keeps polling a closed fd until the queued remove
+        applies (OSError spin), and — worse — a new connection can reuse
+        the fd NUMBER, so the stale queued remove then unregisters the
+        new socket's consumer (the accept-vs-teardown race the native
+        runtime fixes with its deferred listener close). With the close
+        deferred behind the unregister on the one thread that touches
+        the selector, neither interleaving exists."""
+        if self._stop or self._thread is None or not self._thread.is_alive():
+            try:
+                fileobj.close()
+            except OSError:
+                pass
+            self._read_consumers.pop(fd, None)
+            self._write_consumers.pop(fd, None)
+            return
+        self._enqueue(("remove_close", fd, fileobj))
+
     def suspend_read(self, fd: int):
         """Stop delivering read events while a reader drains the fd —
         edge-trigger-and-rearm semantics over a level-triggered selector
@@ -109,7 +129,7 @@ class EventDispatcher:
                 elif kind == "add_write":
                     self._write_consumers[fd] = cb
                     self._reregister(fd)
-                elif kind == "remove":
+                elif kind in ("remove", "remove_close"):
                     self._read_consumers.pop(fd, None)
                     self._write_consumers.pop(fd, None)
                     self._suspended.discard(fd)
@@ -117,6 +137,11 @@ class EventDispatcher:
                         self._selector.unregister(fd)
                     except (KeyError, ValueError, OSError):
                         pass
+                    if kind == "remove_close":
+                        try:
+                            cb.close()  # cb slot carries the file object
+                        except OSError:
+                            pass
             except (ValueError, OSError):
                 # fd already closed — consumer cleanup races are benign
                 self._read_consumers.pop(fd, None)

@@ -347,7 +347,28 @@ def process_frames(msg: H2Message):
             with conn.lock:
                 conn.streams.pop(sid, None)
         elif ftype == F_GOAWAY:
-            sock.set_failed(errors.ECLOSE, "h2 goaway")
+            last_sid = goaway_err = 0
+            if len(payload) >= 8:
+                (last_sid,) = struct.unpack(">I", payload[:4])
+                last_sid &= 0x7FFFFFFF
+                (goaway_err,) = struct.unpack(">I", payload[4:8])
+            if conn.is_client and goaway_err == 0 and \
+                    hasattr(sock, "mark_lame_duck"):
+                # graceful drain (RFC 7540 §6.8): streams <= last_sid
+                # are still served — keep them completing here; refuse
+                # the rest (retryable) and stop opening new streams
+                sock.mark_lame_duck()
+                refused = []
+                with conn.lock:
+                    for rsid in [i for i in conn.streams if i > last_sid]:
+                        st = conn.streams.pop(rsid)
+                        if st.cid is not None:
+                            refused.append(st.cid)
+                for cid in refused:
+                    bthread_id.error(cid, errors.EFAILEDSOCKET,
+                                     "stream refused by GOAWAY")
+            else:
+                sock.set_failed(errors.ECLOSE, "h2 goaway")
 
 
 def _headers_dict(headers) -> Dict[str, str]:

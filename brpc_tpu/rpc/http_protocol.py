@@ -255,6 +255,21 @@ def on_packed(sock, cntl: Controller, correlation_id: int):
 
 def process_response(msg: HttpInputMessage):
     sock = msg.socket
+    # lame duck: a previously keep-alive server answering with
+    # Connection: close means it drains gracefully — new calls must
+    # select another connection while this response (and any pipelined
+    # predecessors) complete normally. The signal is the keep-alive ->
+    # close TRANSITION: a close-per-response server (HTTP/1.0, keepalive
+    # off) closes from its first response and must keep feeding the
+    # circuit breaker normally, not be treated as planned churn forever.
+    conn_close = (
+        msg.http.headers.get("connection", "").lower().find("close") >= 0)
+    if conn_close:
+        if (getattr(sock, "_http_saw_keepalive", False)
+                and hasattr(sock, "mark_lame_duck")):
+            sock.mark_lame_duck()
+    else:
+        sock._http_saw_keepalive = True
     q = getattr(sock, "_http_pipeline", None)
     if not q:
         return

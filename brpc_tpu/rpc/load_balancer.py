@@ -33,8 +33,13 @@ class ServerNode:
 
 
 def _alive(sid: int) -> bool:
+    # A lame-duck socket (peer draining gracefully) is NOT selectable for
+    # new calls — in-flight work completes on it, new work re-balances —
+    # but it is also not "failed": no breaker/recovery alarm fires, and
+    # health-check revival clears the flag when the peer returns.
     s = Socket.address(sid)
-    return s is not None and not s.failed()
+    return s is not None and not s.failed() and \
+        not getattr(s, "lame_duck", False)
 
 
 class LoadBalancer:

@@ -345,7 +345,17 @@ class NativeRuntimeMount:
             _time.sleep(0.1)
         lib.nat_shm_lane_enable(1)
 
-    def stop(self):
+    def stop(self, quiesce_timeout_ms: int = 0):
+        # Graceful quiesce FIRST, while the py lane and the shm workers
+        # are still serving: stop accepting, lame-duck every connection,
+        # drain admitted work (incl. shm-worker in-flight) under the
+        # deadline, reject new arrivals on the wire. Only then tear the
+        # serving machinery down.
+        if quiesce_timeout_ms > 0:
+            try:
+                native.load().nat_server_quiesce(quiesce_timeout_ms)
+            except Exception:
+                pass  # older .so without the export: abrupt stop
         self._stopping = True
         workers = getattr(self, "_shm_workers", None)
         if workers:

@@ -87,6 +87,17 @@ class ServerOptions:
     # the workers serve (must be importable in a fresh process).
     py_workers: int = 0
     py_worker_factory: str = ""
+    # Graceful shutdown (Server::Stop(timeout)/Join + the
+    # graceful_quit_on_sigterm flag of server.cpp): stop() quiesces the
+    # native runtime first — stop accepting, lame-duck every connection
+    # (h2 GOAWAY, HTTP Connection: close, tpu_std SHUTDOWN bit, RESP
+    # close-after-reply), drain admitted work (incl. shm workers) under
+    # this deadline with ELIMIT/503 rejections for new arrivals, close
+    # sockets only once flushed. <= 0 skips the drain (abrupt stop).
+    graceful_shutdown_timeout_ms: int = 5000
+    # SIGTERM becomes stop()+join()+exit(0): planned restarts (rolling
+    # deploys) drain instead of dropping in-flight work.
+    graceful_quit_on_sigterm: bool = False
 
 
 class Server:
@@ -210,6 +221,8 @@ class Server:
                 self.listen_endpoint = EndPoint(ep.ip, port)
                 self._started = True
                 self.start_time = time.time()
+                if self.options.graceful_quit_on_sigterm:
+                    self._install_sigterm_handler()
                 bvar.expose_default_variables()
                 return 0
             lfd = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
@@ -238,17 +251,46 @@ class Server:
             self._acceptor.start_accept(lfd)
             self._started = True
             self.start_time = time.time()
+            if self.options.graceful_quit_on_sigterm:
+                self._install_sigterm_handler()
         bvar.expose_default_variables()
         return 0
 
-    def stop(self) -> int:
-        """Graceful stop: no new connections, existing RPCs drain."""
+    def _install_sigterm_handler(self):
+        """graceful_quit_on_sigterm (server.cpp's flag): a planned
+        restart SIGTERM runs the full quiesce/drain lifecycle, then
+        exits 0. Only installable from the main thread; elsewhere the
+        embedder owns signal routing."""
+        import signal
+        import sys
+
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _on_sigterm(signum, frame):
+            self.stop()
+            self.join(5.0)
+            sys.exit(0)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):
+            pass
+
+    def stop(self, graceful: bool = True) -> int:
+        """Graceful stop (Server::Stop/Join, server.h:426-441): no new
+        connections, lame-duck signaling on live ones, existing RPCs
+        drain up to options.graceful_shutdown_timeout_ms, new arrivals
+        are rejected on the wire (never reset). graceful=False skips the
+        drain (the old abrupt behavior)."""
         with self._lock:
             if not self._started:
                 return -1
             self._started = False
+        timeout_ms = (self.options.graceful_shutdown_timeout_ms
+                      if graceful else 0)
         if getattr(self, "_native_mount", None) is not None:
-            self._native_mount.stop()
+            self._native_mount.stop(quiesce_timeout_ms=timeout_ms)
             self._native_mount = None
         if self._acceptor is not None:
             self._acceptor.stop_accept()

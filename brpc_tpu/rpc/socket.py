@@ -97,6 +97,12 @@ class Socket:
         self._epollout = threading.Event()
         self._reading = False
         self._reading_lock = threading.Lock()
+        # Lame duck (graceful server churn): the peer signaled it is
+        # draining — in-flight RPCs keep completing here, but selection
+        # (LB _usable, the single-connection reuse paths) must send NEW
+        # calls elsewhere, and the eventual close is a PLANNED removal
+        # (no circuit-breaker sample). Cleared by revive/_reset.
+        self.lame_duck = False
         self.on_edge_triggered_events: Optional[Callable[["Socket"], None]] = None
         self.user: Optional[SocketUser] = None
         self.health_check_interval_s: float = -1
@@ -169,6 +175,18 @@ class Socket:
 
     def failed(self) -> bool:
         return self._failed
+
+    def mark_lame_duck(self):
+        """The peer signaled graceful drain (tpu_std SHUTDOWN bit, h2
+        GOAWAY, HTTP Connection: close): finish in-flight work on this
+        connection, route new work elsewhere. Idempotent; NOT a failure
+        — in-flight correlation ids stay registered and complete."""
+        self.lame_duck = True
+
+    def usable_for_new_calls(self) -> bool:
+        """Healthy AND not draining: the selection predicate the LB and
+        the single-connection reuse paths share."""
+        return not self._failed and not self.lame_duck
 
     # -- connect -----------------------------------------------------------
     def connect(self, timeout_s: float = 1.0) -> int:
@@ -372,16 +390,23 @@ class Socket:
         self.error_text = error_text
         fd = self._fd
         if fd is not None:
+            closed = False
             try:
                 fdno = fd.fileno()
                 if fdno >= 0:
-                    get_global_dispatcher(fdno).remove_consumer(fdno)
+                    # unregister AND close on the loop thread, ordered:
+                    # a caller-side close would let the fd number be
+                    # reused under the selector / the stale queued
+                    # remove (the accept-vs-teardown race class)
+                    get_global_dispatcher(fdno).remove_and_close(fdno, fd)
+                    closed = True
             except OSError:
                 pass
-            try:
-                fd.close()
-            except OSError:
-                pass
+            if not closed:
+                try:
+                    fd.close()
+                except OSError:
+                    pass
             self._fd = None
         self._epollout.set()  # unblock KeepWrite
         # Fail queued writes and in-flight RPCs (socket.cpp SetFailed path).
@@ -462,6 +487,7 @@ class Socket:
 
     def _reset_keep_identity(self):
         self._failed = False
+        self.lame_duck = False  # a revived connection serves new calls
         self.error_code = 0
         self.error_text = ""
         self.read_portal = IOPortal()

@@ -62,7 +62,11 @@ class SocketMap:
             entry = self._map.get(key)
             if entry is not None:
                 sock = Socket.address(entry.sid)
-                if sock is not None and not sock.failed():
+                # a lame-duck socket (peer draining) is replaced like a
+                # failed one — but NOT recycled: its in-flight RPCs keep
+                # completing while new channels dial fresh
+                if sock is not None and not sock.failed() and \
+                        not getattr(sock, "lame_duck", False):
                     entry.refcount += 1
                     return entry.sid
                 del self._map[key]

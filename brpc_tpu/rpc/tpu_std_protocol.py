@@ -63,6 +63,50 @@ def pack_frame(meta, payload: bytes, attachment: IOBuf) -> IOBuf:
     return out
 
 
+def _meta_shutdown_bit(meta_bytes: bytes) -> bool:
+    """Lame-duck SHUTDOWN bit: top-level RpcMeta varint field 8 — our
+    native servers' graceful-drain signal. The field is not in
+    rpc_meta.proto (proto3 drops it silently), so scan the raw bytes
+    with a minimal tag walk."""
+    i, n = 0, len(meta_bytes)
+
+    def varint(i):
+        v = s = 0
+        while i < n:
+            b = meta_bytes[i]
+            i += 1
+            v |= (b & 0x7F) << s
+            if not b & 0x80:
+                return v, i
+            s += 7
+        return None, i
+
+    while i < n:
+        tag, i = varint(i)
+        if tag is None:
+            return False
+        field, wire = tag >> 3, tag & 7
+        if field == 8 and wire == 0:
+            v, i = varint(i)
+            return bool(v)
+        if wire == 0:
+            v, i = varint(i)
+            if v is None:
+                return False
+        elif wire == 2:
+            ln, i = varint(i)
+            if ln is None or i + ln > n:
+                return False
+            i += ln
+        elif wire == 1:
+            i += 8
+        elif wire == 5:
+            i += 4
+        else:
+            return False
+    return False
+
+
 def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
     """ParseRpcMessage analog (baidu_rpc_protocol.cpp:95-137)."""
     if len(portal) < HEADER_LEN:
@@ -85,6 +129,16 @@ def parse(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
         meta.ParseFromString(meta_bytes)
     except Exception:
         return ParseResult.error_()
+    # Lame-duck signal (graceful server churn): mark the socket draining
+    # — LB selection skips it, in-flight RPCs keep completing, and the
+    # eventual close is a planned removal. A correlation_id-0 control
+    # frame carries no call; rejection frames proceed normally (their
+    # cid completes with ELIMIT, which the retry path re-balances).
+    if meta.HasField("response") and _meta_shutdown_bit(meta_bytes):
+        try:
+            sock.mark_lame_duck()
+        except AttributeError:
+            pass  # shims without the flag (native raw lane)
     att_size = meta.attachment_size
     payload_size = body_size - meta_size - att_size
     if payload_size < 0:

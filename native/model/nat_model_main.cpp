@@ -21,6 +21,14 @@
 //            mid-flight producer: publish-under-lock means recovery can
 //            never observe a half-offered record; publishing outside
 //            the lock (--bug recover-late-publish) is caught
+//   quiesce  arm_close_after_drain vs the wstack drain-role release —
+//            the graceful-close Dekker pairing nat_server_quiesce's
+//            final pass stands on: a drain-vs-late-arrival or
+//            drain-vs-role-release race may delay the close, never lose
+//            it, and bytes pushed before the close always drain first;
+//            arming the flag AFTER the idle check (--bug
+//            quiesce-arm-late, the TOCTOU the store-then-check order
+//            forbids) loses the close and is caught
 //
 // A failing schedule prints the scenario, seed (random mode) or the
 // choice string (DFS), and the tail of the operation trace; re-running
@@ -554,6 +562,123 @@ bool recover_validate(std::string* why) {
   return true;
 }
 
+// ---- quiesce: arm_close_after_drain vs the drain-role release ----------
+//
+// The graceful-close Dekker pairing of nat_socket.cpp (the seam
+// nat_server_quiesce's final close pass stands on): the QUIESCER stores
+// close_after_drain, fences seq_cst, then loads the stack head
+// (write_idle); the DRAIN-ROLE holder stores the head (grab_more's CAS
+// to nullptr, releasing the role), fences seq_cst, then loads the flag.
+// At least one side must observe the other under every interleaving —
+// a drain-vs-late-arrival or drain-vs-role-release race may DELAY the
+// close but can never LOSE it, and every byte pushed before the close
+// is drained first. --bug quiesce-arm-late seeds the TOCTOU the
+// store-then-check order exists to forbid: checking idle BEFORE arming
+// the flag lets the role release in the window — the drainer sees the
+// flag unarmed, the quiescer saw the stack busy, and the close is LOST
+// (closed == 0 with an empty stack — caught by the validator).
+
+bool g_quiesce_bug = false;  // --bug quiesce-arm-late
+
+struct QuiesceState {
+  brpc_tpu::WStack<WsNode>* st = nullptr;
+  dsched::atomic<uint32_t>* armed = nullptr;
+  dsched::atomic<uint32_t>* closed = nullptr;
+  int drained = 0;  // role-serialized: only the drainer increments
+  static constexpr int kItems = 2;
+};
+QuiesceState* g_qst = nullptr;
+
+// set_failed is idempotent in the real code (failed.exchange); the
+// model counts closes and validates >= 1 (lost) and notes duplicates
+// are legal.
+void quiesce_close(QuiesceState* st) {
+  st->closed->fetch_add(1, std::memory_order_seq_cst);
+}
+
+// The flush_chain drain shape: gather values, then wrefill's
+// role-releasing grab_more; on release, the Dekker recheck of the
+// close flag (fence + seq_cst load).
+void quiesce_drain(QuiesceState* st, WsNode* r) {
+  while (true) {
+    if (r->val != 0) {
+      st->drained++;
+      r->val = 0;
+    }
+    WsNode* next = r->wnext.load(std::memory_order_acquire);
+    if (next != nullptr) {
+      delete r;
+      r = next;
+      continue;
+    }
+    WsNode* more = st->st->grab_more(r);
+    delete r;
+    if (more == nullptr) {
+      // role released: flush_chain's close_after_drain recheck
+      nat::atomic_thread_fence(std::memory_order_seq_cst);
+      if (st->armed->load(std::memory_order_seq_cst) != 0) {
+        quiesce_close(st);
+      }
+      return;
+    }
+    r = more;
+  }
+}
+
+void quiesce_body() {
+  g_qst = new QuiesceState();
+  QuiesceState* st = g_qst;
+  st->st = new brpc_tpu::WStack<WsNode>();
+  st->armed = new dsched::atomic<uint32_t>(0);
+  st->closed = new dsched::atomic<uint32_t>(0);
+  dsched::spawn([st] {  // late-arriving response writer + drainer
+    for (int i = 0; i < QuiesceState::kItems; i++) {
+      WsNode* n = new WsNode();
+      n->val = 1 + i;
+      if (st->st->push(n)) quiesce_drain(st, n);
+    }
+  });
+  if (!g_quiesce_bug) {
+    // the quiescer: arm_close_after_drain's exact shape — STORE the
+    // flag, seq_cst fence, THEN check idleness
+    st->armed->store(1, std::memory_order_seq_cst);
+    nat::atomic_thread_fence(std::memory_order_seq_cst);
+    if (st->st->empty()) quiesce_close(st);
+  } else {
+    // seeded TOCTOU: check idle FIRST, arm after — the drain role can
+    // release inside the window with the flag still unarmed
+    if (st->st->empty()) {
+      quiesce_close(st);
+    } else {
+      st->armed->store(1, std::memory_order_seq_cst);
+    }
+  }
+}
+
+bool quiesce_validate(std::string* why) {
+  QuiesceState* st = g_qst;
+  bool ok = true;
+  if (st->closed->load(std::memory_order_relaxed) == 0) {
+    *why = "close LOST: stack drained but neither the quiescer nor the "
+           "role release closed (missed Dekker pairing / late arm)";
+    ok = false;
+  } else if (!st->st->empty()) {
+    *why = "stack not empty after all producers exited";
+    ok = false;
+  } else if (st->drained != QuiesceState::kItems) {
+    *why = "a response pushed before the close was never drained ("
+           + std::to_string(st->drained) + " of " +
+           std::to_string(QuiesceState::kItems) + ")";
+    ok = false;
+  }
+  delete st->st;
+  delete st->armed;
+  delete st->closed;
+  delete st;
+  g_qst = nullptr;
+  return ok;
+}
+
 // ---- harness -----------------------------------------------------------
 
 struct Scenario {
@@ -576,6 +701,7 @@ const Scenario kScenarios[] = {
     {"arena", arena_body, arena_validate, 2500, 300, 3},
     {"butex", butex_body, butex_validate, 4000, 400, 4},
     {"recover", recover_body, recover_validate, 2500, 300, 3},
+    {"quiesce", quiesce_body, quiesce_validate, 4000, 400, 3},
 };
 
 int run_scenario(const Scenario& sc, dsched::Mode mode, uint64_t seed,
@@ -630,6 +756,7 @@ int main(int argc, char** argv) {
       std::string b = next();
       if (b == "butex-no-fence") g_butex_bug = true;
       else if (b == "recover-late-publish") g_recover_bug = true;
+      else if (b == "quiesce-arm-late") g_quiesce_bug = true;
       else {
         fprintf(stderr, "unknown --bug %s\n", b.c_str());
         return 2;
@@ -641,7 +768,7 @@ int main(int argc, char** argv) {
       fprintf(stderr,
               "usage: nat_model [--smoke] [--scenario NAME|all] "
               "[--mode dfs|random|both] [--seed N] [--execs N] "
-              "[--preempt N] [--bug butex-no-fence|recover-late-publish] "
+              "[--preempt N] [--bug butex-no-fence|recover-late-publish|quiesce-arm-late] "
               "[--list]\n");
       return 2;
     }

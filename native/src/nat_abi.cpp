@@ -200,6 +200,8 @@ int main() {
       NAT_SYM(nat_rpc_server_queue_deadline_ms),
       NAT_SYM(nat_rpc_server_inflight),
       NAT_SYM(nat_rpc_server_limit),
+      NAT_SYM(nat_server_quiesce),
+      NAT_SYM(nat_server_draining),
       NAT_SYM(nat_fault_configure),
       NAT_SYM(nat_fault_enabled),
       NAT_SYM(nat_fault_injected),

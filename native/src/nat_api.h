@@ -109,6 +109,16 @@ int nat_rpc_server_queue_deadline_ms(int ms);
 int nat_rpc_server_inflight(void);
 int nat_rpc_server_limit(void);
 
+// ---- graceful quiesce/drain lifecycle (nat_quiesce.cpp) ----
+// Three-phase Server::Stop(timeout): stop accepting, lame-duck every
+// connection per protocol (h2 GOAWAY, HTTP Connection: close, tpu_std
+// SHUTDOWN meta bit, RESP close-after-reply), drain admitted work under
+// the deadline with ELIMIT/503 rejections for new arrivals, close
+// sockets only once flushed. 0 = drained clean, 1 = deadline expired
+// (stragglers 503'd), -1 = no running server.
+int nat_server_quiesce(int timeout_ms);
+int nat_server_draining(void);
+
 // ---- deterministic fault injection (nat_fault.cpp) ----
 // spec grammar in nat_fault.h; also armed from the NAT_FAULT env var at
 // library load. NULL/"" clears. Same seed => same fault schedule.

@@ -194,6 +194,36 @@ void NatChannel::breaker_reset(bool revived) {
   if (revived && was_broken) nat_counter_add(NS_BREAKER_REVIVALS, 1);
 }
 
+// A peer signaled lame duck on `s` (SHUTDOWN meta bit, h2 GOAWAY, HTTP
+// Connection: close): detach the socket from the channel so NEW calls
+// dial a fresh connection (or re-balance at the LB layer) while
+// in-flight calls keep completing on the old one. A planned removal:
+// no breaker sample, no retry-budget burn, and — because the detached
+// socket's eventual death never enters the sock_id==id arm of
+// set_failed — no fail_all sweep and no health-check alarm.
+void channel_note_lame_duck(NatChannel* ch, NatSocket* s) {
+  if (ch == nullptr) return;
+  ch->lame_duck_ms.store((int64_t)(nat_now_ns() / 1000000ull),
+                         std::memory_order_relaxed);
+  uint64_t expect = s->id;
+  if (ch->sock_id.compare_exchange_strong(expect, 0,
+                                          std::memory_order_seq_cst)) {
+    nat_counter_add(NS_QUIESCE_DRAINING_REDIALS, 1);
+  }
+}
+
+// Connection: close from a NOT-previously-keep-alive connection (a
+// close-per-response backend, not a drain signal): detach so new calls
+// dial fresh — reusing the socket would race the server's FIN — but
+// WITHOUT the planned-churn classification: no draining window, no
+// NS_QUIESCE accounting, breaker/retry-budget sampling stays live.
+void channel_detach_socket(NatChannel* ch, NatSocket* s) {
+  if (ch == nullptr) return;
+  uint64_t expect = s->id;
+  ch->sock_id.compare_exchange_strong(expect, 0,
+                                      std::memory_order_seq_cst);
+}
+
 // Background revival of a failed channel connection (the health-check
 // thread role, health_check.cpp:146-237): re-dial every interval until
 // the channel closes or the connection is back. The dial can block up to
@@ -529,9 +559,18 @@ int nat_channel_call_full(void* h, const char* service, const char* method,
           ch->breaker_broken.load(std::memory_order_acquire)) {
         return kEFAILEDSOCKET;
       }
+      bool planned = ch->draining_recent();
       if (attempt++ < max_retry &&
           !ch->closed.load(std::memory_order_acquire) &&
-          take_retry_token(ch)) {
+          // planned churn (recent lame duck): re-dials toward the
+          // restarting peer don't spend the budget real failures need
+          (planned || take_retry_token(ch))) {
+        if (planned) {
+          // pace the redial so the retry window actually spans the
+          // peer's restart instead of burning attempts in microseconds
+          struct timespec ts = {0, 20 * 1000 * 1000};
+          nanosleep(&ts, nullptr);
+        }
         continue;  // the next channel_socket re-dials
       }
       return kEFAILEDSOCKET;
@@ -551,9 +590,15 @@ int nat_channel_call_full(void* h, const char* service, const char* method,
                           remaining_ms, backup_ms, resp_out, resp_len,
                           err_text_out);
     s->release();
-    if (rc != kEFAILEDSOCKET || attempt++ >= max_retry ||
+    // A drain-window ELIMIT from a lame-duck peer is PLANNED churn: the
+    // call retries (against the re-dialed/restarted peer) without
+    // spending the retry budget — graceful restarts must not eat the
+    // budget real failures need.
+    bool planned_retry = rc == kELIMIT && ch->draining_recent();
+    if ((rc != kEFAILEDSOCKET && !planned_retry) ||
+        attempt++ >= max_retry ||
         ch->closed.load(std::memory_order_acquire) ||
-        !take_retry_token(ch)) {
+        (!planned_retry && !take_retry_token(ch))) {
       return rc;
     }
     if (err_text_out != nullptr && *err_text_out != nullptr) {

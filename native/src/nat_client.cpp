@@ -49,6 +49,13 @@ struct HttpCliSessN {
   int status = 0;
   size_t body_left = 0;
   IOBuf body_acc;
+  // reading-thread only: a response WITHOUT Connection: close completed
+  // on this connection (keep-alive established). The lame-duck signal
+  // is the keep-alive -> close TRANSITION — a close-per-response server
+  // (HTTP/1.0, keepalive off) closes from its first response and must
+  // NOT be treated as draining, or it would permanently bypass the
+  // breaker/retry-budget sampling.
+  bool saw_keepalive = false;
 };
 
 static void http_cli_finish(PendingCall* pc);
@@ -208,6 +215,24 @@ int http_client_process(NatSocket* s) {
       conn_keepalive = cval.find("keep-alive") != std::string::npos;
     }
     bool close_delim_ok = conn_close || (http10 && !conn_keepalive);
+    if (conn_close && s->channel != nullptr) {
+      if (c->saw_keepalive) {
+        // lame-duck signal: a previously keep-alive server now closes
+        // after this response (the HTTP half of graceful quiesce).
+        // Detach so NEW calls re-dial; the pipelined FIFO keeps
+        // completing here, and the socket's eventual EOF is a planned
+        // removal (no breaker penalty).
+        channel_note_lame_duck(s->channel, s);
+      } else {
+        // close-per-response backend (HTTP/1.0 style): still detach —
+        // new calls must not race the coming FIN — but keep the
+        // channel OUT of the planned-churn window so the breaker and
+        // retry budget keep sampling it normally.
+        channel_detach_socket(s->channel, s);
+      }
+    } else if (!close_delim_ok && status / 100 != 1) {
+      c->saw_keepalive = true;
+    }
     size_t content_length = 0;
     bool has_cl = false, chunked = false;
     size_t clpos = hdrs.find("content-length:");
@@ -547,6 +572,50 @@ void h2c_fail_own_streams(NatSocket* s, int32_t code, const char* text) {
   h2c_complete_cids(ch, cids, code, text);
 }
 
+// HTTP twin of h2c_fail_own_streams: a DETACHED (lame-duck) http client
+// socket died — complete every call still waiting in its pipeline FIFO
+// as a PLANNED error (retryable, no breaker sample), so a drained
+// connection's stragglers never hang until their deadline. Called from
+// set_failed's detached arm (fail_all only covers the attached socket).
+void http_cli_fail_own(NatSocket* s, int32_t code, const char* text,
+                       bool teardown) {
+  HttpCliSessN* c = s->httpc;
+  NatChannel* ch = s->channel;
+  if (c == nullptr || ch == nullptr) return;
+  std::vector<int64_t> cids;
+  {
+    // blocking on the fiber path (fresh fiber stack, same discipline as
+    // h2c_fail_own_streams): a try-lock that loses to a sender mid-push
+    // would strand every OTHER pipelined cid in the FIFO until its RPC
+    // deadline — the exact hang this sweep exists to prevent. Teardown
+    // (scheduler stopped) keeps the try-lock: backing off beats wedging
+    // the exit path, and no fiber is left to contend anyway.
+    std::unique_lock g(c->httpc_mu, std::defer_lock);
+    if (teardown) {
+      if (!g.try_lock()) return;
+    } else {
+      g.lock();
+    }
+    while (!c->fifo.empty()) {
+      cids.push_back(c->fifo.front().cid);
+      c->fifo.pop_front();
+    }
+  }
+  for (int64_t cid : cids) {
+    PendingCall* pc = ch->take_pending(cid, /*ok=*/false,
+                                       /*planned=*/true);
+    if (pc == nullptr) continue;
+    pc->error_code = code;
+    pc->error_text = text;
+    if (pc->cb != nullptr) {
+      pc->cb(pc, pc->cb_arg);
+    } else {
+      pc->done.value.store(1, std::memory_order_release);
+      Scheduler::butex_wake(&pc->done, INT32_MAX);
+    }
+  }
+}
+
 // Teardown variant (set_failed with the scheduler stopped: no sweep
 // fiber possible, and no running thread can hold h2c_mu). try_lock on
 // purpose — it cannot deadlock, and if the lock is somehow contended
@@ -851,16 +920,16 @@ int h2_client_process(NatSocket* s, IOBuf* batch_out) {
         NatChannel* ch = s->channel;
         // detach this socket from the channel NOW: new calls dial a
         // fresh connection immediately instead of hard-failing for the
-        // whole drain window, while the permitted streams finish here
-        if (ch != nullptr) {
-          uint64_t expect = s->id;
-          ch->sock_id.compare_exchange_strong(expect, 0,
-                                              std::memory_order_seq_cst);
-        }
+        // whole drain window, while the permitted streams finish here.
+        // A GOAWAY drain is PLANNED churn: the detach counts a
+        // draining-redial and the refused-stream completions feed no
+        // breaker sample (channel_note_lame_duck + planned=true below).
+        if (ch != nullptr) channel_note_lame_duck(ch, s);
         for (int64_t cid : refused) {
-          PendingCall* pc = ch != nullptr
-                                ? ch->take_pending(cid, /*ok=*/false)
-                                : nullptr;
+          PendingCall* pc =
+              ch != nullptr
+                  ? ch->take_pending(cid, /*ok=*/false, /*planned=*/true)
+                  : nullptr;
           if (pc == nullptr) continue;
           pc->error_code = kEFAILEDSOCKET;
           pc->error_text = "stream refused by GOAWAY";

@@ -73,6 +73,8 @@ int site_by_name(const std::string& s) {
   if (s == "connect") return NF_CONNECT;
   if (s == "doorbell") return NF_DOORBELL;
   if (s == "worker") return NF_WORKER;
+  if (s == "accept") return NF_ACCEPT;
+  if (s == "shutdown") return NF_SHUTDOWN;
   return -1;
 }
 
@@ -113,6 +115,10 @@ bool action_supported(int site, int action) {
     case NF_WORKER:
       return action == NF_KILL || action == NF_STALL ||
              action == NF_DELAY;
+    case NF_ACCEPT:  // err breaks the accept burst; delay stalls the loop
+      return action == NF_ERR || action == NF_DELAY;
+    case NF_SHUTDOWN:  // err = forced drain-deadline expiry
+      return action == NF_ERR || action == NF_DELAY;
   }
   return false;
 }

@@ -39,6 +39,12 @@
 //   doorbell  drop | delay  (shm wakes express delay as a drop — the
 //                            consumer's bounded poll timeout IS the delay)
 //   worker    kill | stall | delay
+//   accept    err | delay   (err breaks this accept burst; delay stalls
+//                            the dispatcher loop before accept4 — the
+//                            accept-vs-teardown race window widener)
+//   shutdown  err | delay   (quiesce drain loop: err = forced drain-
+//                            deadline expiry NOW; delay stretches a
+//                            drain poll round)
 #pragma once
 
 #include <stdint.h>
@@ -54,6 +60,8 @@ enum NatFaultSite : int {
   NF_CONNECT,    // client dials (dial_nonblocking)
   NF_DOORBELL,   // shm futex wakes + ring poller wake_fn
   NF_WORKER,     // shm worker request takes
+  NF_ACCEPT,     // server accept4 (accept_loop)
+  NF_SHUTDOWN,   // quiesce drain polls (nat_server_quiesce)
   NF_SITE_COUNT,
 };
 

@@ -463,6 +463,10 @@ struct H2SessionN {
     std::string trailers;  // pre-framed trailer HEADERS (sent last)
   };
   std::deque<PendingSend> pending;
+  // highest client-initiated stream id seen (under mu): the
+  // last_stream_id a lame-duck GOAWAY promises to still serve
+  uint32_t max_client_sid = 0;
+  bool sent_goaway = false;  // quiesce emitted GOAWAY already (under mu)
   // CONTINUATION accumulation (reading thread only)
   uint32_t cont_sid = 0;
   bool cont_end_stream = false;
@@ -779,6 +783,7 @@ static bool h2_headers_complete(NatSocket* s, H2SessionN* h, uint32_t sid,
         h->streams.find(sid) == h->streams.end()) {
       return false;  // connection error: stream table full
     }
+    if (sid > h->max_client_sid) h->max_client_sid = sid;
     H2StreamN& st = h->streams[sid];
     if (st.headers_done) {
       // trailers on a request stream: append to the flat block, under
@@ -1031,6 +1036,43 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
 }
 
 void h2_session_free(H2SessionN* h) { delete h; }
+
+// Lame-duck GOAWAY (quiesce phase 2, RFC 7540 §6.8): NO_ERROR with
+// last_stream_id = the highest client stream seen — "I will finish
+// those; open new streams elsewhere". Clients with the PR-1 graceful-
+// GOAWAY handling detach and re-dial while in-flight streams complete.
+void h2_send_goaway(NatSocket* s) {
+  H2SessionN* h = s->h2;
+  if (h == nullptr) return;
+  std::string out;
+  {
+    std::lock_guard g(h->h2_mu);
+    if (h->sent_goaway) return;  // idempotent per session
+    h->sent_goaway = true;
+    static const char kDebug[] = "lame duck";
+    frame_header(&out, 8 + sizeof(kDebug) - 1, kFGoaway, 0, 0);
+    uint32_t last = h->max_client_sid;
+    out.push_back((char)((last >> 24) & 0x7f));
+    out.push_back((char)((last >> 16) & 0xff));
+    out.push_back((char)((last >> 8) & 0xff));
+    out.push_back((char)(last & 0xff));
+    out.append(4, '\x00');  // NO_ERROR
+    out.append(kDebug, sizeof(kDebug) - 1);
+    // write under h2_mu: GOAWAY must not interleave a response frame
+    IOBuf f;
+    f.append(out.data(), out.size());
+    s->write(std::move(f));
+  }
+}
+
+// Streams not yet answered (or flow-parked bytes) on this session?
+// (quiesce drain predicate)
+bool h2_session_busy(NatSocket* s) {
+  H2SessionN* h = s->h2;
+  if (h == nullptr) return false;
+  std::lock_guard g(h->h2_mu);
+  return !h->streams.empty() || !h->pending.empty();
+}
 
 // Shared primitives for the client lane (nat_client.cpp): the frame
 // emitter and a heap HpackDecoderN behind an opaque pointer so the

@@ -20,7 +20,10 @@ static constexpr size_t kMaxHeaderBytes = 64u << 10;
 static constexpr size_t kMaxBodyBytes = 512u << 20;
 
 struct HttpSessionN {
-  uint64_t next_req_seq = 1;  // reading thread only
+  // written by the reading thread only (relaxed RMW); the quiesce drain
+  // predicate and the lame-duck close read it from other threads, so it
+  // is atomic — the value is advisory there (settled by double-polls)
+  std::atomic<uint64_t> next_req_seq{1};
   // Response reorder window: responses (native or py) may complete out of
   // request order; only the response matching next_resp_seq is written,
   // later ones park. mu guards everything below (py pthreads + reading
@@ -40,6 +43,11 @@ struct HttpSessionN {
   // Expect: 100-continue — the interim response was already sent for the
   // request currently awaiting its body (reading thread only)
   bool continue_sent = false;
+  // Lame duck (server quiesce): every further response carries an
+  // injected "Connection: close" header, and the connection closes once
+  // the reorder window owes nothing — admitted pipelined requests all
+  // get their responses before the FIN (under http_mu).
+  bool lame_duck = false;
   // The reading thread is mid-round with possibly-unflushed responses
   // in its batch accumulator: py emissions must park instead of writing
   // directly, or a later seq could reach the write queue before the
@@ -59,6 +67,39 @@ int http_sniff(const char* p, size_t n) {
   return 0;
 }
 
+// Inject "Connection: close" right after the status line of a complete
+// serialized response (lame-duck signaling). Zero-copy for the body:
+// only the status line is rebuilt; the rest of the IOBuf moves over.
+static void http_inject_conn_close(IOBuf* resp) {
+  char head[256];
+  size_t n = resp->length() < sizeof(head) ? resp->length() : sizeof(head);
+  resp->copy_to(head, n);
+  if (n < 12 || memcmp(head, "HTTP/", 5) != 0) return;  // not a head
+  // don't double up an existing Connection header (responders that were
+  // told close_after already wrote one). Anchored to line start and
+  // bounded by the end of headers — a bare substring scan would match
+  // "Proxy-Connection:" or body bytes and suppress the injection (the
+  // client parser anchors the same way, nat_client.cpp).
+  for (size_t i = 0; i + 12 < n; i++) {
+    if (head[i] == '\r' && head[i + 1] == '\n' && head[i + 2] == '\r' &&
+        head[i + 3] == '\n') {
+      break;  // end of headers: the rest is body
+    }
+    if (head[i] == '\n' && (head[i + 1] == 'C' || head[i + 1] == 'c') &&
+        memcmp(head + i + 2, "onnection:", 10) == 0) {
+      return;
+    }
+  }
+  const char* nl = (const char*)memchr(head, '\n', n);
+  if (nl == nullptr) return;
+  size_t line_end = (size_t)(nl - head) + 1;  // includes the \n
+  IOBuf out;
+  resp->cut_into(&out, line_end);
+  out.append("Connection: close\r\n", 19);
+  out.append(std::move(*resp));
+  *resp = std::move(out);
+}
+
 // Write any now-in-order parked responses. Requires h->http_mu. Appends into
 // out (the caller writes outside the lock).
 static void http_emit_locked(NatSocket* s, HttpSessionN* h,
@@ -66,6 +107,7 @@ static void http_emit_locked(NatSocket* s, HttpSessionN* h,
   while (true) {
     auto it = h->parked.find(h->next_resp_seq);
     if (it == h->parked.end()) break;
+    if (h->lame_duck) http_inject_conn_close(&it->second.data);
     out->append(std::move(it->second.data));
     bool close = it->second.close;
     if (!close) {
@@ -82,6 +124,15 @@ static void http_emit_locked(NatSocket* s, HttpSessionN* h,
       *want_close = true;
       break;  // nothing after a close goes out
     }
+  }
+  // lame duck: once the window owes nothing (every admitted response
+  // went out), the connection closes — FIN after the last byte. The
+  // next_req_seq read races the reading thread by design; the close is
+  // re-evaluated on every later emission, so a miss here only delays.
+  if (h->lame_duck && h->parked.empty() &&
+      h->next_resp_seq ==
+          h->next_req_seq.load(std::memory_order_relaxed)) {
+    *want_close = true;
   }
 }
 
@@ -159,7 +210,10 @@ static void http_maybe_send_continue(HttpSessionN* h, bool expect_continue,
   if (!expect_continue || h->continue_sent) return;
   {
     std::lock_guard g(h->http_mu);
-    if (!h->parked.empty() || h->next_resp_seq != h->next_req_seq) return;
+    if (!h->parked.empty() || h->next_resp_seq !=
+        h->next_req_seq.load(std::memory_order_relaxed)) {
+      return;
+    }
   }
   batch_out->append("HTTP/1.1 100 Continue\r\n\r\n", 25);
   h->continue_sent = true;
@@ -337,7 +391,8 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
     }
     // dispatch
     uint64_t t_parse = nat_now_ns();  // head + body parsed
-    uint64_t seq = h->next_req_seq++;
+    uint64_t seq =
+        h->next_req_seq.fetch_add(1, std::memory_order_relaxed);
     h->continue_sent = false;  // this request is complete
     bool head_only = verb == "HEAD";
     std::string_view path = uri.substr(0, uri.find('?'));
@@ -445,6 +500,35 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
 }
 
 void http_session_free(HttpSessionN* h) { delete h; }
+
+// Lame-duck this HTTP session (quiesce phase 2): every further response
+// carries Connection: close; an idle session (nothing owed) closes
+// right away — a keep-alive FIN on an idle connection is routine for
+// any HTTP client.
+void http_session_lame_duck(NatSocket* s) {
+  HttpSessionN* h = s->http;
+  if (h == nullptr) return;
+  bool idle;
+  {
+    std::lock_guard g(h->http_mu);
+    h->lame_duck = true;
+    idle = h->parked.empty() &&
+           h->next_resp_seq ==
+               h->next_req_seq.load(std::memory_order_relaxed);
+  }
+  if (idle) s->arm_close_after_drain();
+}
+
+// Responses still owed on this session? (quiesce drain predicate; the
+// next_req_seq read races the reading thread — the caller's settled
+// double-poll absorbs it)
+bool http_session_busy(NatSocket* s) {
+  HttpSessionN* h = s->http;
+  if (h == nullptr) return false;
+  std::lock_guard g(h->http_mu);
+  return !h->parked.empty() ||
+         h->next_resp_seq != h->next_req_seq.load(std::memory_order_relaxed);
+}
 
 // End of a read round, called AFTER the round's batch accumulator was
 // flushed to the write queue: drain responses py responders parked

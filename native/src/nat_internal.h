@@ -183,6 +183,12 @@ struct NatSocket {
   // then shutdown sends FIN.
   std::atomic<bool> close_after_drain{false};
 
+  // The connection has carried at least one tpu_std frame: the quiesce
+  // lame-duck pass may speak tpu_std back on it (a SHUTDOWN control
+  // frame would poison any other protocol). Reading thread stores,
+  // quiesce scan reads — atomic for the cross-thread read only.
+  std::atomic<bool> spoke_tpu_std{false};
+
   // TLS (the Socket-level SSLState of socket.h:539-540): set when the
   // first record on a TLS-enabled server port sniffs as a handshake;
   // in_buf then holds PLAINTEXT only (read paths feed ciphertext through
@@ -288,6 +294,15 @@ class Dispatcher {
   // listen sockets: fd -> server
   NatMutex<kLockRankListen> listen_mu;
   std::unordered_map<int, NatServer*> listeners;
+  // Listener fds whose CLOSE is deferred to the loop thread: the loop
+  // may be inside accept_loop(fd) when a stop/quiesce tears the
+  // listener down — closing from the caller thread lets the fd number
+  // be recycled under a concurrently-running accept (the acceptor
+  // teardown race). remove_listener unregisters + parks the fd here;
+  // run() closes parked fds at the top of its next round, when no
+  // accept_loop on this loop can still reference them.
+  NatMutex<kLockRankDispClose> pend_close_mu;
+  std::vector<int> pend_close_fds;
   // per-loop io_uring instance (nullptr = epoll only); owned by g_rings
   RingListener* ring = nullptr;
   // observability (/vars nat_dispatcher_* rows): connections this loop
@@ -303,6 +318,9 @@ class Dispatcher {
   // recycled socket.
   void add_consumer(NatSocket* s);
   void add_listener(int fd, NatServer* srv);
+  // Unregister the listener and defer the fd close to the loop thread
+  // (see pend_close_fds). Safe from any thread; idempotent per fd.
+  void remove_listener(int fd);
 
   void run();
   void accept_loop(int listen_fd, NatServer* srv);
@@ -398,6 +416,60 @@ void admission_on_complete(uint64_t latency_ns, bool ok);
 // Server (re)start hygiene: zero the in-flight count.
 void overload_server_reset();
 
+// ---------------------------------------------------------------------------
+// graceful quiesce (nat_quiesce.cpp): the Server::Stop(timeout)/Join
+// lifecycle for the native runtime — stop accepting, lame-duck every
+// live connection per protocol, drain admitted work under a deadline,
+// reject new arrivals with the PR-5 ELIMIT/503/RESOURCE_EXHAUSTED wire
+// shapes (never a reset), then close sockets once their wstack is idle.
+// ---------------------------------------------------------------------------
+
+// Nonzero from quiesce start until the server is stopped/restarted: the
+// enqueue gate rejects new WORK arrivals while set (one relaxed load on
+// the hot path, colocated with the overload gate).
+extern std::atomic<uint32_t> g_draining;
+// Live kind-0 (tpu_std py-lane) work requests: created at enqueue,
+// retired by ~PyRequest — the tpu_std half of the drain predicate (the
+// HTTP/h2/RESP halves live in their session reorder windows).
+extern std::atomic<int64_t> g_tpu_work_live;
+// Reject one work request during the drain window: per-lane ELIMIT /
+// 503 / RESOURCE_EXHAUSTED wire response ("server draining"); tpu_std
+// rejections carry the SHUTDOWN meta bit so a client that missed the
+// lame-duck frame still learns to redial. Frees `r`. Defined in
+// nat_overload.cpp (shares the detached reject fiber).
+void drain_reject(PyRequest* r);
+
+// A py-lane request that represents admitted WORK (an RPC a client is
+// waiting on) as opposed to lifecycle chatter: the one predicate the
+// overload admitter, the drain enqueue gate, and the drain-deadline
+// straggler sweep all share — a new work kind added here gates/503s
+// everywhere at once.
+inline bool is_work_kind(int32_t kind) {
+  return kind == 0 || kind == 3 || kind == 4 || kind == 6;
+}
+
+// per-protocol lame-duck + drain-quiet hooks (each defined in its TU)
+void h2_send_goaway(NatSocket* s);        // GOAWAY(last client sid seen)
+bool h2_session_busy(NatSocket* s);       // streams/pending not yet quiet
+void http_session_lame_duck(NatSocket* s);// next response: Connection: close
+bool http_session_busy(NatSocket* s);     // responses still owed/parked
+void redis_session_lame_duck(NatSocket* s);// close once window drains
+bool redis_session_busy(NatSocket* s);    // replies still owed/parked
+// meta-only tpu_std control frame carrying the SHUTDOWN bit
+// (correlation_id 0) — the lame-duck signal on tpu_std connections.
+void build_shutdown_frame(IOBuf* out);
+// ELIMIT-class rejection that ALSO carries the SHUTDOWN bit (drain
+// window: reject + "redial elsewhere" in one frame).
+void build_reject_draining_frame(IOBuf* out, int64_t cid,
+                                 int32_t error_code, const char* text);
+// shm worker lane: no request is riding the rings right now
+bool shm_lane_inflight_empty();
+// client half: a peer signaled lame duck on `s` — detach it from the
+// channel (in-flight completes here, new calls re-dial/re-balance) with
+// no breaker penalty and no retry-budget burn. Defined nat_channel.cpp.
+void channel_note_lame_duck(NatChannel* ch, NatSocket* s);
+void channel_detach_socket(NatChannel* ch, NatSocket* s);
+
 struct PyRequest {
   int32_t kind = 0;
   uint64_t sock_id = 0;
@@ -443,12 +515,19 @@ struct PyRequest {
   uint64_t enqueue_ns = 0;
   bool admitted = false;
   bool admit_ok = true;
+  // quiesce drain accounting: this kind-0 request is counted in
+  // g_tpu_work_live until freed (responders free at respond-time, so
+  // liveness == "response not yet queued")
+  bool drain_counted = false;
   ~PyRequest() {
     ::free(big_payload);
     if (shm_slot >= 0) shm_req_span_release(this);
     if (admitted) {
       admission_on_complete(
           enqueue_ns != 0 ? nat_now_ns() - enqueue_ns : 0, admit_ok);
+    }
+    if (drain_counted) {
+      g_tpu_work_live.fetch_sub(1, std::memory_order_acq_rel);
     }
   }
 };
@@ -528,11 +607,26 @@ class NatServer {
   bool py_stopping = false;
 
   void enqueue_py(PyRequest* r) {
+    // graceful drain (nat_quiesce.cpp): after the lame-duck pass, new
+    // WORK arrivals are rejected with the overload wire shapes instead
+    // of dying with the socket — one relaxed load when not draining
+    if (g_draining.load(std::memory_order_relaxed) != 0 &&
+        is_work_kind(r->kind)) {
+      drain_reject(r);
+      return;
+    }
     // admission control (nat_overload.cpp): one relaxed load when off;
     // a rejected request already answered ELIMIT on the wire and is gone
     if (g_overload_on.load(std::memory_order_relaxed) != 0 &&
         !overload_admit(r)) {
       return;
+    }
+    // drain predicate bookkeeping for the tpu_std py lane: these live
+    // until the responder frees them, so a live count IS "responses
+    // still owed" (the other lanes count via their reorder windows)
+    if (r->kind == 0) {
+      r->drain_counted = true;
+      g_tpu_work_live.fetch_add(1, std::memory_order_acq_rel);
     }
     // counted AFTER the gate: kind 2 is a connection-drop control
     // message and admission-rejected requests never enter the lane —
@@ -734,6 +828,18 @@ class NatChannel {
   bool defer_writes_flag = false;
   std::atomic<bool> closed{false};
   std::atomic<bool> hc_pending{false};
+  // Lame-duck bookkeeping (graceful server churn): CLOCK_MONOTONIC ms of
+  // the last lame-duck signal from the peer. While recent, drain-window
+  // ELIMIT rejections are retried WITHOUT spending the retry budget and
+  // the planned socket death feeds no breaker sample — planned churn is
+  // routine, not failure.
+  std::atomic<int64_t> lame_duck_ms{0};
+
+  bool draining_recent() const {
+    int64_t t = lame_duck_ms.load(std::memory_order_relaxed);
+    return t != 0 &&
+           (int64_t)(nat_now_ns() / 1000000ull) - t < 10000;
+  }
   // Health-check re-dial backoff: the CURRENT chain's exponent (reset to
   // 0 when a chain starts and on revival, so the first retry stays fast;
   // only the single hc fiber advances it — atomic for the cross-thread
@@ -848,8 +954,12 @@ class NatChannel {
   // version) and double-completions lose the CAS and get nullptr.
   // `ok=false` marks an error completion (timeout, failed send, refused
   // stream): counted into nat_client_errors and kept OUT of the client
-  // latency histogram — a 30s timeout is not a round trip.
-  PendingCall* take_pending(int64_t cid, bool ok = true) {
+  // latency histogram — a 30s timeout is not a round trip. `planned`
+  // marks a completion caused by the peer's GRACEFUL drain (GOAWAY-
+  // refused stream, lame-duck retire): still an error to the caller,
+  // but not a breaker sample — planned churn must not isolate a peer.
+  PendingCall* take_pending(int64_t cid, bool ok = true,
+                            bool planned = false) {
     uint32_t idx = (uint32_t)cid & kIdxMask;
     if (idx >= nslots_.load(std::memory_order_acquire)) return nullptr;
     PendingCall* pc = slot_at(idx);
@@ -887,7 +997,8 @@ class NatChannel {
         // "ok" here may still be a server error frame / 5xx / grpc 8
       } else {
         nat_counter_add(NS_CLIENT_ERRORS, 1);
-        if (breaker_enabled.load(std::memory_order_relaxed)) {
+        if (!planned &&
+            breaker_enabled.load(std::memory_order_relaxed)) {
           breaker_on_call_end(false);
         }
       }
@@ -1095,6 +1206,10 @@ void h2_cli_free(H2CliSessN* c);
 // channel has already moved to a replacement — a channel-wide fail_all
 // would spuriously kill calls in flight on the new socket).
 void h2c_fail_own_streams(NatSocket* s, int32_t code, const char* text);
+// HTTP twin for a detached (lame-duck drained) http client socket:
+// complete the pipeline FIFO's remaining calls as planned errors.
+void http_cli_fail_own(NatSocket* s, int32_t code, const char* text,
+                       bool teardown = false);
 // Teardown variant (try_lock sweep): for set_failed when the scheduler
 // is stopped and no sweep fiber can run.
 void h2c_fail_own_streams_teardown(NatSocket* s, int32_t code,

@@ -48,6 +48,8 @@ enum : int {
   kLockRankShmResp = 22,      // g_resp_mu: worker-side response producer
   kLockRankRuntime = 30,      // g_rt_mu: runtime/server registry
   kLockRankListen = 34,       // Dispatcher::listen_mu
+  kLockRankDispClose = 35,    // Dispatcher::pend_close_mu: deferred
+                              // listener-fd closes (teardown-race fix)
   kLockRankReconnect = 36,    // NatChannel::reconnect_mu
   kLockRankHttpSess = 40,     // HttpSessionN::http_mu
   kLockRankH2Sess = 42,       // H2SessionN::h2_mu

@@ -11,16 +11,18 @@ namespace brpc_tpu {
 // Header + meta are encoded into ONE stack buffer and appended in a single
 // call (one memcpy into the TLS share block, zero allocations); oversized
 // error texts spill to a heap scratch, never truncate.
-void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
-                          const std::string& error_text, IOBuf&& payload,
-                          IOBuf&& attachment) {
-  nat_counter_add(NS_TPU_STD_RESPONSES_OUT, 1);
+static void build_response_frame_ex(IOBuf* out, int64_t cid,
+                                    int32_t error_code,
+                                    const std::string& error_text,
+                                    IOBuf&& payload, IOBuf&& attachment,
+                                    int shutdown) {
   size_t bound = 12 + response_meta_bound(error_text.size());
   char stack_buf[320];
   char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
   size_t mlen = encode_response_meta_to(buf + 12, error_code,
                                         error_text.data(), error_text.size(),
-                                        cid, (int64_t)attachment.length());
+                                        cid, (int64_t)attachment.length(),
+                                        shutdown);
   memcpy(buf, kMagicRpc, 4);
   wr_be32(buf + 4,
           (uint32_t)(mlen + payload.length() + attachment.length()));
@@ -29,6 +31,31 @@ void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
   if (buf != stack_buf) free(buf);
   out->append(std::move(payload));
   out->append(std::move(attachment));
+}
+
+void build_response_frame(IOBuf* out, int64_t cid, int32_t error_code,
+                          const std::string& error_text, IOBuf&& payload,
+                          IOBuf&& attachment) {
+  nat_counter_add(NS_TPU_STD_RESPONSES_OUT, 1);
+  build_response_frame_ex(out, cid, error_code, error_text,
+                          std::move(payload), std::move(attachment), 0);
+}
+
+// Drain-window rejection frame: an ELIMIT-class error carrying the
+// SHUTDOWN bit — the rejected client learns "redial elsewhere" even if
+// it missed the correlation_id-0 lame-duck frame.
+void build_reject_draining_frame(IOBuf* out, int64_t cid,
+                                 int32_t error_code, const char* text) {
+  nat_counter_add(NS_TPU_STD_RESPONSES_OUT, 1);
+  build_response_frame_ex(out, cid, error_code, text, IOBuf(), IOBuf(),
+                          /*shutdown=*/1);
+}
+
+// Meta-only lame-duck control frame (correlation_id 0, SHUTDOWN bit):
+// "finish in-flight on this connection, send new work elsewhere".
+void build_shutdown_frame(IOBuf* out) {
+  build_response_frame_ex(out, 0, 0, std::string(), IOBuf(), IOBuf(),
+                          /*shutdown=*/1);
 }
 
 void build_request_frame(IOBuf* out, int64_t cid, const std::string& service,
@@ -453,6 +480,17 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
     s->in_buf.pop_front(meta_size);
     size_t payload_size = body - meta_size - att_size;
     if (srv == nullptr && s->channel != nullptr) {
+      // lame-duck signal (SHUTDOWN meta bit): the peer is draining —
+      // detach this socket from the channel so new calls re-dial, keep
+      // in-flight completing here, and charge NOTHING to the breaker
+      // or the retry budget (planned churn is routine, not failure)
+      if (meta.shutdown) {
+        channel_note_lame_duck(s->channel, s);
+        if (meta.correlation_id == 0) {  // pure control frame: no call
+          s->in_buf.pop_front(payload_size + att_size);
+          continue;
+        }
+      }
       // client response: route FIRST, then land the bytes — a small
       // payload goes straight into the call slot's inline buffer (no
       // IOBuf, no block refs), and a stale/duplicate response costs
@@ -473,11 +511,14 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
         s->in_buf.cut_into(&pc->attachment, att_size);
       }
       // tpu_std verdict: error frames (incl. ELIMIT shed) count against
-      // the peer for the breaker and do not replenish the retry budget
+      // the peer for the breaker and do not replenish the retry budget.
+      // Drain-window rejections (shutdown bit) are PLANNED: no breaker
+      // sample either way.
       {
         bool call_ok = pc->error_code == 0;
         if (call_ok) s->channel->note_call_success();
-        if (s->channel->breaker_enabled.load(std::memory_order_relaxed)) {
+        if (!meta.shutdown &&
+            s->channel->breaker_enabled.load(std::memory_order_relaxed)) {
           s->channel->breaker_on_call_end(call_ok);
         }
       }
@@ -496,6 +537,11 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
     if (srv != nullptr) {
       srv->requests.fetch_add(1, std::memory_order_relaxed);
       nat_counter_add(NS_TPU_STD_MSGS_IN, 1);
+      // this connection speaks tpu_std: the quiesce lame-duck pass may
+      // answer it with a SHUTDOWN control frame (once is enough)
+      if (!s->spoke_tpu_std.load(std::memory_order_relaxed)) {
+        s->spoke_tpu_std.store(true, std::memory_order_relaxed);
+      }
       if (handler != nullptr) {
         uint64_t t_parse = nat_now_ns();  // meta decoded, payload cut
         NativeHandlerCtx ctx;

@@ -63,24 +63,34 @@ void overload_recompute_gate() {
 // responders take session/reorder-window locks — decoupling makes the
 // rejection path deadlock-free by construction no matter which lock the
 // rejecting thread holds (and keeps the static lockorder graph clean).
+enum : int { kRejLimit = 0, kRejDeadline = 1, kRejDraining = 2 };
+
 struct RejectCtx {
   int32_t kind;
   uint64_t sock_id;
   int64_t cid;
-  bool deadline;
+  int mode;  // kRej*
 };
 
 void overload_reject_fiber(void* raw) {
   RejectCtx* c = (RejectCtx*)raw;
-  const char* text =
-      c->deadline ? "queue deadline exceeded" : "max concurrency reached";
+  const char* text = c->mode == kRejDeadline ? "queue deadline exceeded"
+                     : c->mode == kRejDraining
+                         ? "server draining (lame duck)"
+                         : "max concurrency reached";
   switch (c->kind) {
     case 0: {  // tpu_std: a real ELIMIT frame on the wire
       NatSocket* s = sock_address(c->sock_id);
       if (s != nullptr) {
         IOBuf out;
-        build_response_frame(&out, c->cid, kELIMIT, text, IOBuf(),
-                             IOBuf());
+        if (c->mode == kRejDraining) {
+          // drain-window rejections carry the SHUTDOWN bit: the client
+          // learns to redial even if it missed the lame-duck frame
+          build_reject_draining_frame(&out, c->cid, kELIMIT, text);
+        } else {
+          build_response_frame(&out, c->cid, kELIMIT, text, IOBuf(),
+                               IOBuf());
+        }
         s->write(std::move(out));
         s->release();
       }
@@ -111,16 +121,13 @@ void overload_reject_fiber(void* raw) {
   delete c;
 }
 
-void emit_overload_reject(PyRequest* r, bool deadline) {
-  nat_counter_add(deadline ? NS_QUEUE_DEADLINE_DROPS : NS_ELIMIT_REJECTS,
+void emit_overload_reject(PyRequest* r, int mode) {
+  nat_counter_add(mode == kRejDeadline ? NS_QUEUE_DEADLINE_DROPS
+                                       : NS_ELIMIT_REJECTS,
                   1);
   Scheduler::instance()->spawn_detached(
       overload_reject_fiber,
-      new RejectCtx{r->kind, r->sock_id, r->cid, deadline});
-}
-
-bool is_work_kind(int32_t kind) {
-  return kind == 0 || kind == 3 || kind == 4 || kind == 6;
+      new RejectCtx{r->kind, r->sock_id, r->cid, mode});
 }
 
 }  // namespace
@@ -133,7 +140,7 @@ bool overload_admit(PyRequest* r) {
   int cur = g_adm_inflight.fetch_add(1, std::memory_order_acq_rel);
   if (limit > 0 && cur >= limit) {
     g_adm_inflight.fetch_sub(1, std::memory_order_acq_rel);
-    emit_overload_reject(r, /*deadline=*/false);
+    emit_overload_reject(r, kRejLimit);
     delete r;
     return false;
   }
@@ -148,11 +155,20 @@ bool overload_expired(const PyRequest* r, uint64_t now_ns) {
 }
 
 void overload_expire(PyRequest* r) {
-  emit_overload_reject(r, /*deadline=*/true);
+  emit_overload_reject(r, kRejDeadline);
   if (r->admitted) {
     r->admitted = false;  // expired work never feeds the limiter window
     admission_on_complete(0, false);
   }
+  delete r;
+}
+
+// Drain-window rejection (nat_quiesce.cpp's gate): same wire shapes as
+// overload shed, but the tpu_std frame also carries the SHUTDOWN bit so
+// the rejected client re-dials/re-balances instead of hammering a
+// draining peer.
+void drain_reject(PyRequest* r) {
+  emit_overload_reject(r, kRejDraining);
   delete r;
 }
 

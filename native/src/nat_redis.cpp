@@ -19,7 +19,9 @@ static constexpr size_t kMaxRedisArgs = 1024 * 1024;
 static constexpr size_t kMaxRedisCommandBytes = 64u << 20;
 
 struct RedisSessN {
-  uint64_t next_req_seq = 1;  // reading thread only
+  // written by the reading thread only (relaxed RMW); the quiesce drain
+  // predicate and the lame-duck close read it cross-thread (advisory)
+  std::atomic<uint64_t> next_req_seq{1};
   // A partial command's known minimum total size: skip re-copying the
   // buffer every read burst while a big bulk value trickles in
   // (reading thread only).
@@ -37,6 +39,9 @@ struct RedisSessN {
   // accumulator).
   uint64_t close_after_seq = 0;
   bool close_pending = false;  // drained mid-round; arm at round end
+  // Lame duck (server quiesce): close as soon as the reply window owes
+  // nothing — every admitted command answers before the FIN (under mu).
+  bool lame_duck = false;
 };
 
 // Arm close-after-drain NOW, with the recheck http_emit_response does:
@@ -50,6 +55,32 @@ static void redis_arm_close(NatSocket* s) {
 }
 
 void redis_session_free(RedisSessN* h) { delete h; }
+
+// Lame-duck this RESP session (quiesce phase 2): once every admitted
+// command's reply has drained through the ordered window, the
+// connection closes (reply first, FIN after). Idle sessions close now.
+void redis_session_lame_duck(NatSocket* s) {
+  RedisSessN* h = s->redis;
+  if (h == nullptr) return;
+  bool idle;
+  {
+    std::lock_guard g(h->redis_mu);
+    h->lame_duck = true;
+    idle = h->parked.empty() &&
+           h->next_resp_seq ==
+               h->next_req_seq.load(std::memory_order_relaxed);
+  }
+  if (idle) s->arm_close_after_drain();
+}
+
+// Replies still owed on this session? (quiesce drain predicate)
+bool redis_session_busy(NatSocket* s) {
+  RedisSessN* h = s->redis;
+  if (h == nullptr) return false;
+  std::lock_guard g(h->redis_mu);
+  return !h->parked.empty() ||
+         h->next_resp_seq != h->next_req_seq.load(std::memory_order_relaxed);
+}
 
 struct RedisStoreN {
   NatMutex<kLockRankRedisStore> store_mu;
@@ -101,6 +132,13 @@ static void redis_drain_locked(RedisSessN* h, std::string* out,
       *want_close = true;
     }
     h->next_resp_seq++;
+  }
+  // lame duck: window owes nothing — close after the last reply byte
+  // (the racy next_req_seq read is settled by the quiesce double-poll)
+  if (h->lame_duck && h->parked.empty() &&
+      h->next_resp_seq ==
+          h->next_req_seq.load(std::memory_order_relaxed)) {
+    *want_close = true;
   }
 }
 
@@ -431,7 +469,8 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
     consumed += pos;
     srv->requests.fetch_add(1, std::memory_order_relaxed);
     nat_counter_add(NS_REDIS_MSGS_IN, 1);
-    uint64_t seq = h->next_req_seq++;
+    uint64_t seq =
+        h->next_req_seq.fetch_add(1, std::memory_order_relaxed);
 
     // QUIT: +OK, then close once that reply has drained to the socket
     if (ieq(argv[0], "quit")) {

@@ -41,6 +41,12 @@ void Dispatcher::shutdown() {
   ssize_t rc = ::write(wake_fd, &one, 8);
   (void)rc;
   if (thread.joinable()) thread.join();
+  {
+    // the loop is gone: close anything it never got to
+    std::lock_guard g(pend_close_mu);
+    for (int fd : pend_close_fds) ::close(fd);
+    pend_close_fds.clear();
+  }
   ::close(wake_fd);
   ::close(epfd);
 }
@@ -66,8 +72,40 @@ void Dispatcher::add_listener(int fd, NatServer* srv) {
   epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
 }
 
+// Teardown-race-safe listener removal: unregister from epoll + the
+// listener map on the caller thread, but defer the CLOSE to the loop
+// thread — the loop may be inside accept_loop(fd) right now, and a
+// caller-side close would let the fd number be recycled under that
+// accept (a connect-flood during stop could then accept on a stranger's
+// fd). run() closes parked fds at the top of its next round, after any
+// in-flight accept burst on this loop has returned.
+void Dispatcher::remove_listener(int fd) {
+  {
+    std::lock_guard g(listen_mu);
+    if (listeners.erase(fd) == 0) return;  // already removed
+  }
+  epoll_ctl(epfd, EPOLL_CTL_DEL, fd, nullptr);
+  if (stop.load(std::memory_order_acquire) || !thread.joinable()) {
+    ::close(fd);  // loop gone: no accept can race; close inline
+    return;
+  }
+  {
+    std::lock_guard g(pend_close_mu);
+    pend_close_fds.push_back(fd);
+  }
+  uint64_t one = 1;
+  ssize_t rc = ::write(wake_fd, &one, 8);  // prompt close, not next 100ms
+  (void)rc;
+}
+
 void Dispatcher::accept_loop(int lfd, NatServer* srv) {
   while (true) {
+    // natfault accept site: err breaks this accept burst (the next
+    // EPOLLIN retries), delay stalls the loop before accept4 — widening
+    // the accept-vs-teardown window the deferred close protects.
+    NatFaultAct faa = NAT_FAULT_POINT(NF_ACCEPT);
+    if (faa.action == NF_DELAY) nat_fault_delay_ms(faa.delay_ms);
+    if (faa.action == NF_ERR) break;
     int cfd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
     if (cfd < 0) break;
     int one = 1;
@@ -95,6 +133,15 @@ void Dispatcher::run() {
   std::vector<Fiber*> wake_batch;      // fibers readied this round
   while (!stop.load(std::memory_order_acquire)) {
     int n = epoll_wait(epfd, events.data(), (int)events.size(), 100);
+    // deferred listener closes (remove_listener): the fds were already
+    // removed from epoll and the listener map, and any accept_loop on
+    // them ran on THIS thread in an earlier round — closing here can
+    // never race an accept
+    {
+      std::lock_guard g(pend_close_mu);
+      for (int fd : pend_close_fds) ::close(fd);
+      pend_close_fds.clear();
+    }
     if (n > 0) {
       // one event-delivering round: the per-loop gauge row and the
       // aggregate counter move together (the stats test relies on it)
@@ -287,6 +334,7 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
   nat_stats_register_gauge(NS_PY_QUEUE_DEPTH, py_queue_depth_gauge);
   overload_server_reset();  // stale admission tokens die with the old
                             // server; the limiter config itself persists
+  g_draining.store(0, std::memory_order_release);  // fresh server serves
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -1;
   int one = 1;
@@ -353,12 +401,15 @@ void nat_rpc_server_stop() {
     g_rpc_server = nullptr;
     // remove the listener in the same critical section that unpublishes
     // (the start path registers under g_rt_mu too, so no listener of a
-    // published server can be missed here)
-    epoll_ctl(g_disp->epfd, EPOLL_CTL_DEL, srv->listen_fd, nullptr);
-    std::lock_guard lg(g_disp->listen_mu);
-    g_disp->listeners.erase(srv->listen_fd);
+    // published server can be missed here). The fd CLOSE is deferred to
+    // the loop thread — see Dispatcher::remove_listener. A preceding
+    // nat_server_quiesce already tore the listener down (listen_fd -1).
+    if (srv->listen_fd >= 0) {
+      g_disp->remove_listener(srv->listen_fd);
+      srv->listen_fd = -1;
+    }
   }
-  ::close(srv->listen_fd);
+  g_draining.store(0, std::memory_order_release);
   // stop the python lane (wakes all waiters empty-handed)
   {
     std::lock_guard g(srv->py_mu);

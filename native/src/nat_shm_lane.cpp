@@ -739,6 +739,13 @@ bool push_to_some_worker(uint8_t kind, uint8_t flags, uint64_t sock_id,
 
 }  // namespace
 
+// Quiesce drain predicate (nat_quiesce.cpp): nothing riding the worker
+// rings right now — every offered request was answered or reaped.
+bool shm_lane_inflight_empty() {
+  std::lock_guard g(g_inflight_mu);
+  return g_inflight.empty();
+}
+
 // release hook for arena-backed PyRequests (declared in nat_internal.h,
 // called from ~PyRequest in whichever process owns the request)
 void shm_req_span_release(PyRequest* r) {

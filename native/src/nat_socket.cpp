@@ -263,6 +263,7 @@ void NatSocket::reset_for_reuse() {
   ssl_sess = nullptr;
   ssl_declined = false;
   close_after_drain.store(false, std::memory_order_relaxed);
+  spoke_tpu_std.store(false, std::memory_order_relaxed);
 }
 
 void NatSocket::set_failed() {
@@ -330,12 +331,17 @@ void NatSocket::set_failed() {
             [](void* raw) {
               NatSocket* s = (NatSocket*)raw;
               h2c_fail_own_streams(s, kEFAILEDSOCKET, "socket failed");
+              // lame-duck-drained HTTP socket: its pipeline FIFO's
+              // stragglers complete as planned errors, not hangs
+              http_cli_fail_own(s, kEFAILEDSOCKET, "connection drained");
               s->release();
             },
             this);
       } else {
         h2c_fail_own_streams_teardown(this, kEFAILEDSOCKET,
                                       "socket failed");
+        http_cli_fail_own(this, kEFAILEDSOCKET, "connection drained",
+                          /*teardown=*/true);
       }
     }
   }

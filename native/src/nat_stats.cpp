@@ -94,6 +94,10 @@ static const char* kCounterNames[NS_COUNTER_COUNT] = {
     "nat_wsq_steals",
     "nat_worker_parks",
     "nat_sqpoll_rings",
+    "nat_quiesce_lame_duck_sent",
+    "nat_quiesce_drained_ok",
+    "nat_quiesce_drain_deadline_drops",
+    "nat_quiesce_draining_redials",
 };
 
 static const char* kLaneNames[NL_LANE_COUNT] = {

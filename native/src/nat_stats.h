@@ -56,6 +56,13 @@ enum NatCounterId : int {
   NS_WSQ_STEALS,            // fiber runqueue steals (cross-core balance)
   NS_WORKER_PARKS,          // scheduler worker park attempts (idle shape)
   NS_SQPOLL_RINGS,          // gauge: io_uring rings running SQPOLL now
+  NS_QUIESCE_LAME_DUCK_SENT,// lame-duck signals emitted (GOAWAY / SHUTDOWN
+                            // bit / Connection: close / RESP close armed)
+  NS_QUIESCE_DRAINED_OK,    // quiesce drains that completed in deadline
+  NS_QUIESCE_DRAIN_DEADLINE_DROPS, // admitted requests 503'd at the
+                            // drain deadline (stragglers, never reset)
+  NS_QUIESCE_DRAINING_REDIALS, // client detaches from a lame-duck peer
+                            // (next call re-dials / re-balances)
   NS_COUNTER_COUNT,
 };
 

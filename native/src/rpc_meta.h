@@ -36,6 +36,12 @@ struct RpcMetaN {
   int32_t compress_type = 0;
   int64_t correlation_id = 0;
   int64_t attachment_size = 0;
+  // Lame-duck wire signal (top-level RpcMeta field 8, our extension of
+  // the tpu_std framing): a server entering graceful quiesce sets it on
+  // a correlation_id=0 control frame (and on drain-window rejections) —
+  // "finish what's in flight on this connection, send new work
+  // elsewhere". Unknown to older peers, which skip the field.
+  bool shutdown = false;
 };
 
 // ---- varint primitives ----
@@ -192,9 +198,12 @@ inline size_t response_meta_bound(size_t err_text_len) {
   return err_text_len + 48;
 }
 
+// `shutdown` != 0 appends the lame-duck bit (RpcMeta field 8) so a
+// drain-window rejection doubles as the redial signal.
 inline size_t encode_response_meta_to(char* buf, int32_t error_code,
                                       const char* err_text, size_t tlen,
-                                      int64_t cid, int64_t att_size) {
+                                      int64_t cid, int64_t att_size,
+                                      int shutdown = 0) {
   char* p = buf;
   size_t sub = 0;
   if (error_code != 0) sub += 1 + varint_len((uint64_t)error_code);
@@ -219,6 +228,10 @@ inline size_t encode_response_meta_to(char* buf, int32_t error_code,
   if (att_size != 0) {
     *p++ = (char)(5 << 3 | 0);
     p = raw_varint(p, (uint64_t)att_size);
+  }
+  if (shutdown != 0) {
+    *p++ = (char)(8 << 3 | 0);
+    *p++ = 1;
   }
   return (size_t)(p - buf);
 }
@@ -329,6 +342,12 @@ inline bool decode_meta(const char* data, size_t size, RpcMetaN* m) {
         uint64_t v;
         if (!get_varint(p, end, &v)) return false;
         m->attachment_size = (int64_t)v;
+        break;
+      }
+      case 8: {  // shutdown (lame-duck) bit
+        uint64_t v;
+        if (wire != 0 || !get_varint(p, end, &v)) return false;
+        m->shutdown = v != 0;
         break;
       }
       default:

@@ -288,6 +288,22 @@ long do_read(int fd) {
     assert any(f.rule == "fault-gate" for f in findings), findings
 
 
+def test_lint_flags_ungated_hook_at_new_sites(tmp_path):
+    # the quiesce/accept fault sites added with the graceful-drain
+    # lifecycle are gated like every other site: a direct table call at
+    # either site name must be flagged
+    findings = _lint_one(tmp_path, "hook_new.cpp", """
+#include "nat_fault.h"
+int do_accept() {
+  return brpc_tpu::nat_fault_hit(brpc_tpu::NF_ACCEPT).action;
+}
+int do_drain_poll() {
+  return brpc_tpu::nat_fault_hit(brpc_tpu::NF_SHUTDOWN).action;
+}
+""")
+    assert sum(1 for f in findings if f.rule == "fault-gate") == 2, findings
+
+
 def test_lint_gated_fault_hook_passes(tmp_path):
     # the sanctioned macro shape (and the definition site itself, which
     # lives in nat_fault.h and is exempt) must come back clean

@@ -56,7 +56,7 @@ def test_model_dfs_explores_shipped_tree_green():
     rc, out = model.build_and_run(
         args=("--mode", "dfs", "--execs", "600"))
     assert rc == 0, out
-    assert out.count("ok") >= 6, out
+    assert out.count("ok") >= 8, out  # incl. the quiesce scenario
 
 
 def test_model_catches_relaxed_order_wsq_bug(tmp_path):
@@ -96,3 +96,22 @@ def test_model_catches_recovery_late_publish():
         args=("--scenario", "recover", "--bug", "recover-late-publish"))
     assert rc != 0, out
     assert "refused fresh offer" in out or "FAIL" in out, out
+
+
+def test_model_catches_quiesce_late_arm():
+    # arming close_after_drain AFTER the idle check (the TOCTOU the
+    # store-then-check Dekker order forbids) must lose the close under
+    # some interleaving — the drain-vs-role-release race the quiesce
+    # scenario exists to pin down
+    rc, out = model.build_and_run(
+        args=("--scenario", "quiesce", "--bug", "quiesce-arm-late"))
+    assert rc != 0, out
+    assert "close LOST" in out, out
+
+
+def test_model_quiesce_clean():
+    # the shipped arm_close_after_drain pairing: close never lost, every
+    # response pushed before the close drained first
+    rc, out = model.build_and_run(args=("--scenario", "quiesce",))
+    assert rc == 0, out
+    assert "FAIL" not in out, out

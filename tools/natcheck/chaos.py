@@ -1,6 +1,6 @@
 """Chaos soak — fixed-seed fault-injection run (``tools/check.sh --chaos``).
 
-Two legs, each a Finding on failure:
+Three legs, each a Finding on failure:
 
 1. C smoke (uninstrumented ``nat_smoke``) under ``CHAOS_SPEC`` in the
    ``NAT_FAULT`` environment — the whole smoke (echo sync/async, http,
@@ -9,6 +9,13 @@ Two legs, each a Finding on failure:
 2. The pytest native matrix under the same spec, plus the dedicated
    fault/overload suites (which install their own destructive specs at
    runtime via ``nat_fault_configure`` and restore the env spec after).
+3. The ``churn`` round: the rolling-restart drill of
+   tests/test_graceful_shutdown.py (3 server processes restarted
+   round-robin under a client flood) with DESTRUCTIVE seeds armed in the
+   SERVER processes via ``CHURN_SPEC`` — random EPIPE on socket writes
+   plus a worker SIGKILL on every worker's 5th shm take. The assertion
+   is the graceful-degradation contract itself: zero failed requests
+   once retries settle, every SIGTERM'd server exits 0.
 
 ``CHAOS_SPEC`` deliberately uses only **semantics-preserving** faults:
 short reads/writes (every parser must stay incremental), EINTR on both
@@ -51,6 +58,10 @@ CHAOS_SPEC = ("seed=42;"
               "write:short:p=0.05;write:err=EINTR:p=0.02;"
               "connect:delay_ms=20:p=0.2;"
               "doorbell:drop:p=0.05")
+
+# The churn round's DESTRUCTIVE spec, armed only in the rolling-restart
+# drill's server processes (the test asserts recovery, not absence).
+CHURN_SPEC = "seed=42;write:err=EPIPE:p=0.002;worker:kill@5"
 
 # The native-lane matrix (the soak set) + the fault/overload suites.
 PYTEST_MATRIX = [
@@ -122,6 +133,36 @@ def _pytest_leg() -> Tuple[List[Finding], str]:
     return findings, out
 
 
+def _churn_leg() -> Tuple[List[Finding], str]:
+    """Seeded rolling-restart drill: servers run under CHURN_SPEC, the
+    client flood must settle with zero failures (the two-process churn
+    acceptance test of the graceful-drain lifecycle)."""
+    findings: List[Finding] = []
+    env = dict(os.environ)
+    env.pop("NAT_FAULT", None)  # the CLIENT side stays clean; servers
+    env["BRPC_TPU_CHURN_FAULT"] = CHURN_SPEC  # arm via the test hook
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             "tests/test_graceful_shutdown.py", "-q",
+             "-k", "churn or rolling_restart or sigterm",
+             "-p", "no:cacheprovider"],
+            capture_output=True, timeout=900, env=env, cwd=REPO_ROOT)
+    except subprocess.TimeoutExpired:
+        return [Finding("chaos", "churn-hang", "tests/",
+                        "churn round timed out (drain wedged?)")], \
+            "chaos churn: TIMED OUT"
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    if proc.returncode != 0:
+        tail = out.strip().splitlines()[-1] if out.strip() else "?"
+        findings.append(Finding(
+            "chaos", "churn", "tests/test_graceful_shutdown.py",
+            f"churn round rc={proc.returncode}: {tail}"))
+    return findings, out
+
+
 def run(write_log: bool = True) -> List[Finding]:
     findings: List[Finding] = []
     sections = []
@@ -132,6 +173,10 @@ def run(write_log: bool = True) -> List[Finding]:
     got, out = _pytest_leg()
     findings.extend(got)
     sections.append(("pytest native matrix under NAT_FAULT", out))
+    got, out = _churn_leg()
+    findings.extend(got)
+    sections.append(("churn round (rolling restart under %s)" %
+                     CHURN_SPEC, out))
 
     if write_log:
         with open(CHAOS_MD, "w", encoding="utf-8") as f:
@@ -142,8 +187,12 @@ def run(write_log: bool = True) -> List[Finding]:
                     "spec below armed via the\n`NAT_FAULT` environment; "
                     "the dedicated fault/overload suites additionally\n"
                     "install destructive specs at runtime and assert the "
-                    "recovery paths.\n\n")
-            f.write("Spec: `%s`\n\n" % CHAOS_SPEC)
+                    "recovery paths.\nThe churn round runs the "
+                    "rolling-restart drill (SIGTERM drain + failover)\n"
+                    "with the destructive churn spec armed in the server "
+                    "processes.\n\n")
+            f.write("Spec: `%s`\n" % CHAOS_SPEC)
+            f.write("Churn spec (server processes): `%s`\n\n" % CHURN_SPEC)
             f.write("Result: %s (%d finding(s), %.0fs)\n\n" %
                     ("CLEAN" if not findings else "FAILING",
                      len(findings), time.time() - t0))
