@@ -458,11 +458,20 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     import os as _os
 
     prof_attached = False
+    mu_prof_attached = False
     if _os.environ.get("BRPC_TPU_BENCH_PROF") == "1":
         try:
             prof_attached = native.prof_start(99) == 0
         except Exception:
             prof_attached = False
+        # contention flight recorder rides the same knob: every
+        # contended NatMutex wait in the loopback window is sampled
+        # (threshold 0 — the slow path only fires on contention, so the
+        # uncontended hot path cost is unchanged)
+        try:
+            mu_prof_attached = native.mu_prof_start(0, 1, 42) == 0
+        except Exception:
+            mu_prof_attached = False
 
     def _async_lane(port_, conns, window=256):
         """One async-windowed measurement; (qps, requests)."""
@@ -630,6 +639,23 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             native.prof_reset()
         except Exception:
             nat_prof = {}
+    # top lock-wait stacks of the loopback window (extra.contention):
+    # a lane regression caused by a lock reintroduced into the
+    # write/dispatch path arrives with the contended stack attached
+    contention = {}
+    if mu_prof_attached:
+        try:
+            native.mu_prof_stop()
+            collapsed = native.mu_prof_report(collapsed=True)
+            contention = {
+                "samples": native.mu_prof_samples(),
+                "ranks": sorted(native.mu_rank_stats(),
+                                key=lambda r: -r["wait_us"])[:16],
+                "collapsed": collapsed.splitlines()[:32],
+            }
+            native.mu_prof_reset()
+        except Exception:
+            contention = {}
 
     # device-transport bandwidth (the rdma_performance analog): tracked
     # round over round in the artifact. Runs AFTER the loopback lanes
@@ -705,6 +731,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             "bypass_ceiling_qps": round(bypass_qps, 1),
             "native_latency_us": native_latency_us,
             **({"nat_prof": nat_prof} if nat_prof else {}),
+            **({"contention": contention} if contention else {}),
             "device_lanes": device_lanes,
             **http_lanes,
             **redis_lanes,
@@ -770,6 +797,11 @@ def scaling_bench(max_cpus: int, seconds: float = 2.0,
     Artifact schema notes (ride as ``extra.scaling``):
       "1".."N"          qps at that cpu count
       cpu_sets          the exact server/client pin sets per point
+      disp_stats        per-point per-dispatcher rows from the SERVER
+                        process after the load ({sockets, wakeups,
+                        sqpoll} per loop via nat_disp_stat) — a
+                        sublinear-scaling finding arrives with the
+                        dispatcher-balance evidence attached
       host_parallel_x   pure-CPU capacity control: one pinned burner per
                         cpu vs one alone — the ceiling ANY workload can
                         scale to on this host (overcommitted containers
@@ -778,6 +810,7 @@ def scaling_bench(max_cpus: int, seconds: float = 2.0,
     a scaling-efficiency band against the committed baseline: sublinear
     scaling beyond tolerance fails the gate like any regression.
     """
+    import json as _json
     import os
     import subprocess
     import sys
@@ -788,7 +821,7 @@ def scaling_bench(max_cpus: int, seconds: float = 2.0,
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     server_script = (
-        "import os, sys\n"
+        "import json, os, sys\n"
         "os.sched_setaffinity(0, {server_cpus})\n"
         "sys.path.insert(0, '.')\n"
         "from brpc_tpu import native\n"
@@ -796,6 +829,11 @@ def scaling_bench(max_cpus: int, seconds: float = 2.0,
         " native_echo=True)\n"
         "print(port, flush=True)\n"
         "sys.stdin.readline()\n"
+        # per-dispatcher evidence for the scaling artifact: wakeup/
+        # SQPOLL/socket counts per loop AFTER the load, so a sublinear
+        # finding shows whether the loops were actually balanced
+        "print('DISP ' + json.dumps(native.dispatcher_stats()),"
+        " flush=True)\n"
         "native.rpc_server_stop()\n")
     client_script = (
         "import os, sys, ctypes\n"
@@ -864,6 +902,21 @@ def scaling_bench(max_cpus: int, seconds: float = 2.0,
         finally:
             try:
                 srv.stdin.close()
+                # the server answers the stdin EOF with one
+                # "DISP [...]" line of per-dispatcher counters, the
+                # balance evidence for this point; read it on a helper
+                # thread so a wedged server cannot hang the gate past
+                # the 15s bound below
+                def _read_disp(stream=srv.stdout, point=str(n)):
+                    for line in stream:
+                        if line.startswith("DISP "):
+                            out.setdefault("disp_stats", {})[point] = \
+                                _json.loads(line[5:])
+                            break
+
+                reader = threading.Thread(target=_read_disp, daemon=True)
+                reader.start()
+                reader.join(timeout=15)
                 srv.wait(timeout=15)
             except Exception:
                 srv.kill()

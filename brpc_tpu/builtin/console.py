@@ -139,13 +139,47 @@ def _health_handler(server, req):
 
 
 def _connections_handler(server, req):
-    """/connections (builtin/connections_service.cpp)."""
+    """/connections (builtin/connections_service.cpp): the Python socket
+    pool's table plus one row per live NATIVE socket — byte/message
+    counters with windowed per-second rates (bvar/window.py), write-stack
+    depth (unwritten bytes), sniffed protocol and owning dispatcher."""
     lines = ["remote_side          |socket_id          |state"]
     for sock in server.list_connections():
         lines.append(
             f"{str(sock.remote_side):21s}|{sock.socket_id:<19d}|"
             f"{'failed' if sock.failed() else 'ok'}"
         )
+    try:
+        from brpc_tpu import native
+        from brpc_tpu.bvar.native_vars import (
+            connection_rates,
+            prune_connection_windows,
+        )
+
+        rows = native.conn_snapshot() if native.available() else []
+    except Exception:
+        rows = []
+    if rows:
+        lines.append("")
+        lines.append("native sockets:")
+        lines.append(
+            "remote_side          |socket_id          |proto   |side  |"
+            "disp|in_bytes(/s)        |out_bytes(/s)       |in_msg  |"
+            "out_msg |rd_sys  |wr_sys  |unwritten")
+        prune_connection_windows(r["sock_id"] for r in rows)
+        for r in sorted(rows, key=lambda r: r["sock_id"]):
+            rates = connection_rates(r["sock_id"])
+            in_cell = f"{r['in_bytes']}({rates['in_Bps']:,.0f}/s)"
+            out_cell = f"{r['out_bytes']}({rates['out_Bps']:,.0f}/s)"
+            lines.append(
+                f"{r['remote'] or '?':21s}|{r['sock_id']:<19d}|"
+                f"{r['protocol']:8s}|"
+                f"{'srv' if r['server_side'] else 'cli':6s}|"
+                f"{r['disp_idx']:<4d}|"
+                f"{in_cell:<20s}|{out_cell:<20s}|"
+                f"{r['in_msgs']:<8d}|{r['out_msgs']:<8d}|"
+                f"{r['read_calls']:<8d}|{r['write_calls']:<8d}|"
+                f"{r['unwritten_bytes']}")
     return 200, "text/plain", "\n".join(lines) + "\n"
 
 

@@ -66,10 +66,43 @@ def thread_dump() -> str:
     return "\n".join(out) + "\n"
 
 
-# one /hotspots/native window at a time: a concurrent request's
-# stop/reset must not wipe another window's samples mid-flight (the
-# second request waits and then gets its own full window)
-_native_prof_lock = threading.Lock()
+class _ProfWindow:
+    """One profiler window at a time: the sample window (nat_prof's
+    SIGPROF aggregate, nat_mu_prof's contention aggregate) is a single
+    shared resource — a concurrent request's stop/reset would wipe
+    another window's samples mid-flight, so the SECOND request gets
+    503 + Retry-After instead of a corrupted/blocking collision.
+    Retry-After derives from the RUNNING window's remaining time (its
+    monotonic deadline), not the rejected request's own seconds."""
+
+    def __init__(self, clamp_max_s: float, busy_text: str):
+        self._lock = threading.Lock()
+        self._deadline = 0.0
+        self._clamp_max_s = clamp_max_s
+        self._busy_text = busy_text
+
+    def run(self, seconds: float, sample_fn):
+        if not self._lock.acquire(blocking=False):
+            remaining = self._deadline - time.monotonic()
+            retry_s = max(1, int(remaining) + 1)
+            return (503, "text/plain", self._busy_text,
+                    {"Retry-After": str(retry_s)})
+        try:
+            # mirror the sampler's own window clamp: the advertised
+            # Retry-After must reflect the window that actually runs,
+            # not a caller-supplied ?seconds=3600
+            seconds = max(0.1, min(self._clamp_max_s, seconds))
+            self._deadline = time.monotonic() + seconds
+            return 200, "text/plain", sample_fn(seconds)
+        finally:
+            self._lock.release()
+
+
+_native_prof_window = _ProfWindow(
+    30.0, "nat_prof busy: another /hotspots/native window is running\n")
+_contention_prof_window = _ProfWindow(
+    10.0, "nat_mu_prof busy: another /hotspots/contention window is "
+          "running\n")
 
 
 def sample_native(seconds: float = 1.0, hz: int = 99,
@@ -78,7 +111,8 @@ def sample_native(seconds: float = 1.0, hz: int = 99,
     sampler, native/src/nat_prof.cpp): samples every thread actually
     burning CPU — fiber workers, dispatcher loops, py-lane pthreads —
     with frame-pointer unwind through the C++ core, where the Python
-    sampler above only sees interpreter frames."""
+    sampler above only sees interpreter frames. Caller must hold
+    _native_prof_window (hotspots_handler serializes windows there)."""
     try:
         from brpc_tpu import native
 
@@ -87,20 +121,81 @@ def sample_native(seconds: float = 1.0, hz: int = 99,
     except Exception as e:
         return f"native runtime unavailable: {e}\n"
     seconds = max(0.1, min(30.0, seconds))
-    with _native_prof_lock:
-        rc = native.prof_start(hz)
-        owns = rc == 0
-        if rc == -2:
-            return "nat_prof: could not install SIGPROF handler/timer\n"
-        # rc == -1: a bench/embedder already runs the profiler — report
-        # the window without stealing ownership of start/stop/reset
-        time.sleep(seconds)
-        if owns:
-            native.prof_stop()
-        report = native.prof_report(collapsed=collapsed)
-        if owns:
-            native.prof_reset()
+    rc = native.prof_start(hz)
+    owns = rc == 0
+    if rc == -2:
+        return "nat_prof: could not install SIGPROF handler/timer\n"
+    # rc == -1: a bench/embedder already runs the profiler — report
+    # the window without stealing ownership of start/stop/reset
+    time.sleep(seconds)
+    if owns:
+        native.prof_stop()
+    report = native.prof_report(collapsed=collapsed)
+    if owns:
+        native.prof_reset()
     return report or "nat_prof: no samples (no native CPU burned?)\n"
+
+
+def sample_contention(seconds: float = 1.0, hz: int = 99) -> str:
+    """/hotspots/contention: the native NatMutex wait profile (nat_mu_prof
+    — collapsed stacks weighted by wait-us, leaf = "lock:<rank name>")
+    merged with the Python wait-frame sampler. The native sampler is
+    armed for exactly the window the Python sampler spends sleeping, so
+    both halves describe the same interval."""
+    from brpc_tpu.builtin import profilers
+
+    seconds = max(0.1, min(10.0, seconds))
+    native_mod = None
+    owns = False
+    try:
+        from brpc_tpu import native as native_mod  # type: ignore
+
+        if native_mod.available():
+            # sample every contended wait in the window (threshold 0);
+            # a bench/embedder already holding the window (rc == -1)
+            # keeps ownership — we still report it
+            owns = native_mod.mu_prof_start(0, 1, 42) == 0
+        else:
+            native_mod = None
+    except Exception:
+        native_mod = None
+    try:
+        py_report = profilers.contention_profile(seconds, hz)
+    except BaseException:
+        # disarm the native sampler we armed: leaving g_mu_on set would
+        # make every later window (and BRPC_TPU_BENCH_PROF bench) see
+        # rc == -1 and silently lose extra.contention until restart
+        if native_mod is not None and owns:
+            try:
+                native_mod.mu_prof_stop()
+                native_mod.mu_prof_reset_samples()
+            except Exception:
+                pass
+        raise
+    parts = []
+    if native_mod is not None:
+        try:
+            if owns:
+                native_mod.mu_prof_stop()
+            ranks = native_mod.mu_rank_stats()
+            parts.append("# native lock contention (nat_mu_prof: "
+                         "contended NatMutex waits, wait-us weighted)")
+            parts.append(native_mod.mu_prof_report(collapsed=True).rstrip())
+            if ranks:
+                parts.append("# per-rank wait totals since start/reset:")
+                for r in sorted(ranks, key=lambda r: -r["wait_us"]):
+                    parts.append(
+                        f"#   rank {r['rank']:>3d} {r['name']:<14s} "
+                        f"waits={r['waits']} wait_us={r['wait_us']}")
+            if owns:
+                # samples only: the per-rank totals ride /brpc_metrics
+                # as counters and must survive debug-page requests
+                native_mod.mu_prof_reset_samples()
+        except Exception as e:
+            parts.append(f"# native contention profiler failed: {e}")
+    parts.append("# python wait-frame profile")
+    parts.append(py_report.rstrip())
+    return "\n".join(parts) + "\n"
 
 
 def hotspots_handler(server, req):
@@ -116,14 +211,15 @@ def hotspots_handler(server, req):
         return 200, "text/plain", sample_cpu(seconds)
     if kind == "native":
         collapsed = req.query.get("flat", "") in ("", "0")
-        return 200, "text/plain", sample_native(seconds,
-                                                collapsed=collapsed)
+        # 503 + Retry-After on collision (regression: ISSUE 9 satellite)
+        return _native_prof_window.run(
+            seconds, lambda s: sample_native(s, collapsed=collapsed))
     if kind == "heap":
         return 200, "text/plain", profilers.heap_profile()
     if kind == "growth":
         return 200, "text/plain", profilers.growth_profile()
     if kind == "contention":
-        return 200, "text/plain", profilers.contention_profile(seconds)
+        return _contention_prof_window.run(seconds, sample_contention)
     if kind == "tpu":
         ctype, body = profilers.tpu_trace(seconds)
         return 200, ctype, body

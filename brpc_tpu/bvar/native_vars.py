@@ -26,21 +26,36 @@ _lock = threading.Lock()
 _registered = False
 _vars = []  # keep strong refs: exposed Variables must not be GC'd
 
-# one combined-snapshot call per dump, not one per counter: /vars and the
-# sampler tick read ~20 counters at once and each combine walks every cell
-_snap_cache = (0.0, None)
+class _TtlCache:
+    """0.25s-TTL cache over one native snapshot call: /vars, /brpc_metrics
+    and the sampler tick read many counters/rows per dump, and each
+    uncached fetch walks every native cell. A stale-read race just costs
+    a duplicate fetch (same as the pre-class tuple-swap discipline)."""
+
+    def __init__(self, fetch_name: str):
+        self._fetch_name = fetch_name  # brpc_tpu.native attribute
+        self._ts = 0.0
+        self._snap = None
+
+    def get(self):
+        now = time.monotonic()
+        if self._snap is None or now - self._ts > 0.25:
+            from brpc_tpu import native
+
+            self._snap = getattr(native, self._fetch_name)()
+            self._ts = now
+        return self._snap
+
+    def clear(self):
+        self._ts, self._snap = 0.0, None
+
+
+# one combined-snapshot call per dump, not one per counter
+_snap_cache = _TtlCache("stats_counters")
 
 
 def _snapshot() -> Dict[str, int]:
-    global _snap_cache
-    now = time.monotonic()
-    ts, snap = _snap_cache
-    if snap is None or now - ts > 0.25:
-        from brpc_tpu import native
-
-        snap = native.stats_counters()
-        _snap_cache = (now, snap)
-    return snap
+    return _snap_cache.get()
 
 
 class _CounterSource:
@@ -61,6 +76,168 @@ _NO_RATE = {"nat_py_queue_depth", "nat_spans_dropped",
             "nat_connections_accepted", "nat_sqpoll_rings"}
 
 _PCTS = (("p50", 0.50), ("p99", 0.99), ("p999", 0.999))
+
+# ---------------------------------------------------------------------------
+# native observatory (ISSUE 9): per-method stats, per-connection rows and
+# lock-contention totals surfaced as LABELED vars — each is one
+# PassiveStatus whose value is a {((label, value), ...): scalar} dict, the
+# MultiDimension shape dump_prometheus renders with escaped label values.
+# ---------------------------------------------------------------------------
+
+_method_cache = _TtlCache("method_stats")
+_conn_cache = _TtlCache("conn_snapshot")
+
+
+def _method_snapshot():
+    return _method_cache.get()
+
+
+def _conn_snapshot():
+    return _conn_cache.get()
+
+
+def _method_labels(row):
+    return (("lane", row["lane"]), ("method", row["method"]))
+
+
+def _method_dim(field: str):
+    return {_method_labels(r): r[field] for r in _method_snapshot()}
+
+
+def _method_p99_dim():
+    from brpc_tpu import native
+
+    lanes = {}
+    try:
+        lanes = {name: i for i, name in
+                 enumerate(native.stats_lane_names())}
+    except Exception:
+        pass
+    out = {}
+    for r in _method_snapshot():
+        li = lanes.get(r["lane"])
+        if li is None:
+            continue
+        out[_method_labels(r)] = round(
+            native.method_quantile(li, r["method"], 0.99) / 1e3, 1)
+    return out
+
+
+def _conn_labels(row):
+    return (("sock_id", row["sock_id"]), ("remote", row["remote"]),
+            ("protocol", row["protocol"]))
+
+
+def _conn_dim(field: str):
+    return {_conn_labels(r): r[field] for r in _conn_snapshot()}
+
+
+def _lock_dim(field: str):
+    from brpc_tpu import native
+
+    return {(("rank", r["rank"]), ("name", r["name"])): r[field]
+            for r in native.mu_rank_stats()}
+
+
+class _ClampedPerSecond(PerSecond):
+    """PerSecond over a native counter: monotonic except for
+    nat_stats_reset/mu_prof_reset (test/bench hygiene), which would
+    otherwise publish a large negative rate for up to one window."""
+
+    def get_value(self):
+        return max(0.0, float(super().get_value() or 0.0))
+
+
+class _KeyedCounterSource:
+    """PerSecond source over one keyed row's counter (a method's count, a
+    connection's byte counter) — missing keys read 0 so a recycled socket
+    window decays instead of raising."""
+
+    invertible = True
+
+    def __init__(self, snap_fn, key_fn, key, field):
+        self._snap_fn = snap_fn
+        self._key_fn = key_fn
+        self._key = key
+        self._field = field
+
+    def get_value(self) -> float:
+        for r in self._snap_fn():
+            if self._key_fn(r) == self._key:
+                return float(r[self._field])
+        return 0.0
+
+
+class _KeyedRates:
+    """Lazily-created PerSecond windows per key (bvar/window.py over the
+    native snapshots): rates(key, fields) returns {field: per-second}.
+    Windows for vanished keys are destroyed on the next prune."""
+
+    def __init__(self, snap_fn, key_fn, window_s: int = 10):
+        self._snap_fn = snap_fn
+        self._key_fn = key_fn
+        self._window_s = window_s
+        # guards _windows: rate() runs on concurrent request threads
+        # (/brpc_metrics scrapes) while prune() runs from /connections
+        # renders; unlocked, prune's iteration races rate's insert and
+        # a lost check-then-insert race leaks the loser's Sampler
+        self._mu = threading.Lock()
+        self._windows = {}  # (key, field) -> PerSecond
+
+    def rate(self, key, field) -> float:
+        with self._mu:
+            w = self._windows.get((key, field))
+            if w is None:
+                w = _ClampedPerSecond(
+                    _KeyedCounterSource(self._snap_fn, self._key_fn, key,
+                                        field),
+                    self._window_s)
+                self._windows[(key, field)] = w
+        return float(w.get_value() or 0.0)
+
+    def prune(self, live_keys):
+        with self._mu:
+            dead = [self._windows.pop(k)
+                    for k in list(self._windows) if k[0] not in live_keys]
+        for w in dead:  # destroy() talks to the collector; not under _mu
+            try:
+                w.destroy()
+            except Exception:
+                pass
+
+    def clear(self):
+        """Destroy every window (and its collector Sampler): without
+        this, reset_for_tests would orphan samplers that keep polling
+        the native snapshots once per second for the process lifetime."""
+        with self._mu:
+            dead = list(self._windows.values())
+            self._windows.clear()
+        for w in dead:
+            try:
+                w.destroy()
+            except Exception:
+                pass
+
+
+_method_rates = _KeyedRates(_method_snapshot,
+                            lambda r: (r["lane"], r["method"]))
+_conn_rates = _KeyedRates(_conn_snapshot, lambda r: r["sock_id"])
+
+
+def method_qps(lane: str, method: str) -> float:
+    """Windowed per-second call rate of one native method row."""
+    return _method_rates.rate((lane, method), "count")
+
+
+def connection_rates(sock_id: int):
+    """Windowed per-second byte rates of one native socket (the
+    /connections in/out rate columns)."""
+    return {"in_Bps": _conn_rates.rate(sock_id, "in_bytes"),
+            "out_Bps": _conn_rates.rate(sock_id, "out_bytes")}
+
+
+def prune_connection_windows(live_sock_ids):
+    _conn_rates.prune(set(live_sock_ids))
 
 
 def register_native_bvars() -> bool:
@@ -90,8 +267,8 @@ def register_native_bvars() -> bool:
                     lambda n=name: int(_snapshot().get(n, 0)), name))
             if name not in _NO_RATE and \
                     find_exposed(f"{name}_second") is None:
-                _vars.append(PerSecond(_CounterSource(name), 10,
-                                       f"{name}_second"))
+                _vars.append(_ClampedPerSecond(_CounterSource(name), 10,
+                                               f"{name}_second"))
         for idx, lane in enumerate(lanes):
             for suffix, q in _PCTS:
                 vname = f"nat_{lane}_latency_{suffix}_us"
@@ -103,6 +280,33 @@ def register_native_bvars() -> bool:
         # gauge triple per epoll/io_uring loop — connections owned now,
         # event-delivering wakeup rounds, SQPOLL on/off on its ring
         _register_dispatcher_rows()
+        # native observatory (ISSUE 9): labeled multi-dimension vars —
+        # per-method stats, per-connection counters and per-rank lock
+        # waits ride /brpc_metrics with {label="value"} rows (values
+        # escaped by dump_prometheus)
+        _LABELED = (
+            ("nat_method_count", lambda: _method_dim("count")),
+            ("nat_method_errors", lambda: _method_dim("errors")),
+            ("nat_method_concurrency",
+             lambda: _method_dim("concurrency")),
+            ("nat_method_max_concurrency",
+             lambda: _method_dim("max_concurrency")),
+            ("nat_method_qps",
+             lambda: {_method_labels(r):
+                      round(method_qps(r["lane"], r["method"]), 1)
+                      for r in _method_snapshot()}),
+            ("nat_method_latency_p99_us", _method_p99_dim),
+            ("nat_connection_in_bytes", lambda: _conn_dim("in_bytes")),
+            ("nat_connection_out_bytes", lambda: _conn_dim("out_bytes")),
+            ("nat_connection_unwritten_bytes",
+             lambda: _conn_dim("unwritten_bytes")),
+            ("nat_lock_contention_waits", lambda: _lock_dim("waits")),
+            ("nat_lock_contention_wait_us",
+             lambda: _lock_dim("wait_us")),
+        )
+        for vname, fn in _LABELED:
+            if find_exposed(vname) is None:
+                _vars.append(PassiveStatus(fn, vname))
         _registered = True
         return True
 
@@ -196,6 +400,32 @@ def native_status_lines(snap: Optional[Dict[str, int]] = None) -> List[str]:
     if any(snap.get(k, 0) for k in _OVERLOAD_KEYS):
         lines.append("  overload/faults: " + " ".join(
             f"{k[4:]}={snap.get(k, 0)}" for k in _OVERLOAD_KEYS))
+    # per-method table (the native MethodStatus rows, /status's
+    # per-method section for native-dispatched methods)
+    try:
+        rows = _method_snapshot()
+        lane_idx = {name: i for i, name in enumerate(lanes)}
+        for r in sorted(rows, key=lambda r: (r["lane"], r["method"])):
+            from brpc_tpu import native as _n
+
+            if not (r["count"] or r["concurrency"]
+                    or r["max_concurrency"]):
+                continue  # claimed but never used (the "(other)" rows)
+            li = lane_idx.get(r["lane"])
+            p50 = p99 = 0.0
+            if li is not None:
+                p50 = _n.method_quantile(li, r["method"], 0.50) / 1e3
+                p99 = _n.method_quantile(li, r["method"], 0.99) / 1e3
+            lines.append(
+                f"  method {r['method']} [{r['lane']}]: "
+                f"count={r['count']} "
+                f"qps={method_qps(r['lane'], r['method']):.1f} "
+                f"errors={r['errors']} "
+                f"concurrency={r['concurrency']} "
+                f"max_concurrency={r['max_concurrency']} "
+                f"latency_us: p50={p50:.1f} p99={p99:.1f}")
+    except Exception:
+        pass
     for idx, lane in enumerate(lanes):
         try:
             from brpc_tpu import native as _n
@@ -214,7 +444,7 @@ def native_status_lines(snap: Optional[Dict[str, int]] = None) -> List[str]:
 def reset_for_tests():
     """Drop registration state (the exposed vars stay hidden-on-GC) and
     zero the native cells."""
-    global _registered, _snap_cache
+    global _registered
     with _lock:
         for v in _vars:
             try:
@@ -225,12 +455,17 @@ def reset_for_tests():
             except Exception:
                 pass
         _vars.clear()
+        _method_rates.clear()
+        _conn_rates.clear()
         _registered = False
-        _snap_cache = (0.0, None)
+        _snap_cache.clear()
+        _method_cache.clear()
+        _conn_cache.clear()
     try:
         from brpc_tpu import native
 
         if native.available():
             native.stats_reset()
+            native.mu_prof_reset()
     except Exception:
         pass

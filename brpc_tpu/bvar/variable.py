@@ -123,6 +123,14 @@ def dump_exposed(filter_fn: Optional[Callable[[str], bool]] = None) -> List[Tupl
     return out
 
 
+def _prom_label_escape(val) -> str:
+    """Prometheus exposition-format label-value escaping: backslash,
+    double quote and newline must be escaped (method paths contain `/`
+    — legal as-is — and may contain `"`)."""
+    return (str(val).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def dump_prometheus() -> str:
     """Prometheus text exposition of all exposed scalar variables
     (builtin/prometheus_metrics_service.cpp equivalent)."""
@@ -138,7 +146,9 @@ def dump_prometheus() -> str:
             lines.append(f"# TYPE {metric} gauge")
             for labels, v in value.items():
                 if isinstance(v, (int, float)):
-                    label_s = ",".join(f'{k}="{val}"' for k, val in labels)
+                    label_s = ",".join(
+                        f'{k}="{_prom_label_escape(val)}"'
+                        for k, val in labels)
                     lines.append(f"{metric}{{{label_s}}} {v}")
     return "\n".join(lines) + "\n"
 

@@ -54,6 +54,52 @@ class NatSpanRec(ctypes.Structure):
     ]
 
 
+class NatMethodStatRow(ctypes.Structure):
+    """Mirror of nat_stats.h NatMethodStatRow — one per-method stats row
+    (count/errors/current+max concurrency; lane indexes the NL_* table)."""
+
+    _fields_ = [
+        ("count", ctypes.c_uint64),
+        ("errors", ctypes.c_uint64),
+        ("concurrency", ctypes.c_int64),
+        ("max_concurrency", ctypes.c_int64),
+        ("lane", ctypes.c_int32),
+        ("method", ctypes.c_char * 52),
+    ]
+
+
+class NatConnRow(ctypes.Structure):
+    """Mirror of nat_stats.h NatConnRow — one native /connections row."""
+
+    _fields_ = [
+        ("sock_id", ctypes.c_uint64),
+        ("in_bytes", ctypes.c_uint64),
+        ("out_bytes", ctypes.c_uint64),
+        ("in_msgs", ctypes.c_uint64),
+        ("out_msgs", ctypes.c_uint64),
+        ("read_calls", ctypes.c_uint64),
+        ("write_calls", ctypes.c_uint64),
+        ("unwritten_bytes", ctypes.c_uint64),
+        ("fd", ctypes.c_int32),
+        ("disp_idx", ctypes.c_int32),
+        ("server_side", ctypes.c_int32),
+        ("protocol", ctypes.c_char * 12),
+        ("remote", ctypes.c_char * 24),
+    ]
+
+
+class NatLockRankRow(ctypes.Structure):
+    """Mirror of nat_stats.h NatLockRankRow — always-on per-rank
+    contended-wait totals of the NatMutex slow path."""
+
+    _fields_ = [
+        ("waits", ctypes.c_uint64),
+        ("wait_us", ctypes.c_uint64),
+        ("rank", ctypes.c_int32),
+        ("name", ctypes.c_char * 20),
+    ]
+
+
 def _build() -> bool:
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
@@ -343,6 +389,37 @@ def load() -> ctypes.CDLL:
         lib.nat_stats_drain_spans.restype = ctypes.c_int
         lib.nat_stats_reset.restype = None
         lib.nat_stats_now_ns.restype = ctypes.c_uint64
+        # -- native observatory: per-method stats, /connections rows,
+        #    lock-contention profiler (ISSUE 9) --
+        lib.nat_method_stats.argtypes = [ctypes.POINTER(NatMethodStatRow),
+                                         ctypes.c_int]
+        lib.nat_method_stats.restype = ctypes.c_int
+        lib.nat_method_quantile.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                            ctypes.c_double]
+        lib.nat_method_quantile.restype = ctypes.c_double
+        lib.nat_conn_snapshot.argtypes = [ctypes.POINTER(NatConnRow),
+                                          ctypes.c_int]
+        lib.nat_conn_snapshot.restype = ctypes.c_int
+        lib.nat_mu_prof_start.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_uint64]
+        lib.nat_mu_prof_start.restype = ctypes.c_int
+        lib.nat_mu_prof_stop.restype = ctypes.c_int
+        lib.nat_mu_prof_running.restype = ctypes.c_int
+        lib.nat_mu_prof_samples.restype = ctypes.c_uint64
+        lib.nat_mu_prof_reset.restype = None
+        lib.nat_mu_prof_reset_samples.restype = None
+        lib.nat_mu_prof_report.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.nat_mu_prof_report.restype = ctypes.c_int
+        lib.nat_mu_rank_stats.argtypes = [ctypes.POINTER(NatLockRankRow),
+                                          ctypes.c_int]
+        lib.nat_mu_rank_stats.restype = ctypes.c_int
+        lib.nat_mu_rank_name.argtypes = [ctypes.c_int]
+        lib.nat_mu_rank_name.restype = ctypes.c_char_p  # static string
+        lib.nat_mu_contend_selftest.argtypes = [ctypes.c_int, ctypes.c_int,
+                                                ctypes.c_int]
+        lib.nat_mu_contend_selftest.restype = ctypes.c_uint64
         # -- trace context + in-process sampling profiler (nat_prof.cpp) --
         lib.nat_trace_set.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
         lib.nat_trace_set.restype = None
@@ -1076,6 +1153,151 @@ def stats_reset():
     """Zero every stat cell and forget undrained spans (test/bench
     hygiene only)."""
     load().nat_stats_reset()
+
+
+# -- native observatory (ISSUE 9) -------------------------------------------
+
+def method_stats() -> list:
+    """Per-method stats rows of the native MethodStatus table: one dict
+    per (lane, method) recorded at the native-handler call sites and the
+    shm worker emit path — {'lane', 'method', 'count', 'errors',
+    'concurrency', 'max_concurrency'}."""
+    lib = load()
+    lanes = stats_lane_names()
+    arr = (NatMethodStatRow * 128)()
+    n = lib.nat_method_stats(arr, 128)
+    out = []
+    for i in range(n):
+        r = arr[i]
+        out.append({
+            "lane": lanes[r.lane] if 0 <= r.lane < len(lanes)
+                    else str(r.lane),
+            "method": r.method.decode(errors="replace"),
+            "count": r.count,
+            "errors": r.errors,
+            # an in-flight end racing a stats_reset can briefly read -1
+            "concurrency": max(0, r.concurrency),
+            "max_concurrency": max(0, r.max_concurrency),
+        })
+    return out
+
+
+def method_quantile(lane: int, method: str, q: float) -> float:
+    """Latency quantile (ns) of one method's own log2 histogram."""
+    return load().nat_method_quantile(lane, method.encode(), q)
+
+
+def conn_snapshot() -> list:
+    """Native /connections rows: one dict per live native socket with
+    byte/message/syscall counters, unwritten (queued-not-yet-accepted)
+    bytes, sniffed protocol, peer address and owning dispatcher."""
+    lib = load()
+    # n == cap means the table may be truncated (the C export clamps to
+    # the caller's buffer): regrow so a thousand-backend fan-out shows
+    # every socket instead of a silently partial table
+    cap = 1024
+    while True:
+        arr = (NatConnRow * cap)()
+        n = lib.nat_conn_snapshot(arr, cap)
+        if n < cap:
+            break
+        cap *= 2
+    out = []
+    for i in range(n):
+        r = arr[i]
+        out.append({
+            "sock_id": r.sock_id,
+            "in_bytes": r.in_bytes,
+            "out_bytes": r.out_bytes,
+            "in_msgs": r.in_msgs,
+            "out_msgs": r.out_msgs,
+            "read_calls": r.read_calls,
+            "write_calls": r.write_calls,
+            "unwritten_bytes": r.unwritten_bytes,
+            "fd": r.fd,
+            "disp_idx": r.disp_idx,
+            "server_side": bool(r.server_side),
+            "protocol": r.protocol.decode(errors="replace"),
+            "remote": r.remote.decode(errors="replace"),
+        })
+    return out
+
+
+def mu_prof_start(threshold_us: int = 0, every: int = 1,
+                  seed: int = 42) -> int:
+    """Arm contended-NatMutex stack sampling: waits >= threshold_us are
+    rate-decimated to one in `every` (seeded, deterministic) and sampled
+    with a frame-pointer stack naming the contended lock site. 0 = ok,
+    -1 = already running (a bench/embedder owns the window)."""
+    return load().nat_mu_prof_start(threshold_us, every, seed)
+
+
+def mu_prof_stop() -> int:
+    """Stop sampling; accumulated contention samples stay reportable."""
+    return load().nat_mu_prof_stop()
+
+
+def mu_prof_running() -> bool:
+    return bool(load().nat_mu_prof_running())
+
+
+def mu_prof_samples() -> int:
+    return load().nat_mu_prof_samples()
+
+
+def mu_prof_reset():
+    """Forget sampled stacks AND the always-on per-rank wait totals."""
+    load().nat_mu_prof_reset()
+
+
+def mu_prof_reset_samples():
+    """Forget sampled stacks only; the per-rank wait totals stay
+    monotonic (they are exported as Prometheus counters)."""
+    load().nat_mu_prof_reset_samples()
+
+
+def mu_prof_report(collapsed: bool = True) -> str:
+    """Contention profile: collapsed stacks weighted by wait-us
+    (default; leaf frame = "lock:<rank name>") or a flat wait-us table
+    per contended lock site."""
+    lib = load()
+    out = ctypes.c_char_p()
+    n = ctypes.c_size_t(0)
+    rc = lib.nat_mu_prof_report(1 if collapsed else 0, ctypes.byref(out),
+                                ctypes.byref(n))
+    if rc != 0 or not out:
+        return ""
+    try:
+        return ctypes.string_at(out, n.value).decode(errors="replace")
+    finally:
+        lib.nat_buf_free(out)
+
+
+def mu_rank_stats() -> list:
+    """Always-on per-rank contended-wait totals (independent of
+    sampling): [{'rank', 'name', 'waits', 'wait_us'}, ...]."""
+    lib = load()
+    arr = (NatLockRankRow * 128)()
+    n = lib.nat_mu_rank_stats(arr, 128)
+    return [{"rank": arr[i].rank,
+             "name": arr[i].name.decode(errors="replace"),
+             "waits": arr[i].waits,
+             "wait_us": arr[i].wait_us} for i in range(n)]
+
+
+def mu_rank_name(rank: int):
+    """Human name of a NatMutex lock rank, or None when unnamed (the
+    drift test asserts every nat_lockrank.h constant resolves)."""
+    nm = load().nat_mu_rank_name(rank)
+    return nm.decode() if nm is not None else None
+
+
+def mu_contend_selftest(nthreads: int = 4, iters: int = 100,
+                        hold_us: int = 20) -> int:
+    """Deterministic contention generator (tests): N threads fight over
+    one declared-rank NatMutex; returns that rank's contended-wait
+    count so far."""
+    return load().nat_mu_contend_selftest(nthreads, iters, hold_us)
 
 
 # Python-side shadow of the C-side thread-local trace context (the

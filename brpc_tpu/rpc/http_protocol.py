@@ -123,12 +123,23 @@ def process_request(msg: HttpInputMessage):
         name = parts[0] if parts else "index"
         handler = handlers.get(name)
         if handler is not None:
+            extra_headers = None
             try:
-                status, ctype, body = handler(server, req)
+                out = handler(server, req)
+                # handlers may return (status, ctype, body) or a 4-tuple
+                # with extra response headers (e.g. Retry-After on the
+                # busy-profiler 503)
+                if len(out) == 4:
+                    status, ctype, body, extra_headers = out
+                else:
+                    status, ctype, body = out
             except Exception as e:
                 status, ctype, body = 500, "text/plain", f"handler raised: {e}"
             resp.status_code = status
             resp.set_body(body, ctype)
+            if extra_headers:
+                for hk, hv in extra_headers.items():
+                    resp.headers.set(hk, hv)
             return _respond(sock, resp, close)
     # bad_method page (builtin/bad_method_service.cpp): a known service
     # with a missing/wrong method lists what IS callable

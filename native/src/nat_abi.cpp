@@ -52,6 +52,9 @@ NAT_TY(unsigned long long, "u64");
 NAT_TY(float, "f32");
 NAT_TY(double, "f64");
 NAT_TY(brpc_tpu::NatSpanRec, "struct:NatSpanRec");
+NAT_TY(brpc_tpu::NatMethodStatRow, "struct:NatMethodStatRow");
+NAT_TY(brpc_tpu::NatConnRow, "struct:NatConnRow");
+NAT_TY(brpc_tpu::NatLockRankRow, "struct:NatLockRankRow");
 #undef NAT_TY
 
 template <typename T>
@@ -122,6 +125,9 @@ int main() {
   // removed/renamed field breaks this build, a reorder changes offsets, an
   // added field changes sizeof — all surface as manifest/ctypes diffs.
   printf("  \"structs\": {\n");
+  using brpc_tpu::NatConnRow;
+  using brpc_tpu::NatLockRankRow;
+  using brpc_tpu::NatMethodStatRow;
   using brpc_tpu::NatSpanRec;
 #define NAT_FIELD(S, F) \
   FieldRow { #F, offsetof(S, F), sizeof(S::F), Ty<decltype(S::F)>::get() }
@@ -140,6 +146,41 @@ int main() {
                    NAT_FIELD(NatSpanRec, req_bytes),
                    NAT_FIELD(NatSpanRec, resp_bytes),
                    NAT_FIELD(NatSpanRec, method),
+               },
+               false);
+  print_struct("NatMethodStatRow", sizeof(NatMethodStatRow),
+               {
+                   NAT_FIELD(NatMethodStatRow, count),
+                   NAT_FIELD(NatMethodStatRow, errors),
+                   NAT_FIELD(NatMethodStatRow, concurrency),
+                   NAT_FIELD(NatMethodStatRow, max_concurrency),
+                   NAT_FIELD(NatMethodStatRow, lane),
+                   NAT_FIELD(NatMethodStatRow, method),
+               },
+               false);
+  print_struct("NatConnRow", sizeof(NatConnRow),
+               {
+                   NAT_FIELD(NatConnRow, sock_id),
+                   NAT_FIELD(NatConnRow, in_bytes),
+                   NAT_FIELD(NatConnRow, out_bytes),
+                   NAT_FIELD(NatConnRow, in_msgs),
+                   NAT_FIELD(NatConnRow, out_msgs),
+                   NAT_FIELD(NatConnRow, read_calls),
+                   NAT_FIELD(NatConnRow, write_calls),
+                   NAT_FIELD(NatConnRow, unwritten_bytes),
+                   NAT_FIELD(NatConnRow, fd),
+                   NAT_FIELD(NatConnRow, disp_idx),
+                   NAT_FIELD(NatConnRow, server_side),
+                   NAT_FIELD(NatConnRow, protocol),
+                   NAT_FIELD(NatConnRow, remote),
+               },
+               false);
+  print_struct("NatLockRankRow", sizeof(NatLockRankRow),
+               {
+                   NAT_FIELD(NatLockRankRow, waits),
+                   NAT_FIELD(NatLockRankRow, wait_us),
+                   NAT_FIELD(NatLockRankRow, rank),
+                   NAT_FIELD(NatLockRankRow, name),
                },
                true);
 #undef NAT_FIELD
@@ -253,6 +294,19 @@ int main() {
       NAT_SYM(nat_stats_drain_spans),
       NAT_SYM(nat_stats_reset),
       NAT_SYM(nat_trace_set),
+      NAT_SYM(nat_method_stats),
+      NAT_SYM(nat_method_quantile),
+      NAT_SYM(nat_conn_snapshot),
+      NAT_SYM(nat_mu_prof_start),
+      NAT_SYM(nat_mu_prof_stop),
+      NAT_SYM(nat_mu_prof_running),
+      NAT_SYM(nat_mu_prof_samples),
+      NAT_SYM(nat_mu_prof_reset),
+      NAT_SYM(nat_mu_prof_reset_samples),
+      NAT_SYM(nat_mu_prof_report),
+      NAT_SYM(nat_mu_rank_stats),
+      NAT_SYM(nat_mu_rank_name),
+      NAT_SYM(nat_mu_contend_selftest),
       NAT_SYM(nat_prof_start),
       NAT_SYM(nat_prof_stop),
       NAT_SYM(nat_prof_running),

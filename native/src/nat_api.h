@@ -15,7 +15,10 @@
 #include <stdint.h>
 
 namespace brpc_tpu {
-struct NatSpanRec;  // full layout in nat_stats.h (mirrored in ctypes)
+struct NatSpanRec;        // full layout in nat_stats.h (mirrored in ctypes)
+struct NatMethodStatRow;  // per-method stats snapshot row (nat_stats.h)
+struct NatConnRow;        // native /connections snapshot row (nat_stats.h)
+struct NatLockRankRow;    // per-rank lock-wait totals row (nat_stats.h)
 }
 
 extern "C" {
@@ -241,6 +244,42 @@ void nat_stats_reset(void);
 // trace fields, HTTP x-bd-trace-* headers, gRPC metadata and kind-8 shm
 // descriptors. (0, 0) clears.
 void nat_trace_set(uint64_t trace_id, uint64_t span_id);
+
+// ---- native observatory (ISSUE 9) ----
+// Per-method stats (details/method_status.h role): one row per
+// (lane, method) recorded at the native-handler call sites + the shm
+// worker emit path — qps source (count), errors, current/max
+// concurrency; latency quantiles per method from log2 histograms.
+int nat_method_stats(brpc_tpu::NatMethodStatRow* out, int max);
+double nat_method_quantile(int lane, const char* method, double q);
+// Native /connections: one row per live socket (byte/message/syscall
+// counters, unwritten bytes = write-stack depth, protocol, remote,
+// owning dispatcher).
+int nat_conn_snapshot(brpc_tpu::NatConnRow* out, int max);
+// Lock-contention profiler: per-rank wait totals are always on (fed by
+// every contended NatMutex acquisition); nat_mu_prof_start arms
+// threshold/rate-decimated stack sampling (seeded, deterministic per
+// thread) into per-tid rings reported as flat wait-us tables (mode 0)
+// or collapsed stacks weighted by wait-us (mode 1), malloc'd (free
+// with nat_buf_free).
+int nat_mu_prof_start(int threshold_us, int every, uint64_t seed);
+int nat_mu_prof_stop(void);
+int nat_mu_prof_running(void);
+uint64_t nat_mu_prof_samples(void);
+// Full hygiene reset: sampled stacks + the always-on per-rank totals.
+void nat_mu_prof_reset(void);
+// Sampled stacks only — the per-rank totals stay monotonic (they are
+// exported as Prometheus counters; debug pages use this one).
+void nat_mu_prof_reset_samples(void);
+int nat_mu_prof_report(int mode, char** out, size_t* out_len);
+int nat_mu_rank_stats(brpc_tpu::NatLockRankRow* out, int max);
+// Rank -> static name string (NULL when unnamed) — the tests' guard
+// that the hand-mirrored name table tracks nat_lockrank.h.
+const char* nat_mu_rank_name(int rank);
+// Deterministic contention generator (tests/smokes): N threads fight
+// over one declared-rank NatMutex; returns that rank's contended-wait
+// count.
+uint64_t nat_mu_contend_selftest(int nthreads, int iters, int hold_us);
 
 // ---- in-process sampling profiler (nat_prof.cpp) ----
 // SIGPROF/CPU-time stack sampling with frame-pointer unwind into

@@ -105,6 +105,7 @@ NatSocket* channel_socket(NatChannel* ch, int max_dial_ms) {
     return nullptr;
   }
   ns->fd = fd;
+  sock_set_peer(ns, ch->peer_ip.c_str(), ch->peer_port);
   ns->disp = pick_dispatcher(/*client_side=*/true);
   ns->disp->sockets_owned.fetch_add(1, std::memory_order_relaxed);
   ns->channel = ch;
@@ -112,6 +113,7 @@ NatSocket* channel_socket(NatChannel* ch, int max_dial_ms) {
   ns->defer_writes = ch->defer_writes_flag;
   ch->sock_id.store(ns->id, std::memory_order_release);
   if (ch->protocol != 0) channel_attach_client_session(ch, ns);
+  ns->conn_visible.store(true, std::memory_order_release);
   ns->add_ref();  // the caller's borrowed reference, taken BEFORE epoll
                   // can fail the socket
   ns->disp->add_consumer(ns);  // client sockets stay on epoll (measured
@@ -345,6 +347,7 @@ static void* channel_open_impl(const char* ip, int port, int nworkers,
     return nullptr;
   }
   s->fd = fd;
+  sock_set_peer(s, ip, port);
   s->disp = pick_dispatcher(/*client_side=*/true);
   s->disp->sockets_owned.fetch_add(1, std::memory_order_relaxed);
   s->channel = ch;
@@ -352,6 +355,7 @@ static void* channel_open_impl(const char* ip, int port, int nworkers,
   s->defer_writes = (batch_writes != 0);
   ch->sock_id.store(s->id, std::memory_order_release);
   if (protocol != 0) channel_attach_client_session(ch, s);
+  s->conn_visible.store(true, std::memory_order_release);
   // NOT ring-adopted: measured slower for clients — the one-in-flight
   // fixed-send discipline throttles request pipelining, while the epoll
   // lane's writer fiber flushes the whole queue per writev
@@ -414,7 +418,9 @@ static void backup_fire_work(void* raw) {
     if (s != nullptr) {
       IOBuf f;
       f.append(b->frame.data(), b->frame.size());
-      s->write(std::move(f));
+      if (s->write(std::move(f)) == 0) {
+        s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
+      }
       s->release();
     }
   }
@@ -463,7 +469,9 @@ static int call_attempt(NatChannel* ch, NatSocket* s, const char* service,
     BackupCtx* b = new BackupCtx{ch, cid, frame.to_string()};
     TimerThread::instance()->schedule(backup_fire, b, backup_ms);
   }
-  if (s->write(std::move(frame)) != 0) {
+  if (s->write(std::move(frame)) == 0) {
+    s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
+  } else {
     PendingCall* mine = ch->take_pending(cid, /*ok=*/false);
     if (mine != nullptr) {
       pc_free(mine);
@@ -684,7 +692,9 @@ int nat_channel_acall(void* h, const char* service, const char* method,
   IOBuf frame;
   build_request_frame(&frame, cid, service, method, payload, payload_len,
                       nullptr, 0, tr.trace_id, tr.span_id);
-  if (s->write(std::move(frame)) != 0) {
+  if (s->write(std::move(frame)) == 0) {
+    s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
+  } else {
     PendingCall* mine = ch->take_pending(cid, /*ok=*/false);  // s still pins the channel
     if (mine != nullptr) {
       // not yet consumed: complete through the SAME callback path so the

@@ -85,6 +85,8 @@ void http_cli_on_socket_fail(NatSocket* s) {
     cid = c->fifo.front().cid;
     c->fifo.pop_front();
   }
+  // the close-delimited body IS a complete parsed response
+  s->c_in_msgs.fetch_add(1, std::memory_order_relaxed);
   NatChannel* ch = s->channel;
   PendingCall* pc = ch != nullptr ? ch->take_pending(cid) : nullptr;
   if (pc == nullptr) return;
@@ -157,6 +159,10 @@ int http_client_process(NatSocket* s) {
         c->body_left -= take;
       }
       if (c->body_left > 0) return 1;  // need more body bytes
+      // a full response came off the wire whether or not a waiter is
+      // still around (timeout may have reaped it): count the parse,
+      // like the server-side c_in_msgs sites
+      s->c_in_msgs.fetch_add(1, std::memory_order_relaxed);
       bool was_head = false;
       PendingCall* pc = http_cli_take_head(s, &was_head);
       if (pc != nullptr) {
@@ -288,6 +294,7 @@ int http_client_process(NatSocket* s) {
       if (!done) {
         return buffered > kCliMaxBodyBytes + 65536 ? 0 : 1;
       }
+      s->c_in_msgs.fetch_add(1, std::memory_order_relaxed);
       bool was_head = false;
       PendingCall* pc = http_cli_take_head(s, &was_head);
       s->in_buf.pop_front(total);
@@ -336,6 +343,7 @@ int http_client_process(NatSocket* s) {
     s->in_buf.pop_front(body_start);
     if (body_len <= 4096 && s->in_buf.length() >= body_len) {
       // fast path: small fully-buffered body completes inline
+      s->c_in_msgs.fetch_add(1, std::memory_order_relaxed);
       bool dummy = false;
       PendingCall* pc = http_cli_take_head(s, &dummy);
       if (pc == nullptr) {
@@ -552,6 +560,7 @@ static int h2c_send_request(NatChannel* ch, NatSocket* s,
     h->streams.erase(sid);
     return kEFAILEDSOCKET;
   }
+  s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
   return 0;
 }
 
@@ -667,6 +676,9 @@ static void h2c_complete(NatSocket* s, H2CliSessN* h, uint32_t sid) {
     h->streams.erase(it);
     drained = h->goaway && h->streams.empty();
   }
+  // /connections in_msg: one response parsed off this client socket
+  // (the h2 server side counts at its own parse site, nat_h2.cpp)
+  s->c_in_msgs.fetch_add(1, std::memory_order_relaxed);
   // last permitted stream after a graceful GOAWAY: retire the socket so
   // the channel re-dials instead of queueing calls a peer won't serve
   if (drained) s->set_failed();
@@ -1094,6 +1106,7 @@ static int http_cli_send(NatChannel* ch, NatSocket* s, const char* verb,
     if (!c->fifo.empty() && c->fifo.back().cid == cid) c->fifo.pop_back();
     return kEFAILEDSOCKET;
   }
+  s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
   return 0;
 }
 

@@ -564,6 +564,7 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
   H2SessionN* h = s->h2;
   if (h == nullptr) return;
   nat_counter_add(NS_H2_RESPONSES_OUT, 1);
+  s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
   // response headers: dynamic-table encoded on the reading thread
   // (wire-ordered), static-encoded from py threads (order-independent)
   std::string hdr_block;
@@ -705,6 +706,7 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
   }
   srv->requests.fetch_add(1, std::memory_order_relaxed);
   nat_counter_add(NS_H2_MSGS_IN, 1);
+  s->c_in_msgs.fetch_add(1, std::memory_order_relaxed);
   // native handler: "/EchoService/Echo" -> "EchoService.Echo"
   if (!srv->handlers.empty() && path.size() > 1) {
     size_t slash = path.find('/', 1);
@@ -729,6 +731,9 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
           }
           uint64_t t_parse = nat_now_ns();
           uint32_t req_bytes = (uint32_t)payload.length();
+          // per-method row keyed by the gRPC :path
+          int midx = nat_method_idx(NL_GRPC, path.data(), path.size());
+          nat_method_begin(midx);
           NativeHandlerCtx ctx;
           ctx.req_payload = &payload;
           ctx.req_attachment = &attachment;
@@ -742,6 +747,7 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
                      batch_out);
           uint64_t t_write = nat_now_ns();
           nat_lat_record(NL_GRPC, t_write - t_parse);
+          nat_method_end(midx, t_write - t_parse, ctx.error_code != 0);
           if (nat_span_tick()) {
             uint64_t trace_id = 0, parent_span = 0;
             trace_from_flat(flat, &trace_id, &parent_span);

@@ -143,6 +143,7 @@ static void http_emit_response(NatSocket* s, uint64_t seq, IOBuf data,
   HttpSessionN* h = s->http;
   if (h == nullptr) return;
   nat_counter_add(NS_HTTP_RESPONSES_OUT, 1);
+  s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
   IOBuf out;
   bool want_close = false;
   bool wrote = false;
@@ -398,9 +399,13 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
     std::string_view path = uri.substr(0, uri.find('?'));
     srv->requests.fetch_add(1, std::memory_order_relaxed);
     nat_counter_add(NS_HTTP_MSGS_IN, 1);
+    s->c_in_msgs.fetch_add(1, std::memory_order_relaxed);
     auto nit = srv->http_handlers.find(path);
     if (nit != srv->http_handlers.end()) {
-      // native usercode, inline (builtin-service discipline)
+      // native usercode, inline (builtin-service discipline): the
+      // per-method row is keyed by the request path
+      int midx = nat_method_idx(NL_HTTP, path.data(), path.size());
+      nat_method_begin(midx);
       HttpHandlerCtxN ctx;
       ctx.verb = verb;
       ctx.path = path;
@@ -445,6 +450,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
       http_emit_response(s, seq, std::move(resp_buf), false, batch_out);
       uint64_t t_write = nat_now_ns();
       nat_lat_record(NL_HTTP, t_write - t_parse);
+      nat_method_end(midx, t_write - t_parse, ctx.status >= 400);
       if (take_span) {
         nat_span_record(NL_HTTP, s->id, span_path, span_path_n, t_recv,
                         t_parse, t_dispatch, t_write,

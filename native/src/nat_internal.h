@@ -15,6 +15,7 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <stdio.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -197,6 +198,44 @@ struct NatSocket {
   SslSessionN* ssl_sess = nullptr;
   bool ssl_declined = false;
 
+  // Per-connection observability (the native /connections row,
+  // connections_service.cpp role): relaxed atomics — each is written by
+  // one thread at a time (reader loop / drain-role holder) and read by
+  // the snapshot walker. c_unwritten tracks bytes queued on the write
+  // stack that the kernel has not yet accepted (the UnwrittenBytes
+  // column); saturating-subtracted so a reset racing a push can never
+  // wrap it negative. c_in_msgs counts protocol messages parsed off the
+  // wire; c_out_msgs counts messages emitted INTO the session/write
+  // stack — batch emit sites (http/h2/redis reorder windows, tpu_std
+  // batches) count before the flush outcome is known, so on a socket
+  // that fails mid-flush out_msgs may exceed what reached the wire
+  // (failed sockets are excluded from /connections, so the skew is
+  // only ever visible through a raw snapshot).
+  // /connections visibility gate: set (release) only after the creating
+  // thread finished setup (fd, peer, disp, channel/server, client
+  // session attach), so the snapshot walker — which can pin the socket
+  // the instant sock_create publishes versioned_ref — never reads those
+  // plain fields mid-write. Server-side protocol session pointers are
+  // sniffed later and stay outside the gate (see conn_fill_row).
+  std::atomic<bool> conn_visible{false};
+  std::atomic<uint64_t> c_in_bytes{0};
+  std::atomic<uint64_t> c_out_bytes{0};
+  std::atomic<uint64_t> c_in_msgs{0};
+  std::atomic<uint64_t> c_out_msgs{0};
+  std::atomic<uint64_t> c_read_calls{0};
+  std::atomic<uint64_t> c_write_calls{0};
+  std::atomic<uint64_t> c_unwritten{0};
+  // "ip:port" peer, written once at accept/dial before the socket joins
+  // its dispatcher; snapshot readers may see "" during setup
+  char peer[24] = {0};
+
+  void conn_unwritten_sub(uint64_t n) {
+    uint64_t v = c_unwritten.load(std::memory_order_relaxed);
+    while (!c_unwritten.compare_exchange_weak(
+        v, v > n ? v - n : 0, std::memory_order_relaxed)) {
+    }
+  }
+
   // io_uring datapath: (generation<<32 | file index) on the OWNING
   // dispatcher's ring when this socket's reads ride the provided-buffer
   // ring (-1 = epoll lane); the generation lets the ring reject stale
@@ -268,6 +307,13 @@ NatSocket* sock_create();
 NatSocket* sock_address(uint64_t id);
 void sock_unregister(NatSocket* s);
 
+// /connections peer column: "ip:port" formatted once at socket setup.
+inline void sock_set_peer(NatSocket* s, const char* ip, int port) {
+  snprintf(s->peer, sizeof(s->peer), "%s:%d", ip, port);
+}
+// getpeername variant for accepted fds (defined in nat_socket.cpp).
+void sock_set_peer_fd(NatSocket* s);
+
 // ring datapath seams (defined in nat_socket.cpp). One RingListener per
 // dispatcher loop (the event_dispatcher_num x io_uring product of the
 // fork): loops never share an SQ, so submissions from different cores
@@ -289,6 +335,7 @@ class Dispatcher {
  public:
   int epfd = -1;
   int wake_fd = -1;  // eventfd to break epoll_wait on stop
+  int idx = 0;       // position in g_disps (the /connections disp column)
   std::thread thread;
   std::atomic<bool> stop{false};
   // listen sockets: fd -> server

@@ -35,6 +35,8 @@
 namespace brpc_tpu {
 
 enum : int {
+  kLockRankMuSelftest = 4,    // nat_mu_contend_selftest's burn mutex
+                              // (holds nothing, held under nothing)
   kLockRankProfCtl = 6,       // nat_prof g_ctl_mu: start/stop/reset
                               // serialization (control path only; held
                               // across the collector join, which takes
@@ -42,6 +44,8 @@ enum : int {
   kLockRankProfReport = 8,    // nat_prof g_report_mu: collector/report
                               // serialization (holds no other lock while
                               // symbolizing), outermost
+  kLockRankMuProfReport = 9,  // nat_prof g_mu_report_mu: contention-
+                              // profiler aggregate/report (control path)
   kLockRankShmProbe = 10,     // g_probe_mu: fence probing, outermost
   // 15: shm.fence (raw robust pthread mutex, see header comment)
   kLockRankShmReq = 20,       // g_req_mu[i]: per-worker request producer
@@ -114,6 +118,14 @@ void assert_none_held(const char* where);
 }  // namespace lockrank
 #endif
 
+// Contended-acquisition slow path (defined in nat_prof.cpp): measures
+// the blocking wait, feeds the always-on per-rank wait totals, and —
+// when the contention profiler is armed — threshold/rate-samples a
+// frame-pointer stack weighted by the wait into the per-thread rings
+// surfaced at /hotspots/contention. MUST acquire no NatMutex itself (it
+// runs inside an acquisition of arbitrary rank).
+void nat_mu_contended_wait(std::mutex* m, int rank);
+
 // Drop-in std::mutex wrapper carrying its declared rank. Zero overhead
 // unless NAT_LOCKRANK is defined. Use with CTAD guards:
 //   NatMutex<kLockRankSockEpoll> epollctl_mu;
@@ -127,7 +139,12 @@ class NatMutex {
 #if defined(NAT_LOCKRANK)
     lockrank::note_acquire(Rank);
 #endif
-    m_.lock();
+    // uncontended fast path: one CAS, exactly what m_.lock() would do.
+    // A failed try_lock IS contention — the out-of-line slow path
+    // blocks in m_.lock() with the wait measured (the lock behavior
+    // lockorder/dsched prove safe, finally measured for cost).
+    if (m_.try_lock()) return;
+    nat_mu_contended_wait(&m_, Rank);
   }
 
   bool try_lock() {

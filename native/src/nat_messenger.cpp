@@ -479,6 +479,7 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
     }
     s->in_buf.pop_front(meta_size);
     size_t payload_size = body - meta_size - att_size;
+    s->c_in_msgs.fetch_add(1, std::memory_order_relaxed);
     if (srv == nullptr && s->channel != nullptr) {
       // lame-duck signal (SHUTDOWN meta bit): the peer is draining —
       // detach this socket from the channel so new calls re-dial, keep
@@ -544,6 +545,23 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
       }
       if (handler != nullptr) {
         uint64_t t_parse = nat_now_ns();  // meta decoded, payload cut
+        // per-method row ("Service.Method", details/method_status role):
+        // concurrency brackets the usercode, the completion records
+        // count/errors/latency into the method's own histogram
+        char m[256];
+        const std::string& sn = meta.request.service_name;
+        const std::string& mn = meta.request.method_name;
+        // oversize names truncate (nat_method_idx keys on a 51-char
+        // prefix anyway) instead of all collapsing into one ""-keyed row
+        size_t sl = sn.size() < sizeof(m) - 2 ? sn.size() : sizeof(m) - 2;
+        memcpy(m, sn.data(), sl);
+        m[sl] = '.';
+        size_t mnl = mn.size() < sizeof(m) - 1 - sl ? mn.size()
+                                                    : sizeof(m) - 1 - sl;
+        memcpy(m + sl + 1, mn.data(), mnl);
+        size_t ml = sl + 1 + mnl;
+        int midx = nat_method_idx(NL_ECHO, m, ml);
+        nat_method_begin(midx);
         NativeHandlerCtx ctx;
         ctx.req_payload = &payload;
         ctx.req_attachment = &attachment;
@@ -556,19 +574,11 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
         build_response_frame(&batch_out, meta.correlation_id, ctx.error_code,
                              ctx.error_text, std::move(ctx.resp_payload),
                              std::move(ctx.resp_attachment));
+        s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
         uint64_t t_write = nat_now_ns();
         nat_lat_record(NL_ECHO, t_write - t_parse);
+        nat_method_end(midx, t_write - t_parse, ctx.error_code != 0);
         if (nat_span_tick()) {
-          char m[256];
-          const std::string& sn = meta.request.service_name;
-          const std::string& mn = meta.request.method_name;
-          size_t ml = 0;
-          if (sn.size() + mn.size() + 1 <= sizeof(m)) {
-            memcpy(m, sn.data(), sn.size());
-            m[sn.size()] = '.';
-            memcpy(m + sn.size() + 1, mn.data(), mn.size());
-            ml = sn.size() + 1 + mn.size();
-          }
           nat_span_record(NL_ECHO, s->id, m, ml, t_recv, t_parse,
                           t_dispatch, t_write, ctx.error_code, req_bytes,
                           resp_bytes, (uint64_t)meta.request.trace_id,
@@ -670,6 +680,8 @@ bool drain_socket_inline(NatSocket* s) {
       }
       if (n > 0) {
         nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)n);
+        s->c_in_bytes.fetch_add((uint64_t)n, std::memory_order_relaxed);
+        s->c_read_calls.fetch_add(1, std::memory_order_relaxed);
         s->fill_off += (size_t)n;
         if (s->fill_off == r->big_len) {
           s->fill_req = nullptr;
@@ -710,6 +722,8 @@ bool drain_socket_inline(NatSocket* s) {
     }
     if (n > 0) {
       nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)n);
+      s->c_in_bytes.fetch_add((uint64_t)n, std::memory_order_relaxed);
+      s->c_read_calls.fetch_add(1, std::memory_order_relaxed);
       if (!process_input(s, &acc)) {
         dead = true;
         break;

@@ -147,6 +147,7 @@ static void redis_drain_locked(RedisSessN* h, std::string* out,
 static void redis_emit(NatSocket* s, RedisSessN* h, uint64_t seq,
                        std::string&& reply, IOBuf* batch_out) {
   nat_counter_add(NS_REDIS_RESPONSES_OUT, 1);
+  s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
   std::string out;
   bool want_close = false;
   {
@@ -189,10 +190,31 @@ static bool ieq(std::string_view a, const char* b) {
 
 // Execute a command against the native store. Returns false when the
 // command is not natively handled (py lane takes it).
+// The command words store_execute handles (everything else returns
+// false and falls through to the py lane / the unknown-command error).
+static bool store_command_known(std::string_view cmd) {
+  static const char* kStoreCmds[] = {
+      "ping", "echo",  "command", "select",   "set",     "get",
+      "del",  "unlink", "exists", "incr",     "decr",    "incrby",
+      "decrby", "append", "strlen", "mget",   "mset",    "dbsize",
+      "flushall", "flushdb",
+  };
+  for (const char* k : kStoreCmds) {
+    if (ieq(cmd, k)) return true;
+  }
+  return false;
+}
+
 static bool store_execute(RedisStoreN* st,
                           const std::vector<std::string>& argv,
-                          std::string* out) {
+                          std::string* out, bool known) {
   std::string_view cmd(argv[0]);
+  // kStoreCmds is authoritative: `known` is the caller's (sole)
+  // store_command_known(argv[0]) result, so a dispatch branch added
+  // below without a list entry is refused here (the command loudly
+  // falls through to the py lane) instead of silently recording no
+  // per-method row
+  if (!known) return false;
   size_t nargs = argv.size() - 1;
   if (ieq(cmd, "ping")) {
     if (nargs == 1) {
@@ -469,6 +491,7 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
     consumed += pos;
     srv->requests.fetch_add(1, std::memory_order_relaxed);
     nat_counter_add(NS_REDIS_MSGS_IN, 1);
+    s->c_in_msgs.fetch_add(1, std::memory_order_relaxed);
     uint64_t seq =
         h->next_req_seq.fetch_add(1, std::memory_order_relaxed);
 
@@ -486,7 +509,29 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
     if (srv->native_redis == 2 && srv->redis_store != nullptr) {
       std::string reply;
       uint64_t t_parse = nat_now_ns();  // command cut, about to execute
-      if (store_execute(srv->redis_store, argv, &reply)) {
+      // per-method row keyed by the command name ("SET"/"GET"/...) —
+      // only store-family commands claim one: argv[0] is raw wire bytes,
+      // and unknown words must not burn never-freed table slots. The
+      // key is case-normalized to match store_command_known's ieq():
+      // "set"/"SET"/"sEt" must share ONE row, not claim a never-freed
+      // slot per case variant.
+      const bool store_known = store_command_known(argv[0]);
+      int midx = -1;
+      if (store_known) {
+        char word[16];  // fits every kStoreCmds word ("flushall" is
+                        // the longest at 8); names >= 16 would truncate
+                        // to a different key than store_command_known
+                        // matched, so grow this with the list
+        size_t wl = argv[0].size() < sizeof(word) ? argv[0].size()
+                                                  : sizeof(word) - 1;
+        for (size_t wi = 0; wi < wl; wi++) {
+          char ch = argv[0][wi];
+          word[wi] = (ch >= 'a' && ch <= 'z') ? (char)(ch - 32) : ch;
+        }
+        midx = nat_method_idx(NL_REDIS, word, wl);
+      }
+      nat_method_begin(midx);
+      if (store_execute(srv->redis_store, argv, &reply, store_known)) {
         uint64_t t_dispatch = nat_now_ns();
         uint32_t req_b = (uint32_t)pos;
         uint32_t resp_b = (uint32_t)reply.size();
@@ -494,6 +539,7 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
         redis_emit(s, h, seq, std::move(reply), batch_out);
         uint64_t t_write = nat_now_ns();
         nat_lat_record(NL_REDIS, t_write - t_parse);
+        nat_method_end(midx, t_write - t_parse, is_err);
         if (nat_span_tick()) {
           nat_span_record(NL_REDIS, s->id, argv[0].data(), argv[0].size(),
                           t_parse, t_parse, t_dispatch, t_write,
@@ -501,6 +547,9 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
         }
         continue;
       }
+      // not a store-family command: no completion recorded here — the
+      // py lane (or the error reply below) owns it
+      nat_method_abort(midx);
     }
     if (!srv->py_lane_enabled) {
       std::string err;

@@ -116,12 +116,14 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
       break;
     }
     s->fd = cfd;
+    sock_set_peer_fd(s);  // the /connections remote_side column
     s->disp = pick_dispatcher();  // shard across the loop pool
     s->disp->sockets_owned.fetch_add(1, std::memory_order_relaxed);
     s->server = srv;
     srv->add_ref();  // released when the socket slot is recycled
     srv->connections.fetch_add(1, std::memory_order_relaxed);
     nat_counter_add(NS_CONNECTIONS_ACCEPTED, 1);
+    s->conn_visible.store(true, std::memory_order_release);
     if (try_ring_adopt(s)) continue;  // the ring owns this read path
     s->disp->add_consumer(s);
   }
@@ -287,6 +289,7 @@ int ensure_runtime(int nworkers) {
     }
     for (int i = 0; i < n; i++) {
       Dispatcher* d = new Dispatcher();
+      d->idx = i;
       if (d->start() != 0) {
         delete d;
         if (g_disps.empty()) return -1;
@@ -604,6 +607,9 @@ int nat_respond(void* h, int32_t error_code, const char* error_text,
                          error_text ? error_text : "", std::move(pay),
                          std::move(attach));
     rc = s->write(std::move(out));
+    // count only frames accepted for the wire: a failed-socket write
+    // must not over-report /connections out_msgs vs the byte counters
+    if (rc == 0) s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
     s->release();
   }
   delete r;

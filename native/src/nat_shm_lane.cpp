@@ -295,6 +295,9 @@ struct InflightEntry {
   uint64_t span_id = 0;         // this request's server span id
   uint64_t offer_ns = 0;        // request entered the worker rings
   char method[40] = {0};
+  // per-method stats slot (nat_method_idx at offer time): concurrency
+  // is held from offer to whichever erase site retires the entry
+  int16_t method_stat = -1;
 };
 
 // Release an erased entry's admission token (call with g_inflight_mu
@@ -340,6 +343,12 @@ void reap_expired() {
   for (auto& d : dead) {
     emit_reaped(d.second.kind, d.first.sock_id, d.first.seq);
     inflight_entry_complete(d.second, /*ok=*/false);
+    uint64_t rn = nat_now_ns();
+    nat_method_end(d.second.method_stat,
+                   d.second.offer_ns != 0 && rn > d.second.offer_ns
+                       ? rn - d.second.offer_ns
+                       : 0,
+                   /*error=*/true);
   }
 }
 
@@ -362,6 +371,12 @@ void reap_slot_inflight(int slot) {
   for (auto& d : dead) {
     emit_reaped(d.second.kind, d.first.sock_id, d.first.seq);
     inflight_entry_complete(d.second, /*ok=*/false);
+    uint64_t rn = nat_now_ns();
+    nat_method_end(d.second.method_stat,
+                   d.second.offer_ns != 0 && rn > d.second.offer_ns
+                       ? rn - d.second.offer_ns
+                       : 0,
+                   /*error=*/true);
   }
 }
 
@@ -447,6 +462,15 @@ void emit_response(int slot, const CellView& c) {
   inflight_entry_complete(done_entry, resp_ok);
   if (wk_take_ns != 0 && wk_resp_ns >= wk_take_ns) {
     nat_lat_record(NL_WORKER, wk_resp_ns - wk_take_ns);
+  }
+  {
+    // per-method completion: offer -> emit covers queueing + usercode
+    uint64_t now_ns = nat_now_ns();
+    nat_method_end(done_entry.method_stat,
+                   done_entry.offer_ns != 0 && now_ns > done_entry.offer_ns
+                       ? now_ns - done_entry.offer_ns
+                       : 0,
+                   !resp_ok);
   }
   if (done_entry.span_sampled) {
     uint64_t now = nat_now_ns();
@@ -776,15 +800,23 @@ bool shm_lane_offer(PyRequest* r) {
   // span sampling decided HERE (the wire parse's trace context rides the
   // PyRequest): the emit side records the server + worker spans when the
   // response comes back
-  if ((entry.span_sampled = nat_span_tick())) {
-    entry.trace_id = r->trace_id != 0 ? r->trace_id : nat_span_id63();
-    entry.parent_span_id = r->parent_span_id;
-    entry.span_id = nat_span_id63();
-    entry.offer_ns = nat_now_ns();
+  entry.offer_ns = nat_now_ns();
+  {
     size_t mn = r->method.size() < sizeof(entry.method) - 1
                     ? r->method.size()
                     : sizeof(entry.method) - 1;
     memcpy(entry.method, r->method.data(), mn);
+  }
+  // per-method row (the worker-dispatched half of the native
+  // MethodStatus table): concurrency spans offer -> emit/reap
+  entry.method_stat = (int16_t)nat_method_idx(
+      r->kind == 4 ? NL_GRPC : NL_HTTP, entry.method,
+      strnlen(entry.method, sizeof(entry.method)));
+  nat_method_begin(entry.method_stat);
+  if ((entry.span_sampled = nat_span_tick())) {
+    entry.trace_id = r->trace_id != 0 ? r->trace_id : nat_span_id63();
+    entry.parent_span_id = r->parent_span_id;
+    entry.span_id = nat_span_id63();
   }
   {
     std::lock_guard g(g_inflight_mu);
@@ -798,8 +830,13 @@ bool shm_lane_offer(PyRequest* r) {
       (uint8_t)r->kind, 0, r->sock_id, r->cid, 0, blob_len, 0,
       [&](char* dst) { serialize_request(dst, r); }, &slot);
   if (!ok) {
-    std::lock_guard g(g_inflight_mu);
-    g_inflight.erase(InflightKey{r->sock_id, r->cid});
+    {
+      std::lock_guard g(g_inflight_mu);
+      g_inflight.erase(InflightKey{r->sock_id, r->cid});
+    }
+    // the call continues on the in-process lane: undo the concurrency
+    // bracket (no completed call to record)
+    nat_method_abort(entry.method_stat);
     return false;  // every ring full / no live worker: in-process lane
   }
   {
@@ -928,6 +965,11 @@ int nat_shm_lane_enable(int enable) {
   if (enable != 0 && !g_lane_enabled.load(std::memory_order_acquire)) {
     {
       std::lock_guard g(g_inflight_mu);
+      // entries recorded nat_method_begin at offer time; dropping them
+      // without the abort would pin per-method concurrency forever
+      for (const auto& kv : g_inflight) {
+        nat_method_abort(kv.second.method_stat);
+      }
       g_inflight.clear();
     }
     g_seg->shutdown.store(0, std::memory_order_release);
@@ -953,6 +995,20 @@ int nat_shm_lane_enable(int enable) {
     if (g_resp_drainer != nullptr && g_resp_drainer->joinable()) {
       g_resp_drainer->join();
     }
+    // the drainer is gone, so nothing will ever retire entries still in
+    // flight: release their method-concurrency slots and admission
+    // tokens now instead of pinning them until a later re-enable
+    std::vector<InflightEntry> orphans;
+    {
+      std::lock_guard g(g_inflight_mu);
+      orphans.reserve(g_inflight.size());
+      for (const auto& kv : g_inflight) {
+        nat_method_abort(kv.second.method_stat);
+        orphans.push_back(kv.second);
+      }
+      g_inflight.clear();
+    }
+    for (const auto& e : orphans) inflight_entry_complete(e, false);
     if (!g_seg_unlinked) {
       shm_unlink(g_seg_name);
       g_seg_unlinked = true;

@@ -281,6 +281,90 @@ int main() {
     CHECK(nat_prof_samples() == 0, "prof reset");
   }
 
+  // ---- contention-profiler round: the NatMutex slow path, the lock-free
+  // per-tid sample rings and the wait-weighted aggregate under
+  // instrumentation (record path races the report drain; the selftest
+  // guarantees real contention so every arm runs hot) ----
+  {
+    CHECK(nat_mu_prof_start(0, 1, 42) == 0, "mu prof start");
+    CHECK(nat_mu_prof_running() == 1, "mu prof running");
+    CHECK(nat_mu_prof_start(0, 1, 42) == -1, "mu prof double start loses");
+    uint64_t waits = nat_mu_contend_selftest(4, 100, 20);
+    CHECK(waits > 0, "selftest produced contended waits");
+    // echo load on top: production locks (session/alloc/py) may contend
+    uint64_t mu_reqs = 0;
+    (void)nat_rpc_client_bench("127.0.0.1", port, 2, 8, 0.2, 16,
+                               &mu_reqs);
+    CHECK(nat_mu_prof_stop() == 0, "mu prof stop");
+    CHECK(nat_mu_prof_running() == 0, "mu prof stopped");
+    CHECK(nat_mu_prof_samples() > 0, "mu prof sampled waits");
+    char* rep = nullptr;
+    size_t rep_len = 0;
+    CHECK(nat_mu_prof_report(1, &rep, &rep_len) == 0 && rep != nullptr,
+          "mu prof collapsed report");
+    CHECK(rep_len > 0 && strstr(rep, "lock:mu.selftest") != nullptr,
+          "report names the contended NatMutex site");
+    if (rep != nullptr) nat_buf_free(rep);
+    rep = nullptr;
+    CHECK(nat_mu_prof_report(0, &rep, &rep_len) == 0 && rep != nullptr,
+          "mu prof flat report");
+    if (rep != nullptr) nat_buf_free(rep);
+    brpc_tpu::NatLockRankRow rows[128];
+    int nrows = nat_mu_rank_stats(rows, 128);
+    bool selftest_row = false;
+    for (int i = 0; i < nrows; i++) {
+      if (strcmp(rows[i].name, "mu.selftest") == 0 && rows[i].waits > 0) {
+        selftest_row = true;
+      }
+    }
+    CHECK(selftest_row, "per-rank totals carry the selftest rank");
+    nat_mu_prof_reset();
+    CHECK(nat_mu_prof_samples() == 0, "mu prof reset");
+  }
+
+  // ---- per-method stats + /connections snapshot: the observatory's
+  // table surfaces driven by the traffic above ----
+  {
+    brpc_tpu::NatMethodStatRow mrows[128];
+    int nm = nat_method_stats(mrows, 128);
+    bool echo_row = false;
+    for (int i = 0; i < nm; i++) {
+      if (strcmp(mrows[i].method, "EchoService.Echo") == 0 &&
+          mrows[i].count > 0 && mrows[i].max_concurrency > 0 &&
+          mrows[i].concurrency == 0) {
+        echo_row = true;
+      }
+    }
+    CHECK(echo_row, "per-method table has the echo row");
+    CHECK(nat_method_quantile(0, "EchoService.Echo", 0.5) > 0.0,
+          "per-method latency histogram");
+    // the snapshot only lists LIVE sockets — hold a dialed channel (plus
+    // its accepted peer) open across the walk, with one call's bytes on it
+    void* cch = nat_channel_open("127.0.0.1", port, 0, 0, 0, 0);
+    CHECK(cch != nullptr, "conn-round channel open");
+    if (cch != nullptr) {
+      char* resp = nullptr;
+      size_t rlen = 0;
+      char* err = nullptr;
+      int rc = nat_channel_call_full(cch, "EchoService", "Echo", "connrow",
+                                     7, 2000, 0, 0, &resp, &rlen, &err);
+      CHECK(rc == 0, "conn-round echo call");
+      if (resp != nullptr) nat_buf_free(resp);
+      if (err != nullptr) nat_buf_free(err);
+      brpc_tpu::NatConnRow crows[64];
+      int ncon = nat_conn_snapshot(crows, 64);
+      CHECK(ncon > 0, "conn snapshot has live sockets");
+      bool saw_bytes = false;
+      for (int i = 0; i < ncon; i++) {
+        if (crows[i].in_bytes > 0 && crows[i].remote[0] != '\0') {
+          saw_bytes = true;
+        }
+      }
+      CHECK(saw_bytes, "conn rows carry bytes + remote addr");
+      nat_channel_close(cch);
+    }
+  }
+
   // ---- redis lane: native store under pipelined load ----
   uint64_t redis_reqs = 0;
   double redis_qps = nat_redis_client_bench("127.0.0.1", port, 1, 8, 0.2,

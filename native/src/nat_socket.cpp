@@ -264,6 +264,27 @@ void NatSocket::reset_for_reuse() {
   ssl_declined = false;
   close_after_drain.store(false, std::memory_order_relaxed);
   spoke_tpu_std.store(false, std::memory_order_relaxed);
+  conn_visible.store(false, std::memory_order_relaxed);
+  c_in_bytes.store(0, std::memory_order_relaxed);
+  c_out_bytes.store(0, std::memory_order_relaxed);
+  c_in_msgs.store(0, std::memory_order_relaxed);
+  c_out_msgs.store(0, std::memory_order_relaxed);
+  c_read_calls.store(0, std::memory_order_relaxed);
+  c_write_calls.store(0, std::memory_order_relaxed);
+  c_unwritten.store(0, std::memory_order_relaxed);
+  peer[0] = '\0';
+}
+
+void sock_set_peer_fd(NatSocket* s) {
+  struct sockaddr_in sa;
+  socklen_t sl = sizeof(sa);
+  if (getpeername(s->fd, (struct sockaddr*)&sa, &sl) != 0 ||
+      sa.sin_family != AF_INET) {
+    return;
+  }
+  char ip[INET_ADDRSTRLEN] = {0};
+  inet_ntop(AF_INET, &sa.sin_addr, ip, sizeof(ip));
+  sock_set_peer(s, ip, (int)ntohs(sa.sin_port));
 }
 
 void NatSocket::set_failed() {
@@ -442,6 +463,8 @@ bool NatSocket::wrefill() {
 // release the role. A writer that pushes AFTER this released checks
 // `failed` post-push and cleans up after itself (write_raw).
 void NatSocket::write_release_all() {
+  // whatever is still queued will never reach the kernel
+  c_unwritten.store(0, std::memory_order_relaxed);
   wbuf.clear();
   ring_sending = false;
   ring_inflight = 0;
@@ -481,7 +504,12 @@ bool NatSocket::flush_chain() {
       } else {
         n = wbuf.cut_into_fd(fd, fwa.action == NF_SHORT ? 1 : SIZE_MAX);
       }
-      if (n > 0) nat_counter_add(NS_SOCK_WRITE_BYTES, (uint64_t)n);
+      if (n > 0) {
+        nat_counter_add(NS_SOCK_WRITE_BYTES, (uint64_t)n);
+        c_out_bytes.fetch_add((uint64_t)n, std::memory_order_relaxed);
+        c_write_calls.fetch_add(1, std::memory_order_relaxed);
+        conn_unwritten_sub((uint64_t)n);
+      }
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
           return false;  // role retained; caller parks on EPOLLOUT
@@ -617,6 +645,7 @@ int NatSocket::write(IOBuf&& frame) {
 // the drain itself needs no lock.
 bool NatSocket::write_push(IOBuf&& frame) {
   WriteReq* r = wreq_alloc();
+  c_unwritten.fetch_add(frame.length(), std::memory_order_relaxed);
   r->data = std::move(frame);
   if (wstack.push(r)) {
     // safe plain store: the push exchange that made us the drainer
@@ -687,6 +716,9 @@ bool ring_drain_one(RingListener* ring) {
       if (c.res > 0) {
         if (s != nullptr && !s->failed.load(std::memory_order_acquire)) {
           nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)c.res);
+          s->c_in_bytes.fetch_add((uint64_t)c.res,
+                                  std::memory_order_relaxed);
+          s->c_read_calls.fetch_add(1, std::memory_order_relaxed);
           if (s->ssl_sess != nullptr) {
             // TLS: ciphertext feeds the session; plaintext lands in
             // in_buf inside ssl_feed
@@ -756,6 +788,9 @@ bool ring_drain_one(RingListener* ring) {
           size_t done = (size_t)c.res;
           if (done > s->ring_inflight) done = s->ring_inflight;
           nat_counter_add(NS_SOCK_WRITE_BYTES, done);
+          s->c_out_bytes.fetch_add(done, std::memory_order_relaxed);
+          s->c_write_calls.fetch_add(1, std::memory_order_relaxed);
+          s->conn_unwritten_sub(done);
           s->wbuf.pop_front(done);
           s->ring_inflight = 0;
           s->wring_continue();  // next chunk / refill / release / close
@@ -806,4 +841,96 @@ bool try_ring_adopt(NatSocket* s) {
   return false;
 }
 
+// ---------------------------------------------------------------------------
+// native /connections snapshot (connections_service.cpp role): walk the
+// registry's high-water mark and fill one row per live socket. Lock-free
+// — liveness is judged by the versioned_ref refcount, counters are
+// relaxed atomics, and the protocol column is derived from the session
+// pointers the single reading thread owns (a mid-recycle row can at
+// worst show a freshly-reset socket's zeros; this is a debug page).
+// ---------------------------------------------------------------------------
+
+// no_sanitize: the SERVER-side protocol session pointers (http/h2/redis)
+// and ssl_sess are sniff-assigned by the owning dispatcher thread after
+// the conn_visible gate; this walker only null-tests them (never
+// dereferences) to derive the protocol column, and a stale null at
+// worst labels a just-sniffed socket "?" for one scrape. Everything
+// else read here is either an atomic or ordered by conn_visible.
+__attribute__((no_sanitize("thread")))
+static void conn_fill_row(NatSocket* s, NatConnRow* r) {
+  r->sock_id = s->id.load(std::memory_order_relaxed);
+  r->in_bytes = s->c_in_bytes.load(std::memory_order_relaxed);
+  r->out_bytes = s->c_out_bytes.load(std::memory_order_relaxed);
+  r->in_msgs = s->c_in_msgs.load(std::memory_order_relaxed);
+  r->out_msgs = s->c_out_msgs.load(std::memory_order_relaxed);
+  r->read_calls = s->c_read_calls.load(std::memory_order_relaxed);
+  r->write_calls = s->c_write_calls.load(std::memory_order_relaxed);
+  r->unwritten_bytes = s->c_unwritten.load(std::memory_order_relaxed);
+  r->fd = s->fd;
+  r->disp_idx = s->disp != nullptr ? s->disp->idx : -1;
+  r->server_side = s->server != nullptr ? 1 : 0;
+  const char* proto = "?";
+  if (s->http != nullptr) proto = "http";
+  else if (s->h2 != nullptr) proto = "h2";
+  else if (s->redis != nullptr) proto = "redis";
+  else if (s->httpc != nullptr) proto = "http_cli";
+  else if (s->h2c != nullptr) proto = "h2_cli";
+  else if (s->spoke_tpu_std.load(std::memory_order_relaxed)) proto = "tpu_std";
+  else if (s->py_streams.load(std::memory_order_relaxed)) proto = "stream";
+  else if (s->py_raw.load(std::memory_order_relaxed)) proto = "raw";
+  else if (s->channel != nullptr) proto = "tpu_std";
+  if (s->ssl_sess != nullptr) proto = "tls";
+  snprintf(r->protocol, sizeof(r->protocol), "%s", proto);
+  memcpy(r->remote, s->peer, sizeof(r->remote) < sizeof(s->peer)
+                                 ? sizeof(r->remote)
+                                 : sizeof(s->peer));
+  r->remote[sizeof(r->remote) - 1] = '\0';
+}
+
 }  // namespace brpc_tpu
+
+using namespace brpc_tpu;
+
+extern "C" {
+
+// Fill up to `max` rows with the live native sockets; returns rows
+// written. A row is "live" when its registry slot holds a reference and
+// an open fd (closed/recycled slots are skipped). Each row is filled
+// under a borrowed reference (the sock_address discipline): the CAS from
+// a nonzero refcount pins the socket so release()'s teardown — which
+// frees sessions and closes the fd the row reads — cannot run mid-fill.
+int nat_conn_snapshot(brpc_tpu::NatConnRow* out, int max) {
+  int n = 0;
+  uint32_t hwm;
+  {
+    std::lock_guard g(g_sock_alloc_mu);
+    hwm = g_sock_next_idx;
+  }
+  for (uint32_t idx = 0; idx < hwm && n < max; idx++) {
+    NatSocket* s = sock_at(idx);
+    if (s == nullptr) continue;
+    uint64_t vr = s->versioned_ref.load(std::memory_order_acquire);
+    bool pinned = false;
+    while ((uint32_t)vr != 0) {  // no refs: free / being recycled
+      if (s->versioned_ref.compare_exchange_weak(
+              vr, vr + 1, std::memory_order_acq_rel)) {
+        pinned = true;
+        break;
+      }
+    }
+    if (!pinned) continue;
+    // conn_visible (acquire) orders every setup write — fd, peer, disp,
+    // channel/server, client session attach — before this row's reads:
+    // the pin alone is not enough, sock_create publishes versioned_ref
+    // before the creating thread has filled those plain fields
+    if (s->conn_visible.load(std::memory_order_acquire) &&
+        !s->failed.load(std::memory_order_acquire) && s->fd >= 0) {
+      conn_fill_row(s, &out[n]);
+      if (out[n].sock_id != 0) n++;
+    }
+    s->release();
+  }
+  return n;
+}
+
+}  // extern "C"

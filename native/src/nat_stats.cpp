@@ -107,6 +107,145 @@ static const char* kLaneNames[NL_LANE_COUNT] = {
 thread_local NatTraceCtx tls_nat_trace;
 
 // ---------------------------------------------------------------------------
+// per-method stats — fixed open-addressed (lane, method) table. Slots are
+// claimed once and never freed (a handed-out index must stay valid while
+// a shm in-flight entry holds it across seconds); lookups are lock-free
+// (state acquire gates the key bytes), inserts race via the state CAS.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct NatMethodCell {
+  // 0 = free, 1 = claiming (key being written), 2 = ready
+  std::atomic<uint32_t> state{0};
+  int32_t lane = 0;
+  char method[kNatMethodNameLen] = {0};
+  std::atomic<uint64_t> count{0};
+  std::atomic<uint64_t> errors{0};
+  std::atomic<int64_t> concurrency{0};
+  std::atomic<int64_t> max_concurrency{0};
+  std::atomic<uint64_t> hist[kNatHistBuckets];
+};
+
+NatMethodCell g_methods[kNatMethodSlots];
+
+uint64_t method_hash(int lane, const char* method, size_t len) {
+  uint64_t h = 1469598103934665603ull ^ (uint64_t)lane;
+  for (size_t i = 0; i < len; i++) {
+    h = (h ^ (uint8_t)method[i]) * 1099511628211ull;
+  }
+  return nat_mix64(h);
+}
+
+// Per-lane "(other)" rows absorb calls once the table is full — method
+// names arrive off the wire (HTTP paths, redis command words), so a
+// client spraying unique names must degrade attribution, not disable
+// it. Claimed at .so load while the table is guaranteed empty.
+int g_method_overflow[NL_LANE_COUNT];
+const bool g_method_overflow_init = [] {
+  for (int lane = 0; lane < NL_LANE_COUNT; lane++) {
+    g_method_overflow[lane] = nat_method_idx(lane, "(other)", 7);
+  }
+  return true;
+}();
+
+}  // namespace
+
+int nat_method_idx(int lane, const char* method, size_t len) {
+  if (len >= kNatMethodNameLen) len = kNatMethodNameLen - 1;
+  uint32_t start = (uint32_t)(method_hash(lane, method, len) %
+                              kNatMethodSlots);
+  for (int probe = 0; probe < kNatMethodSlots; probe++) {
+    int idx = (int)((start + (uint32_t)probe) % kNatMethodSlots);
+    NatMethodCell& c = g_methods[idx];
+    uint32_t st = c.state.load(std::memory_order_acquire);
+    if (st == 2) {
+      if (c.lane == lane && strncmp(c.method, method, len) == 0 &&
+          c.method[len] == '\0') {
+        return idx;
+      }
+      continue;
+    }
+    if (st == 0) {
+      uint32_t expect = 0;
+      if (c.state.compare_exchange_strong(expect, 1,
+                                          std::memory_order_acq_rel)) {
+        c.lane = lane;
+        memcpy(c.method, method, len);
+        c.method[len] = '\0';
+        c.state.store(2, std::memory_order_release);
+        return idx;
+      }
+    }
+    // claiming (st == 1) or lost the claim race: the claimer may be
+    // writing OUR key — spin this slot briefly waiting for it to publish
+    for (int spin = 0; spin < 64; spin++) {
+      if (c.state.load(std::memory_order_acquire) == 2) break;
+    }
+    if (c.state.load(std::memory_order_acquire) == 2) {
+      if (c.lane == lane && strncmp(c.method, method, len) == 0 &&
+          c.method[len] == '\0') {
+        return idx;
+      }
+      continue;  // published someone else's key — keep probing
+    }
+    // still mid-claim after the spin budget (claimer descheduled): it
+    // may be seating OUR key, and probing on could claim a SECOND slot
+    // for the same (lane, method) — a permanent stats split. Degrade
+    // this one call to "(other)" instead; the next call re-probes.
+    break;
+  }
+  // table full: aggregate into the lane's "(other)" row (claimed at
+  // load time, so it exists even when wire traffic filled every slot)
+  return lane >= 0 && lane < NL_LANE_COUNT ? g_method_overflow[lane] : -1;
+}
+
+// Lookup-only probe: never claims a slot. Read-side APIs (quantile
+// queries over caller-supplied names) must not burn table slots on
+// typos or methods that never ran.
+int nat_method_find(int lane, const char* method, size_t len) {
+  if (len >= kNatMethodNameLen) len = kNatMethodNameLen - 1;
+  uint32_t start = (uint32_t)(method_hash(lane, method, len) %
+                              kNatMethodSlots);
+  for (int probe = 0; probe < kNatMethodSlots; probe++) {
+    int idx = (int)((start + (uint32_t)probe) % kNatMethodSlots);
+    NatMethodCell& c = g_methods[idx];
+    uint32_t st = c.state.load(std::memory_order_acquire);
+    if (st == 0) return -1;  // first free slot in probe order: absent
+    if (st == 2 && c.lane == lane && strncmp(c.method, method, len) == 0 &&
+        c.method[len] == '\0') {
+      return idx;
+    }
+  }
+  return -1;
+}
+
+void nat_method_begin(int idx) {
+  if (idx < 0 || idx >= kNatMethodSlots) return;
+  NatMethodCell& c = g_methods[idx];
+  int64_t now = c.concurrency.fetch_add(1, std::memory_order_relaxed) + 1;
+  int64_t max = c.max_concurrency.load(std::memory_order_relaxed);
+  while (now > max && !c.max_concurrency.compare_exchange_weak(
+                          max, now, std::memory_order_relaxed)) {
+  }
+}
+
+void nat_method_end(int idx, uint64_t latency_ns, bool error) {
+  if (idx < 0 || idx >= kNatMethodSlots) return;
+  NatMethodCell& c = g_methods[idx];
+  c.concurrency.fetch_sub(1, std::memory_order_relaxed);
+  c.count.fetch_add(1, std::memory_order_relaxed);
+  if (error) c.errors.fetch_add(1, std::memory_order_relaxed);
+  c.hist[nat_hist_bucket(latency_ns)].fetch_add(1,
+                                                std::memory_order_relaxed);
+}
+
+void nat_method_abort(int idx) {
+  if (idx < 0 || idx >= kNatMethodSlots) return;
+  g_methods[idx].concurrency.fetch_sub(1, std::memory_order_relaxed);
+}
+
+// ---------------------------------------------------------------------------
 // span ring — seqlock slots under a monotonically-increasing ticket: the
 // writer marks a slot busy (odd), fills it, then publishes (2*ticket+2);
 // the drainer skips torn or overwritten slots instead of locking writers
@@ -143,6 +282,11 @@ static uint64_t span_rand() {
   return state;
 }
 
+// TSan cannot model the seqlock: the plain rec copy intentionally races
+// the drainer's read, which detects the overlap via the seq recheck and
+// discards the torn snapshot. Without the annotation the smoke reports
+// this benign race intermittently.
+__attribute__((no_sanitize("thread")))
 void nat_span_submit(const NatSpanRec& rec) {
   uint64_t ticket = g_span_head.fetch_add(1, std::memory_order_relaxed);
   SpanSlot& slot = g_span_ring[ticket & (kNatSpanRing - 1)];
@@ -240,12 +384,10 @@ int nat_stats_hist(int lane, uint64_t* out, int max) {
   return nb;
 }
 
-// Quantile (0..1) over a lane's combined histogram, interpolated within
-// the winning log2 bucket. ns; 0.0 when the lane is empty.
-double nat_stats_hist_quantile(int lane, double q) {
-  uint64_t buckets[kNatHistBuckets];
-  int nb = nat_stats_hist(lane, buckets, kNatHistBuckets);
-  if (nb == 0) return 0.0;
+// Quantile (0..1) over a log2 histogram, interpolated within the
+// winning bucket. ns; 0.0 when empty. Shared by the lane and per-method
+// quantile exports so the interpolation can never diverge between them.
+static double hist_quantile(const uint64_t* buckets, int nb, double q) {
   uint64_t total = 0;
   for (int b = 0; b < nb; b++) total += buckets[b];
   if (total == 0) return 0.0;
@@ -264,6 +406,45 @@ double nat_stats_hist_quantile(int lane, double q) {
     acc += (double)buckets[b];
   }
   return (double)(1ull << (nb - 1));
+}
+
+double nat_stats_hist_quantile(int lane, double q) {
+  uint64_t buckets[kNatHistBuckets];
+  int nb = nat_stats_hist(lane, buckets, kNatHistBuckets);
+  if (nb == 0) return 0.0;
+  return hist_quantile(buckets, nb, q);
+}
+
+// Snapshot the per-method table: fills up to `max` rows (used slots in
+// pool order) and returns the number written.
+int nat_method_stats(NatMethodStatRow* out, int max) {
+  int n = 0;
+  for (int i = 0; i < kNatMethodSlots && n < max; i++) {
+    NatMethodCell& c = g_methods[i];
+    if (c.state.load(std::memory_order_acquire) != 2) continue;
+    NatMethodStatRow& r = out[n++];
+    r.count = c.count.load(std::memory_order_relaxed);
+    r.errors = c.errors.load(std::memory_order_relaxed);
+    r.concurrency = c.concurrency.load(std::memory_order_relaxed);
+    r.max_concurrency = c.max_concurrency.load(std::memory_order_relaxed);
+    r.lane = c.lane;
+    memcpy(r.method, c.method, kNatMethodNameLen);
+  }
+  return n;
+}
+
+// Latency quantile (ns) over one method's log2 histogram; 0.0 when the
+// method is unknown or empty.
+double nat_method_quantile(int lane, const char* method, double q) {
+  if (method == nullptr) return 0.0;
+  int idx = nat_method_find(lane, method, strlen(method));
+  if (idx < 0) return 0.0;
+  NatMethodCell& c = g_methods[idx];
+  uint64_t buckets[kNatHistBuckets];
+  for (int b = 0; b < kNatHistBuckets; b++) {
+    buckets[b] = c.hist[b].load(std::memory_order_relaxed);
+  }
+  return hist_quantile(buckets, kNatHistBuckets, q);
 }
 
 // Arm (or clear, with 0,0) this thread's ambient trace context: client
@@ -285,6 +466,8 @@ void nat_stats_enable_spans(int every) {
 // Drain up to `max` span records into `out` (an array of NatSpanRec).
 // Returns the number copied. Records overwritten before this drain are
 // counted into nat_spans_dropped.
+// no_sanitize: seqlock reader — see nat_span_submit.
+__attribute__((no_sanitize("thread")))
 int nat_stats_drain_spans(NatSpanRec* out, int max) {
   std::lock_guard g(g_span_drain_mu);
   uint64_t head = g_span_head.load(std::memory_order_acquire);
@@ -334,6 +517,23 @@ void nat_stats_reset() {
           c->hist[l][b].store(0, std::memory_order_relaxed);
         }
       }
+    }
+  }
+  // per-method table: zero the VALUES, keep the claimed keys — in-flight
+  // begin/end pairs hold slot indices across the reset. concurrency is
+  // a LIVE gauge and must not be zeroed: an in-flight pair would net it
+  // to a permanent -1 (its end undoes a begin the reset erased) and
+  // every later max_concurrency high-water would under-report by one.
+  // With balanced begin/end it already reads 0 when nothing is in
+  // flight, which is the only state a between-tests reset runs in.
+  for (int i = 0; i < kNatMethodSlots; i++) {
+    NatMethodCell& c = g_methods[i];
+    if (c.state.load(std::memory_order_acquire) != 2) continue;
+    c.count.store(0, std::memory_order_relaxed);
+    c.errors.store(0, std::memory_order_relaxed);
+    c.max_concurrency.store(0, std::memory_order_relaxed);
+    for (int b = 0; b < kNatHistBuckets; b++) {
+      c.hist[b].store(0, std::memory_order_relaxed);
     }
   }
   std::lock_guard g2(g_span_drain_mu);

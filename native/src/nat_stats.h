@@ -131,6 +131,80 @@ inline void nat_lat_record(int lane, uint64_t ns) {
 }
 
 // ---------------------------------------------------------------------------
+// per-method stats — the native MethodStatus table (details/method_status.h
+// role): one slot per (lane, method) holding call count, error count, a
+// log2 latency histogram and current/max concurrency, recorded at the
+// same call sites that feed the NL_* lanes (nat_messenger / nat_http /
+// nat_h2 / nat_redis native handlers + the shm worker emit path).
+// Fixed open-addressed pool; slots are claimed once and never freed, so a
+// returned index stays valid forever (reset zeroes values, keeps keys).
+// ---------------------------------------------------------------------------
+
+inline constexpr int kNatMethodSlots = 128;
+inline constexpr int kNatMethodNameLen = 52;
+
+// Snapshot row (ctypes mirror in brpc_tpu/native, layout in the ABI
+// manifest): values only — the histogram is fetched per (lane, method).
+struct NatMethodStatRow {
+  uint64_t count;            // completed calls (qps source)
+  uint64_t errors;           // completions with a nonzero error/5xx
+  int64_t concurrency;       // running right now
+  int64_t max_concurrency;   // high-water mark since start/reset
+  int32_t lane;              // NatLatLane of the recording site
+  char method[kNatMethodNameLen];
+};
+
+// Find-or-create the slot for (lane, method); when the table is full
+// the lane's "(other)" overflow row is returned (method names arrive
+// off the wire, so exhaustion must degrade attribution, not stop it).
+int nat_method_idx(int lane, const char* method, size_t len);
+// Lookup-only: -1 when (lane, method) has no slot; never claims one.
+int nat_method_find(int lane, const char* method, size_t len);
+// One call entered usercode on this method (concurrency++, high-water).
+void nat_method_begin(int idx);
+// One call completed: concurrency--, count++, errors+=, histogram.
+void nat_method_end(int idx, uint64_t latency_ns, bool error);
+// Undo a begin with no completed call (shm offer that fell back to the
+// in-process lane): concurrency-- only.
+void nat_method_abort(int idx);
+
+// ---------------------------------------------------------------------------
+// per-connection snapshot row (native /connections): counters live on the
+// NatSocket itself (single-ish writers, relaxed atomics); the snapshot
+// walks the registry and fills one row per live socket.
+// ---------------------------------------------------------------------------
+
+struct NatConnRow {
+  uint64_t sock_id;
+  uint64_t in_bytes;         // bytes drained off this fd / ring buffers
+  uint64_t out_bytes;        // bytes the kernel accepted
+  uint64_t in_msgs;          // protocol messages parsed on this socket
+  uint64_t out_msgs;         // protocol messages emitted on this socket
+  uint64_t read_calls;       // read()/readv/ring-recv completions
+  uint64_t write_calls;      // writev/ring-send completions
+  uint64_t unwritten_bytes;  // queued on the write stack, not yet accepted
+  int32_t fd;
+  int32_t disp_idx;          // owning dispatcher loop (-1 = none)
+  int32_t server_side;       // 1 = accepted, 0 = dialed
+  char protocol[12];         // sniffed session kind ("tpu_std", "http"...)
+  char remote[24];           // "ip:port" peer address
+};
+
+// ---------------------------------------------------------------------------
+// lock-contention per-rank totals (nat_prof.cpp's mutex-wait profiler):
+// always-on cheap accounting on the CONTENDED path only — every NatMutex
+// lock() that fails its try_lock measures the blocking wait and feeds its
+// rank's row; stack sampling on top is armed via nat_mu_prof_start.
+// ---------------------------------------------------------------------------
+
+struct NatLockRankRow {
+  uint64_t waits;    // contended acquisitions observed
+  uint64_t wait_us;  // total microseconds spent blocked
+  int32_t rank;      // nat_lockrank.h rank value
+  char name[20];     // human name of the rank ("sock.epoll", ...)
+};
+
+// ---------------------------------------------------------------------------
 // span ring — fixed-size records of native-handled calls, drained by the
 // Python side into the shared /rpcz store (span.h:47-224 shape, with the
 // Collector budget expressed as a sampling stride).

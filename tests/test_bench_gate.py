@@ -255,3 +255,42 @@ def test_old_artifacts_are_not_baselines():
         pytest.skip("no schema'd baseline committed yet")
     n = int(os.path.basename(path)[len("BENCH_r"):-len(".json")])
     assert n >= 6
+
+
+def test_schema_bump_is_backward_compatible(pair):
+    """ISSUE 9 satellite: the /2 bump (extra.contention) must keep
+    gating against committed /1 baselines (BENCH_r07) — only a genuinely
+    foreign schema is a drift finding."""
+    base, cur = pair
+    base["schema"] = "brpc_tpu-bench-artifact/1"
+    assert "brpc_tpu-bench-artifact/1" in benchgate.SCHEMA_COMPAT
+    assert benchgate.compare(base, cur) == []
+    # and the other direction (re-diffing an old artifact) still works
+    assert benchgate.compare(cur, base) == []
+
+
+def test_artifact_records_contention(pair):
+    """The gated artifact carries extra.contention (top lock-wait
+    stacks), and a sublinear-scaling finding attaches both the
+    dispatcher-balance rows and the lock-wait stacks as evidence."""
+    bench = _bench_result()
+    bench["extra"]["contention"] = {
+        "samples": 9,
+        "ranks": [{"rank": 40, "name": "http.sess", "waits": 9,
+                   "wait_us": 1200}],
+        "collapsed": ["flush_chain;lock:http.sess<40> 1200"],
+    }
+    bench["extra"]["scaling"]["disp_stats"] = {
+        "2": [{"sockets": 2, "wakeups": 900, "sqpoll": -1},
+              {"sockets": 0, "wakeups": 3, "sqpoll": -1}]}
+    art = benchgate.make_artifact(bench, round_n=9)
+    assert art["schema"] == benchgate.SCHEMA
+    assert art["contention"]["samples"] == 9
+    base, cur = copy.deepcopy(art), copy.deepcopy(art)
+    cur["lanes"]["cpus2_scaling_x"] = 1.0
+    cur["scaling"]["host_parallel_x"] = 1.9
+    findings = benchgate.compare(base, cur)
+    sub = [f for f in findings if f.rule == "sublinear-scaling"]
+    assert sub, _rules(findings)
+    assert "per-dispatcher rows" in sub[0].message
+    assert "lock:http.sess" in sub[0].message

@@ -143,6 +143,42 @@ def test_every_counter_enum_in_prometheus_exposition(server):
         assert name in exposed, name
 
 
+def test_observatory_vars_in_prometheus_exposition(server):
+    """Drift guard extension (ISSUE 9): the per-method, per-socket and
+    lock-contention vars must appear in the /brpc_metrics exposition as
+    labeled rows, with label values escaped (method paths contain '/';
+    quotes/backslashes must be escaped per the exposition format)."""
+    import re
+
+    from brpc_tpu.bvar.variable import _prom_label_escape
+
+    srv, port = server
+    native.mu_contend_selftest(4, 50, 20)  # ensure a contention row
+    status, body = _get(port, "/brpc_metrics")
+    assert status == 200
+    for vname in ("nat_method_count", "nat_method_errors",
+                  "nat_method_qps", "nat_method_concurrency",
+                  "nat_method_max_concurrency",
+                  "nat_method_latency_p99_us",
+                  "nat_connection_in_bytes", "nat_connection_out_bytes",
+                  "nat_connection_unwritten_bytes",
+                  "nat_lock_contention_waits",
+                  "nat_lock_contention_wait_us"):
+        labeled = [ln for ln in body.splitlines()
+                   if ln.startswith(vname + "{")]
+        assert labeled, f"{vname} has no labeled rows in /brpc_metrics"
+    # (concrete live-traffic row values are asserted in
+    # tests/test_native_observatory.py::test_prometheus_method_labels)
+    # no label value may contain an UNESCAPED quote: every labeled row
+    # must re-parse with the exposition's escaping rules
+    lab_re = re.compile(r'^\w+\{((?:\w+="(?:[^"\\]|\\.)*",?)+)\} ')
+    for ln in body.splitlines():
+        if "{" in ln and ln.startswith("nat_"):
+            assert lab_re.match(ln), f"malformed labeled row: {ln!r}"
+    # escaping helper round-trips the nasty cases
+    assert _prom_label_escape('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
 def test_multicore_counters_and_dispatcher_rows(server):
     """ISSUE 7 observability satellite: the dispatcher/scheduler scale-out
     counters ride the same drift-guarded enum (so /brpc_metrics carries
@@ -291,3 +327,28 @@ def test_native_stack_exits_clean():
                          cwd=repo_root, env=env)
     assert res.returncode == 0, (res.returncode, res.stderr[-2000:])
     assert "clean" in res.stdout
+
+
+def test_lockrank_names_track_header():
+    """Drift guard: every kLockRank constant in nat_lockrank.h (and
+    every raw-rank `// N: name` comment row) must resolve through
+    nat_mu_rank_name — the hand-mirrored switch in nat_prof.cpp is the
+    only thing turning /hotspots/contention ranks into names, and a
+    rank added to the header without a row would silently report as
+    "rank<N>"."""
+    import os
+    import re
+
+    hdr = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "src", "nat_lockrank.h")
+    with open(hdr) as f:
+        text = f.read()
+    # same shape the natcheck lockorder pass parses
+    ranks = {int(v): k for k, v in
+             re.findall(r"\b(kLockRank\w+)\s*=\s*(\d+)", text)}
+    assert len(ranks) >= 30, "lockrank header parse came up short"
+    missing = [f"{name}={rank}" for rank, name in sorted(ranks.items())
+               if native.mu_rank_name(rank) is None]
+    assert not missing, (
+        "nat_lockrank.h ranks without a mu_rank_name row "
+        f"(add them to the switch in nat_prof.cpp): {missing}")

@@ -43,7 +43,12 @@ from typing import Dict, List, Optional
 
 from tools.natcheck import Finding, REPO_ROOT
 
-SCHEMA = "brpc_tpu-bench-artifact/1"
+SCHEMA = "brpc_tpu-bench-artifact/2"
+# /2 only ADDS the extra.contention block (top lock-wait stacks of the
+# loopback window) — artifacts of the previous generation stay fully
+# comparable, so committed /1 baselines (BENCH_r07) keep gating until a
+# fresh round is recorded.
+SCHEMA_COMPAT = {"brpc_tpu-bench-artifact/1", SCHEMA}
 
 # artifact written by every gated run (gitignored; the committed
 # baseline is the newest BENCH_r*.json carrying the schema field)
@@ -134,6 +139,7 @@ def make_artifact(bench: dict, round_n: int, rc: int = 0,
         "scaling": extra.get("scaling", {}),
         "rpcz_percentiles": extra.get("native_latency_us", {}),
         "nat_prof": extra.get("nat_prof", {}),
+        "contention": extra.get("contention", {}),
         "bench": bench,
     }
 
@@ -186,7 +192,7 @@ def find_baseline(repo_root: str = REPO_ROOT) -> Optional[str]:
                 doc = json.load(f)
         except (OSError, ValueError):
             continue
-        if doc.get("schema") != SCHEMA:
+        if doc.get("schema") not in SCHEMA_COMPAT:
             continue  # pre-gate rounds (r01..r05) have no lane schema
         if int(m.group(1)) > best_n:
             best_n, best = int(m.group(1)), path
@@ -201,13 +207,27 @@ def _profile_excerpt(current: dict, lines: int = 12) -> str:
         flat[:lines])
 
 
+def _contention_excerpt(current: dict, lines: int = 6) -> str:
+    """Top lock-wait stacks of the regressing run (extra.contention) —
+    a lane that slowed down because a lock crept back into the
+    write/dispatch path names itself here."""
+    collapsed = (current.get("contention") or {}).get("collapsed") or []
+    if not collapsed:
+        return ""
+    return "; top lock-wait stacks:\n      " + "\n      ".join(
+        collapsed[:lines])
+
+
 def compare(baseline: dict, current: dict) -> List[Finding]:
     """Diff two artifacts' headline lanes. Pure function (golden-tested:
     clean / one-lane-regressed / missing-lane / schema-drift)."""
     findings: List[Finding] = []
     where = "tools/check.sh --bench"
+    # either side may speak any compatible generation — the bump (/2)
+    # only ADDS the contention block, so committed /1 rounds (BENCH_r07)
+    # keep gating and re-diffing old artifacts keeps working
     for doc, label in ((baseline, "baseline"), (current, "current")):
-        if doc.get("schema") != SCHEMA:
+        if doc.get("schema") not in SCHEMA_COMPAT:
             findings.append(Finding(
                 "bench", "schema-drift", where,
                 f"{label} artifact schema is "
@@ -236,7 +256,7 @@ def compare(baseline: dict, current: dict) -> List[Finding]:
                 f"lane {lane!r} present in the baseline "
                 f"({base_v:.1f}) but missing from the current run — a "
                 f"silently-dropped lane is a regression, not a skip"
-                + _profile_excerpt(current)))
+                + _contention_excerpt(current) + _profile_excerpt(current)))
             continue
         cur_v = float(cur_lanes[lane])
         floor = base_v * (1.0 - tol)
@@ -246,7 +266,7 @@ def compare(baseline: dict, current: dict) -> List[Finding]:
                 "bench", "regression", where,
                 f"lane {lane!r} regressed {drop:.1f}%: {base_v:.1f} -> "
                 f"{cur_v:.1f} (tolerance band {tol * 100:.0f}%)"
-                + _profile_excerpt(current)))
+                + _contention_excerpt(current) + _profile_excerpt(current)))
     # absolute sublinear-scaling floor (independent of any baseline):
     # the host probe proved parallel headroom, the runtime didn't use it
     scaling_x = cur_lanes.get("cpus2_scaling_x")
@@ -254,12 +274,20 @@ def compare(baseline: dict, current: dict) -> List[Finding]:
     if isinstance(scaling_x, (int, float)) and \
             isinstance(host_x, (int, float)) and \
             host_x >= SCALING_MIN_HOST_X and scaling_x < SCALING_ABS_MIN_X:
+        disp = (current.get("scaling") or {}).get("disp_stats", {})
+        disp_note = ""
+        if disp:
+            # dispatcher-balance evidence: the per-loop wakeup split at
+            # each measured point says whether the loops shared the load
+            disp_note = "; per-dispatcher rows: " + "; ".join(
+                f"{pt}cpus={rows}" for pt, rows in sorted(disp.items()))
         findings.append(Finding(
             "bench", "sublinear-scaling", where,
             f"2-cpu scaling is {scaling_x:.2f}x while the host's own "
             f"parallel capacity probe measured {host_x:.2f}x — the "
             f"runtime left real cores idle (shared-state bottleneck in "
-            f"the write/dispatch path?)" + _profile_excerpt(current)))
+            f"the write/dispatch path?)" + disp_note
+            + _contention_excerpt(current) + _profile_excerpt(current)))
     return findings
 
 
