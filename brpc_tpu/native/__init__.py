@@ -276,6 +276,11 @@ def load() -> ctypes.CDLL:
         lib.nat_fault_configure.restype = ctypes.c_int
         lib.nat_fault_enabled.restype = ctypes.c_int
         lib.nat_fault_injected.restype = ctypes.c_uint64
+        # -- refcount-contract runtime twin (nat_refguard.cpp) --
+        lib.nat_refguard_enabled.restype = ctypes.c_int
+        lib.nat_refguard_ops.restype = ctypes.c_uint64
+        lib.nat_refguard_selftest.argtypes = [ctypes.c_int]
+        lib.nat_refguard_selftest.restype = ctypes.c_int
         # -- client circuit breaker + retry budget (nat_channel.cpp) --
         lib.nat_channel_set_breaker.argtypes = [ctypes.c_void_p,
                                                 ctypes.c_int]
@@ -709,6 +714,25 @@ def fault_injected() -> int:
     """Total faults injected in THIS process since load (also exported
     as the nat_faults_injected counter)."""
     return load().nat_fault_injected()
+
+
+def refguard_enabled() -> bool:
+    """True when the loaded .so was built with -DNAT_REFGUARD (the
+    NAT_REF_* ownership ledger of native/src/nat_refown.h is live —
+    `make -C native refguard` + the BRPC_TPU_NATIVE_SO override)."""
+    return bool(load().nat_refguard_enabled())
+
+
+def refguard_ops() -> int:
+    """Total refguard ledger operations recorded (0 in normal builds)."""
+    return load().nat_refguard_ops()
+
+
+def refguard_selftest(scenario: int = 0) -> int:
+    """Scenario 0: balanced acquire/transfer/borrow/release/dead round
+    (returns 0 in every build). Scenario 1: deliberate double release —
+    ABORTS the process under refguard, returns -1 otherwise."""
+    return load().nat_refguard_selftest(scenario)
 
 
 def rpc_server_limiter(spec: str = "") -> int:

@@ -21,6 +21,19 @@
 //            mid-flight producer: publish-under-lock means recovery can
 //            never observe a half-offered record; publishing outside
 //            the lock (--bug recover-late-publish) is caught
+//   refrace  the versioned-ref borrow protocol (sock_address's
+//            version-gated CAS pin vs release's deferred close + slot
+//            recycle + re-create): a borrow either pins the ORIGINAL
+//            object until released or fails; a borrower that skips the
+//            version check (--bug refrace-no-version) pins the
+//            RECYCLED socket through a stale id and is caught
+//   refxfer  the admission-token transfer onto a shm InflightEntry
+//            (shm_lane_offer's track-before-publish + transfer-if-
+//            present + producer fallback): the token is released
+//            exactly once no matter how the worker's answer interleaves
+//            with the transfer; transferring without the presence
+//            check (--bug refxfer-blind) orphans the token and is
+//            caught
 //   quiesce  arm_close_after_drain vs the wstack drain-role release —
 //            the graceful-close Dekker pairing nat_server_quiesce's
 //            final pass stands on: a drain-vs-late-arrival or
@@ -679,6 +692,193 @@ bool quiesce_validate(std::string* why) {
   return ok;
 }
 
+// ---- refrace: versioned-ref borrow vs release / deferred close ---------
+//
+// The sock_address / SetFailed discipline of nat_socket.cpp (refown tag
+// sock.borrow vs sock.registry): one atomic word packs (version<<32 |
+// refcount); a borrow CAS-increments the refcount ONLY while the id's
+// version matches, the owner invalidates by bumping the version
+// (sock_unregister) and then drops the creator reference, and the slot
+// recycles exactly when the refcount hits zero — so a borrow either
+// pins the ORIGINAL object until released, or fails. After the recycle
+// the slot is re-created with a fresh version (a different logical
+// socket). --bug refrace-no-version seeds the defect the version half
+// exists to forbid: a borrower that only checks refcount != 0 can pin
+// the RECYCLED socket through its stale id — caught when the borrowed
+// object's logical id is not the one the id named.
+
+bool g_refrace_bug = false;  // --bug refrace-no-version
+
+struct RefraceState {
+  dsched::atomic<uint64_t>* vref = nullptr;
+  dsched::atomic<int>* logical = nullptr;   // which socket lives here
+  dsched::atomic<int>* recycles = nullptr;
+  dsched::atomic<int>* borrows = nullptr;
+};
+RefraceState* g_rr = nullptr;
+
+// the release half (NatSocket::release): last ref recycles the slot
+void refrace_release(RefraceState* st) {
+  uint64_t prev = st->vref->fetch_sub(1, std::memory_order_acq_rel);
+  dsched::check((uint32_t)prev != 0, "release with refcount zero");
+  if ((uint32_t)prev == 1) {
+    st->recycles->fetch_add(1, std::memory_order_seq_cst);
+    // reuse: sock_create on the freed slot — fresh version, new object
+    st->logical->store(2, std::memory_order_seq_cst);
+    st->vref->store((2ull << 32) | 1, std::memory_order_seq_cst);
+  }
+}
+
+void refrace_body() {
+  g_rr = new RefraceState();
+  RefraceState* st = g_rr;
+  st->vref = new dsched::atomic<uint64_t>((1ull << 32) | 1);
+  st->logical = new dsched::atomic<int>(1);
+  st->recycles = new dsched::atomic<int>(0);
+  st->borrows = new dsched::atomic<int>(0);
+
+  dsched::spawn([st] {  // borrower: sock_address(id with version 1)
+    uint64_t vr = st->vref->load(std::memory_order_acquire);
+    while ((g_refrace_bug || (uint32_t)(vr >> 32) == 1) &&
+           (uint32_t)vr != 0) {
+      if (st->vref->compare_exchange_weak(vr, vr + 1,
+                                          std::memory_order_acq_rel)) {
+        // the pin must reference the object id 1 NAMED — a recycled
+        // slot reached through a stale id is the use-after-free class
+        dsched::check(st->logical->load(std::memory_order_seq_cst) == 1,
+                      "borrow through a stale id pinned the recycled "
+                      "socket");
+        st->borrows->fetch_add(1, std::memory_order_relaxed);
+        refrace_release(st);
+        return;
+      }
+    }
+  });
+  dsched::spawn([st] {  // owner: set_failed = unregister + drop registry
+    uint64_t vr = st->vref->load(std::memory_order_acquire);
+    while (!st->vref->compare_exchange_weak(vr, vr + (1ull << 32),
+                                            std::memory_order_acq_rel)) {
+    }
+    refrace_release(st);  // drop the sock.registry reference
+  });
+}
+
+bool refrace_validate(std::string* why) {
+  RefraceState* st = g_rr;
+  bool ok = true;
+  uint64_t vr = st->vref->load(std::memory_order_relaxed);
+  if (st->recycles->load(std::memory_order_relaxed) != 1) {
+    *why = "slot recycled " +
+           std::to_string(st->recycles->load(std::memory_order_relaxed)) +
+           " times (want exactly once)";
+    ok = false;
+  } else if ((uint32_t)vr != 1) {
+    *why = "final refcount " + std::to_string((uint32_t)vr) +
+           " (want the re-created slot's creator ref only)";
+    ok = false;
+  }
+  delete st->vref;
+  delete st->logical;
+  delete st->recycles;
+  delete st->borrows;
+  delete st;
+  g_rr = nullptr;
+  return ok;
+}
+
+// ---- refxfer: admission-token transfer onto a shm InflightEntry --------
+//
+// shm_lane_offer's token discipline (refown tags adm.pyreq ->
+// adm.inflight): the entry is tracked BEFORE the descriptor publishes
+// (a worker may answer instantly), the token transfers onto the entry
+// only if the entry is still present, and whichever side ends up
+// holding the token releases it exactly once — the producer's fallback
+// arm covers the worker-answered-first race. --bug refxfer-blind seeds
+// the transfer without the presence check: the token is marked
+// transferred even when the worker already erased the entry, so nobody
+// releases it — the in-flight count leaks (caught by the validator).
+
+bool g_refxfer_bug = false;  // --bug refxfer-blind
+
+struct RefxferState {
+  dsched::atomic<int>* tokens = nullptr;     // admitted in-flight count
+  dsched::atomic<uint32_t>* pushed = nullptr;  // descriptor doorbell
+  dsched::mutex* mu = nullptr;               // g_inflight_mu
+  int entry_state = 0;     // under mu: 0 none, 1 present, 3 erased
+  bool entry_admitted = false;  // under mu
+  bool r_admitted = false;      // producer-owned (the PyRequest bit)
+};
+RefxferState* g_rx = nullptr;
+
+void refxfer_body() {
+  g_rx = new RefxferState();
+  RefxferState* st = g_rx;
+  st->tokens = new dsched::atomic<int>(0);
+  st->pushed = new dsched::atomic<uint32_t>(0);
+  st->mu = new dsched::mutex();
+
+  dsched::spawn([st] {  // worker/drainer: erase + complete
+    for (;;) {
+      uint32_t v = st->pushed->load(std::memory_order_seq_cst);
+      if (v != 0) break;
+      dsched::futex_wait(st->pushed, v);
+    }
+    bool admitted = false;
+    st->mu->lock();
+    if (st->entry_state == 1) {
+      admitted = st->entry_admitted;
+      st->entry_state = 3;
+    }
+    st->mu->unlock();
+    if (admitted) {
+      int prev = st->tokens->fetch_sub(1, std::memory_order_acq_rel);
+      dsched::check(prev > 0, "inflight token released twice");
+    }
+  });
+
+  // producer: overload_admit -> track entry -> publish -> transfer
+  st->tokens->fetch_add(1, std::memory_order_acq_rel);
+  st->r_admitted = true;
+  st->mu->lock();
+  st->entry_state = 1;
+  st->entry_admitted = false;
+  st->mu->unlock();
+  st->pushed->fetch_add(1, std::memory_order_seq_cst);
+  dsched::futex_wake(st->pushed);
+  st->mu->lock();
+  if (g_refxfer_bug) {
+    // seeded defect: transfer without the presence check — if the
+    // worker erased first, the token is orphaned (nobody releases)
+    st->entry_admitted = st->r_admitted;
+    st->r_admitted = false;
+  } else if (st->entry_state == 1) {
+    st->entry_admitted = st->r_admitted;
+    st->r_admitted = false;
+  }
+  st->mu->unlock();
+  if (st->r_admitted) {  // worker answered before the transfer
+    st->r_admitted = false;
+    int prev = st->tokens->fetch_sub(1, std::memory_order_acq_rel);
+    dsched::check(prev > 0, "inflight token released twice");
+  }
+}
+
+bool refxfer_validate(std::string* why) {
+  RefxferState* st = g_rx;
+  bool ok = st->tokens->load(std::memory_order_relaxed) == 0;
+  if (!ok) {
+    *why = "admission token count ends at " +
+           std::to_string(st->tokens->load(std::memory_order_relaxed)) +
+           " (want 0: released exactly once, leaked never)";
+  }
+  delete st->tokens;
+  delete st->pushed;
+  delete st->mu;
+  delete st;
+  g_rx = nullptr;
+  return ok;
+}
+
 // ---- harness -----------------------------------------------------------
 
 struct Scenario {
@@ -702,6 +902,8 @@ const Scenario kScenarios[] = {
     {"butex", butex_body, butex_validate, 4000, 400, 4},
     {"recover", recover_body, recover_validate, 2500, 300, 3},
     {"quiesce", quiesce_body, quiesce_validate, 4000, 400, 3},
+    {"refrace", refrace_body, refrace_validate, 4000, 400, 4},
+    {"refxfer", refxfer_body, refxfer_validate, 4000, 400, 3},
 };
 
 int run_scenario(const Scenario& sc, dsched::Mode mode, uint64_t seed,
@@ -757,6 +959,8 @@ int main(int argc, char** argv) {
       if (b == "butex-no-fence") g_butex_bug = true;
       else if (b == "recover-late-publish") g_recover_bug = true;
       else if (b == "quiesce-arm-late") g_quiesce_bug = true;
+      else if (b == "refrace-no-version") g_refrace_bug = true;
+      else if (b == "refxfer-blind") g_refxfer_bug = true;
       else {
         fprintf(stderr, "unknown --bug %s\n", b.c_str());
         return 2;
@@ -768,7 +972,7 @@ int main(int argc, char** argv) {
       fprintf(stderr,
               "usage: nat_model [--smoke] [--scenario NAME|all] "
               "[--mode dfs|random|both] [--seed N] [--execs N] "
-              "[--preempt N] [--bug butex-no-fence|recover-late-publish|quiesce-arm-late] "
+              "[--preempt N] [--bug butex-no-fence|recover-late-publish|quiesce-arm-late|refrace-no-version|refxfer-blind] "
               "[--list]\n");
       return 2;
     }

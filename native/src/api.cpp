@@ -62,6 +62,9 @@ struct PingPongArg {
 };
 static void ping_pong_fiber(void* p) {
   PingPongArg* arg = (PingPongArg*)p;
+  // the fetch_adds below are butex WAKE-PROTOCOL value bumps, not
+  // reference counts — they are outside the NAT_REF_* ownership surface
+  // (tools/natcheck refown) by design
   for (int i = 0; i < arg->rounds; i++) {
     if (arg->is_ping) {
       arg->b->value.fetch_add(1, std::memory_order_release);

@@ -22,13 +22,14 @@ namespace brpc_tpu {
 
 static constexpr size_t kBlockBatch = 8;
 
-// central pool of 8-block chains (linked via IOBlock::pool_next), leaked
-// like every runtime static (threads run through exit())
+// central pool of 8-block chains (linked via IOBlock::pool_next)
 struct CentralBlockPool {
   NatMutex<kLockRankBlockPool> pool_mu;
   std::vector<IOBlock*> batches;       // each entry: chain of kBlockBatch
   static constexpr size_t kMaxBatches = 64;  // 4MB cap; beyond -> delete
 };
+// natcheck:leak(g_block_pool): leaked like every runtime static —
+// threads keep recycling blocks through exit()
 static CentralBlockPool& g_block_pool = *new CentralBlockPool();
 
 // Per-thread block cache: blocks freed on this thread are kept for reuse;
@@ -45,7 +46,9 @@ struct TlsBlockCache {
     if (share != nullptr) {
       // drop the creator ref WITHOUT IOBlock::release(): a zero refcount
       // must not recycle into this half-destroyed cache
+      NAT_REF_RELEASED(share, iob.share);
       if (share->ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        NAT_REF_DEAD(share);
         delete share;
       }
       share = nullptr;
@@ -97,13 +100,18 @@ IOBlock* IOBlock::create() {
       head = next;
     }
   }
+  IOBlock* b;
   if (c.n > 0) {
-    IOBlock* b = c.blocks[--c.n];
+    b = c.blocks[--c.n];
     b->ref.store(1, std::memory_order_relaxed);
     b->size = 0;
-    return b;
+  } else {
+    b = new IOBlock();  // ctor ref{1}
   }
-  return new IOBlock();
+  // the initial reference: the creating scope releases it or transfers
+  // it (to iob.share / the first BlockRef)
+  NAT_REF_ACQUIRED(b, iob.creator);
+  return b;
 }
 
 void IOBlock::recycle(IOBlock* b) {
@@ -159,8 +167,9 @@ IOBlock* IOBlock::create_user(const char* p, size_t len,
 static IOBlock* tls_share_block() {
   TlsBlockCache& c = tls_cache;
   if (c.share == nullptr || c.share->left() == 0) {
-    if (c.share) c.share->release();
+    if (c.share) NAT_REF_RELEASE(c.share, iob.share);
     c.share = IOBlock::create();
+    NAT_REF_TRANSFER(c.share, iob.creator, iob.share);
   }
   return c.share;
 }
@@ -211,7 +220,7 @@ void IOBuf::push_ref(IOBlock* b, uint32_t off, uint32_t len) {
       return;
     }
   }
-  b->add_ref();
+  NAT_REF_ACQUIRE(b, iob.ref);
   push_back({b, off, len});
   length_ += len;
 }
@@ -236,7 +245,8 @@ void IOBuf::append_user(const char* p, size_t n, void (*free_fn)(void*),
     return;
   }
   IOBlock* b = IOBlock::create_user(p, n, free_fn, arg);
-  push_back({b, 0, (uint32_t)n});  // creator ref transfers to the IOBuf
+  NAT_REF_TRANSFER(b, iob.creator, iob.ref);  // the IOBuf owns it now
+  push_back({b, 0, (uint32_t)n});
   length_ += n;
 }
 
@@ -270,7 +280,7 @@ void IOBuf::append(const IOBuf& other) {
   }
   for (uint32_t i = 0; i < other.count_; i++) {
     const BlockRef& r = other.at(i);
-    r.block->add_ref();
+    NAT_REF_ACQUIRE(r.block, iob.ref);
     push_back(r);
     length_ += r.length;
   }
@@ -315,7 +325,7 @@ size_t IOBuf::cut_into(IOBuf* out, size_t n) {
       length_ -= r.length;
       drop_front();
     } else {
-      r.block->add_ref();
+      NAT_REF_ACQUIRE(r.block, iob.ref);
       out->push_back({r.block, r.offset, (uint32_t)remain});
       out->length_ += remain;
       r.offset += remain;
@@ -335,7 +345,7 @@ size_t IOBuf::pop_front_slow(size_t n) {
     if (r.length <= remain) {
       remain -= r.length;
       length_ -= r.length;
-      r.block->release();
+      NAT_REF_RELEASE(r.block, iob.ref);
       drop_front();
     } else {
       r.offset += remain;
@@ -444,7 +454,7 @@ ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes) {
     for (int i = 0; i < nspare; i++) {
       IOBlock* sb = spare[i];
       if (remain == 0) {
-        sb->release();  // unused: back to the cache
+        NAT_REF_RELEASE(sb, iob.creator);  // unused: back to the cache
         continue;
       }
       take = std::min(remain, IOBlock::kSize);
@@ -454,14 +464,19 @@ ssize_t IOBuf::append_from_fd(int fd, size_t max_bytes) {
       if (sb->left() > 0) {
         // partially-filled spare becomes the new share block so the
         // next append continues filling it
-        if (tls_cache.share != nullptr) tls_cache.share->release();
-        tls_cache.share = sb;  // transfers our creator reference
+        if (tls_cache.share != nullptr) {
+          NAT_REF_RELEASE(tls_cache.share, iob.share);
+        }
+        NAT_REF_TRANSFER(sb, iob.creator, iob.share);
+        tls_cache.share = sb;
       } else {
-        sb->release();  // full: only the IOBuf ref keeps it
+        NAT_REF_RELEASE(sb, iob.creator);  // full: only the IOBuf ref
       }
     }
   } else {
-    for (int i = 0; i < nspare; i++) spare[i]->release();
+    for (int i = 0; i < nspare; i++) {
+      NAT_REF_RELEASE(spare[i], iob.creator);
+    }
   }
   return n;
 }

@@ -16,6 +16,8 @@
 #include <string>
 #include <sys/uio.h>
 
+#include "nat_refown.h"
+
 namespace brpc_tpu {
 
 struct IOBlock {
@@ -48,7 +50,10 @@ struct IOBlock {
   static void recycle(IOBlock* b);
   void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
   void release() {
-    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) recycle(this);
+    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      NAT_REF_DEAD(this);  // refguard: every tag balanced before recycle
+      recycle(this);
+    }
   }
   size_t left() const { return user_ptr != nullptr ? 0 : kSize - size; }
   char* payload() { return user_ptr != nullptr ? user_ptr : data; }
@@ -94,7 +99,9 @@ class IOBuf {
   bool empty() const { return length_ == 0; }
 
   void clear() {
-    for (uint32_t i = 0; i < count_; i++) refs_[begin_ + i].block->release();
+    for (uint32_t i = 0; i < count_; i++) {
+      NAT_REF_RELEASE(refs_[begin_ + i].block, iob.ref);
+    }
     begin_ = 0;
     count_ = 0;
     length_ = 0;

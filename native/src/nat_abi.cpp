@@ -307,6 +307,9 @@ int main() {
       NAT_SYM(nat_mu_rank_stats),
       NAT_SYM(nat_mu_rank_name),
       NAT_SYM(nat_mu_contend_selftest),
+      NAT_SYM(nat_refguard_enabled),
+      NAT_SYM(nat_refguard_ops),
+      NAT_SYM(nat_refguard_selftest),
       NAT_SYM(nat_prof_start),
       NAT_SYM(nat_prof_stop),
       NAT_SYM(nat_prof_running),

@@ -281,6 +281,20 @@ const char* nat_mu_rank_name(int rank);
 // count.
 uint64_t nat_mu_contend_selftest(int nthreads, int iters, int hold_us);
 
+// ---- refcount-contract runtime twin (nat_refguard.cpp) ----
+// The NAT_REF_* ownership ledger of nat_refown.h, live only in
+// -DNAT_REFGUARD builds (`make -C native refguard`); the exports exist
+// in every build so the ABI surface is build-invariant.
+// 1 when the ledger is compiled in.
+int nat_refguard_enabled(void);
+// Total ledger operations recorded (0 in normal builds).
+uint64_t nat_refguard_ops(void);
+// Scenario 0: balanced acquire/transfer/borrow/release/dead round,
+// returns 0 in every build. Scenario 1: deliberate double release —
+// refguard builds ABORT with the failing tag pair (the golden tests'
+// seam); normal builds return -1.
+int nat_refguard_selftest(int scenario);
+
 // ---- in-process sampling profiler (nat_prof.cpp) ----
 // SIGPROF/CPU-time stack sampling with frame-pointer unwind into
 // lock-free per-thread rings; reports are flat symbol tables (mode 0)

@@ -22,6 +22,8 @@ static double run_client_bench(const char* ip, int port, int nconn,
   // flight, and a stack-lifetime Butex is a use-after-free window. The
   // old mutex "destruction handshake" only synchronized with slow-path
   // wakers (TSan-lane finding; see tools/natcheck/README.md).
+  // natcheck:leak(run_client_bench): see the comment above — freeing it
+  // re-opens the lock-free butex_wake use-after-free window
   Butex* done_count = new Butex();
   std::vector<NatChannel*> channels;
   int nfibers = 0;
@@ -66,7 +68,7 @@ static void bench_call_fiber(void* a) {
     int64_t cid = 0;
     PendingCall* pc = ch->begin_call(&cid);
     if (pc == nullptr) {
-      s->release();
+      NAT_REF_RELEASE(s, sock.borrow);
       break;
     }
     IOBuf frame;
@@ -85,7 +87,7 @@ static void bench_call_fiber(void* a) {
         }
         pc_free(pc);
       }
-      s->release();
+      NAT_REF_RELEASE(s, sock.borrow);
       break;
     }
     while (pc->done.value.load(std::memory_order_acquire) == 0) {
@@ -93,7 +95,7 @@ static void bench_call_fiber(void* a) {
     }
     bool ok = (pc->error_code == 0);
     pc_free(pc);
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     if (!ok) break;
     arg->total->fetch_add(1, std::memory_order_relaxed);
   }
@@ -144,7 +146,10 @@ struct AsyncBenchConn {
 
   void add_ref() { refs.fetch_add(1, std::memory_order_relaxed); }
   void release() {
-    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      NAT_REF_DEAD(this);
+      delete this;
+    }
   }
 };
 
@@ -157,7 +162,7 @@ static void async_bench_cb(PendingCall* pc, void* arg) {
   ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
   ab->room.value.fetch_add(1, std::memory_order_release);
   Scheduler::butex_wake(&ab->room, 1);
-  ab->release();  // the in-flight reference
+  NAT_REF_RELEASE(ab, bench.call);  // the in-flight reference
 }
 
 static void async_bench_fiber(void* a) {
@@ -183,11 +188,11 @@ static void async_bench_fiber(void* a) {
     for (int i = 0; i < room; i++) {
       int64_t cid = 0;
       ab->inflight.fetch_add(1, std::memory_order_acq_rel);
-      ab->add_ref();  // released by async_bench_cb
+      NAT_REF_ACQUIRE(ab, bench.call);  // async_bench_cb releases
       PendingCall* pc = ch->begin_call(&cid, async_bench_cb, ab);
       if (pc == nullptr) {
         ab->inflight.fetch_sub(1, std::memory_order_acq_rel);
-        ab->release();
+        NAT_REF_RELEASE(ab, bench.call);
         dead = true;
         break;
       }
@@ -202,7 +207,7 @@ static void async_bench_fiber(void* a) {
       ch->fail_all(kEFAILEDSOCKET, "socket failed");
       dead = true;
     }
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     if (dead) break;
   }
   // drain the window before reporting done
@@ -212,7 +217,8 @@ static void async_bench_fiber(void* a) {
     Scheduler::butex_wait(&ab->room, expected);
   }
   Butex* done = ab->done_count;
-  ab->release();  // the sender fiber's reference; cb refs may outlive us
+  // the sender fiber's reference; cb refs may outlive us
+  NAT_REF_RELEASE(ab, bench.owner);
   done->value.fetch_add(1, std::memory_order_release);
   Scheduler::butex_wake(done, INT32_MAX);
 }
@@ -230,15 +236,16 @@ double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
       [&](NatChannel* ch, std::atomic<bool>* stop,
           std::atomic<uint64_t>* total, Butex* done) {
         AsyncBenchConn* ab = new AsyncBenchConn();
+        NAT_REF_ACQUIRED(ab, bench.owner);  // refs{1} = the sender fiber
         ab->ch = ch;
         ab->stop = stop;
         ab->total = total;
         ab->payload = &payload;
         ab->done_count = done;
         ab->window = window > 0 ? window : 64;
-        ab->add_ref();  // the harness's own reference (released below) —
-                        // a conn whose fiber died early must outlive
-                        // on_stop's wakeup sweep
+        // the harness's own reference (released below) — a conn whose
+        // fiber died early must outlive on_stop's wakeup sweep
+        NAT_REF_ACQUIRE(ab, bench.owner);
         conns.push_back(ab);
         Scheduler::instance()->spawn_detached(async_bench_fiber, ab);
         return 1;
@@ -249,7 +256,7 @@ double nat_rpc_client_bench_async(const char* ip, int port, int nconn,
           Scheduler::butex_wake(&ab->room, INT32_MAX);
         }
       });
-  for (AsyncBenchConn* ab : conns) ab->release();
+  for (AsyncBenchConn* ab : conns) NAT_REF_RELEASE(ab, bench.owner);
   return qps;
 }
 
@@ -284,7 +291,7 @@ double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
                 int64_t cid = 0;
                 PendingCall* pc = ch->begin_call(&cid);
                 if (pc == nullptr) {
-                  s->release();
+                  NAT_REF_RELEASE(s, sock.borrow);
                   break;
                 }
                 IOBuf frame;
@@ -303,7 +310,7 @@ double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
                     }
                     pc_free(pc);
                   }
-                  s->release();
+                  NAT_REF_RELEASE(s, sock.borrow);
                   break;
                 }
                 while (pc->done.value.load(std::memory_order_acquire) == 0) {
@@ -312,7 +319,7 @@ double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
                 bool ok = (pc->error_code == 0 &&
                            pc->attachment.length() == arg->att->size());
                 pc_free(pc);
-                s->release();
+                NAT_REF_RELEASE(s, sock.borrow);
                 if (!ok) break;
                 arg->total->fetch_add(1, std::memory_order_relaxed);
               }
@@ -674,7 +681,10 @@ struct CliLaneConn {
 
   void add_ref() { refs.fetch_add(1, std::memory_order_relaxed); }
   void release() {
-    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      NAT_REF_DEAD(this);
+      delete this;
+    }
   }
 };
 
@@ -689,7 +699,7 @@ static void cli_lane_cb(void* arg, int32_t ec, int32_t aux,
   cc->inflight.fetch_sub(1, std::memory_order_acq_rel);
   cc->room.value.fetch_add(1, std::memory_order_release);
   Scheduler::butex_wake(&cc->room, 1);
-  cc->release();
+  NAT_REF_RELEASE(cc, bench.call);
 }
 
 static void cli_lane_fiber(void* a) {
@@ -707,7 +717,7 @@ static void cli_lane_fiber(void* a) {
     bool dead = false;
     for (int i = 0; i < room; i++) {
       cc->inflight.fetch_add(1, std::memory_order_acq_rel);
-      cc->add_ref();
+      NAT_REF_ACQUIRE(cc, bench.call);  // cli_lane_cb releases
       int rc =
           cc->proto == 2
               ? nat_grpc_acall(cc->ch, cc->path->c_str(),
@@ -718,7 +728,7 @@ static void cli_lane_fiber(void* a) {
                                0, cli_lane_cb, cc);
       if (rc != 0) {  // never queued: cb will not fire
         cc->inflight.fetch_sub(1, std::memory_order_acq_rel);
-        cc->release();
+        NAT_REF_RELEASE(cc, bench.call);
         dead = true;
         break;
       }
@@ -731,7 +741,7 @@ static void cli_lane_fiber(void* a) {
     Scheduler::butex_wait(&cc->room, expected);
   }
   Butex* done = cc->done_count;
-  cc->release();
+  NAT_REF_RELEASE(cc, bench.owner);  // the sender fiber's reference
   done->value.fetch_add(1, std::memory_order_release);
   Scheduler::butex_wake(done, INT32_MAX);
 }
@@ -754,6 +764,7 @@ static double run_cli_lane_bench(const char* ip, int port, int nconn,
                                       "bench");
     if (ch == nullptr) continue;
     CliLaneConn* cc = new CliLaneConn();
+    NAT_REF_ACQUIRED(cc, bench.owner);  // refs{1} = the sender fiber
     cc->ch = ch;
     cc->stop = &stop;
     cc->total = &total;
@@ -762,7 +773,7 @@ static double run_cli_lane_bench(const char* ip, int port, int nconn,
     cc->proto = proto;
     cc->path = &path;
     cc->payload = &payload;
-    cc->add_ref();  // harness reference
+    NAT_REF_ACQUIRE(cc, bench.owner);  // harness reference
     conns.push_back(cc);
     Scheduler::instance()->spawn_detached(cli_lane_fiber, cc);
     started++;
@@ -784,7 +795,7 @@ static double run_cli_lane_bench(const char* ip, int port, int nconn,
   double dt = std::chrono::duration<double>(t1 - t0).count();
   for (CliLaneConn* cc : conns) {
     nat_channel_close(cc->ch);
-    cc->release();
+    NAT_REF_RELEASE(cc, bench.owner);
   }
   if (out_requests != nullptr) *out_requests = total.load(std::memory_order_relaxed);
   return dt > 0 ? (double)total.load(std::memory_order_relaxed) / dt : 0.0;

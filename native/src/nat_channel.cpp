@@ -109,13 +109,14 @@ NatSocket* channel_socket(NatChannel* ch, int max_dial_ms) {
   ns->disp = pick_dispatcher(/*client_side=*/true);
   ns->disp->sockets_owned.fetch_add(1, std::memory_order_relaxed);
   ns->channel = ch;
-  ch->add_ref();  // the socket's channel reference
+  NAT_REF_ACQUIRE(ch, chan.sock);
   ns->defer_writes = ch->defer_writes_flag;
   ch->sock_id.store(ns->id, std::memory_order_release);
   if (ch->protocol != 0) channel_attach_client_session(ch, ns);
   ns->conn_visible.store(true, std::memory_order_release);
-  ns->add_ref();  // the caller's borrowed reference, taken BEFORE epoll
-                  // can fail the socket
+  // the caller's borrowed reference, taken BEFORE epoll can fail the
+  // socket — the returned ref matches sock_address's borrow contract
+  NAT_REF_ACQUIRE(ns, sock.borrow);
   ns->disp->add_consumer(ns);  // client sockets stay on epoll (measured
                                // slower on the ring: one-in-flight sends
                                // throttle request pipelining)
@@ -177,7 +178,7 @@ void NatChannel::breaker_on_call_end(bool call_ok) {
     NatSocket* s = sock_address(sock_id.load(std::memory_order_acquire));
     if (s != nullptr) {
       s->set_failed();
-      s->release();
+      NAT_REF_RELEASE(s, sock.borrow);
     }
   }
 }
@@ -235,15 +236,15 @@ static void health_check_dial_fiber(void* raw) {
   NatChannel* ch = (NatChannel*)raw;
   if (ch->closed.load(std::memory_order_acquire)) {
     ch->hc_pending.store(false, std::memory_order_release);
-    ch->release();
+    NAT_REF_RELEASE(ch, chan.revival);
     return;
   }
   NatSocket* s = channel_socket(ch);
   if (s != nullptr) {  // revived (or never died)
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     ch->hc_backoff_shift.store(0, std::memory_order_relaxed);
     ch->hc_pending.store(false, std::memory_order_release);
-    ch->release();
+    NAT_REF_RELEASE(ch, chan.revival);
     return;
   }
   // Exponential backoff with jitter: a dead peer must not be hammered
@@ -296,7 +297,7 @@ static void call_timeout_work(void* raw) {
       Scheduler::butex_wake(&pc->done, INT32_MAX);
     }
   }
-  t->ch->release();
+  NAT_REF_RELEASE(t->ch, chan.timer);
   delete t;
 }
 
@@ -308,7 +309,7 @@ static void call_timeout_fire(void* raw) {
 }
 
 void arm_call_timeout(NatChannel* ch, int64_t cid, int timeout_ms) {
-  ch->add_ref();
+  NAT_REF_ACQUIRE(ch, chan.timer);  // call_timeout_work releases
   TimerThread::instance()->schedule(call_timeout_fire,
                                     new CallTimeout{ch, cid}, timeout_ms);
 }
@@ -327,6 +328,7 @@ static void* channel_open_impl(const char* ip, int port, int nworkers,
   if (fd < 0) return nullptr;
 
   NatChannel* ch = new NatChannel();
+  NAT_REF_ACQUIRED(ch, chan.opener);  // ref{1} = the opener's reference
   ch->peer_ip = ip;
   ch->peer_port = port;
   ch->connect_timeout_ms = connect_timeout_ms;
@@ -343,7 +345,7 @@ static void* channel_open_impl(const char* ip, int port, int nworkers,
   NatSocket* s = sock_create();
   if (s == nullptr) {
     ::close(fd);
-    ch->release();
+    NAT_REF_RELEASE(ch, chan.opener);
     return nullptr;
   }
   s->fd = fd;
@@ -351,7 +353,7 @@ static void* channel_open_impl(const char* ip, int port, int nworkers,
   s->disp = pick_dispatcher(/*client_side=*/true);
   s->disp->sockets_owned.fetch_add(1, std::memory_order_relaxed);
   s->channel = ch;
-  ch->add_ref();  // the socket's reference, dropped in NatSocket::release
+  NAT_REF_ACQUIRE(ch, chan.sock);  // dropped in NatSocket::release
   s->defer_writes = (batch_writes != 0);
   ch->sock_id.store(s->id, std::memory_order_release);
   if (protocol != 0) channel_attach_client_session(ch, s);
@@ -393,10 +395,11 @@ void nat_channel_close(void* h) {
   NatSocket* s = sock_address(ch->sock_id);
   if (s != nullptr) {
     s->set_failed();  // fails pending calls via channel->fail_all
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
   }
   ch->fail_all(kEFAILEDSOCKET, "channel closed");
-  ch->release();  // opener's reference; the socket may still hold one
+  // the socket may still hold its chan.sock reference
+  NAT_REF_RELEASE(ch, chan.opener);
 }
 
 // Backup request (the controller.cpp:1256 backup timer): when the timer
@@ -421,10 +424,10 @@ static void backup_fire_work(void* raw) {
       if (s->write(std::move(f)) == 0) {
         s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
       }
-      s->release();
+      NAT_REF_RELEASE(s, sock.borrow);
     }
   }
-  b->ch->release();
+  NAT_REF_RELEASE(b->ch, chan.backup);
   delete b;
 }
 
@@ -465,7 +468,7 @@ static int call_attempt(NatChannel* ch, NatSocket* s, const char* service,
   build_request_frame(&frame, cid, service, method, payload, payload_len,
                       nullptr, 0, tr.trace_id, tr.span_id);
   if (backup_ms > 0 && (timeout_ms <= 0 || backup_ms < timeout_ms)) {
-    ch->add_ref();
+    NAT_REF_ACQUIRE(ch, chan.backup);  // backup_fire_work releases
     BackupCtx* b = new BackupCtx{ch, cid, frame.to_string()};
     TimerThread::instance()->schedule(backup_fire, b, backup_ms);
   }
@@ -590,14 +593,14 @@ int nat_channel_call_full(void* h, const char* service, const char* method,
               .count();
       remaining_ms = (int)((deadline_us - now_us) / 1000);
       if (remaining_ms <= 0) {
-        s->release();
+        NAT_REF_RELEASE(s, sock.borrow);
         return kERPCTIMEDOUT;
       }
     }
     int rc = call_attempt(ch, s, service, method, payload, payload_len,
                           remaining_ms, backup_ms, resp_out, resp_len,
                           err_text_out);
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     // A drain-window ELIMIT from a lame-duck peer is PLANNED churn: the
     // call retries (against the re-dialed/restarted peer) without
     // spending the retry budget — graceful restarts must not eat the
@@ -684,7 +687,7 @@ int nat_channel_acall(void* h, const char* service, const char* method,
   tr.set_label(service, ".", method);
   int64_t cid = 0;
   if (ch->begin_call(&cid, acall_complete, ctx, &tr) == nullptr) {
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     delete ctx;
     return kEFAILEDSOCKET;
   }
@@ -706,10 +709,10 @@ int nat_channel_acall(void* h, const char* service, const char* method,
       acall_complete(mine, ctx);
     }
     // else: fail_all already delivered the failure through cb
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     return 0;
   }
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return 0;
 }
 

@@ -1198,7 +1198,7 @@ int nat_http_call(void* h, const char* verb, const char* path,
   int64_t cid = 0;
   PendingCall* pc = ch->begin_call(&cid, nullptr, nullptr, &tr);
   if (pc == nullptr) {
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     return kEFAILEDSOCKET;
   }
   if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
@@ -1206,10 +1206,10 @@ int nat_http_call(void* h, const char* verb, const char* path,
                          cid, &tr);
   if (rc != 0) {
     reap_failed_send(ch, pc, cid);
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     return rc;
   }
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return harvest_sync(ch, pc, status_out, resp_out, resp_len, nullptr);
 }
 
@@ -1225,7 +1225,7 @@ int nat_http_acall(void* h, const char* verb, const char* path,
   tr.set_label(verb, " ", path);
   int64_t cid = 0;
   if (ch->begin_call(&cid, acall2_complete, ctx, &tr) == nullptr) {
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     delete ctx;
     return kEFAILEDSOCKET;
   }
@@ -1242,7 +1242,7 @@ int nat_http_acall(void* h, const char* verb, const char* path,
       acall2_complete(mine, ctx);
     }
   }
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return 0;
 }
 
@@ -1258,17 +1258,17 @@ int nat_grpc_call(void* h, const char* path, const char* payload,
   int64_t cid = 0;
   PendingCall* pc = ch->begin_call(&cid, nullptr, nullptr, &tr);
   if (pc == nullptr) {
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     return kEFAILEDSOCKET;
   }
   if (timeout_ms > 0) arm_call_timeout(ch, cid, timeout_ms);
   int rc = h2c_send_request(ch, s, path, payload, payload_len, cid, &tr);
   if (rc != 0) {
     reap_failed_send(ch, pc, cid);
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     return rc;
   }
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return harvest_sync(ch, pc, grpc_status_out, resp_out, resp_len,
                       err_text_out);
 }
@@ -1284,7 +1284,7 @@ int nat_grpc_acall(void* h, const char* path, const char* payload,
   tr.set_label(path, "", "");
   int64_t cid = 0;
   if (ch->begin_call(&cid, acall2_complete, ctx, &tr) == nullptr) {
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     delete ctx;
     return kEFAILEDSOCKET;
   }
@@ -1299,7 +1299,7 @@ int nat_grpc_acall(void* h, const char* path, const char* payload,
       acall2_complete(mine, ctx);
     }
   }
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return 0;
 }
 

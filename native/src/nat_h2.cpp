@@ -1105,12 +1105,12 @@ int nat_grpc_respond(uint64_t sock_id, int64_t sid, const char* payload,
   NatSocket* s = sock_address(sock_id);
   if (s == nullptr) return -1;
   if (s->h2 == nullptr) {
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     return -1;
   }
   h2_respond(s, (uint32_t)sid, payload, payload_len, grpc_status,
              grpc_message, nullptr);
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return 0;
 }
 

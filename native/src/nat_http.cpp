@@ -561,12 +561,12 @@ int http_respond_iobuf(uint64_t sock_id, int64_t seq, IOBuf&& data,
   NatSocket* s = sock_address(sock_id);
   if (s == nullptr) return -1;
   if (s->http == nullptr) {
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     return -1;
   }
   http_emit_response(s, (uint64_t)seq, std::move(data), close_after != 0,
                      nullptr);
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return 0;
 }
 
@@ -581,14 +581,14 @@ int nat_http_respond(uint64_t sock_id, int64_t seq, const char* data,
   NatSocket* s = sock_address(sock_id);
   if (s == nullptr) return -1;
   if (s->http == nullptr) {
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     return -1;
   }
   IOBuf buf;
   buf.append(data, len);
   http_emit_response(s, (uint64_t)seq, std::move(buf), close_after != 0,
                      nullptr);
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return 0;
 }
 
@@ -598,7 +598,7 @@ int nat_sock_graceful_close(uint64_t sock_id) {
   NatSocket* s = sock_address(sock_id);
   if (s == nullptr) return -1;
   s->arm_close_after_drain();
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return 0;
 }
 

@@ -42,6 +42,7 @@
 #include "nat_api.h"
 #include "nat_fault.h"
 #include "nat_lockrank.h"
+#include "nat_refown.h"
 #include "nat_stats.h"
 #include "nat_wstack.h"
 #include "ring_listener.h"
@@ -305,6 +306,11 @@ inline NatSocket* sock_at(uint32_t idx) {
 
 NatSocket* sock_create();
 NatSocket* sock_address(uint64_t id);
+// Pin `s` regardless of its id version (the /connections walker: any
+// live refcount qualifies, even mid-teardown) — the second borrow
+// primitive beside sock_address; nullptr when the slot holds no
+// reference. The returned pin is a sock.borrow like sock_address's.
+NatSocket* sock_try_pin(NatSocket* s);
 void sock_unregister(NatSocket* s);
 
 // /connections peer column: "ip:port" formatted once at socket setup.
@@ -570,6 +576,7 @@ struct PyRequest {
     ::free(big_payload);
     if (shm_slot >= 0) shm_req_span_release(this);
     if (admitted) {
+      NAT_REF_RELEASED(nat_ref_adm_anchor(), adm.pyreq);
       admission_on_complete(
           enqueue_ns != 0 ? nat_now_ns() - enqueue_ns : 0, admit_ok);
     }
@@ -599,7 +606,10 @@ class NatServer {
 
   void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
   void release() {
-    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      NAT_REF_DEAD(this);  // refguard: every tag balanced before delete
+      delete this;
+    }
   }
 
   ~NatServer();  // drains py_q: late kind-2 notices enqueue after stop
@@ -918,7 +928,10 @@ class NatChannel {
 
   void add_ref() { ref.fetch_add(1, std::memory_order_relaxed); }
   void release() {
-    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+    if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      NAT_REF_DEAD(this);  // refguard: every tag balanced before delete
+      delete this;
+    }
   }
 
   ~NatChannel() {

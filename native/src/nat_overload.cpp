@@ -92,7 +92,7 @@ void overload_reject_fiber(void* raw) {
                                IOBuf());
         }
         s->write(std::move(out));
-        s->release();
+        NAT_REF_RELEASE(s, sock.borrow);
       }
       break;
     }
@@ -145,6 +145,9 @@ bool overload_admit(PyRequest* r) {
     return false;
   }
   r->admitted = true;
+  // the in-flight token: ~PyRequest (or overload_expire) releases it,
+  // unless shm_lane_offer transfers it onto the InflightEntry
+  NAT_REF_ACQUIRED(nat_ref_adm_anchor(), adm.pyreq);
   return true;
 }
 
@@ -158,6 +161,7 @@ void overload_expire(PyRequest* r) {
   emit_overload_reject(r, kRejDeadline);
   if (r->admitted) {
     r->admitted = false;  // expired work never feeds the limiter window
+    NAT_REF_RELEASED(nat_ref_adm_anchor(), adm.pyreq);
     admission_on_complete(0, false);
   }
   delete r;

@@ -72,7 +72,7 @@ NatMutex<kLockRankProfCtl> g_ctl_mu;
 // (collector-side only, under g_report_mu)
 NatMutex<kLockRankProfReport> g_report_mu;
 std::map<std::vector<uintptr_t>, uint64_t>& g_stacks =
-    *new std::map<std::vector<uintptr_t>, uint64_t>();
+    *new std::map<std::vector<uintptr_t>, uint64_t>();  // natcheck:leak(g_stacks): collector drains at exit
 
 // ---------------------------------------------------------------------------
 // signal side — async-signal-safe only (natcheck sigsafe rule)
@@ -341,8 +341,9 @@ NatMutex<kLockRankMuSelftest> g_mu_selftest_mu;
 // control + aggregate serialization (start/stop/reset/report only — the
 // record path is lock-free)
 NatMutex<kLockRankMuProfReport> g_mu_report_mu;
-// stack -> {wait_us, waits}; leaked (detached runtime threads may still
-// record at exit)
+// stack -> {wait_us, waits}
+// natcheck:leak(g_mu_stacks): detached runtime threads may still record
+// at exit
 std::map<std::vector<uintptr_t>, std::pair<uint64_t, uint64_t>>&
     g_mu_stacks = *new std::map<std::vector<uintptr_t>,
                                 std::pair<uint64_t, uint64_t>>();

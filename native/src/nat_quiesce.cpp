@@ -50,7 +50,7 @@ void for_each_server_socket(NatServer* srv, Fn fn) {
     if (s->server == srv && !s->failed.load(std::memory_order_acquire)) {
       fn(s);
     }
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
   }
 }
 
@@ -131,7 +131,7 @@ int nat_server_quiesce(int timeout_ms) {
     std::lock_guard g(g_rt_mu);
     srv = g_rpc_server;
     if (srv == nullptr) return -1;
-    srv->add_ref();
+    NAT_REF_ACQUIRE(srv, srv.quiesce);
     // phase 1: unsubscribe the listener from its dispatcher. The fd
     // CLOSE is deferred to the loop thread (remove_listener), so a
     // concurrently-dispatched accept can never run on a recycled fd.
@@ -214,7 +214,7 @@ int nat_server_quiesce(int timeout_ms) {
     s->arm_close_after_drain();
   });
 
-  srv->release();
+  NAT_REF_RELEASE(srv, srv.quiesce);
   return expired ? 1 : 0;
 }
 

@@ -615,11 +615,11 @@ int nat_redis_respond(uint64_t sock_id, int64_t seq, const char* data,
   if (s == nullptr) return -1;
   RedisSessN* h = s->redis;
   if (h == nullptr) {
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
     return -1;
   }
   redis_emit(s, h, (uint64_t)seq, std::string(data, len), nullptr);
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return 0;
 }
 

@@ -120,7 +120,7 @@ void Dispatcher::accept_loop(int lfd, NatServer* srv) {
     s->disp = pick_dispatcher();  // shard across the loop pool
     s->disp->sockets_owned.fetch_add(1, std::memory_order_relaxed);
     s->server = srv;
-    srv->add_ref();  // released when the socket slot is recycled
+    NAT_REF_ACQUIRE(srv, srv.sock);  // NatSocket::release drops it
     srv->connections.fetch_add(1, std::memory_order_relaxed);
     nat_counter_add(NS_CONNECTIONS_ACCEPTED, 1);
     s->conn_visible.store(true, std::memory_order_release);
@@ -171,16 +171,18 @@ void Dispatcher::run() {
           // ref taken UNDER the lock: a racing server_stop erases the
           // listener then releases its registration reference — without
           // this, accept_loop could run on a freed server
-          if (srv != nullptr) srv->add_ref();
+          if (srv != nullptr) NAT_REF_ACQUIRE(srv, srv.accept);
         }
         if (srv != nullptr) {
           accept_loop(lfd, srv);
-          srv->release();
+          NAT_REF_RELEASE(srv, srv.accept);
         }
         continue;
       }
       NatSocket* s = sock_address(data);
       if (s == nullptr) continue;
+      // sock.borrow held through this round (the flush_list keeps it
+      // across the end-of-round writev batch)
       if (events[i].events & EPOLLOUT) {
         s->epollout.value.fetch_add(1, std::memory_order_release);
         Scheduler::butex_wake(&s->epollout, INT32_MAX);
@@ -191,7 +193,7 @@ void Dispatcher::run() {
           continue;
         }
       }
-      s->release();
+      NAT_REF_RELEASE(s, sock.borrow);
     }
     // End-of-round flush: one writev per socket covering every burst the
     // round produced (cross-burst syscall batching). The drain role was
@@ -199,10 +201,10 @@ void Dispatcher::run() {
     // continuation; EAGAIN leftovers ride a KeepWrite fiber.
     for (NatSocket* s : flush_list) {
       if (!s->flush_chain()) {
-        s->add_ref();
+        NAT_REF_ACQUIRE(s, sock.keepwrite);
         Scheduler::instance()->spawn_detached(keep_write_fiber, s);
       }
-      s->release();
+      NAT_REF_RELEASE(s, sock.borrow);
     }
     flush_list.clear();
     Scheduler::instance()->flush_wake_batch();
@@ -217,9 +219,9 @@ void Dispatcher::run() {
 // sockets are sharded round-robin across N independent epoll loops so the
 // inline read/process path scales past one core. Listeners live on
 // loop 0; accepted/connected sockets go to the next loop in turn.
-// Leaked on purpose: dispatcher/worker threads run through exit() and
-// pick_dispatcher() must never read a destructed vector (the bench-exit
-// SIGSEGV class, BENCH_r05 rc 139).
+// natcheck:leak(nat_rpc_server_start): dispatcher/worker threads run
+// through exit() and pick_dispatcher() must never read a destructed
+// vector (the bench-exit SIGSEGV class, BENCH_r05 rc 139).
 std::vector<Dispatcher*>& g_disps = *new std::vector<Dispatcher*>();
 Dispatcher* g_disp = nullptr;  // g_disps[0]: listeners + console
 NatServer* g_rpc_server = nullptr;
@@ -356,6 +358,7 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
   getsockname(fd, (struct sockaddr*)&addr, &alen);
 
   NatServer* srv = new NatServer();
+  NAT_REF_ACQUIRED(srv, srv.registry);  // ref{1} = the registration
   srv->listen_fd = fd;
   srv->port = ntohs(addr.sin_port);
   srv->disp = g_disp;
@@ -386,7 +389,7 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
     std::lock_guard g(g_rt_mu);
     if (g_rpc_server != nullptr) {  // lost a concurrent-start race
       ::close(fd);
-      srv->release();
+      NAT_REF_RELEASE(srv, srv.registry);
       return -1;
     }
     g_rpc_server = srv;
@@ -433,7 +436,7 @@ void nat_rpc_server_stop() {
     NatSocket* s = sock_address(id);
     if (s == nullptr) continue;
     if (s->server == srv) s->set_failed();
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
   }
   // drain queued python-lane requests under the lane lock
   {
@@ -441,8 +444,8 @@ void nat_rpc_server_stop() {
     for (PyRequest* r : srv->py_q) delete r;
     srv->py_q.clear();
   }
-  srv->release();  // the registration reference; sockets/takers may
-                   // still hold theirs — the last one deletes
+  // sockets/takers may still hold their references — the last deletes
+  NAT_REF_RELEASE(srv, srv.registry);
 }
 
 // Enable the multi-protocol raw fallback on the running server: framing
@@ -509,10 +512,11 @@ void* nat_take_request(int timeout_ms) {
     std::lock_guard g(g_rt_mu);
     srv = g_rpc_server;
     if (srv == nullptr) return nullptr;
-    srv->add_ref();  // keeps the server alive across the blocking wait
+    // keeps the server alive across the blocking wait
+    NAT_REF_ACQUIRE(srv, srv.taker);
   }
   void* r = srv->take_py(timeout_ms);
-  srv->release();
+  NAT_REF_RELEASE(srv, srv.taker);
   return r;
 }
 
@@ -523,10 +527,10 @@ int nat_take_request_batch(void** out, int max, int timeout_ms) {
     std::lock_guard g(g_rt_mu);
     srv = g_rpc_server;
     if (srv == nullptr) return 0;
-    srv->add_ref();
+    NAT_REF_ACQUIRE(srv, srv.taker);
   }
   int n = srv->take_py_batch((PyRequest**)out, max, timeout_ms);
-  srv->release();
+  NAT_REF_RELEASE(srv, srv.taker);
   return n;
 }
 
@@ -576,7 +580,7 @@ int nat_sock_write(uint64_t sock_id, const char* data, size_t len) {
   IOBuf out;
   out.append(data, len);
   int rc = s->write(std::move(out));
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return rc;
 }
 
@@ -584,7 +588,7 @@ int nat_sock_set_failed(uint64_t sock_id) {
   NatSocket* s = sock_address(sock_id);
   if (s == nullptr) return -1;
   s->set_failed();
-  s->release();
+  NAT_REF_RELEASE(s, sock.borrow);
   return 0;
 }
 
@@ -610,7 +614,7 @@ int nat_respond(void* h, int32_t error_code, const char* error_text,
     // count only frames accepted for the wire: a failed-socket write
     // must not over-report /connections out_msgs vs the byte counters
     if (rc == 0) s->c_out_msgs.fetch_add(1, std::memory_order_relaxed);
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
   }
   delete r;
   return rc;

@@ -304,11 +304,13 @@ struct InflightEntry {
 // NOT held; the limiter window has its own lock).
 void inflight_entry_complete(const InflightEntry& e, bool ok) {
   if (!e.admitted) return;
+  NAT_REF_RELEASED(nat_ref_adm_anchor(), adm.inflight);
   admission_on_complete(
       ok && e.enqueue_ns != 0 ? nat_now_ns() - e.enqueue_ns : 0, ok);
 }
 NatMutex<kLockRankShmInflight> g_inflight_mu;
-// leaked: the reaper/drainer may outrun static destruction at exit()
+// natcheck:leak(g_inflight): the reaper/drainer may outrun static
+// destruction at exit()
 std::map<InflightKey, InflightEntry>& g_inflight =
     *new std::map<InflightKey, InflightEntry>();
 std::atomic<int> g_reap_timeout_ms{30000};
@@ -776,6 +778,7 @@ void shm_req_span_release(PyRequest* r) {
   if (g_seg == nullptr || r->shm_slot < 0 || r->shm_slot >= kMaxWorkers) {
     return;
   }
+  NAT_REF_RELEASED(r, shm.span);
   span_release(req_arena(r->shm_slot), r->shm_span);
 }
 
@@ -848,6 +851,9 @@ bool shm_lane_offer(PyRequest* r) {
       // (emit/reap) release it, not ~PyRequest
       it->second.admitted = r->admitted;
       it->second.enqueue_ns = r->enqueue_ns;
+      if (r->admitted) {
+        NAT_REF_TRANSFER(nat_ref_adm_anchor(), adm.pyreq, adm.inflight);
+      }
       r->admitted = false;
     }
   }
@@ -855,6 +861,7 @@ bool shm_lane_offer(PyRequest* r) {
     // the worker answered (and the entry was erased) before the token
     // could transfer: release it here — exactly once either way
     r->admitted = false;
+    NAT_REF_RELEASED(nat_ref_adm_anchor(), adm.pyreq);
     admission_on_complete(
         r->enqueue_ns != 0 ? nat_now_ns() - r->enqueue_ns : 0, true);
   }
@@ -1120,6 +1127,9 @@ void* nat_shm_take_request(int timeout_ms) {
       tls_take_ns = nat_now_ns();  // handling-start anchor (worker span)
       req->shm_slot = g_my_slot;
       req->shm_span = c.span_off;
+      // the request's field views pin this arena span until
+      // nat_req_free -> shm_req_span_release
+      NAT_REF_ACQUIRED(req, shm.span);
       char* arena = req_arena(g_my_slot);
       const char* p = span_payload(arena, c.span_off);
       const char* end = p + c.payload_len;

@@ -45,10 +45,24 @@ static void acall_done(void*, int32_t code, const char* resp, size_t n) {
 }
 
 int main() {
-  // ---- selftests (wsq / iobuf / meta) ----
+  // ---- selftests (wsq / iobuf / meta / refguard) ----
   CHECK(nat_wsq_selftest() == 0, "wsq selftest");
   CHECK(nat_iobuf_selftest() == 0, "iobuf selftest");
   CHECK(nat_meta_selftest() == 0, "meta selftest");
+  // balanced refguard round is legal in EVERY build (ledger ops under
+  // -DNAT_REFGUARD, no-ops otherwise)
+  CHECK(nat_refguard_selftest(0) == 0, "refguard balanced selftest");
+  if (nat_refguard_enabled() == 1) {
+    CHECK(nat_refguard_ops() > 0, "refguard ledger live");
+  }
+  // deliberately broken scenario (tests/test_natcheck_refown.py): under
+  // -DNAT_REFGUARD the double release ABORTS here with the tag pair
+  if (getenv("NAT_REFGUARD_BREAK") != nullptr) {
+    int rc = nat_refguard_selftest(1);
+    fprintf(stderr, "nat_smoke: refguard break scenario returned %d\n",
+            rc);
+    return rc == -1 ? 3 : 4;  // only reached when the guard is absent
+  }
 
   // ---- server up, all native lanes on ----
   nat_stats_enable_spans(1);  // record every call: exercises the span ring
@@ -363,6 +377,48 @@ int main() {
       CHECK(saw_bytes, "conn rows carry bytes + remote addr");
       nat_channel_close(cch);
     }
+  }
+
+  // ---- refchurn round: socket/channel create-fail-recycle churn under
+  // concurrent /connections pins — the versioned-ref borrow (sock_address
+  // / sock_try_pin) racing release's deferred close and slot recycling,
+  // hot from several threads. Under -DNAT_REFGUARD every acquire/release
+  // lands in the ledger; ASan/TSan/lockrank lanes cover the same paths
+  // uninstrumented. ----
+  {
+    std::atomic<bool> churn_stop{false};
+    std::atomic<int> churn_rounds{0};
+    std::thread pinner([&] {
+      brpc_tpu::NatConnRow rows[64];
+      while (!churn_stop.load(std::memory_order_acquire)) {
+        (void)nat_conn_snapshot(rows, 64);  // sock_try_pin walk
+      }
+    });
+    constexpr int kChurners = 3;
+    std::thread churners[kChurners];
+    for (int t = 0; t < kChurners; t++) {
+      churners[t] = std::thread([&] {
+        for (int i = 0; i < 40; i++) {
+          void* ch = nat_channel_open("127.0.0.1", port, 0, 0, 0, 0);
+          if (ch == nullptr) continue;
+          char* resp = nullptr;
+          size_t rlen = 0;
+          char* err = nullptr;
+          (void)nat_channel_call_full(ch, "EchoService", "Echo", "churn",
+                                      5, 2000, 0, 0, &resp, &rlen, &err);
+          if (resp != nullptr) nat_buf_free(resp);
+          if (err != nullptr) nat_buf_free(err);
+          nat_channel_close(ch);  // socket fails -> slot recycles
+          churn_rounds.fetch_add(1, std::memory_order_relaxed);
+        }
+      });
+    }
+    for (auto& th : churners) th.join();
+    churn_stop.store(true, std::memory_order_release);
+    pinner.join();
+    CHECK(churn_rounds.load(std::memory_order_relaxed) > 0,
+          "refchurn rounds ran");
+    CHECK(nat_refguard_selftest(0) == 0, "refguard balanced post-churn");
   }
 
   // ---- redis lane: native store under pipelined load ----

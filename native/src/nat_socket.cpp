@@ -26,8 +26,8 @@ namespace brpc_tpu {
 
 std::atomic<std::atomic<NatSocket*>*> g_sock_slab[kSockSlabs];
 NatMutex<kLockRankSockAlloc> g_sock_alloc_mu;
-// Leaked on purpose: fibers on detached workers allocate/release socket
-// slots through exit(); a destructed free list here is a use-after-free.
+// natcheck:leak(g_sock_free): fibers on detached workers allocate/release
+// socket slots through exit(); a destructed free list is a use-after-free.
 std::vector<uint32_t>& g_sock_free = *new std::vector<uint32_t>();
 uint32_t g_sock_next_idx = 0;
 
@@ -54,6 +54,8 @@ NatSocket* sock_create() {
       // construct + publish while still holding the alloc lock so the
       // hwm-bounded server-stop scan can never see a half-built socket
       // (the slot store is release; sock_at loads acquire)
+      // natcheck:leak(sock_create): ResourcePool discipline — sockets
+      // and their slabs are never freed; slot indices stay valid forever
       s = new NatSocket();  // lives forever in its slot
       g_sock_slab[slab_i].load(std::memory_order_acquire)
           [idx & (kSockSlabSize - 1)]
@@ -69,6 +71,9 @@ NatSocket* sock_create() {
   uint32_t ver = s->next_version++;
   if (ver == 0) ver = s->next_version++;  // version 0 reserved (= dead)
   s->id = ((uint64_t)ver << 32) | idx;
+  // the initial refcount IS the creator/registry reference; set_failed
+  // retires it after sock_unregister
+  NAT_REF_ACQUIRED(s, sock.registry);
   s->versioned_ref.store(((uint64_t)ver << 32) | 1,
                          std::memory_order_release);
   return s;
@@ -85,6 +90,24 @@ NatSocket* sock_address(uint64_t id) {
   while ((uint32_t)(vr >> 32) == ver && (uint32_t)vr != 0) {
     if (s->versioned_ref.compare_exchange_weak(vr, vr + 1,
                                                std::memory_order_acq_rel)) {
+      // the CAS above IS the count change: a sock.borrow the caller
+      // must release (the Address/SetFailed discipline's borrow half)
+      NAT_REF_ACQUIRED(s, sock.borrow);
+      return s;
+    }
+  }
+  return nullptr;
+}
+
+// Version-blind pin (nat_conn_snapshot): any nonzero refcount pins the
+// slot against recycling; the version is irrelevant because the walker
+// starts from the slot, not from an id.
+NatSocket* sock_try_pin(NatSocket* s) {
+  uint64_t vr = s->versioned_ref.load(std::memory_order_acquire);
+  while ((uint32_t)vr != 0) {  // no refs: free / being recycled
+    if (s->versioned_ref.compare_exchange_weak(
+            vr, vr + 1, std::memory_order_acq_rel)) {
+      NAT_REF_ACQUIRED(s, sock.borrow);
       return s;
     }
   }
@@ -129,16 +152,21 @@ thread_local WreqCache tls_wreq;
 
 WriteReq* wreq_alloc() {
   WreqCache& c = tls_wreq;
+  WriteReq* r;
   if (c.head != nullptr) {
-    WriteReq* r = c.head;
+    r = c.head;
     c.head = r->wnext.load(std::memory_order_relaxed);
     c.n--;
-    return r;
+  } else {
+    r = new WriteReq();
   }
-  return new WriteReq();
+  // a live write-stack node until the drainer's wreq_free
+  NAT_REF_ACQUIRED(r, wreq.node);
+  return r;
 }
 
 void wreq_free(WriteReq* r) {
+  NAT_REF_RELEASED(r, wreq.node);
   r->data.clear();
   WreqCache& c = tls_wreq;
   if (c.n >= WreqCache::kCap) {
@@ -154,6 +182,7 @@ void wreq_free(WriteReq* r) {
 // NatSocket
 // ---------------------------------------------------------------------------
 
+// natcheck:leak(g_rings): ring pollers run through exit()
 std::vector<RingListener*>& g_rings = *new std::vector<RingListener*>();
 // g_rings is built ONCE (under g_rt_mu, only when empty) and never
 // mutated again; every lock-free reader gates on this flag (release
@@ -163,13 +192,13 @@ std::atomic<bool> g_rings_ready{false};
 std::atomic<bool> g_use_ring{false};
 static NatMutex<kLockRankRingRetry> g_ring_retry_mu;
 // sockets whose parked drain role waits for a free SQE/send buffer; each
-// entry holds a socket reference AND the drain role. Leaked — the ring
-// pollers and workers may still push retries while exit() destroys
-// statics.
+// entry holds a socket reference AND the drain role.
+// natcheck:leak(g_ring_retry): the ring pollers and workers may still
+// push retries while exit() destroys statics.
 static std::vector<NatSocket*>& g_ring_retry = *new std::vector<NatSocket*>();
 
 static void ring_retry_park(NatSocket* s) {
-  s->add_ref();  // released by the retry pass (which inherits the role)
+  NAT_REF_ACQUIRE(s, sock.ringretry);  // the retry pass inherits the role
   std::lock_guard g(g_ring_retry_mu);
   g_ring_retry.push_back(s);
 }
@@ -187,11 +216,11 @@ void NatSocket::release() {
       fd = -1;
     }
     if (channel != nullptr) {
-      channel->release();
+      NAT_REF_RELEASE(channel, chan.sock);
       channel = nullptr;
     }
     if (server != nullptr) {
-      server->release();
+      NAT_REF_RELEASE(server, srv.sock);
       server = nullptr;
     }
     if (http != nullptr) {
@@ -228,6 +257,7 @@ void NatSocket::release() {
     // socket, so any leftover drain state (a failed socket whose role
     // holder already cleaned up leaves none) is safely reclaimed here.
     wbuf.clear();
+    NAT_REF_DEAD(this);  // refguard: every tag must balance to zero here
     uint32_t idx = (uint32_t)(id & 0xffffffffu);
     std::lock_guard g(g_sock_alloc_mu);
     g_sock_free.push_back(idx);
@@ -327,7 +357,7 @@ void NatSocket::set_failed() {
       if (channel->health_check_interval_ms > 0 &&
           !channel->closed.load(std::memory_order_acquire) &&
           !channel->hc_pending.exchange(true, std::memory_order_acq_rel)) {
-        channel->add_ref();  // held by the revival chain
+        NAT_REF_ACQUIRE(channel, chan.revival);
         // fresh chain: the FIRST retry fires at the base interval; the
         // dial fiber grows the delay exponentially from there
         channel->hc_backoff_shift.store(0, std::memory_order_relaxed);
@@ -346,7 +376,7 @@ void NatSocket::set_failed() {
       // so the inline sweep is both safe and the only way the pendings
       // still complete.
       if (Scheduler::instance()->started()) {
-        add_ref();  // released by the sweep fiber
+        NAT_REF_ACQUIRE(this, sock.sweep);
         // natcheck:allow(lock-switch): runs on a fresh fiber stack
         Scheduler::instance()->spawn_detached(
             [](void* raw) {
@@ -355,7 +385,7 @@ void NatSocket::set_failed() {
               // lame-duck-drained HTTP socket: its pipeline FIFO's
               // stragglers complete as planned errors, not hangs
               http_cli_fail_own(s, kEFAILEDSOCKET, "connection drained");
-              s->release();
+              NAT_REF_RELEASE(s, sock.sweep);
             },
             this);
       } else {
@@ -371,7 +401,7 @@ void NatSocket::set_failed() {
     disp->sockets_owned.fetch_sub(1, std::memory_order_relaxed);
   }
   sock_unregister(this);
-  release();  // drop the registry's reference
+  NAT_REF_RELEASE(this, sock.registry);
 }
 
 // Connection-close arming — the store-buffer (Dekker) pairing with the
@@ -545,7 +575,7 @@ void keep_write_fiber(void* arg) {
     Scheduler::butex_wait(&s->epollout, expected);
   }
   s->disarm_epollout();
-  s->release();
+  NAT_REF_RELEASE(s, sock.keepwrite);
 }
 
 // Ring-lane submission step — entered by a fresh drainer, a send
@@ -564,7 +594,7 @@ void NatSocket::wring_continue() {
     if (rr < 0 || ring == nullptr) {
       // demoted mid-drain: the bytes continue on the epoll lane
       if (!flush_chain()) {
-        add_ref();
+        NAT_REF_ACQUIRE(this, sock.keepwrite);
         Scheduler::instance()->spawn_detached(keep_write_fiber, this);
       }
       return;
@@ -598,12 +628,12 @@ void NatSocket::wring_continue() {
     // recycling), so the completion needs no id lookup.
     ring_sending = true;
     ring_inflight = n;
-    add_ref();
+    NAT_REF_ACQUIRE(this, sock.ringsend);
     if (!ring->submit_send((int)(rr & 0xffffffff), (uint32_t)(rr >> 32),
                            (uint64_t)(uintptr_t)this, buf, n)) {
       ring_sending = false;  // no completion will come: undo + park
       ring_inflight = 0;
-      release();
+      NAT_REF_RELEASE(this, sock.ringsend);
       ring_retry_park(this);
       return;
     }
@@ -621,7 +651,7 @@ void NatSocket::wdrive() {
   // Inline first attempt on the caller's thread/fiber (socket.cpp:1287);
   // leftovers go to a KeepWrite fiber waiting on EPOLLOUT.
   if (!flush_chain()) {
-    add_ref();
+    NAT_REF_ACQUIRE(this, sock.keepwrite);
     Scheduler::instance()->spawn_detached(keep_write_fiber, this);
   }
 }
@@ -672,7 +702,7 @@ int NatSocket::write_raw(IOBuf&& frame) {
   if (defer_writes) {
     // Batch mode: the writer fiber runs AFTER the currently-ready fibers,
     // so their appends coalesce into one writev.
-    add_ref();
+    NAT_REF_ACQUIRE(this, sock.keepwrite);
     Scheduler::instance()->spawn_detached_back(keep_write_fiber, this);
     return 0;
   }
@@ -726,7 +756,7 @@ bool ring_drain_one(RingListener* ring) {
                           (size_t)c.res)) {
               ring->recycle_buffer(c.buf_id);
               s->set_failed();
-              s->release();
+              NAT_REF_RELEASE(s, sock.borrow);
               continue;
             }
           } else {
@@ -738,7 +768,7 @@ bool ring_drain_one(RingListener* ring) {
               if (took == SIZE_MAX) {  // allocation failed
                 ring->recycle_buffer(c.buf_id);
                 s->set_failed();
-                s->release();
+                NAT_REF_RELEASE(s, sock.borrow);
                 continue;
               }
               src += took;
@@ -775,10 +805,13 @@ bool ring_drain_one(RingListener* ring) {
           s->set_failed();  // EOF (0) or hard error
         }
       }
-      if (s != nullptr) s->release();
+      if (s != nullptr) NAT_REF_RELEASE(s, sock.borrow);
     } else {  // send: the completion IS the drain-role continuation
       ring->recycle_send_buffer(c.send_buf);
       NatSocket* s = (NatSocket*)(uintptr_t)c.tag;
+      // non-owning pointer use justified by the sock.ringsend reference
+      // the submit took (slabs never free; the ref pins the slot)
+      NAT_REF_BORROW(s);
       if (s != nullptr) {
         s->ring_sending = false;
         if (c.res < 0) {
@@ -795,7 +828,7 @@ bool ring_drain_one(RingListener* ring) {
           s->ring_inflight = 0;
           s->wring_continue();  // next chunk / refill / release / close
         }
-        s->release();  // the in-flight send's reference
+        NAT_REF_RELEASE(s, sock.ringsend);
       }
     }
   }
@@ -808,7 +841,7 @@ bool ring_drain_one(RingListener* ring) {
   }
   for (NatSocket* s : retry) {
     s->wring_continue();
-    s->release();
+    NAT_REF_RELEASE(s, sock.ringretry);
   }
   ring->draining.store(false, std::memory_order_release);
   return did;
@@ -909,16 +942,7 @@ int nat_conn_snapshot(brpc_tpu::NatConnRow* out, int max) {
   for (uint32_t idx = 0; idx < hwm && n < max; idx++) {
     NatSocket* s = sock_at(idx);
     if (s == nullptr) continue;
-    uint64_t vr = s->versioned_ref.load(std::memory_order_acquire);
-    bool pinned = false;
-    while ((uint32_t)vr != 0) {  // no refs: free / being recycled
-      if (s->versioned_ref.compare_exchange_weak(
-              vr, vr + 1, std::memory_order_acq_rel)) {
-        pinned = true;
-        break;
-      }
-    }
-    if (!pinned) continue;
+    if (sock_try_pin(s) == nullptr) continue;
     // conn_visible (acquire) orders every setup write — fd, peer, disp,
     // channel/server, client session attach — before this row's reads:
     // the pin alone is not enough, sock_create publishes versioned_ref
@@ -928,7 +952,7 @@ int nat_conn_snapshot(brpc_tpu::NatConnRow* out, int max) {
       conn_fill_row(s, &out[n]);
       if (out[n].sock_id != 0) n++;
     }
-    s->release();
+    NAT_REF_RELEASE(s, sock.borrow);
   }
   return n;
 }

@@ -27,6 +27,9 @@ static NatStatCell g_overflow_cell;
 
 thread_local NatStatCell* tls_nat_cell = nullptr;
 
+// natcheck:leak(nat_cell_slow): per-thread stat cells are never freed —
+// an exited thread's monotonic counters must keep contributing to
+// combined totals (bvar discipline).
 NatStatCell* nat_cell_slow() {
   std::lock_guard g(g_cell_mu);
   int n = g_ncells.load(std::memory_order_relaxed);

@@ -94,6 +94,9 @@ static const size_t kStackPoolCap = 256;
 static char* g_stack_pool[kStackPoolCap];
 static size_t g_stack_pool_n = 0;
 
+// natcheck:leak(alloc_stack): fiber stacks cached in the process-
+// lifetime stack pool (StackPool role); fibers still queued at exit()
+// keep theirs.
 static char* alloc_stack(size_t size) {
   {
     std::lock_guard g(g_stack_pool_mu);
@@ -139,12 +142,13 @@ void Scheduler::wake_one() {
 }
 
 Scheduler* Scheduler::instance() {
-  // Intentionally leaked: worker threads are detached from the process's
-  // point of view and keep scheduling through exit(). A function-local
-  // `static Scheduler s` is destroyed by __cxa_atexit while they still
-  // iterate workers_ — the use-after-free behind the bench-exit SIGSEGV
-  // (BENCH_r05 rc 139). The reference never destructs its TaskControl
-  // either.
+  // natcheck:leak(Scheduler::instance): worker threads are detached from
+  // the process's point of view and keep scheduling through exit(). A
+  // function-local `static Scheduler s` is destroyed by __cxa_atexit
+  // while they still iterate workers_ — the use-after-free behind the
+  // bench-exit SIGSEGV (BENCH_r05 rc 139). The reference never destructs
+  // its TaskControl either. (natcheck:leak(Scheduler::start): the Worker
+  // structs and worker std::threads start() spawns share this lifetime.)
   static Scheduler* s = new Scheduler();
   return s;
 }

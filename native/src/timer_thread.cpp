@@ -13,9 +13,9 @@ static int64_t now_us() {
 }
 
 TimerThread* TimerThread::instance() {
-  // leaked singleton: a static object's destructor would run ~thread on a
-  // joinable thread at exit (std::terminate); process-lifetime like the
-  // reference's timer thread
+  // natcheck:leak(TimerThread::instance): a static object's destructor
+  // would run ~thread on a joinable thread at exit (std::terminate);
+  // process-lifetime like the reference's timer thread
   static TimerThread* t = new TimerThread();
   return t;
 }

@@ -115,3 +115,36 @@ def test_model_quiesce_clean():
     rc, out = model.build_and_run(args=("--scenario", "quiesce",))
     assert rc == 0, out
     assert "FAIL" not in out, out
+
+
+def test_model_catches_refrace_stale_id_pin():
+    # a borrower that skips the version half of the versioned-ref CAS
+    # (sock_address's use-after-free guard) can pin the RECYCLED socket
+    # through a stale id under some interleaving
+    rc, out = model.build_and_run(
+        args=("--scenario", "refrace", "--bug", "refrace-no-version"))
+    assert rc != 0, out
+    assert "stale id" in out, out
+
+
+def test_model_refrace_clean():
+    # the shipped borrow protocol: a borrow pins the ORIGINAL object
+    # until released or fails; the slot recycles exactly once
+    rc, out = model.build_and_run(args=("--scenario", "refrace",))
+    assert rc == 0, out
+    assert "FAIL" not in out, out
+
+
+def test_model_catches_refxfer_blind_transfer():
+    # transferring the admission token onto the InflightEntry without
+    # the presence check orphans the token when the worker answers first
+    rc, out = model.build_and_run(
+        args=("--scenario", "refxfer", "--bug", "refxfer-blind"))
+    assert rc != 0, out
+    assert "token count ends at" in out, out
+
+
+def test_model_refxfer_clean():
+    rc, out = model.build_and_run(args=("--scenario", "refxfer",))
+    assert rc == 0, out
+    assert "FAIL" not in out, out

@@ -2,15 +2,22 @@
 # tools/check.sh — the natcheck gate (also `make -C native check`).
 #
 # Runs the fast static passes first (concurrency lint + ABI/FFI contract
-# + lock-order verification — pure Python, seconds), then the lock-rank
-# runtime validator (NAT_LOCKRANK build of the .so driven by the smoke —
-# a rank inversion or a NatMutex held across a fiber switch aborts it;
-# skipped with a note when the toolchain is absent).
+# + lock-order verification + refown ownership contracts — pure Python,
+# seconds), then the lock-rank runtime validator (NAT_LOCKRANK build of
+# the .so driven by the smoke — a rank inversion or a NatMutex held
+# across a fiber switch aborts it) and the refguard refcount validator
+# (NAT_REFGUARD build: an unbalanced acquire/release tag pair aborts the
+# smoke with the pair printed); both skipped with a note when the
+# toolchain is absent.
 #
 # NATCHECK_SLOW=1 adds the sanitizer lane (ASan+UBSan and TSan builds +
 # smoke; several minutes of compile) and the dsched interleaving smoke.
 # --soak (or NATCHECK_SOAK=1) additionally runs the full sanitizer soak
 # matrix and writes native/SOAK.md (see tools/natcheck/soak.py).
+# --refguard (or NATCHECK_REFGUARD=1) additionally runs the pytest
+# native matrix against the refguard .so (BRPC_TPU_NATIVE_SO override)
+# plus the deliberately-broken scenario that proves the guard fires
+# (see tools/natcheck/refguard.py).
 # --chaos (or NATCHECK_CHAOS=1) runs the fixed-seed fault-injection soak
 # (C smoke + pytest native matrix under the documented NAT_FAULT spec)
 # and writes native/CHAOS.md (see tools/natcheck/chaos.py).
@@ -30,20 +37,22 @@ RC=0
 SOAK="${NATCHECK_SOAK:-0}"
 CHAOS="${NATCHECK_CHAOS:-0}"
 BENCH="${NATCHECK_BENCH:-0}"
+REFGUARD="${NATCHECK_REFGUARD:-0}"
 for arg in "$@"; do
     case "$arg" in
         --soak) SOAK=1 ;;
         --chaos) CHAOS=1 ;;
         --bench) BENCH=1 ;;
+        --refguard) REFGUARD=1 ;;
     esac
 done
 
 # static passes first: they need no toolchain and must report even when
 # the compile below cannot run
 if [ "$SOAK" = "1" ] || [ "${NATCHECK_SLOW:-0}" = "1" ]; then
-    "$PY" -m tools.natcheck lint abi lockorder model san || RC=1
+    "$PY" -m tools.natcheck lint abi lockorder refown model san || RC=1
 else
-    "$PY" -m tools.natcheck lint abi lockorder || RC=1
+    "$PY" -m tools.natcheck lint abi lockorder refown || RC=1
 fi
 
 # lock-rank runtime validator: build + drive the smoke under it
@@ -57,6 +66,33 @@ if command -v g++ >/dev/null 2>&1; then
     fi
 else
     echo "natcheck: lockrank: skipped (no g++)"
+fi
+
+# refcount-contract runtime validator (refown's twin): the NAT_REFGUARD
+# build of the .so driven by the smoke — an unbalanced tag pair aborts
+if command -v g++ >/dev/null 2>&1; then
+    if make -C native refguard >/dev/null 2>&1 &&
+           native/nat_smoke_refguard >/dev/null; then
+        echo "natcheck: refguard: clean"
+    else
+        echo "natcheck: refguard: FAILED (unbalanced ref contract or smoke error)"
+        RC=1
+    fi
+else
+    echo "natcheck: refguard: skipped (no g++)"
+fi
+
+if [ "$REFGUARD" = "1" ]; then
+    "$PY" - <<'PYRG' || RC=1
+import sys
+sys.path.insert(0, ".")
+from tools.natcheck import print_findings, refguard
+findings = refguard.run()
+print("natcheck: refguard lane: %s"
+      % ("clean" if not findings else "%d finding(s)" % len(findings)))
+print_findings(findings)
+sys.exit(1 if findings else 0)
+PYRG
 fi
 
 if [ "$SOAK" = "1" ]; then

@@ -1,6 +1,6 @@
 """natcheck — standing correctness tooling for the native runtime.
 
-Five passes over the C++ core and its FFI boundary (see README.md here):
+Six passes over the C++ core and its FFI boundary (see README.md here):
 
 - ``abi``  — cross-checks the compiler-generated ABI manifest
   (native/nat_abi, built from nat_api.h) against the ctypes declarations
@@ -14,6 +14,13 @@ Five passes over the C++ core and its FFI boundary (see README.md here):
   held across a fiber-switch/blocking point. Runtime twin: the
   NAT_LOCKRANK build (``make -C native lockrank``) asserts the same
   order on a TLS held-rank stack during nat_smoke runs.
+- ``refown`` — declared ownership/refcount contracts: every add_ref/
+  release goes through the NAT_REF_* macro grammar (nat_refown.h), the
+  acquire/release/transfer graph per tag must balance (no unreleased
+  acquires, no orphan releases, no early-return leaks, no borrows after
+  release), deliberate leaks carry natcheck:leak declarations backing
+  native/lsan.supp. Runtime twin: the NAT_REFGUARD build (``make -C
+  native refguard``) asserts per-object per-tag balances at runtime.
 - ``model`` — dsched deterministic interleaving checker (native/model/):
   exhaustive + seeded-random exploration of the lock-free primitives
   (wsq, descriptor ring, arena, butex protocol, EOWNERDEAD recovery)
@@ -22,8 +29,10 @@ Five passes over the C++ core and its FFI boundary (see README.md here):
   smoke driver under each; ``soak`` (tools/check.sh --soak) extends this
   to the full native matrix and logs native/SOAK.md.
 
-Standing check.sh-only lanes: ``--chaos`` (fixed-seed fault-injection
-soak, chaos.py) and ``--bench`` (the perf regression gate, benchgate.py:
+Standing check.sh-only lanes: ``--refguard`` (the refown runtime twin
+over the C smoke + pytest native matrix, refguard.py), ``--chaos``
+(fixed-seed fault-injection soak, chaos.py) and ``--bench`` (the perf
+regression gate, benchgate.py:
 bench.py + nat_prof profile -> schema'd artifact -> headline-lane diff
 against the last committed BENCH_r*.json with tolerance bands).
 

@@ -1,6 +1,7 @@
-"""CLI: ``python -m tools.natcheck [abi] [lint] [lockorder] [san] [model]``.
+"""CLI: ``python -m tools.natcheck [abi] [lint] [lockorder] [refown] [san] [model]``.
 
-With no pass named, runs the fast static trio (lint + abi + lockorder).
+With no pass named, runs the fast static passes (lint + abi + lockorder
++ refown).
 ``--model`` (or naming ``model``) adds the dsched interleaving smoke
 (compiles native/model/, bounded exploration); ``san`` (or
 NATCHECK_SLOW=1 in tools/check.sh) adds the sanitizer lane. Exits 1 on
@@ -18,15 +19,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from tools.natcheck import print_findings  # noqa: E402
 
-DEFAULT_PASSES = ["lint", "abi", "lockorder"]
+DEFAULT_PASSES = ["lint", "abi", "lockorder", "refown"]
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tools.natcheck")
     ap.add_argument("passes", nargs="*",
-                    choices=["abi", "lint", "lockorder", "san", "model",
+                    choices=["abi", "lint", "lockorder", "refown", "san", "model",
                              []],
-                    help="passes to run (default: lint abi lockorder)")
+                    help="passes to run (default: lint abi lockorder refown)")
     ap.add_argument("--model", action="store_true",
                     help="also run the dsched interleaving smoke")
     args = ap.parse_args(argv)
@@ -47,6 +48,9 @@ def main(argv=None) -> int:
             elif p == "lockorder":
                 from tools.natcheck import lockorder
                 got = lockorder.run()
+            elif p == "refown":
+                from tools.natcheck import refown
+                got = refown.run()
             elif p == "model":
                 from tools.natcheck import model
                 got = model.run()

@@ -45,6 +45,10 @@ from tools.natcheck import Finding, REPO_ROOT
 SRC_DIR = os.path.join(REPO_ROOT, "native", "src")
 
 _ALLOW = re.compile(r"natcheck:allow\(([a-z-]+)\)")
+# A declared deliberate leak (the refown pass's leak registry — one
+# source of truth shared with native/lsan.supp) also satisfies the
+# static-dtor rule: a leaked object is never destroyed at exit.
+_LEAK_DECL = re.compile(r"natcheck:leak\(([\w:.\-]+)\)")
 
 _ATOMIC_METHODS = (
     r"load|store|exchange|fetch_add|fetch_sub|fetch_and|fetch_or|"
@@ -101,6 +105,21 @@ def _allowed(lines: List[str], i: int, rule: str) -> bool:
             m = _ALLOW.search(lines[j])
             if m and m.group(1) == rule:
                 return True
+    if rule == "static-dtor":
+        # natcheck:leak(sym) on the declaration line or its CONTIGUOUS
+        # comment block is the declared-leak registry's escape for this
+        # rule (an unrelated declaration past intervening code is not)
+        if 0 <= i < len(lines) and _LEAK_DECL.search(lines[i]):
+            return True
+        j = i - 1
+        while j >= 0 and i - j <= 8:
+            stripped = lines[j].strip()
+            if not stripped.startswith("//") and \
+                    not stripped.startswith("#"):
+                break
+            if _LEAK_DECL.search(lines[j]):
+                return True
+            j -= 1
     return False
 
 

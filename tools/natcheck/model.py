@@ -3,8 +3,9 @@ checker (native/model/) over the lock-free primitives.
 
 ``make -C native nat_model`` compiles wsq.h + nat_desc_ring.h against
 the dsched virtual-thread shim (-DNAT_MODEL=1, src/nat_atomic.h seam)
-and ``nat_model --smoke`` explores every scenario (wsq, ring, arena,
-butex, recovery-vs-offer) exhaustively under a preemption bound plus
+and ``nat_model --smoke`` explores every scenario (wstack, wsq, ring,
+arena, butex, recovery-vs-offer, quiesce, refrace, refxfer)
+exhaustively under a preemption bound plus
 seeded random walks. Deterministic: same seed => same trace => same
 hash, and a failing schedule prints a replayable seed / choice string.
 

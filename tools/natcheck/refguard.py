@@ -1,0 +1,115 @@
+"""refguard lane — the refown runtime twin over the real workload
+(``tools/check.sh --refguard``).
+
+Three legs, each a Finding on failure:
+
+1. C smoke against the ``-DNAT_REFGUARD`` build (``make -C native
+   refguard`` + ``nat_smoke_refguard``): every NAT_REF_* site feeds the
+   per-object per-tag balance ledger; an unbalanced pair, a
+   release-after-final or a borrow of an invalidated object aborts with
+   the failing tag pair printed.
+2. The deliberately-broken scenario (``NAT_REFGUARD_BREAK=1``): the
+   guard MUST abort on the seeded double release — a validator that
+   cannot fire is indistinguishable from one that works.
+3. The pytest native matrix against the refguard .so via the
+   ``BRPC_TPU_NATIVE_SO`` loader override — the full Python-driven
+   socket/channel/shm/h2/redis churn with the ledger live.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import List, Tuple
+
+from tools.natcheck import Finding, REPO_ROOT
+from tools.natcheck.soak import PYTEST_MATRIX
+
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+
+
+def _build() -> None:
+    subprocess.run(["make", "-C", NATIVE_DIR, "refguard"], check=True,
+                   capture_output=True, timeout=900)
+
+
+def _smoke_leg() -> List[Finding]:
+    smoke = os.path.join(NATIVE_DIR, "nat_smoke_refguard")
+    try:
+        proc = subprocess.run([smoke], capture_output=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return [Finding("refguard", "smoke-hang", "native/nat_smoke_refguard",
+                        "refguard smoke timed out (hang/deadlock?)")]
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr).decode(
+            errors="replace").strip()[-500:]
+        return [Finding(
+            "refguard", "smoke", "native/nat_smoke_refguard",
+            f"refguard smoke exited rc={proc.returncode}: {tail}")]
+    return []
+
+
+def _break_leg() -> List[Finding]:
+    smoke = os.path.join(NATIVE_DIR, "nat_smoke_refguard")
+    env = dict(os.environ)
+    env["NAT_REFGUARD_BREAK"] = "1"
+    try:
+        proc = subprocess.run([smoke], capture_output=True, timeout=120,
+                              env=env)
+    except subprocess.TimeoutExpired:
+        return [Finding("refguard", "break-hang",
+                        "native/nat_smoke_refguard",
+                        "break scenario timed out")]
+    err = proc.stderr.decode(errors="replace")
+    if proc.returncode == 0 or "nat_refguard:" not in err:
+        return [Finding(
+            "refguard", "break-silent", "native/nat_smoke_refguard",
+            f"the seeded double release did NOT trip the guard "
+            f"(rc={proc.returncode}) — a validator that cannot fire is "
+            f"indistinguishable from one that works")]
+    return []
+
+
+def _pytest_leg() -> Tuple[List[Finding], str]:
+    env = dict(os.environ)
+    env["BRPC_TPU_NATIVE_SO"] = os.path.join(
+        NATIVE_DIR, "libbrpc_tpu_native_refguard.so")
+    # the ledger serializes every ref op through its shard lock: perf/RSS
+    # gates in the matrix detect this and loosen or skip
+    env["BRPC_TPU_SANITIZED"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", *PYTEST_MATRIX, "-q", "-m",
+             "not slow", "-p", "no:cacheprovider"],
+            capture_output=True, timeout=1800, env=env, cwd=REPO_ROOT)
+    except subprocess.TimeoutExpired:
+        return [Finding("refguard", "pytest-hang", "tests/",
+                        "refguard python matrix timed out")], ""
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    if proc.returncode != 0:
+        tail = "\n".join(out.strip().splitlines()[-12:])
+        return [Finding(
+            "refguard", "pytest", "tests/",
+            f"pytest native matrix under the refguard .so exited "
+            f"rc={proc.returncode}:\n{tail}")], out
+    return [], out
+
+
+def run() -> List[Finding]:
+    try:
+        _build()
+    except subprocess.CalledProcessError as e:
+        return [Finding(
+            "refguard", "build", "native/Makefile",
+            "refguard build failed: " +
+            (e.stderr or b"").decode(errors="replace")[-800:])]
+    except subprocess.TimeoutExpired:
+        return [Finding("refguard", "build-hang", "native/Makefile",
+                        "refguard build timed out")]
+    findings = _smoke_leg()
+    findings += _break_leg()
+    got, _ = _pytest_leg()
+    findings += got
+    return findings

@@ -1,0 +1,493 @@
+"""refown — declared ownership/refcount contract verification.
+
+The native runtime's reference-counting discipline (NatSocket's
+Address/SetFailed borrow protocol, IOBuf block refs, arena span pins,
+WriteReq pool nodes, admission tokens, drain-role-held refs) is declared
+through the ``NAT_REF_*`` macro grammar of ``native/src/nat_refown.h``:
+every acquire names the TAG that will release it, transfers move
+ownership without a count change, borrows mark non-owning uses, and
+``NAT_REF_DEAD`` marks destruction/recycle points. This pass parses
+every TU, builds the acquire/release/transfer graph per tag — with
+transitive call closure, fiber/function-pointer handoffs and lambda
+bodies counted as release points — and fails on unbalanced contracts.
+
+Rules (suppress with ``// natcheck:allow(<rule>): why``):
+
+- ``refown-undeclared-tag``: a NAT_REF_* site uses a tag not declared in
+  nat_refown.h's NAT_REF_TAG table.
+- ``refown-no-release``: a tag is acquired (or transferred INTO)
+  somewhere but no release (or transfer OUT) of it exists anywhere —
+  the reference can never be retired.
+- ``refown-no-acquire``: a release/transfer-out of a tag that is never
+  acquired/transferred-in — a release with no owning acquire.
+- ``refown-leak-path``: inside a function that both acquires a tag and
+  (directly, via a callee's closure, via a function handed off by name,
+  or via a lambda body) releases it, an early ``return`` between the
+  acquire and the first reachable release leaks the held tag.
+- ``refown-double-release``: two straight-line releases of the same
+  (object, tag) with no intervening acquire / branch boundary.
+- ``refown-borrow-after-release``: a ``NAT_REF_BORROW(x)`` reachable in
+  straight line after a release of ``x`` — use after the owning
+  reference was dropped.
+- ``refown-raw``: a raw ``add_ref()`` / ``release()`` call outside the
+  macro surface (the definitions themselves and nat_refown.h are
+  exempt) — every count change must carry its owner tag.
+- ``refown-leak-undeclared``: a deliberately-leaked static (the
+  ``T& x = *new T`` / ``static T* x = new T`` idioms) without a
+  ``natcheck:leak(symbol): why`` declaration.
+- ``refown-lsan-unbacked``: a ``leak:`` entry in native/lsan.supp whose
+  symbol is not backed by any ``natcheck:leak`` declaration — the
+  suppression and the source annotation must stay one source of truth.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+if __package__ in (None, ""):  # `python tools/natcheck/refown.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.natcheck import Finding, REPO_ROOT  # noqa: E402
+from tools.natcheck.lockorder import (  # noqa: E402
+    _dedupe, _strip_comments_and_strings, collect_sources,
+    parse_functions, FuncInfo)
+
+SRC_DIR = os.path.join(REPO_ROOT, "native", "src")
+REFOWN_HEADER = "nat_refown.h"
+LSAN_SUPP = os.path.join(REPO_ROOT, "native", "lsan.supp")
+
+_ALLOW = re.compile(r"natcheck:allow\(([a-z-]+)\)")
+_TAG_DECL = re.compile(r"\bNAT_REF_TAG\(\s*([\w.]+)\s*,")
+_LEAK_DECL = re.compile(r"natcheck:leak\(([\w:.\-]+)\)")
+_MACRO = re.compile(
+    r"\bNAT_REF_(ACQUIRE|ACQUIRED|RELEASE|RELEASED|TRANSFER|BORROW|DEAD)"
+    r"\s*\(")
+_LEAK_IDIOM = re.compile(
+    r"&\s*\w+\s*=\s*\*\s*new\b|\bstatic\s+\w[\w:<>,\s]*\*\s*\w+\s*=\s*new\b")
+# raw count-change call: optional receiver, empty parens. The receiver
+# group keeps `wreq_release()`-style OTHER names from matching via \b.
+_RAW_CALL = re.compile(
+    r"(?:([\w\]\)]+)\s*(?:->|\.)\s*)?\b(add_ref|release)\s*\(\s*\)")
+_RETURN = re.compile(r"\breturn\b")
+
+ACQ_KINDS = ("ACQUIRE", "ACQUIRED")
+REL_KINDS = ("RELEASE", "RELEASED")
+
+
+class Site:
+    """One NAT_REF_* macro site."""
+
+    def __init__(self, kind: str, obj: str, tags: Tuple[str, ...],
+                 path: str, line: int, pos: int = -1):
+        self.kind = kind
+        self.obj = obj          # normalized object expression
+        self.tags = tags        # 1 tag; TRANSFER: (from, to); BORROW/DEAD: ()
+        self.path = path
+        self.line = line
+        self.pos = pos          # offset within the enclosing body (local)
+
+
+def _balanced_args(text: str, open_idx: int) -> Tuple[str, int]:
+    depth = 0
+    for k in range(open_idx, len(text)):
+        if text[k] == "(":
+            depth += 1
+        elif text[k] == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_idx + 1:k], k
+    return text[open_idx + 1:], len(text)
+
+
+def _split_args(args: str) -> List[str]:
+    out, depth, cur = [], 0, []
+    for ch in args:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur).strip())
+    return out
+
+
+def _norm_obj(expr: str) -> str:
+    """`refs_[begin_ + i].block` -> block, `&d` -> d, `this` -> this,
+    `nat_ref_adm_anchor()` -> nat_ref_adm_anchor."""
+    expr = expr.strip().rstrip(")").replace("(", " ")
+    expr = re.sub(r"\[[^\]]*\]", "", expr)
+    m = re.search(r"([A-Za-z_]\w*)\s*$", expr)
+    return m.group(1) if m else expr
+
+
+def _sites_in(text: str, path: str, line_base: int = 1,
+              pos_base: int = 0) -> List[Site]:
+    out = []
+    for m in _MACRO.finditer(text):
+        kind = m.group(1)
+        args, _ = _balanced_args(text, m.end() - 1)
+        parts = _split_args(args)
+        obj = _norm_obj(parts[0]) if parts else ""
+        if kind == "TRANSFER":
+            tags = tuple(p for p in parts[1:3])
+        elif kind in ("BORROW", "DEAD"):
+            tags = ()
+        else:
+            tags = (parts[1],) if len(parts) > 1 else ("",)
+        out.append(Site(kind, obj, tags, path,
+                        line_base + text.count("\n", 0, m.start()),
+                        pos_base + m.start()))
+    return out
+
+
+def _allowed(lines: List[str], i: int, rule: str) -> bool:
+    """allow() on the same line or the contiguous comment block above."""
+    if 0 <= i < len(lines):
+        m = _ALLOW.search(lines[i])
+        if m and m.group(1) == rule:
+            return True
+    j = i - 1
+    while j >= 0 and i - j <= 8:
+        stripped = lines[j].strip()
+        if not stripped.startswith("//") and not stripped.startswith("#"):
+            break
+        m = _ALLOW.search(lines[j])
+        if m and m.group(1) == rule:
+            return True
+        j -= 1
+    return False
+
+
+def _leak_declared(lines: List[str], i: int) -> bool:
+    """A natcheck:leak declaration on the line itself or in the
+    CONTIGUOUS comment block attached above it — an unrelated
+    declaration past intervening code must not excuse this one."""
+    if 0 <= i < len(lines) and _LEAK_DECL.search(lines[i]):
+        return True
+    j = i - 1
+    while j >= 0 and i - j <= 8:
+        stripped = lines[j].strip()
+        if not stripped.startswith("//") and not stripped.startswith("#"):
+            break
+        if _LEAK_DECL.search(lines[j]):
+            return True
+        j -= 1
+    return False
+
+
+def parse_tag_table(src_dir: str) -> Set[str]:
+    p = os.path.join(src_dir, REFOWN_HEADER)
+    if not os.path.exists(p):
+        p = os.path.join(SRC_DIR, REFOWN_HEADER)
+    tags: Set[str] = set()
+    if os.path.exists(p):
+        with open(p, "r", encoding="utf-8", errors="replace") as f:
+            for m in _TAG_DECL.finditer(f.read()):
+                tags.add(m.group(1))
+    return tags
+
+
+_CALL_NAME = re.compile(r"\b([A-Za-z_]\w*)\b")
+
+
+def _function_release_sets(
+        all_fns: Dict[str, List[FuncInfo]]) -> Dict[str, Set[str]]:
+    """name -> tags the function (transitively) releases/transfers-out."""
+    direct: Dict[str, Set[str]] = {}
+    calls: Dict[str, Set[str]] = {}
+    for name, fns in all_fns.items():
+        rel: Set[str] = set()
+        callees: Set[str] = set()
+        for fn in fns:
+            for st in _sites_in(fn.body, fn.path):
+                if st.kind in REL_KINDS:
+                    rel.add(st.tags[0])
+                elif st.kind == "TRANSFER" and len(st.tags) == 2:
+                    rel.add(st.tags[0])
+            for cm in _CALL_NAME.finditer(fn.body):
+                callees.add(cm.group(1))
+        direct[name] = rel
+        calls[name] = callees
+    changed = True
+    while changed:
+        changed = False
+        for name in direct:
+            for callee in calls[name]:
+                if callee == name or callee not in direct:
+                    continue
+                extra = direct[callee] - direct[name]
+                if extra:
+                    direct[name] |= extra
+                    changed = True
+    return direct
+
+
+def check(src_dir: str = SRC_DIR,
+          lsan_path: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    sources = collect_sources(src_dir)
+    declared_tags = parse_tag_table(src_dir)
+
+    all_sites: List[Site] = []
+    file_lines: Dict[str, List[str]] = {}
+    fns_by_name: Dict[str, List[FuncInfo]] = {}
+    fns_by_file: Dict[str, List[FuncInfo]] = {}
+    leak_decls: Set[str] = set()
+
+    for path, text in sources.items():
+        rel = os.path.relpath(path, REPO_ROOT)
+        lines = text.splitlines()
+        file_lines[path] = lines
+        for m in _LEAK_DECL.finditer(text):
+            leak_decls.add(m.group(1))
+        scrubbed = "\n".join(_strip_comments_and_strings(ln)
+                             for ln in lines)
+        if os.path.basename(path) != REFOWN_HEADER:
+            all_sites.extend(_sites_in(scrubbed, path))
+        flist = parse_functions(path, text)
+        fns_by_file[path] = flist
+        for fn in flist:
+            fns_by_name.setdefault(fn.name, []).append(fn)
+
+    # ---- tag declaration + global pairing ---------------------------------
+    acquired: Dict[str, List[Site]] = {}
+    released: Dict[str, List[Site]] = {}
+    for st in all_sites:
+        if st.kind in ACQ_KINDS:
+            acquired.setdefault(st.tags[0], []).append(st)
+        elif st.kind in REL_KINDS:
+            released.setdefault(st.tags[0], []).append(st)
+        elif st.kind == "TRANSFER" and len(st.tags) == 2:
+            released.setdefault(st.tags[0], []).append(st)
+            acquired.setdefault(st.tags[1], []).append(st)
+        for tag in st.tags:
+            if tag and tag not in declared_tags:
+                rel = os.path.relpath(st.path, REPO_ROOT)
+                if not _allowed(file_lines[st.path], st.line - 1,
+                                "refown-undeclared-tag"):
+                    findings.append(Finding(
+                        "refown", "refown-undeclared-tag",
+                        f"{rel}:{st.line}",
+                        f"tag `{tag}` is not declared in "
+                        f"{REFOWN_HEADER}'s NAT_REF_TAG table"))
+    for tag, sites in acquired.items():
+        if tag in released:
+            continue
+        st = sites[0]
+        rel = os.path.relpath(st.path, REPO_ROOT)
+        if _allowed(file_lines[st.path], st.line - 1, "refown-no-release"):
+            continue
+        findings.append(Finding(
+            "refown", "refown-no-release", f"{rel}:{st.line}",
+            f"tag `{tag}` is acquired here but no release/transfer-out "
+            f"of it exists anywhere — the reference can never be "
+            f"retired"))
+    for tag, sites in released.items():
+        if tag in acquired:
+            continue
+        st = sites[0]
+        rel = os.path.relpath(st.path, REPO_ROOT)
+        if _allowed(file_lines[st.path], st.line - 1, "refown-no-acquire"):
+            continue
+        findings.append(Finding(
+            "refown", "refown-no-acquire", f"{rel}:{st.line}",
+            f"tag `{tag}` is released here but never acquired/"
+            f"transferred-in — a release with no owning acquire"))
+
+    # ---- per-function path rules ------------------------------------------
+    release_sets = _function_release_sets(fns_by_name)
+    for path, flist in fns_by_file.items():
+        rel = os.path.relpath(path, REPO_ROOT)
+        lines = file_lines[path]
+        for fn in flist:
+            _check_function(fn, rel, lines, flist, release_sets, findings)
+
+    # ---- raw add_ref()/release() outside the macro surface ----------------
+    for path, text in sources.items():
+        if os.path.basename(path) == REFOWN_HEADER:
+            continue
+        rel = os.path.relpath(path, REPO_ROOT)
+        lines = file_lines[path]
+        for i, ln in enumerate(_strip_comments_and_strings(ln)
+                               for ln in lines):
+            for m in _RAW_CALL.finditer(ln):
+                # definition/declaration, not a call: `void release() {`,
+                # `void NatSocket::release() {`, `void release();`
+                before = ln[:m.start()]
+                if re.search(r"\bvoid\s+[\w:]*$", before):
+                    continue
+                if _allowed(lines, i, "refown-raw"):
+                    continue
+                findings.append(Finding(
+                    "refown", "refown-raw", f"{rel}:{i + 1}",
+                    f"raw {m.group(2)}() call outside the NAT_REF_* "
+                    f"macro surface — every count change must name the "
+                    f"tag that owns it (nat_refown.h)"))
+
+    # ---- declared-leak registry -------------------------------------------
+    for path, text in sources.items():
+        rel = os.path.relpath(path, REPO_ROOT)
+        lines = file_lines[path]
+        for i, ln in enumerate(_strip_comments_and_strings(ln)
+                               for ln in lines):
+            if not _LEAK_IDIOM.search(ln):
+                continue
+            if _leak_declared(lines, i):
+                continue
+            if _allowed(lines, i, "refown-leak-undeclared"):
+                continue
+            findings.append(Finding(
+                "refown", "refown-leak-undeclared", f"{rel}:{i + 1}",
+                "deliberately-leaked static without a "
+                "`natcheck:leak(symbol): why` declaration — the leak "
+                "registry (this rule, the static-dtor lint and "
+                "native/lsan.supp) shares one source of truth"))
+    lsan = lsan_path if lsan_path is not None else LSAN_SUPP
+    if os.path.exists(lsan):
+        with open(lsan, "r", encoding="utf-8", errors="replace") as f:
+            for i, ln in enumerate(f):
+                ln = ln.strip()
+                if not ln.startswith("leak:"):
+                    continue
+                sym = ln[len("leak:"):].strip()
+                base = sym[len("brpc_tpu::"):] if sym.startswith(
+                    "brpc_tpu::") else sym
+                if base in leak_decls or sym in leak_decls:
+                    continue
+                findings.append(Finding(
+                    "refown", "refown-lsan-unbacked",
+                    f"{os.path.relpath(lsan, REPO_ROOT)}:{i + 1}",
+                    f"lsan suppression `{sym}` has no backing "
+                    f"`natcheck:leak({base})` declaration in the "
+                    f"sources — prune it or declare the leak"))
+    return _dedupe(findings)
+
+
+def _check_function(fn: FuncInfo, rel: str, lines: List[str],
+                    file_fns: List[FuncInfo],
+                    release_sets: Dict[str, Set[str]],
+                    findings: List[Finding]) -> None:
+    body = fn.body
+    sites = _sites_in(body, fn.path, line_base=fn.start_line)
+
+    def lineno(off: int) -> int:
+        return fn.start_line + body[:off].count("\n")
+
+    # lambdas extracted from this body count as handoff release points at
+    # their offset (the lambda runs later, on whatever thread/fiber the
+    # handoff targets — exactly the "released by the sweep fiber" shape)
+    lam_events: List[Tuple[int, Set[str]]] = []
+    for lf in file_fns:
+        if lf.name == fn.name + "<lambda>" and \
+                fn.body_off <= lf.body_off <= fn.body_off + len(body):
+            rels: Set[str] = set()
+            for st in _sites_in(lf.body, lf.path):
+                if st.kind in REL_KINDS:
+                    rels.add(st.tags[0])
+                elif st.kind == "TRANSFER" and len(st.tags) == 2:
+                    rels.add(st.tags[0])
+            if rels:
+                lam_events.append((lf.body_off - fn.body_off, rels))
+
+    acqs = [st for st in sites if st.kind in ACQ_KINDS]
+    rels = [st for st in sites if st.kind in REL_KINDS]
+    xfers = [st for st in sites if st.kind == "TRANSFER"
+             and len(st.tags) == 2]
+
+    # ---- refown-leak-path -------------------------------------------------
+    for acq in acqs:
+        tag = acq.tags[0]
+        events = [st.pos for st in rels if st.tags[0] == tag]
+        events += [st.pos for st in xfers if st.tags[0] == tag]
+        events += [off for off, tags in lam_events if tag in tags]
+        # callees (or function names handed off as arguments) whose
+        # transitive closure releases the tag
+        for name, relset in release_sets.items():
+            if name == fn.name or tag not in relset:
+                continue
+            for m in re.finditer(r"\b%s\b" % re.escape(name), body):
+                events.append(m.start())
+        events = sorted(e for e in events if e > acq.pos)
+        if not events:
+            continue  # cross-function contract: global pairing covers it
+        first_rel = events[0]
+        for m in _RETURN.finditer(body, acq.pos, first_rel):
+            ln = lineno(m.start())
+            if _allowed(lines, ln - 1, "refown-leak-path"):
+                continue
+            findings.append(Finding(
+                "refown", "refown-leak-path", f"{rel}:{ln}",
+                f"early return leaks tag `{tag}` acquired at line "
+                f"{acq.line} (no release/transfer/handoff reaches this "
+                f"arm)"))
+
+    # ---- refown-double-release (straight-line) ----------------------------
+    by_key: Dict[Tuple[str, str], List[Site]] = {}
+    for st in rels:
+        by_key.setdefault((st.obj, st.tags[0]), []).append(st)
+    for (obj, tag), group in by_key.items():
+        group.sort(key=lambda s: s.pos)
+        for a, b in zip(group, group[1:]):
+            between = body[a.pos:b.pos]
+            if "{" in between or "}" in between or \
+                    _RETURN.search(between):
+                continue
+            if any(st.pos > a.pos and st.pos < b.pos and
+                   st.kind in ACQ_KINDS and st.tags[0] == tag and
+                   st.obj == obj for st in sites):
+                continue
+            if any(st.pos > a.pos and st.pos < b.pos and
+                   st.kind == "TRANSFER" and st.tags[1] == tag
+                   for st in sites):
+                continue
+            if _allowed(lines, b.line - 1, "refown-double-release"):
+                continue
+            findings.append(Finding(
+                "refown", "refown-double-release", f"{rel}:{b.line}",
+                f"straight-line double release of `{obj}` tag `{tag}` "
+                f"(first at line {a.line}) with no intervening "
+                f"acquire"))
+
+    # ---- refown-borrow-after-release --------------------------------------
+    for st in sites:
+        if st.kind != "BORROW":
+            continue
+        for r in rels:
+            if r.obj != st.obj or r.pos >= st.pos:
+                continue
+            between = body[r.pos:st.pos]
+            if "{" in between or "}" in between:
+                continue
+            if any(a.pos > r.pos and a.pos < st.pos and
+                   a.kind in ACQ_KINDS and a.obj == st.obj
+                   for a in sites):
+                continue
+            if _allowed(lines, st.line - 1, "refown-borrow-after-release"):
+                continue
+            findings.append(Finding(
+                "refown", "refown-borrow-after-release",
+                f"{rel}:{st.line}",
+                f"`{st.obj}` borrowed after its reference was released "
+                f"at line {r.line}"))
+
+
+def run(src_dir: str = SRC_DIR) -> List[Finding]:
+    return check(src_dir)
+
+
+if __name__ == "__main__":
+    src = SRC_DIR
+    for a in sys.argv[1:]:
+        src = a
+    fs = check(src)
+    for f in fs:
+        print(f)
+    sys.exit(1 if fs else 0)
