@@ -611,6 +611,14 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # flight-recorder replay lane (ISSUE 12): the committed golden
+    # capture re-fired through the native replay client in press mode
+    replay_lanes = {}
+    try:
+        replay_lanes = replay_lane_bench()
+    except Exception:
+        pass
+
     # py-usercode across worker processes (VERDICT r4 #2, shm lane)
     worker_lanes = {}
     try:
@@ -716,8 +724,12 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
         "vs_baseline": round(qps / BASELINE_QPS, 4),
         "extra": {
             # client + server + py lanes share these cores; on 1 core the
-            # absolute numbers carry the whole pipeline on one CPU
-            "host_cpus": os.cpu_count(),
+            # absolute numbers carry the whole pipeline on one CPU.
+            # Affinity, not cpu_count: the scaling lane keys on
+            # sched_getaffinity, and benchgate's cpus2_scaling_x
+            # unmeasurable-skip must agree with it (taskset/cgroup
+            # cpusets shrink affinity without shrinking cpu_count).
+            "host_cpus": len(os.sched_getaffinity(0)),
             "connections": nconn,
             "payload_bytes": payload,
             "requests": requests,
@@ -735,11 +747,42 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             "device_lanes": device_lanes,
             **http_lanes,
             **redis_lanes,
+            **replay_lanes,
             **worker_lanes,
             **stream_lanes,
             **model_rows,
         },
     }
+
+
+def replay_lane_bench(times: int = 3, concurrency: int = 8) -> dict:
+    """replay_qps: the committed 1k-request golden capture
+    (tests/data/golden_capture_1k.rio, regenerate with
+    tools/make_golden_capture.py) re-fired through the native replay
+    client in press mode against a fresh native echo server — the
+    flight recorder turned standing bench lane (any production-shaped
+    capture can stand in for the golden file the same way). Zero failed
+    RPCs is part of the lane's contract: a run with failures reports
+    0 qps so the bench gate trips on it."""
+    import os
+
+    from brpc_tpu import native
+
+    golden = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "data", "golden_capture_1k.rio")
+    if not os.path.exists(golden):
+        return {}
+    port = native.rpc_server_start(native_echo=True)
+    try:
+        res = native.replay_run("127.0.0.1", port, golden, times=times,
+                                concurrency=concurrency, timeout_ms=5000)
+    finally:
+        native.rpc_server_stop()
+    if res["failed"]:
+        return {"replay_qps": 0.0, "replay_failed": res["failed"]}
+    return {"replay_qps": round(res["qps"], 1),
+            "replay_p99_us": round(res["p99_us"], 1)}
 
 
 def _host_parallel_probe(seconds: float = 1.5) -> float:

@@ -11,7 +11,14 @@ import json
 import time
 
 from brpc_tpu import bvar
+from brpc_tpu.builtin.hotspots import _ProfWindow
 from brpc_tpu.butil import flags as flags_mod
+
+# one native capture window at a time (the recorder is a single shared
+# resource like the profilers): the second concurrent /rpc_dump?seconds=
+# request gets 503 + Retry-After instead of a stop/start collision
+_rpc_dump_window = _ProfWindow(
+    30.0, "rpc_dump busy: another /rpc_dump capture window is running\n")
 
 
 def _status_handler(server, req):
@@ -244,6 +251,106 @@ def _sockets_handler(server, req):
     return 200, "text/plain", f"socket_slots: {pool.size()}\n"
 
 
+def _rpc_dump_status_body():
+    """Status text of /rpc_dump: native recorder status + capture files
+    on disk + the Python-lane rpc_dump flags (one pane for both)."""
+    import os
+
+    lines = ["traffic flight recorder (rpc_dump)", ""]
+    st = None
+    try:
+        from brpc_tpu import native
+
+        if native.available():
+            st = native.dump_status()
+    except Exception:
+        st = None
+    if st is None:
+        lines.append("native recorder: unavailable (no native runtime)")
+    else:
+        lines.append(
+            f"native recorder: {'RUNNING' if st['running'] else 'stopped'}"
+            f"  sample_every={st['every']}  seed={st['seed']}")
+        lines.append(
+            f"  window: samples={st['samples']} written={st['written']} "
+            f"bytes={st['bytes']} drops={st['drops']} "
+            f"oversize={st['oversize']} rotations={st['rotations']}")
+        lines.append(
+            f"  config: dir={st['dir'] or '(unset)'} "
+            f"max_file_bytes={st['max_file_bytes']} "
+            f"generations={st['generations']} "
+            f"max_payload={st['max_payload']}")
+        if st["dir"]:
+            try:
+                names = sorted(n for n in os.listdir(st["dir"])
+                               if n.endswith(".rio"))
+            except OSError:
+                names = []
+            lines.append(f"  capture files ({len(names)}):")
+            for n in names:
+                try:
+                    sz = os.path.getsize(os.path.join(st["dir"], n))
+                except OSError:
+                    sz = 0
+                lines.append(f"    {n}  {sz} bytes")
+    lines.append("")
+    # the flags are defined by the module that owns the python lane
+    from brpc_tpu.rpc import rpc_dump as _rpc_dump_mod  # noqa: F401
+
+    lines.append(
+        f"python lane: -rpc_dump={flags_mod.get_flag('rpc_dump')} "
+        f"-rpc_dump_dir={flags_mod.get_flag('rpc_dump_dir')} "
+        f"-rpc_dump_sample_every="
+        f"{flags_mod.get_flag('rpc_dump_sample_every')}")
+    lines.append("")
+    lines.append("GET /rpc_dump?seconds=N[&every=M][&dir=PATH] arms a "
+                 "bounded native capture window; replay the files with "
+                 "`python tools/rpc_replay.py --native`.")
+    return "\n".join(lines) + "\n"
+
+
+def _rpc_dump_handler(server, req):
+    """/rpc_dump: the traffic flight recorder's console page — status,
+    sample rate, capture files, drop counts; ?seconds=N arms a bounded
+    native capture window (serialized by the shared one-window guard:
+    a concurrent window request gets 503 + Retry-After, the /hotspots/*
+    discipline)."""
+    seconds = req.query.get("seconds")
+    if not seconds:
+        return 200, "text/plain", _rpc_dump_status_body()
+    try:
+        from brpc_tpu import native
+
+        if not native.available():
+            return 200, "text/plain", "native runtime unavailable\n"
+    except Exception as e:
+        return 200, "text/plain", f"native runtime unavailable: {e}\n"
+    try:
+        every = int(req.query.get("every", "1") or 1)
+    except ValueError:
+        return 400, "text/plain", "every must be an integer\n"
+    directory = req.query.get("dir") or flags_mod.get_flag("rpc_dump_dir")
+
+    def _capture_window(s):
+        rc = native.dump_start(directory, every=max(1, every))
+        if rc == -1:
+            # an embedder owns the recorder: report, don't steal the
+            # window (the sample_native rc == -1 discipline)
+            return ("recorder already armed by the embedder:\n\n"
+                    + _rpc_dump_status_body())
+        if rc != 0:
+            return f"could not start capture under {directory!r}\n"
+        time.sleep(s)
+        native.dump_stop()
+        return _rpc_dump_status_body()
+
+    try:
+        window_s = float(seconds)
+    except ValueError:
+        return 400, "text/plain", "seconds must be a number\n"
+    return _rpc_dump_window.run(window_s, _capture_window)
+
+
 def _rpcz_handler(server, req):
     """/rpcz: recent spans (builtin/rpcz_service.cpp); filled by the rpcz
     module once tracing is enabled."""
@@ -378,6 +485,7 @@ def attach_console(server):
         "protobufs": _protobufs_handler,
         "bthreads": _bthreads_handler,
         "sockets": _sockets_handler,
+        "rpc_dump": _rpc_dump_handler,
         "rpcz": _rpcz_handler,
         "list": _list_handler,
         "vlog": _vlog_handler,

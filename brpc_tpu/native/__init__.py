@@ -100,6 +100,44 @@ class NatLockRankRow(ctypes.Structure):
     ]
 
 
+class NatDumpStatusRec(ctypes.Structure):
+    """Mirror of nat_dump.h NatDumpStatusRec — flight-recorder status
+    (counts are since the current nat_dump_start window)."""
+
+    _fields_ = [
+        ("samples", ctypes.c_uint64),
+        ("written", ctypes.c_uint64),
+        ("bytes", ctypes.c_uint64),
+        ("drops", ctypes.c_uint64),
+        ("oversize", ctypes.c_uint64),
+        ("rotations", ctypes.c_uint64),
+        ("max_file_bytes", ctypes.c_uint64),
+        ("max_payload", ctypes.c_uint64),
+        ("seed", ctypes.c_uint64),
+        ("every", ctypes.c_uint32),
+        ("running", ctypes.c_int32),
+        ("generations", ctypes.c_int32),
+        ("dir", ctypes.c_char * 192),
+    ]
+
+
+class NatReplayResult(ctypes.Structure):
+    """Mirror of nat_dump.h NatReplayResult — one nat_replay_run's
+    outcome (latency quantiles cover successful calls)."""
+
+    _fields_ = [
+        ("loaded", ctypes.c_uint64),
+        ("sent", ctypes.c_uint64),
+        ("ok", ctypes.c_uint64),
+        ("failed", ctypes.c_uint64),
+        ("skipped", ctypes.c_uint64),
+        ("seconds", ctypes.c_double),
+        ("qps", ctypes.c_double),
+        ("p50_us", ctypes.c_double),
+        ("p99_us", ctypes.c_double),
+    ]
+
+
 def _build() -> bool:
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
@@ -425,6 +463,20 @@ def load() -> ctypes.CDLL:
         lib.nat_mu_contend_selftest.argtypes = [ctypes.c_int, ctypes.c_int,
                                                 ctypes.c_int]
         lib.nat_mu_contend_selftest.restype = ctypes.c_uint64
+        # -- traffic flight recorder (nat_dump.cpp / nat_replay.cpp) --
+        lib.nat_dump_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64]
+        lib.nat_dump_start.restype = ctypes.c_int
+        lib.nat_dump_stop.restype = ctypes.c_int
+        lib.nat_dump_running.restype = ctypes.c_int
+        lib.nat_dump_status.argtypes = [ctypes.POINTER(NatDumpStatusRec)]
+        lib.nat_dump_status.restype = ctypes.c_int
+        lib.nat_replay_run.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(NatReplayResult)]
+        lib.nat_replay_run.restype = ctypes.c_int
         # -- trace context + in-process sampling profiler (nat_prof.cpp) --
         lib.nat_trace_set.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
         lib.nat_trace_set.restype = None
@@ -1358,6 +1410,94 @@ class trace_scope:
 
     def __exit__(self, *exc):
         trace_set(*self._prev)
+
+
+# -- traffic flight recorder (nat_dump.cpp / nat_replay.cpp) ----------------
+
+def dump_start(directory: str, every: int = 1, seed: int = 42,
+               max_file_bytes: int = 64 << 20, generations: int = 4,
+               max_payload: int = 1 << 20) -> int:
+    """Arm the native traffic flight recorder: sample 1-in-`every`
+    requests at the native protocol seams (tpu_std, native HTTP,
+    gRPC/h2, redis store, kind-8 shm descriptors) into recordio files
+    under `directory` — the format butil/recordio.py reads — rotated
+    past max_file_bytes keeping `generations` files. Payloads past
+    max_payload are skipped whole (a truncated request is not
+    replayable). 0 = ok, -1 = already running, -2 = dir/file error."""
+    return load().nat_dump_start(directory.encode(), every, seed,
+                                 max_file_bytes, generations, max_payload)
+
+
+def dump_stop() -> int:
+    """Disarm the recorder: drain the capture rings, flush + close the
+    current file. Safe when not running."""
+    return load().nat_dump_stop()
+
+
+def dump_running() -> bool:
+    return bool(load().nat_dump_running())
+
+
+def dump_status() -> dict:
+    """Flight-recorder status snapshot (counts since the current start;
+    config reflects the armed window, or the last one when stopped)."""
+    st = NatDumpStatusRec()
+    load().nat_dump_status(ctypes.byref(st))
+    return {
+        "running": bool(st.running),
+        "dir": st.dir.decode(errors="replace"),
+        "every": st.every,
+        "seed": st.seed,
+        "samples": st.samples,
+        "written": st.written,
+        "bytes": st.bytes,
+        "drops": st.drops,
+        "oversize": st.oversize,
+        "rotations": st.rotations,
+        "max_file_bytes": st.max_file_bytes,
+        "max_payload": st.max_payload,
+        "generations": st.generations,
+    }
+
+
+def replay_run(ip: str, port: int, files, times: int = 1,
+               qps: float = 0.0, qps_to: float = 0.0,
+               concurrency: int = 4, timeout_ms: int = 2000) -> dict:
+    """Replay captured recordio traffic against ip:port through the
+    native client lanes (tpu_std / HTTP / gRPC). `files` is a path, a
+    directory, or a list of either. qps > 0 throttles the fire schedule
+    (qps_to > 0 ramps linearly to it across the run); qps <= 0 is press
+    mode: no throttle, `concurrency` callers back to back. Raises on
+    empty captures / connect failures."""
+    if qps_to > 0 and qps <= 0:
+        # fire_time ignores the ramp without a starting rate: running
+        # UNTHROTTLED when the caller asked for a 500-qps ceiling is
+        # the opposite of what they meant — refuse loudly
+        raise ValueError("qps_to requires a starting qps > 0 "
+                         "(use qps=<low>, qps_to=<high> for a ramp)")
+    if isinstance(files, (list, tuple)):
+        spec = ";".join(str(f) for f in files)
+    else:
+        spec = str(files)
+    res = NatReplayResult()
+    rc = load().nat_replay_run(ip.encode(), port, spec.encode(), times,
+                               qps, qps_to, concurrency, timeout_ms,
+                               ctypes.byref(res))
+    if rc == -1:
+        raise ValueError(f"no replayable records under {spec!r}")
+    if rc != 0:
+        raise ConnectionError(f"native replay failed: rc={rc}")
+    return {
+        "loaded": res.loaded,
+        "sent": res.sent,
+        "ok": res.ok,
+        "failed": res.failed,
+        "skipped": res.skipped,
+        "seconds": res.seconds,
+        "qps": res.qps,
+        "p50_us": res.p50_us,
+        "p99_us": res.p99_us,
+    }
 
 
 # -- in-process sampling profiler (nat_prof.cpp) ----------------------------

@@ -20,6 +20,7 @@
 #include <vector>
 
 #include "nat_api.h"
+#include "nat_dump.h"
 #include "nat_stats.h"
 
 namespace {
@@ -55,6 +56,8 @@ NAT_TY(brpc_tpu::NatSpanRec, "struct:NatSpanRec");
 NAT_TY(brpc_tpu::NatMethodStatRow, "struct:NatMethodStatRow");
 NAT_TY(brpc_tpu::NatConnRow, "struct:NatConnRow");
 NAT_TY(brpc_tpu::NatLockRankRow, "struct:NatLockRankRow");
+NAT_TY(brpc_tpu::NatDumpStatusRec, "struct:NatDumpStatusRec");
+NAT_TY(brpc_tpu::NatReplayResult, "struct:NatReplayResult");
 #undef NAT_TY
 
 template <typename T>
@@ -126,8 +129,10 @@ int main() {
   // added field changes sizeof — all surface as manifest/ctypes diffs.
   printf("  \"structs\": {\n");
   using brpc_tpu::NatConnRow;
+  using brpc_tpu::NatDumpStatusRec;
   using brpc_tpu::NatLockRankRow;
   using brpc_tpu::NatMethodStatRow;
+  using brpc_tpu::NatReplayResult;
   using brpc_tpu::NatSpanRec;
 #define NAT_FIELD(S, F) \
   FieldRow { #F, offsetof(S, F), sizeof(S::F), Ty<decltype(S::F)>::get() }
@@ -181,6 +186,36 @@ int main() {
                    NAT_FIELD(NatLockRankRow, wait_us),
                    NAT_FIELD(NatLockRankRow, rank),
                    NAT_FIELD(NatLockRankRow, name),
+               },
+               false);
+  print_struct("NatDumpStatusRec", sizeof(NatDumpStatusRec),
+               {
+                   NAT_FIELD(NatDumpStatusRec, samples),
+                   NAT_FIELD(NatDumpStatusRec, written),
+                   NAT_FIELD(NatDumpStatusRec, bytes),
+                   NAT_FIELD(NatDumpStatusRec, drops),
+                   NAT_FIELD(NatDumpStatusRec, oversize),
+                   NAT_FIELD(NatDumpStatusRec, rotations),
+                   NAT_FIELD(NatDumpStatusRec, max_file_bytes),
+                   NAT_FIELD(NatDumpStatusRec, max_payload),
+                   NAT_FIELD(NatDumpStatusRec, seed),
+                   NAT_FIELD(NatDumpStatusRec, every),
+                   NAT_FIELD(NatDumpStatusRec, running),
+                   NAT_FIELD(NatDumpStatusRec, generations),
+                   NAT_FIELD(NatDumpStatusRec, dir),
+               },
+               false);
+  print_struct("NatReplayResult", sizeof(NatReplayResult),
+               {
+                   NAT_FIELD(NatReplayResult, loaded),
+                   NAT_FIELD(NatReplayResult, sent),
+                   NAT_FIELD(NatReplayResult, ok),
+                   NAT_FIELD(NatReplayResult, failed),
+                   NAT_FIELD(NatReplayResult, skipped),
+                   NAT_FIELD(NatReplayResult, seconds),
+                   NAT_FIELD(NatReplayResult, qps),
+                   NAT_FIELD(NatReplayResult, p50_us),
+                   NAT_FIELD(NatReplayResult, p99_us),
                },
                true);
 #undef NAT_FIELD
@@ -310,6 +345,11 @@ int main() {
       NAT_SYM(nat_refguard_enabled),
       NAT_SYM(nat_refguard_ops),
       NAT_SYM(nat_refguard_selftest),
+      NAT_SYM(nat_dump_start),
+      NAT_SYM(nat_dump_stop),
+      NAT_SYM(nat_dump_running),
+      NAT_SYM(nat_dump_status),
+      NAT_SYM(nat_replay_run),
       NAT_SYM(nat_prof_start),
       NAT_SYM(nat_prof_stop),
       NAT_SYM(nat_prof_running),

@@ -19,6 +19,8 @@ struct NatSpanRec;        // full layout in nat_stats.h (mirrored in ctypes)
 struct NatMethodStatRow;  // per-method stats snapshot row (nat_stats.h)
 struct NatConnRow;        // native /connections snapshot row (nat_stats.h)
 struct NatLockRankRow;    // per-rank lock-wait totals row (nat_stats.h)
+struct NatDumpStatusRec;  // flight-recorder status snapshot (nat_dump.h)
+struct NatReplayResult;   // replay run result (nat_dump.h)
 }
 
 extern "C" {
@@ -294,6 +296,31 @@ uint64_t nat_refguard_ops(void);
 // refguard builds ABORT with the failing tag pair (the golden tests'
 // seam); normal builds return -1.
 int nat_refguard_selftest(int scenario);
+
+// ---- traffic flight recorder (nat_dump.cpp / nat_replay.cpp) ----
+// Capture: arm the always-on dump tap at the native protocol seams
+// (tpu_std, native HTTP, gRPC/h2, redis store, kind-8 shm descriptors)
+// — sample 1-in-`every` requests (seeded deterministic decimation) into
+// per-thread lock-free rings drained by a background writer into
+// recordio files under `dir` (butil/recordio.py-compatible), rotated
+// past max_file_bytes keeping `generations` files. Payloads larger than
+// max_payload are skipped whole (a truncated request is not
+// replayable). 0 = ok, -1 = already running, -2 = dir/file error.
+int nat_dump_start(const char* dir, int every, uint64_t seed,
+                   uint64_t max_file_bytes, int generations,
+                   uint64_t max_payload);
+int nat_dump_stop(void);
+int nat_dump_running(void);
+int nat_dump_status(brpc_tpu::NatDumpStatusRec* out);
+// Replay/press: re-fire captured recordio traffic (files = ';'-separated
+// .rio paths / directories) through the native client lanes at a
+// controlled rate — qps_from > 0 throttles (qps_to > 0 ramps linearly),
+// qps_from <= 0 is press mode (no throttle, `concurrency` callers) —
+// with latency quantiles recorded. 0 = ok, -1 = no replayable records,
+// -2 = channel open failed.
+int nat_replay_run(const char* ip, int port, const char* files, int times,
+                   double qps_from, double qps_to, int concurrency,
+                   int timeout_ms, brpc_tpu::NatReplayResult* out);
 
 // ---- in-process sampling profiler (nat_prof.cpp) ----
 // SIGPROF/CPU-time stack sampling with frame-pointer unwind into

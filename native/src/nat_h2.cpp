@@ -723,14 +723,26 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
         if (hit != nullptr) {
           // de-frame the (single, uncompressed) gRPC message
           IOBuf payload, attachment;
+          bool framed_ok = false;
           if (data.size() >= 5 && data[0] == '\x00') {
             uint32_t mlen = rd_be32(data.data() + 1);
             if (5 + (size_t)mlen <= data.size()) {
               payload.append(data.data() + 5, mlen);
+              framed_ok = true;
             }
           }
           uint64_t t_parse = nat_now_ns();
           uint32_t req_bytes = (uint32_t)payload.length();
+          // flight-recorder tap: the DE-framed gRPC message (replay
+          // re-frames it via nat_grpc_call) + the wire trace context.
+          // An unframeable/compressed body is not replayable and
+          // records nothing (the py-lane arm's guard, mirrored).
+          if (framed_ok && nat_dump_enabled() && nat_dump_tick()) {
+            uint64_t d_trace = 0, d_span = 0;
+            trace_from_flat(flat, &d_trace, &d_span);
+            nat_dump_sample_iobuf(NL_GRPC, "", 0, path.data(),
+                                  path.size(), payload, d_trace, d_span);
+          }
           // per-method row keyed by the gRPC :path
           int midx = nat_method_idx(NL_GRPC, path.data(), path.size());
           nat_method_begin(midx);
@@ -774,6 +786,19 @@ static void h2_dispatch(NatSocket* s, H2SessionN* h, uint32_t sid,
   trace_from_flat(flat, &r->trace_id, &r->parent_span_id);
   r->meta_bytes = std::move(flat);
   r->payload = std::move(data);
+  // flight-recorder tap, py-lane arm: de-frame the (single,
+  // uncompressed) gRPC message like the handler arm — an unframeable
+  // body is not replayable and records nothing
+  if (nat_dump_enabled() && nat_dump_tick() && r->payload.size() >= 5 &&
+      r->payload[0] == '\x00') {
+    uint32_t mlen = rd_be32(r->payload.data() + 1);
+    if (5 + (size_t)mlen <= r->payload.size()) {
+      nat_dump_sample(NL_GRPC, "", 0, r->method.data(),
+                      r->method.size(), nullptr, 0,
+                      r->payload.data() + 5, mlen, r->trace_id,
+                      r->parent_span_id);
+    }
+  }
   srv->enqueue_py(r);
 }
 

@@ -431,6 +431,14 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         std::lock_guard g(h->http_mu);
         h->close_seqs.push_back(seq);
       }
+      // flight-recorder tap, also BEFORE pop_front (uri/body may view
+      // into in_buf blocks the pop recycles): full URI + body + wire
+      // trace context — replay re-fires it via nat_http_call
+      if (nat_dump_enabled() && nat_dump_tick()) {
+        nat_dump_sample(NL_HTTP, "", 0, uri.data(), uri.size(),
+                        verb.data(), verb.size(), ctx.body.data(),
+                        ctx.body.size(), trace_id, parent_span);
+      }
       // capture the span method BEFORE pop_front: `path` may view into
       // in_buf's own blocks (fetch's zero-copy case) which the pop
       // recycles
@@ -498,6 +506,14 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
     if (conn_close) {
       std::lock_guard g(h->http_mu);
       h->close_seqs.push_back(seq);
+    }
+    // flight-recorder tap, py-lane arm (r->service = verb, r->method =
+    // uri): the native-usercode seam above captures the other arm
+    if (nat_dump_enabled() && nat_dump_tick()) {
+      nat_dump_sample(NL_HTTP, "", 0, r->method.data(),
+                      r->method.size(), r->service.data(),
+                      r->service.size(), r->payload.data(),
+                      r->payload.size(), trace_id, parent_span);
     }
     s->in_buf.pop_front(total);
     srv->enqueue_py(r);

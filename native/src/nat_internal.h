@@ -40,6 +40,7 @@
 
 #include "iobuf.h"
 #include "nat_api.h"
+#include "nat_dump.h"
 #include "nat_fault.h"
 #include "nat_lockrank.h"
 #include "nat_refown.h"

@@ -37,6 +37,10 @@ namespace brpc_tpu {
 enum : int {
   kLockRankMuSelftest = 4,    // nat_mu_contend_selftest's burn mutex
                               // (holds nothing, held under nothing)
+  kLockRankDumpCtl = 5,       // nat_dump g_dump_ctl_mu: flight-recorder
+                              // start/stop/status (control path only;
+                              // held across the writer join, which
+                              // takes no NatMutex of its own)
   kLockRankProfCtl = 6,       // nat_prof g_ctl_mu: start/stop/reset
                               // serialization (control path only; held
                               // across the collector join, which takes

@@ -538,6 +538,18 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
     if (srv != nullptr) {
       srv->requests.fetch_add(1, std::memory_order_relaxed);
       nat_counter_add(NS_TPU_STD_MSGS_IN, 1);
+      if (nat_dump_enabled() && nat_dump_tick()) {
+        // flight-recorder tap (nat_dump.h): the request payload with
+        // the wire's trace context, BEFORE the handler/py-lane branch
+        // so both dispatch paths are captured (attachment bytes stay
+        // out — replay re-sends the payload field only)
+        nat_dump_sample_iobuf(NL_ECHO, meta.request.service_name.data(),
+                              meta.request.service_name.size(),
+                              meta.request.method_name.data(),
+                              meta.request.method_name.size(), payload,
+                              (uint64_t)meta.request.trace_id,
+                              (uint64_t)meta.request.span_id);
+      }
       // this connection speaks tpu_std: the quiesce lame-duck pass may
       // answer it with a SHUTDOWN control frame (once is enough)
       if (!s->spoke_tpu_std.load(std::memory_order_relaxed)) {

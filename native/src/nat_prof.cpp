@@ -354,6 +354,7 @@ std::map<std::vector<uintptr_t>, std::pair<uint64_t, uint64_t>>&
 const char* mu_rank_name(int rank) {
   switch (rank) {
     case kLockRankMuSelftest: return "mu.selftest";
+    case kLockRankDumpCtl: return "dump.ctl";
     case kLockRankProfCtl: return "prof.ctl";
     case kLockRankProfReport: return "prof.report";
     case kLockRankMuProfReport: return "muprof.report";

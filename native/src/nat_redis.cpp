@@ -529,6 +529,13 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
           word[wi] = (ch >= 'a' && ch <= 'z') ? (char)(ch - 32) : ch;
         }
         midx = nat_method_idx(NL_REDIS, word, wl);
+        // flight-recorder tap (redis store seam): the raw RESP command
+        // bytes (p..pos), method = the case-normalized command word;
+        // RESP carries no trace metadata, so the ids stay 0
+        if (nat_dump_enabled() && nat_dump_tick()) {
+          nat_dump_sample(NL_REDIS, "", 0, word, wl, nullptr, 0, p, pos,
+                          0, 0);
+        }
       }
       nat_method_begin(midx);
       if (store_execute(srv->redis_store, argv, &reply, store_known)) {

@@ -1243,6 +1243,16 @@ int nat_shm_push_tensor(const char* data, size_t len, uint64_t tag) {
   // across the process boundary — the consumer reads them back through
   // nat_req_sock_id (trace_id) / nat_req_cid (parent span id).
   const NatTraceCtx& tc = tls_nat_trace;
+  // flight-recorder tap (kind-8 descriptor seam): the staged tensor
+  // bytes, method = "tensor/<tag>" — bulk records past the capture's
+  // max_payload are skipped whole and counted as oversize
+  if (nat_dump_enabled() && nat_dump_tick()) {
+    char tag_m[32];
+    int tag_n = snprintf(tag_m, sizeof(tag_m), "tensor/%llu",
+                         (unsigned long long)tag);
+    nat_dump_sample(NL_WORKER, "", 0, tag_m, (size_t)tag_n, nullptr, 0,
+                    data, len, tc.trace_id, tc.span_id);
+  }
   bool ok = push_to_some_worker(
       8, 0, tc.trace_id, (int64_t)tc.span_id, 0, len, tag,
       [&](char* dst) {

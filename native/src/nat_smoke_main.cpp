@@ -14,6 +14,7 @@
 #include <cstdio>
 #include <cstring>
 #include <cstdlib>
+#include <unistd.h>
 
 #include <atomic>
 #include <chrono>
@@ -21,6 +22,7 @@
 #include <thread>
 
 #include "nat_api.h"
+#include "nat_dump.h"   // NatDumpStatusRec / NatReplayResult layouts
 #include "nat_stats.h"  // full NatSpanRec layout for the drain buffer
 
 static int g_failures = 0;
@@ -426,6 +428,70 @@ int main() {
   double redis_qps = nat_redis_client_bench("127.0.0.1", port, 1, 8, 0.2,
                                             &redis_reqs);
   CHECK(redis_qps > 0 && redis_reqs > 0, "redis bench lane");
+
+  // ---- flight-recorder round: dump tap + capture rings + recordio
+  // writer + native replay under instrumentation (the per-thread rings
+  // race the background writer; replay's worker pool drives the public
+  // sync client surface against the same server) ----
+  {
+    char dump_dir[] = "/tmp/nat_smoke_dump.XXXXXX";
+    CHECK(mkdtemp(dump_dir) != nullptr, "dump dir created");
+    CHECK(nat_dump_start(dump_dir, 1, 99, 1u << 20, 2, 1u << 20) == 0,
+          "dump start");
+    CHECK(nat_dump_running() == 1, "dump running");
+    CHECK(nat_dump_start(dump_dir, 1, 99, 0, 0, 0) == -1,
+          "dump double start loses");
+    int dump_calls = 0;
+    void* dch = nat_channel_open("127.0.0.1", port, 0, 0, 0, 0);
+    CHECK(dch != nullptr, "dump channel open");
+    if (dch != nullptr) {
+      for (int i = 0; i < 20; i++) {
+        char* resp = nullptr;
+        size_t rlen = 0;
+        char* err = nullptr;
+        int rc = nat_channel_call_full(dch, "EchoService", "Echo",
+                                       "flight-recorder", 15, 2000, 0, 0,
+                                       &resp, &rlen, &err);
+        if (rc == 0) dump_calls++;
+        if (resp != nullptr) nat_buf_free(resp);
+        if (err != nullptr) nat_buf_free(err);
+      }
+      nat_channel_close(dch);
+    }
+    CHECK(dump_calls == 20, "dump-window calls echoed");
+    brpc_tpu::NatDumpStatusRec dst;
+    memset(&dst, 0, sizeof(dst));
+    auto dump_ddl =
+        std::chrono::steady_clock::now() + std::chrono::seconds(10);
+    while (std::chrono::steady_clock::now() < dump_ddl) {
+      nat_dump_status(&dst);
+      if (dst.written >= (uint64_t)dump_calls) break;
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    CHECK(nat_dump_stop() == 0, "dump stop");
+    CHECK(nat_dump_running() == 0, "dump stopped");
+    nat_dump_status(&dst);
+    CHECK(dst.written >= (uint64_t)dump_calls, "dump records persisted");
+    CHECK(dst.drops == 0, "dump dropped nothing");
+    brpc_tpu::NatReplayResult rr;
+    memset(&rr, 0, sizeof(rr));
+    CHECK(nat_replay_run("127.0.0.1", port, dump_dir, 2, 0.0, 0.0, 4,
+                         5000, &rr) == 0,
+          "replay run");
+    CHECK(rr.failed == 0, "replay zero failed RPCs");
+    CHECK(rr.ok == rr.sent && rr.sent == dst.written * 2,
+          "replay response-count parity");
+    CHECK(rr.p50_us > 0.0 && rr.p99_us >= rr.p50_us,
+          "replay latency recorded");
+    // leave /tmp clean across smoke runs (two generations at most)
+    for (uint64_t gen = 0; gen < 4; gen++) {
+      char path[300];
+      snprintf(path, sizeof(path), "%s/nat_dump.%d.%06llu.rio",
+               dump_dir, (int)getpid(), (unsigned long long)gen);
+      remove(path);
+    }
+    remove(dump_dir);
+  }
 
   // ---- natfault round: echo + retry under semantics-preserving faults
   // (short reads/writes fragment I/O, EINTR exercises the requeue arms)

@@ -101,6 +101,14 @@ static const char* kCounterNames[NS_COUNTER_COUNT] = {
     "nat_quiesce_drained_ok",
     "nat_quiesce_drain_deadline_drops",
     "nat_quiesce_draining_redials",
+    "nat_dump_samples",
+    "nat_dump_records_written",
+    "nat_dump_bytes_written",
+    "nat_dump_drops",
+    "nat_dump_oversize",
+    "nat_dump_rotations",
+    "nat_replay_calls",
+    "nat_replay_errors",
 };
 
 static const char* kLaneNames[NL_LANE_COUNT] = {
@@ -330,6 +338,31 @@ void nat_span_record(int lane, uint64_t sock_id, const char* method,
   nat_span_submit(rec);
 }
 
+// Quantile (0..1) over a log2 histogram, interpolated within the
+// winning bucket. ns; 0.0 when empty. Shared by the lane/per-method
+// quantile exports AND nat_replay.cpp's run-local histogram, so the
+// interpolation can never diverge between them (declared nat_stats.h).
+double nat_hist_quantile(const uint64_t* buckets, int nb, double q) {
+  uint64_t total = 0;
+  for (int b = 0; b < nb; b++) total += buckets[b];
+  if (total == 0) return 0.0;
+  if (q < 0.0) q = 0.0;
+  if (q > 1.0) q = 1.0;
+  double target = q * (double)total;
+  double acc = 0.0;
+  for (int b = 0; b < nb; b++) {
+    if (buckets[b] == 0) continue;
+    if (acc + (double)buckets[b] >= target) {
+      double lo = b == 0 ? 0.0 : (double)(1ull << (b - 1));
+      double hi = (double)(1ull << b);
+      double frac = (target - acc) / (double)buckets[b];
+      return lo + frac * (hi - lo);
+    }
+    acc += (double)buckets[b];
+  }
+  return (double)(1ull << (nb - 1));
+}
+
 }  // namespace brpc_tpu
 
 // ---------------------------------------------------------------------------
@@ -387,35 +420,11 @@ int nat_stats_hist(int lane, uint64_t* out, int max) {
   return nb;
 }
 
-// Quantile (0..1) over a log2 histogram, interpolated within the
-// winning bucket. ns; 0.0 when empty. Shared by the lane and per-method
-// quantile exports so the interpolation can never diverge between them.
-static double hist_quantile(const uint64_t* buckets, int nb, double q) {
-  uint64_t total = 0;
-  for (int b = 0; b < nb; b++) total += buckets[b];
-  if (total == 0) return 0.0;
-  if (q < 0.0) q = 0.0;
-  if (q > 1.0) q = 1.0;
-  double target = q * (double)total;
-  double acc = 0.0;
-  for (int b = 0; b < nb; b++) {
-    if (buckets[b] == 0) continue;
-    if (acc + (double)buckets[b] >= target) {
-      double lo = b == 0 ? 0.0 : (double)(1ull << (b - 1));
-      double hi = (double)(1ull << b);
-      double frac = (target - acc) / (double)buckets[b];
-      return lo + frac * (hi - lo);
-    }
-    acc += (double)buckets[b];
-  }
-  return (double)(1ull << (nb - 1));
-}
-
 double nat_stats_hist_quantile(int lane, double q) {
   uint64_t buckets[kNatHistBuckets];
   int nb = nat_stats_hist(lane, buckets, kNatHistBuckets);
   if (nb == 0) return 0.0;
-  return hist_quantile(buckets, nb, q);
+  return brpc_tpu::nat_hist_quantile(buckets, nb, q);
 }
 
 // Snapshot the per-method table: fills up to `max` rows (used slots in
@@ -447,7 +456,7 @@ double nat_method_quantile(int lane, const char* method, double q) {
   for (int b = 0; b < kNatHistBuckets; b++) {
     buckets[b] = c.hist[b].load(std::memory_order_relaxed);
   }
-  return hist_quantile(buckets, kNatHistBuckets, q);
+  return brpc_tpu::nat_hist_quantile(buckets, kNatHistBuckets, q);
 }
 
 // Arm (or clear, with 0,0) this thread's ambient trace context: client

@@ -63,6 +63,16 @@ enum NatCounterId : int {
                             // drain deadline (stragglers, never reset)
   NS_QUIESCE_DRAINING_REDIALS, // client detaches from a lame-duck peer
                             // (next call re-dials / re-balances)
+  // traffic flight recorder (nat_dump.cpp / nat_replay.cpp): monotonic
+  // cross-window totals; per-window figures ride nat_dump_status
+  NS_DUMP_SAMPLES,          // requests captured into the dump rings
+  NS_DUMP_RECORDS_WRITTEN,  // records persisted to recordio files
+  NS_DUMP_BYTES_WRITTEN,    // capture file bytes (headers+meta+payload)
+  NS_DUMP_DROPS,            // ring-full / cell-pool drops
+  NS_DUMP_OVERSIZE,         // payloads past the cap, skipped whole
+  NS_DUMP_ROTATIONS,        // capture file generation rollovers
+  NS_REPLAY_CALLS,          // replay calls fired (all lanes)
+  NS_REPLAY_ERRORS,         // replay calls that failed
   NS_COUNTER_COUNT,
 };
 
@@ -129,6 +139,12 @@ inline void nat_lat_record(int lane, uint64_t ns) {
   std::atomic<uint64_t>& c = nat_cell()->hist[lane][nat_hist_bucket(ns)];
   c.store(c.load(std::memory_order_relaxed) + 1, std::memory_order_relaxed);
 }
+
+// Quantile (0..1) over a log2 histogram, interpolated within the
+// winning bucket; ns, 0.0 when empty. ONE implementation shared by the
+// lane exports, the per-method exports and the replay client — the
+// interpolation must never diverge between them. Defined nat_stats.cpp.
+double nat_hist_quantile(const uint64_t* buckets, int nb, double q);
 
 // ---------------------------------------------------------------------------
 // per-method stats — the native MethodStatus table (details/method_status.h

@@ -130,6 +130,19 @@ def test_zero_baseline_lane_is_skipped(pair):
     assert benchgate.compare(base, cur) == []
 
 
+def test_scaling_lane_unmeasurable_on_one_cpu_host(pair):
+    """A 1-cpu container cannot measure a 2-cpu scaling ratio: a
+    missing cpus2_scaling_x with extra.host_cpus == 1 is unmeasurable
+    (the current-side twin of the zero-baseline skip), while a >= 2 cpu
+    host dropping it is still a finding."""
+    base, cur = pair
+    del cur["lanes"]["cpus2_scaling_x"]
+    cur["bench"]["extra"]["host_cpus"] = 1
+    assert benchgate.compare(base, cur) == []
+    cur["bench"]["extra"]["host_cpus"] = 2
+    assert _rules(benchgate.compare(base, cur)) == ["missing-lane"]
+
+
 def test_schema_drift_fails(pair):
     base, cur = pair
     cur["schema"] = "brpc_tpu-bench-artifact/999"

@@ -141,6 +141,13 @@ def test_every_counter_enum_in_prometheus_exposition(server):
                  "nat_queue_deadline_drops", "nat_retry_budget_exhausted",
                  "nat_breaker_isolations", "nat_breaker_revivals"):
         assert name in exposed, name
+    # the flight-recorder counters specifically (the ISSUE 12 satellite:
+    # every nat_dump_* / nat_replay_* counter rides the exposition)
+    for name in ("nat_dump_samples", "nat_dump_records_written",
+                 "nat_dump_bytes_written", "nat_dump_drops",
+                 "nat_dump_oversize", "nat_dump_rotations",
+                 "nat_replay_calls", "nat_replay_errors"):
+        assert name in exposed, name
 
 
 def test_observatory_vars_in_prometheus_exposition(server):

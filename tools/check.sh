@@ -21,6 +21,11 @@
 # --chaos (or NATCHECK_CHAOS=1) runs the fixed-seed fault-injection soak
 # (C smoke + pytest native matrix under the documented NAT_FAULT spec)
 # and writes native/CHAOS.md (see tools/natcheck/chaos.py).
+# --replay (or NATCHECK_REPLAY=1) runs the flight-recorder round-trip
+# gate: capture a seeded native run, restart the server fresh, replay
+# the capture through the native replay client — zero failed RPCs,
+# response-count parity, Python-reader byte identity (see
+# tools/natcheck/replay.py).
 # --bench (or NATCHECK_BENCH=1) runs the perf regression gate: bench.py
 # with the nat_prof flight recorder attached, a schema'd artifact
 # (BENCH_latest.json), and a headline-lane diff against the last
@@ -38,12 +43,14 @@ SOAK="${NATCHECK_SOAK:-0}"
 CHAOS="${NATCHECK_CHAOS:-0}"
 BENCH="${NATCHECK_BENCH:-0}"
 REFGUARD="${NATCHECK_REFGUARD:-0}"
+REPLAY="${NATCHECK_REPLAY:-0}"
 for arg in "$@"; do
     case "$arg" in
         --soak) SOAK=1 ;;
         --chaos) CHAOS=1 ;;
         --bench) BENCH=1 ;;
         --refguard) REFGUARD=1 ;;
+        --replay) REPLAY=1 ;;
     esac
 done
 
@@ -93,6 +100,19 @@ print("natcheck: refguard lane: %s"
 print_findings(findings)
 sys.exit(1 if findings else 0)
 PYRG
+fi
+
+if [ "$REPLAY" = "1" ]; then
+    JAX_PLATFORMS=cpu "$PY" - <<'PYRP' || RC=1
+import sys
+sys.path.insert(0, ".")
+from tools.natcheck import print_findings, replay
+findings = replay.run()
+print("natcheck: replay lane: %s"
+      % ("clean" if not findings else "%d finding(s)" % len(findings)))
+print_findings(findings)
+sys.exit(1 if findings else 0)
+PYRP
 fi
 
 if [ "$SOAK" = "1" ]; then

@@ -71,6 +71,11 @@ HEADLINE_LANES: Dict[str, float] = {
     # stable, and a 0 baseline (ring refused) skips the row entirely
     "io_uring_qps": DEFAULT_TOL,
     "io_uring_async_qps": DEFAULT_TOL,
+    # flight-recorder replay of the committed golden capture (press
+    # mode): native data path, but the short window includes capture
+    # parse + thread ramp — banded wider until committed rounds prove
+    # it as stable as the long-window lanes
+    "replay_qps": 0.30,
     # Python-usercode lanes: GIL scheduling noise on the 1-CPU host
     "http_py_qps": 0.30,
     "grpc_py_qps": 0.30,
@@ -199,6 +204,13 @@ def find_baseline(repo_root: str = REPO_ROOT) -> Optional[str]:
     return best
 
 
+def _host_cpus(artifact: dict) -> int:
+    """CPUs the recording host actually had (bench.py records
+    extra.host_cpus); 0 when the artifact predates the field."""
+    v = ((artifact.get("bench") or {}).get("extra") or {}).get("host_cpus")
+    return v if isinstance(v, int) else 0
+
+
 def _profile_excerpt(current: dict, lines: int = 12) -> str:
     flat = (current.get("nat_prof") or {}).get("flat") or []
     if not flat:
@@ -251,6 +263,12 @@ def compare(baseline: dict, current: dict) -> List[Finding]:
         if base_v <= 0:
             continue  # unmeasurable at baseline (e.g. io_uring refused)
         if lane not in cur_lanes:
+            if lane == "cpus2_scaling_x" and _host_cpus(current) < 2:
+                # a 1-cpu host cannot measure a 2-cpu scaling ratio:
+                # unmeasurable on this container, not silently dropped
+                # (the io_uring-refused 0-baseline case's twin on the
+                # current side)
+                continue
             findings.append(Finding(
                 "bench", "missing-lane", where,
                 f"lane {lane!r} present in the baseline "

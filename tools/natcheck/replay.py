@@ -1,0 +1,127 @@
+"""replay lane — capture/replay round-trip gate (``tools/check.sh
+--replay``).
+
+The flight recorder's correctness contract, proven live in one process:
+
+1. start a native server (builtin echo handler) and arm the dump tap
+   with a fixed seed at 1-in-1 sampling;
+2. drive a seeded run of tpu_std calls through the native client;
+3. stop the capture, restart the server FRESH (new port, empty stats);
+4. replay the capture through the native replay client
+   (``nat_replay_run``) and require ZERO failed RPCs and
+   response-count parity (ok == records captured == requests driven);
+5. cross-check the capture files parse with the Python reader
+   (``butil/recordio.py``) with byte-identical payloads — the
+   native-written/Python-read half of the interop contract (the other
+   half, Python-written/native-replayed, rides
+   tests/test_rpc_dump_replay.py).
+
+Each broken leg is a Finding; a clean run returns [].
+"""
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import tempfile
+from typing import List
+
+from tools.natcheck import Finding
+
+N_CALLS = 40
+SEED = 1234
+
+
+def run() -> List[Finding]:
+    where = "tools/check.sh --replay"
+    try:
+        from brpc_tpu import native
+
+        if not native.available():
+            return [Finding("replay", "no-native", where,
+                            "native toolchain unavailable")]
+    except Exception as e:
+        return [Finding("replay", "no-native", where,
+                        f"native import failed: {e}")]
+
+    findings: List[Finding] = []
+    capture_dir = tempfile.mkdtemp(prefix="natcheck_replay_")
+    try:
+        port = native.rpc_server_start(native_echo=True)
+        rc = native.dump_start(capture_dir, every=1, seed=SEED)
+        if rc != 0:
+            native.rpc_server_stop()
+            return [Finding("replay", "dump-start", where,
+                            f"nat_dump_start rc={rc}")]
+        sent = []
+        h = native.channel_open("127.0.0.1", port)
+        for i in range(N_CALLS):
+            payload = (b"replay-lane-%04d-" % i) * (1 + i % 5)
+            code, body, text = native.channel_call(
+                h, "EchoService", "Echo", payload, timeout_ms=5000)
+            if code != 0 or body != payload:
+                findings.append(Finding(
+                    "replay", "capture-drive", where,
+                    f"seed call {i} failed: code={code} {text!r}"))
+                break
+            sent.append(payload)
+        native.channel_close(h)
+        import time
+
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if native.dump_status()["written"] >= len(sent):
+                break
+            time.sleep(0.05)
+        native.dump_stop()
+        native.rpc_server_stop()
+        if findings:
+            return findings
+
+        st = native.dump_status()
+        if st["written"] != len(sent) or st["drops"] != 0:
+            findings.append(Finding(
+                "replay", "capture-parity", where,
+                f"captured {st['written']}/{len(sent)} records "
+                f"(drops={st['drops']}) at 1-in-1 sampling"))
+
+        # interop leg: the Python reader parses the native files with
+        # byte-identical payloads, in capture order
+        from brpc_tpu.butil.recordio import RecordReader
+
+        got = []
+        for path in sorted(glob.glob(os.path.join(capture_dir, "*.rio"))):
+            with RecordReader(path) as reader:
+                for meta, payload in reader:
+                    got.append(payload)
+                    if meta.get("service") != "EchoService":
+                        findings.append(Finding(
+                            "replay", "meta-drift", where,
+                            f"record meta {meta!r} lost the service"))
+        if got != sent:
+            findings.append(Finding(
+                "replay", "byte-identity", where,
+                f"python reader saw {len(got)} payloads, "
+                f"{sum(1 for a, b in zip(got, sent) if a != b)} of the "
+                f"overlapping ones differ from what was sent"))
+
+        # replay leg: fresh server, zero failures, count parity
+        port2 = native.rpc_server_start(native_echo=True)
+        try:
+            res = native.replay_run("127.0.0.1", port2, capture_dir,
+                                    times=1, concurrency=4,
+                                    timeout_ms=5000)
+        except (ValueError, ConnectionError) as e:
+            native.rpc_server_stop()
+            findings.append(Finding("replay", "replay-run", where, str(e)))
+            return findings
+        native.rpc_server_stop()
+        if res["failed"] != 0 or res["ok"] != len(sent):
+            findings.append(Finding(
+                "replay", "replay-parity", where,
+                f"replayed ok={res['ok']} failed={res['failed']} of "
+                f"{len(sent)} captured requests — the contract is zero "
+                f"failures and full response-count parity"))
+    finally:
+        shutil.rmtree(capture_dir, ignore_errors=True)
+    return findings

@@ -2,12 +2,19 @@
 """rpc_replay — replays rpc_dump recordio samples against a live server.
 
 Counterpart of tools/rpc_replay (/root/reference/tools/rpc_replay/): reads
-the recordio files produced by -rpc_dump (brpc_tpu/rpc/rpc_dump.py) and
+the recordio files produced by -rpc_dump (brpc_tpu/rpc/rpc_dump.py) or by
+the native flight recorder (native/src/nat_dump.cpp — same format) and
 re-issues each sampled request, optionally qps-throttled.
+
+--native re-fires the capture through the native replay client
+(nat_replay_run): tpu_std/HTTP/gRPC records go through the real native
+client lanes from a worker-thread pool, with an optional linear qps ramp
+(--qps-to) and latency quantiles recorded — the rpc_press-grade load
+mode over captured traffic.
 
 Usage:
   python tools/rpc_replay.py --dir ./rpc_dump --server 127.0.0.1:8000 \
-      [--qps 100] [--times 1]
+      [--qps 100] [--times 1] [--native [--qps-to 500] [--concurrency 8]]
 """
 from __future__ import annotations
 
@@ -26,7 +33,30 @@ def main():
     ap.add_argument("--qps", type=float, default=0)
     ap.add_argument("--times", type=int, default=1)
     ap.add_argument("--timeout-ms", type=float, default=1000)
+    ap.add_argument("--native", action="store_true",
+                    help="replay through the native client lanes "
+                         "(nat_replay_run)")
+    ap.add_argument("--qps-to", type=float, default=0,
+                    help="with --native: ramp the rate linearly from "
+                         "--qps to this across the run")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="with --native: worker threads firing calls")
     args = ap.parse_args()
+
+    if args.native:
+        from brpc_tpu import native
+
+        ip, _, port = args.server.rpartition(":")
+        res = native.replay_run(ip or "127.0.0.1", int(port), args.dir,
+                                times=args.times, qps=args.qps,
+                                qps_to=args.qps_to,
+                                concurrency=args.concurrency,
+                                timeout_ms=int(args.timeout_ms))
+        print(f"replayed ok={res['ok']} failed={res['failed']} "
+              f"skipped={res['skipped']} in {res['seconds']:.1f}s "
+              f"({res['qps']:.1f} qps) "
+              f"p50={res['p50_us']:.0f}us p99={res['p99_us']:.0f}us")
+        return 1 if res["failed"] else 0
 
     from brpc_tpu import rpc
     from brpc_tpu.butil.recordio import RecordReader
@@ -42,12 +72,20 @@ def main():
         return 1
 
     interval = 1.0 / args.qps if args.qps > 0 else 0
-    ok = fail = 0
+    ok = fail = skipped = 0
     t0 = time.monotonic()
     for _ in range(args.times):
         for path in files:
             with RecordReader(path) as reader:
                 for meta, payload in reader:
+                    # native mixed-lane captures: only tpu_std records
+                    # are replayable through this Channel — firing an
+                    # HTTP/redis/worker record as "service.method"
+                    # would be a guaranteed bogus call (use --native
+                    # for the other lanes)
+                    if meta.get("lane", "echo") != "echo":
+                        skipped += 1
+                        continue
                     method = f"{meta['service']}.{meta['method']}"
                     # replay raw payload bytes; response left unparsed
                     cntl, _ = ch.call(method, payload, None,
@@ -59,9 +97,9 @@ def main():
                     if interval:
                         time.sleep(interval)
     dt = time.monotonic() - t0
-    print(f"replayed ok={ok} failed={fail} in {dt:.1f}s "
-          f"({(ok + fail) / dt:.1f} qps)")
-    return 0
+    print(f"replayed ok={ok} failed={fail} skipped={skipped} "
+          f"in {dt:.1f}s ({(ok + fail) / max(dt, 1e-9):.1f} qps)")
+    return 1 if fail else 0
 
 
 if __name__ == "__main__":
