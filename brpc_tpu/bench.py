@@ -619,6 +619,21 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # native fan-out lanes (ISSUE 13, ROADMAP item 1): 32-backend
+    # parallel fan-out + the Python-ParallelChannel comparison, then the
+    # 1000-backend swarm churned by rolling SIGTERM restarts and live
+    # naming updates (the zero-failed-RPC acceptance drill)
+    fanout_lanes = {}
+    try:
+        fanout_lanes = fanout_lane_bench(seconds=max(1.0, seconds / 2))
+    except Exception:
+        pass
+    swarm_lanes = {}
+    try:
+        swarm_lanes = fanout_swarm_bench()
+    except Exception:
+        pass
+
     # py-usercode across worker processes (VERDICT r4 #2, shm lane)
     worker_lanes = {}
     try:
@@ -748,6 +763,8 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             **http_lanes,
             **redis_lanes,
             **replay_lanes,
+            **fanout_lanes,
+            **swarm_lanes,
             **worker_lanes,
             **stream_lanes,
             **model_rows,
@@ -783,6 +800,279 @@ def replay_lane_bench(times: int = 3, concurrency: int = 8) -> dict:
         return {"replay_qps": 0.0, "replay_failed": res["failed"]}
     return {"replay_qps": round(res["qps"], 1),
             "replay_p99_us": round(res["p99_us"], 1)}
+
+
+def fanout_lane_bench(seconds: float = 1.5, backends: int = 32) -> dict:
+    """Native fan-out lanes (ISSUE 13, ROADMAP item 1): one native echo
+    server listening on `backends` ports (each port a distinct LB
+    backend), fanned to by the C++ cluster's ParallelChannel verb —
+    every call issues `backends` concurrent sub-calls over the
+    DoublyBufferedData LB and merges responses natively.
+
+    fanout_qps / fanout_p99_us: native parallel fan-out verb rate and
+    tail. fanout_py_qps: the SAME fan-out through the pure-Python
+    ParallelChannel against the same server (the path every fan-out
+    paid before the native cluster); fanout_native_vs_py_x is the
+    speedup the acceptance bar holds at >= 5x. Zero failed sub-calls is
+    part of the lane contract: failures report 0 qps so the gate trips.
+    """
+    from brpc_tpu import native, rpc
+    from brpc_tpu.rpc.proto import echo_pb2
+
+    out: dict = {}
+    port = native.rpc_server_start(native_echo=True)
+    try:
+        ports = [port]
+        for _ in range(backends - 1):
+            ports.append(native.rpc_server_add_port())
+        h = native.cluster_create("rr", connect_timeout_ms=1000,
+                                  health_check_ms=100, breaker=True)
+        try:
+            native.cluster_update(h, [f"127.0.0.1:{p}" for p in ports])
+            r = native.cluster_bench(h, mode=1, param=0, seconds=seconds,
+                                     concurrency=4, timeout_ms=3000)
+            out["fanout_backends"] = backends
+            if r["failed"]:
+                out["fanout_qps"] = 0.0
+                out["fanout_failed"] = r["failed"]
+            else:
+                out["fanout_qps"] = round(r["qps"], 1)
+                out["fanout_p99_us"] = round(r["p99_us"], 1)
+        finally:
+            native.cluster_close(h)
+
+        # the honest comparison: the pure-Python ParallelChannel fanning
+        # to the SAME backends on the same host (sub-calls through the
+        # Python Channel/Socket stack, threading.Event merge)
+        from brpc_tpu.rpc.combo_channels import ParallelChannel
+
+        pch = ParallelChannel()
+        chans = []
+        for p in ports:
+            ch = rpc.Channel(rpc.ChannelOptions(timeout_ms=3000))
+            ch.init(f"127.0.0.1:{p}")
+            chans.append(ch)
+            pch.add_channel(ch)
+        req = echo_pb2.EchoRequest(message="x" * 16)
+        py_seconds = max(1.0, seconds / 2)
+        stop_at = time.monotonic() + py_seconds
+        py_calls = 0
+        py_failed = 0
+        while time.monotonic() < stop_at:
+            cntl = rpc.Controller()
+            cntl.timeout_ms = 3000
+            resp = echo_pb2.EchoResponse()
+            pch.call_method("EchoService.Echo", cntl, req, resp)
+            py_calls += 1
+            if cntl.failed():
+                py_failed += 1
+        for ch in chans:
+            ch.close()
+        py_qps = py_calls / py_seconds
+        out["fanout_py_qps"] = round(py_qps, 1)
+        out["fanout_py_failed"] = py_failed
+        if py_qps > 0 and out.get("fanout_qps", 0) > 0:
+            out["fanout_native_vs_py_x"] = round(
+                out["fanout_qps"] / py_qps, 2)
+    finally:
+        native.rpc_server_stop()
+    return out
+
+
+def _spawn_swarm_server(base: int, count: int, repo_root: str, env: dict):
+    """One swarm backend process: a native echo server listening on
+    `count` consecutive ports from `base`. Returns the Popen (READY
+    already seen) or None when a port in the range was taken.
+
+    BRPC_TPU_CHURN_FAULT (the PR-8 chaos hook): when set, the SERVER
+    process arms that NAT_FAULT spec at library load — the chaos lane's
+    swarm round runs the whole drill with destructive seeds in the
+    backends while the client side stays clean."""
+    import os
+    import subprocess
+    import sys
+
+    churn_spec = env.get("BRPC_TPU_CHURN_FAULT") or \
+        os.environ.get("BRPC_TPU_CHURN_FAULT")
+    if churn_spec:
+        env = dict(env)
+        env["NAT_FAULT"] = churn_spec
+
+    script = (
+        "import os, signal, sys\n"
+        "sys.path.insert(0, '.')\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from brpc_tpu import native\n"
+        f"base, count = {base}, {count}\n"
+        "try:\n"
+        "    native.rpc_server_start('127.0.0.1', base, 2, True)\n"
+        "    for p in range(base + 1, base + count):\n"
+        "        native.rpc_server_add_port('127.0.0.1', p)\n"
+        "except Exception:\n"
+        "    print('BINDFAIL', flush=True)\n"
+        "    sys.exit(17)\n"
+        "print('READY', flush=True)\n"
+        "def _term(sig, frm):\n"
+        "    native.server_quiesce(3000)\n"  # graceful: lame-duck + drain
+        "    native.rpc_server_stop()\n"
+        "    os._exit(0)\n"
+        "signal.signal(signal.SIGTERM, _term)\n"
+        "while True:\n"
+        "    signal.pause()\n")
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True,
+                            cwd=repo_root, env=env)
+    line = proc.stdout.readline().strip()
+    if line != "READY":
+        proc.kill()
+        proc.wait(timeout=10)
+        return None
+    return proc
+
+
+def fanout_swarm_bench(backends: int = 1000, servers: int = 3,
+                       bench_seconds: float = 12.0,
+                       concurrency: int = 4) -> dict:
+    """The ROADMAP acceptance drill: a `backends`-port in-process swarm
+    (`servers` subprocesses, each hosting backends/servers native echo
+    ports) behind one native cluster, churned by ROLLING SIGTERM
+    restarts (graceful quiesce + lame-duck, PR 8) and LIVE naming
+    add/remove (the file naming service rewritten mid-run) while the
+    selective-with-retry verb floods it from C threads. The contract is
+    ZERO failed RPCs once failover/retry settles — a run with failures
+    reports swarm_qps 0 so the bench gate trips — with the per-backend
+    qps distribution recorded in the artifact.
+
+    Also records fanout1000_qps: the parallel verb fanning one call to
+    all `backends` backends (measured before the churn starts)."""
+    import json as _json
+    import os
+    import signal as _signal
+    import threading as _threading
+
+    from brpc_tpu import native
+    from brpc_tpu.rpc.native_cluster import NativeCluster
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    per = backends // servers
+    out: dict = {}
+    procs = []
+    bases = []
+    nf_path = None
+    cluster = None
+    try:
+        base_candidates = [21000, 23000, 25000, 27000, 29000, 19000]
+        ci = 0
+        for _ in range(servers):
+            proc = None
+            while proc is None and ci < len(base_candidates):
+                base = base_candidates[ci]
+                ci += 1
+                proc = _spawn_swarm_server(base, per, repo_root, env)
+                if proc is not None:
+                    procs.append(proc)
+                    bases.append(base)
+        if len(procs) < servers:
+            raise RuntimeError("swarm port ranges unavailable")
+        all_ports = [b + i for b in bases for i in range(per)]
+        import tempfile
+
+        nf = tempfile.NamedTemporaryFile("w", suffix=".swarm.ns",
+                                         delete=False)
+        nf_path = nf.name
+
+        def write_naming(ports):
+            with open(nf_path, "w") as f:
+                for p in ports:
+                    f.write(f"127.0.0.1:{p}\n")
+
+        write_naming(all_ports)
+        nf.close()
+        cluster = NativeCluster(lb="rr", connect_timeout_ms=1000,
+                                health_check_ms=200, breaker=True,
+                                name="swarm")
+        cluster.watch(f"file://{nf_path}")
+        n = cluster.backend_count()
+        out["swarm_backends"] = n
+
+        # parallel fan-out to the WHOLE swarm (pre-churn): one verb =
+        # `backends` concurrent sub-calls + native merge
+        r1000 = cluster.bench(mode=1, param=0, seconds=1.0,
+                              concurrency=2, timeout_ms=8000)
+        out["fanout1000_qps"] = (0.0 if r1000["failed"]
+                                 else round(r1000["qps"], 1))
+
+        # churn window: selective flood from C threads while this thread
+        # SIGTERMs each server in turn and flaps the naming file
+        result: dict = {}
+
+        def flood():
+            result.update(cluster.bench(mode=0, param=12,
+                                        seconds=bench_seconds,
+                                        concurrency=concurrency,
+                                        timeout_ms=5000))
+
+        flood_t = _threading.Thread(target=flood)
+        flood_t.start()
+        time.sleep(0.5)
+        # live naming remove (the tail 5% of backends)...
+        drop = max(1, n // 20)
+        write_naming(all_ports[:-drop])
+        restarts = 0
+        for i in range(len(procs)):
+            procs[i].send_signal(_signal.SIGTERM)
+            try:
+                procs[i].wait(timeout=20)
+            except Exception:
+                procs[i].kill()
+                procs[i].wait(timeout=10)
+            fresh = _spawn_swarm_server(bases[i], per, repo_root, env)
+            if fresh is None:
+                break
+            procs[i] = fresh
+            restarts += 1
+        # ...and live naming re-add
+        write_naming(all_ports)
+        flood_t.join(timeout=bench_seconds + 60)
+        out["swarm_restarts"] = restarts
+        out["swarm_calls"] = result.get("calls", 0)
+        out["swarm_p99_us"] = round(result.get("p99_us", 0.0), 1)
+        failed = result.get("failed", -1)
+        out["swarm_failed"] = failed
+        # the zero-failed contract IS the lane value
+        out["swarm_qps"] = (round(result.get("qps", 0.0), 1)
+                            if failed == 0 and restarts == len(procs)
+                            else 0.0)
+        # per-backend qps distribution (the artifact's evidence that the
+        # LB spread the flood): selects quantiles across live backends
+        selects = sorted(row["selects"] for row in cluster.stats())
+        if selects:
+            out["swarm_selects_per_backend"] = {
+                "min": selects[0],
+                "p50": selects[len(selects) // 2],
+                "max": selects[-1],
+            }
+        out["swarm_stats_note"] = _json.dumps(
+            {"servers": len(procs), "ports_per_server": per})
+    finally:
+        if cluster is not None:
+            cluster.close()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                pass
+        if nf_path is not None:
+            try:
+                os.unlink(nf_path)
+            except OSError:
+                pass
+    return out
 
 
 def _host_parallel_probe(seconds: float = 1.5) -> float:

@@ -139,6 +139,45 @@ def _lock_dim(field: str):
             for r in native.mu_rank_stats()}
 
 
+_cluster_rows_cache = {"ts": 0.0, "rows": []}
+
+
+def _cluster_rows():
+    """(cluster_name, backend_row) pairs over every live native cluster
+    (the brpc_tpu.rpc.native_cluster registry), cached for 0.25s like
+    the other snapshot caches — one /brpc_metrics dump evaluates six
+    nat_cluster_backend_* dimensions, and each uncached fetch walks
+    every cluster's member map natively. Import is lazy and
+    failure-tolerant: a process that never built a cluster pays one
+    cheap import check per dump."""
+    now = time.monotonic()
+    if now - _cluster_rows_cache["ts"] <= 0.25:
+        return _cluster_rows_cache["rows"]
+    try:
+        from brpc_tpu.rpc.native_cluster import live_clusters
+    except Exception:
+        return []
+    out = []
+    for c in live_clusters():
+        try:
+            for row in c.stats():
+                out.append((c.name, row))
+        except Exception:
+            continue
+    _cluster_rows_cache["ts"] = now
+    _cluster_rows_cache["rows"] = out
+    return out
+
+
+def _cluster_dim(field: str, as_int=None):
+    out = {}
+    for cname, r in _cluster_rows():
+        v = r[field]
+        out[(("cluster", cname), ("backend", r["endpoint"]))] = \
+            int(v) if as_int else v
+    return out
+
+
 class _ClampedPerSecond(PerSecond):
     """PerSecond over a native counter: monotonic except for
     nat_stats_reset/mu_prof_reset (test/bench hygiene), which would
@@ -303,6 +342,21 @@ def register_native_bvars() -> bool:
             ("nat_lock_contention_waits", lambda: _lock_dim("waits")),
             ("nat_lock_contention_wait_us",
              lambda: _lock_dim("wait_us")),
+            # native fan-out clusters (ISSUE 13): one row per backend of
+            # every live cluster — LB selects/errors, in-flight
+            # sub-calls, breaker/lame-duck state, EMA latency feedback
+            ("nat_cluster_backend_selects",
+             lambda: _cluster_dim("selects")),
+            ("nat_cluster_backend_errors",
+             lambda: _cluster_dim("errors")),
+            ("nat_cluster_backend_inflight",
+             lambda: _cluster_dim("inflight")),
+            ("nat_cluster_backend_breaker_open",
+             lambda: _cluster_dim("breaker_open", as_int=True)),
+            ("nat_cluster_backend_lame_duck",
+             lambda: _cluster_dim("lame_duck", as_int=True)),
+            ("nat_cluster_backend_ema_latency_us",
+             lambda: _cluster_dim("ema_latency_us")),
         )
         for vname, fn in _LABELED:
             if find_exposed(vname) is None:
@@ -438,6 +492,32 @@ def native_status_lines(snap: Optional[Dict[str, int]] = None) -> List[str]:
             continue
         lines.append(f"  {lane}_latency_us: p50={p50:.1f} p99={p99:.1f} "
                      f"p999={p999:.1f}")
+    # per-cluster tables (ISSUE 13): every live native cluster lists its
+    # backends with LB + health state — the /status face of the
+    # nat_cluster_* Prometheus rows
+    try:
+        from brpc_tpu.rpc.native_cluster import live_clusters
+
+        for c in live_clusters():
+            rows = c.stats()
+            lines.append(f"  cluster {c.name} [{c.lb}]: "
+                         f"{len(rows)} backends")
+            for r in rows:
+                state = []
+                if r["breaker_open"]:
+                    state.append("BREAKER-OPEN")
+                if r["lame_duck"]:
+                    state.append("lame-duck")
+                if r["tag"]:
+                    state.append(f"tag={r['tag']}")
+                lines.append(
+                    f"    {r['endpoint']} w={r['weight']} "
+                    f"selects={r['selects']} errors={r['errors']} "
+                    f"inflight={r['inflight']} "
+                    f"ema_us={r['ema_latency_us']}"
+                    + (" " + " ".join(state) if state else ""))
+    except Exception:
+        pass
     return lines
 
 

@@ -138,6 +138,25 @@ class NatReplayResult(ctypes.Structure):
     ]
 
 
+class NatClusterRow(ctypes.Structure):
+    """Mirror of nat_stats.h NatClusterRow — one per-backend row of a
+    native cluster's server list (selects/errors/breaker/lame-duck)."""
+
+    _fields_ = [
+        ("selects", ctypes.c_uint64),
+        ("errors", ctypes.c_uint64),
+        ("inflight", ctypes.c_int64),
+        ("ema_latency_us", ctypes.c_uint64),
+        ("weight", ctypes.c_int32),
+        ("breaker_open", ctypes.c_int32),
+        ("lame_duck", ctypes.c_int32),
+        ("part_index", ctypes.c_int32),
+        ("part_total", ctypes.c_int32),
+        ("endpoint", ctypes.c_char * 24),
+        ("tag", ctypes.c_char * 16),
+    ]
+
+
 def _build() -> bool:
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
@@ -477,6 +496,59 @@ def load() -> ctypes.CDLL:
             ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(NatReplayResult)]
         lib.nat_replay_run.restype = ctypes.c_int
+        # -- native fan-out cluster (nat_cluster.cpp / nat_lb.cpp) --
+        lib.nat_rpc_server_add_port.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int]
+        lib.nat_rpc_server_add_port.restype = ctypes.c_int
+        lib.nat_rpc_server_remove_port.argtypes = [ctypes.c_int]
+        lib.nat_rpc_server_remove_port.restype = ctypes.c_int
+        lib.nat_cluster_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.nat_cluster_create.restype = ctypes.c_void_p
+        lib.nat_cluster_close.argtypes = [ctypes.c_void_p]
+        lib.nat_cluster_close.restype = None
+        lib.nat_cluster_update.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p]
+        lib.nat_cluster_update.restype = ctypes.c_int
+        lib.nat_cluster_backend_count.argtypes = [ctypes.c_void_p]
+        lib.nat_cluster_backend_count.restype = ctypes.c_int
+        lib.nat_cluster_select_debug.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_size_t]
+        lib.nat_cluster_select_debug.restype = ctypes.c_int
+        lib.nat_cluster_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_char_p)]
+        lib.nat_cluster_call.restype = ctypes.c_int
+        lib.nat_cluster_parallel_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int)]
+        lib.nat_cluster_parallel_call.restype = ctypes.c_int
+        lib.nat_cluster_partition_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int)]
+        lib.nat_cluster_partition_call.restype = ctypes.c_int
+        lib.nat_cluster_stats.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(NatClusterRow),
+                                          ctypes.c_int]
+        lib.nat_cluster_stats.restype = ctypes.c_int
+        lib.nat_cluster_bench.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_double)]
+        lib.nat_cluster_bench.restype = ctypes.c_double
         # -- trace context + in-process sampling profiler (nat_prof.cpp) --
         lib.nat_trace_set.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
         lib.nat_trace_set.restype = None
@@ -1498,6 +1570,200 @@ def replay_run(ip: str, port: int, files, times: int = 1,
         "p50_us": res.p50_us,
         "p99_us": res.p99_us,
     }
+
+
+# -- native fan-out cluster (nat_cluster.cpp / nat_lb.cpp) ------------------
+
+def rpc_server_add_port(ip: str = "127.0.0.1", port: int = 0) -> int:
+    """Listen on another port with the RUNNING native server (the
+    swarm-backend seam: one process, N ports, each port a distinct LB
+    backend). Returns the bound port; raises if no server is running."""
+    rc = load().nat_rpc_server_add_port(ip.encode(), port)
+    if rc <= 0:
+        raise RuntimeError("nat_rpc_server_add_port failed")
+    return rc
+
+
+def rpc_server_remove_port(port: int) -> int:
+    """Unregister a port added with rpc_server_add_port (accepted
+    connections keep serving; new connects are refused)."""
+    return load().nat_rpc_server_remove_port(port)
+
+
+def cluster_create(lb: str = "rr", connect_timeout_ms: int = 500,
+                   health_check_ms: int = 100, breaker: bool = True):
+    """Open a native cluster: DoublyBufferedData server list, native LB
+    (rr/wrr/random/wr/la/c_hash), per-backend lazily-dialed channels
+    with circuit breakers + lame-duck failover. Feed it with
+    cluster_update; call through cluster_call / cluster_parallel_call /
+    cluster_partition_call. The higher-level wrapper (NativeCluster in
+    brpc_tpu.rpc.native_cluster) adds the naming-observer thread."""
+    h = load().nat_cluster_create(lb.encode(), connect_timeout_ms,
+                                  health_check_ms, 1 if breaker else 0)
+    if not h:
+        raise RuntimeError(f"nat_cluster_create failed (lb={lb!r})")
+    return h
+
+
+def cluster_close(handle):
+    load().nat_cluster_close(handle)
+
+
+def cluster_node_entry(node):
+    """(endpoint[, weight[, tag]]) tuple or bare endpoint ->
+    (endpoint, weight, tag) with per-missing-field defaults (naive list
+    padding would hand a 2-tuple the weight default as its TAG)."""
+    if isinstance(node, (tuple, list)):
+        ep = node[0]
+        weight = node[1] if len(node) > 1 else 1
+        tag = node[2] if len(node) > 2 else ""
+        return str(ep), int(weight), str(tag)
+    return str(node), 1, ""
+
+
+def cluster_update(handle, servers) -> int:
+    """Full-list naming feed. `servers` is a spec string of
+    "ip:port[ weight[ tag]]" entries (';'/','/newline separated) or an
+    iterable of such entries / (endpoint, weight, tag) tuples. Returns
+    the backend count."""
+    if not isinstance(servers, (str, bytes)):
+        parts = []
+        for s in servers:
+            ep, weight, tag = cluster_node_entry(s)
+            parts.append(f"{ep} {weight} {tag}".strip())
+        servers = ";".join(parts)
+    if isinstance(servers, str):
+        servers = servers.encode()
+    rc = load().nat_cluster_update(handle, servers)
+    if rc < 0:
+        raise ValueError("malformed server spec (or closed cluster)")
+    return rc
+
+
+def cluster_backend_count(handle) -> int:
+    return load().nat_cluster_backend_count(handle)
+
+
+def cluster_select_debug(handle, request_code: int = 0):
+    """Which endpoint would the LB pick for request_code right now?
+    Lookup-only (no dial, no counters); None when nothing is usable."""
+    buf = ctypes.create_string_buffer(32)
+    rc = load().nat_cluster_select_debug(handle, request_code, buf, 32)
+    return buf.value.decode() if rc == 0 else None
+
+
+def cluster_call(handle, service: str, method: str, payload: bytes = b"",
+                 timeout_ms: int = 0, max_retry: int = 2,
+                 request_code: int = 0):
+    """SelectiveChannel verb: LB-pick one backend, fail over to another
+    on failure (timeout covers all attempts). Returns
+    (error_code, response_bytes, error_text)."""
+    lib = load()
+    resp = ctypes.c_char_p()
+    rlen = ctypes.c_size_t(0)
+    err = ctypes.c_char_p()
+    rc = lib.nat_cluster_call(handle, service.encode(), method.encode(),
+                              payload, len(payload), timeout_ms, max_retry,
+                              request_code, ctypes.byref(resp),
+                              ctypes.byref(rlen), ctypes.byref(err))
+    body = b""
+    if resp:
+        body = ctypes.string_at(resp, rlen.value)
+        lib.nat_buf_free(resp)
+    text = ""
+    if err:
+        text = ctypes.string_at(err).decode(errors="replace")
+        lib.nat_buf_free(err)
+    return rc, body, text
+
+
+def _cluster_fan(fn, handle, service, method, payload, timeout_ms, args):
+    lib = load()
+    resp = ctypes.c_char_p()
+    rlen = ctypes.c_size_t(0)
+    err = ctypes.c_char_p()
+    failed = ctypes.c_int(0)
+    rc = fn(handle, service.encode(), method.encode(), payload,
+            len(payload), timeout_ms, *args, ctypes.byref(resp),
+            ctypes.byref(rlen), ctypes.byref(err), ctypes.byref(failed))
+    body = b""
+    if resp:
+        body = ctypes.string_at(resp, rlen.value)
+        lib.nat_buf_free(resp)
+    text = ""
+    if err:
+        text = ctypes.string_at(err).decode(errors="replace")
+        lib.nat_buf_free(err)
+    return rc, body, text, failed.value
+
+
+def cluster_parallel_call(handle, service: str, method: str,
+                          payload: bytes = b"", timeout_ms: int = 0,
+                          fail_limit: int = 0):
+    """ParallelChannel verb: fan the request to EVERY backend, merge the
+    successful responses natively (concatenation in backend order ==
+    protobuf MergeFrom). Returns (error_code, merged_bytes, error_text,
+    failed_subcalls); fails once failed sub-calls reach fail_limit
+    (<= 0 = all must fail)."""
+    return _cluster_fan(load().nat_cluster_parallel_call, handle, service,
+                        method, payload, timeout_ms, (fail_limit,))
+
+
+def cluster_partition_call(handle, service: str, method: str,
+                           payload: bytes = b"", timeout_ms: int = 0,
+                           partitions: int = 0, fail_limit: int = 0):
+    """PartitionChannel verb: one sub-call per "i/n" partition group
+    (partitions = n; 0 infers the largest scheme present), merged in
+    partition order. Returns (error_code, merged_bytes, error_text,
+    failed_subcalls)."""
+    return _cluster_fan(load().nat_cluster_partition_call, handle, service,
+                        method, payload, timeout_ms,
+                        (partitions, fail_limit))
+
+
+def cluster_stats(handle, max_rows: int = 4096) -> list:
+    """Per-backend rows: [{'endpoint', 'tag', 'weight', 'selects',
+    'errors', 'inflight', 'ema_latency_us', 'breaker_open', 'lame_duck',
+    'part_index', 'part_total'}, ...]."""
+    arr = (NatClusterRow * max_rows)()
+    n = load().nat_cluster_stats(handle, arr, max_rows)
+    out = []
+    for i in range(n):
+        r = arr[i]
+        out.append({
+            "endpoint": r.endpoint.decode(errors="replace"),
+            "tag": r.tag.decode(errors="replace"),
+            "weight": r.weight,
+            "selects": r.selects,
+            "errors": r.errors,
+            "inflight": r.inflight,
+            "ema_latency_us": r.ema_latency_us,
+            "breaker_open": bool(r.breaker_open),
+            "lame_duck": bool(r.lame_duck),
+            "part_index": r.part_index,
+            "part_total": r.part_total,
+        })
+    return out
+
+
+def cluster_bench(handle, mode: int = 0, service: str = "EchoService",
+                  method: str = "Echo", payload: bytes = b"x" * 16,
+                  timeout_ms: int = 2000, param: int = 2,
+                  seconds: float = 2.0, concurrency: int = 4) -> dict:
+    """Drive the cluster from C threads: mode 0 = selective (param =
+    max_retry), 1 = parallel (param = fail_limit). ctypes releases the
+    GIL for the whole run, so churn orchestration (SIGTERMs, naming
+    updates) can ride a Python thread beside it. Returns {'qps',
+    'calls', 'failed', 'p99_us'}."""
+    calls = ctypes.c_uint64(0)
+    failed = ctypes.c_uint64(0)
+    p99 = ctypes.c_double(0.0)
+    qps = load().nat_cluster_bench(
+        handle, mode, service.encode(), method.encode(), payload,
+        len(payload), timeout_ms, param, seconds, concurrency,
+        ctypes.byref(calls), ctypes.byref(failed), ctypes.byref(p99))
+    return {"qps": qps, "calls": calls.value, "failed": failed.value,
+            "p99_us": p99.value}
 
 
 # -- in-process sampling profiler (nat_prof.cpp) ----------------------------

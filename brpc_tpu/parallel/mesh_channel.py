@@ -27,15 +27,45 @@ from brpc_tpu.parallel import collectives
 
 
 class MeshChannel:
-    """One mesh axis treated as a set of N sub-channels."""
+    """One mesh axis treated as a set of N sub-channels.
 
-    def __init__(self, mesh: Mesh, axis: str):
+    Two fan-out axes compose here (ISSUE 13): the DEVICE axis keeps its
+    XLA-collective lowering (parallel_call/ring_call/partition_call
+    below — one fused device program), while the HOST axis goes native:
+    attach_host_cluster() binds a brpc_tpu.rpc.native_cluster
+    NativeCluster, and host_parallel_call() fans an RPC across that
+    cluster's backends through the C++ fan-out core (DoublyBufferedData
+    LB select, sub-calls on fibers, native merge) — the cross-host hop
+    of a host×device 2D mesh without touching Python per sub-call.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str, host_cluster=None):
         if axis not in mesh.shape:
             raise ValueError(f"axis {axis!r} not in mesh {tuple(mesh.shape)}")
         self.mesh = mesh
         self.axis = axis
         self.n = mesh.shape[axis]
         self._cache = {}
+        self.host_cluster = host_cluster
+
+    # -- host axis (native fan-out) ---------------------------------------
+    def attach_host_cluster(self, cluster):
+        """Bind the host axis: a NativeCluster whose backends are the
+        peer hosts of this mesh slice."""
+        self.host_cluster = cluster
+        return self
+
+    def host_parallel_call(self, service_method: str, payload: bytes,
+                           timeout_ms: int = 1000, fail_limit: int = 0):
+        """ParallelChannel semantics over the HOST axis: the request
+        fans to every host backend natively; returns (error_code,
+        merged_bytes, error_text, failed_subcalls)."""
+        if self.host_cluster is None:
+            raise ValueError("no host cluster attached "
+                             "(attach_host_cluster)")
+        return self.host_cluster.parallel_call(service_method, payload,
+                                               timeout_ms=timeout_ms,
+                                               fail_limit=fail_limit)
 
     # -- ParallelChannel analog -------------------------------------------
     def parallel_call(self, fn: Callable, x, merger: Optional[str] = "add"):

@@ -29,6 +29,58 @@ from brpc_tpu.rpc import errors
 from brpc_tpu.rpc.channel import Channel, ChannelOptions
 from brpc_tpu.rpc.controller import Controller
 
+# ---------------------------------------------------------------------------
+# Native fast path (ISSUE 13): the combo channels grow `native=True` —
+# same API shape as the Python path, but the server list, the LB, the
+# fan-out sub-calls and the response merge run in the C++ core
+# (native/src/nat_cluster.cpp via brpc_tpu.rpc.native_cluster). The
+# native merge concatenates successful sub-responses in sub-call order,
+# which for serialized protobufs IS MergeFrom — the default
+# ResponseMerger semantics — so response.MergeFromString(merged) yields
+# the same result the Python merger produces.
+# ---------------------------------------------------------------------------
+
+
+def _native_cluster_init(naming_url: str, lb_name: str,
+                         options: Optional[ChannelOptions],
+                         node_filter=None, name: str = ""):
+    from brpc_tpu.rpc.native_cluster import NativeCluster
+
+    connect_ms = int(options.connect_timeout_ms) if options else 500
+    hc_ms = (int(options.health_check_interval_s * 1000)
+             if options is not None and options.health_check_interval_s > 0
+             else 100)
+    cluster = NativeCluster(lb=lb_name or "rr",
+                            connect_timeout_ms=connect_ms,
+                            health_check_ms=hc_ms, name=name)
+    cluster.watch(naming_url, node_filter)
+    return cluster
+
+
+def _native_run(cntl: Controller, done, fn):
+    """Run one native combo verb: sync inline, async on a thread (combo
+    fan-out is already parallel natively; the thread only carries the
+    done-callback contract)."""
+    if done is None:
+        fn()
+        return
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+
+
+def _native_finish(cntl: Controller, response, rc: int, body: bytes,
+                   err: str, start_time: float, done):
+    import time as _t
+
+    if rc == 0:
+        if response is not None and body:
+            response.MergeFromString(body)
+    else:
+        cntl.set_failed(rc, err or errors.berror(rc))
+    cntl.latency_us = (_t.monotonic() - start_time) * 1e6
+    if done is not None:
+        done(cntl)
+
 
 class SubCall:
     """What a CallMapper returns for one sub-channel
@@ -72,21 +124,67 @@ class ResponseMerger:
 
 
 class ParallelChannel:
-    def __init__(self, fail_limit: int = -1):
+    def __init__(self, fail_limit: int = -1, native: bool = False):
         self._subs: List[Tuple[Channel, Optional[CallMapper], Optional[ResponseMerger]]] = []
         self.fail_limit = fail_limit
+        self.native = native
+        self._cluster = None
+
+    def init(self, naming_url: str, lb_name: str = "rr",
+             options: Optional[ChannelOptions] = None) -> int:
+        """Native-mode init (same shape as Channel.init): the naming url
+        feeds the C++ cluster; every resolved server is a sub-channel.
+        The Python path keeps using add_channel()."""
+        if not self.native:
+            raise ValueError("init(naming_url) requires native=True; "
+                             "use add_channel() on the Python path")
+        self._cluster = _native_cluster_init(naming_url, lb_name, options,
+                                             name="parallel")
+        self._options = options
+        return 0
 
     def add_channel(self, channel: Channel,
                     call_mapper: Optional[CallMapper] = None,
                     response_merger: Optional[ResponseMerger] = None):
+        if self._cluster is not None:
+            raise ValueError("native ParallelChannel fans to its naming "
+                             "service's servers; add_channel is the "
+                             "Python path")
         self._subs.append((channel, call_mapper, response_merger))
 
     @property
     def channel_count(self) -> int:
+        if self._cluster is not None:
+            return self._cluster.backend_count()
         return len(self._subs)
+
+    def stop(self):
+        if self._cluster is not None:
+            self._cluster.close()
+
+    def _call_method_native(self, method: str, cntl: Controller, request,
+                            response, done: Optional[Callable]):
+        import time as _t
+
+        payload = request.SerializeToString() if request is not None else b""
+        timeout_ms = int(cntl.timeout_ms or 1000)
+        fail_limit = self.fail_limit if self.fail_limit > 0 else 0
+        start_time = _t.monotonic()
+
+        def run():
+            rc, body, err, _failed = self._cluster.parallel_call(
+                method, payload, timeout_ms=timeout_ms,
+                fail_limit=fail_limit)
+            _native_finish(cntl, response, rc, body, err, start_time,
+                           done)
+
+        _native_run(cntl, done, run)
 
     def call_method(self, method: str, cntl: Controller, request, response,
                     done: Optional[Callable] = None):
+        if self._cluster is not None:
+            self._call_method_native(method, cntl, request, response, done)
+            return
         n = len(self._subs)
         if n == 0:
             cntl.set_failed(errors.EINVAL, "no sub channels")
@@ -198,13 +296,25 @@ class PartitionChannel(ParallelChannel):
     """N sub-channels fed by ONE naming service; server tag picks the
     partition (partition_channel.h:41-103)."""
 
-    def __init__(self, fail_limit: int = -1):
-        super().__init__(fail_limit)
+    def __init__(self, fail_limit: int = -1, native: bool = False):
+        super().__init__(fail_limit, native=native)
         self._ns_threads = []
+        self._partition_count = 0
 
     def init(self, partition_count: int, naming_url: str, lb_name: str = "rr",
              parser: Optional[PartitionParser] = None,
              options: Optional[ChannelOptions] = None) -> int:
+        if self.native:
+            # the C++ core groups backends by the default "i/n" tag
+            # grammar; a custom parser needs the Python path
+            if parser is not None and type(parser) is not PartitionParser:
+                raise ValueError("native PartitionChannel supports the "
+                                 "default 'i/n' tag grammar only")
+            self._partition_count = partition_count
+            self._cluster = _native_cluster_init(naming_url, lb_name,
+                                                 options,
+                                                 name="partition")
+            return 0
         parser = parser or PartitionParser()
         for part in range(partition_count):
             ch = Channel(options)
@@ -222,7 +332,26 @@ class PartitionChannel(ParallelChannel):
             self.add_channel(ch)
         return 0
 
+    def _call_method_native(self, method: str, cntl: Controller, request,
+                            response, done: Optional[Callable]):
+        import time as _t
+
+        payload = request.SerializeToString() if request is not None else b""
+        timeout_ms = int(cntl.timeout_ms or 1000)
+        fail_limit = self.fail_limit if self.fail_limit > 0 else 0
+        start_time = _t.monotonic()
+
+        def run():
+            rc, body, err, _failed = self._cluster.partition_call(
+                method, payload, timeout_ms=timeout_ms,
+                partitions=self._partition_count, fail_limit=fail_limit)
+            _native_finish(cntl, response, rc, body, err, start_time,
+                           done)
+
+        _native_run(cntl, done, run)
+
     def stop(self):
+        super().stop()
         for t in self._ns_threads:
             if t is not None:
                 t.stop()
@@ -325,20 +454,44 @@ class SelectiveChannel:
     """LB over channels with failover (selective_channel.h:52-72): each call
     goes to ONE sub-channel; failure retries another."""
 
-    def __init__(self, max_retry: int = 2):
+    def __init__(self, max_retry: int = 2, native: bool = False):
         self._channels: List[Channel] = []
         self._health: Dict[int, int] = {}  # index -> consecutive failures
         self._index = 0
         self._lock = threading.Lock()
         self.max_retry = max_retry
+        self.native = native
+        self._cluster = None
+
+    def init(self, naming_url: str, lb_name: str = "rr",
+             options: Optional[ChannelOptions] = None) -> int:
+        """Native-mode init: LB + failover retry run in the C++ cluster
+        (selection excludes already-tried backends, the per-backend
+        breakers fail dead peers fast, lame-duck peers re-balance)."""
+        if not self.native:
+            raise ValueError("init(naming_url) requires native=True; "
+                             "use add_channel() on the Python path")
+        self._cluster = _native_cluster_init(naming_url, lb_name, options,
+                                             name="selective")
+        return 0
+
+    def stop(self):
+        if self._cluster is not None:
+            self._cluster.close()
 
     def add_channel(self, channel: Channel) -> int:
+        if self._cluster is not None:
+            raise ValueError("native SelectiveChannel balances over its "
+                             "naming service's servers; add_channel is "
+                             "the Python path")
         with self._lock:
             self._channels.append(channel)
             return len(self._channels) - 1
 
     @property
     def channel_count(self) -> int:
+        if self._cluster is not None:
+            return self._cluster.backend_count()
         return len(self._channels)
 
     def _select(self, exclude: set) -> Optional[int]:
@@ -359,8 +512,31 @@ class SelectiveChannel:
             self._index = (self._index + 1) % len(candidates)
             return candidates[self._index]
 
+    def _call_method_native(self, method: str, cntl: Controller, request,
+                            response, done: Optional[Callable]):
+        import time as _t
+
+        payload = request.SerializeToString() if request is not None else b""
+        timeout_ms = int(cntl.timeout_ms or 1000)
+        start_time = _t.monotonic()
+        request_code = int(getattr(cntl, "request_code", 0) or 0)
+
+        def run():
+            rc, body, err = self._cluster.call(
+                method, payload, timeout_ms=timeout_ms,
+                max_retry=self.max_retry, request_code=request_code)
+            if rc == 0 and response is not None and body:
+                response.Clear()  # one backend answered: replace, not merge
+            _native_finish(cntl, response, rc, body, err, start_time,
+                           done)
+
+        _native_run(cntl, done, run)
+
     def call_method(self, method: str, cntl: Controller, request, response,
                     done: Optional[Callable] = None):
+        if self._cluster is not None:
+            self._call_method_native(method, cntl, request, response, done)
+            return
         tried = set()
         last_cntl = None
         for _ in range(self.max_retry + 1):

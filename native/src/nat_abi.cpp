@@ -58,6 +58,7 @@ NAT_TY(brpc_tpu::NatConnRow, "struct:NatConnRow");
 NAT_TY(brpc_tpu::NatLockRankRow, "struct:NatLockRankRow");
 NAT_TY(brpc_tpu::NatDumpStatusRec, "struct:NatDumpStatusRec");
 NAT_TY(brpc_tpu::NatReplayResult, "struct:NatReplayResult");
+NAT_TY(brpc_tpu::NatClusterRow, "struct:NatClusterRow");
 #undef NAT_TY
 
 template <typename T>
@@ -128,6 +129,7 @@ int main() {
   // removed/renamed field breaks this build, a reorder changes offsets, an
   // added field changes sizeof — all surface as manifest/ctypes diffs.
   printf("  \"structs\": {\n");
+  using brpc_tpu::NatClusterRow;
   using brpc_tpu::NatConnRow;
   using brpc_tpu::NatDumpStatusRec;
   using brpc_tpu::NatLockRankRow;
@@ -216,6 +218,21 @@ int main() {
                    NAT_FIELD(NatReplayResult, qps),
                    NAT_FIELD(NatReplayResult, p50_us),
                    NAT_FIELD(NatReplayResult, p99_us),
+               },
+               false);
+  print_struct("NatClusterRow", sizeof(NatClusterRow),
+               {
+                   NAT_FIELD(NatClusterRow, selects),
+                   NAT_FIELD(NatClusterRow, errors),
+                   NAT_FIELD(NatClusterRow, inflight),
+                   NAT_FIELD(NatClusterRow, ema_latency_us),
+                   NAT_FIELD(NatClusterRow, weight),
+                   NAT_FIELD(NatClusterRow, breaker_open),
+                   NAT_FIELD(NatClusterRow, lame_duck),
+                   NAT_FIELD(NatClusterRow, part_index),
+                   NAT_FIELD(NatClusterRow, part_total),
+                   NAT_FIELD(NatClusterRow, endpoint),
+                   NAT_FIELD(NatClusterRow, tag),
                },
                true);
 #undef NAT_FIELD
@@ -350,6 +367,18 @@ int main() {
       NAT_SYM(nat_dump_running),
       NAT_SYM(nat_dump_status),
       NAT_SYM(nat_replay_run),
+      NAT_SYM(nat_rpc_server_add_port),
+      NAT_SYM(nat_rpc_server_remove_port),
+      NAT_SYM(nat_cluster_create),
+      NAT_SYM(nat_cluster_close),
+      NAT_SYM(nat_cluster_update),
+      NAT_SYM(nat_cluster_backend_count),
+      NAT_SYM(nat_cluster_select_debug),
+      NAT_SYM(nat_cluster_call),
+      NAT_SYM(nat_cluster_parallel_call),
+      NAT_SYM(nat_cluster_partition_call),
+      NAT_SYM(nat_cluster_stats),
+      NAT_SYM(nat_cluster_bench),
       NAT_SYM(nat_prof_start),
       NAT_SYM(nat_prof_stop),
       NAT_SYM(nat_prof_running),

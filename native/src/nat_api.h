@@ -21,6 +21,7 @@ struct NatConnRow;        // native /connections snapshot row (nat_stats.h)
 struct NatLockRankRow;    // per-rank lock-wait totals row (nat_stats.h)
 struct NatDumpStatusRec;  // flight-recorder status snapshot (nat_dump.h)
 struct NatReplayResult;   // replay run result (nat_dump.h)
+struct NatClusterRow;     // per-backend cluster snapshot row (nat_stats.h)
 }
 
 extern "C" {
@@ -103,6 +104,66 @@ int nat_redis_respond(uint64_t sock_id, int64_t seq, const char* data,
 
 // TLS on the native port (nat_ssl.cpp)
 int nat_rpc_server_ssl(const char* cert_path, const char* key_path);
+
+// Multi-port listening on the RUNNING native server (the swarm-backend
+// seam: one process serves N ports, each port a distinct LB backend).
+// add_port binds+listens and shards the listener across the dispatcher
+// pool; returns the bound port (or -1). remove_port unregisters a
+// listener added this way (its accepted connections keep serving).
+// Every extra port tears down with the server (stop/quiesce).
+int nat_rpc_server_add_port(const char* ip, int port);
+int nat_rpc_server_remove_port(int port);
+
+// ---- native fan-out cluster (nat_cluster.cpp / nat_lb.cpp) ----
+// A C++ cluster: DoublyBufferedData server list (zero-lock LB reads),
+// the LB zoo (lb_policy: rr / wrr / random / wr / la / c_hash aliases
+// c_murmurhash,c_md5), per-backend lazily-dialed NatChannels with
+// circuit breakers + lame-duck detach, and the combo-channel verbs.
+// The naming feed (nat_cluster_update) takes the FULL resolved list
+// "ip:port[ weight[ tag]]" separated by ';'/','/newlines each refresh.
+void* nat_cluster_create(const char* lb_policy, int connect_timeout_ms,
+                         int health_check_ms, int enable_breaker);
+void nat_cluster_close(void* h);
+int nat_cluster_update(void* h, const char* servers);
+int nat_cluster_backend_count(void* h);
+int nat_cluster_select_debug(void* h, uint64_t request_code, char* ep_out,
+                             size_t cap);
+// SelectiveChannel verb: LB-pick + failover retry (exclusion set);
+// timeout covers all attempts; request_code keys consistent hashing.
+int nat_cluster_call(void* h, const char* service, const char* method,
+                     const char* payload, size_t payload_len,
+                     int timeout_ms, int max_retry, uint64_t request_code,
+                     char** resp_out, size_t* resp_len,
+                     char** err_text_out);
+// ParallelChannel verb: fan to every backend on fibers, merge the
+// successful responses natively in backend order (concatenation ==
+// protobuf MergeFrom); fails when failed sub-calls reach fail_limit
+// (<= 0 = all). failed_out reports the failed sub-call count.
+int nat_cluster_parallel_call(void* h, const char* service,
+                              const char* method, const char* payload,
+                              size_t payload_len, int timeout_ms,
+                              int fail_limit, char** resp_out,
+                              size_t* resp_len, char** err_text_out,
+                              int* failed_out);
+// PartitionChannel verb: one sub-call per "i/n" partition group
+// (partitions = n; 0 = largest scheme present), merged in partition
+// order; an empty partition counts as a failed sub-call.
+int nat_cluster_partition_call(void* h, const char* service,
+                               const char* method, const char* payload,
+                               size_t payload_len, int timeout_ms,
+                               int partitions, int fail_limit,
+                               char** resp_out, size_t* resp_len,
+                               char** err_text_out, int* failed_out);
+int nat_cluster_stats(void* h, brpc_tpu::NatClusterRow* out, int max);
+// Fan-out bench loop: mode 0 = selective (param = max_retry), 1 =
+// parallel (param = fail_limit); `concurrency` pthreads for `seconds`.
+// Returns verb qps; out_p99_us = verb-latency p99.
+double nat_cluster_bench(void* h, int mode, const char* service,
+                         const char* method, const char* payload,
+                         size_t payload_len, int timeout_ms, int param,
+                         double seconds, int concurrency,
+                         uint64_t* out_calls, uint64_t* out_failed,
+                         double* out_p99_us);
 
 // ---- overload protection: native server admission control
 // (nat_overload.cpp) ----

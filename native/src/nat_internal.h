@@ -56,6 +56,7 @@ namespace brpc_tpu {
 // error codes shared with brpc_tpu/rpc/errors.py
 inline constexpr int kENOSERVICE = 1001;
 inline constexpr int kENOMETHOD = 1002;
+inline constexpr int kETOOMANYFAILS = 1005;  // fan-out fail_limit reached
 inline constexpr int kERPCTIMEDOUT = 1008;
 inline constexpr int kEFAILEDSOCKET = 1009;
 inline constexpr int kELIMIT = 2004;  // max concurrency reached
@@ -393,6 +394,9 @@ extern NatMutex<kLockRankRuntime> g_rt_mu;
 // interference the single-core bench lanes used to include).
 Dispatcher* pick_dispatcher(bool client_side = false);
 int ensure_runtime(int nworkers);
+// Unregister every nat_rpc_server_add_port listener (stop + quiesce
+// teardown). Caller holds g_rt_mu. Defined in nat_server.cpp.
+void server_remove_extra_ports_locked(NatServer* srv);
 
 // ---------------------------------------------------------------------------
 // NatServer
@@ -640,6 +644,11 @@ class NatServer {
     }
     return nullptr;
   }
+  // Extra listening ports (nat_rpc_server_add_port — the swarm-backend
+  // seam): port -> (listen fd, owning dispatcher). Guarded by g_rt_mu
+  // like the primary listener registration; torn down with the server.
+  std::map<int, std::pair<int, Dispatcher*>> extra_ports;
+
   bool py_lane_enabled = false;
   // Route unrecognized framing to the Python protocol stack instead of
   // failing the socket (set when a Python server with a full protocol

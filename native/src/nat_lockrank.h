@@ -54,6 +54,10 @@ enum : int {
   // 15: shm.fence (raw robust pthread mutex, see header comment)
   kLockRankShmReq = 20,       // g_req_mu[i]: per-worker request producer
   kLockRankShmResp = 22,      // g_resp_mu: worker-side response producer
+  kLockRankCluster = 28,      // NatCluster::mu: naming-feed diff/publish
+                              // (creates channels under it: below the
+                              // runtime lock; the LB read path takes NO
+                              // lock — the DoublyBufferedData gate)
   kLockRankRuntime = 30,      // g_rt_mu: runtime/server registry
   kLockRankListen = 34,       // Dispatcher::listen_mu
   kLockRankDispClose = 35,    // Dispatcher::pend_close_mu: deferred

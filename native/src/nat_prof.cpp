@@ -362,6 +362,7 @@ const char* mu_rank_name(int rank) {
     case 15: return "shm.fence";
     case kLockRankShmReq: return "shm.req";
     case kLockRankShmResp: return "shm.resp";
+    case kLockRankCluster: return "cluster";
     case kLockRankRuntime: return "runtime";
     case kLockRankListen: return "disp.listen";
     case kLockRankDispClose: return "disp.close";

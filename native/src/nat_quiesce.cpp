@@ -139,6 +139,8 @@ int nat_server_quiesce(int timeout_ms) {
       g_disp->remove_listener(srv->listen_fd);
       srv->listen_fd = -1;  // stop() must not tear it down again
     }
+    // multi-port servers (swarm backends) stop accepting on EVERY port
+    server_remove_extra_ports_locked(srv);
   }
   // arm the drain gate BEFORE signaling: a request racing the lame-duck
   // frame is rejected (wire answer), never silently dropped

@@ -130,6 +130,18 @@ NAT_REF_TAG(selftest.b, "selftest: plain acquire/release pair")
 NAT_REF_TAG(selftest.c, "selftest: receives a's transfer, then released")
 NAT_REF_TAG(selftest.dbl, "selftest: the deliberate double release")
 
+// Native fan-out cluster (nat_cluster.cpp / nat_lb.{h,cpp}):
+NAT_REF_TAG(clus.opener, "nat_cluster_create's creating reference; "
+            "nat_cluster_close releases")
+NAT_REF_TAG(clus.verb, "one in-flight cluster verb/control op pins the "
+            "cluster (gate + version machinery) until it returns")
+NAT_REF_TAG(clus.member, "the cluster member map's backend reference; "
+            "a naming removal (or close) releases")
+NAT_REF_TAG(clus.ver, "one ServerListVer entry holds the backend; "
+            "released when the version retires after the gate quiesce")
+NAT_REF_TAG(clus.call, "an in-flight sub-call/selective attempt pins its "
+            "backend; the completion/accounting path releases")
+
 // bench harness connections (AsyncBenchConn / CliLaneConn):
 NAT_REF_TAG(bench.owner, "the bench harness + sender fiber's own "
             "reference, dropped when the bench round retires the conn")

@@ -304,6 +304,45 @@ int ensure_runtime(int nworkers) {
   return 0;
 }
 
+// Bound listen socket for a server port. A stop/quiesce DEFERS the old
+// listener fd's close to its dispatcher loop thread (the accept-vs-
+// teardown race fix), so an immediate restart on the SAME port can
+// land in the window before the loop runs — SO_REUSEADDR does not
+// cover a still-open listener. Binding a specific port therefore
+// retries EADDRINUSE briefly (the window is one loop wakeup, normally
+// microseconds; 500ms bounds a stalled loop). Returns the fd or -1.
+static int server_listen_fd(const char* ip, int port) {
+  for (int attempt = 0;; attempt++) {
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    inet_pton(AF_INET, ip, &addr.sin_addr);
+    if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) == 0 &&
+        listen(fd, 1024) == 0) {
+      return fd;
+    }
+    int err = errno;
+    ::close(fd);
+    if (port == 0 || err != EADDRINUSE || attempt >= 100) return -1;
+    struct timespec ts = {0, 5 * 1000 * 1000};
+    nanosleep(&ts, nullptr);
+  }
+}
+
+// Tear down every extra listener (nat_rpc_server_add_port) — server
+// stop/quiesce. Caller holds g_rt_mu.
+void server_remove_extra_ports_locked(NatServer* srv) {
+  for (auto& kv : srv->extra_ports) {
+    kv.second.second->remove_listener(kv.second.first);
+  }
+  srv->extra_ports.clear();
+}
+
 extern "C" {
 
 // -event_dispatcher_num analog: set the epoll-loop pool size BEFORE the
@@ -340,20 +379,9 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
   overload_server_reset();  // stale admission tokens die with the old
                             // server; the limiter config itself persists
   g_draining.store(0, std::memory_order_release);  // fresh server serves
-  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  int fd = server_listen_fd(ip, port);
   if (fd < 0) return -1;
-  int one = 1;
-  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   struct sockaddr_in addr;
-  memset(&addr, 0, sizeof(addr));
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons((uint16_t)port);
-  inet_pton(AF_INET, ip, &addr.sin_addr);
-  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
-      listen(fd, 1024) != 0) {
-    ::close(fd);
-    return -1;
-  }
   socklen_t alen = sizeof(addr);
   getsockname(fd, (struct sockaddr*)&addr, &alen);
 
@@ -414,6 +442,7 @@ void nat_rpc_server_stop() {
       g_disp->remove_listener(srv->listen_fd);
       srv->listen_fd = -1;
     }
+    server_remove_extra_ports_locked(srv);
   }
   g_draining.store(0, std::memory_order_release);
   // stop the python lane (wakes all waiters empty-handed)
@@ -446,6 +475,46 @@ void nat_rpc_server_stop() {
   }
   // sockets/takers may still hold their references — the last deletes
   NAT_REF_RELEASE(srv, srv.registry);
+}
+
+// Multi-port listening (the swarm-backend seam): bind+listen another
+// port for the RUNNING server and shard the listener across the
+// dispatcher pool — 250 ports on a 4-loop runtime accept on 4 loops
+// instead of serializing through loop 0. Returns the bound port.
+int nat_rpc_server_add_port(const char* ip, int port) {
+  int fd = server_listen_fd(ip, port);
+  if (fd < 0) return -1;
+  struct sockaddr_in addr;
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (struct sockaddr*)&addr, &alen);
+  int bound = ntohs(addr.sin_port);
+  {
+    std::lock_guard g(g_rt_mu);
+    NatServer* srv = g_rpc_server;
+    if (srv == nullptr || srv->listen_fd < 0 ||
+        srv->extra_ports.count(bound) != 0) {
+      ::close(fd);  // no server / draining teardown / duplicate port
+      return -1;
+    }
+    Dispatcher* d = pick_dispatcher();
+    srv->extra_ports[bound] = {fd, d};
+    d->add_listener(fd, srv);
+  }
+  return bound;
+}
+
+// Unregister one add_port listener (live naming-removal drills close
+// the port while accepted connections keep serving). Returns 0, or -1
+// when the port was not an extra listener of the running server.
+int nat_rpc_server_remove_port(int port) {
+  std::lock_guard g(g_rt_mu);
+  NatServer* srv = g_rpc_server;
+  if (srv == nullptr) return -1;
+  auto it = srv->extra_ports.find(port);
+  if (it == srv->extra_ports.end()) return -1;
+  it->second.second->remove_listener(it->second.first);
+  srv->extra_ports.erase(it);
+  return 0;
 }
 
 // Enable the multi-protocol raw fallback on the running server: framing

@@ -657,6 +657,113 @@ int main() {
     }
   }
 
+  // ---- cluster round (ISSUE 13): the native fan-out core under
+  // instrumentation — multi-port listeners, the DoublyBufferedData
+  // naming feed racing hot selective/parallel verbs, fail_limit with a
+  // dead backend, per-backend stats, then close with calls settled ----
+  {
+    int p2 = nat_rpc_server_add_port("127.0.0.1", 0);
+    int p3 = nat_rpc_server_add_port("127.0.0.1", 0);
+    CHECK(p2 > 0 && p3 > 0, "swarm add_port");
+    void* cl = nat_cluster_create("rr", 500, 100, 1);
+    CHECK(cl != nullptr, "cluster create");
+    if (cl != nullptr && p2 > 0 && p3 > 0) {
+      char spec[256];
+      snprintf(spec, sizeof(spec),
+               "127.0.0.1:%d;127.0.0.1:%d;127.0.0.1:%d", port, p2, p3);
+      CHECK(nat_cluster_update(cl, spec) == 3, "cluster update");
+      // verb threads race membership flaps (the DBD gate's hot path:
+      // version swap + quiesce vs zero-lock selects)
+      std::atomic<bool> cl_stop{false};
+      std::atomic<int> cl_ok{0};
+      std::atomic<int> cl_fail{0};
+      std::thread cl_caller([&] {
+        while (!cl_stop.load(std::memory_order_acquire)) {
+          char* resp = nullptr;
+          size_t rlen = 0;
+          char* err = nullptr;
+          int rc = nat_cluster_call(cl, "EchoService", "Echo", "clus",
+                                    4, 3000, 4, 0, &resp, &rlen, &err);
+          if (rc == 0 && rlen == 4) {
+            cl_ok.fetch_add(1, std::memory_order_relaxed);
+          } else {
+            cl_fail.fetch_add(1, std::memory_order_relaxed);
+          }
+          if (resp != nullptr) nat_buf_free(resp);
+          if (err != nullptr) nat_buf_free(err);
+        }
+      });
+      for (int i = 0; i < 20; i++) {
+        char flap[256];
+        if (i % 2 == 0) {
+          snprintf(flap, sizeof(flap), "127.0.0.1:%d;127.0.0.1:%d",
+                   port, p2);
+        } else {
+          snprintf(flap, sizeof(flap),
+                   "127.0.0.1:%d;127.0.0.1:%d;127.0.0.1:%d", port, p2,
+                   p3);
+        }
+        CHECK(nat_cluster_update(cl, flap) > 0, "cluster flap update");
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+      }
+      // parallel fan-out + native merge over the final membership
+      {
+        char* resp = nullptr;
+        size_t rlen = 0;
+        char* err = nullptr;
+        int failed = -1;
+        int rc = nat_cluster_parallel_call(cl, "EchoService", "Echo",
+                                           "fan", 3, 3000, 0, &resp,
+                                           &rlen, &err, &failed);
+        CHECK(rc == 0 && failed == 0 && rlen == 9,
+              "cluster parallel merge");
+        if (resp != nullptr) nat_buf_free(resp);
+        if (err != nullptr) nat_buf_free(err);
+      }
+      cl_stop.store(true, std::memory_order_release);
+      cl_caller.join();
+      CHECK(cl_ok.load(std::memory_order_relaxed) > 0,
+            "cluster selective calls succeeded");
+      CHECK(cl_fail.load(std::memory_order_relaxed) == 0,
+            "cluster flap caused no failed calls");
+      // fail_limit with a dead backend folded in
+      {
+        char spec2[256];
+        snprintf(spec2, sizeof(spec2),
+                 "127.0.0.1:%d;127.0.0.1:%d;127.0.0.1:1", port, p2);
+        CHECK(nat_cluster_update(cl, spec2) == 3, "dead-backend update");
+        char* resp = nullptr;
+        size_t rlen = 0;
+        char* err = nullptr;
+        int failed = -1;
+        int rc = nat_cluster_parallel_call(cl, "EchoService", "Echo",
+                                           "fl", 2, 3000, 2, &resp,
+                                           &rlen, &err, &failed);
+        CHECK(rc == 0 && failed == 1 && rlen == 4,
+              "fail_limit tolerates one dead backend");
+        if (resp != nullptr) nat_buf_free(resp);
+        if (err != nullptr) nat_buf_free(err);
+        rc = nat_cluster_parallel_call(cl, "EchoService", "Echo", "fl",
+                                       2, 3000, 1, &resp, &rlen, &err,
+                                       &failed);
+        CHECK(rc != 0 && failed == 1, "fail_limit 1 trips on the dead");
+        if (resp != nullptr) nat_buf_free(resp);
+        if (err != nullptr) nat_buf_free(err);
+      }
+      brpc_tpu::NatClusterRow rows[8];
+      int nrows = nat_cluster_stats(cl, rows, 8);
+      CHECK(nrows == 3, "cluster stats rows");
+      uint64_t total_selects = 0;
+      for (int i = 0; i < nrows; i++) total_selects += rows[i].selects;
+      CHECK(total_selects > 0, "cluster stats selects");
+      nat_cluster_close(cl);
+    } else if (cl != nullptr) {
+      nat_cluster_close(cl);
+    }
+    if (p2 > 0) nat_rpc_server_remove_port(p2);
+    if (p3 > 0) nat_rpc_server_remove_port(p3);
+  }
+
   // ---- clean exit: stop the server, leave the scheduler's detached
   // workers running — process must still exit 0 (the PR-1 class) ----
   nat_rpc_server_stop();

@@ -109,6 +109,14 @@ static const char* kCounterNames[NS_COUNTER_COUNT] = {
     "nat_dump_rotations",
     "nat_replay_calls",
     "nat_replay_errors",
+    "nat_lb_selects",
+    "nat_fanout_calls",
+    "nat_fanout_subcalls",
+    "nat_fanout_subcall_errors",
+    "nat_fanout_fails",
+    "nat_cluster_updates",
+    "nat_cluster_backends_added",
+    "nat_cluster_backends_removed",
 };
 
 static const char* kLaneNames[NL_LANE_COUNT] = {

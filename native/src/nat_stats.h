@@ -73,6 +73,16 @@ enum NatCounterId : int {
   NS_DUMP_ROTATIONS,        // capture file generation rollovers
   NS_REPLAY_CALLS,          // replay calls fired (all lanes)
   NS_REPLAY_ERRORS,         // replay calls that failed
+  // native fan-out cluster (nat_cluster.cpp / nat_lb.cpp)
+  NS_LB_SELECTS,            // LB selections (selective picks + fan subs)
+  NS_FANOUT_CALLS,          // cluster verbs begun (selective/parallel/
+                            // partition)
+  NS_FANOUT_SUBCALLS,       // sub-calls issued by the fan-out verbs
+  NS_FANOUT_SUBCALL_ERRORS, // sub-calls that completed with an error
+  NS_FANOUT_FAILS,          // verbs that failed their fail_limit
+  NS_CLUSTER_UPDATES,       // naming-feed server-list swaps
+  NS_CLUSTER_BACKENDS_ADDED,   // backends opened by naming additions
+  NS_CLUSTER_BACKENDS_REMOVED, // backends retired by naming removals
   NS_COUNTER_COUNT,
 };
 
@@ -204,6 +214,26 @@ struct NatConnRow {
   int32_t server_side;       // 1 = accepted, 0 = dialed
   char protocol[12];         // sniffed session kind ("tpu_std", "http"...)
   char remote[24];           // "ip:port" peer address
+};
+
+// ---------------------------------------------------------------------------
+// per-backend cluster snapshot row (nat_cluster.cpp): one row per member
+// of a native cluster — the /status cluster table and the labeled
+// nat_cluster_* Prometheus rows ride this.
+// ---------------------------------------------------------------------------
+
+struct NatClusterRow {
+  uint64_t selects;         // times the LB handed this backend out
+  uint64_t errors;          // sub-calls/attempts that failed on it
+  int64_t inflight;         // in-flight sub-calls right now
+  uint64_t ema_latency_us;  // locality-aware EMA latency feedback
+  int32_t weight;
+  int32_t breaker_open;     // 1 = breaker-isolated (PR-5 per-channel)
+  int32_t lame_duck;        // 1 = peer recently signaled drain (PR-8)
+  int32_t part_index;       // parsed "i/n" partition tag (-1 untagged)
+  int32_t part_total;
+  char endpoint[24];        // "ip:port"
+  char tag[16];             // raw naming tag
 };
 
 // ---------------------------------------------------------------------------

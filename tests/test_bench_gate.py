@@ -46,6 +46,12 @@ def _bench_result():
             "native_bulk_GBps": 1.66,
             "shm_desc_GBps": 1.45,
             "shm_desc_small_GBps": 0.19,
+            "fanout_qps": 4500.0,
+            "fanout_p99_us": 3200.0,
+            "fanout_py_qps": 130.0,
+            "fanout1000_qps": 60.0,
+            "swarm_qps": 38000.0,
+            "swarm_p99_us": 820.0,
             "native_latency_us": {"echo": {"p50": 10.0, "p99": 50.0,
                                            "p999": 200.0}},
             "nat_prof": {"samples": 1234,
@@ -141,6 +147,54 @@ def test_scaling_lane_unmeasurable_on_one_cpu_host(pair):
     assert benchgate.compare(base, cur) == []
     cur["bench"]["extra"]["host_cpus"] = 2
     assert _rules(benchgate.compare(base, cur)) == ["missing-lane"]
+
+
+def test_fanout_lane_regression_fails(pair):
+    """The native fan-out verb lane holds its 30% band; a zero-qps run
+    (the zero-failed-RPC contract reporting failures as 0) hard-fails."""
+    base, cur = pair
+    cur["lanes"]["fanout_qps"] = base["lanes"]["fanout_qps"] * 0.70
+    assert benchgate.compare(base, cur) == []
+    cur["lanes"]["fanout_qps"] = base["lanes"]["fanout_qps"] * 0.60
+    assert _rules(benchgate.compare(base, cur)) == ["regression"]
+    cur["lanes"]["fanout_qps"] = 0.0  # a failed drill reports 0 qps
+    assert _rules(benchgate.compare(base, cur)) == ["regression"]
+
+
+def test_swarm_zero_failed_contract_trips_gate(pair):
+    base, cur = pair
+    cur["lanes"]["swarm_qps"] = 0.0
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["regression"]
+    assert "swarm_qps" in findings[0].message
+
+
+def test_latency_ceiling_lane_regresses_upward(pair):
+    """fanout_p99_us is a CEILING lane: falling is fine, rising past
+    baseline * (1 + band) is a tail regression even when qps held."""
+    base, cur = pair
+    cur["lanes"]["fanout_p99_us"] = base["lanes"]["fanout_p99_us"] * 0.5
+    assert benchgate.compare(base, cur) == []
+    cur["lanes"]["fanout_p99_us"] = base["lanes"]["fanout_p99_us"] * 1.4
+    assert benchgate.compare(base, cur) == []  # inside the 50% band
+    cur["lanes"]["fanout_p99_us"] = base["lanes"]["fanout_p99_us"] * 1.7
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["regression"]
+    assert "upward" in findings[0].message
+
+
+def test_ceiling_lane_baseline_takes_max():
+    """make_baseline composes latency ceilings from the MAXIMUM over
+    clean rounds (the worst credible case), not the minimum."""
+    a1 = benchgate.make_artifact(_bench_result(), round_n=1)
+    a2 = copy.deepcopy(a1)
+    a1["lanes"]["fanout_p99_us"] = 1000.0
+    a2["lanes"]["fanout_p99_us"] = 3000.0
+    a1["lanes"]["fanout_qps"] = 5000.0
+    a2["lanes"]["fanout_qps"] = 4000.0
+    base = benchgate.make_baseline([a1, a2], round_n=8)
+    assert base["lanes"]["fanout_p99_us"] == 3000.0  # ceiling: max
+    assert base["lanes"]["fanout_qps"] == 4000.0     # floor: min
 
 
 def test_schema_drift_fails(pair):

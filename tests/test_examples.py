@@ -34,6 +34,7 @@ EXAMPLES = [
     ("examples/native_protocol_clients.py", []),
     ("examples/usercode_workers.py", []),
     ("examples/rtmp_relay.py", []),
+    ("examples/fanout_swarm.py", ["--backends", "6", "--seconds", "2"]),
 ]
 
 

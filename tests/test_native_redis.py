@@ -177,9 +177,21 @@ def test_big_bulk_value_trickle(store_redis_server):
         for i in range(0, len(cmd), 256 << 10):
             sk.sendall(cmd[i:i + (256 << 10)])
         sk.settimeout(5)
-        assert sk.recv(64) == b"+OK\r\n"
+
+        def recv_line():
+            # read to CRLF: one recv() returning a whole reply is not a
+            # TCP guarantee (and the chaos lane's write:short seeds
+            # split replies on purpose)
+            buf = b""
+            while not buf.endswith(b"\r\n"):
+                chunk = sk.recv(64)
+                assert chunk, f"peer closed mid-reply: {buf!r}"
+                buf += chunk
+            return buf
+
+        assert recv_line() == b"+OK\r\n"
         sk.sendall(_cmd_bytes("STRLEN", "big"))
-        assert sk.recv(64) == b":%d\r\n" % len(val)
+        assert recv_line() == b":%d\r\n" % len(val)
     finally:
         sk.close()
 

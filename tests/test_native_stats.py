@@ -148,6 +148,14 @@ def test_every_counter_enum_in_prometheus_exposition(server):
                  "nat_dump_oversize", "nat_dump_rotations",
                  "nat_replay_calls", "nat_replay_errors"):
         assert name in exposed, name
+    # the fan-out cluster counters specifically (the ISSUE 13 satellite:
+    # every LB/fan-out/naming-feed counter rides the exposition)
+    for name in ("nat_lb_selects", "nat_fanout_calls",
+                 "nat_fanout_subcalls", "nat_fanout_subcall_errors",
+                 "nat_fanout_fails", "nat_cluster_updates",
+                 "nat_cluster_backends_added",
+                 "nat_cluster_backends_removed"):
+        assert name in exposed, name
 
 
 def test_observatory_vars_in_prometheus_exposition(server):
@@ -161,19 +169,38 @@ def test_observatory_vars_in_prometheus_exposition(server):
 
     srv, port = server
     native.mu_contend_selftest(4, 50, 20)  # ensure a contention row
-    status, body = _get(port, "/brpc_metrics")
-    assert status == 200
-    for vname in ("nat_method_count", "nat_method_errors",
-                  "nat_method_qps", "nat_method_concurrency",
-                  "nat_method_max_concurrency",
-                  "nat_method_latency_p99_us",
-                  "nat_connection_in_bytes", "nat_connection_out_bytes",
-                  "nat_connection_unwritten_bytes",
-                  "nat_lock_contention_waits",
-                  "nat_lock_contention_wait_us"):
-        labeled = [ln for ln in body.splitlines()
-                   if ln.startswith(vname + "{")]
-        assert labeled, f"{vname} has no labeled rows in /brpc_metrics"
+    # a live native cluster (ISSUE 13): its per-backend rows must ride
+    # the same exposition under the nat_cluster_backend_* names
+    from brpc_tpu.rpc.native_cluster import NativeCluster
+
+    cluster = NativeCluster(lb="rr", name="driftcluster")
+    try:
+        cluster.update([f"127.0.0.1:{port}"])
+        cluster.call("EchoService.Echo", b"drift", timeout_ms=2000)
+        status, body = _get(port, "/brpc_metrics")
+        assert status == 200
+        for vname in ("nat_method_count", "nat_method_errors",
+                      "nat_method_qps", "nat_method_concurrency",
+                      "nat_method_max_concurrency",
+                      "nat_method_latency_p99_us",
+                      "nat_connection_in_bytes",
+                      "nat_connection_out_bytes",
+                      "nat_connection_unwritten_bytes",
+                      "nat_lock_contention_waits",
+                      "nat_lock_contention_wait_us",
+                      "nat_cluster_backend_selects",
+                      "nat_cluster_backend_errors",
+                      "nat_cluster_backend_inflight",
+                      "nat_cluster_backend_breaker_open",
+                      "nat_cluster_backend_lame_duck",
+                      "nat_cluster_backend_ema_latency_us"):
+            labeled = [ln for ln in body.splitlines()
+                       if ln.startswith(vname + "{")]
+            assert labeled, f"{vname} has no labeled rows in /brpc_metrics"
+        assert ('nat_cluster_backend_selects{cluster="driftcluster",'
+                f'backend="127.0.0.1:{port}"}}') in body
+    finally:
+        cluster.close()
     # (concrete live-traffic row values are asserted in
     # tests/test_native_observatory.py::test_prometheus_method_labels)
     # no label value may contain an UNESCAPED quote: every labeled row

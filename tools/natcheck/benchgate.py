@@ -94,6 +94,23 @@ HEADLINE_LANES: Dict[str, float] = {
     # over run (extra.scaling.host_parallel_x records it), so the band
     # is wide; the absolute sublinear check below is the hard floor.
     "cpus2_scaling_x": 0.35,
+    # native fan-out lanes (ISSUE 13): the parallel verb to 32 / 1000
+    # backends and the swarm churn drill's selective flood. Each lane
+    # reports 0 qps when ANY RPC failed (the zero-failed contract), so
+    # a failing drill trips the band like a throughput collapse. The
+    # Python-comparison lane bounces with GIL scheduling (wide band).
+    "fanout_qps": 0.30,
+    "fanout1000_qps": 0.50,
+    "swarm_qps": 0.30,
+    "fanout_py_qps": 0.50,
+}
+
+# Latency CEILING lanes: these regress UPWARD — the gate fails when the
+# current value exceeds baseline * (1 + band). Composed from the same
+# artifacts; extract_lanes carries them beside the throughput lanes.
+CEILING_LANES: Dict[str, float] = {
+    "fanout_p99_us": 0.50,
+    "swarm_p99_us": 0.50,
 }
 
 # Hard sublinear-scaling floor: when the host probe shows real parallel
@@ -113,7 +130,7 @@ def extract_lanes(bench: dict) -> Dict[str, float]:
     lanes: Dict[str, float] = {}
     extra = bench.get("extra", {}) or {}
     device = extra.get("device_lanes", {}) or {}
-    for key in HEADLINE_LANES:
+    for key in list(HEADLINE_LANES) + list(CEILING_LANES):
         if key == "value":
             v = bench.get("value")
         elif key == "cpus2_scaling_x":
@@ -165,6 +182,12 @@ def make_baseline(artifacts: List[dict], round_n: int) -> dict:
                 # scaling ratios record the best ACHIEVED ratio (a
                 # crushed shared-host round would otherwise bake an
                 # unachievably-low scaling bar into the baseline)
+                if lane not in floor or float(v) > floor[lane]:
+                    floor[lane] = float(v)
+            elif lane in CEILING_LANES:
+                # latency ceilings take the MAXIMUM over clean rounds —
+                # the credible worst case plays the floor's role for a
+                # lane that regresses upward
                 if lane not in floor or float(v) > floor[lane]:
                     floor[lane] = float(v)
             elif lane not in floor or float(v) < floor[lane]:
@@ -284,6 +307,25 @@ def compare(baseline: dict, current: dict) -> List[Finding]:
                 "bench", "regression", where,
                 f"lane {lane!r} regressed {drop:.1f}%: {base_v:.1f} -> "
                 f"{cur_v:.1f} (tolerance band {tol * 100:.0f}%)"
+                + _contention_excerpt(current) + _profile_excerpt(current)))
+    # latency ceiling lanes regress UPWARD: current above the committed
+    # worst case + band is a tail regression even when qps held
+    for lane, tol in CEILING_LANES.items():
+        if lane not in base_lanes:
+            continue
+        base_v = float(base_lanes[lane])
+        if base_v <= 0 or lane not in cur_lanes:
+            continue  # unmeasured either side (a failing drill already
+            # trips through its 0-qps twin lane)
+        cur_v = float(cur_lanes[lane])
+        ceiling = base_v * (1.0 + tol)
+        if cur_v > ceiling:
+            rise = 100.0 * (cur_v / base_v - 1.0)
+            findings.append(Finding(
+                "bench", "regression", where,
+                f"latency lane {lane!r} regressed {rise:.1f}% upward: "
+                f"{base_v:.1f} -> {cur_v:.1f} us (ceiling band "
+                f"{tol * 100:.0f}%)"
                 + _contention_excerpt(current) + _profile_excerpt(current)))
     # absolute sublinear-scaling floor (independent of any baseline):
     # the host probe proved parallel headroom, the runtime didn't use it

@@ -72,6 +72,7 @@ PYTEST_MATRIX = [
     "tests/test_shm_workers.py", "tests/test_shm_desc_ring.py",
     "tests/test_shm_worker_crash.py",
     "tests/test_native_fault.py", "tests/test_native_overload.py",
+    "tests/test_native_cluster.py",
 ]
 
 
@@ -163,6 +164,39 @@ def _churn_leg() -> Tuple[List[Finding], str]:
     return findings, out
 
 
+def _swarm_leg() -> Tuple[List[Finding], str]:
+    """Swarm round (ISSUE 13): the multi-port fan-out churn drill
+    (tests/test_native_cluster.py's slow acceptance) with DESTRUCTIVE
+    seeds armed in every swarm SERVER process — random EPIPE on socket
+    writes plus the worker-kill seed — while the cluster client stays
+    clean. The assertion is the fan-out contract itself: zero failed
+    RPCs through rolling SIGTERM restarts + live naming updates."""
+    findings: List[Finding] = []
+    env = dict(os.environ)
+    env.pop("NAT_FAULT", None)  # the CLIENT side stays clean; servers
+    env["BRPC_TPU_CHURN_FAULT"] = CHURN_SPEC  # armed via the bench hook
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             "tests/test_native_cluster.py", "-q",
+             "-k", "swarm_churn or membership_updates",
+             "-p", "no:cacheprovider"],
+            capture_output=True, timeout=900, env=env, cwd=REPO_ROOT)
+    except subprocess.TimeoutExpired:
+        return [Finding("chaos", "swarm-hang", "tests/",
+                        "swarm round timed out (fan-out wedged?)")], \
+            "chaos swarm: TIMED OUT"
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    if proc.returncode != 0:
+        tail = out.strip().splitlines()[-1] if out.strip() else "?"
+        findings.append(Finding(
+            "chaos", "swarm", "tests/test_native_cluster.py",
+            f"swarm round rc={proc.returncode}: {tail}"))
+    return findings, out
+
+
 def run(write_log: bool = True) -> List[Finding]:
     findings: List[Finding] = []
     sections = []
@@ -176,6 +210,10 @@ def run(write_log: bool = True) -> List[Finding]:
     got, out = _churn_leg()
     findings.extend(got)
     sections.append(("churn round (rolling restart under %s)" %
+                     CHURN_SPEC, out))
+    got, out = _swarm_leg()
+    findings.extend(got)
+    sections.append(("swarm round (fan-out churn under %s)" %
                      CHURN_SPEC, out))
 
     if write_log:
