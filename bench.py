@@ -83,7 +83,15 @@ def main():
                     help="record a per-core scaling curve at {1..N} cpus "
                          "(taskset-pinned two-process echo lane) into "
                          "extra.scaling")
+    ap.add_argument("--conn-scale", type=int, default=None, metavar="N",
+                    help="override the connection-scale drill's target "
+                         "connection count (default 20000, clamped to "
+                         "RLIMIT_NOFILE; 0 disables the lane)")
     args = ap.parse_args()
+    if args.conn_scale is not None:
+        import os
+
+        os.environ["BRPC_TPU_CONN_SCALE"] = str(args.conn_scale)
     try:
         result = bench_echo()
     except (ImportError, ModuleNotFoundError):

@@ -634,6 +634,16 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # connection-scale drill (ISSUE 14, ROADMAP item 5): 20k mostly-idle
+    # keep-alive connections from client subprocesses, per-connection
+    # bytes/fd/wakeup cost from the nat_res accounting, accept-storm
+    # recovery, zero failed RPCs on the live subset
+    conn_lanes = {}
+    try:
+        conn_lanes = conn_scale_bench()
+    except Exception:
+        pass
+
     # py-usercode across worker processes (VERDICT r4 #2, shm lane)
     worker_lanes = {}
     try:
@@ -765,6 +775,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             **replay_lanes,
             **fanout_lanes,
             **swarm_lanes,
+            **conn_lanes,
             **worker_lanes,
             **stream_lanes,
             **model_rows,
@@ -875,6 +886,239 @@ def fanout_lane_bench(seconds: float = 1.5, backends: int = 32) -> dict:
             out["fanout_native_vs_py_x"] = round(
                 out["fanout_qps"] / py_qps, 2)
     finally:
+        native.rpc_server_stop()
+    return out
+
+
+def conn_scale_bench(target_conns: int = 20000, client_procs: int = 4,
+                     idle_s: float = 2.0) -> dict:
+    """The connection-scale drill (ISSUE 14, ROADMAP item 5's last
+    half): hold `target_conns` mostly-idle keep-alive tpu_std
+    connections from client SUBPROCESSES against one in-process native
+    server and measure what a connection COSTS from the nat_res
+    accounting — bytes (accounted live delta / connection), fds, and
+    idle wakeups/s — plus the accept-storm recovery time (spawn ->
+    every connection accepted and answered) with a live RPC subset
+    flooding throughout (zero failed calls is part of the contract:
+    any failure, an unfinished storm, or a post-teardown leak in the
+    transient subsystems reports conn_scale_conns 0 so the bench gate
+    trips).
+
+    The target is clamped to RLIMIT_NOFILE minus headroom (the server
+    process holds one fd per connection); conn_scale_target records the
+    CLAMPED target the drill actually ran (conn_scale_requested keeps
+    the pre-clamp ask, so a fd-limited host is distinguishable from a
+    failing drill). BRPC_TPU_CONN_SCALE overrides the target
+    (0 disables the lane)."""
+    import ctypes
+    import os
+    import resource
+    import subprocess
+    import sys
+    import threading
+
+    from brpc_tpu import native
+
+    env_target = os.environ.get("BRPC_TPU_CONN_SCALE")
+    if env_target is not None:
+        try:
+            target_conns = int(env_target)
+        except ValueError:
+            pass
+        if target_conns <= 0:
+            return {}
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    try:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+        soft = hard
+    except (ValueError, OSError):
+        pass
+    conns = max(100, min(target_conns, soft - 1000))
+    per_proc = max(1, conns // client_procs)
+    conns = per_proc * client_procs
+
+    lib = native.load()
+    port = native.rpc_server_start(native_echo=True)
+    out = {"conn_scale_target": conns,
+           "conn_scale_requested": target_conns}
+    procs = []
+    live_stop = threading.Event()
+    live_ok = [0]
+    live_fail = [0]
+
+    def _live_loop():
+        # the live subset: continuous echo RPCs through the accept storm
+        # and the idle window — the "zero failed RPCs on the live
+        # subset" half of the acceptance contract
+        h = lib.nat_channel_open(b"127.0.0.1", port, 0, 0, 0, 0)
+        if not h:
+            live_fail[0] += 1
+            return
+        resp = ctypes.c_char_p()
+        rlen = ctypes.c_size_t(0)
+        err = ctypes.c_char_p()
+        while not live_stop.is_set():
+            rc = lib.nat_channel_call(h, b"EchoService", b"Echo",
+                                      b"live", 4, 3000,
+                                      ctypes.byref(resp),
+                                      ctypes.byref(rlen),
+                                      ctypes.byref(err))
+            if rc == 0 and rlen.value == 4:
+                live_ok[0] += 1
+            else:
+                live_fail[0] += 1
+            if resp:
+                lib.nat_buf_free(resp)
+                resp = ctypes.c_char_p()
+            if err:
+                lib.nat_buf_free(err)
+                err = ctypes.c_char_p()
+        lib.nat_channel_close(h)
+
+    client_src = (
+        "import socket, struct, sys, time\n"
+        "port, n = int(sys.argv[1]), int(sys.argv[2])\n"
+        "from brpc_tpu.rpc.proto import rpc_meta_pb2\n"
+        "meta = rpc_meta_pb2.RpcMeta()\n"
+        "meta.request.service_name = 'EchoService'\n"
+        "meta.request.method_name = 'Echo'\n"
+        "meta.correlation_id = 7\n"
+        "mb = meta.SerializeToString()\n"
+        "frame = (b'TRPC' + struct.pack('>II', len(mb) + 1, len(mb))\n"
+        "         + mb + b'k')\n"
+        "socks, failed = [], 0\n"
+        "for i in range(n):\n"
+        "    try:\n"
+        "        s = socket.create_connection(('127.0.0.1', port),\n"
+        "                                     timeout=20)\n"
+        "        s.sendall(frame)\n"
+        "        socks.append(s)\n"
+        "    except OSError:\n"
+        "        failed += 1\n"
+        "# one echo answered per connection proves each was accepted\n"
+        "# AND served through the storm (not just SYN-queued)\n"
+        "answered = 0\n"
+        "for s in socks:\n"
+        "    try:\n"
+        "        s.settimeout(30)\n"
+        "        buf = b''\n"
+        "        while len(buf) < 12:\n"
+        "            got = s.recv(4096)\n"
+        "            if not got:\n"
+        "                raise OSError('eof')\n"
+        "            buf += got\n"
+        "        body, _ = struct.unpack('>II', buf[4:12])\n"
+        "        while len(buf) < 12 + body:\n"
+        "            got = s.recv(65536)\n"
+        "            if not got:\n"
+        "                raise OSError('eof')\n"
+        "            buf += got\n"
+        "        answered += 1\n"
+        "    except OSError:\n"
+        "        failed += 1\n"
+        "print('READY %d %d' % (answered, failed), flush=True)\n"
+        "sys.stdin.readline()  # parent closes stdin -> teardown\n"
+        "for s in socks:\n"
+        "    try:\n"
+        "        s.close()\n"
+        "    except OSError:\n"
+        "        pass\n"
+        "print('CLOSED', flush=True)\n")
+
+    try:
+        time.sleep(0.3)
+        fd0 = len(os.listdir("/proc/self/fd"))
+        res0 = {r["subsystem"]: r for r in native.res_stats()}
+        live_thread = threading.Thread(target=_live_loop, daemon=True)
+        live_thread.start()
+        t_storm = time.perf_counter()
+        for _ in range(client_procs):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", client_src, str(port),
+                 str(per_proc)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))))
+        answered = failed = 0
+        for p in procs:
+            line = p.stdout.readline().decode().split()
+            if len(line) == 3 and line[0] == "READY":
+                answered += int(line[1])
+                failed += int(line[2])
+            else:
+                failed += per_proc
+        accept_storm_s = time.perf_counter() - t_storm
+        # the live subset proved the storm; stop it BEFORE the idle
+        # window so the wakeup figure measures what HOLDING the
+        # connections costs, not the flood
+        live_stop.set()
+        live_thread.join(timeout=10)
+        time.sleep(0.5)  # settle: in-flight drains, pools quiesce
+        wake0 = native.stats_counters().get("nat_dispatcher_wakeups", 0)
+        time.sleep(idle_s)
+        wake1 = native.stats_counters().get("nat_dispatcher_wakeups", 0)
+        fd1 = len(os.listdir("/proc/self/fd"))
+        res1 = {r["subsystem"]: r for r in native.res_stats()}
+        held = int(lib.nat_rpc_server_connections())
+        out.update({
+            "conn_scale_answered": answered,
+            "conn_scale_failed": failed,
+            "conn_held": held,
+            "conn_accept_storm_s": round(accept_storm_s, 2),
+            # positive subsystem deltas only: in a full bench run the
+            # PRECEDING lanes' pools may still be draining through the
+            # drill, and a negative total would poison the ceiling
+            # band's baseline (the attribution dict below keeps the
+            # signed per-subsystem truth)
+            "conn_per_conn_bytes": round(
+                sum(max(0, res1[s]["live_bytes"] - res0[s]["live_bytes"])
+                    for s in res1) / max(1, answered), 1),
+            "conn_per_conn_fds": round((fd1 - fd0) / max(1, answered), 3),
+            "conn_idle_wakeups_per_s": round(
+                max(0, wake1 - wake0) / idle_s, 1),
+            "conn_live_ok": live_ok[0],
+            "conn_live_failed": live_fail[0],
+            # where the bytes sit: per-subsystem live deltas over the
+            # drill (the accounting's attribution, not a guess)
+            "conn_mem_by_subsystem": {
+                sub: int(res1[sub]["live_bytes"]
+                         - res0[sub]["live_bytes"])
+                for sub in res1
+                if res1[sub]["live_bytes"] != res0[sub]["live_bytes"]},
+        })
+        # teardown + churn balance: close every client and wait for the
+        # transient subsystems to return (socket slots recycle to the
+        # freelist but their slabs stay live BY DESIGN — ResourcePool)
+        for p in procs:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+        for p in procs:
+            p.wait(timeout=60)
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                int(lib.nat_rpc_server_connections()) > 4:
+            time.sleep(0.1)
+        time.sleep(0.5)
+        res2 = {r["subsystem"]: r for r in native.res_stats()}
+        leaks = {}
+        for sub in ("srv.pyreq", "dump.spill"):
+            d = res2[sub]["live_objects"] - res0[sub]["live_objects"]
+            if d > max(8, answered * 0.01):
+                leaks[sub] = int(d)
+        out["conn_balance_leaked"] = leaks
+        ok = (failed == 0 and answered == conns and live_fail[0] == 0
+              and live_ok[0] > 0 and not leaks)
+        out["conn_scale_conns"] = answered if ok else 0
+    except Exception as e:  # a wedged drill must not kill the artifact
+        out["conn_scale_error"] = repr(e)
+        out["conn_scale_conns"] = 0
+        live_stop.set()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
         native.rpc_server_stop()
     return out
 

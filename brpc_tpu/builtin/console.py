@@ -172,12 +172,14 @@ def _connections_handler(server, req):
         lines.append(
             "remote_side          |socket_id          |proto   |side  |"
             "disp|in_bytes(/s)        |out_bytes(/s)       |in_msg  |"
-            "out_msg |rd_sys  |wr_sys  |unwritten")
+            "out_msg |rd_sys  |wr_sys  |unwritten |mem_bytes")
         prune_connection_windows(r["sock_id"] for r in rows)
+        total_mem = 0
         for r in sorted(rows, key=lambda r: r["sock_id"]):
             rates = connection_rates(r["sock_id"])
             in_cell = f"{r['in_bytes']}({rates['in_Bps']:,.0f}/s)"
             out_cell = f"{r['out_bytes']}({rates['out_Bps']:,.0f}/s)"
+            total_mem += r.get("mem_bytes", 0)
             lines.append(
                 f"{r['remote'] or '?':21s}|{r['sock_id']:<19d}|"
                 f"{r['protocol']:8s}|"
@@ -186,7 +188,12 @@ def _connections_handler(server, req):
                 f"{in_cell:<20s}|{out_cell:<20s}|"
                 f"{r['in_msgs']:<8d}|{r['out_msgs']:<8d}|"
                 f"{r['read_calls']:<8d}|{r['write_calls']:<8d}|"
-                f"{r['unwritten_bytes']}")
+                f"{r['unwritten_bytes']:<10d}|{r.get('mem_bytes', 0)}")
+        # where the bytes sit at scale: per-socket buffered memory
+        # (write stack + read buffer + reorder windows) summed, so the
+        # 20k-connection page answers "what does a connection cost"
+        lines.append(f"native socket buffered memory: {total_mem} bytes "
+                     f"across {len(rows)} sockets")
     return 200, "text/plain", "\n".join(lines) + "\n"
 
 
@@ -351,6 +358,46 @@ def _rpc_dump_handler(server, req):
     return _rpc_dump_window.run(window_s, _capture_window)
 
 
+def _heap_handler(server, req):
+    """/heap: live allocations by site — Python lane via tracemalloc;
+    /heap/native reports the NATIVE allocators (iobuf block pool, socket
+    slabs, fiber stacks, shm arenas...) from the nat_res ledger's
+    sampled allocation-site profiler, which tracemalloc cannot see
+    (ISSUE 14 — the reference's tcmalloc-backed /heap builtin)."""
+    from brpc_tpu.builtin import hotspots, profilers
+
+    parts = [p for p in req.path.split("/") if p]
+    if len(parts) > 1 and parts[1] == "native":
+        try:
+            seconds = float(req.query.get("seconds", "0") or 0)
+        except ValueError:
+            return 400, "text/plain", "seconds must be a number\n"
+        flat = req.query.get("flat", "") not in ("", "0")
+        return hotspots._res_prof_window.run(
+            max(0.1, seconds),
+            lambda s: hotspots.heap_native(seconds, flat=flat))
+    return 200, "text/plain", profilers.heap_profile()
+
+
+def _growth_handler(server, req):
+    """/growth: allocation growth since profiling start — Python lane
+    via tracemalloc; /growth/native diffs native live-bytes-by-site
+    against the baseline (?seconds=N re-baselines and reports exactly
+    that window's growth)."""
+    from brpc_tpu.builtin import hotspots, profilers
+
+    parts = [p for p in req.path.split("/") if p]
+    if len(parts) > 1 and parts[1] == "native":
+        try:
+            seconds = float(req.query.get("seconds", "0") or 0)
+        except ValueError:
+            return 400, "text/plain", "seconds must be a number\n"
+        return hotspots._res_prof_window.run(
+            max(0.1, seconds),
+            lambda s: hotspots.growth_native(seconds))
+    return 200, "text/plain", profilers.growth_profile()
+
+
 def _rpcz_handler(server, req):
     """/rpcz: recent spans (builtin/rpcz_service.cpp); filled by the rpcz
     module once tracing is enabled."""
@@ -485,6 +532,8 @@ def attach_console(server):
         "protobufs": _protobufs_handler,
         "bthreads": _bthreads_handler,
         "sockets": _sockets_handler,
+        "heap": _heap_handler,
+        "growth": _growth_handler,
         "rpc_dump": _rpc_dump_handler,
         "rpcz": _rpcz_handler,
         "list": _list_handler,

@@ -103,6 +103,69 @@ _native_prof_window = _ProfWindow(
 _contention_prof_window = _ProfWindow(
     10.0, "nat_mu_prof busy: another /hotspots/contention window is "
           "running\n")
+# /heap/native and /growth/native share ONE window: both drain the same
+# allocation-event rings and the growth baseline is shared state — a
+# concurrent pair would race baseline/report (the /hotspots/* 503 +
+# Retry-After discipline)
+_res_prof_window = _ProfWindow(
+    30.0, "nat_res busy: another /heap/native or /growth/native window "
+          "is running\n")
+
+
+def _res_ensure_armed():
+    """Arm the native allocation-site tracker on first use (the
+    tracemalloc ensure-on-first-profile discipline). Returns (native
+    module or None, fresh: True when tracking JUST started)."""
+    try:
+        from brpc_tpu import native
+
+        if not native.available():
+            return None, False
+    except Exception:
+        return None, False
+    if native.res_prof_running():
+        return native, False
+    # rc == -1: an embedder owns the profiler — report without stealing
+    return native, native.res_prof_start(1, 42) == 0
+
+
+def heap_native(seconds: float = 0.0, flat: bool = False) -> str:
+    """/heap/native body: live bytes by native allocation site from the
+    nat_res ledger's sampled profiler (the tcmalloc /heap role for the
+    runtime's OWN allocators, which tracemalloc cannot see). ?seconds=N
+    lets the armed tracker observe N seconds of churn before reporting.
+    Caller must hold _res_prof_window."""
+    native, fresh = _res_ensure_armed()
+    if native is None:
+        return "native runtime unavailable\n"
+    if seconds > 0:
+        time.sleep(min(seconds, 30.0))
+    report = native.res_heap_report(collapsed=not flat)
+    if fresh:
+        report = ("# note: allocation-site tracking just started; pool "
+                  "memory allocated earlier is in the nat_mem_* ledger "
+                  "but not attributed to a site — rerun for steady "
+                  "state\n") + report
+    return report
+
+
+def growth_native(seconds: float = 0.0) -> str:
+    """/growth/native body: live-bytes-by-site growth since the
+    baseline (taken at arming). ?seconds=N re-takes the baseline NOW
+    and reports the growth of exactly that window — the leak-trend
+    question ("what grew while I watched") answered directly. Caller
+    must hold _res_prof_window."""
+    native, fresh = _res_ensure_armed()
+    if native is None:
+        return "native runtime unavailable\n"
+    if seconds > 0:
+        native.res_growth_baseline()
+        time.sleep(min(seconds, 30.0))
+    report = native.res_growth_report()
+    if fresh:
+        report = ("# note: tracking just started; baseline taken now — "
+                  "rerun (or pass ?seconds=N) to see growth\n") + report
+    return report
 
 
 def sample_native(seconds: float = 1.0, hz: int = 99,

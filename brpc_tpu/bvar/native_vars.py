@@ -86,6 +86,7 @@ _PCTS = (("p50", 0.50), ("p99", 0.99), ("p999", 0.999))
 
 _method_cache = _TtlCache("method_stats")
 _conn_cache = _TtlCache("conn_snapshot")
+_res_cache = _TtlCache("res_stats")
 
 
 def _method_snapshot():
@@ -94,6 +95,15 @@ def _method_snapshot():
 
 def _conn_snapshot():
     return _conn_cache.get()
+
+
+def _res_snapshot():
+    return _res_cache.get()
+
+
+def _res_dim(field: str):
+    return {(("subsystem", r["subsystem"]),): r[field]
+            for r in _res_snapshot()}
 
 
 def _method_labels(row):
@@ -339,6 +349,17 @@ def register_native_bvars() -> bool:
             ("nat_connection_out_bytes", lambda: _conn_dim("out_bytes")),
             ("nat_connection_unwritten_bytes",
              lambda: _conn_dim("unwritten_bytes")),
+            ("nat_connection_mem_bytes",
+             lambda: _conn_dim("mem_bytes")),
+            # native memory observatory (ISSUE 14): the per-resource
+            # bvar surface — one row per allocator subsystem from the
+            # always-on nat_res ledger
+            ("nat_mem_live_bytes", lambda: _res_dim("live_bytes")),
+            ("nat_mem_live_objects",
+             lambda: _res_dim("live_objects")),
+            ("nat_mem_cum_allocs", lambda: _res_dim("cum_allocs")),
+            ("nat_mem_cum_frees", lambda: _res_dim("cum_frees")),
+            ("nat_mem_hwm_bytes", lambda: _res_dim("hwm_bytes")),
             ("nat_lock_contention_waits", lambda: _lock_dim("waits")),
             ("nat_lock_contention_wait_us",
              lambda: _lock_dim("wait_us")),
@@ -408,6 +429,54 @@ def _stats_quantile_us(lane: int, q: float) -> float:
     return native.stats_quantile(lane, q) / 1e3
 
 
+# ---------------------------------------------------------------------------
+# RSS reconciliation (ISSUE 14): /status attributes the accounted share
+# of the process's resident growth since the native runtime loaded —
+# "do the ledger's bytes explain the RSS the .so added?"
+# ---------------------------------------------------------------------------
+
+def _rss_bytes() -> int:
+    # the ONE statm reader lives beside the load-time baseline capture
+    # (brpc_tpu.native._read_rss) so both ends of the reconciliation
+    # parse resident bytes identically
+    from brpc_tpu import native
+
+    return native._read_rss()
+
+
+# fixed BSS sample pools (NAT_RES_STATIC registrations): virtual until a
+# sample touches their pages, so the RSS share is computed over the
+# HEAP-BACKED subsystems only (the fixed pools still show in the rows)
+_FIXED_POOL_SUBSYSTEMS = ("prof.cells",)
+
+
+def rss_reconciliation_line() -> str:
+    """The /status nat_mem line: accounted native bytes, current RSS,
+    the RSS delta since just before the .so loaded (the native
+    runtime's own memory footprint), and the heap-accounted share of
+    that delta. Fixed BSS pools are excluded from the share — they are
+    attributed in the rows but only fault in page by page."""
+    from brpc_tpu import native
+
+    accounted = native.res_accounted_bytes()
+    rows = _res_snapshot()
+    fixed = sum(r["live_bytes"] for r in rows
+                if r["subsystem"] in _FIXED_POOL_SUBSYSTEMS)
+    heap_acct = accounted - fixed
+    rss = _rss_bytes()
+    base = native.rss_at_load() if hasattr(native, "rss_at_load") else 0
+    delta = rss - base if base else 0
+    share = f" ({100.0 * heap_acct / delta:.0f}% of rss_delta)" \
+        if delta > 0 else ""
+    top = sorted(rows, key=lambda r: -r["live_bytes"])[:3]
+    top_s = " ".join(f"{r['subsystem']}={r['live_bytes']}"
+                     for r in top if r["live_bytes"])
+    return (f"  nat_mem: accounted={accounted} bytes "
+            f"(heap={heap_acct} fixed_pools={fixed}){share} "
+            f"rss={rss} rss_delta_since_native_load={delta}"
+            + (f"  top: {top_s}" if top_s else ""))
+
+
 # the PR-5 robustness counters, summarized on /status as one line the
 # moment any of them moves (a fault injection round, an overload shed or
 # a breaker trip should be visible at a glance, not only in /vars)
@@ -433,6 +502,19 @@ def native_status_lines(snap: Optional[Dict[str, int]] = None) -> List[str]:
     if not any(snap.values()):
         return []
     lines = ["", "native runtime:"]
+    # memory observatory reconciliation (ISSUE 14): the ledger's
+    # accounted bytes vs the process's resident delta since native load
+    try:
+        lines.append(rss_reconciliation_line())
+        mem_rows = [r for r in _res_snapshot() if r["live_bytes"]]
+        if mem_rows:
+            lines.append("  nat_mem subsystems: " + " ".join(
+                f"{r['subsystem']}={r['live_bytes']}/"
+                f"{r['live_objects']}obj(hwm={r['hwm_bytes']})"
+                for r in sorted(mem_rows,
+                                key=lambda r: -r["live_bytes"])))
+    except Exception:
+        pass
     lines.append(
         f"  read_bytes: {snap.get('nat_socket_read_bytes', 0)}  "
         f"write_bytes: {snap.get('nat_socket_write_bytes', 0)}  "
@@ -541,6 +623,7 @@ def reset_for_tests():
         _snap_cache.clear()
         _method_cache.clear()
         _conn_cache.clear()
+        _res_cache.clear()
     try:
         from brpc_tpu import native
 

@@ -27,6 +27,24 @@ _SO_PATH = os.environ.get(
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
+# /proc/self/statm resident bytes just before the .so first loaded: the
+# zero point the /status nat_mem RSS reconciliation diffs against
+# (brpc_tpu.bvar.native_vars.rss_reconciliation_line).
+_rss_at_load: Optional[int] = None
+
+
+def _read_rss() -> int:
+    try:
+        with open("/proc/self/statm", "r") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def rss_at_load() -> int:
+    """Resident bytes captured immediately before the native library
+    loaded (0 when it never loaded)."""
+    return _rss_at_load or 0
 
 
 class NativeUnavailable(RuntimeError):
@@ -80,11 +98,27 @@ class NatConnRow(ctypes.Structure):
         ("read_calls", ctypes.c_uint64),
         ("write_calls", ctypes.c_uint64),
         ("unwritten_bytes", ctypes.c_uint64),
+        ("mem_bytes", ctypes.c_uint64),
         ("fd", ctypes.c_int32),
         ("disp_idx", ctypes.c_int32),
         ("server_side", ctypes.c_int32),
         ("protocol", ctypes.c_char * 12),
         ("remote", ctypes.c_char * 24),
+    ]
+
+
+class NatResRow(ctypes.Structure):
+    """Mirror of nat_res.h NatResRow — one per-subsystem resource-ledger
+    row (live bytes/objects, cumulative allocs/frees, high-water)."""
+
+    _fields_ = [
+        ("live_bytes", ctypes.c_uint64),
+        ("live_objects", ctypes.c_uint64),
+        ("cum_allocs", ctypes.c_uint64),
+        ("cum_frees", ctypes.c_uint64),
+        ("cum_alloc_bytes", ctypes.c_uint64),
+        ("hwm_bytes", ctypes.c_uint64),
+        ("name", ctypes.c_char * 16),
     ]
 
 
@@ -197,6 +231,9 @@ def load() -> ctypes.CDLL:
         elif not _build() and not os.path.exists(_SO_PATH):
             raise NativeUnavailable(
                 "native core not built and toolchain unavailable")
+        global _rss_at_load
+        if _rss_at_load is None:
+            _rss_at_load = _read_rss()
         lib = ctypes.CDLL(_SO_PATH)
         lib.nat_sched_start.argtypes = [ctypes.c_int]
         lib.nat_sched_start.restype = ctypes.c_int
@@ -462,6 +499,31 @@ def load() -> ctypes.CDLL:
         lib.nat_conn_snapshot.argtypes = [ctypes.POINTER(NatConnRow),
                                           ctypes.c_int]
         lib.nat_conn_snapshot.restype = ctypes.c_int
+        # -- native memory observatory (nat_res.cpp, ISSUE 14) --
+        lib.nat_res_count.restype = ctypes.c_int
+        lib.nat_res_name.argtypes = [ctypes.c_int]
+        lib.nat_res_name.restype = ctypes.c_char_p  # static string
+        lib.nat_res_stats.argtypes = [ctypes.POINTER(NatResRow),
+                                      ctypes.c_int]
+        lib.nat_res_stats.restype = ctypes.c_int
+        lib.nat_res_accounted_bytes.restype = ctypes.c_uint64
+        lib.nat_res_prof_start.argtypes = [ctypes.c_int, ctypes.c_uint64]
+        lib.nat_res_prof_start.restype = ctypes.c_int
+        lib.nat_res_prof_stop.restype = ctypes.c_int
+        lib.nat_res_prof_running.restype = ctypes.c_int
+        lib.nat_res_prof_samples.restype = ctypes.c_uint64
+        lib.nat_res_prof_reset.restype = None
+        lib.nat_res_heap_report.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.nat_res_heap_report.restype = ctypes.c_int
+        lib.nat_res_growth_baseline.restype = ctypes.c_int
+        lib.nat_res_growth_report.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.nat_res_growth_report.restype = ctypes.c_int
+        lib.nat_res_selftest.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.nat_res_selftest.restype = ctypes.c_int
         lib.nat_mu_prof_start.argtypes = [ctypes.c_int, ctypes.c_int,
                                           ctypes.c_uint64]
         lib.nat_mu_prof_start.restype = ctypes.c_int
@@ -1362,6 +1424,7 @@ def conn_snapshot() -> list:
             "read_calls": r.read_calls,
             "write_calls": r.write_calls,
             "unwritten_bytes": r.unwritten_bytes,
+            "mem_bytes": r.mem_bytes,
             "fd": r.fd,
             "disp_idx": r.disp_idx,
             "server_side": bool(r.server_side),
@@ -1369,6 +1432,110 @@ def conn_snapshot() -> list:
             "remote": r.remote.decode(errors="replace"),
         })
     return out
+
+
+def res_stats() -> list:
+    """The native memory observatory's per-subsystem ledger: one dict
+    per allocator subsystem (iobuf blocks, socket slabs, WriteReq pools,
+    fiber stacks, shm segments, ...) with live bytes/objects, cumulative
+    allocs/frees and the high-water mark."""
+    lib = load()
+    n = lib.nat_res_count()
+    arr = (NatResRow * n)()
+    got = lib.nat_res_stats(arr, n)
+    out = []
+    for i in range(got):
+        r = arr[i]
+        out.append({
+            "subsystem": r.name.decode(errors="replace"),
+            "live_bytes": r.live_bytes,
+            "live_objects": r.live_objects,
+            "cum_allocs": r.cum_allocs,
+            "cum_frees": r.cum_frees,
+            "cum_alloc_bytes": r.cum_alloc_bytes,
+            "hwm_bytes": r.hwm_bytes,
+        })
+    return out
+
+
+def res_names() -> list:
+    """Subsystem names in enum order (the nat_mem_* label values)."""
+    lib = load()
+    return [lib.nat_res_name(i).decode()
+            for i in range(lib.nat_res_count())]
+
+
+def res_accounted_bytes() -> int:
+    """Total live bytes across every accounted native subsystem — the
+    /status RSS reconciliation's accounted side."""
+    return load().nat_res_accounted_bytes()
+
+
+def res_prof_start(every: int = 1, seed: int = 42) -> int:
+    """Arm allocation-site stack sampling (1-in-`every`, seeded
+    deterministic). 0 = ok, -1 = already running (an embedder owns it —
+    report without stealing, the nat_prof discipline)."""
+    return load().nat_res_prof_start(every, seed)
+
+
+def res_prof_stop() -> int:
+    return load().nat_res_prof_stop()
+
+
+def res_prof_running() -> bool:
+    return bool(load().nat_res_prof_running())
+
+
+def res_prof_samples() -> int:
+    return load().nat_res_prof_samples()
+
+
+def res_prof_reset():
+    """Forget sampled sites/baseline (the always-on ledger is separate
+    and untouched)."""
+    load().nat_res_prof_reset()
+
+
+def res_heap_report(collapsed: bool = True) -> str:
+    """/heap/native body: live bytes by allocation site — collapsed
+    stacks (default, leaf = "res:<subsystem>") or a flat table."""
+    lib = load()
+    out = ctypes.c_char_p()
+    n = ctypes.c_size_t(0)
+    rc = lib.nat_res_heap_report(1 if collapsed else 0, ctypes.byref(out),
+                                 ctypes.byref(n))
+    if rc != 0 or not out:
+        return ""
+    try:
+        return ctypes.string_at(out, n.value).decode(errors="replace")
+    finally:
+        lib.nat_buf_free(out)
+
+
+def res_growth_baseline() -> int:
+    """Re-take the /growth/native zero point."""
+    return load().nat_res_growth_baseline()
+
+
+def res_growth_report() -> str:
+    """/growth/native body: collapsed stacks weighted by live-bytes
+    growth since the baseline."""
+    lib = load()
+    out = ctypes.c_char_p()
+    n = ctypes.c_size_t(0)
+    rc = lib.nat_res_growth_report(ctypes.byref(out), ctypes.byref(n))
+    if rc != 0 or not out:
+        return ""
+    try:
+        return ctypes.string_at(out, n.value).decode(errors="replace")
+    finally:
+        lib.nat_buf_free(out)
+
+
+def res_selftest(nthreads: int = 4, iters: int = 200) -> int:
+    """Deterministic alloc/free churn with concurrent snapshot/report
+    readers; 0 = the ledger balanced exactly."""
+    return load().nat_res_selftest(nthreads, iters)
 
 
 def mu_prof_start(threshold_us: int = 0, every: int = 1,

@@ -32,6 +32,21 @@ struct CentralBlockPool {
 // threads keep recycling blocks through exit()
 static CentralBlockPool& g_block_pool = *new CentralBlockPool();
 
+// The ONLY raw allocation/release seam for 8KB blocks: every block in a
+// TLS cache or the central batch pool is LIVE in the ledger — the
+// conn-scale drill's "where do 20k connections' bytes sit" answer needs
+// parked pool memory attributed, not just in-flight buffers.
+static IOBlock* block_new() {
+  IOBlock* b = new IOBlock();  // ctor ref{1}
+  NAT_RES_ALLOC(NR_IOBUF_BLOCK, sizeof(IOBlock), b);
+  return b;
+}
+
+static void block_delete(IOBlock* b) {
+  NAT_RES_FREE(NR_IOBUF_BLOCK, sizeof(IOBlock), b);
+  delete b;
+}
+
 // Per-thread block cache: blocks freed on this thread are kept for reuse;
 // overflow returns WHOLE BATCHES to the central pool, refill steals them.
 struct TlsBlockCache {
@@ -49,7 +64,7 @@ struct TlsBlockCache {
       NAT_REF_RELEASED(share, iob.share);
       if (share->ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
         NAT_REF_DEAD(share);
-        delete share;
+        block_delete(share);
       }
       share = nullptr;
     }
@@ -71,12 +86,12 @@ struct TlsBlockCache {
       if (head != nullptr) {
         while (head != nullptr) {
           IOBlock* next = head->pool_next;
-          delete head;
+          block_delete(head);
           head = next;
         }
       }
     }
-    for (size_t i = 0; i < n; i++) delete blocks[i];
+    for (size_t i = 0; i < n; i++) block_delete(blocks[i]);
   }
 };
 static thread_local TlsBlockCache tls_cache;
@@ -106,7 +121,7 @@ IOBlock* IOBlock::create() {
     b->ref.store(1, std::memory_order_relaxed);
     b->size = 0;
   } else {
-    b = new IOBlock();  // ctor ref{1}
+    b = block_new();
   }
   // the initial reference: the creating scope releases it or transfers
   // it (to iob.share / the first BlockRef)
@@ -147,7 +162,7 @@ void IOBlock::recycle(IOBlock* b) {
     }
     while (head != nullptr) {  // central pool full: free the batch
       IOBlock* next = head->pool_next;
-      delete head;
+      block_delete(head);
       head = next;
     }
   }
@@ -182,8 +197,9 @@ void IOBuf::make_room() {
   }
   uint32_t ncap = cap_ * 2;
   BlockRef* nrefs = (BlockRef*)::malloc(ncap * sizeof(BlockRef));
+  NAT_RES_ALLOC(NR_IOBUF_REFS, ncap * sizeof(BlockRef), nrefs);
   memcpy(nrefs, refs_ + begin_, count_ * sizeof(BlockRef));
-  if (refs_ != inline_) ::free(refs_);
+  release_refs_array();
   refs_ = nrefs;
   cap_ = ncap;
   begin_ = 0;
@@ -288,7 +304,7 @@ void IOBuf::append(const IOBuf& other) {
 
 void IOBuf::append(IOBuf&& other) {
   if (count_ == 0) {
-    if (refs_ != inline_) ::free(refs_);
+    release_refs_array();
     refs_ = inline_;
     cap_ = kInlineRefs;
     steal(std::move(other));

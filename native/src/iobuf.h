@@ -17,6 +17,7 @@
 #include <sys/uio.h>
 
 #include "nat_refown.h"
+#include "nat_res.h"
 
 namespace brpc_tpu {
 
@@ -73,7 +74,7 @@ class IOBuf {
   IOBuf() = default;
   ~IOBuf() {
     clear();
-    if (refs_ != inline_) ::free(refs_);
+    release_refs_array();
   }
   IOBuf(const IOBuf& other) { append(other); }
   IOBuf& operator=(const IOBuf& other) {
@@ -87,7 +88,7 @@ class IOBuf {
   IOBuf& operator=(IOBuf&& other) noexcept {
     if (this != &other) {
       clear();
-      if (refs_ != inline_) ::free(refs_);
+      release_refs_array();
       refs_ = inline_;
       cap_ = kInlineRefs;
       steal(std::move(other));
@@ -167,6 +168,15 @@ class IOBuf {
 
  private:
   static const uint32_t kInlineRefs = 6;
+
+  // Free a spilled (heap) ref array and retire its ledger bytes — the
+  // one release seam paired with make_room's NAT_RES_ALLOC.
+  void release_refs_array() {
+    if (refs_ != inline_) {
+      NAT_RES_FREE(NR_IOBUF_REFS, cap_ * sizeof(BlockRef), refs_);
+      ::free(refs_);
+    }
+  }
 
   size_t pop_front_slow(size_t n);
   size_t copy_to_slow(void* out, size_t n, size_t pos) const;

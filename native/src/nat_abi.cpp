@@ -21,6 +21,7 @@
 
 #include "nat_api.h"
 #include "nat_dump.h"
+#include "nat_res.h"
 #include "nat_stats.h"
 
 namespace {
@@ -59,6 +60,7 @@ NAT_TY(brpc_tpu::NatLockRankRow, "struct:NatLockRankRow");
 NAT_TY(brpc_tpu::NatDumpStatusRec, "struct:NatDumpStatusRec");
 NAT_TY(brpc_tpu::NatReplayResult, "struct:NatReplayResult");
 NAT_TY(brpc_tpu::NatClusterRow, "struct:NatClusterRow");
+NAT_TY(brpc_tpu::NatResRow, "struct:NatResRow");
 #undef NAT_TY
 
 template <typename T>
@@ -135,6 +137,7 @@ int main() {
   using brpc_tpu::NatLockRankRow;
   using brpc_tpu::NatMethodStatRow;
   using brpc_tpu::NatReplayResult;
+  using brpc_tpu::NatResRow;
   using brpc_tpu::NatSpanRec;
 #define NAT_FIELD(S, F) \
   FieldRow { #F, offsetof(S, F), sizeof(S::F), Ty<decltype(S::F)>::get() }
@@ -175,11 +178,23 @@ int main() {
                    NAT_FIELD(NatConnRow, read_calls),
                    NAT_FIELD(NatConnRow, write_calls),
                    NAT_FIELD(NatConnRow, unwritten_bytes),
+                   NAT_FIELD(NatConnRow, mem_bytes),
                    NAT_FIELD(NatConnRow, fd),
                    NAT_FIELD(NatConnRow, disp_idx),
                    NAT_FIELD(NatConnRow, server_side),
                    NAT_FIELD(NatConnRow, protocol),
                    NAT_FIELD(NatConnRow, remote),
+               },
+               false);
+  print_struct("NatResRow", sizeof(NatResRow),
+               {
+                   NAT_FIELD(NatResRow, live_bytes),
+                   NAT_FIELD(NatResRow, live_objects),
+                   NAT_FIELD(NatResRow, cum_allocs),
+                   NAT_FIELD(NatResRow, cum_frees),
+                   NAT_FIELD(NatResRow, cum_alloc_bytes),
+                   NAT_FIELD(NatResRow, hwm_bytes),
+                   NAT_FIELD(NatResRow, name),
                },
                false);
   print_struct("NatLockRankRow", sizeof(NatLockRankRow),
@@ -379,6 +394,19 @@ int main() {
       NAT_SYM(nat_cluster_partition_call),
       NAT_SYM(nat_cluster_stats),
       NAT_SYM(nat_cluster_bench),
+      NAT_SYM(nat_res_count),
+      NAT_SYM(nat_res_name),
+      NAT_SYM(nat_res_stats),
+      NAT_SYM(nat_res_accounted_bytes),
+      NAT_SYM(nat_res_prof_start),
+      NAT_SYM(nat_res_prof_stop),
+      NAT_SYM(nat_res_prof_running),
+      NAT_SYM(nat_res_prof_samples),
+      NAT_SYM(nat_res_prof_reset),
+      NAT_SYM(nat_res_heap_report),
+      NAT_SYM(nat_res_growth_baseline),
+      NAT_SYM(nat_res_growth_report),
+      NAT_SYM(nat_res_selftest),
       NAT_SYM(nat_prof_start),
       NAT_SYM(nat_prof_stop),
       NAT_SYM(nat_prof_running),

@@ -22,6 +22,7 @@ struct NatLockRankRow;    // per-rank lock-wait totals row (nat_stats.h)
 struct NatDumpStatusRec;  // flight-recorder status snapshot (nat_dump.h)
 struct NatReplayResult;   // replay run result (nat_dump.h)
 struct NatClusterRow;     // per-backend cluster snapshot row (nat_stats.h)
+struct NatResRow;         // per-subsystem resource-accounting row (nat_res.h)
 }
 
 extern "C" {
@@ -382,6 +383,33 @@ int nat_dump_status(brpc_tpu::NatDumpStatusRec* out);
 int nat_replay_run(const char* ip, int port, const char* files, int times,
                    double qps_from, double qps_to, int concurrency,
                    int timeout_ms, brpc_tpu::NatReplayResult* out);
+
+// ---- native memory observatory (nat_res.cpp, ISSUE 14) ----
+// Always-on per-subsystem resource ledger (live bytes/objects,
+// cumulative allocs/frees, high-water mark) recorded at every native
+// allocator seam, plus a sampled allocation-site profiler behind
+// /heap/native and /growth/native.
+int nat_res_count(void);
+const char* nat_res_name(int sub);
+int nat_res_stats(brpc_tpu::NatResRow* out, int max);
+uint64_t nat_res_accounted_bytes(void);
+// Arm 1-in-`every` allocation-site stack sampling (seeded deterministic
+// decimation; frees always recorded while armed). 0 ok, -1 running.
+int nat_res_prof_start(int every, uint64_t seed);
+int nat_res_prof_stop(void);
+int nat_res_prof_running(void);
+uint64_t nat_res_prof_samples(void);
+void nat_res_prof_reset(void);
+// Live bytes by allocation site: mode 0 = flat by leaf symbol, mode 1 =
+// collapsed stacks weighted by live bytes. malloc'd (nat_buf_free).
+int nat_res_heap_report(int mode, char** out, size_t* out_len);
+// Re-take the growth zero point; the next growth report diffs against it.
+int nat_res_growth_baseline(void);
+// Collapsed stacks weighted by live-bytes GROWTH since the baseline.
+int nat_res_growth_report(char** out, size_t* out_len);
+// Deterministic alloc/free churn with a concurrent snapshot/report
+// reader; 0 = the ledger balanced exactly (tests/smokes).
+int nat_res_selftest(int nthreads, int iters);
 
 // ---- in-process sampling profiler (nat_prof.cpp) ----
 // SIGPROF/CPU-time stack sampling with frame-pointer unwind into

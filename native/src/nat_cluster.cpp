@@ -44,6 +44,7 @@ namespace brpc_tpu {
 static NatChannel* channel_create_lazy(const char* ip, int port,
                                        int connect_timeout_ms,
                                        int health_check_ms, bool breaker) {
+  // natcheck:allow(resacct): NatChannel self-accounts in its ctor/dtor
   NatChannel* ch = new NatChannel();
   NAT_REF_ACQUIRED(ch, chan.opener);  // released by nat_channel_close
   ch->peer_ip = ip;
@@ -60,6 +61,7 @@ void NatLbBackend::release() {
   if (ref.fetch_sub(1, std::memory_order_acq_rel) == 1) {
     NAT_REF_DEAD(this);  // refguard: clus.* tags balanced before delete
     if (ch != nullptr) nat_channel_close(ch);
+    NAT_RES_FREE(NR_CLUSTER, sizeof(NatLbBackend), this);
     delete this;
   }
 }
@@ -127,6 +129,7 @@ struct NatCluster {
         }
         delete v;
       }
+      NAT_RES_FREE(NR_CLUSTER, sizeof(NatCluster), this);
       delete this;
     }
   }
@@ -445,6 +448,7 @@ static int fan_merge(FanCtx* ctx, int fail_limit, char** resp_out,
                        first_text != nullptr ? first_text->c_str() : "");
       if (k < 0) k = 0;
       if (k >= (int)sizeof(buf)) k = (int)sizeof(buf) - 1;
+      // natcheck:allow(resacct): FFI error text, freed by the caller
       *err_text_out = (char*)malloc((size_t)k + 1);
       memcpy(*err_text_out, buf, (size_t)k);
       (*err_text_out)[k] = '\0';
@@ -452,6 +456,7 @@ static int fan_merge(FanCtx* ctx, int fail_limit, char** resp_out,
     return kETOOMANYFAILS;
   }
   if (resp_out != nullptr) {
+    // natcheck:allow(resacct): FFI merged response, freed by the caller
     char* out = (char*)malloc(total ? total : 1);
     size_t off = 0;
     for (const FanSub& sub : ctx->subs) {
@@ -499,6 +504,7 @@ void* nat_cluster_create(const char* lb_policy, int connect_timeout_ms,
   if (policy < 0) return nullptr;
   if (ensure_runtime(0) != 0) return nullptr;
   NatCluster* c = new NatCluster();
+  NAT_RES_ALLOC(NR_CLUSTER, sizeof(NatCluster), c);
   NAT_REF_ACQUIRED(c, clus.opener);  // released by nat_cluster_close
   c->policy = policy;
   c->connect_timeout_ms = connect_timeout_ms;
@@ -577,6 +583,7 @@ int nat_cluster_update(void* h, const char* servers) {
         continue;
       }
       NatLbBackend* b = new NatLbBackend();
+      NAT_RES_ALLOC(NR_CLUSTER, sizeof(NatLbBackend), b);
       NAT_REF_ACQUIRE(b, clus.member);  // removal (or close) releases
       snprintf(b->endpoint, sizeof(b->endpoint), "%s", kv.first.c_str());
       snprintf(b->ip, sizeof(b->ip), "%s", kv.second->ip.c_str());
@@ -692,6 +699,7 @@ int nat_cluster_call(void* h, const char* service, const char* method,
         rc = kEFAILEDSOCKET;
         if (err_text_out != nullptr && *err_text_out == nullptr) {
           const char* msg = "no usable backend";
+          // natcheck:allow(resacct): FFI error text, freed by the caller
           *err_text_out = (char*)malloc(strlen(msg) + 1);
           memcpy(*err_text_out, msg, strlen(msg) + 1);
         }
@@ -829,6 +837,7 @@ int nat_cluster_parallel_call(void* h, const char* service,
     NAT_REF_RELEASE(c, clus.verb);
     if (err_text_out != nullptr) {
       const char* msg = "no sub channels";
+      // natcheck:allow(resacct): FFI error text, freed by the caller
       *err_text_out = (char*)malloc(strlen(msg) + 1);
       memcpy(*err_text_out, msg, strlen(msg) + 1);
     }
@@ -919,6 +928,7 @@ int nat_cluster_partition_call(void* h, const char* service,
     NAT_REF_RELEASE(c, clus.verb);
     if (err_text_out != nullptr) {
       const char* msg = "no partition-tagged backends";
+      // natcheck:allow(resacct): FFI error text, freed by the caller
       *err_text_out = (char*)malloc(strlen(msg) + 1);
       memcpy(*err_text_out, msg, strlen(msg) + 1);
     }

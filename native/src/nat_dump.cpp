@@ -10,6 +10,8 @@
 // with older files unlinked (the rpcz SpanDB rotation shape).
 #include "nat_dump.h"
 
+#include "nat_res.h"
+
 #include <errno.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -65,6 +67,11 @@ struct DumpCell {
 // fixed pool, zero-initialized BSS — the tap claims but never allocates
 // cells (a thread keeps its cell across start/stop windows)
 DumpCell g_dump_cells[kDumpCells];
+// fixed BSS capture pool, attributed for the RSS reconciliation
+const bool g_dump_pool_registered = [] {
+  NAT_RES_STATIC(NR_PROF_CELLS, sizeof(g_dump_cells));
+  return true;
+}();
 
 // decimation + caps (relaxed: armed once per window, read per tap)
 std::atomic<uint32_t> g_dump_every{1};
@@ -272,6 +279,9 @@ int dump_drain_pass(DumpFileState* st, std::string* meta) {
     while (tail < head) {
       DumpSlot* s = &c->ring[tail & (kDumpRing - 1)];
       dump_write_record(st, s, meta);
+      if (s->spill != nullptr) {
+        NAT_RES_FREE(NR_DUMP_SPILL, s->payload_len, s->spill);
+      }
       free(s->spill);
       s->spill = nullptr;
       tail++;
@@ -390,6 +400,7 @@ bool dump_fill_header(DumpSlot* s, int lane, const char* service,
       nat_counter_add(NS_DUMP_DROPS, 1);
       return false;
     }
+    NAT_RES_ALLOC(NR_DUMP_SPILL, payload_len, s->spill);
   }
   return true;
 }
@@ -489,6 +500,9 @@ int nat_dump_start(const char* dir, int every, uint64_t seed,
     uint64_t tail = c->tail.load(std::memory_order_relaxed);
     while (tail < head) {
       DumpSlot* s = &c->ring[tail & (kDumpRing - 1)];
+      if (s->spill != nullptr) {
+        NAT_RES_FREE(NR_DUMP_SPILL, s->payload_len, s->spill);
+      }
       free(s->spill);
       s->spill = nullptr;
       tail++;
@@ -503,6 +517,7 @@ int nat_dump_start(const char* dir, int every, uint64_t seed,
   g_dump_writer_stop.store(false, std::memory_order_release);
   // heap-held + joined in stop — never a static std::thread (the
   // static-dtor exit-crash class)
+  // natcheck:allow(resacct): control-plane thread handle, joined in stop
   g_dump_writer = new std::thread(dump_writer_loop, std::move(st));
   g_nat_dump_on.store(1, std::memory_order_release);
   return 0;

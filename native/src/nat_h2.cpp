@@ -626,6 +626,7 @@ static void h2_respond(NatSocket* s, uint32_t sid, const char* payload,
     if (!data.empty()) {
       // window exhausted: park the remainder + trailers; the
       // WINDOW_UPDATE path finishes the stream
+      s->conn_parked_add(data.size() + trailers.size());
       h->pending.push_back({sid, std::move(data), std::move(trailers)});
       if (it != h->streams.end()) {
         // keep the stream entry alive for its send window
@@ -662,8 +663,11 @@ static void h2_flush_pending(NatSocket* s, H2SessionN* h, std::string* out) {
     auto it = h->streams.find(p.sid);
     H2StreamN tmp;
     H2StreamN* st = it != h->streams.end() ? &it->second : &tmp;
+    size_t before = p.data.size();
     h2_send_data_locked(h, st, p.sid, &p.data, out);
+    s->conn_parked_sub(before - p.data.size());
     if (!p.data.empty()) break;  // still blocked
+    s->conn_parked_sub(p.trailers.size());
     out->append(p.trailers);
     if (it != h->streams.end()) h->streams.erase(it);
     h->pending.pop_front();

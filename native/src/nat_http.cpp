@@ -107,6 +107,9 @@ static void http_emit_locked(NatSocket* s, HttpSessionN* h,
   while (true) {
     auto it = h->parked.find(h->next_resp_seq);
     if (it == h->parked.end()) break;
+    // parked-window accounting: pre-inject length matches the park-side
+    // add (the lame-duck header injection grows only the wire bytes)
+    s->conn_parked_sub(it->second.data.length());
     if (h->lame_duck) http_inject_conn_close(&it->second.data);
     out->append(std::move(it->second.data));
     bool close = it->second.close;
@@ -152,6 +155,7 @@ static void http_emit_response(NatSocket* s, uint64_t seq, IOBuf data,
     auto& slot = h->parked[seq];
     slot.data = std::move(data);
     slot.close = close;
+    s->conn_parked_add(slot.data.length());
     if (batch_out == nullptr && h->round_active) {
       // the reading thread's round holds unflushed earlier responses;
       // stay parked — http_round_end drains after its flush

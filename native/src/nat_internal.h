@@ -228,6 +228,24 @@ struct NatSocket {
   std::atomic<uint64_t> c_read_calls{0};
   std::atomic<uint64_t> c_write_calls{0};
   std::atomic<uint64_t> c_unwritten{0};
+  // per-socket approximate memory (ISSUE 14's /connections column):
+  // c_rdbuf = buffered-but-unparsed read bytes, settled once per drain
+  // by the reading thread; c_parked = reorder-window bytes parked on
+  // the protocol session (http/h2/redis out-of-order responses and
+  // flow-control-blocked h2 sends), adjusted under the session lock.
+  // mem_bytes in the snapshot row = unwritten + rdbuf + parked.
+  std::atomic<uint64_t> c_rdbuf{0};
+  std::atomic<uint64_t> c_parked{0};
+
+  void conn_parked_add(uint64_t n) {
+    c_parked.fetch_add(n, std::memory_order_relaxed);
+  }
+  void conn_parked_sub(uint64_t n) {
+    uint64_t v = c_parked.load(std::memory_order_relaxed);
+    while (!c_parked.compare_exchange_weak(
+        v, v > n ? v - n : 0, std::memory_order_relaxed)) {
+    }
+  }
   // "ip:port" peer, written once at accept/dial before the socket joins
   // its dispatcher; snapshot readers may see "" during setup
   char peer[24] = {0};
@@ -577,7 +595,14 @@ struct PyRequest {
   // g_tpu_work_live until freed (responders free at respond-time, so
   // liveness == "response not yet queued")
   bool drain_counted = false;
+  // resource ledger (nat_res.h): PyRequests are allocated at five lanes'
+  // cut loops and freed at four release sites — self-accounting in the
+  // ctor/dtor covers every one of them with a single seam (allocation
+  // sites carry natcheck:allow(resacct) notes pointing here). The
+  // big_payload fill buffer accounts its grows in stream_fill_reserve.
+  PyRequest() { NAT_RES_ALLOC(NR_SRV_PYREQ, sizeof(PyRequest), this); }
   ~PyRequest() {
+    if (big_cap > 0) NAT_RES_FREE(NR_SRV_PYREQ, big_cap, big_payload);
     ::free(big_payload);
     if (shm_slot >= 0) shm_req_span_release(this);
     if (admitted) {
@@ -588,6 +613,7 @@ struct PyRequest {
     if (drain_counted) {
       g_tpu_work_live.fetch_sub(1, std::memory_order_acq_rel);
     }
+    NAT_RES_FREE(NR_SRV_PYREQ, sizeof(PyRequest), this);
   }
 };
 
@@ -944,11 +970,20 @@ class NatChannel {
     }
   }
 
+  // resource ledger: channels are allocated by channel_open (client
+  // lane) and channel_create_lazy (cluster backends) and freed by the
+  // refcount chain — ctor/dtor self-accounting covers every site (the
+  // raw news carry natcheck:allow(resacct) notes pointing here).
+  NatChannel() { NAT_RES_ALLOC(NR_CLUSTER, sizeof(NatChannel), this); }
   ~NatChannel() {
     for (uint32_t i = 0; i < kMaxSlabs; i++) {
       PendingCall* slab = slabs_[i].load(std::memory_order_acquire);
-      if (slab != nullptr) delete[] slab;
+      if (slab != nullptr) {
+        NAT_RES_FREE(NR_CLUSTER, kSlabSize * sizeof(PendingCall), slab);
+        delete[] slab;
+      }
     }
+    NAT_RES_FREE(NR_CLUSTER, sizeof(NatChannel), this);
   }
 
   PendingCall* slot_at(uint32_t idx) {
@@ -1189,6 +1224,7 @@ class NatChannel {
     uint32_t slab_i = n >> kSlabBits;
     if (slab_i >= kMaxSlabs) return false;
     PendingCall* slab = new PendingCall[kSlabSize];
+    NAT_RES_ALLOC(NR_CLUSTER, kSlabSize * sizeof(PendingCall), slab);
     slabs_[slab_i].store(slab, std::memory_order_release);
     nslots_.store(n + kSlabSize, std::memory_order_release);
     // seed indices [n+1, n+kSlabSize) through the freelist; hand out n

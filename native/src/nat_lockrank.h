@@ -45,6 +45,11 @@ enum : int {
                               // serialization (control path only; held
                               // across the collector join, which takes
                               // g_report_mu on its own thread)
+  kLockRankResReport = 7,     // nat_res g_res_report_mu: allocation-site
+                              // collector/report + ledger snapshots
+                              // (control path only; the record seams are
+                              // lock-free — they run under registry
+                              // locks of arbitrary rank)
   kLockRankProfReport = 8,    // nat_prof g_report_mu: collector/report
                               // serialization (holds no other lock while
                               // symbolizing), outermost

@@ -18,6 +18,7 @@ static void build_response_frame_ex(IOBuf* out, int64_t cid,
                                     int shutdown) {
   size_t bound = 12 + response_meta_bound(error_text.size());
   char stack_buf[320];
+  // natcheck:allow(resacct): per-frame scratch, freed before return
   char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
   size_t mlen = encode_response_meta_to(buf + 12, error_code,
                                         error_text.data(), error_text.size(),
@@ -64,6 +65,7 @@ void build_request_frame(IOBuf* out, int64_t cid, const std::string& service,
                          uint64_t trace_id, uint64_t span_id) {
   size_t bound = 12 + request_meta_bound(service.size(), method.size());
   char stack_buf[320];
+  // natcheck:allow(resacct): per-frame scratch, freed before return
   char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
   size_t mlen = encode_request_meta_to(buf + 12, service.data(),
                                        service.size(), method.data(),
@@ -169,6 +171,7 @@ static int try_process_http(NatSocket* s, IOBuf* batch_out) {
 // Parse the 9-byte stream frame header (8B dest stream id + 1B type)
 // into a kind-5 request — shared by the buffered and fill paths.
 static PyRequest* make_stream_request(NatSocket* s, const char fh[9]) {
+  // natcheck:allow(resacct): PyRequest self-accounts in its ctor
   PyRequest* r = new PyRequest();
   r->kind = 5;
   r->sock_id = s->id;
@@ -185,8 +188,22 @@ static bool stream_fill_reserve(PyRequest* r, size_t need_off) {
   size_t cap = r->big_cap > 0 ? r->big_cap : (1u << 20);
   while (cap < need_off) cap *= 2;
   if (cap > r->big_len) cap = r->big_len;
+  // ledger: retire the old capacity BEFORE realloc can hand its pages
+  // to a concurrent accounted allocation (the site profiler applies
+  // events in global-ticket order — a FREE published after another
+  // thread's ALLOC at the same address would erase that entry);
+  // re-added on failure so the ledger stays balanced
+  if (r->big_cap > 0) {
+    NAT_RES_FREE(NR_SRV_PYREQ, r->big_cap, r->big_payload);
+  }
   char* p = (char*)realloc(r->big_payload, cap);
-  if (p == nullptr) return false;
+  if (p == nullptr) {
+    if (r->big_cap > 0) {
+      NAT_RES_ALLOC(NR_SRV_PYREQ, r->big_cap, r->big_payload);
+    }
+    return false;
+  }
+  NAT_RES_ALLOC(NR_SRV_PYREQ, cap, p);
   r->big_payload = p;
   r->big_cap = cap;
   return true;
@@ -216,6 +233,7 @@ size_t stream_fill_feed(NatSocket* s, const char* data, size_t n) {
 // ordered chunk.
 static void forward_raw_chunk(NatSocket* s) {
   if (s->in_buf.empty()) return;
+  // natcheck:allow(resacct): PyRequest self-accounts in its ctor
   PyRequest* r = new PyRequest();
   r->kind = 1;
   r->sock_id = s->id;
@@ -597,6 +615,7 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
                           (uint64_t)meta.request.span_id);
         }
       } else if (srv->py_lane_enabled) {
+        // natcheck:allow(resacct): PyRequest self-accounts in its ctor
         PyRequest* r = new PyRequest();
         r->sock_id = s->id;
         r->cid = meta.correlation_id;
@@ -747,6 +766,10 @@ bool drain_socket_inline(NatSocket* s) {
     dead = true;  // EOF or hard error
     break;
   }
+  // /connections memory column: buffered-but-unparsed request bytes on
+  // this socket, settled once per drain (single reading thread stores,
+  // the snapshot walker reads)
+  s->c_rdbuf.store(s->in_buf.length(), std::memory_order_relaxed);
   bool hold_role = false;
   if (!acc.empty() && !dead && s->ssl_sess != nullptr) {
     // TLS: encrypt + queue atomically (ssl_encrypt_and_write) — a py

@@ -27,6 +27,7 @@
 
 #include "nat_api.h"
 #include "nat_lockrank.h"
+#include "nat_res.h"
 #include "nat_stats.h"
 
 namespace brpc_tpu {
@@ -127,32 +128,8 @@ int prof_unwind(void* ucv, uintptr_t* out) {
   return n;
 }
 
-// Claim (or find) the cell for `tid`: open addressing over a fixed
-// pool, CAS on the tid word. No allocation, no locks — shared by the
-// SIGPROF ring and the mutex-contention ring (the seqlock
-// publish/drain pairs stay per-ring: one writer runs in signal
-// context under the sigsafe lint, payloads and drop accounting
-// differ; a protocol change there must be applied to BOTH rings and
-// the span ring in nat_stats.cpp).
-template <typename Cell, size_t N>
-Cell* claim_cell(Cell (&pool)[N], int32_t tid) {
-  uint32_t h = (uint32_t)(nat_mix64((uint64_t)tid) % N);
-  for (size_t probe = 0; probe < N; probe++) {
-    Cell* c = &pool[(h + probe) % N];
-    int32_t cur = c->tid.load(std::memory_order_acquire);
-    if (cur == tid) return c;
-    if (cur == 0) {
-      int32_t expect = 0;
-      if (c->tid.compare_exchange_strong(expect, tid,
-                                         std::memory_order_acq_rel)) {
-        return c;
-      }
-      if (expect == tid) return c;  // lost to ourselves? (impossible) —
-                                    // lost to another tid: keep probing
-    }
-  }
-  return nullptr;  // pool full: drop the sample
-}
+// (claim_cell lives in nat_prof.h now: the nat_res allocation ring is
+// the third user of the fixed-pool CAS-claim discipline.)
 
 ProfCell* prof_cell(int32_t tid) { return claim_cell(g_cells, tid); }
 
@@ -287,6 +264,12 @@ std::string prof_symbolize(uintptr_t pc,
 
 }  // namespace
 
+// The one symbolizer (shared with nat_res's heap/growth reports).
+std::string nat_prof_symbolize_pc(uintptr_t pc,
+                                  std::map<uintptr_t, std::string>* cache) {
+  return prof_symbolize(pc, cache);
+}
+
 // ---------------------------------------------------------------------------
 // lock-contention profiler (/hotspots/contention's native half): the
 // NatMutex<Rank> slow path lands here on every acquisition whose
@@ -356,6 +339,7 @@ const char* mu_rank_name(int rank) {
     case kLockRankMuSelftest: return "mu.selftest";
     case kLockRankDumpCtl: return "dump.ctl";
     case kLockRankProfCtl: return "prof.ctl";
+    case kLockRankResReport: return "res.report";
     case kLockRankProfReport: return "prof.report";
     case kLockRankMuProfReport: return "muprof.report";
     case kLockRankShmProbe: return "shm.probe";
@@ -424,6 +408,14 @@ int mu_backtrace(uintptr_t* out, int max) {
 
 MuCell* mu_cell(int32_t tid) { return claim_cell(g_mu_cells, tid); }
 
+// fixed BSS sample pools, attributed once for the RSS reconciliation
+// (/status nat_mem line): resident the moment the first sample touches
+// their pages
+const bool g_prof_pools_registered = [] {
+  NAT_RES_STATIC(NR_PROF_CELLS, sizeof(g_cells) + sizeof(g_mu_cells));
+  return true;
+}();
+
 // Drain published contention samples into the aggregate map. Requires
 // g_mu_report_mu.
 // no_sanitize: seqlock reader — the plain payload copy intentionally
@@ -485,6 +477,13 @@ std::string mu_symbolize(uintptr_t pc,
 }
 
 }  // namespace
+
+// Shared frame-pointer walk for samplers running in NORMAL code (the
+// contention profiler here and nat_res's allocation-site sampler):
+// return addresses starting at this function's caller.
+int nat_fp_backtrace(uintptr_t* out, int max) {
+  return mu_backtrace(out, max);
+}
 
 // no_sanitize: seqlock writer — see mu_drain_locked. Only the ring
 // publish is annotated; the enclosing wait path keeps instrumentation
@@ -597,6 +596,7 @@ int nat_prof_start(int hz) {
     return -2;
   }
   g_collector_stop.store(false, std::memory_order_release);
+  // natcheck:allow(resacct): control-plane thread handle, joined in stop
   g_collector = new std::thread(prof_collector_loop);
   return 0;
 }
@@ -704,6 +704,7 @@ int nat_prof_report(int mode, char** out, size_t* out_len) {
       }
     }
   }
+  // natcheck:allow(resacct): FFI report buffer, freed by the caller
   char* buf = (char*)malloc(text.size() + 1);
   if (buf == nullptr) return -1;
   memcpy(buf, text.data(), text.size());
@@ -849,6 +850,7 @@ int nat_mu_prof_report(int mode, char** out, size_t* out_len) {
       }
     }
   }
+  // natcheck:allow(resacct): FFI report buffer, freed by the caller
   char* buf = (char*)malloc(text.size() + 1);
   if (buf == nullptr) return -1;
   memcpy(buf, text.data(), text.size());

@@ -24,10 +24,53 @@
 
 #include <stdint.h>
 
+#include <atomic>
+#include <map>
+#include <string>
+
+#include "nat_stats.h"  // nat_mix64 (cell hashing)
+
 namespace brpc_tpu {
 
 inline constexpr int kProfMaxFrames = 24;   // pcs kept per sample
 inline constexpr uint32_t kProfRing = 256;  // samples buffered per thread
 inline constexpr int kProfCells = 64;       // concurrent sampled threads
+
+// Claim (or find) the cell for `tid`: open addressing over a fixed
+// pool, CAS on the tid word. No allocation, no locks — shared by the
+// SIGPROF ring, the mutex-contention ring and the nat_res allocation
+// ring (the seqlock publish/drain pairs stay per-ring: one writer runs
+// in signal context under the sigsafe lint, payloads and drop
+// accounting differ; a protocol change there must be applied to ALL
+// rings and the span ring in nat_stats.cpp).
+template <typename Cell, size_t N>
+Cell* claim_cell(Cell (&pool)[N], int32_t tid) {
+  uint32_t h = (uint32_t)(nat_mix64((uint64_t)tid) % N);
+  for (size_t probe = 0; probe < N; probe++) {
+    Cell* c = &pool[(h + probe) % N];
+    int32_t cur = c->tid.load(std::memory_order_acquire);
+    if (cur == tid) return c;
+    if (cur == 0) {
+      int32_t expect = 0;
+      if (c->tid.compare_exchange_strong(expect, tid,
+                                         std::memory_order_acq_rel)) {
+        return c;
+      }
+      if (expect == tid) return c;  // lost to ourselves? (impossible) —
+                                    // lost to another tid: keep probing
+    }
+  }
+  return nullptr;  // pool full: drop the sample
+}
+
+// Frame-pointer walk from the CALLER's frame (normal code, not signal
+// context; probe-read bounded monotone — defined in nat_prof.cpp,
+// shared with nat_res's allocation-site sampler).
+int nat_fp_backtrace(uintptr_t* out, int max);
+
+// pc -> demangled symbol (dladdr + __cxa_demangle, cached) — the one
+// symbolizer every native profile report goes through.
+std::string nat_prof_symbolize_pc(uintptr_t pc,
+                                  std::map<uintptr_t, std::string>* cache);
 
 }  // namespace brpc_tpu

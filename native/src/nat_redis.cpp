@@ -120,11 +120,12 @@ static void r_nil(std::string* out) { out->append("$-1\r\n"); }
 
 // Drain in-order parked replies. Requires h->redis_mu. Appends to out;
 // *want_close set when the QUIT reply drained.
-static void redis_drain_locked(RedisSessN* h, std::string* out,
-                               bool* want_close) {
+static void redis_drain_locked(NatSocket* s, RedisSessN* h,
+                               std::string* out, bool* want_close) {
   while (true) {
     auto it = h->parked.find(h->next_resp_seq);
     if (it == h->parked.end()) break;
+    s->conn_parked_sub(it->second.size());
     out->append(it->second);
     h->parked.erase(it);
     if (h->close_after_seq != 0 &&
@@ -152,14 +153,16 @@ static void redis_emit(NatSocket* s, RedisSessN* h, uint64_t seq,
   bool want_close = false;
   {
     std::lock_guard g(h->redis_mu);
-    h->parked[seq] = std::move(reply);
+    std::string& slot = h->parked[seq];
+    slot = std::move(reply);
+    s->conn_parked_add(slot.size());
     if (batch_out == nullptr && h->round_active) {
       // the reading thread holds unflushed earlier replies in its round
       // accumulator: writing now could overtake them. It drains the
       // window at end of round.
       return;
     }
-    redis_drain_locked(h, &out, &want_close);
+    redis_drain_locked(s, h, &out, &want_close);
     if (batch_out != nullptr) {
       // mid-round: the bytes flush at end of round; closing must wait
       // for that flush (redis_round_end arms it)
@@ -600,7 +603,7 @@ void redis_round_end(NatSocket* s) {
   std::string out;
   bool want_close = false;
   std::lock_guard g(h->redis_mu);
-  redis_drain_locked(h, &out, &want_close);
+  redis_drain_locked(s, h, &out, &want_close);
   want_close = want_close || h->close_pending;
   h->close_pending = false;
   h->round_active = false;

@@ -134,8 +134,9 @@ std::atomic<bool> g_lane_enabled{false};
 std::atomic<bool> g_drainer_stop{false};
 
 // parent-local producer locks (one per worker request ring) + routing
+// natcheck:leak(g_req_mu): leaked — exit order vs the drainer thread
 NatMutex<kLockRankShmReq>* g_req_mu =
-    new NatMutex<kLockRankShmReq>[kMaxWorkers];  // leaked: exit order
+    new NatMutex<kLockRankShmReq>[kMaxWorkers];
 std::atomic<uint32_t> g_rr{0};
 // parent-local: outstanding arena-backed user blocks per slot (responses
 // in flight through socket write queues) + a recovery epoch so a release
@@ -150,8 +151,9 @@ int g_my_slot = -1;
 // anchor); nat_shm_respond ships it back so the parent can stitch the
 // worker span without any cross-process span ring.
 thread_local uint64_t tls_take_ns = 0;
+// natcheck:leak(g_resp_mu): leaked — exit order vs the worker loop
 NatMutex<kLockRankShmResp>* g_resp_mu =
-    new NatMutex<kLockRankShmResp>;  // leaked: exit order
+    new NatMutex<kLockRankShmResp>;
 
 // every sub-block is 64-byte aligned: the segment base is page-aligned,
 // the header/rings round up to 64, and arena_bytes is page-rounded
@@ -402,6 +404,7 @@ void user_span_free(void* raw) {
     span_release(resp_arena(ctx->slot), ctx->span_off);
   }
   g_user_spans[ctx->slot].fetch_sub(1, std::memory_order_acq_rel);
+  NAT_RES_FREE(NR_SHM_SEG, sizeof(UserSpanCtx), ctx);
   delete ctx;
 }
 
@@ -521,6 +524,7 @@ void emit_response(int slot, const CellView& c) {
     UserSpanCtx* ctx = new UserSpanCtx{
         slot, g_slot_epoch[slot].load(std::memory_order_acquire),
         c.span_off};
+    NAT_RES_ALLOC(NR_SHM_SEG, sizeof(UserSpanCtx), ctx);
     g_user_spans[slot].fetch_add(1, std::memory_order_acq_rel);
     IOBuf body;
     body.append_user(payload, payload_len, user_span_free, ctx);
@@ -888,7 +892,9 @@ int nat_shm_lane_create(size_t ring_bytes) {
     // gates them, not a rendezvous) — a stray touch of an unlinked,
     // still-mapped segment is harmless, a touch of an unmapped one is a
     // SIGSEGV. Stop->start cycles are rare; the cost is bounded virtual
-    // address space, not RAM that matters.
+    // address space, not RAM that matters. The ledger keeps the old
+    // mapping's bytes LIVE on purpose: leaked-but-resident pages are
+    // exactly what the /status RSS reconciliation must attribute.
     g_seg = nullptr;
     g_my_slot = -1;
   }
@@ -916,6 +922,7 @@ int nat_shm_lane_create(size_t ring_bytes) {
     shm_unlink(g_seg_name);
     return -1;
   }
+  NAT_RES_ALLOC(NR_SHM_SEG, total, mem);
   g_seg = (ShmSeg*)mem;
   g_seg_total = total;
   g_seg_unlinked = false;
@@ -982,6 +989,7 @@ int nat_shm_lane_enable(int enable) {
     g_seg->shutdown.store(0, std::memory_order_release);
     g_drainer_stop.store(false, std::memory_order_relaxed);
     delete g_resp_drainer;
+    // natcheck:allow(resacct): control-plane thread handle
     g_resp_drainer = new std::thread(resp_drainer_loop);
     static std::atomic<bool> hook_added{false};
     if (!hook_added.exchange(true, std::memory_order_acq_rel)) {
@@ -1056,7 +1064,9 @@ int nat_shm_worker_attach(const char* name) {
                      MAP_SHARED, fd, 0);
     ::close(fd);
     if (mem == MAP_FAILED) return -1;
+    NAT_RES_ALLOC(NR_SHM_SEG, (size_t)st.st_size, mem);
     if (((ShmSeg*)mem)->magic != kShmMagic) {
+      NAT_RES_FREE(NR_SHM_SEG, (size_t)st.st_size, mem);
       munmap(mem, (size_t)st.st_size);
       return -1;
     }
@@ -1119,6 +1129,7 @@ void* nat_shm_take_request(int timeout_ms) {
       } else if (fwk.action == NF_STALL || fwk.action == NF_DELAY) {
         nat_fault_delay_ms(fwk.delay_ms);
       }
+      // natcheck:allow(resacct): PyRequest self-accounts in its ctor
       PyRequest* req = new PyRequest();
       req->kind = (int32_t)c.kind;
       req->sock_id = c.sock_id;
@@ -1270,6 +1281,7 @@ double nat_shm_push_bench(size_t record_bytes, double seconds,
   if (g_seg == nullptr || record_bytes == 0) return 0.0;
   char* buf = (char*)malloc(record_bytes);
   if (buf == nullptr) return 0.0;
+  NAT_RES_ALLOC(NR_SHM_SEG, record_bytes, buf);
   memset(buf, 0x5a, record_bytes);
   uint64_t records = 0;
   auto t0 = std::chrono::steady_clock::now();
@@ -1289,6 +1301,7 @@ double nat_shm_push_bench(size_t record_bytes, double seconds,
   double dt =
       std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
           .count();
+  NAT_RES_FREE(NR_SHM_SEG, record_bytes, buf);
   free(buf);
   if (out_records != nullptr) *out_records = records;
   return dt > 0 ? (double)records * (double)record_bytes / dt / 1e9 : 0.0;

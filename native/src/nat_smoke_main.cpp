@@ -23,6 +23,7 @@
 
 #include "nat_api.h"
 #include "nat_dump.h"   // NatDumpStatusRec / NatReplayResult layouts
+#include "nat_res.h"    // NatResRow layout for the resacct round
 #include "nat_stats.h"  // full NatSpanRec layout for the drain buffer
 
 static int g_failures = 0;
@@ -762,6 +763,48 @@ int main() {
     }
     if (p2 > 0) nat_rpc_server_remove_port(p2);
     if (p3 > 0) nat_rpc_server_remove_port(p3);
+  }
+
+  // ---- resacct round (ISSUE 14): the memory observatory's ledger and
+  // allocation-site profiler under churn — alloc/free balance asserted
+  // by the selftest (4 threads x 400 rounds with a concurrent
+  // snapshot + /heap-style report drain racing them: the sanitizer
+  // lanes see the seqlock event ring and the lock-free cell claims
+  // under real overlap), then the live rows the traffic above must
+  // have populated ----
+  {
+    CHECK(nat_res_selftest(4, 400) == 0, "resacct selftest balance");
+    CHECK(nat_res_count() >= 10, "resacct subsystem count");
+    brpc_tpu::NatResRow rrows[32];
+    int nres = nat_res_stats(rrows, 32);
+    CHECK(nres == nat_res_count(), "resacct stats rows");
+    uint64_t iobuf_live = 0, sock_live = 0, total_live = 0;
+    for (int i = 0; i < nres; i++) {
+      total_live += rrows[i].live_bytes;
+      if (strcmp(rrows[i].name, "iobuf.block") == 0) {
+        iobuf_live = rrows[i].live_bytes;
+      }
+      if (strcmp(rrows[i].name, "sock.slab") == 0) {
+        sock_live = rrows[i].live_bytes;
+      }
+      CHECK(rrows[i].hwm_bytes >= rrows[i].live_bytes,
+            "resacct hwm >= live");
+    }
+    CHECK(iobuf_live > 0, "iobuf blocks accounted after traffic");
+    CHECK(sock_live > 0, "socket slabs accounted after traffic");
+    CHECK(nat_res_accounted_bytes() >= total_live / 2,
+          "accounted-bytes total coherent");
+    // heap/growth reports render while the ledger is hot
+    int armed = nat_res_prof_start(1, 42);
+    char* rep = nullptr;
+    size_t rep_len = 0;
+    CHECK(nat_res_heap_report(1, &rep, &rep_len) == 0 && rep != nullptr,
+          "heap report renders");
+    if (rep != nullptr) nat_buf_free(rep);
+    CHECK(nat_res_growth_report(&rep, &rep_len) == 0 && rep != nullptr,
+          "growth report renders");
+    if (rep != nullptr) nat_buf_free(rep);
+    if (armed == 0) nat_res_prof_stop();
   }
 
   // ---- clean exit: stop the server, leave the scheduler's detached

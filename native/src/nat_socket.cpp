@@ -49,6 +49,9 @@ NatSocket* sock_create() {
       if (slab_i >= kSockSlabs) return nullptr;
       if (g_sock_slab[slab_i].load(std::memory_order_relaxed) == nullptr) {
         auto* slab = new std::atomic<NatSocket*>[kSockSlabSize]();
+        NAT_RES_ALLOC(NR_SOCK_SLAB,
+                      kSockSlabSize * sizeof(std::atomic<NatSocket*>),
+                      slab);
         g_sock_slab[slab_i].store(slab, std::memory_order_release);
       }
       // construct + publish while still holding the alloc lock so the
@@ -57,6 +60,7 @@ NatSocket* sock_create() {
       // natcheck:leak(sock_create): ResourcePool discipline — sockets
       // and their slabs are never freed; slot indices stay valid forever
       s = new NatSocket();  // lives forever in its slot
+      NAT_RES_ALLOC(NR_SOCK_SLAB, sizeof(NatSocket), s);
       g_sock_slab[slab_i].load(std::memory_order_acquire)
           [idx & (kSockSlabSize - 1)]
               .store(s, std::memory_order_release);
@@ -142,6 +146,7 @@ struct WreqCache {
   ~WreqCache() {
     while (head != nullptr) {
       WriteReq* next = head->wnext.load(std::memory_order_relaxed);
+      NAT_RES_FREE(NR_SOCK_WREQ, sizeof(WriteReq), head);
       delete head;
       head = next;
     }
@@ -159,6 +164,7 @@ WriteReq* wreq_alloc() {
     c.n--;
   } else {
     r = new WriteReq();
+    NAT_RES_ALLOC(NR_SOCK_WREQ, sizeof(WriteReq), r);
   }
   // a live write-stack node until the drainer's wreq_free
   NAT_REF_ACQUIRED(r, wreq.node);
@@ -170,6 +176,7 @@ void wreq_free(WriteReq* r) {
   r->data.clear();
   WreqCache& c = tls_wreq;
   if (c.n >= WreqCache::kCap) {
+    NAT_RES_FREE(NR_SOCK_WREQ, sizeof(WriteReq), r);
     delete r;
     return;
   }
@@ -302,6 +309,8 @@ void NatSocket::reset_for_reuse() {
   c_read_calls.store(0, std::memory_order_relaxed);
   c_write_calls.store(0, std::memory_order_relaxed);
   c_unwritten.store(0, std::memory_order_relaxed);
+  c_rdbuf.store(0, std::memory_order_relaxed);
+  c_parked.store(0, std::memory_order_relaxed);
   peer[0] = '\0';
 }
 
@@ -343,6 +352,7 @@ void NatSocket::set_failed() {
        py_streams.load(std::memory_order_acquire)) &&
       server != nullptr) {
     // tell the Python protocol stack to drop this connection's session
+    // natcheck:allow(resacct): PyRequest self-accounts in its ctor
     PyRequest* r = new PyRequest();
     r->kind = 2;
     r->sock_id = id;
@@ -778,7 +788,9 @@ bool ring_drain_one(RingListener* ring) {
           }
           ring->recycle_buffer(c.buf_id);
           int64_t rr = s->ring_ref.load(std::memory_order_acquire);
-          if (!process_input(s)) {
+          bool in_ok = process_input(s);
+          s->c_rdbuf.store(s->in_buf.length(), std::memory_order_relaxed);
+          if (!in_ok) {
             s->set_failed();
           } else if (!c.more && rr >= 0 &&
                      !ring->rearm_recv((int)(rr & 0xffffffff),
@@ -899,6 +911,9 @@ static void conn_fill_row(NatSocket* s, NatConnRow* r) {
   r->read_calls = s->c_read_calls.load(std::memory_order_relaxed);
   r->write_calls = s->c_write_calls.load(std::memory_order_relaxed);
   r->unwritten_bytes = s->c_unwritten.load(std::memory_order_relaxed);
+  r->mem_bytes = r->unwritten_bytes +
+                 s->c_rdbuf.load(std::memory_order_relaxed) +
+                 s->c_parked.load(std::memory_order_relaxed);
   r->fd = s->fd;
   r->disp_idx = s->disp != nullptr ? s->disp->idx : -1;
   r->server_side = s->server != nullptr ? 1 : 0;

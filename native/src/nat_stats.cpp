@@ -7,6 +7,7 @@
 
 #include <mutex>
 #include "nat_lockrank.h"
+#include "nat_res.h"
 
 namespace brpc_tpu {
 
@@ -36,6 +37,7 @@ NatStatCell* nat_cell_slow() {
   NatStatCell* c;
   if (n < kMaxCells) {
     c = new NatStatCell();  // zero-initialized (atomics value-init to 0)
+    NAT_RES_ALLOC(NR_STATS_CELL, sizeof(NatStatCell), c);
     g_cells[n].store(c, std::memory_order_release);
     g_ncells.store(n + 1, std::memory_order_release);
   } else {
@@ -277,6 +279,11 @@ struct SpanSlot {
   NatSpanRec rec;
 };
 static SpanSlot g_span_ring[kNatSpanRing];
+// fixed BSS span ring, attributed for the RSS reconciliation
+static const bool g_span_ring_registered = [] {
+  NAT_RES_STATIC(NR_PROF_CELLS, sizeof(g_span_ring));
+  return true;
+}();
 static std::atomic<uint64_t> g_span_head{0};  // next ticket
 static NatMutex<kLockRankStatsSpan> g_span_drain_mu;
 static uint64_t g_span_next_read = 0;  // under g_span_drain_mu

@@ -209,6 +209,10 @@ struct NatConnRow {
   uint64_t read_calls;       // read()/readv/ring-recv completions
   uint64_t write_calls;      // writev/ring-send completions
   uint64_t unwritten_bytes;  // queued on the write stack, not yet accepted
+  uint64_t mem_bytes;        // approximate per-socket memory: unwritten
+                             // write-stack bytes + read-buffer bytes +
+                             // reorder-window parked bytes (ISSUE 14's
+                             // /connections memory column)
   int32_t fd;
   int32_t disp_idx;          // owning dispatcher loop (-1 = none)
   int32_t server_side;       // 1 = accepted, 0 = dialed

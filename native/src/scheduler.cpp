@@ -3,6 +3,7 @@
 // guard page like bthread's StackPool (stack_inl.h:36-105).
 #include "scheduler.h"
 
+#include "nat_res.h"
 #include "nat_stats.h"
 
 #include <sys/mman.h>
@@ -83,6 +84,20 @@ __attribute__((noinline)) static Worker* current_worker() {
 
 static const size_t kStackSize = 256 * 1024;
 
+// Fiber object alloc/release seams (the ledger pairs them; the stacks
+// account separately at the mmap/munmap above, so a pooled stack stays
+// LIVE while a reaped Fiber does not).
+static Fiber* fiber_new() {
+  Fiber* f = new Fiber();
+  NAT_RES_ALLOC(NR_SCHED_STACK, sizeof(Fiber), f);
+  return f;
+}
+
+static void fiber_delete(Fiber* f) {
+  NAT_RES_FREE(NR_SCHED_STACK, sizeof(Fiber), f);
+  delete f;
+}
+
 // Pooled stacks (StackPool role, stack_inl.h:36-105): per-request fibers
 // must not pay an mmap/munmap round trip each spawn. POD storage on
 // purpose: detached worker threads outlive exit()'s static destructors
@@ -107,6 +122,7 @@ static char* alloc_stack(size_t size) {
   void* mem = mmap(nullptr, size + 4096, PROT_READ | PROT_WRITE,
                    MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
   if (mem == MAP_FAILED) return nullptr;
+  NAT_RES_ALLOC(NR_SCHED_STACK, size + 4096, mem);
   mprotect(mem, 4096, PROT_NONE);  // guard page
   return (char*)mem + 4096;
 }
@@ -119,6 +135,7 @@ static void free_stack(char* stack, size_t size) {
       return;
     }
   }
+  NAT_RES_FREE(NR_SCHED_STACK, size + 4096, stack - 4096);
   munmap(stack - 4096, size + 4096);
 }
 
@@ -158,6 +175,7 @@ int Scheduler::start(int nworkers) {
   stopping_ = false;
   for (int i = 0; i < nworkers; i++) {
     Worker* w = new Worker();
+    NAT_RES_ALLOC(NR_SCHED_STACK, sizeof(Worker), w);
     w->sched = this;
     w->id = i;
     workers_.push_back(w);
@@ -176,7 +194,10 @@ void Scheduler::stop() {
   for (Worker* w : workers_) {
     if (w->thread.joinable()) w->thread.join();
   }
-  for (Worker* w : workers_) delete w;
+  for (Worker* w : workers_) {
+    NAT_RES_FREE(NR_SCHED_STACK, sizeof(Worker), w);
+    delete w;
+  }
   workers_.clear();
   started_ = false;
 }
@@ -261,7 +282,7 @@ static void init_fiber_ctx(Fiber* f) {
 }
 
 Fiber* Scheduler::spawn(FiberFn fn, void* arg) {
-  Fiber* f = new Fiber();
+  Fiber* f = fiber_new();
   f->fn = fn;
   f->arg = arg;
   f->stack = alloc_stack(kStackSize);
@@ -273,7 +294,7 @@ Fiber* Scheduler::spawn(FiberFn fn, void* arg) {
 }
 
 void Scheduler::spawn_detached(FiberFn fn, void* arg) {
-  Fiber* f = new Fiber();
+  Fiber* f = fiber_new();
   f->detached = true;
   f->fn = fn;
   f->arg = arg;
@@ -285,7 +306,7 @@ void Scheduler::spawn_detached(FiberFn fn, void* arg) {
 }
 
 void Scheduler::spawn_detached_back(FiberFn fn, void* arg) {
-  Fiber* f = new Fiber();
+  Fiber* f = fiber_new();
   f->detached = true;
   f->fn = fn;
   f->arg = arg;
@@ -471,7 +492,7 @@ void Scheduler::run_fiber(Worker* w, Fiber* f) {
       w->remained_op = Worker::RemainedOp::NONE;
       sanitize_fiber_destroy(rf);
       free_stack(rf->stack, rf->stack_size);
-      delete rf;
+      fiber_delete(rf);
       break;
     }
   }
@@ -641,7 +662,7 @@ void Scheduler::join(Fiber* f) {
   }
   sanitize_fiber_destroy(f);
   free_stack(f->stack, f->stack_size);
-  delete f;
+  fiber_delete(f);
 }
 
 uint64_t Scheduler::total_switches() const {

@@ -52,6 +52,9 @@ def _bench_result():
             "fanout1000_qps": 60.0,
             "swarm_qps": 38000.0,
             "swarm_p99_us": 820.0,
+            "conn_scale_conns": 19000.0,
+            "conn_per_conn_bytes": 14000.0,
+            "conn_accept_storm_s": 12.0,
             "native_latency_us": {"echo": {"p50": 10.0, "p99": 50.0,
                                            "p999": 200.0}},
             "nat_prof": {"samples": 1234,
@@ -181,6 +184,54 @@ def test_latency_ceiling_lane_regresses_upward(pair):
     findings = benchgate.compare(base, cur)
     assert _rules(findings) == ["regression"]
     assert "upward" in findings[0].message
+
+
+def test_conn_scale_zero_failed_contract_trips_gate(pair):
+    # the conn-scale drill reports 0 connections when ANY live-subset
+    # RPC failed, the storm left connections unanswered, or a transient
+    # subsystem leaked — the gate must read that as a collapse
+    base, cur = pair
+    cur["lanes"]["conn_scale_conns"] = 0.0
+    findings = benchgate.compare(base, cur)
+    assert "regression" in _rules(findings)
+    assert any("conn_scale_conns" in f.message for f in findings)
+
+
+def test_conn_per_conn_bytes_ceiling_regresses_upward(pair):
+    # per-connection memory cost is a CEILING lane: regressing UPWARD
+    # past baseline * (1 + band) fails even when every qps lane held
+    base, cur = pair
+    cur["lanes"]["conn_per_conn_bytes"] = 14000.0 * 1.8  # +80% > 50%
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["regression"]
+    assert "conn_per_conn_bytes" in findings[0].message
+    assert "upward" in findings[0].message
+
+
+def test_conn_ceilings_within_band_pass(pair):
+    base, cur = pair
+    cur["lanes"]["conn_per_conn_bytes"] = 14000.0 * 1.3   # < 50% band
+    cur["lanes"]["conn_accept_storm_s"] = 12.0 * 1.7      # < 100% band
+    assert benchgate.compare(base, cur) == []
+
+
+def test_accept_storm_ceiling_regresses_upward(pair):
+    base, cur = pair
+    cur["lanes"]["conn_accept_storm_s"] = 12.0 * 2.5  # +150% > 100%
+    findings = benchgate.compare(base, cur)
+    assert _rules(findings) == ["regression"]
+    assert "conn_accept_storm_s" in findings[0].message
+
+
+def test_conn_ceiling_baseline_takes_max():
+    # make_baseline records the credible WORST case for ceiling lanes
+    arts = []
+    for v in (9.0, 14.0, 11.0):
+        b = _bench_result()
+        b["extra"]["conn_accept_storm_s"] = v
+        arts.append(benchgate.make_artifact(b, round_n=1))
+    base = benchgate.make_baseline(arts, round_n=9)
+    assert base["lanes"]["conn_accept_storm_s"] == 14.0
 
 
 def test_ceiling_lane_baseline_takes_max():

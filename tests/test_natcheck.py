@@ -560,3 +560,121 @@ def test_cli_lockorder_exits_zero_on_clean_tree():
         [sys.executable, "-m", "tools.natcheck", "lockorder"],
         cwd=REPO, capture_output=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+# ---------------------------------------------------------------------------
+# lint: resacct (ISSUE 14 — raw allocations in accounted subsystem TUs
+# must route through the nat_res ledger or carry a reviewed escape)
+# ---------------------------------------------------------------------------
+
+
+def test_lint_resacct_flags_unaccounted_malloc(tmp_path):
+    # the TU uses the accounting macros (self-selecting rule) but one
+    # malloc bypasses the ledger: invisible to /heap/native + nat_mem_*
+    findings = _lint_one(tmp_path, "res1.cpp", """
+#include <cstdlib>
+void stray() {
+  void* b = malloc(128);
+  (void)b;
+}
+// ---- padding so the stray site sits outside the pairing window ----
+// (the rule accepts a NAT_RES_* within 3 lines before / 6 after)
+//
+//
+void seam() {
+  void* a = malloc(64);
+  NAT_RES_ALLOC(0, 64, a);
+}
+""")
+    flagged = [f for f in findings if f.rule == "resacct"]
+    assert len(flagged) == 1 and "res1.cpp:4" in flagged[0].where, \
+        findings
+
+
+def test_lint_resacct_flags_unaccounted_new_and_mmap(tmp_path):
+    findings = _lint_one(tmp_path, "res2.cpp", """
+#include <sys/mman.h>
+struct Obj {};
+void seam(int n) {
+  NAT_RES_STATIC(1, 4096);
+}
+Obj* grow() {
+
+
+
+  return new Obj();
+}
+void* seg(size_t n) {
+
+
+
+  return mmap(nullptr, n, 0, 0, -1, 0);
+}
+""")
+    rules = [f.rule for f in findings]
+    assert rules.count("resacct") == 2, findings
+
+
+def test_lint_resacct_nearby_macro_pairs(tmp_path):
+    # accounting within 3 lines before / 6 after (room for the
+    # idiomatic error-check block) pairs the allocation
+    findings = _lint_one(tmp_path, "res3.cpp", """
+#include <sys/mman.h>
+#include <cstdlib>
+void seam(size_t n) {
+  void* mem = mmap(nullptr, n, 0, 0, -1, 0);
+  if (mem == (void*)-1) {
+    return;
+  }
+  NAT_RES_ALLOC(2, n, mem);
+}
+void rel(void* p, size_t n) {
+  NAT_RES_FREE(2, n, p);
+  free(p);
+}
+""")
+    assert [f for f in findings if f.rule == "resacct"] == [], findings
+
+
+def test_lint_resacct_allow_escape(tmp_path):
+    findings = _lint_one(tmp_path, "res4.cpp", """
+#include <cstdlib>
+void seam() {
+  void* a = malloc(64);
+  NAT_RES_ALLOC(0, 64, a);
+}
+char* ffi_out() {
+
+
+
+  // natcheck:allow(resacct): FFI buffer, freed by the caller
+  return (char*)malloc(32);
+}
+""")
+    assert [f for f in findings if f.rule == "resacct"] == [], findings
+
+
+def test_lint_resacct_leak_declaration_escapes(tmp_path):
+    # a declared deliberate leak (the refown leak registry) is reviewed
+    # surface — including when the `new` sits on a continuation line
+    findings = _lint_one(tmp_path, "res5.cpp", """
+#include <map>
+void seam() {
+  NAT_RES_STATIC(0, 64);
+}
+// natcheck:leak(g_tbl): detached threads may record through exit()
+std::map<int, int>& g_tbl =
+    *new std::map<int, int>();
+""")
+    assert [f for f in findings if f.rule == "resacct"] == [], findings
+
+
+def test_lint_resacct_only_in_accounted_tus(tmp_path):
+    # a TU that never touches the macros is not an accounted subsystem:
+    # its raw allocations are out of the rule's jurisdiction
+    findings = _lint_one(tmp_path, "res6.cpp", """
+#include <cstdlib>
+void* plain() {
+  return malloc(64);
+}
+""")
+    assert [f for f in findings if f.rule == "resacct"] == [], findings

@@ -186,6 +186,12 @@ def test_observatory_vars_in_prometheus_exposition(server):
                       "nat_connection_in_bytes",
                       "nat_connection_out_bytes",
                       "nat_connection_unwritten_bytes",
+                      "nat_connection_mem_bytes",
+                      "nat_mem_live_bytes",
+                      "nat_mem_live_objects",
+                      "nat_mem_cum_allocs",
+                      "nat_mem_cum_frees",
+                      "nat_mem_hwm_bytes",
                       "nat_lock_contention_waits",
                       "nat_lock_contention_wait_us",
                       "nat_cluster_backend_selects",

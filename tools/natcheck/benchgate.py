@@ -103,6 +103,12 @@ HEADLINE_LANES: Dict[str, float] = {
     "fanout1000_qps": 0.50,
     "swarm_qps": 0.30,
     "fanout_py_qps": 0.50,
+    # connection-scale drill (ISSUE 14): connections held idle with the
+    # live subset at zero failures — the lane reports 0 when ANY RPC
+    # failed, the storm left connections unanswered, or a transient
+    # subsystem leaked after teardown, so a failing drill trips the
+    # band like a throughput collapse
+    "conn_scale_conns": DEFAULT_TOL,
 }
 
 # Latency CEILING lanes: these regress UPWARD — the gate fails when the
@@ -111,6 +117,12 @@ HEADLINE_LANES: Dict[str, float] = {
 CEILING_LANES: Dict[str, float] = {
     "fanout_p99_us": 0.50,
     "swarm_p99_us": 0.50,
+    # memory-observatory ceilings (ISSUE 14): per-connection accounted
+    # bytes (a regression here is a memory-cost regression even when
+    # qps holds) and the accept-storm recovery time. Both noisy on the
+    # shared container — wide bands; make_baseline takes the MAX.
+    "conn_per_conn_bytes": 0.50,
+    "conn_accept_storm_s": 1.00,
 }
 
 # Hard sublinear-scaling floor: when the host probe shows real parallel

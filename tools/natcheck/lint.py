@@ -26,6 +26,16 @@ sanitizer suppressions entry):
   skips the one-predictable-branch gate and puts a function call (plus a
   per-site op-counter RMW) on the disabled hot path.
 
+- ``resacct``: in a TU that uses the nat_res accounting macros (an
+  "accounted subsystem" of the memory observatory, ISSUE 14), every raw
+  allocation — ``new`` / ``malloc`` / ``calloc`` / ``realloc`` /
+  ``mmap`` — must sit within three lines of a ``NAT_RES_ALLOC`` /
+  ``NAT_RES_STATIC`` call, be a declared deliberate leak
+  (``natcheck:leak``), or carry a ``natcheck:allow(resacct): why``
+  escape. An unaccounted allocation in an accounted subsystem is
+  invisible to /heap/native, the nat_mem_* ledger and the RSS
+  reconciliation — exactly the drift this pass exists to stop.
+
 - ``sigsafe``: a function named ``*_sighandler`` (and every in-file
   function it reaches) is a signal handler body and must stay
   async-signal-safe: no allocation (malloc/new/std:: containers), no
@@ -86,6 +96,45 @@ _SIGSAFE_FORBID = re.compile(
     r"std::(?:string|vector|map|unordered_map|deque|set|function)\b|"
     r"lock_guard|unique_lock|(?:\.|->)\s*lock\s*\(|\bpthread_mutex|"
     r"\bmutex\b|\bdladdr\s*\(|__cxa_demangle|\bfopen\s*\(|\bthrow\b")
+
+
+_RES_MACRO = re.compile(r"\bNAT_RES_(?:ALLOC|FREE|STATIC)\s*\(")
+# raw allocation vocabulary the resacct rule pairs with the ledger:
+# object news (incl. array news), the malloc family, and mmap
+_RAW_ALLOC = re.compile(
+    r"\bnew\s+[A-Za-z_][\w:<>,\s*&]*?[({\[;]|"
+    r"\bmalloc\s*\(|\bcalloc\s*\(|\brealloc\s*\(|\bmmap\s*\(")
+
+
+def _leak_declared(lines, i: int) -> bool:
+    """natcheck:leak(sym) on the statement or its contiguous leading
+    comment block (the static-dtor rule's escape, shared by resacct: a
+    declared deliberate leak is reviewed surface). The `new` of a
+    leaked global often sits on a CONTINUATION line
+    (``Type&\\n    x = *new Type()``), so walk back to the statement
+    start first."""
+    if not (0 <= i < len(lines)):
+        return False
+    # hop to the start of the (possibly multi-line) statement
+    j = i
+    while j > 0 and i - j < 4:
+        prev = lines[j - 1].strip()
+        if prev == "" or prev.startswith("//") or prev.startswith("#") \
+                or prev.endswith((";", "{", "}")):
+            break
+        j -= 1
+    for k in range(j, i + 1):
+        if _LEAK_DECL.search(lines[k]):
+            return True
+    k = j - 1
+    while k >= 0 and j - k <= 8:
+        stripped = lines[k].strip()
+        if not stripped.startswith("//") and not stripped.startswith("#"):
+            break
+        if _LEAK_DECL.search(lines[k]):
+            return True
+        k -= 1
+    return False
 
 
 def _strip_comments_and_strings(line: str) -> str:
@@ -352,6 +401,35 @@ def lint_file(path: str, text: str, nontrivial: set) -> List[Finding]:
                 "direct nat_fault_hit() call — fault hooks must go "
                 "through NAT_FAULT_POINT so the disabled hot path costs "
                 "one predictable branch (no call, no op-counter RMW)"))
+
+    # ---- resacct ----------------------------------------------------------
+    # accounted TU: it calls the nat_res macros itself (self-selecting —
+    # adopting the first NAT_RES_* in a file turns the rule on for that
+    # whole file). nat_res.h only DEFINES the macros and is exempt.
+    if os.path.basename(path) != "nat_res.h" and \
+            _RES_MACRO.search(scrubbed):
+        slines = scrubbed.splitlines()
+        for m in _RAW_ALLOC.finditer(scrubbed):
+            i = scrubbed.count("\n", 0, m.start())
+            # a NAT_RES_ALLOC/FREE/STATIC within 3 lines before or 6
+            # after pairs the allocation with its ledger entry (the
+            # asymmetry leaves room for the idiomatic error-check block
+            # between a syscall/malloc and its accounting)
+            lo, hi = max(0, i - 3), min(len(slines), i + 7)
+            if any(_RES_MACRO.search(slines[j]) for j in range(lo, hi)):
+                continue
+            if _allowed(lines, i, "resacct"):
+                continue
+            # a declared deliberate leak (the refown leak registry) is
+            # reviewed surface: same escape contract as static-dtor
+            if _leak_declared(lines, i):
+                continue
+            findings.append(Finding(
+                "lint", "resacct", f"{rel}:{i + 1}",
+                f"raw allocation {m.group(0).strip()!r} in an accounted "
+                f"subsystem TU without a NAT_RES_* accounting call "
+                f"nearby — route it through the nat_res ledger or "
+                f"escape with natcheck:allow(resacct): why"))
 
     # ---- sigsafe ----------------------------------------------------------
     # *_sighandler bodies (and the in-file functions they reach) must stay
