@@ -1521,6 +1521,99 @@ def device_lane_bench() -> dict:
     # still RECOVERING (improving >15% every 2s), bounded at 45s.
     _loopback_stabilize()
 
+    # two-process shm push: full RPC + descriptor-ring fabric path
+    # (ISSUE 15: payload written ONCE into the server's blob arena as
+    # kind-8 records, consumed in place as zero-copy lease-backed
+    # arrays — no payload bytes on the wire, no staging copy on either
+    # side). Runs FIRST: the client must not own a fabric segment of its
+    # own (the shm_desc lane below creates one in this process), and the
+    # tunnel-DMA lanes must not depress it.
+    try:
+        import os
+        import subprocess
+        import sys
+
+        from brpc_tpu.rpc import device_transport as dt
+        from brpc_tpu.rpc.tensor_service import (TensorClient,
+                                                 make_device_channel)
+
+        # the receiving server rides the NATIVE runtime: descriptor RPCs
+        # parse in the C++ loop, usercode (lease consume) on the py lane
+        script = (
+            "import os, sys; sys.path.insert(0, '.')\n"
+            "os.environ.setdefault('BRPC_TPU_FABRIC_ARENA',"
+            " str(128 << 20))\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from brpc_tpu import rpc, native\n"
+            "from brpc_tpu.rpc.tensor_service import TensorStoreService\n"
+            "use_nat = native.available()\n"
+            "srv = rpc.Server(rpc.ServerOptions(num_threads=2,\n"
+            "                 use_native_runtime=use_nat))\n"
+            "srv.add_service(TensorStoreService())\n"
+            "assert srv.start('127.0.0.1:0') == 0\n"
+            "print(srv.listen_endpoint.port, flush=True)\n"
+            "sys.stdin.readline()\n")
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        proc = subprocess.Popen([sys.executable, "-c", script],
+                                stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE, text=True,
+                                cwd=repo_root)
+        try:
+            port = int(proc.stdout.readline())
+            ch = make_device_channel(f"127.0.0.1:{port}")
+            client = TensorClient(ch)
+            arr = np.random.randint(0, 255, 8 << 20,
+                                    dtype=np.uint8)
+            # ONE name throughout: the fabric's blob arena is a RING, so
+            # the store must keep replacing (= releasing) its zero-copy
+            # lease-backed entries — a store retaining every name would
+            # head-block arena reclaim (leases release out of order, but
+            # the head only advances past released spans)
+            client.push("serial", [arr])  # handshake + warm
+            rounds = 8
+            t0 = time.perf_counter()
+            for i in range(rounds):
+                cntl, resp = client.push("serial", [arr])
+                assert not cntl.failed(), cntl.error_text
+            dt_s = time.perf_counter() - t0
+            out["shm_push_serial_GBps"] = round(
+                arr.nbytes * rounds / dt_s / 1e9, 3)
+            # concurrent pushes — the rdma_performance measurement shape
+            # (client.cpp:136-183 runs many streams at once): arena
+            # write, descriptor RPC and lease consume of different
+            # pushes overlap, which is what the send window exists for
+            import threading as _threading
+
+            K, per = 3, 6
+            errs = []
+
+            def _pusher(tid):
+                for i in range(per):
+                    c, _ = client.push("serial", [arr])
+                    if c.failed():
+                        errs.append(c.error_text)
+
+            t0 = time.perf_counter()
+            ts = [_threading.Thread(target=_pusher, args=(t,))
+                  for t in range(K)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt_s = time.perf_counter() - t0
+            assert not errs, errs
+            out["shm_push_GBps"] = round(
+                arr.nbytes * per * K / dt_s / 1e9, 3)
+            out["shm_push_lane"] = (
+                "ring" if dt.lane_counters()["ring"] > 0 else "shm")
+            ch.close()
+        finally:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+    except Exception:
+        pass
+
     # zero-copy descriptor-ring lane (nat_shm_lane.cpp): two-process push
     # through the lock-free descriptor rings + blob arena — the native
     # transport the shm usercode lane and bulk-tensor staging ride
@@ -1565,82 +1658,96 @@ def device_lane_bench() -> dict:
     except Exception:
         pass
 
-    # two-process shm push: full RPC + arena descriptor path. Runs
-    # FIRST among the tunnel-DMA lanes so h2d/d2h can't depress it.
+    # per-hop breakdown of the fabric path (ISSUE 15 satellite): where a
+    # regression in the zero-copy pipeline lives — arena write (the ONE
+    # producer memcpy), ring latency (push -> take), consume (zero-copy
+    # lease -> np view), device_put (put_via_pool from the arena view).
+    # In-process: the hops are the same code the two-process lanes run.
     try:
-        import os
-        import subprocess
-        import sys
+        from brpc_tpu import native
 
+        if native.available():
+            lib = native.load()
+            lib.nat_shm_lane_enable(0)
+            if lib.nat_shm_lane_create(32 << 20) == 0 and \
+                    lib.nat_shm_producer_attach(
+                        lib.nat_shm_lane_name()) >= 0:
+                src = np.random.randint(0, 255, 1 << 20, dtype=np.uint8)
+                hops = {"arena_write_us": [], "ring_us": [],
+                        "consume_us": [], "device_put_us": []}
+                from brpc_tpu.rpc.device_transport import \
+                    default_block_pool
+
+                pool = default_block_pool()
+                for i in range(20):
+                    t0 = time.perf_counter()
+                    rc = native.fabric_push(src, i)
+                    t1 = time.perf_counter()
+                    if rc != 0:
+                        continue
+                    lease = native.fabric_take(2000)
+                    t2 = time.perf_counter()
+                    if lease is None:
+                        continue
+                    view = np.frombuffer(lease.view(), dtype=np.uint8)
+                    t3 = time.perf_counter()
+                    arr = pool.put_via_pool(view, np.uint8,
+                                            (view.size,))
+                    t4 = time.perf_counter()
+                    del arr
+                    lease.release()
+                    hops["arena_write_us"].append((t1 - t0) * 1e6)
+                    hops["ring_us"].append((t2 - t1) * 1e6)
+                    hops["consume_us"].append((t3 - t2) * 1e6)
+                    hops["device_put_us"].append((t4 - t3) * 1e6)
+                if hops["arena_write_us"]:
+                    import statistics
+
+                    out["hops"] = {
+                        k: round(statistics.median(v), 1)
+                        for k, v in hops.items() if v}
+            lib.nat_shm_lane_enable(0)
+    except Exception:
+        pass
+
+    # read-arena grow prefault (drive-by satellite): the growable
+    # read-side allocator seam (install_read_arena) must not
+    # reintroduce the first-touch fault cliff on grow (the r05
+    # 0.085->1.0 GB/s class) — a GROWN arena's first block writes must
+    # run within a small factor of warm writes (every arena prefaults
+    # at creation). Contract: a cliff reports 0 so the gate trips.
+    try:
         from brpc_tpu.rpc import device_transport as dt
-        from brpc_tpu.rpc.tensor_service import (TensorClient,
-                                                 make_device_channel)
 
-        # the receiving server rides the NATIVE runtime: descriptor RPCs
-        # parse in the C++ loop, usercode (arena copy-out) on the py lane
-        script = (
-            "import sys; sys.path.insert(0, '.')\n"
-            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
-            "from brpc_tpu import rpc, native\n"
-            "from brpc_tpu.rpc.tensor_service import TensorStoreService\n"
-            "use_nat = native.available()\n"
-            "srv = rpc.Server(rpc.ServerOptions(num_threads=2,\n"
-            "                 use_native_runtime=use_nat))\n"
-            "srv.add_service(TensorStoreService())\n"
-            "assert srv.start('127.0.0.1:0') == 0\n"
-            "print(srv.listen_endpoint.port, flush=True)\n"
-            "sys.stdin.readline()\n")
-        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
-            __file__)))
-        proc = subprocess.Popen([sys.executable, "-c", script],
-                                stdin=subprocess.PIPE,
-                                stdout=subprocess.PIPE, text=True,
-                                cwd=repo_root)
+        chain = dt.ReadArenaChain(size=4 << 20, capacity=1 << 20)
         try:
-            port = int(proc.stdout.readline())
-            ch = make_device_channel(f"127.0.0.1:{port}")
-            client = TensorClient(ch)
-            arr = np.random.randint(0, 255, 8 << 20,
-                                    dtype=np.uint8)
-            client.push("warm", [arr])  # handshake + warm
-            rounds = 8
-            t0 = time.perf_counter()
-            for i in range(rounds):
-                cntl, resp = client.push(f"b{i}", [arr])
-                assert not cntl.failed(), cntl.error_text
-            dt_s = time.perf_counter() - t0
-            out["shm_push_serial_GBps"] = round(
-                arr.nbytes * rounds / dt_s / 1e9, 3)
-            # concurrent pushes — the rdma_performance measurement shape
-            # (client.cpp:136-183 runs many streams at once): stage-in,
-            # descriptor RPC and copy-out of different pushes overlap,
-            # which is what the endpoint's send window exists for
-            import threading as _threading
+            pinned = []  # hold the blocks: a dropped block's finalizer
+            while True:  # would free its span and un-exhaust the arena
+                b = chain.arenas[0].make_block(1 << 20)
+                if b is None:
+                    break
+                pinned.append(b)
+            grows0 = chain.grows
+            blk = chain.alloc_block()  # forces a prefaulted grow
+            assert blk is not None and chain.grows == grows0 + 1
+            src = np.random.randint(0, 255, 1 << 20, dtype=np.uint8)
 
-            K, per = 3, 6
-            errs = []
+            def _write_bw(rounds):
+                t0 = time.perf_counter()
+                for _ in range(rounds):
+                    np.frombuffer(blk.data, dtype=np.uint8)[:] = src
+                return (1 << 20) * rounds / (
+                    time.perf_counter() - t0) / 1e9
 
-            def _pusher(tid):
-                for i in range(per):
-                    c, _ = client.push(f"t{tid}b{i}", [arr])
-                    if c.failed():
-                        errs.append(c.error_text)
-
-            t0 = time.perf_counter()
-            ts = [_threading.Thread(target=_pusher, args=(t,))
-                  for t in range(K)]
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join()
-            dt_s = time.perf_counter() - t0
-            assert not errs, errs
-            out["shm_push_GBps"] = round(
-                arr.nbytes * per * K / dt_s / 1e9, 3)
-            ch.close()
+            first = _write_bw(1)   # includes any residual fault cost
+            warm = _write_bw(8)
+            gbps = round(first, 3)
+            if first < warm / 6:   # the r05 cliff was ~12x
+                gbps = 0.0
+            out["read_arena_grow_GBps"] = gbps
+            out["read_arena_warm_GBps"] = round(warm, 3)
         finally:
-            proc.stdin.close()
-            proc.wait(timeout=10)
+            chain.close()
     except Exception:
         pass
 

@@ -457,6 +457,14 @@ def load() -> ctypes.CDLL:
         lib.nat_shm_push_tensor.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
         lib.nat_shm_push_tensor.restype = ctypes.c_int
+        # -- tensor fabric (producer slots + receiver leases, ISSUE 15) --
+        lib.nat_shm_producer_attach.argtypes = [ctypes.c_char_p]
+        lib.nat_shm_producer_attach.restype = ctypes.c_int
+        lib.nat_shm_fabric_push.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
+        lib.nat_shm_fabric_push.restype = ctypes.c_int
+        lib.nat_shm_fabric_take.argtypes = [ctypes.c_int]
+        lib.nat_shm_fabric_take.restype = ctypes.c_void_p
         lib.nat_shm_push_bench.argtypes = [
             ctypes.c_size_t, ctypes.c_double,
             ctypes.POINTER(ctypes.c_uint64)]
@@ -1271,6 +1279,97 @@ def shm_worker_drain_bench(idle_exit_ms: int = 1000) -> int:
     arena spans in place until the lane shuts down or `idle_exit_ms`
     passes with no data. Returns the number of records drained."""
     return load().nat_shm_worker_drain_bench(idle_exit_ms)
+
+
+# -- tensor fabric: producer slots + receiver leases (ISSUE 15) -------------
+
+class FabricLease:
+    """One kind-8 tensor record leased from the descriptor-ring fabric.
+
+    ``view()`` is a ZERO-COPY memoryview straight into the producer's
+    shared blob arena: the span stays pinned (and accounted in the
+    ``shm.span`` nat_res ledger row) until ``release()``, which may run
+    OUT OF ORDER relative to other leases — the arena's released-bit +
+    lazy reclaim is built for exactly that. Views must not be read after
+    release (the producer reclaims the bytes). Dropping the last
+    reference releases the lease too."""
+
+    __slots__ = ("_h", "tag", "trace_id", "parent_span_id", "nbytes",
+                 "_ptr", "__weakref__")
+
+    def __init__(self, h: int):
+        lib = load()
+        self._h = h
+        self.tag = lib.nat_req_aux(h)
+        self.trace_id = lib.nat_req_sock_id(h)
+        self.parent_span_id = lib.nat_req_cid(h) & ((1 << 63) - 1)
+        n = ctypes.c_size_t(0)
+        self._ptr = lib.nat_req_field(h, 2, ctypes.byref(n))
+        self.nbytes = n.value
+
+    def view(self) -> memoryview:
+        if self._h is None:
+            raise ValueError("fabric lease already released")
+        if self.nbytes == 0 or not self._ptr:
+            return memoryview(b"")
+        return memoryview(
+            (ctypes.c_char * self.nbytes).from_address(self._ptr))
+
+    def tobytes(self) -> bytes:
+        return bytes(self.view()) if self.nbytes else b""
+
+    def release(self):
+        h, self._h = self._h, None
+        if h:
+            load().nat_req_free(h)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+def fabric_producer_attach(name) -> int:
+    """Claim a PRODUCER slot on a peer's fabric segment (by shm name).
+    This process becomes the sole producer of that slot's ring; a crash
+    here surfaces as EOWNERDEAD on the receiver's recovery probe.
+    Returns the slot index (>= 0) or -1."""
+    if isinstance(name, str):
+        name = name.encode()
+    return load().nat_shm_producer_attach(name)
+
+
+def fabric_push(data, tag: int) -> int:
+    """Stage `data` ONCE into the attached fabric's shared blob arena and
+    publish one kind-8 descriptor (the producer write of the zero-copy
+    path). numpy arrays push straight from their buffer (no bytes()
+    staging copy). Returns 0, or -1 on backpressure (ring/arena full)."""
+    lib = load()
+    try:
+        import numpy as np
+
+        if isinstance(data, np.ndarray):
+            a = np.ascontiguousarray(data)
+            ptr = ctypes.cast(ctypes.c_void_p(a.ctypes.data),
+                              ctypes.c_char_p)
+            return lib.nat_shm_fabric_push(ptr, a.nbytes, tag)
+    except ImportError:
+        pass
+    if isinstance(data, memoryview):
+        data = bytes(data)
+    elif not isinstance(data, (bytes, bytearray)):
+        data = bytes(data)
+    return lib.nat_shm_fabric_push(bytes(data) if isinstance(
+        data, bytearray) else data, len(data), tag)
+
+
+def fabric_take(timeout_ms: int = 200):
+    """Receiver side: take one pushed tensor record from any producer
+    slot as a FabricLease (zero-copy arena view, out-of-order release),
+    or None on timeout/shutdown."""
+    h = load().nat_shm_fabric_take(timeout_ms)
+    return FabricLease(h) if h else None
 
 
 # -- native observability (nat_stats.cpp) -----------------------------------

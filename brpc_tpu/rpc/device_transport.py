@@ -76,15 +76,22 @@ def _host_boot_id() -> str:
 _boot_id = _host_boot_id()
 
 
-def local_device_info() -> dict:
+def local_device_info(arm_fabric: bool = False) -> dict:
     """Discovery: platform + device ids (GID/LID discovery analog). The
     send arena's name rides along like the GID/QPN credentials so the peer
-    can map our registered memory."""
+    can map our registered memory. With arm_fabric=True (the SERVER half
+    of the handshake) the descriptor-ring tensor fabric is armed and its
+    segment name advertised, so same-host peers can attach as producers
+    and push payloads with zero bytes on the wire (the ring lane)."""
     arena = default_send_arena()
     info = {
         "process": _process_uuid,
         "host": _boot_id,
         "arena": arena.name if arena is not None else "",
+        # descriptor-ring fabric inbox (ISSUE 15): advertised only when
+        # the receiver drain is actually running — a peer that sees a
+        # name will push kind-8 payloads with nothing on the wire
+        "fabric": _fabric_arm_receiver() if arm_fabric else "",
         # advertised ONLY when the server actually started: a peer that
         # sees True may publish xfer-lane payloads with nothing on the
         # wire, so import success alone is not proof enough
@@ -461,6 +468,153 @@ _dev_zero_copy = bvar.Adder("device_transport_zero_copy_transfers")
 _dev_shm = bvar.Adder("device_transport_shm_transfers")
 _dev_wire = bvar.Adder("device_transport_wire_transfers")
 _dev_xfer = bvar.Adder("device_transport_xfer_transfers")
+_dev_ring = bvar.Adder("device_transport_ring_transfers")
+
+
+# -- descriptor-ring tensor fabric (the ring lane, ISSUE 15) ----------------
+#
+# The same-host cross-process lane re-plumbed onto the PR-3 descriptor
+# ring (nat_shm_lane.cpp): the RECEIVER owns a shm segment whose slots
+# peers claim as PRODUCERS; a send writes its payload ONCE into the
+# shared blob arena (nat_shm_fabric_push, kind-8 descriptor) and the
+# receiver's drain thread takes it as a LEASE consumed in place —
+# producer-write -> arena -> jax.device_put/put_via_pool with no
+# intermediate memcpy, and no payload bytes on the TCP wire. Leases
+# release OUT OF ORDER (the arena's released-bit discipline), and a
+# producer SIGKILL surfaces as EOWNERDEAD on the receiver's recovery
+# probe (the robust lifetime fence the worker lane already proves).
+
+_fabric_lock = threading.Lock()
+_fabric_name: Optional[str] = None
+_fabric_thread: Optional[threading.Thread] = None
+_fabric_stop = threading.Event()
+_fabric_cv = threading.Condition()
+_fabric_records: Dict[int, object] = {}   # tag -> (FabricLease, deadline)
+_fabric_sink = None                       # optional delivery override
+_producer_target: Optional[str] = None    # segment we attached to
+_FABRIC_RECORD_TTL_S = 30.0
+
+
+def fabric_set_sink(fn):
+    """Override the tag-registry delivery: every kind-8 record taken by
+    the receiver drain goes to fn(lease) instead (the lease is OWNED by
+    the sink — it must release, possibly out of order). Pass None to
+    restore the registry."""
+    global _fabric_sink
+    _fabric_sink = fn
+
+
+def _fabric_arm_receiver() -> str:
+    """Create (or adopt) this process's fabric segment and start the
+    receiver drain thread. Returns the segment name, or '' when the
+    native runtime is unavailable / disabled (BRPC_TPU_FABRIC=0)."""
+    global _fabric_name, _fabric_thread
+    import os
+
+    if os.environ.get("BRPC_TPU_FABRIC", "1") == "0":
+        return ""
+    try:
+        from brpc_tpu import native
+
+        if not native.available():
+            return ""
+        lib = native.load()
+    except Exception:
+        return ""
+    with _fabric_lock:
+        if _fabric_thread is not None and _fabric_thread.is_alive():
+            name = lib.nat_shm_lane_name() or b""
+            return name.decode() or (_fabric_name or "")
+        size = int(os.environ.get("BRPC_TPU_FABRIC_ARENA",
+                                  str(32 << 20)))
+        if lib.nat_shm_lane_create(size) != 0:
+            return ""
+        _fabric_name = lib.nat_shm_lane_name().decode()
+        _fabric_stop.clear()
+        t = threading.Thread(target=_fabric_drain_loop, daemon=True,
+                             name="tensor-fabric-drain")
+        _fabric_thread = t
+        t.start()
+        return _fabric_name
+
+
+def _fabric_drain_loop():
+    from brpc_tpu import native
+
+    import time
+
+    while not _fabric_stop.is_set():
+        try:
+            lease = native.fabric_take(200)
+        except Exception:
+            return
+        now = time.monotonic()
+        with _fabric_cv:
+            # purge abandoned records (a sender whose RPC failed after
+            # the push): their leases must not pin the arena forever.
+            # Runs on EVERY wakeup incl. empty timeouts — a pinned-full
+            # arena stops new records from arriving, so an
+            # arrival-gated purge could never free it.
+            stale = [t for t, (_, dl) in _fabric_records.items()
+                     if dl <= now]
+            for t in stale:
+                _fabric_records.pop(t)[0].release()
+        if lease is None:
+            continue
+        sink = _fabric_sink
+        if sink is not None:
+            try:
+                sink(lease)
+            except Exception:
+                lease.release()
+            continue
+        with _fabric_cv:
+            _fabric_records[lease.tag] = (lease,
+                                          now + _FABRIC_RECORD_TTL_S)
+            _fabric_cv.notify_all()
+
+
+def _fabric_claim(tag: int, timeout_s: float = 10.0):
+    """Receiver side: wait for the drain thread to deliver tag's lease."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    with _fabric_cv:
+        while True:
+            entry = _fabric_records.pop(tag, None)
+            if entry is not None:
+                return entry[0]
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return None
+            _fabric_cv.wait(remain)
+
+
+def _fabric_attach_producer(name: str) -> bool:
+    """Attach this process as a PRODUCER on the peer segment `name`.
+    The native mapping is process-wide, so only one target segment per
+    process: a process that owns its own segment (it is a receiver /
+    shm-worker parent) or already attached elsewhere falls back to the
+    shm-arena lane for other peers."""
+    global _producer_target
+    try:
+        from brpc_tpu import native
+
+        if not native.available():
+            return False
+        lib = native.load()
+    except Exception:
+        return False
+    with _fabric_lock:
+        if _producer_target is not None:
+            return _producer_target == name
+        own = (lib.nat_shm_lane_name() or b"").decode()
+        if own and own != name:
+            return False  # this process's mapping belongs to its own seg
+        if lib.nat_shm_producer_attach(name.encode()) < 0:
+            return False
+        _producer_target = name
+        return True
 
 from brpc_tpu.butil import flags as _flags  # noqa: E402
 
@@ -474,8 +628,10 @@ _flags.define_bool(
 
 def lane_counters() -> dict:
     """Public per-lane transfer counts (also exposed as bvars under
-    device_transport_*): {'inproc': N, 'shm': N, 'wire': N, 'xfer': N}."""
+    device_transport_*): {'inproc': N, 'ring': N, 'shm': N, 'wire': N,
+    'xfer': N}."""
     return {"inproc": _dev_zero_copy.get_value(),
+            "ring": _dev_ring.get_value(),
             "shm": _dev_shm.get_value(),
             "wire": _dev_wire.get_value(),
             "xfer": _dev_xfer.get_value()}
@@ -740,6 +896,17 @@ class DeviceEndpoint:
                 f"xfer|{self._my_xfer_addr}|{uid}|{seq}")
             _dev_xfer.update(1)
             release = (lambda: None)
+        elif (self.state == ESTABLISHED and self.same_host
+              and self.peer_info.get("fabric")
+              and self._ring_lane_send(arrays, meta, seq)):
+            # descriptor-ring fabric: payload written ONCE into the
+            # receiver's blob arena (kind-8 records), consumed in place
+            # on the far side — zero payload bytes on the wire, zero
+            # intermediate memcpy. The receiver owns the spans (leases),
+            # so there is nothing to free on ACK; the window retention
+            # still bounds in-flight bytes.
+            _dev_ring.update(1)
+            release = (lambda: None)
         elif self.state == ESTABLISHED and self.same_host:
             arena = default_send_arena()
             offset = arena.alloc(total) if arena is not None else None
@@ -768,6 +935,47 @@ class DeviceEndpoint:
                 attachment.append(np.asarray(a).tobytes())
             _dev_wire.update(1)
         return release
+
+    def _ring_lane_send(self, arrays, meta, seq) -> bool:
+        """Push every tensor's bytes as one kind-8 fabric record each
+        (tags base..base+n-1) onto the peer's descriptor ring; the spec
+        `ring:<base>:<n>:<seq>` rides the RPC in place of any payload.
+        False -> the caller falls through to the shm-arena/wire lanes."""
+        name = self.peer_info.get("fabric") or ""
+        if not name or not _fabric_attach_producer(name):
+            return False
+        if len(arrays) > 256:
+            # the per-seq tag stride is 256 (base = uuid + (seq << 8)):
+            # more tensors would collide with the next seq's tags and
+            # the receiver could claim the wrong record — fall back
+            return False
+        import time
+
+        import numpy as np
+
+        from brpc_tpu import native
+
+        base = (self._xfer_uuid_base + (seq << 8)) & ((1 << 62) - 1)
+        pushed = 0
+        for i, a in enumerate(arrays):
+            host = np.ascontiguousarray(np.asarray(a))
+            flat = host.reshape(-1).view(np.uint8)
+            # Bounded backoff only: the blob arena is a RING — a receiver
+            # retaining leases indefinitely head-blocks reclaim, and the
+            # right response is falling back to the shm-arena lane, not
+            # stalling the send path (size the fabric to the consumer's
+            # retention with BRPC_TPU_FABRIC_ARENA).
+            deadline = time.monotonic() + 0.25
+            while native.fabric_push(flat, base + i) != 0:
+                if time.monotonic() >= deadline:
+                    # stranded records (tags base..base+pushed-1) are
+                    # purged by the receiver's registry TTL
+                    return False
+                time.sleep(0.0005)
+            pushed += 1
+        meta.tensors[0].sharding_spec = (
+            f"ring:{base}:{len(arrays)}:{seq}")
+        return True
 
     def on_ack(self, seq: int):
         """Peer confirmed receipt: run the lane's release action (free the
@@ -816,6 +1024,91 @@ def _np_dtype(name: str):
         return np.dtype(getattr(ml_dtypes, name))
 
 
+def _bind_lease(arr, lease):
+    """Tie a fabric lease's lifetime to the zero-copy array carved from
+    it: the span releases (out of order, whenever) when the array dies.
+    The finalizer itself holds the lease reference, so the arena bytes
+    stay valid for exactly as long as the array is reachable."""
+    import weakref
+
+    weakref.finalize(arr, lease.release)
+
+
+# -- read-side arena seam (the all-IOBuf-memory-registered config) ----------
+#
+# The reference points IOBuf's blockmem_allocate at its registered pool
+# so EVERY buffer a socket drains into is transfer-ready (SURVEY 2.9).
+# install_read_arena is that configuration for the Python stack: socket
+# reads land in prefaulted HostArena blocks, growing by whole prefaulted
+# arenas on exhaustion — the grow path must never reintroduce the
+# first-touch fault cliff (BENCH_r05's 0.085 GB/s staging artifact), so
+# every grown arena prefaults at creation exactly like the first.
+
+_read_chain = None
+_read_chain_lock = threading.Lock()
+
+
+class ReadArenaChain:
+    """A growable chain of prefaulted HostArenas serving IOBuf blocks."""
+
+    MAX_ARENAS = 8
+
+    def __init__(self, size: int = 32 << 20, capacity: int = 256 << 10):
+        self.size = size
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self.arenas = [HostArena(size=size)]
+        self.grows = 0
+
+    def alloc_block(self):
+        with self._lock:
+            arenas = list(self.arenas)
+        for arena in reversed(arenas):
+            b = arena.make_block(self.capacity)
+            if b is not None:
+                return b
+        with self._lock:
+            if len(self.arenas) >= self.MAX_ARENAS:
+                return None  # plain host blocks take over
+            try:
+                arena = HostArena(size=self.size)  # prefaulted at create
+            except OSError:
+                return None
+            self.arenas.append(arena)
+            self.grows += 1
+        return arena.make_block(self.capacity)
+
+    def close(self):
+        for arena in self.arenas:
+            arena.close()
+
+
+def install_read_arena(size: int = 32 << 20,
+                       capacity: int = 256 << 10) -> ReadArenaChain:
+    """Install a prefaulted, growable arena chain as the IOBuf block
+    factory (HostArena.install_as_iobuf_allocator generalized with a
+    grow path). Returns the chain; uninstall_read_arena undoes it."""
+    global _read_chain
+    from brpc_tpu.butil import iobuf as iobuf_mod
+
+    with _read_chain_lock:
+        if _read_chain is None:
+            _read_chain = ReadArenaChain(size=size, capacity=capacity)
+        iobuf_mod.set_block_allocator(_read_chain.alloc_block)
+    return _read_chain
+
+
+def uninstall_read_arena():
+    global _read_chain
+    from brpc_tpu.butil import iobuf as iobuf_mod
+
+    with _read_chain_lock:
+        iobuf_mod.set_block_allocator(None)
+        chain, _read_chain = _read_chain, None
+    if chain is not None:
+        chain.close()
+
+
 def receive_tensors(meta, attachment: IOBuf, device=None) -> Tuple[List, Optional[int]]:
     """Reconstruct arrays from a tensor-bearing message. Returns
     (arrays, ack_seq). Zero-copy when the sender published in-process;
@@ -848,6 +1141,59 @@ def receive_tensors(meta, attachment: IOBuf, device=None) -> Tuple[List, Optiona
     seq = None
     if len(parts) >= 3 and parts[-1].isdigit():
         seq = int(parts[-1])
+    if parts[0] == "ring" and len(parts) == 4:
+        # descriptor-ring fabric: the payload arrived as kind-8 records
+        # in OUR blob arena (the sender wrote it there once); consume the
+        # leases IN PLACE — put_via_pool DMAs straight from the arena
+        # view, and host-side consumers get zero-copy arrays that release
+        # the lease when they die (out-of-order, past this drain).
+        import numpy as np
+
+        base, count = int(parts[1]), int(parts[2])
+        if count != len(meta.tensors):
+            raise ValueError("device transport: ring record count "
+                             f"{count} != {len(meta.tensors)} tensors")
+        leases = []
+        for i in range(count):
+            lease = _fabric_claim(base + i)
+            if lease is None:
+                for l in leases:
+                    l.release()
+                raise ValueError(
+                    f"device transport: ring record {base + i} never "
+                    f"arrived (fabric receiver not draining?)")
+            leases.append(lease)
+        arrays = []
+        try:
+            for t, lease in zip(meta.tensors, leases):
+                dtype = _np_dtype(t.dtype)
+                mv = lease.view()
+                if device is not None:
+                    arr = default_block_pool().put_via_pool(
+                        np.frombuffer(mv, dtype=np.uint8), dtype,
+                        tuple(t.shape), device)
+                else:
+                    # zero-copy: the array IS the arena span; the lease
+                    # releases when the last view of it is collected.
+                    # Bind the finalizer to the BASE frombuffer array:
+                    # numpy collapses .base chains to it, so any derived
+                    # view (slices of the reshaped array) keeps it — and
+                    # therefore the lease — alive; binding to the
+                    # reshape wrapper would let a slice outlive the span.
+                    flat = np.frombuffer(mv, dtype=dtype)
+                    _bind_lease(flat, lease)
+                    arr = flat.reshape(tuple(t.shape))
+                arrays.append(arr)
+        finally:
+            if device is not None:
+                import jax
+
+                # the async H2D copies must finish before the spans are
+                # handed back to the producer's reclaim
+                jax.block_until_ready(arrays)
+                for lease in leases:
+                    lease.release()
+        return arrays, seq
     if parts[0] == "inproc" and parts[1].isdigit():
         arrays = inproc_claim(int(parts[1]))
         if arrays is None:

@@ -29,6 +29,53 @@ import ctypes
 import importlib
 import sys
 
+from brpc_tpu import bvar
+
+# -- kind-8 tensor sink (ISSUE 15) ------------------------------------------
+#
+# Bulk tensor records (nat_shm_push_tensor / the device-lane fabric) used
+# to hit a dead end here: no usercode hook, span silently released. A
+# worker-side consumer registers a sink — called with a FabricLease whose
+# view() reads the record's arena span IN PLACE; the sink OWNS the lease
+# and may hold it past further takes, releasing out of order (e.g. after
+# a jax.device_put completes). Unregistered records are counted, never
+# silently dropped.
+
+_tensor_sink = None
+_sink_drops = bvar.Adder("shm_tensor_sink_unregistered_drops")
+
+
+def set_tensor_sink(fn):
+    """Register fn(lease) as this worker's bulk-tensor consumer (call it
+    from the service factory — the factory runs in the worker process).
+    The sink owns the lease: it must release() it, possibly out of
+    order. Pass None to unregister."""
+    global _tensor_sink
+    _tensor_sink = fn
+
+
+def tensor_sink_drops() -> int:
+    """Records dropped because no sink was registered (observability —
+    also exported as the shm_tensor_sink_unregistered_drops bvar)."""
+    return _sink_drops.get_value()
+
+
+def dispatch_tensor_record(native_mod, h) -> bool:
+    """Route one kind-8 handle to the registered sink as a lease.
+    Returns True when a sink consumed it (and now owns the span)."""
+    lease = native_mod.FabricLease(h)
+    sink = _tensor_sink
+    if sink is None:
+        _sink_drops.update(1)
+        lease.release()
+        return False
+    try:
+        sink(lease)
+        return True
+    except Exception:
+        lease.release()  # idempotent: a sink that released already is fine
+        return False
+
 
 def main(shm_name: str, factory_spec: str) -> int:
     from brpc_tpu import native, rpc
@@ -87,9 +134,10 @@ def main(shm_name: str, factory_spec: str) -> int:
             continue
         kind = lib.nat_req_kind(h)
         if kind == 8:
-            # bulk tensor record (nat_shm_push_tensor): no usercode hook
-            # registered in the default worker — release the span
-            lib.nat_req_free(h)
+            # bulk tensor record: deliver to the registered tensor sink
+            # as an out-of-order-releasable lease (unregistered sinks
+            # count the drop instead of losing it silently)
+            dispatch_tensor_record(native, h)
             continue
         sock_id = lib.nat_req_sock_id(h)
         seq = lib.nat_req_cid(h)

@@ -72,11 +72,14 @@ def _parse_handshake(portal: IOBuf, sock, read_eof: bool, arg) -> ParseResult:
 
 def _process_handshake(msg: _HandshakeMsg):
     """Server half of the device handshake: answer with our identity and
-    attach an ESTABLISHED/FALLBACK endpoint to the connection."""
+    attach an ESTABLISHED/FALLBACK endpoint to the connection. The
+    server arms the descriptor-ring tensor fabric and advertises its
+    segment name, so same-host clients push payloads straight into our
+    blob arena (the ring lane) with zero bytes on the wire."""
     sock = msg.socket
     ep = DeviceEndpoint()
     ep.peer_info = msg.info
-    mine = local_device_info()
+    mine = local_device_info(arm_fabric=True)
     from brpc_tpu.rpc import device_transport as dt
 
     if msg.info.get("device_count", 0) > 0 and mine["device_count"] > 0:

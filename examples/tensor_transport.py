@@ -92,6 +92,9 @@ def main():
     xport = int(proc.stdout.readline())
     xch = make_device_channel(f"127.0.0.1:{xport}")
     xclient = TensorClient(xch)
+    from brpc_tpu.rpc import device_transport as _dt
+
+    lanes0 = _dt.lane_counters()
     cntl, _ = xclient.push("xwarm", [arr])
     assert not cntl.failed(), cntl.error_text
     ep = cntl._current_sock.app_state
@@ -100,9 +103,15 @@ def main():
         cntl, _ = xclient.push(f"x{i}", [arr])
         assert not cntl.failed(), cntl.error_text
     dtx = time.perf_counter() - t0
+    lanes1 = _dt.lane_counters()
+    # this process hosted its own in-process server above, so it owns a
+    # fabric segment of its own — the push falls back to the shared
+    # HostArena lane; a pure client process would ride the ring fabric
+    lane = next((k for k in ("ring", "shm", "wire")
+                 if lanes1[k] > lanes0[k]), "?")
     print(f"cross-process pushed {args.iters} x {args.mb}MB in {dtx:.3f}s "
           f"-> {nbytes * args.iters / dtx / 1e9:.2f} GB/s "
-          f"(shared-arena lane, same_host={ep.same_host}, "
+          f"({lane} lane, same_host={ep.same_host}, "
           f"same_process={ep.same_process})")
     xch.close()
     proc.stdin.close()

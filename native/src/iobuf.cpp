@@ -179,6 +179,87 @@ IOBlock* IOBlock::create_user(const char* p, size_t len,
   return b;
 }
 
+// ---------------------------------------------------------------------------
+// Bulk slab pool (read-side arena blocks for bulk frames, ISSUE 15):
+// power-of-two slabs 64KB..8MB recycled through per-class freelists; a
+// parked slab stays LIVE in the ledger like every parked pool block.
+// Frames past the max class fall back to an exact-size unpooled malloc.
+// ---------------------------------------------------------------------------
+
+static constexpr size_t kBulkMinSlab = 64u << 10;
+static constexpr size_t kBulkMaxSlab = 8u << 20;
+static constexpr int kBulkClasses = 8;  // 64K 128K ... 8M
+static constexpr int kBulkPoolDepth = 4;
+
+struct BulkSlabPool {
+  NatMutex<kLockRankBulkPool> bulk_mu;
+  char* free_[kBulkClasses][kBulkPoolDepth];
+  int n_[kBulkClasses] = {};
+};
+// natcheck:leak(g_bulk_pool): leaked like every runtime static — read
+// paths keep releasing slabs through exit()
+static BulkSlabPool& g_bulk_pool = *new BulkSlabPool();
+
+static int bulk_class(size_t cap) {
+  if (cap < kBulkMinSlab || cap > kBulkMaxSlab || (cap & (cap - 1)) != 0) {
+    return -1;  // unpooled (exact-size giant frame)
+  }
+  int cls = 0;
+  for (size_t c = kBulkMinSlab; c < cap; c <<= 1) cls++;
+  return cls;
+}
+
+char* iob_bulk_acquire(size_t need, size_t* cap_out) {
+  size_t cap = kBulkMinSlab;
+  while (cap < need && cap < kBulkMaxSlab) cap <<= 1;
+  if (need > cap) cap = need;  // giant frame: exact size, unpooled
+  int cls = bulk_class(cap);
+  if (cls >= 0) {
+    std::lock_guard g(g_bulk_pool.bulk_mu);
+    if (g_bulk_pool.n_[cls] > 0) {
+      *cap_out = cap;
+      return g_bulk_pool.free_[cls][--g_bulk_pool.n_[cls]];
+    }
+  }
+  char* p = (char*)::malloc(cap);
+  if (p != nullptr) NAT_RES_ALLOC(NR_IOBUF_BLOCK, cap, p);
+  *cap_out = cap;
+  return p;
+}
+
+void iob_bulk_release(char* p, size_t cap) {
+  if (p == nullptr) return;
+  int cls = bulk_class(cap);
+  if (cls >= 0) {
+    std::lock_guard g(g_bulk_pool.bulk_mu);
+    if (g_bulk_pool.n_[cls] < kBulkPoolDepth) {
+      g_bulk_pool.free_[cls][g_bulk_pool.n_[cls]++] = p;
+      return;  // parked: stays LIVE in the ledger
+    }
+  }
+  NAT_RES_FREE(NR_IOBUF_BLOCK, cap, p);
+  ::free(p);
+}
+
+// (slab, capacity) context threaded through append_user's single arg
+struct BulkCtx {
+  char* p;
+  size_t cap;
+};
+
+void* iob_bulk_ctx(char* p, size_t cap) {
+  BulkCtx* c = new BulkCtx{p, cap};
+  NAT_RES_ALLOC(NR_IOBUF_REFS, sizeof(BulkCtx), c);
+  return c;
+}
+
+void iob_bulk_user_free(void* raw) {
+  BulkCtx* c = (BulkCtx*)raw;
+  iob_bulk_release(c->p, c->cap);
+  NAT_RES_FREE(NR_IOBUF_REFS, sizeof(BulkCtx), c);
+  delete c;
+}
+
 static IOBlock* tls_share_block() {
   TlsBlockCache& c = tls_cache;
   if (c.share == nullptr || c.share->left() == 0) {

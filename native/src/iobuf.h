@@ -208,4 +208,18 @@ class IOBuf {
   size_t length_ = 0;
 };
 
+// Pooled bulk read slabs — the read-side registered-arena role of the
+// reference's block_pool (docs/cn/rdma.md: ALL IOBuf memory comes from
+// the registered pool so payloads are transfer-ready). Large tpu_std
+// frame bodies read straight into one slab (no per-8KB block churn) and
+// join the stream as a single arena-backed USER block. Slabs are
+// power-of-two capacities recycled through a small freelist so bulk
+// traffic doesn't pay malloc/mmap + first-touch faults per frame.
+// cap_out receives the slab capacity — the release key.
+char* iob_bulk_acquire(size_t need, size_t* cap_out);
+void iob_bulk_release(char* p, size_t cap);
+// append_user free_fn adapter: arg is the BulkCtx made by iob_bulk_ctx.
+void iob_bulk_user_free(void* raw);
+void* iob_bulk_ctx(char* p, size_t cap);
+
 }  // namespace brpc_tpu

@@ -346,6 +346,9 @@ int main() {
       NAT_SYM(nat_shm_take_request),
       NAT_SYM(nat_shm_respond),
       NAT_SYM(nat_shm_push_tensor),
+      NAT_SYM(nat_shm_producer_attach),
+      NAT_SYM(nat_shm_fabric_push),
+      NAT_SYM(nat_shm_fabric_take),
       NAT_SYM(nat_shm_push_bench),
       NAT_SYM(nat_shm_worker_drain_bench),
       NAT_SYM(nat_stats_counter_count),

@@ -284,6 +284,13 @@ int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
 // publish one kind-8 descriptor (the HostArena / device-lane staging
 // seam); -1 = every ring full (caller owns backpressure policy)
 int nat_shm_push_tensor(const char* data, size_t len, uint64_t tag);
+// tensor fabric (ISSUE 15): a peer process claims a PRODUCER slot on the
+// receiver's segment, pushes kind-8 records written ONCE into the shared
+// blob arena, and the receiver takes them as out-of-order-releasable
+// LEASES (nat_req_* handle; nat_req_free releases the span)
+int nat_shm_producer_attach(const char* name);
+int nat_shm_fabric_push(const char* data, size_t len, uint64_t tag);
+void* nat_shm_fabric_take(int timeout_ms);
 // transport microbenchmarks (bench.py shm_desc lanes): parent-side push
 // loop (returns GB/s) and worker-side native drain loop (returns records)
 double nat_shm_push_bench(size_t record_bytes, double seconds,

@@ -295,9 +295,15 @@ double nat_rpc_client_bench_bulk(const char* ip, int port, int att_bytes,
                   break;
                 }
                 IOBuf frame;
-                build_request_frame(&frame, cid, "EchoService", "Echo",
-                                    nullptr, 0, arg->att->data(),
-                                    arg->att->size());
+                // zero-copy build: the attachment rides as ONE user
+                // block over the bench's long-lived payload string —
+                // no 1MB memcpy per call, one iovec into writev (the
+                // device-push sender shape, not a bench-only trick)
+                IOBuf att_buf;
+                att_buf.append_user(arg->att->data(), arg->att->size(),
+                                    nullptr, nullptr);
+                build_request_frame_iobuf(&frame, cid, "EchoService",
+                                          "Echo", std::move(att_buf));
                 int wrc = s->write(std::move(frame));
                 if (wrc != 0) {
                   PendingCall* mine = ch->take_pending(cid, /*ok=*/false);

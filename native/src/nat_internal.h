@@ -168,6 +168,19 @@ struct NatSocket {
   // on socket teardown.
   PyRequest* fill_req = nullptr;
   size_t fill_off = 0;
+  // tpu_std bulk-frame fill mode (read-side arena blocks, the
+  // registered-pool read path of the reference's rdma config): when a
+  // frame header announces a body >= kBulkFillMin that is not yet
+  // buffered, the remaining bytes read STRAIGHT into one pooled bulk
+  // slab (iob_bulk_acquire) that joins in_buf as a single arena-backed
+  // USER block on completion — the whole frame body is then one
+  // contiguous ref, so meta/payload/attachment cut zero-copy and the
+  // echo/write path emits one iovec instead of ~128 8KB blocks per MB.
+  // Owned by the reading thread; released on socket teardown.
+  char* bulk_buf = nullptr;
+  size_t bulk_cap = 0;  // slab capacity (the pool-release key)
+  size_t bulk_len = 0;  // frame body length (fill target)
+  size_t bulk_off = 0;  // filled prefix
 
   // Native protocol sessions (the per-connection parse state the
   // reference keeps in Socket::_parsing_context, socket.h:793): owned by
@@ -573,6 +586,15 @@ struct PyRequest {
   // this request is freed, which releases the span back to the arena.
   int32_t shm_slot = -1;
   uint64_t shm_span = 0;   // span-start offset (monotone) for the release
+  // span-lease bookkeeping (tensor fabric, ISSUE 15): shm_span_bytes is
+  // the leased payload size (the shm.span nat_res ledger row — payload
+  // bytes are accounted ONCE per transfer, the structural zero-copy
+  // witness); shm_lease marks a receiver-side fabric lease whose release
+  // must be epoch-guarded (the producer slot may have been recovered
+  // from under it) and decrement the slot's outstanding-lease count.
+  uint32_t shm_span_bytes = 0;
+  uint32_t shm_epoch = 0;
+  bool shm_lease = false;
   const char* shm_view[5] = {nullptr, nullptr, nullptr, nullptr, nullptr};
   size_t shm_view_len[5] = {0, 0, 0, 0, 0};
   // trace context parsed off the wire (RpcMeta trace fields /
@@ -1257,8 +1279,24 @@ void build_request_frame(IOBuf* out, int64_t cid, const std::string& service,
                          const std::string& method, const char* payload,
                          size_t payload_len, const char* att, size_t att_len,
                          uint64_t trace_id = 0, uint64_t span_id = 0);
+// zero-copy build: the attachment's refs splice into the frame (no
+// payload memcpy; user blocks ride straight into writev)
+void build_request_frame_iobuf(IOBuf* out, int64_t cid,
+                               const std::string& service,
+                               const std::string& method,
+                               IOBuf&& attachment, uint64_t trace_id = 0,
+                               uint64_t span_id = 0);
 bool process_input(NatSocket* s, IOBuf* defer_out = nullptr);
 bool drain_socket_inline(NatSocket* s);
+// tpu_std bulk-frame fill mode (nat_messenger.cpp): frames with a body
+// >= kBulkFillMin read their remaining payload straight into one pooled
+// bulk slab (iob_bulk_acquire) consumed as a single IOBuf user block.
+inline constexpr size_t kBulkFillMin = 128u << 10;
+// Feed freshly-received bytes into the armed fill; returns the count
+// consumed (the rest belongs to the next frame). Reading thread only.
+size_t bulk_fill_feed(NatSocket* s, const char* data, size_t n);
+// Teardown: release a half-filled slab back to the pool.
+void bulk_fill_abort(NatSocket* s);
 
 // Native HTTP/1.1 session (nat_http.cpp).
 // try_process returns: 1 = session active (consumed what it could),

@@ -59,6 +59,9 @@ enum : int {
   // 15: shm.fence (raw robust pthread mutex, see header comment)
   kLockRankShmReq = 20,       // g_req_mu[i]: per-worker request producer
   kLockRankShmResp = 22,      // g_resp_mu: worker-side response producer
+  kLockRankShmFabric = 24,    // g_fab_mu: producer-side tensor-fabric
+                              // push lock (kind-8 records onto the
+                              // producer slot's own request ring)
   kLockRankCluster = 28,      // NatCluster::mu: naming-feed diff/publish
                               // (creates channels under it: below the
                               // runtime lock; the LB read path takes NO
@@ -108,6 +111,8 @@ enum : int {
   kLockRankSchedHooks = 88,   // Scheduler::hooks_mu_
   // 90: butex (raw, cv partner)
   kLockRankSchedRemote = 92,  // Worker::remote_mu
+  kLockRankBulkPool = 93,     // iobuf bulk-slab freelist (read-side
+                              // arena blocks for bulk frames): leaf
   // 94: sched.park (raw, cv partner)
   kLockRankBlockPool = 95,    // iobuf central block pool (batch steal/
                               // return under ANY runtime lock: leaf)

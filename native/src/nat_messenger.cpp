@@ -80,6 +80,33 @@ void build_request_frame(IOBuf* out, int64_t cid, const std::string& service,
   if (att_len) out->append(att, att_len);
 }
 
+// Zero-copy variant for bulk senders: the attachment's refs SPLICE into
+// the frame (user blocks over caller-owned memory ride straight into
+// writev — the send half of the registered-arena discipline; the bulk
+// bench and device-lane senders use it so a 1MB payload never pays a
+// build memcpy).
+void build_request_frame_iobuf(IOBuf* out, int64_t cid,
+                               const std::string& service,
+                               const std::string& method,
+                               IOBuf&& attachment, uint64_t trace_id,
+                               uint64_t span_id) {
+  size_t att_len = attachment.length();
+  size_t bound = 12 + request_meta_bound(service.size(), method.size());
+  char stack_buf[320];
+  // natcheck:allow(resacct): per-frame scratch, freed before return
+  char* buf = bound <= sizeof(stack_buf) ? stack_buf : (char*)malloc(bound);
+  size_t mlen = encode_request_meta_to(buf + 12, service.data(),
+                                       service.size(), method.data(),
+                                       method.size(), cid, (int64_t)att_len,
+                                       trace_id, span_id);
+  memcpy(buf, kMagicRpc, 4);
+  wr_be32(buf + 4, (uint32_t)(mlen + att_len));
+  wr_be32(buf + 8, (uint32_t)mlen);
+  out->append(buf, 12 + mlen);
+  if (buf != stack_buf) free(buf);
+  out->append(std::move(attachment));
+}
+
 // Minimal HTTP console on the native port (the multi-protocol-port
 // discipline of server.cpp: one port tries every protocol): GET
 // /health /status /vars /version answer from native counters so the
@@ -227,6 +254,37 @@ size_t stream_fill_feed(NatSocket* s, const char* data, size_t n) {
     s->server->enqueue_py(r);
   }
   return take;
+}
+
+// tpu_std bulk-frame fill (read-side arena blocks, ISSUE 15): once the
+// slab is full it joins in_buf as ONE user block — header + body are
+// then contiguous refs and the normal cut loop slices meta/payload/
+// attachment zero-copy out of the slab.
+static void bulk_fill_complete(NatSocket* s) {
+  char* p = s->bulk_buf;
+  size_t cap = s->bulk_cap;
+  size_t len = s->bulk_len;
+  s->bulk_buf = nullptr;
+  s->bulk_cap = s->bulk_len = s->bulk_off = 0;
+  s->in_buf.append_user(p, len, iob_bulk_user_free, iob_bulk_ctx(p, cap));
+  nat_counter_add(NS_BULK_FILL_FRAMES, 1);
+}
+
+size_t bulk_fill_feed(NatSocket* s, const char* data, size_t n) {
+  size_t want = s->bulk_len - s->bulk_off;
+  size_t take = n < want ? n : want;
+  memcpy(s->bulk_buf + s->bulk_off, data, take);
+  s->bulk_off += take;
+  if (s->bulk_off == s->bulk_len) bulk_fill_complete(s);
+  return take;
+}
+
+void bulk_fill_abort(NatSocket* s) {
+  if (s->bulk_buf != nullptr) {
+    iob_bulk_release(s->bulk_buf, s->bulk_cap);
+    s->bulk_buf = nullptr;
+    s->bulk_cap = s->bulk_len = s->bulk_off = 0;
+  }
 }
 
 // Forward everything buffered on a raw-mode socket to the py lane as one
@@ -448,7 +506,32 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
       ok = false;
       break;
     }
-    if (s->in_buf.length() < 12 + (size_t)body) break;
+    if (s->in_buf.length() < 12 + (size_t)body) {
+      // Bulk-frame fill: a large body's remaining bytes read straight
+      // into ONE pooled slab (socket -> arena, no per-8KB block churn)
+      // that joins in_buf as a single user block on completion — the
+      // whole frame is then contiguous and meta/payload/attachment cut
+      // zero-copy. TLS stays buffered (payload exists only post-decrypt).
+      // Everything after the 12-byte header already buffered belongs to
+      // THIS frame's body (length < 12 + body), so it moves into the
+      // slab and in_buf shrinks to exactly the header.
+      if ((size_t)body >= kBulkFillMin && s->ssl_sess == nullptr &&
+          s->bulk_buf == nullptr && s->fill_req == nullptr) {
+        size_t cap = 0;
+        char* p = iob_bulk_acquire(body, &cap);
+        if (p != nullptr) {
+          size_t have = s->in_buf.length() - 12;
+          if (have > 0) s->in_buf.copy_to(p, have, 12);
+          s->in_buf.clear();
+          s->in_buf.append(header, 12);
+          s->bulk_buf = p;
+          s->bulk_cap = cap;
+          s->bulk_len = body;
+          s->bulk_off = have;
+        }
+      }
+      break;
+    }
     uint64_t t_recv = nat_now_ns();  // frame fully buffered
     s->in_buf.pop_front(12);
     // decode straight from the buffer (fetch: contiguous view or stack
@@ -724,6 +807,40 @@ bool drain_socket_inline(NatSocket* s) {
       if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
       if (n < 0 && errno == EINTR) continue;
       dead = true;  // EOF or hard error mid-payload
+      break;
+    }
+    if (s->bulk_buf != nullptr && s->ssl_sess == nullptr) {
+      // bulk-frame fill: the read syscall lands STRAIGHT in the pooled
+      // slab (socket -> arena, zero userspace copies for the body);
+      // capped at the frame remainder so the next frame's bytes stay in
+      // the socket buffer for the normal path
+      size_t want = s->bulk_len - s->bulk_off;
+      if (fra.action == NF_SHORT) want = 1;
+      if (fra.action == NF_ERR) {
+        errno = fra.err;
+        n = -1;
+      } else if (fra.action == NF_EOF) {
+        n = 0;
+      } else {
+        n = ::read(s->fd, s->bulk_buf + s->bulk_off, want);
+      }
+      if (n > 0) {
+        nat_counter_add(NS_SOCK_READ_BYTES, (uint64_t)n);
+        s->c_in_bytes.fetch_add((uint64_t)n, std::memory_order_relaxed);
+        s->c_read_calls.fetch_add(1, std::memory_order_relaxed);
+        s->bulk_off += (size_t)n;
+        if (s->bulk_off == s->bulk_len) {
+          bulk_fill_complete(s);
+          if (!process_input(s, &acc)) {
+            dead = true;
+            break;
+          }
+        }
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      if (n < 0 && errno == EINTR) continue;
+      dead = true;  // EOF or hard error mid-frame
       break;
     }
     if (s->ssl_sess != nullptr) {

@@ -346,6 +346,7 @@ const char* mu_rank_name(int rank) {
     case 15: return "shm.fence";
     case kLockRankShmReq: return "shm.req";
     case kLockRankShmResp: return "shm.resp";
+    case kLockRankShmFabric: return "shm.fabric";
     case kLockRankCluster: return "cluster";
     case kLockRankRuntime: return "runtime";
     case kLockRankListen: return "disp.listen";
@@ -380,6 +381,7 @@ const char* mu_rank_name(int rank) {
     case kLockRankSchedHooks: return "sched.hooks";
     case 90: return "butex";
     case kLockRankSchedRemote: return "sched.remote";
+    case kLockRankBulkPool: return "iobuf.bulk";
     case 94: return "sched.park";
     case kLockRankBlockPool: return "iobuf.pool";
     case kLockRankStackPool: return "stack.pool";

@@ -122,6 +122,9 @@ NAT_REF_TAG(adm.inflight, "the token after shm_lane_offer transferred it "
 // shm blob-arena spans (descriptor-lane PyRequests read in place):
 NAT_REF_TAG(shm.span, "an arena span pinned by a descriptor-lane "
             "PyRequest's field views; nat_req_free releases")
+NAT_REF_TAG(shm.lease, "a tensor-fabric span leased to the receiver by "
+            "nat_shm_fabric_take (held past the drain loop, released "
+            "out of order); shm_req_span_release retires it")
 
 // refguard selftest tags (nat_refguard_selftest's dummy object — the
 // balanced round and the deliberately-broken golden scenario):

@@ -90,8 +90,9 @@ std::atomic<int64_t> g_res_hwm_bytes[NR_SUBSYS_COUNT];
 
 const char* kResNames[NR_SUBSYS_COUNT] = {
     "iobuf.block", "iobuf.refs", "sock.slab",  "sock.wreq",
-    "srv.pyreq",   "sched.stack", "shm.seg",   "dump.spill",
-    "prof.cells",  "cluster",     "stats.cell", "selftest",
+    "srv.pyreq",   "sched.stack", "shm.seg",   "shm.span",
+    "dump.spill",  "prof.cells",  "cluster",    "stats.cell",
+    "selftest",
 };
 
 void res_hwm_update(int sub, int64_t live) {

@@ -60,6 +60,10 @@ enum NatResSubsys : int {
                        // page) + Fiber/Worker objects
   NR_SHM_SEG,          // nat_shm_lane.cpp: shm segment mmaps (rings +
                        // blob arenas, parent and worker mappings)
+  NR_SHM_SPAN,         // nat_shm_lane.cpp: blob-arena spans pinned by
+                       // live descriptor-lane requests / tensor-fabric
+                       // leases (bytes = leased payload; freed at
+                       // shm_req_span_release)
   NR_DUMP_SPILL,       // nat_dump.cpp: capture-ring spill buffers
   NR_PROF_CELLS,       // fixed BSS sample pools: nat_prof/mu-prof/res
                        // rings + span ring (NAT_RES_STATIC at .so init)

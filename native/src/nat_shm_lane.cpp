@@ -121,7 +121,13 @@ int64_t mono_ms() {
 }
 constexpr uint64_t kShmMagic = 0x62727063646C6EULL ^ 0x2ULL;  // v2 lane
 
-ShmSeg* g_seg = nullptr;
+// The one process-wide segment mapping. ATOMIC pointer: scheduler idle
+// hooks, reactor offers and fabric takes read it with no rendezvous
+// against a stop->start replace — the OLD mapping is deliberately
+// leaked so a stale pointer value stays dereferenceable, but the
+// pointer word itself must not be a plain-load/store race.
+std::atomic<ShmSeg*> g_seg_ptr{nullptr};
+inline ShmSeg* seg_now() { return g_seg_ptr.load(std::memory_order_acquire); }
 size_t g_seg_total = 0;
 bool g_seg_unlinked = false;
 char g_seg_name[64];
@@ -144,8 +150,21 @@ std::atomic<uint32_t> g_rr{0};
 std::atomic<int> g_user_spans[kMaxWorkers] = {};
 std::atomic<uint32_t> g_slot_epoch[kMaxWorkers] = {};
 
+// parent-side tensor-fabric lease accounting: outstanding receiver
+// leases per PRODUCER slot (state 4) — recovery of a dead producer
+// waits these out (bounded) before scrubbing its arena
+std::atomic<int> g_fab_leases[kMaxWorkers] = {};
+
 // worker-local identity + response-ring producer lock
 int g_my_slot = -1;
+// producer-local identity (tensor-fabric push role, state-4 slot): a
+// peer process that attached with nat_shm_producer_attach owns this
+// slot's REQUEST ring as its sole producer — its threads serialize on
+// g_fab_mu (process-local, like every ring's producer lock)
+int g_my_prod_slot = -1;
+// natcheck:leak(g_fab_mu): leaked — exit order vs pushing threads
+NatMutex<kLockRankShmFabric>* g_fab_mu =
+    new NatMutex<kLockRankShmFabric>;
 // worker-local: when THIS thread's latest take_request popped its record
 // (the sequential take -> handle -> respond worker loop's handling-start
 // anchor); nat_shm_respond ships it back so the parent can stitch the
@@ -156,24 +175,45 @@ NatMutex<kLockRankShmResp>* g_resp_mu =
     new NatMutex<kLockRankShmResp>;
 
 // every sub-block is 64-byte aligned: the segment base is page-aligned,
-// the header/rings round up to 64, and arena_bytes is page-rounded
+// the header/rings round up to 64, and arena_bytes is page-rounded.
+//
+// The *_of(s, ...) forms compute every address from ONE ShmSeg snapshot:
+// a thread racing a stop->start segment replace must never mix the old
+// mapping's base with the new mapping's arena_bytes (a wholly-stale
+// pointer lands in the leaked-but-mapped old segment and is harmless; a
+// MIXED computation is a wild pointer). The snapshot-less wrappers are
+// for call sites that take their own snapshot or run on paths where the
+// segment cannot be replaced concurrently.
 size_t whdr_bytes() { return (sizeof(ShmWorkerHdr) + 63) & ~(size_t)63; }
-size_t worker_block_bytes() {
-  return whdr_bytes() + 2 * (sizeof(ShmRing) + (size_t)g_seg->arena_bytes);
+size_t worker_block_bytes_of(const ShmSeg* s) {
+  return whdr_bytes() + 2 * (sizeof(ShmRing) + (size_t)s->arena_bytes);
 }
-char* worker_base(int i) {
-  return (char*)g_seg + ((sizeof(ShmSeg) + 63) & ~(size_t)63) +
-         (size_t)i * worker_block_bytes();
+char* worker_base_of(ShmSeg* s, int i) {
+  return (char*)s + ((sizeof(ShmSeg) + 63) & ~(size_t)63) +
+         (size_t)i * worker_block_bytes_of(s);
 }
-ShmWorkerHdr* whdr(int i) { return (ShmWorkerHdr*)worker_base(i); }
-ShmRing* wreq(int i) {
-  return (ShmRing*)(worker_base(i) + whdr_bytes());
+ShmWorkerHdr* whdr_of(ShmSeg* s, int i) {
+  return (ShmWorkerHdr*)worker_base_of(s, i);
 }
-char* req_arena(int i) { return (char*)wreq(i) + sizeof(ShmRing); }
-ShmRing* wresp(int i) {
-  return (ShmRing*)(req_arena(i) + g_seg->arena_bytes);
+ShmRing* wreq_of(ShmSeg* s, int i) {
+  return (ShmRing*)(worker_base_of(s, i) + whdr_bytes());
 }
-char* resp_arena(int i) { return (char*)wresp(i) + sizeof(ShmRing); }
+char* req_arena_of(ShmSeg* s, int i) {
+  return (char*)wreq_of(s, i) + sizeof(ShmRing);
+}
+ShmRing* wresp_of(ShmSeg* s, int i) {
+  return (ShmRing*)(req_arena_of(s, i) + (size_t)s->arena_bytes);
+}
+char* resp_arena_of(ShmSeg* s, int i) {
+  return (char*)wresp_of(s, i) + sizeof(ShmRing);
+}
+size_t worker_block_bytes() { return worker_block_bytes_of(seg_now()); }
+char* worker_base(int i) { return worker_base_of(seg_now(), i); }
+ShmWorkerHdr* whdr(int i) { return whdr_of(seg_now(), i); }
+ShmRing* wreq(int i) { return wreq_of(seg_now(), i); }
+char* req_arena(int i) { return req_arena_of(seg_now(), i); }
+ShmRing* wresp(int i) { return wresp_of(seg_now(), i); }
+char* resp_arena(int i) { return resp_arena_of(seg_now(), i); }
 
 // Shared (non-PRIVATE) futex wait/wake on a doorbell counter.
 //
@@ -210,22 +250,22 @@ void futex_wake_shared(std::atomic<uint32_t>* a) {
 }
 
 // ---------------------------------------------------------------------------
-// ring/arena wrappers binding g_seg->arena_bytes (core: nat_desc_ring.h)
+// ring/arena wrappers binding seg_now()->arena_bytes (core: nat_desc_ring.h)
 // ---------------------------------------------------------------------------
 
 char* span_payload(char* arena, uint64_t span_off) {
-  return desc_span_payload(arena, span_off, g_seg->arena_bytes);
+  return desc_span_payload(arena, span_off, seg_now()->arena_bytes);
 }
 
 void span_release(char* arena, uint64_t span_off) {
-  desc_span_release(arena, span_off, g_seg->arena_bytes);
+  desc_span_release(arena, span_off, seg_now()->arena_bytes);
 }
 
 void ring_init(ShmRing* r) { desc_ring_init(r); }
 
 bool ring_begin_push(ShmRing* r, char* arena, size_t len, uint64_t* pos_out,
                      uint64_t* span_out, char** dst) {
-  return desc_ring_begin_push(r, arena, len, g_seg->arena_bytes, pos_out,
+  return desc_ring_begin_push(r, arena, len, seg_now()->arena_bytes, pos_out,
                               span_out, dst);
 }
 
@@ -397,11 +437,16 @@ struct UserSpanCtx {
 void user_span_free(void* raw) {
   UserSpanCtx* ctx = (UserSpanCtx*)raw;
   // a release outliving a slot recovery (epoch bump) must not scribble
-  // the released bit onto arena bytes a fresh worker now owns
-  if (g_seg != nullptr &&
+  // the released bit onto arena bytes a fresh worker now owns. ONE
+  // segment snapshot: this path runs with no rendezvous against a
+  // stop->start replace — mixing the old base with the new arena_bytes
+  // would compute a wild pointer (a wholly-stale one is harmless).
+  ShmSeg* s = seg_now();
+  if (s != nullptr &&
       g_slot_epoch[ctx->slot].load(std::memory_order_acquire) ==
           ctx->epoch) {
-    span_release(resp_arena(ctx->slot), ctx->span_off);
+    desc_span_release(resp_arena_of(s, ctx->slot), ctx->span_off,
+                      s->arena_bytes);
   }
   g_user_spans[ctx->slot].fetch_sub(1, std::memory_order_acq_rel);
   NAT_RES_FREE(NR_SHM_SEG, sizeof(UserSpanCtx), ctx);
@@ -414,7 +459,7 @@ void user_span_free(void* raw) {
 // past the mapping (the parent-crash class the old byte rings validated
 // against).
 bool span_sane(const CellView& c) {
-  uint64_t asize = g_seg->arena_bytes;
+  uint64_t asize = seg_now()->arena_bytes;
   uint64_t off = c.span_off % asize;
   return (off & 63) == 0 && (uint64_t)c.payload_len <= asize &&
          off + 8 + (uint64_t)c.payload_len <= asize;
@@ -561,7 +606,7 @@ std::atomic<int> g_emit_busy[kMaxWorkers] = {};
 // One sweep over every ACTIVE response ring; true when anything drained.
 // (state==2 slots are recovery-owned: recover_slot drains them itself.)
 bool drain_resp_once() {
-  if (g_seg == nullptr) return false;
+  if (seg_now() == nullptr) return false;
   bool any = false;
   for (int i = 0; i < kMaxWorkers; i++) {
     if (whdr(i)->state.load(std::memory_order_seq_cst) != 1) continue;
@@ -596,7 +641,7 @@ bool resp_any_ready() {
 // are drained and in-flight user blocks released, anything unreleased is
 // the dead worker's half-claimed garbage.
 void scrub_arena(ShmRing* r, char* arena) {
-  desc_scrub_arena(r, arena, g_seg->arena_bytes);
+  desc_scrub_arena(r, arena, seg_now()->arena_bytes);
 }
 
 void ring_discard_claims(ShmRing* r) { desc_ring_discard_claims(r); }
@@ -653,7 +698,53 @@ void recover_slot(int i) {
   }
   // answer everything that was routed to this worker NOW
   reap_slot_inflight(i);
-  g_seg->attached.fetch_sub(1, std::memory_order_acq_rel);
+  seg_now()->attached.fetch_sub(1, std::memory_order_acq_rel);
+  w->pid.store(0, std::memory_order_relaxed);
+  w->state.store(0, std::memory_order_seq_cst);  // slot reusable
+}
+
+// Recover a dead PRODUCER slot (tensor fabric, state 4). Requires the
+// fence (EOWNERDEAD, made consistent) to be held by the caller. Records
+// the dead producer published but nobody took are DISCARDED (the sender
+// died; its RPCs fail with it); receiver-held leases are waited out
+// (bounded) before the arena scrub, and the epoch bump fences any
+// straggler lease release off the recycled arena.
+void recover_producer_slot(int i) {
+  ShmWorkerHdr* w = whdr(i);
+  w->state.store(2, std::memory_order_seq_cst);  // takes back off
+  // wait out fabric takes already mid-pop on this slot: after busy
+  // clears, every taken record's lease is registered in g_fab_leases
+  // natcheck:allow(lock-switch): recovery slow path on the drainer
+  // thread (never a fiber); the probe lock is held by the caller
+  while (g_emit_busy[i].load(std::memory_order_seq_cst) > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  {
+    CellView c;
+    while (ring_pop(wreq(i), &c)) {
+      if (span_sane(c)) span_release(req_arena(i), c.span_off);
+      nat_counter_add(NS_FABRIC_RECOVER_DROPS, 1);
+    }
+    ring_discard_claims(wreq(i));
+  }
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(5);
+  // natcheck:allow(lock-switch): bounded recovery wait (drainer thread
+  // only, never a fiber) — receiver leases drain on their own schedule
+  while (g_fab_leases[i].load(std::memory_order_acquire) > 0 &&
+         std::chrono::steady_clock::now() < deadline) {
+    // natcheck:allow(lock-switch): see the comment above this loop
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  bool quiesced = g_fab_leases[i].load(std::memory_order_acquire) == 0;
+  g_slot_epoch[i].fetch_add(1, std::memory_order_acq_rel);
+  g_fab_leases[i].store(0, std::memory_order_release);
+  if (quiesced) {
+    scrub_arena(wreq(i), req_arena(i));
+  }
+  // else: a lease is STILL held past the deadline — leak its span (the
+  // epoch bump stops the eventual release from touching the arena)
+  // rather than hand bytes a live reader still maps to a new producer
   w->pid.store(0, std::memory_order_relaxed);
   w->state.store(0, std::memory_order_seq_cst);  // slot reusable
 }
@@ -664,21 +755,29 @@ void recover_slot(int i) {
 // two against each other.
 NatMutex<kLockRankShmProbe> g_probe_mu;
 int probe_fences() {
-  if (g_seg == nullptr) return 0;
+  if (seg_now() == nullptr) return 0;
   std::lock_guard pg(g_probe_mu);
   int recovered = 0;
   for (int i = 0; i < kMaxWorkers; i++) {
     ShmWorkerHdr* w = whdr(i);
-    if (w->state.load(std::memory_order_acquire) != 1) continue;
+    uint32_t st = w->state.load(std::memory_order_acquire);
+    if (st != 1 && st != 4) continue;
+    if (i == g_my_prod_slot) continue;  // our own producer role: alive
     int rc = pthread_mutex_trylock(&w->fence);
-    if (rc == EBUSY) continue;  // worker alive, holding its fence
+    if (rc == EBUSY) continue;  // worker/producer alive, holding its fence
     if (rc == EOWNERDEAD) pthread_mutex_consistent(&w->fence);
     if (rc == EOWNERDEAD || rc == 0) {
       // rc == 0 (unlocked while active) is the same condition: a live
       // worker holds its fence for its whole lifetime.
-      // natcheck:allow(lock-switch): recovery quiesce sleeps under the
-      // probe lock + fence by design (see recover_slot)
-      recover_slot(i);
+      if (st == 4) {
+        // natcheck:allow(lock-switch): recovery quiesce sleeps under
+        // the probe lock + fence by design (see recover_producer_slot)
+        recover_producer_slot(i);
+      } else {
+        // natcheck:allow(lock-switch): recovery quiesce sleeps under
+        // the probe lock + fence by design (see recover_slot)
+        recover_slot(i);
+      }
       recovered++;
     }
     if (rc == EOWNERDEAD || rc == 0) pthread_mutex_unlock(&w->fence);
@@ -695,13 +794,13 @@ void resp_drainer_loop() {
     if (!any) {
       // waiter-gated park: producers only pay the futex wake while this
       // flag is up (one wake per burst, not per record)
-      uint32_t db = g_seg->resp_doorbell.load(std::memory_order_seq_cst);
-      g_seg->resp_waiters.fetch_add(1, std::memory_order_seq_cst);
+      uint32_t db = seg_now()->resp_doorbell.load(std::memory_order_seq_cst);
+      seg_now()->resp_waiters.fetch_add(1, std::memory_order_seq_cst);
       if (!resp_any_ready() &&
           !g_drainer_stop.load(std::memory_order_relaxed)) {
-        futex_wait_shared(&g_seg->resp_doorbell, db, 200);
+        futex_wait_shared(&seg_now()->resp_doorbell, db, 200);
       }
-      g_seg->resp_waiters.fetch_sub(1, std::memory_order_seq_cst);
+      seg_now()->resp_waiters.fetch_sub(1, std::memory_order_seq_cst);
     }
   }
 }
@@ -777,13 +876,41 @@ bool shm_lane_inflight_empty() {
 }
 
 // release hook for arena-backed PyRequests (declared in nat_internal.h,
-// called from ~PyRequest in whichever process owns the request)
+// called from ~PyRequest in whichever process owns the request).
+// Releases may land OUT OF ORDER relative to takes — the arena's
+// released-bit + lazy head reclaim is built for exactly that — so a
+// consumer can hold a record's span (a LEASE) across further drains.
 void shm_req_span_release(PyRequest* r) {
-  if (g_seg == nullptr || r->shm_slot < 0 || r->shm_slot >= kMaxWorkers) {
+  // ONE segment snapshot for the whole release: this path runs with no
+  // rendezvous against a stop->start replace (see user_span_free).
+  ShmSeg* s = seg_now();
+  if (s == nullptr || r->shm_slot < 0 || r->shm_slot >= kMaxWorkers) {
+    return;
+  }
+  // ledger retire is unconditional and symmetric with the take-side
+  // NAT_RES_ALLOC (every shm-slot request was accounted at its take,
+  // including zero-length records — a bytes!=0 guard here would leak
+  // live_objects forever on empty tensors)
+  NAT_RES_FREE(NR_SHM_SPAN, r->shm_span_bytes, r);
+  if (r->shm_lease) {
+    // receiver-side fabric lease: the producer slot may have been
+    // recovered (producer SIGKILL -> epoch bump) while this lease was
+    // held — a stale release must not scribble the released bit onto
+    // arena bytes a fresh producer now owns
+    NAT_REF_RELEASED(r, shm.lease);
+    if (g_slot_epoch[r->shm_slot].load(std::memory_order_acquire) ==
+        r->shm_epoch) {
+      desc_span_release(req_arena_of(s, r->shm_slot), r->shm_span,
+                        s->arena_bytes);
+      g_fab_leases[r->shm_slot].fetch_sub(1, std::memory_order_acq_rel);
+    }
+    // stale epoch: the slot was recovered with this lease outstanding —
+    // its count was zeroed there, so only current-epoch leases decrement
     return;
   }
   NAT_REF_RELEASED(r, shm.span);
-  span_release(req_arena(r->shm_slot), r->shm_span);
+  desc_span_release(req_arena_of(s, r->shm_slot), r->shm_span,
+                    s->arena_bytes);
 }
 
 // enqueue hook used by the cut loops: true = the request was routed to
@@ -793,7 +920,7 @@ bool shm_lane_offer(PyRequest* r) {
   if (r->kind != 3 && r->kind != 4) return false;
   // all workers dead/stalled (no take-loop heartbeat for 2s): serve
   // in-process instead of queueing requests for the reaper to 503
-  int64_t last = g_seg->last_worker_poll_ms.load(std::memory_order_relaxed);
+  int64_t last = seg_now()->last_worker_poll_ms.load(std::memory_order_relaxed);
   if (last == 0 || mono_ms() - last > 2000) return false;
   size_t blob_len = request_blob_bytes(r);
   // track BEFORE the publish: once the descriptor is visible a worker
@@ -879,8 +1006,8 @@ extern "C" {
 // After a full disable (which unlinks the name) a new segment with a
 // fresh name is created, so stop -> start cycles work.
 int nat_shm_lane_create(size_t ring_bytes) {
-  if (g_seg != nullptr && !g_seg_unlinked) return 0;
-  if (g_seg != nullptr) {  // previous lane fully shut down: replace
+  if (seg_now() != nullptr && !g_seg_unlinked) return 0;
+  if (seg_now() != nullptr) {  // previous lane fully shut down: replace
     // fence stragglers first: an arena-backed user block still riding a
     // socket write queue must not release its span into the NEW segment
     for (int i = 0; i < kMaxWorkers; i++) {
@@ -895,8 +1022,9 @@ int nat_shm_lane_create(size_t ring_bytes) {
     // address space, not RAM that matters. The ledger keeps the old
     // mapping's bytes LIVE on purpose: leaked-but-resident pages are
     // exactly what the /status RSS reconciliation must attribute.
-    g_seg = nullptr;
+    g_seg_ptr.store(nullptr, std::memory_order_release);
     g_my_slot = -1;
+    g_my_prod_slot = -1;
   }
   if (ring_bytes == 0) ring_bytes = 8u << 20;
   ring_bytes = (ring_bytes + 4095) & ~(size_t)4095;
@@ -923,18 +1051,18 @@ int nat_shm_lane_create(size_t ring_bytes) {
     return -1;
   }
   NAT_RES_ALLOC(NR_SHM_SEG, total, mem);
-  g_seg = (ShmSeg*)mem;
+  g_seg_ptr.store((ShmSeg*)mem, std::memory_order_release);
   g_seg_total = total;
   g_seg_unlinked = false;
-  g_seg->magic = kShmMagic;
-  g_seg->version = 2;
-  g_seg->nslots = kMaxWorkers;
-  g_seg->arena_bytes = ring_bytes;
-  g_seg->attached.store(0, std::memory_order_relaxed);
-  g_seg->shutdown.store(0, std::memory_order_relaxed);
-  g_seg->last_worker_poll_ms.store(0, std::memory_order_relaxed);
-  g_seg->resp_doorbell.store(0, std::memory_order_relaxed);
-  g_seg->resp_waiters.store(0, std::memory_order_relaxed);
+  seg_now()->magic = kShmMagic;
+  seg_now()->version = 2;
+  seg_now()->nslots = kMaxWorkers;
+  seg_now()->arena_bytes = ring_bytes;
+  seg_now()->attached.store(0, std::memory_order_relaxed);
+  seg_now()->shutdown.store(0, std::memory_order_relaxed);
+  seg_now()->last_worker_poll_ms.store(0, std::memory_order_relaxed);
+  seg_now()->resp_doorbell.store(0, std::memory_order_relaxed);
+  seg_now()->resp_waiters.store(0, std::memory_order_relaxed);
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);
   pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
@@ -949,6 +1077,7 @@ int nat_shm_lane_create(size_t ring_bytes) {
     ring_init(wreq(i));
     ring_init(wresp(i));
     g_user_spans[i].store(0, std::memory_order_relaxed);
+    g_fab_leases[i].store(0, std::memory_order_relaxed);
   }
   pthread_mutexattr_destroy(&ma);
   return 0;
@@ -962,12 +1091,12 @@ int nat_shm_lane_max_workers() { return kMaxWorkers; }
 // Parent: how many workers are attached and live (readiness barrier —
 // a short reap timeout must not fire while workers are still booting).
 int nat_shm_lane_workers() {
-  return g_seg != nullptr
-             ? g_seg->attached.load(std::memory_order_acquire)
+  return seg_now() != nullptr
+             ? seg_now()->attached.load(std::memory_order_acquire)
              : 0;
 }
 
-const char* nat_shm_lane_name() { return g_seg != nullptr ? g_seg_name : ""; }
+const char* nat_shm_lane_name() { return seg_now() != nullptr ? g_seg_name : ""; }
 
 // Parent: route kind-3/4 py-lane requests to the workers + start the
 // response drainer and the scheduler idle-hook drain. Disable signals
@@ -975,7 +1104,7 @@ const char* nat_shm_lane_name() { return g_seg != nullptr ? g_seg_name : ""; }
 // segment must not outlive the server run); the mapping stays until a
 // later create replaces it.
 int nat_shm_lane_enable(int enable) {
-  if (g_seg == nullptr) return -1;
+  if (seg_now() == nullptr) return -1;
   if (enable != 0 && !g_lane_enabled.load(std::memory_order_acquire)) {
     {
       std::lock_guard g(g_inflight_mu);
@@ -986,7 +1115,7 @@ int nat_shm_lane_enable(int enable) {
       }
       g_inflight.clear();
     }
-    g_seg->shutdown.store(0, std::memory_order_release);
+    seg_now()->shutdown.store(0, std::memory_order_release);
     g_drainer_stop.store(false, std::memory_order_relaxed);
     delete g_resp_drainer;
     // natcheck:allow(resacct): control-plane thread handle
@@ -998,11 +1127,11 @@ int nat_shm_lane_enable(int enable) {
     g_lane_enabled.store(true, std::memory_order_release);
   } else if (enable == 0) {
     g_lane_enabled.store(false, std::memory_order_release);
-    g_seg->shutdown.store(1, std::memory_order_release);
+    seg_now()->shutdown.store(1, std::memory_order_release);
     g_drainer_stop.store(true, std::memory_order_relaxed);
     // wake every parked consumer so shutdown is observed promptly
-    g_seg->resp_doorbell.fetch_add(1, std::memory_order_seq_cst);
-    futex_wake_shared(&g_seg->resp_doorbell);
+    seg_now()->resp_doorbell.fetch_add(1, std::memory_order_seq_cst);
+    futex_wake_shared(&seg_now()->resp_doorbell);
     for (int i = 0; i < kMaxWorkers; i++) {
       whdr(i)->req_doorbell.fetch_add(1, std::memory_order_seq_cst);
       futex_wake_shared(&whdr(i)->req_doorbell);
@@ -1051,7 +1180,7 @@ int nat_shm_lane_recover_probe(void) { return probe_fences(); }
 // crash cannot leave orphan workers polling the (leaked) segment.
 int nat_shm_worker_attach(const char* name) {
   if (g_my_slot >= 0) return 0;
-  if (g_seg == nullptr) {
+  if (seg_now() == nullptr) {
     prctl(PR_SET_PDEATHSIG, SIGTERM);
     int fd = shm_open(name, O_RDWR, 0600);
     if (fd < 0) return -1;
@@ -1070,7 +1199,7 @@ int nat_shm_worker_attach(const char* name) {
       munmap(mem, (size_t)st.st_size);
       return -1;
     }
-    g_seg = (ShmSeg*)mem;
+    g_seg_ptr.store((ShmSeg*)mem, std::memory_order_release);
     g_seg_total = (size_t)st.st_size;
   }
   for (int i = 0; i < kMaxWorkers; i++) {
@@ -1094,12 +1223,180 @@ int nat_shm_worker_attach(const char* name) {
     g_my_slot = i;
     // the attach IS the first heartbeat: requests arriving between
     // attach and the worker's first take must route to the ring
-    g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
+    seg_now()->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
     w->state.store(1, std::memory_order_release);
-    g_seg->attached.fetch_add(1, std::memory_order_acq_rel);
+    seg_now()->attached.fetch_add(1, std::memory_order_acq_rel);
     return 0;
   }
   return -1;  // every slot taken
+}
+
+// Tensor-fabric PRODUCER attach (ISSUE 15): map the receiver's segment
+// and claim a slot in the PUSH role — this process becomes the sole
+// producer of the slot's request ring (its own threads serialize on the
+// process-local g_fab_mu, exactly the per-ring single-producer-process
+// discipline every ring here relies on), and the receiver (the segment
+// creator) consumes its kind-8 records via nat_shm_fabric_take. The
+// slot's robust fence is held for the producer's lifetime, so a
+// producer SIGKILL surfaces as EOWNERDEAD on the receiver's probe and
+// recover_producer_slot reclaims the slot. Unlike a worker attach, no
+// PDEATHSIG is armed (a tensor producer is a peer with its own
+// lifecycle, not a child) and the attached worker count is untouched.
+// Returns the claimed slot (>= 0), or -1.
+int nat_shm_producer_attach(const char* name) {
+  if (g_my_prod_slot >= 0) return g_my_prod_slot;
+  if (seg_now() == nullptr) {
+    int fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return -1;
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      ::close(fd);
+      return -1;
+    }
+    void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (mem == MAP_FAILED) return -1;
+    NAT_RES_ALLOC(NR_SHM_SEG, (size_t)st.st_size, mem);
+    if (((ShmSeg*)mem)->magic != kShmMagic) {
+      NAT_RES_FREE(NR_SHM_SEG, (size_t)st.st_size, mem);
+      munmap(mem, (size_t)st.st_size);
+      return -1;
+    }
+    g_seg_ptr.store((ShmSeg*)mem, std::memory_order_release);
+    g_seg_total = (size_t)st.st_size;
+  }
+  for (int i = 0; i < kMaxWorkers; i++) {
+    ShmWorkerHdr* w = whdr(i);
+    uint32_t expect = 0;
+    if (!w->state.compare_exchange_strong(expect, 3,
+                                          std::memory_order_acq_rel,
+                                          std::memory_order_acquire)) {
+      continue;
+    }
+    int rc = pthread_mutex_lock(&w->fence);
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(&w->fence);
+      rc = 0;
+    }
+    if (rc != 0) {
+      w->state.store(0, std::memory_order_release);
+      return -1;
+    }
+    w->pid.store((int32_t)getpid(), std::memory_order_relaxed);
+    g_my_prod_slot = i;
+    w->state.store(4, std::memory_order_release);
+    return i;
+  }
+  return -1;  // every slot taken
+}
+
+// Producer: stage `len` tensor bytes ONCE into this producer slot's blob
+// arena and publish one kind-8 descriptor (aux = tag) toward the
+// segment's receiver. The receiver reads the span IN PLACE through a
+// nat_shm_fabric_take lease — producer-write -> arena -> consume, no
+// intermediate copy anywhere. The ambient trace context rides the
+// descriptor's sock_id/cid like nat_shm_push_tensor. Returns 0, or -1
+// when the ring/arena is full (caller owns backpressure policy) or the
+// slot was recovered from under us.
+int nat_shm_fabric_push(const char* data, size_t len, uint64_t tag) {
+  if (seg_now() == nullptr || g_my_prod_slot < 0) return -1;
+  if (seg_now()->shutdown.load(std::memory_order_acquire) != 0) return -1;
+  int i = g_my_prod_slot;
+  ShmWorkerHdr* w = whdr(i);
+  if (w->state.load(std::memory_order_seq_cst) != 4) return -1;
+  const NatTraceCtx& tc = tls_nat_trace;
+  // flight-recorder tap: same seam/shape as nat_shm_push_tensor
+  if (nat_dump_enabled() && nat_dump_tick()) {
+    char tag_m[32];
+    int tag_n = snprintf(tag_m, sizeof(tag_m), "tensor/%llu",
+                         (unsigned long long)tag);
+    nat_dump_sample(NL_WORKER, "", 0, tag_m, (size_t)tag_n, nullptr, 0,
+                    data, len, tc.trace_id, tc.span_id);
+  }
+  uint64_t pos, span;
+  char* dst;
+  {
+    // the lock covers only the claim: the claimed cell/span are private
+    // until the publish's seq store (nat_desc_ring.h contract), so
+    // concurrent pushers overlap their payload memcpys
+    std::lock_guard g(*g_fab_mu);
+    if (!ring_begin_push(wreq(i), req_arena(i), len, &pos, &span, &dst)) {
+      return -1;  // ring/arena full: backpressure
+    }
+  }
+  if (len != 0) memcpy(dst, data, len);
+  ring_publish(wreq(i), pos, 8, 0, tc.trace_id, (int64_t)tc.span_id, 0,
+               span, (uint32_t)len, tag);
+  nat_counter_add(NS_FABRIC_PUSHES, 1);
+  seg_now()->resp_doorbell.fetch_add(1, std::memory_order_seq_cst);
+  if (seg_now()->resp_waiters.load(std::memory_order_seq_cst) != 0) {
+    futex_wake_shared(&seg_now()->resp_doorbell);
+  }
+  return 0;
+}
+
+// Receiver (segment creator): take one pushed tensor record from any
+// producer slot as a LEASE — a PyRequest* handle whose payload view
+// (nat_req_field(h, 2)) points straight into the producer's blob arena.
+// The lease may be held past further takes and released OUT OF ORDER
+// with nat_req_free; leased payload bytes sit in the shm.span nat_res
+// ledger row until release. Trace context comes back through
+// nat_req_sock_id (trace_id) / nat_req_cid (producer span id), the tag
+// through nat_req_aux. Null on timeout/shutdown.
+void* nat_shm_fabric_take(int timeout_ms) {
+  if (seg_now() == nullptr) return nullptr;
+  for (int attempt = 0;; attempt++) {
+    for (int i = 0; i < kMaxWorkers; i++) {
+      ShmWorkerHdr* w = whdr(i);
+      if (w->state.load(std::memory_order_seq_cst) != 4) continue;
+      g_emit_busy[i].fetch_add(1, std::memory_order_seq_cst);
+      PyRequest* req = nullptr;
+      if (w->state.load(std::memory_order_seq_cst) == 4) {
+        CellView c;
+        while (ring_pop(wreq(i), &c)) {
+          if (!span_sane(c)) continue;  // corrupt cell: drop, look again
+          // natcheck:allow(resacct): PyRequest self-accounts in its ctor
+          req = new PyRequest();
+          req->kind = (int32_t)c.kind;
+          req->sock_id = c.sock_id;  // producer trace_id
+          req->cid = c.cid;          // producer span id
+          req->aux = c.aux;
+          req->shm_slot = i;
+          req->shm_span = c.span_off;
+          req->shm_epoch =
+              g_slot_epoch[i].load(std::memory_order_acquire);
+          req->shm_lease = true;
+          req->shm_span_bytes = c.payload_len;
+          req->shm_view[2] = span_payload(req_arena(i), c.span_off);
+          req->shm_view_len[2] = c.payload_len;
+          NAT_REF_ACQUIRED(req, shm.lease);
+          NAT_RES_ALLOC(NR_SHM_SPAN, c.payload_len, req);
+          g_fab_leases[i].fetch_add(1, std::memory_order_acq_rel);
+          nat_counter_add(NS_FABRIC_TAKES, 1);
+          break;
+        }
+      }
+      g_emit_busy[i].fetch_sub(1, std::memory_order_seq_cst);
+      if (req != nullptr) return req;
+    }
+    if (seg_now()->shutdown.load(std::memory_order_acquire) != 0) {
+      return nullptr;
+    }
+    if (attempt >= 1) return nullptr;  // one bounded wait per call
+    uint32_t db = seg_now()->resp_doorbell.load(std::memory_order_seq_cst);
+    seg_now()->resp_waiters.fetch_add(1, std::memory_order_seq_cst);
+    bool ready = false;
+    for (int i = 0; i < kMaxWorkers && !ready; i++) {
+      ready = whdr(i)->state.load(std::memory_order_acquire) == 4 &&
+              ring_has_data(wreq(i));
+    }
+    if (!ready && seg_now()->shutdown.load(std::memory_order_acquire) == 0) {
+      futex_wait_shared(&seg_now()->resp_doorbell, db,
+                        timeout_ms > 0 ? timeout_ms : 200);
+    }
+    seg_now()->resp_waiters.fetch_sub(1, std::memory_order_seq_cst);
+  }
 }
 
 // Worker: take one request; returns a PyRequest* handle compatible with
@@ -1107,15 +1404,15 @@ int nat_shm_worker_attach(const char* name) {
 // string fields are VIEWS into the blob arena (zero-copy); freeing the
 // request releases the span.
 void* nat_shm_take_request(int timeout_ms) {
-  if (g_seg == nullptr || g_my_slot < 0) return nullptr;
+  if (seg_now() == nullptr || g_my_slot < 0) return nullptr;
   ShmWorkerHdr* w = whdr(g_my_slot);
   ShmRing* r = wreq(g_my_slot);
   // liveness heartbeat for the parent's all-workers-dead fallback
-  g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
+  seg_now()->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
   for (int attempt = 0;; attempt++) {
     CellView c;
     while (ring_pop(r, &c)) {
-      g_seg->last_worker_poll_ms.store(mono_ms(),
+      seg_now()->last_worker_poll_ms.store(mono_ms(),
                                        std::memory_order_relaxed);
       if (!span_sane(c)) continue;  // corrupt cell: drop, look again
       // natfault worker site: die or stall EXACTLY here — descriptor
@@ -1139,8 +1436,11 @@ void* nat_shm_take_request(int timeout_ms) {
       req->shm_slot = g_my_slot;
       req->shm_span = c.span_off;
       // the request's field views pin this arena span until
-      // nat_req_free -> shm_req_span_release
+      // nat_req_free -> shm_req_span_release; the pinned payload bytes
+      // sit in the shm.span ledger row for their whole lease
       NAT_REF_ACQUIRED(req, shm.span);
+      req->shm_span_bytes = c.payload_len;
+      NAT_RES_ALLOC(NR_SHM_SPAN, c.payload_len, req);
       char* arena = req_arena(g_my_slot);
       const char* p = span_payload(arena, c.span_off);
       const char* end = p + c.payload_len;
@@ -1168,14 +1468,14 @@ void* nat_shm_take_request(int timeout_ms) {
       req->shm_view_len[2] = pay_n;
       return req;
     }
-    if (g_seg->shutdown.load(std::memory_order_acquire) != 0) {
+    if (seg_now()->shutdown.load(std::memory_order_acquire) != 0) {
       return nullptr;
     }
     if (attempt >= 1) return nullptr;  // one bounded wait per call
     uint32_t db = w->req_doorbell.load(std::memory_order_seq_cst);
     w->req_waiters.fetch_add(1, std::memory_order_seq_cst);
     if (!ring_has_data(r) &&
-        g_seg->shutdown.load(std::memory_order_acquire) == 0) {
+        seg_now()->shutdown.load(std::memory_order_acquire) == 0) {
       futex_wait_shared(&w->req_doorbell, db,
                         timeout_ms > 0 ? timeout_ms : 200);
     }
@@ -1190,7 +1490,7 @@ void* nat_shm_take_request(int timeout_ms) {
 int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
                     const char* payload, size_t payload_len, int32_t status,
                     const char* message, int close_after) {
-  if (g_seg == nullptr || g_my_slot < 0) return -1;
+  if (seg_now() == nullptr || g_my_slot < 0) return -1;
   size_t msg_len = message != nullptr ? strlen(message) : 0;
   // + the 16B worker-timing blob (take_ns, respond_ns) the parent's
   // emit stitches into the worker span
@@ -1198,7 +1498,7 @@ int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
   // can NEVER fit (response larger than the whole blob arena): fail now
   // instead of spinning on backpressure that cannot clear — the parent
   // reaper answers the request
-  if (blob_len + 8 + 128 > g_seg->arena_bytes) return -1;
+  if (blob_len + 8 + 128 > seg_now()->arena_bytes) return -1;
   ShmRing* r = wresp(g_my_slot);
   char* arena = resp_arena(g_my_slot);
   // BOUNDED backpressure: the arena normally frees within a drain pass,
@@ -1209,7 +1509,7 @@ int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
   auto give_up =
       std::chrono::steady_clock::now() + std::chrono::seconds(10);
   for (;;) {
-    if (g_seg->shutdown.load(std::memory_order_acquire) != 0) return -1;
+    if (seg_now()->shutdown.load(std::memory_order_acquire) != 0) return -1;
     uint64_t pos, span;
     char* dst;
     bool ok;
@@ -1229,9 +1529,9 @@ int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
     put_blob(p, (const char*)times, sizeof(times));
     ring_publish(r, pos, (uint8_t)kind, close_after != 0 ? 1 : 0, sock_id,
                  seq, status, span, (uint32_t)blob_len, 0);
-    g_seg->resp_doorbell.fetch_add(1, std::memory_order_seq_cst);
-    if (g_seg->resp_waiters.load(std::memory_order_seq_cst) != 0) {
-      futex_wake_shared(&g_seg->resp_doorbell);
+    seg_now()->resp_doorbell.fetch_add(1, std::memory_order_seq_cst);
+    if (seg_now()->resp_waiters.load(std::memory_order_seq_cst) != 0) {
+      futex_wake_shared(&seg_now()->resp_doorbell);
     }
     return 0;
   }
@@ -1248,7 +1548,7 @@ int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
 // consumer reads in place. Returns 0, or -1 when every ring is full (the
 // caller owns backpressure policy).
 int nat_shm_push_tensor(const char* data, size_t len, uint64_t tag) {
-  if (g_seg == nullptr) return -1;
+  if (seg_now() == nullptr) return -1;
   // kind-8 descriptors have no connection, so the sock_id/cid fields are
   // free: they carry this thread's ambient trace context (nat_trace_set)
   // across the process boundary — the consumer reads them back through
@@ -1278,7 +1578,7 @@ int nat_shm_push_tensor(const char* data, size_t len, uint64_t tag) {
 double nat_shm_push_bench(size_t record_bytes, double seconds,
                           uint64_t* out_records) {
   if (out_records != nullptr) *out_records = 0;
-  if (g_seg == nullptr || record_bytes == 0) return 0.0;
+  if (seg_now() == nullptr || record_bytes == 0) return 0.0;
   char* buf = (char*)malloc(record_bytes);
   if (buf == nullptr) return 0.0;
   NAT_RES_ALLOC(NR_SHM_SEG, record_bytes, buf);
@@ -1312,7 +1612,7 @@ double nat_shm_push_bench(size_t record_bytes, double seconds,
 // Returns the number of records drained; exits after `idle_exit_ms`
 // without data or on lane shutdown.
 uint64_t nat_shm_worker_drain_bench(int idle_exit_ms) {
-  if (g_seg == nullptr || g_my_slot < 0) return 0;
+  if (seg_now() == nullptr || g_my_slot < 0) return 0;
   ShmWorkerHdr* w = whdr(g_my_slot);
   ShmRing* r = wreq(g_my_slot);
   char* arena = req_arena(g_my_slot);
@@ -1327,12 +1627,12 @@ uint64_t nat_shm_worker_drain_bench(int idle_exit_ms) {
       drained++;
       got = true;
     }
-    g_seg->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
+    seg_now()->last_worker_poll_ms.store(mono_ms(), std::memory_order_relaxed);
     if (got) {
       last_work = std::chrono::steady_clock::now();
       continue;
     }
-    if (g_seg->shutdown.load(std::memory_order_acquire) != 0) break;
+    if (seg_now()->shutdown.load(std::memory_order_acquire) != 0) break;
     // exit only after a FULL quiet window: futex returns early on wakes,
     // EAGAIN and EINTR, none of which mean the producer is done
     if (std::chrono::steady_clock::now() - last_work >=
@@ -1342,7 +1642,7 @@ uint64_t nat_shm_worker_drain_bench(int idle_exit_ms) {
     uint32_t db = w->req_doorbell.load(std::memory_order_seq_cst);
     w->req_waiters.fetch_add(1, std::memory_order_seq_cst);
     if (!ring_has_data(r) &&
-        g_seg->shutdown.load(std::memory_order_acquire) == 0) {
+        seg_now()->shutdown.load(std::memory_order_acquire) == 0) {
       futex_wait_shared(&w->req_doorbell, db,
                         idle_exit_ms < 50 ? idle_exit_ms : 50);
     }

@@ -269,6 +269,65 @@ int main() {
     CHECK(nat_shm_lane_enable(0) == 0, "shm disable");
   }
 
+  // ---- tensor-fabric round (ISSUE 15): producer slot + receiver
+  // leases (held past the drain, released out of order) under a
+  // concurrent recover-probe — the ASan/TSan/lockrank/refguard lanes
+  // see the push/take/lease/probe overlaps on the same shm words the
+  // cross-process fabric uses ----
+  CHECK(nat_shm_lane_create(1u << 20) == 0, "fabric lane create");
+  {
+    CHECK(nat_shm_producer_attach(nat_shm_lane_name()) >= 0,
+          "fabric producer attach");
+    std::atomic<bool> probe_stop{false};
+    std::thread prober([&] {  // concurrent recovery probe: must find
+      while (!probe_stop.load(std::memory_order_acquire)) {
+        // nothing to recover while pushes/takes race it
+        nat_shm_lane_recover_probe();
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      }
+    });
+    size_t rec = 100u << 10;
+    char* tb = (char*)malloc(rec);
+    memset(tb, 9, rec);
+    int fab_pushed = 0, fab_taken = 0;
+    void* held[4] = {nullptr, nullptr, nullptr, nullptr};
+    int nheld = 0;
+    for (int i = 0; i < 120; i++) {
+      if (nat_shm_fabric_push(tb, rec, (uint64_t)i) != 0) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+        continue;
+      }
+      fab_pushed++;
+      void* h = nat_shm_fabric_take(2000);
+      CHECK(h != nullptr, "fabric take");
+      size_t n = 0;
+      const char* p = nat_req_field(h, 2, &n);
+      CHECK(n == rec && p != nullptr && p[0] == 9 && p[rec - 1] == 9,
+            "fabric lease view reads the arena in place");
+      fab_taken++;
+      if (nheld < 4) {
+        held[nheld++] = h;  // hold leases past further takes
+      } else {
+        // release the OLDEST held lease first (out of order vs the
+        // most recent take), then this one
+        nat_req_free(held[0]);
+        held[0] = held[1];
+        held[1] = held[2];
+        held[2] = held[3];
+        held[3] = h;
+      }
+    }
+    for (int i = 0; i < nheld && i < 4; i++) {
+      if (held[i] != nullptr) nat_req_free(held[i]);
+    }
+    free(tb);
+    CHECK(fab_pushed >= 50 && fab_taken == fab_pushed,
+          "fabric records all leased");
+    probe_stop.store(true, std::memory_order_release);
+    prober.join();
+    CHECK(nat_shm_lane_enable(0) == 0, "fabric disable");
+  }
+
   // ---- profiler round: SIGPROF sampling + fp unwind + seqlock sample
   // rings under instrumentation (the handler races the collector; the
   // sanitizer lanes must see both sides hot) ----

@@ -251,6 +251,7 @@ void NatSocket::release() {
       fill_req = nullptr;
       fill_off = 0;
     }
+    bulk_fill_abort(this);  // died mid-bulk-frame: slab back to the pool
     if (httpc != nullptr) {
       http_cli_free(httpc);
       httpc = nullptr;
@@ -292,6 +293,10 @@ void NatSocket::reset_for_reuse() {
   stream_seq = 0;
   fill_req = nullptr;
   fill_off = 0;
+  bulk_buf = nullptr;
+  bulk_cap = 0;
+  bulk_len = 0;
+  bulk_off = 0;
   http = nullptr;
   h2 = nullptr;
   redis = nullptr;
@@ -781,6 +786,13 @@ bool ring_drain_one(RingListener* ring) {
                 NAT_REF_RELEASE(s, sock.borrow);
                 continue;
               }
+              src += took;
+              len -= took;
+            }
+            if (s->bulk_buf != nullptr && len > 0) {
+              // bulk-frame fill: body bytes land in the pooled slab;
+              // the remainder (next frame) takes the normal path
+              size_t took = bulk_fill_feed(s, src, len);
               src += took;
               len -= took;
             }

@@ -119,6 +119,10 @@ static const char* kCounterNames[NS_COUNTER_COUNT] = {
     "nat_cluster_updates",
     "nat_cluster_backends_added",
     "nat_cluster_backends_removed",
+    "nat_fabric_pushes",
+    "nat_fabric_takes",
+    "nat_fabric_recover_drops",
+    "nat_bulk_fill_frames",
 };
 
 static const char* kLaneNames[NL_LANE_COUNT] = {

@@ -83,6 +83,14 @@ enum NatCounterId : int {
   NS_CLUSTER_UPDATES,       // naming-feed server-list swaps
   NS_CLUSTER_BACKENDS_ADDED,   // backends opened by naming additions
   NS_CLUSTER_BACKENDS_REMOVED, // backends retired by naming removals
+
+  NS_FABRIC_PUSHES,         // kind-8 tensor records pushed onto the
+                            // descriptor-ring fabric (both directions)
+  NS_FABRIC_TAKES,          // fabric records taken as receiver leases
+  NS_FABRIC_RECOVER_DROPS,  // fabric records discarded by dead-producer
+                            // slot recovery (sender died mid-stream)
+  NS_BULK_FILL_FRAMES,      // tpu_std frames whose payload landed in one
+                            // pooled bulk block via read-side fill mode
   NS_COUNTER_COUNT,
 };
 

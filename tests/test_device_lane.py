@@ -78,14 +78,16 @@ def remote_store():
     proc.wait(timeout=10)
 
 
-def test_two_process_shm_transfer(remote_store):
-    """Push+pull to a DIFFERENT process: payload crosses via the shared
-    arena (descriptor on the wire, zero payload bytes in the attachment)."""
+def test_two_process_ring_transfer(remote_store):
+    """Push+pull to a DIFFERENT process rides the descriptor-ring fabric
+    by default (ISSUE 15): the payload is written once into the
+    receiver's blob arena as kind-8 records, zero payload bytes in the
+    attachment, and the receiver consumes the spans in place."""
     port = remote_store
     ch = make_device_channel(f"127.0.0.1:{port}")
     client = TensorClient(ch)
 
-    shm0 = dt._dev_shm.get_value()
+    ring0 = dt._dev_ring.get_value()
     wire0 = dt._dev_wire.get_value()
 
     arr = np.arange(4096, dtype=np.float32).reshape(64, 64)
@@ -98,8 +100,10 @@ def test_two_process_shm_transfer(remote_store):
     assert isinstance(ep, dt.DeviceEndpoint)
     assert ep.state == dt.ESTABLISHED
     assert not ep.same_process and ep.same_host
-    # the established same-host path used the arena, not the wire
-    assert dt._dev_shm.get_value() == shm0 + 1
+    # the server advertised its fabric and the push used it — no wire
+    # payload, no send-arena staging
+    assert ep.peer_info.get("fabric"), "server did not advertise a fabric"
+    assert dt._dev_ring.get_value() == ring0 + 1
     assert dt._dev_wire.get_value() == wire0
     assert len(cntl.request_attachment) == 0  # no payload bytes on the wire
     # push response piggybacked the ACK: retention drained, window open
@@ -111,7 +115,52 @@ def test_two_process_shm_transfer(remote_store):
     np.testing.assert_array_equal(pulled[0], arr)
     assert len(cntl2.response_attachment) == 0
 
+    # multi-tensor pushes ride one record per tensor
+    arrs = [np.full((32, 32), i, dtype=np.int32) for i in range(3)]
+    cntl3, resp3 = client.push("multi", arrs)
+    assert not cntl3.failed(), cntl3.error_text
+    cntl4, pulled4 = client.pull("multi")
+    assert not cntl4.failed(), cntl4.error_text
+    for i in range(3):
+        np.testing.assert_array_equal(pulled4[i], arrs[i])
+
     ch.close()
+
+
+FABRIC_OFF_SERVER_SCRIPT = SERVER_SCRIPT.replace(
+    "import sys", "import os, sys\nos.environ['BRPC_TPU_FABRIC'] = '0'", 1)
+
+
+def test_two_process_shm_arena_fallback():
+    """With the fabric disabled on the server (BRPC_TPU_FABRIC=0) the
+    same-host lane falls back to the shared HostArena staging path —
+    still zero payload bytes on the wire."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen([sys.executable, "-c",
+                             FABRIC_OFF_SERVER_SCRIPT],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            text=True, cwd=repo_root)
+    try:
+        port = int(proc.stdout.readline())
+        ch = make_device_channel(f"127.0.0.1:{port}")
+        client = TensorClient(ch)
+
+        shm0 = dt._dev_shm.get_value()
+        wire0 = dt._dev_wire.get_value()
+        arr = np.arange(4096, dtype=np.float32).reshape(64, 64)
+        cntl, resp = client.push("w", [arr])
+        assert not cntl.failed(), cntl.error_text
+        ep = cntl._current_sock.app_state
+        assert not ep.peer_info.get("fabric")
+        assert dt._dev_shm.get_value() == shm0 + 1
+        assert dt._dev_wire.get_value() == wire0
+        assert len(cntl.request_attachment) == 0
+        cntl2, pulled = client.pull("w")
+        np.testing.assert_array_equal(pulled[0], arr)
+        ch.close()
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=10)
 
 
 def test_two_process_window_retention(remote_store):

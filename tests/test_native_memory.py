@@ -180,6 +180,39 @@ def test_churn_balance_dial_call_close():
 
 
 @pytest.mark.slow
+def test_churn_balance_fabric_leases():
+    """Tensor-fabric lease churn (ISSUE 15): six rounds of push -> take
+    -> (held, then out-of-order released) leases leave the shm.span
+    ledger row exactly balanced, and while leases are held the row
+    carries exactly the leased payload bytes — the zero-copy structural
+    contract (payload bytes accounted once per transfer)."""
+    lib = native.load()
+    lib.nat_shm_lane_enable(0)
+    assert lib.nat_shm_lane_create(1 << 20) == 0
+    assert lib.nat_shm_producer_attach(lib.nat_shm_lane_name()) >= 0
+    base = _res_rows()["shm.span"]
+    payload = b"t" * (64 << 10)
+    for _ in range(6):
+        held = []
+        for i in range(4):
+            assert lib.nat_shm_fabric_push(payload, len(payload), i) == 0
+            lease = native.fabric_take(2000)
+            assert lease is not None
+            held.append(lease)
+        row = _res_rows()["shm.span"]
+        assert row["live_bytes"] - base["live_bytes"] \
+            == 4 * len(payload)
+        assert row["live_objects"] - base["live_objects"] == 4
+        for lease in reversed(held):  # out-of-order vs take order
+            lease.release()
+        row = _res_rows()["shm.span"]
+        assert row["live_bytes"] == base["live_bytes"]
+        assert row["live_objects"] == base["live_objects"]
+    final = _res_rows()["shm.span"]
+    assert final["cum_allocs"] - base["cum_allocs"] == 24
+    assert final["cum_frees"] - base["cum_frees"] == 24
+
+
 def test_churn_balance_shm_worker_sigkill_recover():
     """The shm half of the churn-balance contract: a worker SIGKILLed
     mid-request is recovered (fence probe, arena scrub, slot reap) and

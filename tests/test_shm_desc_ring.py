@@ -123,6 +123,117 @@ def test_record_size_sweep_monotone_throughput():
         child.wait(timeout=15)
 
 
+def test_fabric_lease_out_of_order_release_and_wrap():
+    """Tensor-fabric leases (ISSUE 15): the receiver holds record spans
+    past the drain loop and releases OUT OF ORDER; a held lease pins the
+    arena head (content stays intact while later records churn), and
+    after release the ring wraps cleanly with byte-identical payloads.
+    Leased payload bytes sit in the shm.span nat_res ledger row — the
+    structural zero-copy witness (payload bytes accounted ONCE)."""
+    _fresh_lane(1 << 20)
+    name = lib.nat_shm_lane_name()
+    assert lib.nat_shm_producer_attach(name) >= 0  # in-process producer
+
+    def span_row():
+        return {r["subsystem"]: r for r in native.res_stats()}["shm.span"]
+
+    live0 = span_row()["live_bytes"]
+    pat_a = bytes(range(256)) * 800   # 200KB
+    pat_b = b"B" * (200 << 10)
+    assert lib.nat_shm_fabric_push(pat_a, len(pat_a), 1) == 0
+    assert lib.nat_shm_fabric_push(pat_b, len(pat_b), 2) == 0
+    la = native.fabric_take(2000)
+    lb = native.fabric_take(2000)
+    assert la is not None and lb is not None
+    assert la.tag == 1 and lb.tag == 2
+    # both spans pinned: the ledger carries exactly the leased bytes
+    assert span_row()["live_bytes"] - live0 == len(pat_a) + len(pat_b)
+    # zero-copy: the lease view IS the arena span (no staging buffer)
+    import numpy as np
+
+    va = np.frombuffer(la.view(), dtype=np.uint8)
+    assert va.ctypes.data == la._ptr
+    lb.release()  # OUT OF ORDER: b released while a (earlier) is held
+    # churn more records past the held lease: the arena head is pinned
+    # at a, but tail space still serves pushes until exhaustion
+    churned = 0
+    for i in range(3, 10):
+        if lib.nat_shm_fabric_push(pat_b, len(pat_b), i) != 0:
+            break
+        h = native.fabric_take(2000)
+        assert h is not None
+        h.release()
+        churned += 1
+    assert churned >= 1
+    # the held span's content is untouched by the churn
+    assert bytes(va[:1024]) == pat_a[:1024]
+    assert va[-1] == pat_a[-1]
+    la.release()
+    # head unpinned: the ring now wraps the arena edge many times over
+    for i in range(12):
+        assert lib.nat_shm_fabric_push(pat_a, len(pat_a), 100 + i) == 0, i
+        h = native.fabric_take(2000)
+        assert h is not None and h.tag == 100 + i
+        assert h.tobytes() == pat_a, f"wrap corrupted record {i}"
+        h.release()
+    assert span_row()["live_bytes"] == live0  # every lease retired
+
+
+def test_producer_sigkill_lease_epoch_guard():
+    """SIGKILL a PRODUCER process while the receiver holds one of its
+    leases: the robust fence surfaces EOWNERDEAD, recovery waits the
+    lease out (bounded), drops the untaken record (counted), and the
+    slot serves a fresh producer. The stale lease's release after
+    recovery is epoch-fenced — no scribble on the recycled arena."""
+    _fresh_lane(1 << 20)
+    name = lib.nat_shm_lane_name().decode()
+    drops0 = native.stats_counters().get("nat_fabric_recover_drops", 0)
+    child = subprocess.Popen(
+        [sys.executable, "-c", (
+            "import sys, time; sys.path.insert(0, '.')\n"
+            "from brpc_tpu import native\n"
+            "lib = native.load()\n"
+            f"assert lib.nat_shm_producer_attach({name!r}.encode()) >= 0\n"
+            "assert lib.nat_shm_fabric_push(b'x' * 100000, 100000, 1) == 0\n"
+            "assert lib.nat_shm_fabric_push(b'y' * 100000, 100000, 2) == 0\n"
+            "print('PUSHED', flush=True)\n"
+            "time.sleep(60)\n")],
+        stdout=subprocess.PIPE, text=True, cwd=".")
+    assert child.stdout.readline().strip() == "PUSHED"
+    lease = native.fabric_take(5000)
+    assert lease is not None and lease.tag == 1
+    child.send_signal(signal.SIGKILL)
+    child.wait(timeout=10)
+    # recovery: EOWNERDEAD on the probe; the held lease is waited out
+    # (bounded 5s) and then epoch-fenced; record 2 is dropped + counted
+    t0 = time.time()
+    recovered = 0
+    while recovered == 0 and time.time() - t0 < 20:
+        recovered = lib.nat_shm_lane_recover_probe()
+        if recovered == 0:
+            time.sleep(0.1)
+    assert recovered == 1, "dead producer's fence was not recovered"
+    assert native.stats_counters()["nat_fabric_recover_drops"] \
+        >= drops0 + 1
+    lease.release()  # stale epoch: must be a harmless no-op
+    # the freed slot serves a replacement producer end to end
+    child2 = subprocess.Popen(
+        [sys.executable, "-c", (
+            "import sys; sys.path.insert(0, '.')\n"
+            "from brpc_tpu import native\n"
+            "lib = native.load()\n"
+            f"assert lib.nat_shm_producer_attach({name!r}.encode()) >= 0\n"
+            "assert lib.nat_shm_fabric_push(b'z' * 50000, 50000, 9) == 0\n"
+            "print('OK', flush=True)\n")],
+        stdout=subprocess.PIPE, text=True, cwd=".")
+    assert child2.stdout.readline().strip() == "OK"
+    child2.wait(timeout=10)
+    fresh = native.fabric_take(5000)
+    assert fresh is not None and fresh.tag == 9
+    assert fresh.tobytes() == b"z" * 50000
+    fresh.release()
+
+
 def test_worker_sigkill_mid_record_recovery():
     """SIGKILL a worker that consumed a record but never released its
     span or answered: the robust lifetime fence must surface the death

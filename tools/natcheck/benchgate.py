@@ -88,6 +88,13 @@ HEADLINE_LANES: Dict[str, float] = {
     "native_bulk_GBps": 0.30,
     "shm_desc_GBps": 0.30,
     "shm_desc_small_GBps": 0.50,
+    # tensor-fabric RPC push lane (ISSUE 15): the full device-channel
+    # path (kind-8 arena write -> descriptor RPC -> lease consume); a
+    # Python RPC stack drives it, so the band is the py-lane class.
+    # read_arena_grow_GBps reports 0 when the grow path reintroduces
+    # the first-touch fault cliff, tripping the band like a collapse.
+    "shm_push_GBps": 0.50,
+    "read_arena_grow_GBps": 0.50,
     # multicore scaling efficiency (bench.py --cpus N): qps(2cpus) /
     # qps(1cpu) from the pinned two-process lane. On the shared dev
     # container the HOST's own parallel capacity swings 1.3-2.2x run
