@@ -634,6 +634,14 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # fleet-observatory scrape overhead (ISSUE 16): 1Hz builtin.stats
+    # scrape armed vs unarmed — the <=3% always-on-scraping contract
+    fleet_lanes = {}
+    try:
+        fleet_lanes = fleet_scrape_bench(round_s=max(1.0, seconds / 2))
+    except Exception:
+        pass
+
     # connection-scale drill (ISSUE 14, ROADMAP item 5): 20k mostly-idle
     # keep-alive connections from client subprocesses, per-connection
     # bytes/fd/wakeup cost from the nat_res accounting, accept-storm
@@ -775,6 +783,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             **replay_lanes,
             **fanout_lanes,
             **swarm_lanes,
+            **fleet_lanes,
             **conn_lanes,
             **worker_lanes,
             **stream_lanes,
@@ -811,6 +820,61 @@ def replay_lane_bench(times: int = 3, concurrency: int = 8) -> dict:
         return {"replay_qps": 0.0, "replay_failed": res["failed"]}
     return {"replay_qps": round(res["qps"], 1),
             "replay_p99_us": round(res["p99_us"], 1)}
+
+
+def fleet_scrape_bench(round_s: float = 1.5, rounds: int = 3,
+                       nconn: int = 2, fibers_per_conn: int = 32,
+                       payload: int = 16) -> dict:
+    """fleet_scrape_overhead_pct (ISSUE 16): headline echo qps with a
+    1Hz fleet observatory scraping the SAME server (builtin.stats over
+    the wire, full snapshot JSON each tick) versus unarmed, as a
+    percent. The acceptance bar is <= 3% — the snapshot must stay cheap
+    enough that always-on fleet scraping is free. Alternating
+    unarmed/armed rounds, MAX qps per arm (host-noise discipline: a
+    depressed round in either arm cannot fake an overhead or mask one
+    that is real)."""
+    from brpc_tpu import native
+    from brpc_tpu.fleet import FleetObservatory
+
+    port = native.rpc_server_start(native_echo=True)
+    unarmed = 0.0
+    armed = 0.0
+    scrapes = 0
+    try:
+        # discarded warmup: the first round after server start runs cold
+        # (fiber pool, dispatcher, client sockets) and measures ~25%
+        # low on this host — an outlier in either arm would fake or
+        # mask an overhead
+        native.rpc_client_bench("127.0.0.1", port, nconn=nconn,
+                                fibers_per_conn=fibers_per_conn,
+                                seconds=min(1.0, round_s), payload=payload)
+        for _ in range(rounds):
+            r = native.rpc_client_bench("127.0.0.1", port, nconn=nconn,
+                                        fibers_per_conn=fibers_per_conn,
+                                        seconds=round_s, payload=payload)
+            unarmed = max(unarmed, r["qps"])
+            obs = FleetObservatory(endpoints=[f"127.0.0.1:{port}"],
+                                   interval_s=1.0, register_bvars=False)
+            try:
+                obs.scrape_once()  # the loop ticks at 1Hz; prime now so
+                obs.start()        # even a sub-second round is scraped
+                r = native.rpc_client_bench(
+                    "127.0.0.1", port, nconn=nconn,
+                    fibers_per_conn=fibers_per_conn,
+                    seconds=round_s, payload=payload)
+                armed = max(armed, r["qps"])
+                scrapes += obs.scrape_counts()[0]
+            finally:
+                obs.close()
+    finally:
+        native.rpc_server_stop()
+    if unarmed <= 0:
+        return {}
+    overhead = max(0.0, (1.0 - armed / unarmed) * 100.0)
+    return {"fleet_scrape_overhead_pct": round(overhead, 2),
+            "fleet_scrape_unarmed_qps": round(unarmed, 1),
+            "fleet_scrape_armed_qps": round(armed, 1),
+            "fleet_scrape_count": scrapes}
 
 
 def fanout_lane_bench(seconds: float = 1.5, backends: int = 32) -> dict:
@@ -1141,6 +1205,13 @@ def _spawn_swarm_server(base: int, count: int, repo_root: str, env: dict):
     if churn_spec:
         env = dict(env)
         env["NAT_FAULT"] = churn_spec
+    # BRPC_TPU_SWARM_LIMITER (ISSUE 16 drill hook): arm the native
+    # admission limiter in the SERVER process ("constant:1", "auto", ...)
+    # so a fleet drill can inject real ELIMIT overload on a member —
+    # py-lane floods past the limit shed with 2004 on the wire while the
+    # native echo path keeps serving
+    limiter_spec = env.get("BRPC_TPU_SWARM_LIMITER") or \
+        os.environ.get("BRPC_TPU_SWARM_LIMITER") or ""
 
     script = (
         "import os, signal, sys\n"
@@ -1153,6 +1224,8 @@ def _spawn_swarm_server(base: int, count: int, repo_root: str, env: dict):
         "    native.rpc_server_start('127.0.0.1', base, 2, True)\n"
         "    for p in range(base + 1, base + count):\n"
         "        native.rpc_server_add_port('127.0.0.1', p)\n"
+        f"    if {limiter_spec!r}:\n"
+        f"        native.rpc_server_limiter({limiter_spec!r})\n"
         "except Exception:\n"
         "    print('BINDFAIL', flush=True)\n"
         "    sys.exit(17)\n"

@@ -218,6 +218,26 @@ def _metrics_handler(server, req):
     return 200, "text/plain; version=0.0.4", bvar.dump_prometheus()
 
 
+def _fleet_handler(server, req):
+    """/fleet: the fleet observatory rollup — merged methods (quantiles
+    off MERGED log2 buckets), per-member breaker/lame-duck/overload
+    state, SLO burn rates. ?backend=ip:port drills into one member;
+    ?json=1 dumps the rollup; ?trace_id=<hex> fans find_trace across
+    the swarm."""
+    try:
+        from brpc_tpu import fleet
+    except ImportError:
+        return 200, "text/plain", "fleet: module not loaded\n"
+    tid = req.query.get("trace_id")
+    if tid:
+        parts = []
+        for obs in fleet.active_observatories():
+            parts.append(obs.stitched_trace(int(tid, 16)))
+        return 200, "text/plain", ("".join(parts)
+                                   or "no fleet observatory running\n")
+    return 200, "text/plain", fleet.render_fleet_page(req.query)
+
+
 def _protobufs_handler(server, req):
     """/protobufs: message schemas in use (builtin/protobufs_service.cpp)."""
     seen = {}
@@ -536,6 +556,7 @@ def attach_console(server):
         "growth": _growth_handler,
         "rpc_dump": _rpc_dump_handler,
         "rpcz": _rpcz_handler,
+        "fleet": _fleet_handler,
         "list": _list_handler,
         "vlog": _vlog_handler,
         "dir": _dir_handler,

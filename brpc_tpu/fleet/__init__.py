@@ -1,0 +1,32 @@
+"""Fleet observatory: wire-native stats scrape, mergeable log2
+histograms, and an SLO burn-rate engine for the swarm (ISSUE 16).
+
+- :mod:`brpc_tpu.fleet.hist` — the Python twin of the native log2
+  bucket discipline; merge-by-summation, quantiles off merged buckets.
+- :mod:`brpc_tpu.fleet.slo` — declarative objectives evaluated as
+  multi-window (fast 5m / slow 1h) burn rates.
+- :mod:`brpc_tpu.fleet.observatory` — the collector: drives a
+  NativeCluster over the naming feeds, scrapes every member's
+  ``builtin.stats`` endpoint, merges, drives /fleet + fleet_* rows,
+  fans find_trace across the swarm.
+"""
+from brpc_tpu.fleet import hist
+from brpc_tpu.fleet.observatory import (
+    FLEET_VAR_NAMES,
+    FleetObservatory,
+    active_observatories,
+    register_fleet_bvars,
+    render_fleet_page,
+)
+from brpc_tpu.fleet.slo import SloEngine, SloObjective
+
+__all__ = [
+    "FLEET_VAR_NAMES",
+    "FleetObservatory",
+    "SloEngine",
+    "SloObjective",
+    "active_observatories",
+    "hist",
+    "register_fleet_bvars",
+    "render_fleet_page",
+]

@@ -1,0 +1,584 @@
+"""Fleet observatory — the cross-process observability plane (ISSUE 16).
+
+Every per-process surface (per-method stats, breakers, the nat_mem
+ledger, /rpcz) ends at one server's console; the fleet twin drives a
+NativeCluster over the SAME naming feeds the data plane resolves
+through, scrapes every backend's wire-native ``builtin.stats`` endpoint
+(one tpu_std call returning the versioned snapshot JSON with RAW log2
+histogram buckets), and merges:
+
+- counters by summation, histograms by bucket-wise addition (exact for
+  log2 buckets — fleet quantiles come from the MERGED histogram, never
+  from averaged per-server percentiles);
+- per-method rollups with per-backend drill-down;
+- breaker / lame-duck / overload / quiesce state per member, from both
+  sides: the member's own snapshot (server draining, inflight/limit,
+  its client channels) and the collector's cluster view (breaker_open /
+  lame_duck per backend).
+
+On top ride the ``/fleet`` console page, ``fleet_*{backend=}``
+Prometheus rows, an :class:`~brpc_tpu.fleet.slo.SloEngine` evaluating
+declarative objectives as multi-window burn rates over the merged
+streams, and ``find_trace`` fan-out: one trace id queried against every
+member's /rpcz returns the stitched cross-process chain.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from brpc_tpu.fleet import hist as _hist
+from brpc_tpu.fleet.slo import SloEngine, SloObjective
+
+# live observatories, walked by /fleet and the fleet_* bvar rows (weak:
+# a dropped observatory vanishes from the console like a dropped cluster)
+_registry: "weakref.WeakSet[FleetObservatory]" = weakref.WeakSet()
+_registry_lock = threading.Lock()
+
+
+def active_observatories() -> List["FleetObservatory"]:
+    with _registry_lock:
+        return [o for o in _registry if not o.closed]
+
+
+class BackendSnapshot:
+    """Latest scrape result of one member."""
+
+    __slots__ = ("endpoint", "ok", "ts", "data", "error")
+
+    def __init__(self, endpoint: str, ok: bool, ts: float,
+                 data: Optional[dict], error: str = ""):
+        self.endpoint = endpoint
+        self.ok = ok
+        self.ts = ts
+        self.data = data
+        self.error = error
+
+
+class FleetObservatory:
+    """Scrape -> merge -> evaluate, on an interval or on demand.
+
+    ``naming_url`` (e.g. ``file:///tmp/fleet.ns``) resolves membership
+    through the shared NamingService registry exactly like the data
+    plane; a static ``endpoints`` list works for tests. ``console_map``
+    maps a backend endpoint to the address serving its /rpcz page for
+    find_trace fan-out (defaults to the backend endpoint itself).
+    """
+
+    def __init__(self, naming_url: Optional[str] = None,
+                 endpoints: Optional[Sequence[str]] = None,
+                 interval_s: float = 1.0,
+                 objectives: Sequence[SloObjective] = (),
+                 name: str = "fleet",
+                 scrape_timeout_ms: int = 1000,
+                 console_map: Optional[Dict[str, str]] = None,
+                 register_bvars: bool = True):
+        from brpc_tpu.rpc.native_cluster import NativeCluster
+
+        self.name = name
+        self.closed = False
+        self._interval = max(0.05, float(interval_s))
+        self._timeout_ms = scrape_timeout_ms
+        self._console_map = dict(console_map or {})
+        self._lock = threading.Lock()
+        self._channels: Dict[str, object] = {}  # endpoint -> native handle
+        self._snapshots: Dict[str, BackendSnapshot] = {}
+        self._merged: dict = {"ts": 0.0, "backends": {}, "counters": {},
+                              "methods": {}, "lanes": {}, "mem": {}}
+        self._scrapes = 0
+        self._scrape_errors = 0
+        self.slo = SloEngine(list(objectives))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        self._cluster = NativeCluster(lb="rr", connect_timeout_ms=500,
+                                      health_check_ms=200, breaker=True,
+                                      name=f"{name}-observatory")
+        if naming_url is not None:
+            self._cluster.watch(naming_url)
+        elif endpoints:
+            self._cluster.update(list(endpoints))
+        with _registry_lock:
+            _registry.add(self)
+        if register_bvars:
+            register_fleet_bvars()
+
+    # -- membership --------------------------------------------------------
+    def backends(self) -> List[dict]:
+        """The collector-side member view: cluster rows (endpoint,
+        breaker_open, lame_duck, selects, ...)."""
+        return self._cluster.stats()
+
+    def update(self, endpoints: Sequence[str]) -> int:
+        return self._cluster.update(list(endpoints))
+
+    # -- scraping ----------------------------------------------------------
+    def _channel(self, endpoint: str):
+        from brpc_tpu import native
+
+        ch = self._channels.get(endpoint)
+        if ch is not None:
+            return ch
+        ip, _, port = endpoint.rpartition(":")
+        ch = native.channel_open(ip, int(port))
+        if ch:
+            self._channels[endpoint] = ch
+        return ch
+
+    def _drop_channel(self, endpoint: str):
+        from brpc_tpu import native
+
+        ch = self._channels.pop(endpoint, None)
+        if ch is not None:
+            try:
+                native.channel_close(ch)
+            except Exception:
+                pass
+
+    def _scrape_backend(self, endpoint: str) -> BackendSnapshot:
+        from brpc_tpu import native
+
+        now = time.time()
+        try:
+            ch = self._channel(endpoint)
+            if not ch:
+                return BackendSnapshot(endpoint, False, now, None, "dial")
+            rc, body, err = native.channel_call(
+                ch, "builtin", "stats", b"", timeout_ms=self._timeout_ms)
+            if rc != 0:
+                self._drop_channel(endpoint)
+                return BackendSnapshot(endpoint, False, now, None,
+                                       f"rc={rc} {err or ''}".strip())
+            return BackendSnapshot(endpoint, True, now, json.loads(body))
+        except Exception as exc:  # parse error, native unload, ...
+            self._drop_channel(endpoint)
+            return BackendSnapshot(endpoint, False, now, None, str(exc))
+
+    def scrape_once(self) -> dict:
+        """One scrape round over the current membership: refresh every
+        member's snapshot, rebuild the merged rollup, feed the SLO
+        engine. Returns the merged rollup."""
+        rows = self._cluster.stats()
+        snaps: Dict[str, BackendSnapshot] = {}
+        for row in rows:
+            snap = self._scrape_backend(row["endpoint"])
+            snaps[row["endpoint"]] = snap
+        with self._lock:
+            self._scrapes += 1
+            self._scrape_errors += sum(1 for s in snaps.values()
+                                       if not s.ok)
+            self._snapshots = snaps
+            merged = _merge_snapshots(snaps, rows)
+            self._merged = merged
+        self.slo.ingest(merged)
+        return merged
+
+    # -- background loop ---------------------------------------------------
+    def start(self) -> "FleetObservatory":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name=f"fleet-{self.name}", daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            if self.closed:
+                return
+            try:
+                self.scrape_once()
+            except Exception:
+                with self._lock:
+                    self._scrape_errors += 1
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)
+
+    # -- readout -----------------------------------------------------------
+    def merged(self) -> dict:
+        with self._lock:
+            return self._merged
+
+    def snapshots(self) -> Dict[str, BackendSnapshot]:
+        with self._lock:
+            return dict(self._snapshots)
+
+    def scrape_counts(self) -> Tuple[int, int]:
+        with self._lock:
+            return self._scrapes, self._scrape_errors
+
+    def method_quantile(self, method: str, q: float,
+                        lane: str = "echo") -> float:
+        """Fleet quantile (ns) of one merged method stream — computed
+        from the MERGED buckets."""
+        row = self.merged().get("methods", {}).get(f"{lane}/{method}")
+        if not row:
+            return 0.0
+        return _hist.quantile(row["buckets"], q)
+
+    # -- find_trace fan-out ------------------------------------------------
+    def console_of(self, endpoint: str) -> str:
+        return self._console_map.get(endpoint, endpoint)
+
+    def find_trace(self, trace_id: int,
+                   timeout_s: float = 3.0) -> List[dict]:
+        """Fan one trace id out across every member's /rpcz (plus the
+        local span store): [{"backend", "body"}] for each member that
+        holds part of the chain — the stitched cross-process view."""
+        out: List[dict] = []
+        needle = f"{trace_id:x}"
+        try:
+            from brpc_tpu import rpcz
+
+            local = rpcz.describe_recent_spans({"trace_id": needle})
+            if _has_spans(local):
+                out.append({"backend": "(local)", "body": local})
+        except Exception:
+            pass
+        seen = set()
+        for row in self._cluster.stats():
+            console = self.console_of(row["endpoint"])
+            if console in seen:
+                continue
+            seen.add(console)
+            body = _http_get(console, f"/rpcz?trace_id={needle}",
+                             timeout_s)
+            if body is not None and _has_spans(body):
+                out.append({"backend": console, "body": body})
+        return out
+
+    def stitched_trace(self, trace_id: int, timeout_s: float = 3.0) -> str:
+        parts = self.find_trace(trace_id, timeout_s)
+        if not parts:
+            return f"trace {trace_id:x}: no spans on any member\n"
+        lines = [f"trace {trace_id:x}: spans on {len(parts)} member(s)"]
+        for p in parts:
+            lines.append(f"--- {p['backend']} ---")
+            lines.append(p["body"].rstrip("\n"))
+        return "\n".join(lines) + "\n"
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        self.stop()
+        for ep in list(self._channels):
+            self._drop_channel(ep)
+        self._cluster.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _has_spans(body: str) -> bool:
+    return "trace=" in body
+
+
+def _http_get(endpoint: str, path: str,
+              timeout_s: float) -> Optional[str]:
+    ip, _, port = endpoint.rpartition(":")
+    try:
+        conn = http.client.HTTPConnection(ip, int(port),
+                                          timeout=timeout_s)
+        try:
+            conn.request("GET", path)
+            r = conn.getresponse()
+            if r.status != 200:
+                return None
+            return r.read().decode(errors="replace")
+        finally:
+            conn.close()
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+def _merge_snapshots(snaps: Dict[str, BackendSnapshot],
+                     cluster_rows: List[dict]) -> dict:
+    """One merged rollup: counters summed, histograms bucket-summed,
+    per-method rows keyed "lane/Service.Method" with per-backend
+    drill-down, per-member state from both the member's own snapshot and
+    the collector's cluster view."""
+    by_ep = {r["endpoint"]: r for r in cluster_rows}
+    merged: dict = {"ts": time.time(), "backends": {}, "counters": {},
+                    "methods": {}, "lanes": {}, "mem": {}}
+    for ep, snap in snaps.items():
+        crow = by_ep.get(ep, {})
+        brow = {
+            "up": snap.ok,
+            "age_s": round(time.time() - snap.ts, 3),
+            "error": snap.error,
+            # collector-side view (its own channels to this member)
+            "breaker_open": bool(crow.get("breaker_open", False)),
+            "lame_duck": bool(crow.get("lame_duck", False)),
+            "selects": crow.get("selects", 0),
+            "errors": crow.get("errors", 0),
+        }
+        if snap.ok and snap.data:
+            d = snap.data
+            srv = d.get("server", {})
+            brow["draining"] = bool(srv.get("draining", 0))
+            brow["inflight"] = srv.get("inflight", 0)
+            brow["limit"] = srv.get("limit", 0)
+            brow["elimit_rejects"] = d.get("counters", {}).get(
+                "nat_elimit_rejects", 0)
+            brow["channels"] = d.get("channels", [])
+            for cname, v in d.get("counters", {}).items():
+                merged["counters"][cname] = \
+                    merged["counters"].get(cname, 0) + v
+            for lane, sparse in d.get("lanes", {}).items():
+                dense = _hist.dense(sparse)
+                cur = merged["lanes"].get(lane)
+                merged["lanes"][lane] = (
+                    _hist.merge(cur, dense) if cur else dense)
+            for m in d.get("methods", []):
+                key = f"{m['lane']}/{m['method']}"
+                dense = _hist.dense(m.get("buckets", []))
+                row = merged["methods"].get(key)
+                if row is None:
+                    row = {"lane": m["lane"], "method": m["method"],
+                           "count": 0, "errors": 0, "concurrency": 0,
+                           "max_concurrency": 0,
+                           "buckets": [0] * _hist.NBUCKETS,
+                           "per_backend": {}}
+                    merged["methods"][key] = row
+                row["count"] += m.get("count", 0)
+                row["errors"] += m.get("errors", 0)
+                row["concurrency"] += max(0, m.get("concurrency", 0))
+                row["max_concurrency"] = max(
+                    row["max_concurrency"], m.get("max_concurrency", 0))
+                row["buckets"] = _hist.merge(row["buckets"], dense)
+                row["per_backend"][ep] = {
+                    "count": m.get("count", 0),
+                    "errors": m.get("errors", 0),
+                    "p99_us": round(_hist.quantile(dense, 0.99) / 1e3, 1),
+                }
+            for sub, r in d.get("mem", {}).items():
+                cur = merged["mem"].setdefault(
+                    sub, {"live_bytes": 0, "live_objects": 0,
+                          "hwm_bytes": 0})
+                cur["live_bytes"] += r.get("live_bytes", 0)
+                cur["live_objects"] += r.get("live_objects", 0)
+                cur["hwm_bytes"] += r.get("hwm_bytes", 0)
+        merged["backends"][ep] = brow
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# /fleet page + fleet_* bvar rows
+# ---------------------------------------------------------------------------
+
+def render_fleet_page(query: Optional[dict] = None) -> str:
+    """/fleet body: fleet rollup + per-backend drill-down + SLO burn
+    table, over every active observatory. ``?backend=ip:port`` drills
+    into one member's latest snapshot; ``?json=1`` dumps the rollup."""
+    query = query or {}
+    obs_list = active_observatories()
+    if not obs_list:
+        return ("no fleet observatory running (construct "
+                "brpc_tpu.fleet.FleetObservatory and start() it)\n")
+    if query.get("json"):
+        return json.dumps({o.name: o.merged() for o in obs_list},
+                          default=str) + "\n"
+    lines: List[str] = []
+    for obs in obs_list:
+        merged = obs.merged()
+        scrapes, errors = obs.scrape_counts()
+        lines.append(f"[fleet.{obs.name}]")
+        lines.append(f"backends: {len(merged.get('backends', {}))}  "
+                     f"scrapes: {scrapes}  scrape_errors: {errors}")
+        drill = query.get("backend")
+        if drill:
+            lines += _render_drilldown(obs, drill)
+            lines.append("")
+            continue
+        lines.append("")
+        lines.append("-- members --")
+        for ep, b in sorted(merged.get("backends", {}).items()):
+            state = []
+            if not b.get("up"):
+                state.append(f"DOWN({b.get('error', '?')})")
+            if b.get("draining"):
+                state.append("draining")
+            if b.get("breaker_open"):
+                state.append("breaker_open")
+            if b.get("lame_duck"):
+                state.append("lame_duck")
+            lines.append(
+                f"{ep}  {'|'.join(state) or 'up'}  "
+                f"inflight={b.get('inflight', '-')} "
+                f"limit={b.get('limit', '-')} "
+                f"elimit_rejects={b.get('elimit_rejects', '-')}")
+        lines.append("")
+        lines.append("-- merged methods (quantiles from MERGED log2 "
+                     "buckets) --")
+        for key, m in sorted(merged.get("methods", {}).items()):
+            p50 = _hist.quantile(m["buckets"], 0.50) / 1e3
+            p99 = _hist.quantile(m["buckets"], 0.99) / 1e3
+            lines.append(
+                f"{key}  count={m['count']} errors={m['errors']} "
+                f"p50_us={p50:.1f} p99_us={p99:.1f} "
+                f"members={len(m['per_backend'])}")
+        slo = obs.slo.status()
+        if slo:
+            lines.append("")
+            lines.append("-- SLO burn rates (fast/slow windows) --")
+            for name, st in sorted(slo.items()):
+                lines.append(
+                    f"{name} [{st['kind']}] "
+                    f"{'FIRING' if st['alert'] else 'ok'}  "
+                    f"fast={st['fast_burn']:.2f}/{st['fast_threshold']} "
+                    f"slow={st['slow_burn']:.2f}/{st['slow_threshold']} "
+                    f"fired={st['fired_total']} "
+                    f"cleared={st['cleared_total']}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _render_drilldown(obs: "FleetObservatory", endpoint: str) -> List[str]:
+    snap = obs.snapshots().get(endpoint)
+    if snap is None:
+        return [f"backend {endpoint}: unknown member"]
+    if not snap.ok or not snap.data:
+        return [f"backend {endpoint}: DOWN ({snap.error})"]
+    d = snap.data
+    lines = [f"-- {endpoint} (snapshot v{d.get('v')}) --",
+             f"server: {json.dumps(d.get('server', {}))}"]
+    for m in d.get("methods", []):
+        dense = _hist.dense(m.get("buckets", []))
+        lines.append(
+            f"{m['lane']}/{m['method']}  count={m['count']} "
+            f"errors={m['errors']} "
+            f"p99_us={_hist.quantile(dense, 0.99) / 1e3:.1f}")
+    chans = d.get("channels", [])
+    if chans:
+        lines.append(f"channels: {json.dumps(chans)}")
+    return lines
+
+
+# one-time idempotent registration (the native_vars discipline):
+# PassiveStatus rows reading the active observatories; labeled dicts for
+# per-backend / per-method / per-objective dimensions
+_fleet_vars: List[object] = []
+_fleet_vars_lock = threading.Lock()
+
+
+def _merged_of_all() -> List[Tuple["FleetObservatory", dict]]:
+    return [(o, o.merged()) for o in active_observatories()]
+
+
+def _backend_dim(field: str, as_int=True):
+    out = {}
+    for obs, merged in _merged_of_all():
+        for ep, b in merged.get("backends", {}).items():
+            v = b.get(field, 0)
+            out[(("fleet", obs.name), ("backend", ep))] = \
+                int(v) if as_int else v
+    return out
+
+
+def _method_dim(field: str):
+    out = {}
+    for obs, merged in _merged_of_all():
+        for key, m in merged.get("methods", {}).items():
+            out[(("fleet", obs.name), ("method", key))] = m.get(field, 0)
+    return out
+
+
+def _method_p99_dim():
+    out = {}
+    for obs, merged in _merged_of_all():
+        for key, m in merged.get("methods", {}).items():
+            out[(("fleet", obs.name), ("method", key))] = \
+                round(_hist.quantile(m["buckets"], 0.99) / 1e3, 1)
+    return out
+
+
+def _slo_dim(field: str, as_int=False):
+    out = {}
+    for obs in active_observatories():
+        for name, st in obs.slo.status().items():
+            v = st.get(field, 0)
+            out[(("fleet", obs.name), ("slo", name))] = \
+                int(v) if as_int else v
+    return out
+
+
+def register_fleet_bvars() -> bool:
+    """Idempotently expose the fleet_* bvar surface (scraped into
+    /brpc_metrics beside the nat_* rows)."""
+    from brpc_tpu.bvar.variable import PassiveStatus, find_exposed
+
+    with _fleet_vars_lock:
+        scalars = (
+            ("fleet_observatories",
+             lambda: len(active_observatories())),
+            ("fleet_backends",
+             lambda: sum(len(m.get("backends", {}))
+                         for _, m in _merged_of_all())),
+            ("fleet_scrapes_total",
+             lambda: sum(o.scrape_counts()[0]
+                         for o in active_observatories())),
+            ("fleet_scrape_errors_total",
+             lambda: sum(o.scrape_counts()[1]
+                         for o in active_observatories())),
+            ("fleet_slo_alerts_fired_total",
+             lambda: sum(o.slo.alerts_fired_total()
+                         for o in active_observatories())),
+            ("fleet_slo_alerts_cleared_total",
+             lambda: sum(o.slo.alerts_cleared_total()
+                         for o in active_observatories())),
+        )
+        labeled = (
+            ("fleet_backend_up", lambda: _backend_dim("up")),
+            ("fleet_backend_draining", lambda: _backend_dim("draining")),
+            ("fleet_backend_breaker_open",
+             lambda: _backend_dim("breaker_open")),
+            ("fleet_backend_lame_duck",
+             lambda: _backend_dim("lame_duck")),
+            ("fleet_backend_inflight",
+             lambda: _backend_dim("inflight")),
+            ("fleet_backend_elimit_rejects",
+             lambda: _backend_dim("elimit_rejects")),
+            ("fleet_method_count", lambda: _method_dim("count")),
+            ("fleet_method_errors", lambda: _method_dim("errors")),
+            ("fleet_method_latency_p99_us", _method_p99_dim),
+            ("fleet_slo_burn_fast",
+             lambda: _slo_dim("fast_burn")),
+            ("fleet_slo_burn_slow",
+             lambda: _slo_dim("slow_burn")),
+            ("fleet_slo_alert",
+             lambda: _slo_dim("alert", as_int=True)),
+        )
+        for vname, fn in scalars + labeled:
+            if find_exposed(vname) is None:
+                _fleet_vars.append(PassiveStatus(fn, vname))
+    return True
+
+
+# the drift test walks this: every fleet_* / SLO var the module exposes
+FLEET_VAR_NAMES = (
+    "fleet_observatories", "fleet_backends", "fleet_scrapes_total",
+    "fleet_scrape_errors_total", "fleet_slo_alerts_fired_total",
+    "fleet_slo_alerts_cleared_total", "fleet_backend_up",
+    "fleet_backend_draining", "fleet_backend_breaker_open",
+    "fleet_backend_lame_duck", "fleet_backend_inflight",
+    "fleet_backend_elimit_rejects", "fleet_method_count",
+    "fleet_method_errors", "fleet_method_latency_p99_us",
+    "fleet_slo_burn_fast", "fleet_slo_burn_slow", "fleet_slo_alert",
+)

@@ -504,6 +504,16 @@ def load() -> ctypes.CDLL:
         lib.nat_method_quantile.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                             ctypes.c_double]
         lib.nat_method_quantile.restype = ctypes.c_double
+        # -- fleet observatory: raw mergeable buckets + the wire snapshot
+        #    behind builtin.stats (ISSUE 16) --
+        lib.nat_method_hist.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_uint64),
+                                        ctypes.c_int]
+        lib.nat_method_hist.restype = ctypes.c_int
+        lib.nat_stats_snapshot.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.nat_stats_snapshot.restype = ctypes.c_int
         lib.nat_conn_snapshot.argtypes = [ctypes.POINTER(NatConnRow),
                                           ctypes.c_int]
         lib.nat_conn_snapshot.restype = ctypes.c_int
@@ -1494,6 +1504,37 @@ def method_stats() -> list:
 def method_quantile(lane: int, method: str, q: float) -> float:
     """Latency quantile (ns) of one method's own log2 histogram."""
     return load().nat_method_quantile(lane, method.encode(), q)
+
+
+def method_hist(lane: int, method: str) -> list:
+    """Raw log2 buckets of one method's latency histogram (the mergeable
+    form: fleet quantiles are computed from bucket-wise sums across
+    processes, never from averaged percentiles). Empty list when the
+    method has no slot."""
+    lib = load()
+    nb = lib.nat_stats_hist_nbuckets()
+    arr = (ctypes.c_uint64 * nb)()
+    n = lib.nat_method_hist(lane, method.encode(), arr, nb)
+    if n < 0:
+        return []
+    return list(arr[:n])
+
+
+def stats_snapshot() -> bytes:
+    """The builtin.stats snapshot JSON, built in-process (the same bytes
+    the wire endpoint serves): counters, per-lane and per-method raw
+    log2 buckets, overload/quiesce state, open client channels, and the
+    nat_res subsystem ledger."""
+    lib = load()
+    out = ctypes.c_char_p()
+    n = ctypes.c_size_t(0)
+    rc = lib.nat_stats_snapshot(ctypes.byref(out), ctypes.byref(n))
+    if rc != 0 or not out:
+        return b""
+    try:
+        return ctypes.string_at(out, n.value)
+    finally:
+        lib.nat_buf_free(out)
 
 
 def conn_snapshot() -> list:

@@ -41,9 +41,16 @@ def decompress(data: bytes, ctype: int) -> bytes:
     return pair[1](data)
 
 
+def _gzip_compress(d: bytes) -> bytes:
+    # zlib.compress only grew a wbits parameter in 3.11; compressobj
+    # takes it everywhere, so the gzip wrapper (wbits=31) goes this way
+    co = zlib.compressobj(6, zlib.DEFLATED, 31)
+    return co.compress(d) + co.flush()
+
+
 register_compress(
     COMPRESS_GZIP,
-    lambda d: zlib.compress(d, 6, wbits=31),
+    _gzip_compress,
     lambda d: zlib.decompress(d, wbits=31),
 )
 register_compress(

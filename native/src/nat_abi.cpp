@@ -366,6 +366,8 @@ int main() {
       NAT_SYM(nat_trace_set),
       NAT_SYM(nat_method_stats),
       NAT_SYM(nat_method_quantile),
+      NAT_SYM(nat_method_hist),
+      NAT_SYM(nat_stats_snapshot),
       NAT_SYM(nat_conn_snapshot),
       NAT_SYM(nat_mu_prof_start),
       NAT_SYM(nat_mu_prof_stop),

@@ -323,6 +323,17 @@ void nat_trace_set(uint64_t trace_id, uint64_t span_id);
 // concurrency; latency quantiles per method from log2 histograms.
 int nat_method_stats(brpc_tpu::NatMethodStatRow* out, int max);
 double nat_method_quantile(int lane, const char* method, double q);
+// Raw log2 buckets for one method (lookup-only; -1 when absent): the
+// mergeable form — a fleet collector sums buckets across processes and
+// takes quantiles of the merged histogram (exact for log2 buckets),
+// never averaging per-member percentiles.
+int nat_method_hist(int lane, const char* method, uint64_t* out, int max);
+// Versioned compact snapshot (JSON) for the builtin.stats endpoint:
+// counters, per-lane + per-method raw log2 buckets, server
+// overload/quiesce state, open client channels (breaker / lame-duck /
+// retry budget), and the nat_res subsystem ledger. Caller frees *out
+// with nat_buf_free.
+int nat_stats_snapshot(char** out, size_t* out_len);
 // Native /connections: one row per live socket (byte/message/syscall
 // counters, unwritten bytes = write-stack depth, protocol, remote,
 // owning dispatcher).

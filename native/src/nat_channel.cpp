@@ -314,6 +314,58 @@ void arm_call_timeout(NatChannel* ch, int64_t cid, int timeout_ms) {
                                     new CallTimeout{ch, cid}, timeout_ms);
 }
 
+// Open-channel registry for the builtin.stats snapshot: explicitly
+// opened client channels enter at open and leave at close (the opener
+// reference keeps the pointer valid in between, so the walk never races
+// a delete). Cluster lazy-created backends do NOT register — their
+// breaker/lame-duck state already surfaces through the cluster stats
+// rows, and the fleet collector reads those from its own NativeCluster.
+static NatMutex<kLockRankChanReg> g_chan_reg_mu;
+// natcheck:leak(g_chan_reg): leaked like every runtime static — a
+// static-dtor order race against late channel closes (py atexit) would
+// walk a destructed vector; process exit reclaims it anyway
+static std::vector<NatChannel*>& g_chan_reg = *new std::vector<NatChannel*>();
+
+static void chan_reg_add(NatChannel* ch) {
+  std::lock_guard g(g_chan_reg_mu);
+  g_chan_reg.push_back(ch);
+}
+
+static void chan_reg_remove(NatChannel* ch) {
+  std::lock_guard g(g_chan_reg_mu);
+  for (size_t i = 0; i < g_chan_reg.size(); i++) {
+    if (g_chan_reg[i] == ch) {
+      g_chan_reg[i] = g_chan_reg.back();
+      g_chan_reg.pop_back();
+      return;
+    }
+  }
+}
+
+// Snapshot rows (see nat_stats.h): JSON array of open channels. Reads
+// immutable open-time fields (peer, protocol) and atomics only — no
+// channel lock is taken under g_chan_reg_mu.
+void nat_channels_snapshot_json(std::string* out) {
+  out->append("[");
+  std::lock_guard g(g_chan_reg_mu);
+  for (size_t i = 0; i < g_chan_reg.size(); i++) {
+    NatChannel* ch = g_chan_reg[i];
+    char row[192];
+    snprintf(row, sizeof(row),
+             "%s{\"peer\":\"%s:%d\",\"protocol\":%d,"
+             "\"breaker_enabled\":%d,\"breaker_broken\":%d,"
+             "\"lame_duck\":%d,\"retry_budget_decis\":%d}",
+             i == 0 ? "" : ",", ch->peer_ip.c_str(), ch->peer_port,
+             ch->protocol,
+             ch->breaker_enabled.load(std::memory_order_relaxed) ? 1 : 0,
+             ch->breaker_broken.load(std::memory_order_acquire) ? 1 : 0,
+             ch->draining_recent() ? 1 : 0,
+             ch->retry_budget_decis.load(std::memory_order_relaxed));
+    out->append(row);
+  }
+  out->append("]");
+}
+
 // Shared open path: the client session (and ch->protocol) must be fully
 // attached BEFORE the socket joins epoll — a spec-compliant h2 server
 // sends SETTINGS immediately on accept, and the dispatcher must never
@@ -362,6 +414,7 @@ static void* channel_open_impl(const char* ip, int port, int nworkers,
   // fixed-send discipline throttles request pipelining, while the epoll
   // lane's writer fiber flushes the whole queue per writev
   s->disp->add_consumer(s);
+  chan_reg_add(ch);
   return ch;
 }
 
@@ -385,6 +438,7 @@ void* nat_channel_open_proto(const char* ip, int port, int nworkers,
 
 void nat_channel_close(void* h) {
   NatChannel* ch = (NatChannel*)h;
+  chan_reg_remove(ch);
   {
     // serialize against an in-flight reconnect: once we hold
     // reconnect_mu, any racing channel_socket has either published its

@@ -56,6 +56,7 @@ namespace brpc_tpu {
 // error codes shared with brpc_tpu/rpc/errors.py
 inline constexpr int kENOSERVICE = 1001;
 inline constexpr int kENOMETHOD = 1002;
+inline constexpr int kEREQUEST = 1003;
 inline constexpr int kETOOMANYFAILS = 1005;  // fan-out fail_limit reached
 inline constexpr int kERPCTIMEDOUT = 1008;
 inline constexpr int kEFAILEDSOCKET = 1009;

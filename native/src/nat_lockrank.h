@@ -103,6 +103,10 @@ enum : int {
   kLockRankStatsSpan = 76,    // g_span_drain_mu: span-ring drain (its
                               // dropped-span accounting can enter the
                               // cell registry: span < cell)
+  kLockRankChanReg = 77,      // g_chan_reg_mu: open-channel registry for
+                              // the builtin.stats snapshot (near-leaf:
+                              // the walk reads channel atomics only; the
+                              // register/unregister sites hold no lock)
   kLockRankStatsCell = 78,    // g_cell_mu: stat-cell registry
   kLockRankTimerStart = 80,   // TimerThread::start_mu_
   kLockRankTimerBucket = 82,  // TimerThread::Bucket::bucket_mu

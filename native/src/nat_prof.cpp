@@ -373,6 +373,7 @@ const char* mu_rank_name(int rank) {
     case kLockRankRingComp: return "ring.comp";
     case kLockRankRingBuf: return "ring.buf";
     case kLockRankStatsSpan: return "stats.span";
+    case kLockRankChanReg: return "chan.registry";
     case kLockRankStatsCell: return "stats.cell";
     case kLockRankTimerStart: return "timer.start";
     case kLockRankTimerBucket: return "timer.bucket";
@@ -919,8 +920,49 @@ uint64_t nat_mu_contend_selftest(int nthreads, int iters, int hold_us) {
     });
   }
   for (auto& th : threads) th.join();
-  return g_mu_rank_waits[kLockRankMuSelftest].load(
+  // Minimum-contention harness: the start barrier releases every thread
+  // together, but a loaded 2-cpu host can still SERIALIZE them — each
+  // thread runs its whole hold inside one scheduling quantum and every
+  // try_lock succeeds, so the round ends with zero contended waits and
+  // every caller asserting waits > 0 flakes. When that happens, force at
+  // least one contended acquisition with a two-thread handshake: the
+  // holder takes the mutex and keeps it until the waiter has ANNOUNCED
+  // its lock() attempt, then holds through a widening window so the
+  // waiter's try_lock lands inside the hold. Bounded retries with a
+  // doubling window make a miss (waiter descheduled for the entire
+  // window between announce and try_lock) vanishingly unlikely.
+  uint64_t waits = g_mu_rank_waits[kLockRankMuSelftest].load(
       std::memory_order_relaxed);
+  for (int round = 0; waits == 0 && round < 64; round++) {
+    std::atomic<bool> held{false};
+    std::atomic<bool> attempting{false};
+    std::thread holder([&held, &attempting, hold_us, round] {
+      std::lock_guard g(g_mu_selftest_mu);
+      held.store(true, std::memory_order_release);
+      uint64_t deadline = nat_now_ns() + 50'000'000ull;  // 50ms cap
+      while (!attempting.load(std::memory_order_acquire) &&
+             nat_now_ns() < deadline) {
+      }
+      uint64_t window =
+          (uint64_t)hold_us * 1000ull * (1ull << (round < 10 ? round : 10));
+      uint64_t until = nat_now_ns() + window;
+      while (nat_now_ns() < until) {
+      }
+    });
+    std::thread waiter([&held, &attempting] {
+      uint64_t deadline = nat_now_ns() + 50'000'000ull;
+      while (!held.load(std::memory_order_acquire) &&
+             nat_now_ns() < deadline) {
+      }
+      attempting.store(true, std::memory_order_release);
+      std::lock_guard g(g_mu_selftest_mu);
+    });
+    holder.join();
+    waiter.join();
+    waits = g_mu_rank_waits[kLockRankMuSelftest].load(
+        std::memory_order_relaxed);
+  }
+  return waits;
 }
 
 }  // extern "C"

@@ -409,6 +409,24 @@ int nat_rpc_server_start(const char* ip, int port, int nworkers,
       ctx.content_type = "application/octet-stream";
     };
   }
+  // builtin.stats: the wire-native observability endpoint (always on,
+  // the builtin-service discipline). One tpu_std call returns the
+  // versioned snapshot JSON — counters, per-method raw log2 buckets,
+  // overload/quiesce and channel breaker state, the nat_res ledger — so
+  // a fleet collector scrapes over the same RPC lane it load-balances,
+  // with no Python on the serving side. Runs inline in the reader fiber:
+  // the builder takes no blocking lock beyond the channel-registry leaf.
+  srv->handlers["builtin.stats"] = [](NativeHandlerCtx& ctx) {
+    char* buf = nullptr;
+    size_t len = 0;
+    if (nat_stats_snapshot(&buf, &len) != 0) {
+      ctx.error_code = kEREQUEST;  // snapshot malloc failed (~never)
+      ctx.error_text = "snapshot build failed";
+      return;
+    }
+    ctx.resp_payload.append(buf, len);
+    free(buf);
+  };
   srv->freeze_handlers();
   {
     // publish AND register the listener in ONE critical section: a

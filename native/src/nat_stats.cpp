@@ -5,6 +5,9 @@
 #include "nat_api.h"
 #include "nat_stats.h"
 
+#include <stdio.h>
+#include <stdlib.h>
+
 #include <mutex>
 #include "nat_lockrank.h"
 #include "nat_res.h"
@@ -123,6 +126,7 @@ static const char* kCounterNames[NS_COUNTER_COUNT] = {
     "nat_fabric_takes",
     "nat_fabric_recover_drops",
     "nat_bulk_fill_frames",
+    "nat_stats_snapshots",
 };
 
 static const char* kLaneNames[NL_LANE_COUNT] = {
@@ -476,6 +480,159 @@ double nat_method_quantile(int lane, const char* method, double q) {
     buckets[b] = c.hist[b].load(std::memory_order_relaxed);
   }
   return brpc_tpu::nat_hist_quantile(buckets, kNatHistBuckets, q);
+}
+
+// Raw log2 buckets of one method's latency histogram (lookup-only; -1
+// when the method has no slot). The FLEET seam: log2 histograms merge
+// exactly by bucket-wise addition, so a collector that wants a
+// cross-process quantile must take the buckets off each member and merge
+// — never average per-member percentiles.
+int nat_method_hist(int lane, const char* method, uint64_t* out, int max) {
+  if (method == nullptr || out == nullptr || max <= 0) return -1;
+  int idx = nat_method_find(lane, method, strlen(method));
+  if (idx < 0) return -1;
+  NatMethodCell& c = g_methods[idx];
+  int nb = max < kNatHistBuckets ? max : (int)kNatHistBuckets;
+  for (int b = 0; b < nb; b++) {
+    out[b] = c.hist[b].load(std::memory_order_relaxed);
+  }
+  return nb;
+}
+
+}  // extern "C"
+
+namespace {
+
+// Sparse bucket rendering: [[bucket, count], ...] — at 1Hz scrape the
+// snapshot rides the wire every second, so empty buckets (most of the
+// 44, most of the time) must not pay bytes.
+void append_buckets_json(std::string* s, const uint64_t* b, int nb) {
+  s->append("[");
+  bool first = true;
+  for (int i = 0; i < nb; i++) {
+    if (b[i] == 0) continue;
+    char tmp[48];
+    snprintf(tmp, sizeof(tmp), "%s[%d,%llu]", first ? "" : ",", i,
+             (unsigned long long)b[i]);
+    s->append(tmp);
+    first = false;
+  }
+  s->append("]");
+}
+
+// Method names arrive off the wire (HTTP paths, redis command words):
+// escape the JSON-breaking bytes before they enter the snapshot.
+void append_escaped_json(std::string* s, const char* p) {
+  for (; *p != '\0'; p++) {
+    unsigned char c = (unsigned char)*p;
+    if (c == '"' || c == '\\') {
+      s->push_back('\\');
+      s->push_back((char)c);
+    } else if (c < 0x20) {
+      char tmp[8];
+      snprintf(tmp, sizeof(tmp), "\\u%04x", c);
+      s->append(tmp);
+    } else {
+      s->push_back((char)c);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// The versioned compact snapshot behind the builtin.stats tpu_std
+// endpoint: counters (gauges computed in place), per-lane and per-method
+// log2 histograms WITH raw buckets (the mergeable form — fleet quantiles
+// come from merged buckets, never averaged percentiles), server
+// overload/quiesce state, open client channels (breaker/lame-duck), and
+// the nat_res subsystem ledger. One malloc, caller frees via
+// nat_buf_free. Cheap by construction: one pass over the stat cells and
+// the 128-slot method table, no locks beyond the channel-registry leaf.
+int nat_stats_snapshot(char** out, size_t* out_len) {
+  if (out == nullptr || out_len == nullptr) return -1;
+  nat_counter_add(NS_STATS_SNAPSHOTS, 1);
+  std::string s;
+  s.reserve(8192);
+  char tmp[192];
+  snprintf(tmp, sizeof(tmp), "{\"v\":1,\"ts_ns\":%llu",
+           (unsigned long long)nat_now_ns());
+  s.append(tmp);
+  s.append(",\"counters\":{");
+  for (int i = 0; i < NS_COUNTER_COUNT; i++) {
+    snprintf(tmp, sizeof(tmp), "%s\"%s\":%llu", i == 0 ? "" : ",",
+             kCounterNames[i], (unsigned long long)combined_counter(i));
+    s.append(tmp);
+  }
+  s.append("},\"lanes\":{");
+  for (int lane = 0; lane < NL_LANE_COUNT; lane++) {
+    uint64_t b[kNatHistBuckets];
+    nat_stats_hist(lane, b, kNatHistBuckets);
+    snprintf(tmp, sizeof(tmp), "%s\"%s\":", lane == 0 ? "" : ",",
+             kLaneNames[lane]);
+    s.append(tmp);
+    append_buckets_json(&s, b, kNatHistBuckets);
+  }
+  s.append("},\"methods\":[");
+  bool first = true;
+  for (int i = 0; i < kNatMethodSlots; i++) {
+    NatMethodCell& c = g_methods[i];
+    if (c.state.load(std::memory_order_acquire) != 2) continue;
+    uint64_t count = c.count.load(std::memory_order_relaxed);
+    int64_t conc = c.concurrency.load(std::memory_order_relaxed);
+    if (count == 0 && conc == 0) continue;  // untouched "(other)" rows
+    s.append(first ? "{" : ",{");
+    first = false;
+    snprintf(tmp, sizeof(tmp), "\"lane\":\"%s\",\"method\":\"",
+             c.lane >= 0 && c.lane < NL_LANE_COUNT ? kLaneNames[c.lane]
+                                                   : "?");
+    s.append(tmp);
+    append_escaped_json(&s, c.method);
+    snprintf(tmp, sizeof(tmp),
+             "\",\"count\":%llu,\"errors\":%llu,\"concurrency\":%lld,"
+             "\"max_concurrency\":%lld,\"buckets\":",
+             (unsigned long long)count,
+             (unsigned long long)c.errors.load(std::memory_order_relaxed),
+             (long long)conc,
+             (long long)c.max_concurrency.load(std::memory_order_relaxed));
+    s.append(tmp);
+    uint64_t b[kNatHistBuckets];
+    for (int j = 0; j < kNatHistBuckets; j++) {
+      b[j] = c.hist[j].load(std::memory_order_relaxed);
+    }
+    append_buckets_json(&s, b, kNatHistBuckets);
+    s.append("}");
+  }
+  snprintf(tmp, sizeof(tmp),
+           "],\"server\":{\"inflight\":%d,\"limit\":%d,\"draining\":%d}",
+           nat_rpc_server_inflight(), nat_rpc_server_limit(),
+           nat_server_draining());
+  s.append(tmp);
+  s.append(",\"channels\":");
+  nat_channels_snapshot_json(&s);
+  s.append(",\"mem\":{");
+  NatResRow rows[64];
+  int nres = nat_res_stats(rows, 64);
+  for (int i = 0; i < nres; i++) {
+    snprintf(tmp, sizeof(tmp),
+             "%s\"%s\":{\"live_bytes\":%llu,\"live_objects\":%llu,"
+             "\"hwm_bytes\":%llu}",
+             i == 0 ? "" : ",", rows[i].name,
+             (unsigned long long)rows[i].live_bytes,
+             (unsigned long long)rows[i].live_objects,
+             (unsigned long long)rows[i].hwm_bytes);
+    s.append(tmp);
+  }
+  s.append("}}");
+  // natcheck:allow(resacct): FFI snapshot buffer, freed by the caller
+  char* buf = (char*)malloc(s.size() + 1);
+  if (buf == nullptr) return -1;
+  memcpy(buf, s.data(), s.size());
+  buf[s.size()] = '\0';
+  *out = buf;
+  *out_len = s.size();
+  return 0;
 }
 
 // Arm (or clear, with 0,0) this thread's ambient trace context: client

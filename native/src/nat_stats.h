@@ -17,6 +17,7 @@
 #include <time.h>
 
 #include <atomic>
+#include <string>
 
 namespace brpc_tpu {
 
@@ -91,6 +92,9 @@ enum NatCounterId : int {
                             // slot recovery (sender died mid-stream)
   NS_BULK_FILL_FRAMES,      // tpu_std frames whose payload landed in one
                             // pooled bulk block via read-side fill mode
+  NS_STATS_SNAPSHOTS,       // builtin.stats snapshots built (the fleet
+                            // scrape counter — a collector at 1Hz shows
+                            // here, so overhead questions are answerable)
   NS_COUNTER_COUNT,
 };
 
@@ -163,6 +167,14 @@ inline void nat_lat_record(int lane, uint64_t ns) {
 // lane exports, the per-method exports and the replay client — the
 // interpolation must never diverge between them. Defined nat_stats.cpp.
 double nat_hist_quantile(const uint64_t* buckets, int nb, double q);
+
+// Channel-registry JSON rows for the builtin.stats snapshot (defined in
+// nat_channel.cpp beside the registry): appends a JSON array of the
+// process's open client channels — peer, protocol, breaker and
+// lame-duck state, retry budget. The snapshot builder (nat_stats.cpp)
+// must stay channel-layout-blind, so the row rendering lives with the
+// fields it reads.
+void nat_channels_snapshot_json(std::string* out);
 
 // ---------------------------------------------------------------------------
 // per-method stats — the native MethodStatus table (details/method_status.h

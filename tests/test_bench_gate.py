@@ -55,6 +55,7 @@ def _bench_result():
             "conn_scale_conns": 19000.0,
             "conn_per_conn_bytes": 14000.0,
             "conn_accept_storm_s": 12.0,
+            "fleet_scrape_overhead_pct": 1.1,
             "native_latency_us": {"echo": {"p50": 10.0, "p99": 50.0,
                                            "p999": 200.0}},
             "nat_prof": {"samples": 1234,
@@ -412,3 +413,36 @@ def test_artifact_records_contention(pair):
     assert sub, _rules(findings)
     assert "per-dispatcher rows" in sub[0].message
     assert "lock:http.sess" in sub[0].message
+
+
+def test_fleet_scrape_lane_is_carried():
+    """extract_lanes picks the fleet-observatory overhead lane out of
+    extra, and make_baseline keeps the MAX (the worst credible cost)."""
+    art = benchgate.make_artifact(_bench_result(), round_n=9)
+    assert art["lanes"]["fleet_scrape_overhead_pct"] == 1.1
+    a2 = copy.deepcopy(art)
+    a2["lanes"]["fleet_scrape_overhead_pct"] = 2.4
+    base = benchgate.make_baseline([art, a2], round_n=9)
+    assert base["lanes"]["fleet_scrape_overhead_pct"] == 2.4
+
+
+def test_fleet_scrape_overhead_absolute_ceiling(pair):
+    """The 1Hz-scrape <=3% contract is ABSOLUTE: it trips on the fixed
+    bar even when the committed baseline itself is above it."""
+    base, cur = pair
+    cur["lanes"]["fleet_scrape_overhead_pct"] = 3.4
+    base["lanes"]["fleet_scrape_overhead_pct"] = 4.0  # bad baseline
+    findings = benchgate.compare(base, cur)
+    assert "abs-ceiling" in _rules(findings)
+    msg = [f for f in findings if f.rule == "abs-ceiling"][0].message
+    assert "fleet_scrape_overhead_pct" in msg and "3.00" in msg
+
+
+def test_fleet_scrape_overhead_under_bar_passes(pair):
+    base, cur = pair
+    cur["lanes"]["fleet_scrape_overhead_pct"] = 2.9
+    assert benchgate.compare(base, cur) == []
+    # unmeasured (lane absent) is a skip, not a finding
+    del cur["lanes"]["fleet_scrape_overhead_pct"]
+    del base["lanes"]["fleet_scrape_overhead_pct"]
+    assert benchgate.compare(base, cur) == []

@@ -156,13 +156,16 @@ def test_multiwriter_socket_fail_mid_drain(echo_server):
     assert len(outcomes) == 120  # every call completed exactly once
     native.fault_configure("")
     # the channel recovers: the write stack of the dead socket was fully
-    # released (a leaked drain role would wedge every later call)
-    for _ in range(5):
+    # released (a leaked drain role would wedge every later call). The
+    # dead-socket re-dial cool-down doubles up to 3.2s, so back-to-back
+    # attempts can all land inside the window under load — space them out.
+    for _ in range(12):
         rc, body, _ = native.channel_call(ch, "EchoService", "Echo",
                                           b"post", timeout_ms=5000,
                                           max_retry=2)
         if rc == 0:
             break
+        time.sleep(0.4)
     assert rc == 0 and body == b"post"
     native.channel_close(ch)
 

@@ -26,6 +26,10 @@
 # the capture through the native replay client — zero failed RPCs,
 # response-count parity, Python-reader byte identity (see
 # tools/natcheck/replay.py).
+# --fleet (or NATCHECK_FLEET=1) runs the fleet-observatory round: a
+# live 3-server group behind a file naming feed, real traffic, then
+# wire-native builtin.stats scrape -> exact histogram merge -> fleet
+# quantiles -> SLO engine, end to end (see tools/natcheck/fleet.py).
 # --bench (or NATCHECK_BENCH=1) runs the perf regression gate: bench.py
 # with the nat_prof flight recorder attached, a schema'd artifact
 # (BENCH_latest.json), and a headline-lane diff against the last
@@ -44,6 +48,7 @@ CHAOS="${NATCHECK_CHAOS:-0}"
 BENCH="${NATCHECK_BENCH:-0}"
 REFGUARD="${NATCHECK_REFGUARD:-0}"
 REPLAY="${NATCHECK_REPLAY:-0}"
+FLEET="${NATCHECK_FLEET:-0}"
 for arg in "$@"; do
     case "$arg" in
         --soak) SOAK=1 ;;
@@ -51,6 +56,7 @@ for arg in "$@"; do
         --bench) BENCH=1 ;;
         --refguard) REFGUARD=1 ;;
         --replay) REPLAY=1 ;;
+        --fleet) FLEET=1 ;;
     esac
 done
 
@@ -126,6 +132,19 @@ print("natcheck: soak: %s (log: native/SOAK.md)"
 print_findings(findings)
 sys.exit(1 if findings else 0)
 EOF
+fi
+
+if [ "$FLEET" = "1" ]; then
+    JAX_PLATFORMS=cpu "$PY" - <<'PYFL' || RC=1
+import sys
+sys.path.insert(0, ".")
+from tools.natcheck import print_findings, fleet
+findings = fleet.run()
+print("natcheck: fleet: %s"
+      % ("clean" if not findings else "%d finding(s)" % len(findings)))
+print_findings(findings)
+sys.exit(1 if findings else 0)
+PYFL
 fi
 
 if [ "$BENCH" = "1" ]; then

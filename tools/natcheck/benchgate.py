@@ -132,6 +132,15 @@ CEILING_LANES: Dict[str, float] = {
     "conn_accept_storm_s": 1.00,
 }
 
+# ABSOLUTE ceiling lanes: gated against a fixed bar, not a baseline —
+# the fleet-observatory contract (ISSUE 16) is that a 1Hz builtin.stats
+# scrape costs <= 3% of headline qps on ANY host, so no committed
+# baseline can relax it. Carried in artifacts/baselines like the
+# relative ceilings (make_baseline takes the MAX over clean rounds).
+ABS_CEILING_LANES: Dict[str, float] = {
+    "fleet_scrape_overhead_pct": 3.0,
+}
+
 # Hard sublinear-scaling floor: when the host probe shows real parallel
 # headroom (host_parallel_x >= the MIN_HOST bar) and the runtime still
 # scales below MIN_X, that is a failing finding regardless of baseline —
@@ -149,7 +158,8 @@ def extract_lanes(bench: dict) -> Dict[str, float]:
     lanes: Dict[str, float] = {}
     extra = bench.get("extra", {}) or {}
     device = extra.get("device_lanes", {}) or {}
-    for key in list(HEADLINE_LANES) + list(CEILING_LANES):
+    for key in (list(HEADLINE_LANES) + list(CEILING_LANES)
+                + list(ABS_CEILING_LANES)):
         if key == "value":
             v = bench.get("value")
         elif key == "cpus2_scaling_x":
@@ -203,7 +213,7 @@ def make_baseline(artifacts: List[dict], round_n: int) -> dict:
                 # unachievably-low scaling bar into the baseline)
                 if lane not in floor or float(v) > floor[lane]:
                     floor[lane] = float(v)
-            elif lane in CEILING_LANES:
+            elif lane in CEILING_LANES or lane in ABS_CEILING_LANES:
                 # latency ceilings take the MAXIMUM over clean rounds —
                 # the credible worst case plays the floor's role for a
                 # lane that regresses upward
@@ -345,6 +355,18 @@ def compare(baseline: dict, current: dict) -> List[Finding]:
                 f"latency lane {lane!r} regressed {rise:.1f}% upward: "
                 f"{base_v:.1f} -> {cur_v:.1f} us (ceiling band "
                 f"{tol * 100:.0f}%)"
+                + _contention_excerpt(current) + _profile_excerpt(current)))
+    # absolute ceiling lanes: a fixed bar, independent of any baseline
+    # (the fleet 1Hz-scrape <=3% contract); missing lane = unmeasured =
+    # skip (the bench may run with the fleet lane disabled)
+    for lane, bar in ABS_CEILING_LANES.items():
+        cur_v = cur_lanes.get(lane)
+        if isinstance(cur_v, (int, float)) and float(cur_v) > bar:
+            findings.append(Finding(
+                "bench", "abs-ceiling", where,
+                f"lane {lane!r} measured {float(cur_v):.2f}, above the "
+                f"absolute bar {bar:.2f} — the always-on fleet scrape "
+                f"contract (ISSUE 16) does not bend with baselines"
                 + _contention_excerpt(current) + _profile_excerpt(current)))
     # absolute sublinear-scaling floor (independent of any baseline):
     # the host probe proved parallel headroom, the runtime didn't use it
