@@ -441,6 +441,9 @@ def load() -> ctypes.CDLL:
         lib.nat_shm_lane_name.restype = ctypes.c_char_p
         lib.nat_shm_lane_enable.argtypes = [ctypes.c_int]
         lib.nat_shm_lane_enable.restype = ctypes.c_int
+        lib.nat_shm_seg_validate.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_size_t]
+        lib.nat_shm_seg_validate.restype = ctypes.c_int
         lib.nat_shm_worker_attach.argtypes = [ctypes.c_char_p]
         lib.nat_shm_worker_attach.restype = ctypes.c_int
         lib.nat_shm_take_request.argtypes = [ctypes.c_int]
@@ -642,6 +645,21 @@ def load() -> ctypes.CDLL:
             ctypes.c_int, ctypes.POINTER(ctypes.c_char_p),
             ctypes.POINTER(ctypes.c_size_t)]
         lib.nat_prof_report.restype = ctypes.c_int
+        # -- parser fuzz seams (nat_fuzz_entry.cpp / nat_replay.cpp) --
+        lib.nat_fuzz_rpc_meta.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.nat_fuzz_rpc_meta.restype = ctypes.c_int
+        lib.nat_fuzz_http.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.nat_fuzz_http.restype = ctypes.c_int
+        lib.nat_fuzz_h2.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.nat_fuzz_h2.restype = ctypes.c_int
+        lib.nat_fuzz_redis.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.nat_fuzz_redis.restype = ctypes.c_int
+        lib.nat_fuzz_hpack.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.nat_fuzz_hpack.restype = ctypes.c_int
+        lib.nat_fuzz_recordio.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.nat_fuzz_recordio.restype = ctypes.c_int
+        lib.nat_fuzz_shm_seg.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.nat_fuzz_shm_seg.restype = ctypes.c_int
         _lib = lib
         return lib
 

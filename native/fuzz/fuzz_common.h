@@ -1,0 +1,15 @@
+// Shared by the per-parser fuzz targets (fuzz_*.cpp): pulls in the
+// production fuzz seams (nat_api.h nat_fuzz_*, implemented inside the
+// instrumented .so the target links against) and the libFuzzer entry
+// signature. Each target defines LLVMFuzzerTestOneInput; with clang the
+// real libFuzzer drives it (coverage-guided), with g++ the bundled
+// deterministic driver (fuzz_driver_main.cpp) does (corpus replay +
+// fixed-seed mutation loop) — same target code either way.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "nat_api.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size);

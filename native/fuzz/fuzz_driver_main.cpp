@@ -1,0 +1,220 @@
+// Standalone fuzz driver for toolchains without libFuzzer (plain g++):
+// gives every fuzz_*.cpp target a main() with libFuzzer-compatible
+// replay semantics plus a bounded, DETERMINISTIC mutation loop so CI
+// can run a fixed-work fuzz pass with stable results:
+//
+//   fuzz_x FILE...                 replay each input once, exit 0/crash
+//   fuzz_x [--budget-ms M] [--seed S] [--max-len N] DIR...
+//       load every file under each DIR as the seed corpus, replay all,
+//       then mutate seeds with a seeded xorshift64 until the budget
+//       expires. Same seed + same corpus => same byte sequences.
+//
+// Crashes are the sanitizer's business (the target links the
+// ASan+UBSan .so); this driver only schedules inputs. It prints one
+// summary line so tools/natcheck/fuzzlane.py can assert liveness.
+#ifndef NAT_FUZZ_STANDALONE
+#error "fuzz_driver_main.cpp is only built for the standalone (no-libFuzzer) lane"
+#endif
+
+#include <dirent.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <time.h>
+
+#include <string>
+#include <vector>
+
+#include "fuzz_common.h"
+
+namespace {
+
+uint64_t g_rng = 0x9e3779b97f4a7c15ull;
+
+uint64_t rng_next() {
+  // xorshift64: deterministic, seedable, no libc rand() state
+  uint64_t x = g_rng;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  g_rng = x;
+  return x;
+}
+
+uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000u + (uint64_t)(ts.tv_nsec / 1000000);
+}
+
+bool load_file(const std::string& path, std::vector<uint8_t>* out) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (f == nullptr) return false;
+  out->clear();
+  uint8_t buf[4096];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) {
+    out->insert(out->end(), buf, buf + n);
+  }
+  fclose(f);
+  return true;
+}
+
+void load_dir(const std::string& dir,
+              std::vector<std::vector<uint8_t>>* corpus) {
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) return;
+  std::vector<std::string> names;
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    if (e->d_name[0] == '.') continue;
+    names.push_back(dir + "/" + e->d_name);
+  }
+  closedir(d);
+  // sorted load order: the corpus replay sequence is part of determinism
+  for (size_t i = 0; i < names.size(); i++) {
+    for (size_t j = i + 1; j < names.size(); j++) {
+      if (names[j] < names[i]) names[i].swap(names[j]);
+    }
+  }
+  for (const auto& n : names) {
+    struct stat st;
+    if (stat(n.c_str(), &st) == 0 && S_ISREG(st.st_mode)) {
+      std::vector<uint8_t> data;
+      if (load_file(n, &data)) corpus->push_back(std::move(data));
+    }
+  }
+}
+
+// One mutation step: start from a corpus pick, apply 1-8 edits drawn
+// from the classic set (bit flip, byte set, chunk erase/insert/splice,
+// interesting integer splat) — structure-unaware but effective against
+// length/offset parsers when the corpus is structure-aware.
+void mutate(const std::vector<std::vector<uint8_t>>& corpus,
+            std::vector<uint8_t>* out, size_t max_len) {
+  const std::vector<uint8_t>& base =
+      corpus[rng_next() % corpus.size()];
+  *out = base;
+  size_t edits = 1 + rng_next() % 8;
+  static const uint64_t kInteresting[] = {
+      0, 1, 0x7f, 0x80, 0xff, 0x100, 0xffff, 0x10000, 0x7fffffff,
+      0x80000000ull, 0xffffffffull, 0x100000000ull, 0x7fffffffffffffffull,
+      0xffffffffffffffffull};
+  for (size_t i = 0; i < edits; i++) {
+    switch (rng_next() % 6) {
+      case 0:  // bit flip
+        if (!out->empty()) {
+          size_t p = rng_next() % out->size();
+          (*out)[p] ^= (uint8_t)(1u << (rng_next() % 8));
+        }
+        break;
+      case 1:  // byte set
+        if (!out->empty()) {
+          (*out)[rng_next() % out->size()] = (uint8_t)rng_next();
+        }
+        break;
+      case 2: {  // chunk erase
+        if (out->size() > 1) {
+          size_t p = rng_next() % out->size();
+          size_t n = 1 + rng_next() % (out->size() - p);
+          out->erase(out->begin() + (long)p, out->begin() + (long)(p + n));
+        }
+        break;
+      }
+      case 3: {  // chunk insert (random bytes)
+        size_t p = out->empty() ? 0 : rng_next() % out->size();
+        size_t n = 1 + rng_next() % 16;
+        std::vector<uint8_t> ins(n);
+        for (auto& b : ins) b = (uint8_t)rng_next();
+        out->insert(out->begin() + (long)p, ins.begin(), ins.end());
+        break;
+      }
+      case 4: {  // splice from another corpus entry
+        const std::vector<uint8_t>& other =
+            corpus[rng_next() % corpus.size()];
+        if (!other.empty()) {
+          size_t p = out->empty() ? 0 : rng_next() % out->size();
+          size_t so = rng_next() % other.size();
+          size_t n = 1 + rng_next() % (other.size() - so);
+          out->insert(out->begin() + (long)p, other.begin() + (long)so,
+                      other.begin() + (long)(so + n));
+        }
+        break;
+      }
+      case 5: {  // interesting integer splat (1/2/4/8 bytes, LE and BE)
+        uint64_t v = kInteresting[rng_next() %
+                                  (sizeof(kInteresting) / sizeof(uint64_t))];
+        size_t w = (size_t)1 << (rng_next() % 4);
+        if (out->size() >= w) {
+          size_t p = rng_next() % (out->size() - w + 1);
+          bool be = (rng_next() & 1) != 0;
+          for (size_t k = 0; k < w; k++) {
+            size_t sh = be ? (w - 1 - k) * 8 : k * 8;
+            (*out)[p + k] = (uint8_t)(v >> sh);
+          }
+        }
+        break;
+      }
+    }
+  }
+  if (out->size() > max_len) out->resize(max_len);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  uint64_t budget_ms = 0;
+  uint64_t seed = 1;
+  size_t max_len = 1 << 16;
+  std::vector<std::string> paths;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--budget-ms" && i + 1 < argc) {
+      budget_ms = strtoull(argv[++i], nullptr, 10);
+    } else if (a == "--seed" && i + 1 < argc) {
+      seed = strtoull(argv[++i], nullptr, 10);
+    } else if (a == "--max-len" && i + 1 < argc) {
+      max_len = strtoull(argv[++i], nullptr, 10);
+    } else if (a.rfind("-", 0) == 0) {
+      fprintf(stderr, "unknown flag: %s\n", a.c_str());
+      return 2;
+    } else {
+      paths.push_back(a);
+    }
+  }
+  g_rng = seed ? seed : 1;
+
+  std::vector<std::vector<uint8_t>> corpus;
+  for (const auto& p : paths) {
+    struct stat st;
+    if (stat(p.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) {
+      load_dir(p, &corpus);
+    } else {
+      std::vector<uint8_t> data;
+      if (load_file(p, &data)) corpus.push_back(std::move(data));
+    }
+  }
+
+  // phase 1: replay the corpus (every committed seed + regress input)
+  uint64_t execs = 0;
+  for (const auto& in : corpus) {
+    LLVMFuzzerTestOneInput(in.data(), in.size());
+    execs++;
+  }
+
+  // phase 2: bounded deterministic mutation loop
+  if (budget_ms > 0 && !corpus.empty()) {
+    uint64_t deadline = now_ms() + budget_ms;
+    std::vector<uint8_t> buf;
+    while (now_ms() < deadline) {
+      mutate(corpus, &buf, max_len);
+      LLVMFuzzerTestOneInput(buf.data(), buf.size());
+      execs++;
+    }
+  }
+  printf("fuzz-driver: %llu execs, %zu corpus seeds, seed=%llu: OK\n",
+         (unsigned long long)execs, corpus.size(),
+         (unsigned long long)seed);
+  return 0;
+}

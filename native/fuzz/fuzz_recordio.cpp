@@ -1,0 +1,8 @@
+// Fuzz target: drives the production nat_fuzz_recordio seam (see
+// native/src/nat_fuzz_entry.cpp / nat_replay.cpp) under ASan+UBSan.
+#include "fuzz_common.h"
+
+extern "C" int LLVMFuzzerTestOneInput(const uint8_t* data, size_t size) {
+  nat_fuzz_recordio((const char*)data, size);
+  return 0;
+}

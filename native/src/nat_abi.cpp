@@ -342,6 +342,7 @@ int main() {
       NAT_SYM(nat_shm_lane_enable),
       NAT_SYM(nat_shm_lane_set_timeout_ms),
       NAT_SYM(nat_shm_lane_recover_probe),
+      NAT_SYM(nat_shm_seg_validate),
       NAT_SYM(nat_shm_worker_attach),
       NAT_SYM(nat_shm_take_request),
       NAT_SYM(nat_shm_respond),
@@ -418,6 +419,13 @@ int main() {
       NAT_SYM(nat_prof_samples),
       NAT_SYM(nat_prof_reset),
       NAT_SYM(nat_prof_report),
+      NAT_SYM(nat_fuzz_rpc_meta),
+      NAT_SYM(nat_fuzz_http),
+      NAT_SYM(nat_fuzz_h2),
+      NAT_SYM(nat_fuzz_redis),
+      NAT_SYM(nat_fuzz_hpack),
+      NAT_SYM(nat_fuzz_recordio),
+      NAT_SYM(nat_fuzz_shm_seg),
 #undef NAT_SYM
   };
   for (size_t i = 0; i < syms.size(); i++) {

@@ -14,6 +14,13 @@
 #include <stddef.h>
 #include <stdint.h>
 
+// wire-origin marker for the wiretrust taint pass (grammar documented in
+// nat_internal.h); defined in the lowest common header so every TU that
+// parses wire bytes can annotate without pulling in the internals
+#ifndef NAT_WIRE
+#define NAT_WIRE(x) (x)
+#endif
+
 namespace brpc_tpu {
 struct NatSpanRec;        // full layout in nat_stats.h (mirrored in ctypes)
 struct NatMethodStatRow;  // per-method stats snapshot row (nat_stats.h)
@@ -275,6 +282,11 @@ int nat_shm_lane_set_timeout_ms(int ms);
 // probe worker lifetime fences once; recover dead slots (the drainer
 // does this continuously while the lane is enabled)
 int nat_shm_lane_recover_probe(void);
+// validate a candidate segment image (cross-process attach trust
+// boundary: magic/version/slots/arena vs the claimed length) without
+// mapping or attaching; 1 = attachable, 0 = rejected. Also the forged-
+// segment fuzz seam.
+int nat_shm_seg_validate(const void* mem, size_t len);
 int nat_shm_worker_attach(const char* name);
 void* nat_shm_take_request(int timeout_ms);
 int nat_shm_respond(int kind, uint64_t sock_id, int64_t seq,
@@ -439,5 +451,21 @@ int nat_prof_running(void);
 uint64_t nat_prof_samples(void);
 void nat_prof_reset(void);
 int nat_prof_report(int mode, char** out, size_t* out_len);
+
+// ---- fuzz seams (nat_fuzz_entry.cpp / nat_replay.cpp) ----
+// One entry per hand-rolled wire parser, each driving the REAL
+// production path (messenger-style cut over a fake-socket fill, HPACK
+// into a live dynamic table, recordio through the CRC/bounds loader,
+// shm segment-image validation). Consumed by native/fuzz/ targets and
+// replayed over the plain .so by tests/test_fuzz_regress.py. Returns
+// 1 if the input parsed/was consumed, 0 if rejected — the interesting
+// outcome is the sanitizer's, not the return value.
+int nat_fuzz_rpc_meta(const char* data, size_t len);
+int nat_fuzz_http(const char* data, size_t len);
+int nat_fuzz_h2(const char* data, size_t len);
+int nat_fuzz_redis(const char* data, size_t len);
+int nat_fuzz_hpack(const char* data, size_t len);
+int nat_fuzz_recordio(const char* data, size_t len);
+int nat_fuzz_shm_seg(const char* data, size_t len);
 
 }  // extern "C"

@@ -166,12 +166,19 @@ static bool huff_decode(const uint8_t* data, size_t n, std::string* out) {
   return true;
 }
 
-// RFC 7541 §5.1 integer; returns false on truncation
+// RFC 7541 §5.1 integer; returns false on truncation or on a value past
+// kHpIntMax. Every integer this decoder yields is a string length, a
+// table index or a table-size update: lengths are bounded by the header
+// block (≤ kMaxHeaderBlock), indices by the table, size updates by the
+// 4096 clamp in decode() — a continuation-encoded value past 2^24 is an
+// attack or corruption, never a legal header, so reject it here rather
+// than letting a 56-bit length reach the callers' arithmetic.
+static constexpr uint64_t kHpIntMax = 1u << 24;
 static bool hp_int(const uint8_t* d, size_t n, size_t* pos, int prefix,
                    uint64_t* out) {
   if (*pos >= n) return false;
   uint64_t limit = (1u << prefix) - 1;
-  uint64_t v = d[*pos] & limit;
+  uint64_t v = NAT_WIRE(d[*pos] & limit);
   (*pos)++;
   if (v < limit) {
     *out = v;
@@ -185,6 +192,7 @@ static bool hp_int(const uint8_t* d, size_t n, size_t* pos, int prefix,
     v += (uint64_t)(b & 0x7f) << shift;
     shift += 7;
     if (!(b & 0x80)) {
+      if (v > kHpIntMax) return false;  // wire-int clamp (wiretrust)
       *out = v;
       return true;
     }
@@ -213,6 +221,7 @@ class HpackDecoderN {
   // Decodes a header block; each header appended to `flat` as
   // "name: value\n" (names arrive lowercased per h2). :path is also
   // surfaced separately for dispatch.
+  // natcheck:wire: d — HPACK block bytes straight from frame payloads
   bool decode(const uint8_t* d, size_t n, std::string* flat,
               std::string* path) {
     size_t pos = 0;
@@ -865,7 +874,8 @@ int h2_try_process(NatSocket* s, IOBuf* batch_out) {
     if (s->in_buf.length() < 9) break;
     uint8_t fh[9];
     s->in_buf.copy_to((char*)fh, 9);
-    size_t flen = ((size_t)fh[0] << 16) | ((size_t)fh[1] << 8) | fh[2];
+    size_t flen = NAT_WIRE(((size_t)fh[0] << 16) | ((size_t)fh[1] << 8) |
+                           fh[2]);
     uint8_t ftype = fh[3];
     uint8_t flags = fh[4];
     uint32_t sid = (((uint32_t)fh[5] & 0x7f) << 24) |

@@ -253,6 +253,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
     std::string heap_scan;
     size_t scan_len = buffered < kMaxHeaderBytes + 4 ? buffered
                                                      : kMaxHeaderBytes + 4;
+    // natcheck:wire: scan — raw request bytes off the socket drain
     const char* scan;
     if (scan_len <= sizeof(stack_scan)) {
       scan = s->in_buf.fetch(stack_scan, scan_len);
@@ -312,8 +313,8 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         while (ve > v && (ve[-1] == ' ' || ve[-1] == '\t')) ve--;
         std::string_view val(v, (size_t)(ve - v));
         if (key == "content-length") {
-          content_length = (size_t)strtoull(std::string(val).c_str(),
-                                            nullptr, 10);
+          content_length = (size_t)NAT_WIRE(strtoull(
+              std::string(val).c_str(), nullptr, 10));
         } else if (key == "transfer-encoding") {
           chunked = val.find("chunked") != std::string_view::npos;
         } else if (key == "connection") {
@@ -364,7 +365,7 @@ int http_try_process(NatSocket* s, IOBuf* batch_out) {
         if (nl == nullptr) break;
         size_t chunk_hdr_end = (size_t)(nl - scan) + 1;
         if (!isxdigit((unsigned char)scan[pos])) return 0;
-        size_t sz = (size_t)strtoull(scan + pos, nullptr, 16);
+        size_t sz = (size_t)NAT_WIRE(strtoull(scan + pos, nullptr, 16));
         // reject before arithmetic: sz near SIZE_MAX would wrap the
         // buffered-length comparison below and pass a bogus append
         if (sz > kMaxBodyBytes) return 0;

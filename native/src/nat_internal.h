@@ -51,6 +51,23 @@
 #include "scheduler.h"
 #include "timer_thread.h"
 
+// ---- wiretrust annotation surface (tools/natcheck/wiretrust.py) ----
+//
+// NAT_WIRE(expr) marks `expr` as wire-origin bytes at the point where
+// attacker- or corruption-controlled data enters a parser: socket drain
+// fill buffers, shm descriptor cells, recordio loads, TDEV credentials.
+// The macro is a compile-time no-op; the wiretrust static pass taints
+// the value and verifies every use as a memcpy/memmove length,
+// allocation size, container resize, array index, pointer offset or
+// loop bound sits behind a dominating bounds check against a trusted
+// limit. `// natcheck:wire: a, b` marks identifiers the same way where
+// a macro is awkward (e.g. struct fields loaded from a mapped
+// segment). Suppress a deliberate use with
+// `// natcheck:allow(wiretrust): <bounds argument>`.
+#ifndef NAT_WIRE
+#define NAT_WIRE(x) (x)
+#endif
+
 namespace brpc_tpu {
 
 // error codes shared with brpc_tpu/rpc/errors.py

@@ -406,7 +406,7 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
       // deliver ordered to the Python Stream objects via the py lane —
       // the Python loop never re-parses stream framing. Body = 8B dest
       // stream id + 1B frame type + payload.
-      uint32_t body = rd_be32(header + 4);
+      uint32_t body = NAT_WIRE(rd_be32(header + 4));
       if (body < 9 || body > (512u << 20)) {
         ok = false;  // same body cap as every other native lane
         break;
@@ -500,8 +500,8 @@ bool process_input(NatSocket* s, IOBuf* defer_out) {
       ok = false;  // not tpu_std, not HTTP: protocol error
       break;
     }
-    uint32_t body = rd_be32(header + 4);
-    uint32_t meta_size = rd_be32(header + 8);
+    uint32_t body = NAT_WIRE(rd_be32(header + 4));
+    uint32_t meta_size = NAT_WIRE(rd_be32(header + 8));
     if (meta_size > body || body > (512u << 20)) {
       ok = false;
       break;

@@ -435,7 +435,7 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
       break;
     }
     char* endp = nullptr;
-    long nargs = strtol(p + 1, &endp, 10);
+    long nargs = NAT_WIRE(strtol(p + 1, &endp, 10));
     if (endp == nullptr || *endp != '\r' || nargs <= 0 ||
         (size_t)nargs > kMaxRedisArgs) {
       rc = 0;
@@ -466,7 +466,7 @@ int redis_try_process(NatSocket* s, IOBuf* batch_out) {
         break;
       }
       char* aend = nullptr;
-      long alen = strtol(p + pos + 1, &aend, 10);
+      long alen = NAT_WIRE(strtol(p + pos + 1, &aend, 10));
       if (aend == nullptr || *aend != '\r' || alen < 0 ||
           (size_t)alen > kMaxRedisCommandBytes) {
         rc = 0;

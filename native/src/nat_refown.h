@@ -145,6 +145,12 @@ NAT_REF_TAG(clus.ver, "one ServerListVer entry holds the backend; "
 NAT_REF_TAG(clus.call, "an in-flight sub-call/selective attempt pins its "
             "backend; the completion/accounting path releases")
 
+// fuzz harness fake connections (nat_fuzz_entry.cpp's FuzzConn):
+NAT_REF_TAG(sock.fuzz, "FuzzConn's heap socket (fd=/dev/null, never "
+            "registered); the FuzzConn dtor releases after each exec")
+NAT_REF_TAG(srv.fuzz, "FuzzConn's handler-less server; the FuzzConn "
+            "dtor releases after the socket")
+
 // bench harness connections (AsyncBenchConn / CliLaneConn):
 NAT_REF_TAG(bench.owner, "the bench harness + sender fiber's own "
             "reference, dropped when the bench round retires the conn")

@@ -12,6 +12,7 @@
 // standing bench lane (ROADMAP item 4's load generator).
 #include <dirent.h>
 #include <math.h>
+#include <unistd.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -147,18 +148,29 @@ void load_file(const char* path, std::vector<ReplayRec>* out,
                uint64_t* loaded_bytes) {
   FILE* f = fopen(path, "rb");
   if (f == nullptr) return;
+  // the claimed record lengths below must also fit the bytes actually
+  // on disk: a 16-byte forged file claiming pl=512MB must not force a
+  // 512MB zero-filled resize before fread discovers the truncation
+  struct stat st;
+  if (fstat(fileno(f), &st) != 0 || st.st_size < 0) {
+    fclose(f);
+    return;
+  }
+  uint64_t remaining = (uint64_t)st.st_size;
   std::string meta, payload;
   for (;;) {
     unsigned char hdr[16];
     if (fread(hdr, 1, 16, f) != 16) break;  // EOF / truncated tail
-    uint32_t ml = rd32(hdr + 4);
-    uint32_t pl = rd32(hdr + 8);
-    uint32_t crc = rd32(hdr + 12);
+    remaining = remaining >= 16 ? remaining - 16 : 0;
+    uint32_t ml = NAT_WIRE(rd32(hdr + 4));
+    uint32_t pl = NAT_WIRE(rd32(hdr + 8));
+    uint32_t crc = NAT_WIRE(rd32(hdr + 12));
     if (memcmp(hdr, "RIO1", 4) != 0 || ml > (1u << 20) ||
-        pl > (512u << 20)) {
+        pl > (512u << 20) || (uint64_t)ml + pl > remaining) {
       (*skipped)++;  // corrupt stream: the file's remainder is lost
       break;
     }
+    remaining -= (uint64_t)ml + pl;
     meta.resize(ml);
     payload.resize(pl);
     if (ml != 0 && fread(&meta[0], 1, ml, f) != ml) break;
@@ -344,6 +356,28 @@ double replay_quantile_ns(const std::atomic<uint64_t>* hist, double q) {
 }
 
 }  // namespace
+
+// Fuzz seam (nat_fuzz_entry.cpp owns the others; this one lives here
+// for the anonymous-namespace load_file): round an arbitrary byte
+// image through a temp file into the real recordio CRC/bounds loader.
+extern "C" int nat_fuzz_recordio(const char* data, size_t len) {
+  char path[] = "/tmp/nat_fuzz_rio_XXXXXX";
+  int fd = mkstemp(path);
+  if (fd < 0) return 0;
+  size_t off = 0;
+  while (off < len) {
+    ssize_t w = write(fd, data + off, len - off);
+    if (w <= 0) break;
+    off += (size_t)w;
+  }
+  ::close(fd);
+  std::vector<ReplayRec> recs;
+  uint64_t loaded = 0, skipped = 0, loaded_bytes = 0;
+  load_file(path, &recs, &loaded, &skipped, &loaded_bytes);
+  unlink(path);
+  return loaded != 0 ? 1 : 0;
+}
+
 }  // namespace brpc_tpu
 
 using namespace brpc_tpu;

@@ -458,6 +458,7 @@ void user_span_free(void* raw) {
 // scribbling shared memory — must be DROPPED, never chased into a read
 // past the mapping (the parent-crash class the old byte rings validated
 // against).
+// natcheck:wire: c — descriptor cell fields read from shared memory
 bool span_sane(const CellView& c) {
   uint64_t asize = seg_now()->arena_bytes;
   uint64_t off = c.span_off % asize;
@@ -1174,6 +1175,43 @@ int nat_shm_lane_set_timeout_ms(int ms) {
 // Returns the number of slots recovered.
 int nat_shm_lane_recover_probe(void) { return probe_fences(); }
 
+// Cross-process trust boundary: a segment image we are about to attach
+// to was produced by ANOTHER process (or forged/corrupted on disk in
+// /dev/shm) — every header field is wire data until proven consistent
+// with the bytes actually mapped. Rejecting here means a malicious or
+// corrupt peer segment fails the attach loudly instead of the layout
+// helpers chasing nslots/arena_bytes into reads past the mapping
+// (symmetric with span_sane()'s per-descriptor bounds check, PR 3).
+static bool shm_seg_image_check(const void* mem, size_t len) {
+  if (mem == nullptr || len < sizeof(ShmSeg)) return false;
+  const ShmSeg* s = (const ShmSeg*)mem;
+  if (s->magic != kShmMagic) return false;
+  if (s->version != 2) return false;
+  // natcheck:wire: nslots, arena_bytes — peer-written header fields
+  uint32_t nslots = s->nslots;
+  uint64_t arena_bytes = s->arena_bytes;
+  // creation always carves exactly kMaxWorkers slots and a page-rounded
+  // arena; anything else is not a segment this build produced
+  if (nslots != (uint32_t)kMaxWorkers) return false;
+  if (arena_bytes == 0 || (arena_bytes & 4095) != 0 ||
+      arena_bytes > (1ull << 30)) {
+    return false;
+  }
+  // the layout the header claims must fit the bytes actually mapped:
+  // header + nslots * (worker hdr + 2 * (ring + arena))
+  uint64_t block = (uint64_t)whdr_bytes() +
+                   2 * ((uint64_t)sizeof(ShmRing) + arena_bytes);
+  uint64_t total = ((sizeof(ShmSeg) + 63) & ~(uint64_t)63) +
+                   (uint64_t)nslots * block;
+  return total <= (uint64_t)len;
+}
+
+// Fuzz/ops seam: validate a candidate segment image without mapping or
+// attaching — drives shm_seg_image_check over arbitrary bytes.
+int nat_shm_seg_validate(const void* mem, size_t len) {
+  return shm_seg_image_check(mem, len) ? 1 : 0;
+}
+
 // Worker: map the parent's segment (same-process callers reuse the
 // existing mapping) and claim a worker slot by locking its lifetime
 // fence. Also arms parent-death delivery of SIGTERM so a hard parent
@@ -1194,10 +1232,10 @@ int nat_shm_worker_attach(const char* name) {
     ::close(fd);
     if (mem == MAP_FAILED) return -1;
     NAT_RES_ALLOC(NR_SHM_SEG, (size_t)st.st_size, mem);
-    if (((ShmSeg*)mem)->magic != kShmMagic) {
+    if (!shm_seg_image_check(mem, (size_t)st.st_size)) {
       NAT_RES_FREE(NR_SHM_SEG, (size_t)st.st_size, mem);
       munmap(mem, (size_t)st.st_size);
-      return -1;
+      return -1;  // forged/corrupt/foreign segment: reject loudly
     }
     g_seg_ptr.store((ShmSeg*)mem, std::memory_order_release);
     g_seg_total = (size_t)st.st_size;
@@ -1258,10 +1296,10 @@ int nat_shm_producer_attach(const char* name) {
     ::close(fd);
     if (mem == MAP_FAILED) return -1;
     NAT_RES_ALLOC(NR_SHM_SEG, (size_t)st.st_size, mem);
-    if (((ShmSeg*)mem)->magic != kShmMagic) {
+    if (!shm_seg_image_check(mem, (size_t)st.st_size)) {
       NAT_RES_FREE(NR_SHM_SEG, (size_t)st.st_size, mem);
       munmap(mem, (size_t)st.st_size);
-      return -1;
+      return -1;  // forged/corrupt/foreign segment: reject loudly
     }
     g_seg_ptr.store((ShmSeg*)mem, std::memory_order_release);
     g_seg_total = (size_t)st.st_size;

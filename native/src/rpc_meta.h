@@ -11,6 +11,13 @@
 #include <cstdint>
 #include <string>
 
+// wire-origin marker for the wiretrust taint pass; canonical definition
+// and grammar live in nat_internal.h (this header is also included
+// standalone, so the guard is repeated)
+#ifndef NAT_WIRE
+#define NAT_WIRE(x) (x)
+#endif
+
 namespace brpc_tpu {
 
 struct RpcRequestMetaN {
@@ -299,8 +306,11 @@ inline bool decode_submessage(const char* p, const char* end, RpcMetaN* m,
 }
 
 inline bool decode_meta(const char* data, size_t size, RpcMetaN* m) {
-  const char* p = data;
+  // meta bytes come straight off the tpu_std frame cut: hostile
+  const char* p = NAT_WIRE(data);
   const char* end = data + size;
+  // natcheck:allow(wiretrust): cursor advances every iteration (every
+  // arm either consumes bytes or returns false) and is capped by end
   while (p < end) {
     uint64_t tag;
     if (!get_varint(p, end, &tag)) return false;

@@ -1,0 +1,92 @@
+"""Fuzz regression replay — every committed crasher must stay fixed.
+
+native/fuzz/regress/<target>/ holds minimized inputs that once crashed
+(or pathologically bloated) a wire parser. The fast test replays every
+one through the plain .so via the ctypes-reachable nat_fuzz_* seams —
+the production entry points the fuzzers drive — so a regression aborts
+this process and the suite. The corpus seeds replay too: a seed the
+parser can no longer digest means the corpus (or the parser) rotted.
+
+The slow test runs the real bounded fuzz lane (build + budgeted run per
+target, libFuzzer under clang++ or the bundled deterministic driver
+under g++), skipping gracefully when no C++ toolchain is available.
+"""
+import os
+import shutil
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import brpc_tpu.native as native  # noqa: E402
+
+FUZZ_DIR = os.path.join(REPO, "native", "fuzz")
+
+TARGETS = ("rpc_meta", "http", "h2", "redis", "hpack", "recordio",
+           "shm_seg")
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native .so unavailable")
+
+
+def _inputs(kind):
+    """Yield (target, filename, bytes) under regress/ or corpus/."""
+    root = os.path.join(FUZZ_DIR, kind)
+    for target in TARGETS:
+        d = os.path.join(root, target)
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            p = os.path.join(d, name)
+            if os.path.isfile(p):
+                with open(p, "rb") as f:
+                    yield target, name, f.read()
+
+
+def test_regress_inputs_exist():
+    found = list(_inputs("regress"))
+    assert found, "native/fuzz/regress/ is empty — the fixed crashers " \
+                  "must be committed"
+
+
+def test_regress_replays_clean():
+    lib = native.load()
+    ran = 0
+    for target, name, data in _inputs("regress"):
+        fn = getattr(lib, "nat_fuzz_" + target)
+        rc = fn(data, len(data))
+        # surviving the call IS the gate (a regression dies in-process);
+        # additionally every committed crasher documents a rejected
+        # input, so the seam must report it rejected, not consumed
+        assert rc == 0, f"regress/{target}/{name}: rc={rc} " \
+                        f"(crasher now parses as valid?)"
+        ran += 1
+    assert ran >= 4
+
+
+def test_corpus_seeds_replay():
+    lib = native.load()
+    ran = 0
+    for target, name, data in _inputs("corpus"):
+        fn = getattr(lib, "nat_fuzz_" + target)
+        fn(data, len(data))  # survival is the assertion
+        ran += 1
+    assert ran >= len(TARGETS), "every target needs committed seeds"
+
+
+def test_every_target_has_seeds():
+    for target in TARGETS:
+        d = os.path.join(FUZZ_DIR, "corpus", target)
+        assert os.path.isdir(d) and os.listdir(d), \
+            f"no corpus seeds for {target}"
+
+
+@pytest.mark.slow
+def test_bounded_fuzz_budget():
+    if not (shutil.which("clang++") or shutil.which("g++")):
+        pytest.skip("no C++ toolchain for the fuzz lane")
+    from tools.natcheck import fuzzlane
+    findings = fuzzlane.run(budget_ms=2000)
+    assert findings == [], "\n".join(str(f) for f in findings)

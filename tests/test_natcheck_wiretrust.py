@@ -1,0 +1,168 @@
+"""wiretrust golden tests — the wire-input taint pass must fire.
+
+Each violation class the pass claims to catch gets a deliberate defect
+seeded into a temp tree and must be flagged: an unbounded memcpy length
+from wire bytes, an unclamped wire-sized allocation, a wire integer
+used as an array index, taint flowing through a helper into a sink in
+the caller (interprocedural), and a wire-bounded loop with no clamp.
+The allow-escape must suppress, a dominating bounds check must
+suppress, the shipped tree must come back clean, and the annotation
+surface must hold its breadth floor (>=6 wire sources across >=5 TUs).
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.natcheck import wiretrust  # noqa: E402
+
+
+def _check(tmp_path, src, name="case.cpp"):
+    p = tmp_path / name
+    p.write_text(src)
+    return wiretrust.check(str(tmp_path))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# the five golden violation classes
+# ---------------------------------------------------------------------------
+
+def test_flags_unbounded_memcpy_length(tmp_path):
+    findings = _check(tmp_path, """
+        void drain(const char* buf, char* dst) {
+            unsigned len = NAT_WIRE(rd32(buf));
+            memcpy(dst, buf + 4, len);
+        }
+    """)
+    assert "wire-int-unbounded" in _rules(findings), findings
+
+
+def test_flags_unclamped_alloc(tmp_path):
+    findings = _check(tmp_path, """
+        void grow(const char* buf, std::string* out) {
+            unsigned long n = NAT_WIRE(rd32(buf));
+            out->resize(n);
+        }
+    """)
+    assert "wire-alloc-unclamped" in _rules(findings), findings
+
+
+def test_flags_wire_array_index(tmp_path):
+    findings = _check(tmp_path, """
+        int pick(const char* buf, int* table) {
+            unsigned idx = NAT_WIRE(buf[0]);
+            return table[idx];
+        }
+    """)
+    assert "wire-int-unbounded" in _rules(findings), findings
+
+
+def test_flags_taint_through_helper(tmp_path):
+    # taint enters in the caller, the SINK lives in the helper: the
+    # finding needs the interprocedural summary (helper's param 0 is a
+    # memcpy length) plus the call-site taint match
+    findings = _check(tmp_path, """
+        void helper_sink(char* dst, const char* src, unsigned n) {
+            memcpy(dst, src, n);
+        }
+        unsigned helper_mid(unsigned v) { return v + 2; }
+        void drain(const char* buf, char* dst) {
+            unsigned len = NAT_WIRE(rd32(buf));
+            unsigned adj = helper_mid(len);
+            helper_sink(dst, buf, adj);
+        }
+    """)
+    assert "wire-int-unbounded" in _rules(findings), findings
+
+
+def test_flags_unbounded_wire_loop(tmp_path):
+    findings = _check(tmp_path, """
+        void walk(const char* buf, int* out) {
+            unsigned count = NAT_WIRE(rd32(buf));
+            for (unsigned i = 0; i < count; i++) {
+                out[0] += 1;
+            }
+        }
+    """)
+    assert "wire-loop-unbounded" in _rules(findings), findings
+
+
+# ---------------------------------------------------------------------------
+# suppression: bounds checks and the allow escape
+# ---------------------------------------------------------------------------
+
+def test_dominating_bounds_check_suppresses(tmp_path):
+    findings = _check(tmp_path, """
+        void drain(const char* buf, char* dst, unsigned cap) {
+            unsigned len = NAT_WIRE(rd32(buf));
+            if (len > cap) return;
+            memcpy(dst, buf + 4, len);
+        }
+    """)
+    assert findings == [], findings
+
+
+def test_clamp_suppresses_alloc(tmp_path):
+    findings = _check(tmp_path, """
+        void grow(const char* buf, std::string* out) {
+            unsigned long n = NAT_WIRE(rd32(buf));
+            out->resize(std::min(n, 4096ul));
+        }
+    """)
+    assert findings == [], findings
+
+
+def test_allow_escape_suppresses(tmp_path):
+    findings = _check(tmp_path, """
+        void drain(const char* buf, char* dst) {
+            unsigned len = NAT_WIRE(rd32(buf));
+            // natcheck:allow(wiretrust): dst is always 2^32 bytes
+            memcpy(dst, buf + 4, len);
+        }
+    """)
+    assert findings == [], findings
+
+
+def test_comment_grammar_seeds_taint(tmp_path):
+    # the comment form must work where no expression site exists
+    findings = _check(tmp_path, """
+        void drain(char* scan, char* dst) {
+            // natcheck:wire: scan — raw bytes off the socket drain
+            unsigned len = rd32(scan);
+            memcpy(dst, scan + 4, len);
+        }
+    """)
+    assert "wire-int-unbounded" in _rules(findings), findings
+
+
+def test_untainted_code_is_clean(tmp_path):
+    findings = _check(tmp_path, """
+        void copy(char* dst, const char* src) {
+            unsigned len = rd32(src);
+            memcpy(dst, src + 4, len);
+        }
+    """)
+    assert findings == [], findings
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree and the annotation surface
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_clean():
+    findings = wiretrust.run()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_wire_source_breadth_floor():
+    # the annotation surface must actually cover the wire-facing
+    # parsers: >=6 declared wire sources spread over >=5 TUs
+    sources = wiretrust.collect_wire_sources(wiretrust.SRC_DIR)
+    assert len(sources) >= 6, sources
+    tus = {path for path, _line, _names in sources}
+    assert len(tus) >= 5, tus

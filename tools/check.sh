@@ -2,13 +2,15 @@
 # tools/check.sh — the natcheck gate (also `make -C native check`).
 #
 # Runs the fast static passes first (concurrency lint + ABI/FFI contract
-# + lock-order verification + refown ownership contracts — pure Python,
-# seconds), then the lock-rank runtime validator (NAT_LOCKRANK build of
-# the .so driven by the smoke — a rank inversion or a NatMutex held
-# across a fiber switch aborts it) and the refguard refcount validator
-# (NAT_REFGUARD build: an unbalanced acquire/release tag pair aborts the
-# smoke with the pair printed); both skipped with a note when the
-# toolchain is absent.
+# + lock-order verification + refown ownership contracts + wiretrust
+# wire-input taint — pure Python, seconds), then the lock-rank runtime
+# validator (NAT_LOCKRANK build of the .so driven by the smoke — a rank
+# inversion or a NatMutex held across a fiber switch aborts it), the
+# refguard refcount validator (NAT_REFGUARD build: an unbalanced
+# acquire/release tag pair aborts the smoke with the pair printed), and
+# the strict UBSan smoke (-fno-sanitize-recover build: any undefined
+# behaviour aborts); all skipped with a note when the toolchain is
+# absent.
 #
 # NATCHECK_SLOW=1 adds the sanitizer lane (ASan+UBSan and TSan builds +
 # smoke; several minutes of compile) and the dsched interleaving smoke.
@@ -30,6 +32,11 @@
 # live 3-server group behind a file naming feed, real traffic, then
 # wire-native builtin.stats scrape -> exact histogram merge -> fleet
 # quantiles -> SLO engine, end to end (see tools/natcheck/fleet.py).
+# --fuzz (or NATCHECK_FUZZ=1) runs the bounded deterministic parser
+# fuzz lane: every native/fuzz target (ASan+UBSan, fixed seed) over its
+# committed corpus + regress inputs for NATCHECK_FUZZ_MS (default
+# 2000ms) each; any crash or sanitizer report fails (see
+# tools/natcheck/fuzzlane.py).
 # --bench (or NATCHECK_BENCH=1) runs the perf regression gate: bench.py
 # with the nat_prof flight recorder attached, a schema'd artifact
 # (BENCH_latest.json), and a headline-lane diff against the last
@@ -49,6 +56,7 @@ BENCH="${NATCHECK_BENCH:-0}"
 REFGUARD="${NATCHECK_REFGUARD:-0}"
 REPLAY="${NATCHECK_REPLAY:-0}"
 FLEET="${NATCHECK_FLEET:-0}"
+FUZZ="${NATCHECK_FUZZ:-0}"
 for arg in "$@"; do
     case "$arg" in
         --soak) SOAK=1 ;;
@@ -57,15 +65,16 @@ for arg in "$@"; do
         --refguard) REFGUARD=1 ;;
         --replay) REPLAY=1 ;;
         --fleet) FLEET=1 ;;
+        --fuzz) FUZZ=1 ;;
     esac
 done
 
 # static passes first: they need no toolchain and must report even when
 # the compile below cannot run
 if [ "$SOAK" = "1" ] || [ "${NATCHECK_SLOW:-0}" = "1" ]; then
-    "$PY" -m tools.natcheck lint abi lockorder refown model san || RC=1
+    "$PY" -m tools.natcheck lint abi lockorder refown wiretrust model san || RC=1
 else
-    "$PY" -m tools.natcheck lint abi lockorder refown || RC=1
+    "$PY" -m tools.natcheck lint abi lockorder refown wiretrust || RC=1
 fi
 
 # lock-rank runtime validator: build + drive the smoke under it
@@ -93,6 +102,20 @@ if command -v g++ >/dev/null 2>&1; then
     fi
 else
     echo "natcheck: refguard: skipped (no g++)"
+fi
+
+# strict UBSan smoke: -fno-sanitize-recover build — any undefined
+# behaviour aborts the smoke instead of printing and continuing
+if command -v g++ >/dev/null 2>&1; then
+    if make -C native ubsan >/dev/null 2>&1 &&
+           UBSAN_OPTIONS=print_stacktrace=1 native/nat_smoke_ubsan >/dev/null; then
+        echo "natcheck: ubsan: clean"
+    else
+        echo "natcheck: ubsan: FAILED (undefined behaviour or smoke error)"
+        RC=1
+    fi
+else
+    echo "natcheck: ubsan: skipped (no g++)"
 fi
 
 if [ "$REFGUARD" = "1" ]; then
@@ -145,6 +168,19 @@ print("natcheck: fleet: %s"
 print_findings(findings)
 sys.exit(1 if findings else 0)
 PYFL
+fi
+
+if [ "$FUZZ" = "1" ]; then
+    "$PY" - <<'PYFZ' || RC=1
+import sys
+sys.path.insert(0, ".")
+from tools.natcheck import print_findings, fuzzlane
+findings = fuzzlane.run()
+print("natcheck: fuzz: %s"
+      % ("clean" if not findings else "%d finding(s)" % len(findings)))
+print_findings(findings)
+sys.exit(1 if findings else 0)
+PYFZ
 fi
 
 if [ "$BENCH" = "1" ]; then

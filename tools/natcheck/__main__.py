@@ -1,7 +1,7 @@
-"""CLI: ``python -m tools.natcheck [abi] [lint] [lockorder] [refown] [san] [model]``.
+"""CLI: ``python -m tools.natcheck [abi] [lint] [lockorder] [refown] [wiretrust] [san] [model]``.
 
 With no pass named, runs the fast static passes (lint + abi + lockorder
-+ refown).
++ refown + wiretrust).
 ``--model`` (or naming ``model``) adds the dsched interleaving smoke
 (compiles native/model/, bounded exploration); ``san`` (or
 NATCHECK_SLOW=1 in tools/check.sh) adds the sanitizer lane. Exits 1 on
@@ -19,15 +19,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from tools.natcheck import print_findings  # noqa: E402
 
-DEFAULT_PASSES = ["lint", "abi", "lockorder", "refown"]
+DEFAULT_PASSES = ["lint", "abi", "lockorder", "refown", "wiretrust"]
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tools.natcheck")
     ap.add_argument("passes", nargs="*",
-                    choices=["abi", "lint", "lockorder", "refown", "san", "model",
-                             []],
-                    help="passes to run (default: lint abi lockorder refown)")
+                    choices=["abi", "lint", "lockorder", "refown", "wiretrust",
+                             "san", "model", []],
+                    help="passes to run (default: lint abi lockorder refown wiretrust)")
     ap.add_argument("--model", action="store_true",
                     help="also run the dsched interleaving smoke")
     args = ap.parse_args(argv)
@@ -51,6 +51,9 @@ def main(argv=None) -> int:
             elif p == "refown":
                 from tools.natcheck import refown
                 got = refown.run()
+            elif p == "wiretrust":
+                from tools.natcheck import wiretrust
+                got = wiretrust.run()
             elif p == "model":
                 from tools.natcheck import model
                 got = model.run()

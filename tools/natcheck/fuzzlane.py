@@ -1,0 +1,121 @@
+"""Fuzz lane — build the parser fuzz targets, run each for a bounded,
+deterministic budget over its committed corpus, fail on any crash or
+sanitizer report.
+
+``make -C native fuzz`` builds one binary per hand-rolled wire parser
+(native/fuzz/fuzz_*.cpp — tpu_std RpcMeta varints, HTTP/1, h2 frames,
+HPACK, RESP, the recordio loader, the shm segment header), each linked
+against the ASan+UBSan .so and driving the real production entry via
+its nat_fuzz_* seam (native/src/nat_fuzz_entry.cpp). With clang++ on
+PATH the binaries are libFuzzer (coverage-guided); otherwise the
+bundled deterministic driver (native/fuzz/fuzz_driver_main.cpp) replays
+the corpus and runs a fixed-seed mutation loop — either way this lane
+passes ``-seed``/``--seed`` and a time budget so CI runs are
+reproducible and bounded.
+
+Inputs per target: ``native/fuzz/corpus/<name>/`` (structure-aware hand
+seeds) plus ``native/fuzz/regress/<name>/`` (minimized crashers from
+past findings, committed so they are re-fuzzed forever, not just
+replayed — the fast replay gate is tests/test_fuzz_regress.py).
+
+A nonzero exit or a sanitizer marker in the output is a finding. The
+budget default (2s/target) keeps ``tools/check.sh --fuzz`` in CI
+territory; crank NATCHECK_FUZZ_MS for a soak.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import List
+
+from tools.natcheck import Finding, REPO_ROOT
+
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+FUZZ_DIR = os.path.join(NATIVE_DIR, "fuzz")
+
+TARGETS = ("rpc_meta", "http", "h2", "redis", "hpack", "recordio",
+           "shm_seg")
+
+SEED = 20250806  # fixed: the lane must be reproducible run-to-run
+
+_BAD_MARKERS = (
+    "ERROR: AddressSanitizer",
+    "ERROR: LeakSanitizer",
+    "runtime error:",
+    "SUMMARY: UndefinedBehaviorSanitizer",
+    "SUMMARY: libFuzzer",
+    "DEADLYSIGNAL",
+)
+
+
+def _is_libfuzzer(binary: str) -> bool:
+    """libFuzzer binaries answer -help=1; the standalone driver rejects
+    unknown flags with exit 2 and no libFuzzer banner."""
+    try:
+        proc = subprocess.run([binary, "-help=1"], capture_output=True,
+                              timeout=30)
+    except Exception:
+        return False
+    return b"libFuzzer" in proc.stdout + proc.stderr
+
+
+def build(timeout: int = 900) -> None:
+    """Build the asan .so + every fuzz binary (raises on failure)."""
+    subprocess.run(["make", "-C", NATIVE_DIR, "fuzz"], check=True,
+                   capture_output=True, timeout=timeout)
+
+
+def run_target(name: str, budget_ms: int) -> "tuple[int, str]":
+    """Run one target for budget_ms over corpus+regress; returns
+    (exit code, combined output)."""
+    binary = os.path.join(FUZZ_DIR, "bin", "fuzz_" + name)
+    dirs = [d for d in (os.path.join(FUZZ_DIR, "corpus", name),
+                        os.path.join(FUZZ_DIR, "regress", name))
+            if os.path.isdir(d)]
+    env = dict(os.environ)
+    env["ASAN_OPTIONS"] = "abort_on_error=0:exitcode=87"
+    env["UBSAN_OPTIONS"] = "print_stacktrace=1"
+    env["LSAN_OPTIONS"] = (
+        "suppressions=%s" % os.path.join(NATIVE_DIR, "lsan.supp"))
+    if _is_libfuzzer(binary):
+        secs = max(1, budget_ms // 1000)
+        cmd = [binary, "-seed=%d" % SEED, "-max_total_time=%d" % secs,
+               "-print_final_stats=0"] + dirs
+    else:
+        cmd = [binary, "--seed", str(SEED), "--budget-ms",
+               str(budget_ms)] + dirs
+    proc = subprocess.run(cmd, capture_output=True,
+                          timeout=60 + 10 * (budget_ms // 1000), env=env)
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    return proc.returncode, out
+
+
+def run(budget_ms: int = 0) -> List[Finding]:
+    if budget_ms <= 0:
+        budget_ms = int(os.environ.get("NATCHECK_FUZZ_MS", "2000"))
+    findings: List[Finding] = []
+    try:
+        build()
+    except subprocess.CalledProcessError as e:
+        findings.append(Finding(
+            "fuzz", "fuzz-build", "native/Makefile",
+            "fuzz build failed: " +
+            (e.stderr or b"").decode(errors="replace")[-800:]))
+        return findings
+    for name in TARGETS:
+        try:
+            rc, out = run_target(name, budget_ms)
+        except subprocess.TimeoutExpired:
+            findings.append(Finding(
+                "fuzz", "fuzz-hang", f"native/fuzz/bin/fuzz_{name}",
+                f"target wedged past its {budget_ms}ms budget"))
+            continue
+        bad = [ln for ln in out.splitlines()
+               if any(mk in ln for mk in _BAD_MARKERS)]
+        if rc != 0 or bad:
+            head = "; ".join(bad[:3]) if bad else out.strip()[-400:]
+            findings.append(Finding(
+                "fuzz", "fuzz-crash", f"native/fuzz/bin/fuzz_{name}",
+                f"fuzz run exited rc={rc}: {head}"))
+    return findings

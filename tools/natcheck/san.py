@@ -1,11 +1,18 @@
-"""Sanitizer lane — build the .so under ASan+UBSan / TSan, run the smoke.
+"""Sanitizer lane — build the .so under ASan+UBSan / TSan / UBSan-strict,
+run the smoke.
 
-``make -C native asan`` / ``make -C native tsan`` build the instrumented
-library plus ``nat_smoke_{asan,tsan}``, a driver that links the .so
-through the public C API and exercises the smoke subset: echo (native
-framework calls), http (native HTTP lane round trips), stats (counters +
-span drain), clean exit (the PR-1 static-destructor class — the process
-must return 0 with runtime threads still live).
+``make -C native asan`` / ``make -C native tsan`` / ``make -C native
+ubsan`` build the instrumented library plus ``nat_smoke_{kind}``, a
+driver that links the .so through the public C API and exercises the
+smoke subset: echo (native framework calls), http (native HTTP lane
+round trips), stats (counters + span drain), clean exit (the PR-1
+static-destructor class — the process must return 0 with runtime
+threads still live).
+
+The dedicated ubsan lane differs from the UBSan piggybacked on asan in
+one load-bearing way: it is built ``-fno-sanitize-recover=undefined``,
+so any undefined behaviour ABORTS the smoke instead of printing and
+continuing — a hard gate rather than a log line.
 
 Suppressions live in native/*.supp; every entry carries a comment saying
 why it is a false positive. An unsuppressed report fails the lane.
@@ -36,6 +43,8 @@ def _env(kind: str) -> dict:
         env["UBSAN_OPTIONS"] = "print_stacktrace=1"
         env["LSAN_OPTIONS"] = (
             "suppressions=%s" % os.path.join(NATIVE_DIR, "lsan.supp"))
+    elif kind == "ubsan":
+        env["UBSAN_OPTIONS"] = "print_stacktrace=1"
     else:
         env["TSAN_OPTIONS"] = (
             "suppressions=%s:halt_on_error=0:exitcode=86"
@@ -44,9 +53,10 @@ def _env(kind: str) -> dict:
 
 
 def build_and_run(kind: str, timeout: int = 900) -> Tuple[int, str]:
-    """Build the `kind` lane ('asan'|'tsan') and run its smoke binary.
-    Returns (exit code, combined output); raises on build failure."""
-    assert kind in ("asan", "tsan")
+    """Build the `kind` lane ('asan'|'tsan'|'ubsan') and run its smoke
+    binary. Returns (exit code, combined output); raises on build
+    failure."""
+    assert kind in ("asan", "tsan", "ubsan")
     subprocess.run(["make", "-C", NATIVE_DIR, kind], check=True,
                    capture_output=True, timeout=timeout)
     proc = subprocess.run(
@@ -57,7 +67,7 @@ def build_and_run(kind: str, timeout: int = 900) -> Tuple[int, str]:
     return proc.returncode, out
 
 
-def run(kinds=("asan", "tsan")) -> List[Finding]:
+def run(kinds=("asan", "tsan", "ubsan")) -> List[Finding]:
     findings: List[Finding] = []
     for kind in kinds:
         try:

@@ -1,0 +1,546 @@
+"""Wire-input taint verification — hostile bytes must meet a bound.
+
+The native tree turns attacker- or corruption-controlled bytes into
+lengths, offsets, allocation sizes and loop bounds in six hand-rolled
+parsers (tpu_std rpc_meta varints, HTTP/1, h2/HPACK, RESP, the recordio
+capture loader, the shm descriptor/fabric records). This pass makes the
+trust boundary explicit and machine-checked:
+
+Annotation surface (``native/src/nat_internal.h``):
+
+- ``NAT_WIRE(expr)`` — a no-op macro marking ``expr`` as wire-origin at
+  the point it enters the parser. On an assignment/declaration line the
+  declared variable becomes tainted; standalone, every identifier inside
+  the parens does.
+- ``// natcheck:wire: a, b`` — names identifiers (locals or parameters)
+  of the enclosing function as wire-tainted from that line on. On or
+  directly above a function signature it taints the named parameters.
+
+Taint propagates forward through assignments (including through calls:
+``n = rd_be32(p)`` with ``p`` tainted taints ``n``) and — with a
+transitive call closure reusing lockorder.py's walker — through function
+parameters and return values. A value stops being dangerous once a
+DOMINATING BOUNDS CHECK is seen: a relational comparison against it, or
+a ``min``/``max``/``clamp`` rebind, or a masking/modulo derivation.
+
+Rules (suppress with ``// natcheck:allow(wiretrust): why``):
+
+- ``wire-int-unbounded``: a wire-derived integer used as a
+  memcpy/memmove/memset length, an array index, or a pointer offset
+  with no dominating bounds check.
+- ``wire-alloc-unclamped``: a wire-derived integer used as an
+  allocation size (malloc/calloc/realloc/new[]) or container
+  resize/reserve with no clamp.
+- ``wire-loop-unbounded``: a loop whose bound is a wire-derived integer
+  with no prior cap (the loop's own condition does not count — that IS
+  the unbounded iteration).
+
+Interprocedural findings are reported at the call site ("via helper()"),
+so the fix lands where the unclamped value crosses the boundary.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from functools import lru_cache
+from typing import Dict, List, Optional, Set, Tuple
+
+if __package__ in (None, ""):  # `python tools/natcheck/wiretrust.py`
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.natcheck import Finding, REPO_ROOT  # noqa: E402
+from tools.natcheck.lockorder import (  # noqa: E402
+    _CALL, _CALL_STOP, _allowed, _strip_comments_and_strings,
+    collect_sources, parse_functions, FuncInfo, _dedupe)
+
+SRC_DIR = os.path.join(REPO_ROOT, "native", "src")
+
+# names run until a dash/paren/end: "natcheck:wire: a, b — why"
+_WIRE_COMMENT = re.compile(r"natcheck:wire\s*[:(]\s*([A-Za-z_]\w*"
+                           r"(?:\s*,\s*[A-Za-z_]\w*)*)")
+_WIRE_MACRO = re.compile(r"\bNAT_WIRE\s*\(")
+
+# relational operator that is a COMPARISON (not <<, >>, ->, <>, template)
+_CMP = r"(?:==|!=|<=|>=|(?<![<-])<(?![<=])|(?<![->])>(?![>=]))"
+
+# assignment line: `lhs = rhs` / `type lhs = rhs` / `lhs += rhs`
+_ASSIGN = re.compile(
+    r"(?:^|[;{(]|\bif\b|\bwhile\b)\s*"               # statement start-ish
+    r"(?:[\w:<>,*&\s]+?\s)?"                          # optional decl type
+    r"([A-Za-z_]\w*)\s*"                              # lhs identifier
+    r"(?:\[[^\]]*\]\s*)?"                             # optional subscript
+    r"(\+=|-=|\|=|&=|\^=|=)(?!=)\s*(.*)")             # op + rhs
+_RETURN = re.compile(r"\breturn\b\s*([^;]*);")
+
+# sinks
+_MEMLEN = re.compile(r"\b(?:memcpy|memmove|memset)\s*\(")
+_ALLOC = re.compile(
+    r"(?:\.|->)\s*(?:resize|reserve)\s*\(|"
+    r"\b(?:malloc|alloca)\s*\(|\bcalloc\s*\(|\brealloc\s*\(|"
+    r"\bnew\s+[\w:<>]+\s*\[")
+_NEW_ARR = re.compile(r"\bnew\s+[\w:<>]+\s*\[([^\]]*)\]")
+_INDEX = re.compile(r"\b[A-Za-z_]\w*(?:\.|->)?\w*\s*\[([^\]]+)\]")
+_PTR_OFF = re.compile(r"\*\s*\(\s*[A-Za-z_][\w.>\-]*\s*\+\s*([^)]+)\)")
+_FOR_COND = re.compile(r"\bfor\s*\([^;]*;([^;]*);")
+_WHILE_COND = re.compile(r"\bwhile\s*\(([^)]*)\)")
+
+# call-name stoplist for the call closure: lockorder's plus the sink
+# names and libc converters this pass models directly
+_STOP = _CALL_STOP | {
+    "memmove", "realloc", "alloca", "strtol", "strtoll", "strtoul",
+    "strtoull", "memchr", "copy_to", "fetch", "pop_front", "length",
+    "NAT_WIRE", "if", "return", "sizeof",
+}
+
+_SANITIZED = re.compile(
+    r"\b(?:std::)?(?:min|max|clamp)\s*\(|"
+    r"%(?!=)|"                                 # modulo derivation
+    r"&\s*(?:0[xX][0-9a-fA-F]+|\d+|k[A-Z]\w*)")  # constant mask
+
+
+@lru_cache(maxsize=None)
+def _ident_re(name: str) -> "re.Pattern":
+    return re.compile(r"\b%s\b" % re.escape(name))
+
+
+@lru_cache(maxsize=None)
+def _cmp_res(ident: str) -> Tuple["re.Pattern", ...]:
+    e = re.escape(ident)
+    return (re.compile(r"\b%s\b\s*%s" % (e, _CMP)),
+            re.compile(r"%s[^;,={}()]{0,60}\b%s\b" % (_CMP, e)),
+            re.compile(r"\b(?:std::)?(?:min|max|clamp)\s*"
+                       r"\([^;{}]{0,120}\b%s\b" % e))
+
+
+@lru_cache(maxsize=None)
+def _loop_bound_re(ident: str) -> "re.Pattern":
+    e = re.escape(ident)
+    return re.compile(r"\b%s\b\s*(?:%s|--)|%s\s*[^=\s]*\s*\b%s\b"
+                      % (e, _CMP, _CMP, e))
+
+
+def _has_cmp_against(text: str, ident: str) -> bool:
+    """`ident` appears on either side of a relational comparison."""
+    if ident not in text:
+        return False
+    return any(r.search(text) for r in _cmp_res(ident))
+
+
+def _call_args(text: str, open_idx: int) -> List[str]:
+    """Split the argument list whose '(' is at `open_idx` on top-level
+    commas. Returns [] on unbalanced text."""
+    depth = 0
+    args: List[str] = []
+    cur = []
+    k = open_idx
+    while k < len(text):
+        ch = text[k]
+        if ch in "([":
+            depth += 1
+            if depth > 1:
+                cur.append(ch)
+        elif ch in ")]":
+            depth -= 1
+            if depth == 0:
+                args.append("".join(cur).strip())
+                return args
+            cur.append(ch)
+        elif ch == "," and depth == 1:
+            args.append("".join(cur).strip())
+            cur = []
+        else:
+            if depth >= 1:
+                cur.append(ch)
+        k += 1
+    return []
+
+
+def _param_names(scrubbed: str, fn: FuncInfo) -> List[str]:
+    """Parameter names of `fn`, by position, parsed from the signature
+    directly before the body's opening brace."""
+    j = fn.body_off - 1
+    # skip const/noexcept/trailing-return between ')' and '{'
+    while j >= 0 and scrubbed[j] != ")":
+        j -= 1
+    if j < 0:
+        return []
+    depth = 0
+    k = j
+    while k >= 0:
+        if scrubbed[k] == ")":
+            depth += 1
+        elif scrubbed[k] == "(":
+            depth -= 1
+            if depth == 0:
+                break
+        k -= 1
+    if k < 0:
+        return []
+    params = _call_args(scrubbed, k)
+    names: List[str] = []
+    for p in params:
+        p = p.split("=")[0].strip()       # drop default value
+        p = re.sub(r"\[[^\]]*\]\s*$", "", p)  # drop array suffix
+        m = re.search(r"([A-Za-z_]\w*)\s*$", p)
+        if m and m.group(1) not in ("void", "const", "int", "char",
+                                    "size_t", "uint64_t", "uint32_t"):
+            names.append(m.group(1))
+        else:
+            names.append("")              # unnamed / type-only param
+    if names == [""]:
+        return []
+    return names
+
+
+class Summary:
+    """Per-function interprocedural facts, computed to fixpoint."""
+
+    def __init__(self):
+        # param index -> rule it reaches unchecked ("wire-int-unbounded"
+        # / "wire-alloc-unclamped" / "wire-loop-unbounded")
+        self.sink_params: Dict[int, str] = {}
+        self.returns_wire = False
+        self.returns_params: Set[int] = set()
+
+
+class _Analysis:
+    """One pass over one function body: propagate labels line by line.
+
+    Labels: "wire" (a real wire source) and "p<i>" (came from parameter
+    i — used only to build the interprocedural summary)."""
+
+    def __init__(self, fn: FuncInfo, rel: str, raw_lines: List[str],
+                 params: List[str],
+                 summaries: Dict[str, Summary]):
+        self.fn = fn
+        self.rel = rel
+        self.raw_lines = raw_lines
+        self.params = params
+        self.summaries = summaries
+        self.labels: Dict[str, Set[str]] = {}
+        self.checked: Set[str] = set()
+        self.findings: List[Finding] = []
+        self.summary = Summary()
+
+    # -- label helpers ------------------------------------------------------
+
+    def _live_labels(self, expr: str) -> Set[str]:
+        """Labels of every tainted-and-unchecked ident in `expr`."""
+        out: Set[str] = set()
+        for ident, labs in self.labels.items():
+            if ident in self.checked or ident not in expr:
+                continue
+            if _ident_re(ident).search(expr):
+                out |= labs
+        return out
+
+    def _taint(self, ident: str, labs: Set[str]) -> None:
+        if not labs or not ident:
+            return
+        self.labels.setdefault(ident, set()).update(labs)
+        self.checked.discard(ident)  # fresh wire value: re-check needed
+
+    def _allowed_at(self, abs_line: int) -> bool:
+        i = abs_line - 1
+        return (_allowed(self.raw_lines, i, "wiretrust") or
+                _allowed(self.raw_lines, i, "wire-int-unbounded") or
+                _allowed(self.raw_lines, i, "wire-alloc-unclamped") or
+                _allowed(self.raw_lines, i, "wire-loop-unbounded"))
+
+    def _report(self, rule: str, abs_line: int, msg: str) -> None:
+        if self._allowed_at(abs_line):
+            return
+        self.findings.append(Finding(
+            "wiretrust", rule, f"{self.rel}:{abs_line}", msg))
+
+    def _sink(self, rule: str, labs: Set[str], abs_line: int,
+              what: str, report: bool) -> None:
+        if "wire" in labs and report:
+            self._report(rule, abs_line,
+                         f"wire-derived integer used as {what} with no "
+                         f"dominating bounds check")
+        for lab in labs:
+            if lab.startswith("p"):
+                idx = int(lab[1:])
+                self.summary.sink_params.setdefault(idx, rule)
+
+    # -- seeds --------------------------------------------------------------
+
+    def _seed_line(self, line: str, raw: str, abs_line: int) -> None:
+        m = _WIRE_COMMENT.search(raw)
+        if m:
+            for name in re.split(r"[,\s]+", m.group(1)):
+                if name:
+                    self._taint(name, {"wire"})
+        if _WIRE_MACRO.search(line):
+            am = re.search(r"([A-Za-z_]\w*)\s*=[^=].*\bNAT_WIRE\s*\(",
+                           line)
+            if am:
+                self._taint(am.group(1), {"wire"})
+            else:
+                mm = _WIRE_MACRO.search(line)
+                args = _call_args(line, mm.end() - 1)
+                for a in args:
+                    for ident in re.findall(r"[A-Za-z_]\w*", a):
+                        self._taint(ident, {"wire"})
+
+    def _seed_params(self) -> None:
+        # natcheck:wire above the signature taints named params; every
+        # param additionally carries its positional label for summaries
+        for off, name in enumerate(self.params):
+            if name:
+                self._taint(name, {"p%d" % off})
+        j = self.fn.start_line - 2
+        while j >= 0 and self.fn.start_line - j <= 6:
+            stripped = self.raw_lines[j].strip() \
+                if j < len(self.raw_lines) else ""
+            if not stripped.startswith("//"):
+                break
+            m = _WIRE_COMMENT.search(stripped)
+            if m:
+                for name in re.split(r"[,\s]+", m.group(1)):
+                    if name:
+                        self._taint(name, {"wire"})
+            j -= 1
+
+    # -- the walk -----------------------------------------------------------
+
+    def run(self, report: bool) -> None:
+        body_lines = self.fn.body.split("\n")
+        self._seed_params()
+        # callees whose return value is wire-tainted can introduce taint
+        # on lines that mention no currently-live ident
+        wire_returners = tuple(n for n, s in self.summaries.items()
+                               if s.returns_wire)
+        # two passes: the first discovers taint introduced later in the
+        # body by helpers whose summaries mention it; the second reports
+        # with the full taint map. Only the last pass reports.
+        for final in (False, True):
+            self.checked = set()
+            for idx, line in enumerate(body_lines):
+                abs_line = self.fn.start_line + idx
+                raw = self.raw_lines[abs_line - 1] \
+                    if abs_line - 1 < len(self.raw_lines) else ""
+                # fast path: a line with no live tainted ident, no wire
+                # annotation, and no taint-returning callee cannot
+                # change state or fire a rule
+                if "NAT_WIRE" not in line and "natcheck:wire" not in raw:
+                    live = any(i in line for i in self.labels
+                               if i not in self.checked)
+                    if not live and not any(n in line
+                                            for n in wire_returners):
+                        continue
+                self._seed_line(line, raw, abs_line)
+                self._loops(line, abs_line, report and final)
+                self._checks(line)
+                self._assign(line)
+                self._calls(line, abs_line, report and final)
+                self._sinks(line, abs_line, report and final)
+                self._returns(line)
+
+    def _loops(self, line: str, abs_line: int, report: bool) -> None:
+        conds = [m.group(1) for m in _FOR_COND.finditer(line)]
+        conds += [m.group(1) for m in _WHILE_COND.finditer(line)]
+        for cond in conds:
+            labs: Set[str] = set()
+            for ident, ls in self.labels.items():
+                if ident in self.checked or ident not in cond:
+                    continue
+                if _loop_bound_re(ident).search(cond):
+                    labs |= ls
+            if labs:
+                self._sink("wire-loop-unbounded", labs, abs_line,
+                           "a loop bound", report)
+
+    def _checks(self, line: str) -> None:
+        # loop conditions must not count as the bound for the loop rule,
+        # but DO dominate sinks inside the loop body (i < n caps i); the
+        # simple approximation: any relational mention checks the ident.
+        for ident in list(self.labels):
+            if ident in self.checked or ident not in line:
+                continue
+            if _has_cmp_against(line, ident):
+                self.checked.add(ident)
+
+    def _assign(self, line: str) -> None:
+        for m in _ASSIGN.finditer(line):
+            lhs, op, rhs = m.group(1), m.group(2), m.group(3)
+            if lhs in ("if", "while", "return", "for", "else"):
+                continue
+            if _SANITIZED.search(rhs):
+                continue  # min/clamp/mask/mod: bounded by construction
+            labs = self._live_labels(rhs)
+            # returns-taint through a call on the RHS
+            for cm in _CALL.finditer(rhs):
+                s = self.summaries.get(cm.group(1))
+                if s is None:
+                    continue
+                if s.returns_wire:
+                    labs = labs | {"wire"}
+                if s.returns_params:
+                    args = _call_args(rhs, cm.end() - 1)
+                    for pi in s.returns_params:
+                        if pi < len(args):
+                            labs = labs | self._live_labels(args[pi])
+            if op == "=" and not labs:
+                # overwritten with an untainted value: clears taint
+                if lhs in self.labels and not \
+                        _ident_re(lhs).search(rhs):
+                    self.labels.pop(lhs, None)
+                    self.checked.discard(lhs)
+                continue
+            self._taint(lhs, labs)
+
+    def _calls(self, line: str, abs_line: int, report: bool) -> None:
+        for m in _CALL.finditer(line):
+            name = m.group(1)
+            if name in _STOP:
+                continue
+            s = self.summaries.get(name)
+            if s is None or not s.sink_params:
+                continue
+            args = _call_args(line, m.end() - 1)
+            for pi, rule in s.sink_params.items():
+                if pi >= len(args):
+                    continue
+                labs = self._live_labels(args[pi])
+                what = {"wire-int-unbounded": "length/index",
+                        "wire-alloc-unclamped": "allocation",
+                        "wire-loop-unbounded": "loop-bound"}[rule]
+                if "wire" in labs and report:
+                    self._report(rule, abs_line,
+                                 f"wire-derived integer flows unchecked "
+                                 f"into a {what} sink via {name}() "
+                                 f"(parameter {pi})")
+                for lab in labs:
+                    if lab.startswith("p"):
+                        self.summary.sink_params.setdefault(
+                            int(lab[1:]), rule)
+
+    def _sinks(self, line: str, abs_line: int, report: bool) -> None:
+        # memcpy/memmove/memset length (3rd argument)
+        for m in _MEMLEN.finditer(line):
+            args = _call_args(line, m.end() - 1)
+            if len(args) >= 3:
+                self._sink("wire-int-unbounded",
+                           self._live_labels(args[2]), abs_line,
+                           "a memcpy/memmove/memset length", report)
+        # allocation / resize / reserve
+        for m in _ALLOC.finditer(line):
+            nm = _NEW_ARR.search(line, m.start())
+            if nm is not None and nm.start() == m.start():
+                expr = nm.group(1)
+            else:
+                op = line.find("(", m.start())
+                if op < 0:
+                    continue
+                args = _call_args(line, op)
+                expr = ",".join(args)
+            self._sink("wire-alloc-unclamped", self._live_labels(expr),
+                       abs_line, "an allocation size", report)
+        # array index / pointer offset
+        for m in _INDEX.finditer(line):
+            self._sink("wire-int-unbounded",
+                       self._live_labels(m.group(1)), abs_line,
+                       "an array index", report)
+        for m in _PTR_OFF.finditer(line):
+            self._sink("wire-int-unbounded",
+                       self._live_labels(m.group(1)), abs_line,
+                       "a pointer offset", report)
+
+    def _returns(self, line: str) -> None:
+        for m in _RETURN.finditer(line):
+            labs = self._live_labels(m.group(1))
+            if "wire" in labs:
+                self.summary.returns_wire = True
+            for lab in labs:
+                if lab.startswith("p"):
+                    self.summary.returns_params.add(int(lab[1:]))
+
+
+def collect_wire_sources(src_dir: str = SRC_DIR) \
+        -> List[Tuple[str, int, str]]:
+    """Every annotated wire source: (relpath, line, annotation text).
+    The golden breadth-floor test counts these."""
+    out: List[Tuple[str, int, str]] = []
+    for path, text in collect_sources(src_dir).items():
+        rel = os.path.relpath(path, REPO_ROOT)
+        for i, raw in enumerate(text.splitlines()):
+            if "#define NAT_WIRE" in raw:
+                continue  # the macro definition is not a source
+            if _WIRE_COMMENT.search(raw) or \
+                    _WIRE_MACRO.search(_strip_comments_and_strings(raw)):
+                out.append((rel, i + 1, raw.strip()))
+    return out
+
+
+def check(src_dir: str = SRC_DIR, dump: bool = False) -> List[Finding]:
+    sources = collect_sources(src_dir)
+    per_fn: List[Tuple[FuncInfo, str, List[str], List[str]]] = []
+    summaries: Dict[str, Summary] = {}
+    for path, text in sources.items():
+        rel = os.path.relpath(path, REPO_ROOT)
+        raw_lines = text.splitlines()
+        scrubbed = "\n".join(_strip_comments_and_strings(ln)
+                             for ln in raw_lines)
+        for fn in parse_functions(path, text):
+            params = _param_names(scrubbed, fn)
+            per_fn.append((fn, rel, raw_lines, params))
+
+    # fixpoint over summaries (3 rounds bounds the transitive closure
+    # depth this tree needs); after round one, only functions whose
+    # callees' summaries changed are re-analyzed
+    dirty = {fn.name for fn, _, _, _ in per_fn}
+    for _ in range(3):
+        changed_names: Set[str] = set()
+        for fn, rel, raw_lines, params in per_fn:
+            if fn.name not in dirty:
+                continue
+            a = _Analysis(fn, rel, raw_lines, params, summaries)
+            a.run(report=False)
+            prev = summaries.get(fn.name)
+            if prev is None or \
+                    prev.sink_params != a.summary.sink_params or \
+                    prev.returns_wire != a.summary.returns_wire or \
+                    prev.returns_params != a.summary.returns_params:
+                summaries[fn.name] = a.summary
+                changed_names.add(fn.name)
+        if not changed_names:
+            break
+        dirty = {fn.name for fn, _, _, _ in per_fn
+                 if any(c in changed_names for c, _ in fn.calls)}
+
+    findings: List[Finding] = []
+    for fn, rel, raw_lines, params in per_fn:
+        a = _Analysis(fn, rel, raw_lines, params, summaries)
+        a.run(report=True)
+        findings.extend(a.findings)
+
+    if dump:
+        print("== wire sources ==")
+        for rel, line, text in collect_wire_sources(src_dir):
+            print(f"  {rel}:{line}  {text}")
+        print("== interprocedural sink summaries ==")
+        for name, s in sorted(summaries.items()):
+            if s.sink_params or s.returns_wire:
+                print(f"  {name}: params {s.sink_params} "
+                      f"returns_wire={s.returns_wire}")
+    return _dedupe(findings)
+
+
+def run(src_dir: str = SRC_DIR) -> List[Finding]:
+    return check(src_dir)
+
+
+if __name__ == "__main__":
+    src = SRC_DIR
+    dump = "--dump" in sys.argv
+    for a in sys.argv[1:]:
+        if a != "--dump":
+            src = a
+    fs = check(src, dump=dump)
+    for f in fs:
+        print(f)
+    sys.exit(1 if fs else 0)
