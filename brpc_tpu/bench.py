@@ -642,6 +642,16 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
     except Exception:
         pass
 
+    # elastic-capacity drill (ISSUE 20): the fleet autoscaler resizing
+    # a dynpart swarm live under the replayed golden-capture ramp, with
+    # a mid-resize SIGKILL — zero failed RPCs, p99 under the ceiling,
+    # capacity tracking the offered load, or the lane reports 0
+    autoscale_lanes = {}
+    try:
+        autoscale_lanes = autoscale_drill_bench()
+    except Exception:
+        pass
+
     # connection-scale drill (ISSUE 14, ROADMAP item 5): 20k mostly-idle
     # keep-alive connections from client subprocesses, per-connection
     # bytes/fd/wakeup cost from the nat_res accounting, accept-storm
@@ -784,6 +794,7 @@ def framework_echo_bench(nconn: int = 4, fibers_per_conn: int = 64,
             **fanout_lanes,
             **swarm_lanes,
             **fleet_lanes,
+            **autoscale_lanes,
             **conn_lanes,
             **worker_lanes,
             **stream_lanes,
@@ -1389,6 +1400,219 @@ def fanout_swarm_bench(backends: int = 1000, servers: int = 3,
                 os.unlink(nf_path)
             except OSError:
                 pass
+    return out
+
+
+def autoscale_drill_bench(ramp_times: int = 4,
+                          qps_from: float = 150.0,
+                          qps_to: float = 1200.0,
+                          settle_s: float = 5.0,
+                          p99_ceiling_ms: float = 250.0,
+                          tracking_floor: float = 0.4) -> dict:
+    """The ISSUE-20 elastic-capacity drill: a dynpart cluster over a
+    live subprocess swarm, resized by the fleet autoscaler while the
+    committed golden capture replays through the native replay client
+    in RAMP mode (the offered-load curve) and a paced dynpart probe
+    exercises the resize path end to end. One member is SIGKILLed
+    mid-resize (never announced — the controller must notice the corpse
+    in the rollup and replace it; the dynpart capacity rule routes
+    around its half-dead scheme meanwhile).
+
+    The SLO contract IS the lane value: autoscale_qps reports the
+    replay's achieved qps only when the probe saw ZERO failed RPCs
+    across every grow/shrink/crash, probe p99 stayed under the ceiling,
+    the controller actually scaled both ways (>= 1 grow AND >= 1
+    shrink), and capacity tracked the offered load (pool size within
+    one member of the controller's desired size on >= tracking_floor of
+    post-warmup decisions). Any breach reports 0 qps so the bench gate
+    trips."""
+    import os
+    import tempfile
+    import threading as _threading
+
+    from brpc_tpu import native
+    from brpc_tpu.fleet.autoscaler import (Autoscaler, AutoscalerConfig,
+                                           SwarmPool)
+    from brpc_tpu.fleet.observatory import FleetObservatory
+    from brpc_tpu.fleet.slo import SloObjective
+    from brpc_tpu.rpc.native_cluster import NativeCluster
+
+    golden = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "data", "golden_capture_1k.rio")
+    if not os.path.exists(golden):
+        return {}
+
+    out: dict = {}
+    nf = tempfile.NamedTemporaryFile("w", suffix=".autoscale.ns",
+                                     delete=False)
+    nf_path = nf.name
+    nf.close()
+    cluster = None
+    obs = None
+    pool = None
+    stop = _threading.Event()
+    try:
+        cluster = NativeCluster(lb="_dynpart", connect_timeout_ms=1000,
+                                health_check_ms=200, breaker=True,
+                                name="autoscale")
+        obs = FleetObservatory(
+            naming_url=f"file://{nf_path}", interval_s=0.4,
+            objectives=[SloObjective(name="autoscale-p99",
+                                     kind="latency", lane="echo",
+                                     ceiling_ms=p99_ceiling_ms,
+                                     budget=0.05)],
+            name="autoscale", register_bvars=False)
+
+        def publish_cb():
+            # push the fresh list NOW (the file watchers' 2s poll is an
+            # eternity against a 0.5s control loop)
+            for w in (cluster._watcher, obs._cluster._watcher):
+                if w is not None:
+                    try:
+                        w.refresh()
+                    except Exception:
+                        pass
+
+        pool = SwarmPool(nf_path, base_port=26100, publish_cb=publish_cb)
+        if pool.grow(2) < 2:
+            raise RuntimeError("autoscale swarm port range unavailable")
+        cluster.watch(f"file://{nf_path}")
+        publish_cb()
+        obs.start()
+        anchor_port = pool.ports()[0]  # never retired above min=2
+
+        cfg = AutoscalerConfig(min_backends=2, max_backends=6,
+                               target_qps_per_backend=400.0,
+                               p99_ceiling_ms=p99_ceiling_ms,
+                               grow_step=2, shrink_step=2,
+                               cooldown_s=0.6)
+        scaler = Autoscaler(cfg, pool, obs)
+        ctrl = _threading.Thread(target=scaler.run, args=(0.5, stop),
+                                 daemon=True)
+        ctrl.start()
+
+        # the zero-failed probe: paced dynpart verbs through every
+        # resize, with the same bounded client retry the swarm churn
+        # lane rides (its selective verb retries in-verb; the fan verbs
+        # have no failover, so an unannounced corpse can be the SOLE
+        # seat of a one-group scheme for the 2-3 calls its transport
+        # cool-down takes — the retry re-picks, the rr cursor moves to
+        # a live member). fail_limit=0 = the verb fails only when EVERY
+        # seated sub fails; a call that exhausts its retries is a
+        # failed RPC and zeroes the lane.
+        probe_lat_us: list = []
+        probe_failed = [0]
+        probe_retries = [0]
+        probe_schemes: dict = {}
+
+        def probe():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                rc = -1
+                for attempt in range(3):
+                    rc, _body, _err, _nfail, scheme = \
+                        cluster.dynpart_call(
+                            "EchoService.Echo", b"autoscale-probe",
+                            timeout_ms=4000, fail_limit=0)
+                    if rc == 0:
+                        break
+                    probe_retries[0] += 1
+                if rc != 0:
+                    probe_failed[0] += 1
+                else:
+                    probe_lat_us.append(
+                        (time.monotonic() - t0) * 1e6)
+                    probe_schemes[scheme] = \
+                        probe_schemes.get(scheme, 0) + 1
+                time.sleep(0.02)
+
+        probe_t = _threading.Thread(target=probe, daemon=True)
+        probe_t.start()
+
+        # chaos arm: SIGKILL the newest member the moment the first
+        # grow lands (mid-resize by construction)
+        killed = [0]
+
+        def assassin():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not stop.is_set():
+                if scaler.grows >= 1 and pool.size() >= 3:
+                    if pool.kill_one() is not None:
+                        killed[0] += 1
+                    return
+                time.sleep(0.1)
+
+        kill_t = _threading.Thread(target=assassin, daemon=True)
+        kill_t.start()
+
+        # offered load: the golden capture ramped qps_from -> qps_to
+        # against the anchor member (PR-11 replay, ramp mode)
+        replay = native.replay_run("127.0.0.1", anchor_port, golden,
+                                   times=ramp_times, qps=qps_from,
+                                   qps_to=qps_to, concurrency=4,
+                                   timeout_ms=5000)
+        # load gone: the settle window is where the shrinks happen
+        deadline = time.monotonic() + settle_s
+        while time.monotonic() < deadline and \
+                not (scaler.shrinks >= 1 and pool.size() <= 3):
+            time.sleep(0.25)
+        kill_t.join(timeout=5)
+        stop.set()
+        ctrl.join(timeout=10)
+        probe_t.join(timeout=10)
+
+        # capacity-tracking score: post-warmup decisions where the pool
+        # sat within one member of the controller's own desired size
+        recs = [r for r in scaler.decisions if r["qps"] > 0]
+        tracked = sum(1 for r in recs
+                      if abs(r["size"] - r["desired"]) <= 1)
+        tracking = (tracked / len(recs)) if recs else 0.0
+
+        probe_lat_us.sort()
+        p99_us = (probe_lat_us[int(len(probe_lat_us) * 0.99)]
+                  if probe_lat_us else 0.0)
+        counters = native.stats_counters()
+        out.update({
+            "autoscale_replay_qps": round(replay["qps"], 1),
+            "autoscale_probe_calls": len(probe_lat_us),
+            "autoscale_probe_retries": probe_retries[0],
+            "autoscale_failed": probe_failed[0] + replay["failed"],
+            "autoscale_grows": scaler.grows,
+            "autoscale_shrinks": scaler.shrinks,
+            "autoscale_blocked": scaler.blocked,
+            "autoscale_kills": killed[0],
+            "autoscale_peak_size": max(r["size"] for r in
+                                       scaler.decisions),
+            "autoscale_tracking": round(tracking, 3),
+            "autoscale_schemes": {str(k): v for k, v
+                                  in sorted(probe_schemes.items())},
+            "autoscale_resizes": counters.get("nat_dynpart_resizes", 0),
+            "autoscale_p99_us": round(p99_us, 1),
+        })
+        contract_ok = (probe_failed[0] == 0 and replay["failed"] == 0
+                       and scaler.grows >= 1 and scaler.shrinks >= 1
+                       and killed[0] == 1
+                       and p99_us <= p99_ceiling_ms * 1000
+                       and tracking >= tracking_floor
+                       and len(probe_lat_us) > 50)
+        out["autoscale_qps"] = (round(replay["qps"], 1)
+                                if contract_ok else 0.0)
+    except Exception as e:  # a wedged drill must not kill the artifact
+        out["autoscale_error"] = repr(e)
+        out["autoscale_qps"] = 0.0
+    finally:
+        stop.set()
+        if obs is not None:
+            obs.close()
+        if cluster is not None:
+            cluster.close()
+        if pool is not None:
+            pool.close()
+        try:
+            os.unlink(nf_path)
+        except OSError:
+            pass
     return out
 
 

@@ -150,6 +150,17 @@ def tpu_trace(seconds: float = 1.0):
     seconds = max(0.1, min(30.0, seconds))
     import tempfile
 
+    if (os.cpu_count() or 1) < 2 and not os.environ.get(
+            "BRPC_TPU_FORCE_TPU_TRACE"):
+        # Trace collection is not bounded by `seconds`: profiler start/stop
+        # does several seconds of native work that monopolises the only
+        # core, starving every other handler on the server (observed as
+        # cascading 60s timeouts on 1-cpu CI). Explain instead of hanging;
+        # BRPC_TPU_FORCE_TPU_TRACE=1 overrides when the stall is acceptable.
+        return ("text/plain",
+                "profiler trace skipped: single-cpu host (trace collection "
+                "would starve the server; set BRPC_TPU_FORCE_TPU_TRACE=1 "
+                "to force)\n")
     try:
         import jax
     except Exception as e:  # pragma: no cover - jax is baked in

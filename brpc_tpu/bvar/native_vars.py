@@ -603,6 +603,24 @@ def native_status_lines(snap: Optional[Dict[str, int]] = None) -> List[str]:
     return lines
 
 
+def settle_for_tests():
+    """Drop the 0.25s-TTL snapshot caches (counters, method/conn/res rows,
+    cluster rows) so the NEXT exposition dump reads live native state.
+
+    Tests that open connections or clusters and immediately assert on
+    /vars or /brpc_metrics rows race the TTL: an exposition rendered
+    within 0.25s of an earlier test's dump replays that test's snapshot,
+    which predates the rows being asserted.  Settling here — instead of
+    widening the TTL — keeps the production cache behaviour untouched."""
+    with _lock:
+        _snap_cache.clear()
+        _method_cache.clear()
+        _conn_cache.clear()
+        _res_cache.clear()
+    _cluster_rows_cache["ts"] = 0.0
+    _cluster_rows_cache["rows"] = []
+
+
 def reset_for_tests():
     """Drop registration state (the exposed vars stay hidden-on-GC) and
     zero the native cells."""

@@ -9,8 +9,18 @@ histograms, and an SLO burn-rate engine for the swarm (ISSUE 16).
   NativeCluster over the naming feeds, scrapes every member's
   ``builtin.stats`` endpoint, merges, drives /fleet + fleet_* rows,
   fans find_trace across the swarm.
+- :mod:`brpc_tpu.fleet.autoscaler` — the elastic-capacity controller
+  (ISSUE 20): consumes the observatory rollups and resizes a subprocess
+  swarm live under the SLO contract (grow on band/p99 breach, graceful
+  quiesce on shrink, shrink vetoed while the budget burns).
 """
 from brpc_tpu.fleet import hist
+from brpc_tpu.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    SwarmPool,
+    swarm_tags,
+)
 from brpc_tpu.fleet.observatory import (
     FLEET_VAR_NAMES,
     FleetObservatory,
@@ -22,11 +32,15 @@ from brpc_tpu.fleet.slo import SloEngine, SloObjective
 
 __all__ = [
     "FLEET_VAR_NAMES",
+    "Autoscaler",
+    "AutoscalerConfig",
     "FleetObservatory",
     "SloEngine",
     "SloObjective",
+    "SwarmPool",
     "active_observatories",
     "hist",
     "register_fleet_bvars",
     "render_fleet_page",
+    "swarm_tags",
 ]

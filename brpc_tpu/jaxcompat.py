@@ -1,0 +1,50 @@
+"""Version-drift shims for the jax surface the repo leans on.
+
+jax moved ``shard_map`` twice in the window we support: it lives at
+``jax.experimental.shard_map.shard_map`` on older releases (0.4.x, with a
+``check_rep`` kwarg), graduated to ``jax.shard_map`` later, and the
+replication-check kwarg was renamed ``check_rep`` -> ``check_vma`` along
+the way.  Every in-repo caller goes through :func:`shard_map` below so the
+probe happens in exactly one place instead of a try/except at each site.
+"""
+from __future__ import annotations
+
+import jax
+
+try:  # newer jax: top-level export
+    _shard_map_impl = jax.shard_map
+except AttributeError:  # 0.4.x: experimental namespace only
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+# The replication-check kwarg name drifted: probe once, lazily, and pin it.
+_CHECK_KW: list = [None]  # [None]=unprobed, ["check_vma"/"check_rep"/""]=pinned
+
+
+def shard_map(f, mesh, in_specs, out_specs, check=None):
+    """``jax.shard_map`` across the supported jax versions.
+
+    ``check`` maps onto whichever of ``check_vma``/``check_rep`` this jax
+    accepts (``None`` leaves the library default in place).
+    """
+    if check is None:
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )
+    if _CHECK_KW[0] is None:
+        for kw in ("check_vma", "check_rep"):
+            try:
+                out = _shard_map_impl(
+                    f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    **{kw: check}
+                )
+                _CHECK_KW[0] = kw
+                return out
+            except TypeError:
+                continue
+        _CHECK_KW[0] = ""  # neither kwarg: drop the flag entirely
+    if _CHECK_KW[0]:
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            **{_CHECK_KW[0]: check}
+        )
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)

@@ -482,6 +482,9 @@ def load() -> ctypes.CDLL:
         lib.nat_stats_counters.argtypes = [
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
         lib.nat_stats_counters.restype = ctypes.c_int
+        lib.nat_stats_counter_bump.argtypes = [ctypes.c_char_p,
+                                               ctypes.c_uint64]
+        lib.nat_stats_counter_bump.restype = ctypes.c_int
         lib.nat_stats_lane_count.restype = ctypes.c_int
         lib.nat_stats_lane_name.argtypes = [ctypes.c_int]
         lib.nat_stats_lane_name.restype = ctypes.c_char_p
@@ -620,6 +623,19 @@ def load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_size_t),
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int)]
         lib.nat_cluster_partition_call.restype = ctypes.c_int
+        lib.nat_cluster_dynpart_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.nat_cluster_dynpart_call.restype = ctypes.c_int
+        lib.nat_cluster_dynpart_debug.argtypes = [
+            ctypes.c_void_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.nat_cluster_dynpart_debug.restype = ctypes.c_int
         lib.nat_cluster_stats.argtypes = [ctypes.c_void_p,
                                           ctypes.POINTER(NatClusterRow),
                                           ctypes.c_int]
@@ -1421,6 +1437,14 @@ def stats_counters() -> dict:
             for i in range(got)}
 
 
+def stats_counter_bump(name: str, delta: int = 1) -> int:
+    """Bump a native counter by NAME from Python-side controllers (the
+    fleet autoscaler charges nat_autoscale_* here so its decisions land
+    in the same /vars + /brpc_metrics surface as native events).
+    Returns the counter id, or -1 for an unknown name."""
+    return load().nat_stats_counter_bump(name.encode(), delta)
+
+
 def stats_lane_names() -> list:
     """Latency-histogram lane names (echo/http/redis/grpc/client)."""
     lib = load()
@@ -2046,6 +2070,54 @@ def cluster_partition_call(handle, service: str, method: str,
                         (partitions, fail_limit))
 
 
+def cluster_dynpart_call(handle, service: str, method: str,
+                         payload: bytes = b"", timeout_ms: int = 0,
+                         fail_limit: int = 0):
+    """DynamicPartitionChannel verb: the partition count is picked PER
+    CALL from the live "i/n" schemes, weighted by usable capacity
+    (_dynpart LB), then fanned one sub-call per group. A resize is never
+    caller-visible — in-flight fans complete against their pinned server
+    list version. Returns (error_code, merged_bytes, error_text,
+    failed_subcalls, chosen_scheme)."""
+    lib = load()
+    resp = ctypes.c_char_p()
+    rlen = ctypes.c_size_t(0)
+    err = ctypes.c_char_p()
+    failed = ctypes.c_int(0)
+    scheme = ctypes.c_int(0)
+    rc = lib.nat_cluster_dynpart_call(
+        handle, service.encode(), method.encode(), payload, len(payload),
+        timeout_ms, fail_limit, ctypes.byref(resp), ctypes.byref(rlen),
+        ctypes.byref(err), ctypes.byref(failed), ctypes.byref(scheme))
+    body = b""
+    if resp:
+        body = ctypes.string_at(resp, rlen.value)
+        lib.nat_buf_free(resp)
+    text = ""
+    if err:
+        text = ctypes.string_at(err).decode(errors="replace")
+        lib.nat_buf_free(err)
+    return rc, body, text, failed.value, scheme.value
+
+
+def cluster_dynpart_debug(handle, x01: float = 0.0,
+                          max_schemes: int = 64) -> dict:
+    """Equivalence probe for the dynpart pick: the live scheme table
+    (ascending part_total with usable capacities) plus the scheme the
+    weighted walk chooses for the caller-supplied point x01 in [0,1) —
+    so a Python DynPartLB walk can be replayed against identical inputs.
+    Returns {'schemes': [(part_total, capacity), ...], 'chosen': int}."""
+    totals = (ctypes.c_int * max_schemes)()
+    caps = (ctypes.c_int * max_schemes)()
+    chosen = ctypes.c_int(0)
+    n = load().nat_cluster_dynpart_debug(handle, x01, totals, caps,
+                                         max_schemes,
+                                         ctypes.byref(chosen))
+    n = min(n, max_schemes)
+    return {"schemes": [(totals[i], caps[i]) for i in range(n)],
+            "chosen": chosen.value}
+
+
 def cluster_stats(handle, max_rows: int = 4096) -> list:
     """Per-backend rows: [{'endpoint', 'tag', 'weight', 'selects',
     'errors', 'inflight', 'ema_latency_us', 'breaker_open', 'lame_duck',
@@ -2076,7 +2148,8 @@ def cluster_bench(handle, mode: int = 0, service: str = "EchoService",
                   timeout_ms: int = 2000, param: int = 2,
                   seconds: float = 2.0, concurrency: int = 4) -> dict:
     """Drive the cluster from C threads: mode 0 = selective (param =
-    max_retry), 1 = parallel (param = fail_limit). ctypes releases the
+    max_retry), 1 = parallel (param = fail_limit), 2 = dynpart (param =
+    fail_limit; the autoscale drill's flood). ctypes releases the
     GIL for the whole run, so churn orchestration (SIGTERMs, naming
     updates) can ride a Python thread beside it. Returns {'qps',
     'calls', 'failed', 'p99_us'}."""

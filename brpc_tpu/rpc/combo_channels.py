@@ -359,10 +359,19 @@ class PartitionChannel(ParallelChannel):
 
 class DynamicPartitionChannel:
     """Multiple partitioning schemes co-existing; scheme chosen per call,
-    weighted by its server capacity (partition_channel.h:136-142)."""
+    weighted by its server capacity (partition_channel.h:136-142).
 
-    def __init__(self, fail_limit: int = -1):
+    native=True rides nat_cluster_dynpart_call: ONE C++ cluster holds
+    every "i/n"-tagged backend, the scheme pick (_dynpart, capacity-
+    weighted) and the per-group fan happen under one zero-lock server-
+    list pin, and a resize (naming update changing the scheme layout)
+    publishes a new list version while in-flight calls finish against
+    their pinned one — never caller-visible."""
+
+    def __init__(self, fail_limit: int = -1, native: bool = False):
         self.fail_limit = fail_limit
+        self.native = native
+        self._cluster = None
         self._schemes: Dict[int, PartitionChannel] = {}
         self._lock = threading.Lock()
         self._url = ""
@@ -376,11 +385,22 @@ class DynamicPartitionChannel:
              schemes: Optional[List[int]] = None) -> int:
         """schemes: partition counts to serve (discovered from tags when
         omitted requires a first resolution; explicit list keeps it simple
-        and deterministic)."""
+        and deterministic). The native path ignores `schemes` — the C++
+        cluster derives the live scheme set from the tags on every naming
+        refresh, which is what makes the partition count truly dynamic."""
         self._url = naming_url
         self._lb_name = lb_name
         self._parser = parser or PartitionParser()
         self._options = options
+        if self.native:
+            # the C++ core groups backends by the default "i/n" tag
+            # grammar; a custom parser needs the Python path
+            if parser is not None and type(parser) is not PartitionParser:
+                raise ValueError("native DynamicPartitionChannel supports "
+                                 "the default 'i/n' tag grammar only")
+            self._cluster = _native_cluster_init(naming_url, "_dynpart",
+                                                 options, name="dynpart")
+            return 0
         if not schemes:
             from brpc_tpu.rpc.naming_service import start_naming_service  # noqa: F401
             from brpc_tpu.rpc.naming_service import _ns_registry
@@ -426,8 +446,30 @@ class DynamicPartitionChannel:
         total = self._dynlb.select_server()
         return self._schemes.get(total) if total is not None else None
 
+    def _call_method_native(self, method: str, cntl: Controller, request,
+                            response, done: Optional[Callable]):
+        import time as _t
+
+        payload = request.SerializeToString() if request is not None else b""
+        timeout_ms = int(cntl.timeout_ms or 1000)
+        fail_limit = self.fail_limit if self.fail_limit > 0 else 0
+        start_time = _t.monotonic()
+
+        def run():
+            rc, body, err, _failed, scheme = self._cluster.dynpart_call(
+                method, payload, timeout_ms=timeout_ms,
+                fail_limit=fail_limit)
+            cntl.partition_count = scheme
+            _native_finish(cntl, response, rc, body, err, start_time,
+                           done)
+
+        _native_run(cntl, done, run)
+
     def call_method(self, method: str, cntl: Controller, request, response,
                     done: Optional[Callable] = None):
+        if self._cluster is not None:
+            self._call_method_native(method, cntl, request, response, done)
+            return
         pc = self._pick_scheme()
         if pc is None:
             cntl.set_failed(errors.EFAILEDSOCKET, "no usable partition scheme")
@@ -446,6 +488,8 @@ class DynamicPartitionChannel:
         return cntl, response
 
     def stop(self):
+        if self._cluster is not None:
+            self._cluster.close()
         for pc in self._schemes.values():
             pc.stop()
 

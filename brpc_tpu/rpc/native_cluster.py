@@ -192,6 +192,32 @@ class NativeCluster:
         finally:
             self._exit()
 
+    def dynpart_call(self, service_method: str, payload: bytes = b"",
+                     timeout_ms: int = 1000, fail_limit: int = 0
+                     ) -> Tuple[int, bytes, str, int, int]:
+        """DynamicPartitionChannel verb: scheme picked per call from the
+        live "i/n" totals, capacity-weighted (_dynpart); returns
+        (rc, merged, err, failed_subcalls, chosen_scheme)."""
+        if not self._enter():
+            return self._CLOSED + (0, 0)
+        try:
+            service, _, method = service_method.rpartition(".")
+            return native.cluster_dynpart_call(self._h, service, method,
+                                               payload, timeout_ms,
+                                               fail_limit)
+        finally:
+            self._exit()
+
+    def dynpart_debug(self, x01: float = 0.0) -> dict:
+        """Live dynpart scheme table + the pick for point x01 (the
+        native-vs-Python equivalence probe)."""
+        if not self._enter():
+            return {"schemes": [], "chosen": 0}
+        try:
+            return native.cluster_dynpart_debug(self._h, x01)
+        finally:
+            self._exit()
+
     def bench(self, mode: int = 0, seconds: float = 2.0,
               concurrency: int = 4, payload: bytes = b"x" * 16,
               timeout_ms: int = 2000, param: int = 2,

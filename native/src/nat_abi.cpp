@@ -356,6 +356,7 @@ int main() {
       NAT_SYM(nat_stats_now_ns),
       NAT_SYM(nat_stats_counter_name),
       NAT_SYM(nat_stats_counters),
+      NAT_SYM(nat_stats_counter_bump),
       NAT_SYM(nat_stats_lane_count),
       NAT_SYM(nat_stats_lane_name),
       NAT_SYM(nat_stats_hist_nbuckets),
@@ -398,6 +399,8 @@ int main() {
       NAT_SYM(nat_cluster_call),
       NAT_SYM(nat_cluster_parallel_call),
       NAT_SYM(nat_cluster_partition_call),
+      NAT_SYM(nat_cluster_dynpart_call),
+      NAT_SYM(nat_cluster_dynpart_debug),
       NAT_SYM(nat_cluster_stats),
       NAT_SYM(nat_cluster_bench),
       NAT_SYM(nat_res_count),

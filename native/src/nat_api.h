@@ -162,9 +162,29 @@ int nat_cluster_partition_call(void* h, const char* service,
                                int partitions, int fail_limit,
                                char** resp_out, size_t* resp_len,
                                char** err_text_out, int* failed_out);
+// DynamicPartitionChannel verb: the partition count is picked PER CALL
+// from the live version's "i/n" totals, weighted by usable capacity
+// (_dynpart LB), then fanned one sub-call per group like
+// partition_call. A resize (naming update changing the scheme layout)
+// is never caller-visible: in-flight fans complete against their
+// pinned version. scheme_out reports the chosen part_total.
+int nat_cluster_dynpart_call(void* h, const char* service,
+                             const char* method, const char* payload,
+                             size_t payload_len, int timeout_ms,
+                             int fail_limit, char** resp_out,
+                             size_t* resp_len, char** err_text_out,
+                             int* failed_out, int* scheme_out);
+// Dynpart equivalence probe: dump the live scheme table (ascending
+// part_total + usable capacity, up to max_schemes rows) and the scheme
+// the weighted walk picks for the caller-supplied point x01 in [0,1).
+// Returns the scheme count.
+int nat_cluster_dynpart_debug(void* h, double x01, int* totals_out,
+                              int* caps_out, int max_schemes,
+                              int* chosen_out);
 int nat_cluster_stats(void* h, brpc_tpu::NatClusterRow* out, int max);
 // Fan-out bench loop: mode 0 = selective (param = max_retry), 1 =
-// parallel (param = fail_limit); `concurrency` pthreads for `seconds`.
+// parallel (param = fail_limit), 2 = dynpart (param = fail_limit);
+// `concurrency` pthreads for `seconds`.
 // Returns verb qps; out_p99_us = verb-latency p99.
 double nat_cluster_bench(void* h, int mode, const char* service,
                          const char* method, const char* payload,
@@ -314,6 +334,11 @@ int nat_stats_counter_count(void);
 uint64_t nat_stats_now_ns(void);
 const char* nat_stats_counter_name(int id);
 int nat_stats_counters(uint64_t* out, int max);
+// Bump a native counter by NAME (Python-side controllers — the fleet
+// autoscaler charges nat_autoscale_* here so its decisions land in the
+// same /vars + /brpc_metrics surface as native events). Returns the
+// counter id, or -1 for an unknown name.
+int nat_stats_counter_bump(const char* name, uint64_t delta);
 int nat_stats_lane_count(void);
 const char* nat_stats_lane_name(int lane);
 int nat_stats_hist_nbuckets(void);

@@ -231,10 +231,31 @@ static void parse_partition_tag(NatLbBackend* b) {
   }
 }
 
+// True when two versions carry a different partition-scheme layout:
+// a scheme appeared/vanished or any group's membership count changed.
+// This is the dynpart-visible shape — a weight-only refresh publishes
+// a new version without being a resize.
+static bool parts_layout_differs(const ServerListVer* a,
+                                 const ServerListVer* b) {
+  if (a->parts.size() != b->parts.size()) return true;
+  auto ia = a->parts.begin();
+  auto ib = b->parts.begin();
+  for (; ia != a->parts.end(); ++ia, ++ib) {
+    if (ia->first != ib->first) return true;
+    if (ia->second.size() != ib->second.size()) return true;
+    for (size_t g = 0; g < ia->second.size(); g++) {
+      if (ia->second[g].size() != ib->second[g].size()) return true;
+    }
+  }
+  return false;
+}
+
 // Swap in a freshly-built version over the CURRENT member set. Caller
 // holds c->mu (updates are serialized — the gate's parity quiesce is
 // single-writer). Old version's backend references retire after the
-// readers drain.
+// readers drain — an in-flight dynpart/partition fan keeps its pinned
+// version's backends alive through clus.call references, so a resize
+// published here is never visible to a call already issued.
 static void cluster_publish_locked(NatCluster* c) {
   std::vector<NatLbBackend*> mem;
   mem.reserve(c->members.size());
@@ -245,6 +266,9 @@ static void cluster_publish_locked(NatCluster* c) {
     NAT_REF_ACQUIRE(b, clus.ver);
   }
   ServerListVer* old = c->cur.exchange(nv, std::memory_order_seq_cst);
+  if (old != nullptr && parts_layout_differs(old, nv)) {
+    nat_counter_add(NS_DYNPART_RESIZES, 1);
+  }
   c->gate.quiesce();  // every reader of `old` has exited
   if (old != nullptr) {
     for (NatLbBackend* b : old->backends) {
@@ -942,6 +966,129 @@ int nat_cluster_partition_call(void* h, const char* service,
                  err_text_out, failed_out);
 }
 
+// DynamicPartitionChannel verb (combo_channels.DynamicPartitionChannel
+// natively): the partition count is not fixed — every call picks a
+// scheme from the live version's "i/n" totals, weighted by capacity
+// (_dynpart, SURVEY §2.6), then fans one sub-call per group exactly
+// like partition_call. The scheme pick and the seat walk happen under
+// ONE gate pin, so a resize published mid-call is invisible: the fan
+// completes against its pinned version while new calls land on the new
+// scheme mix. scheme_out reports the chosen part_total (observability +
+// the equivalence probe).
+int nat_cluster_dynpart_call(void* h, const char* service,
+                             const char* method, const char* payload,
+                             size_t payload_len, int timeout_ms,
+                             int fail_limit, char** resp_out,
+                             size_t* resp_len, char** err_text_out,
+                             int* failed_out, int* scheme_out) {
+  NatCluster* c = cluster_pin(h);
+  if (resp_out != nullptr) {
+    *resp_out = nullptr;
+    *resp_len = 0;
+  }
+  if (err_text_out != nullptr) *err_text_out = nullptr;
+  if (failed_out != nullptr) *failed_out = 0;
+  if (scheme_out != nullptr) *scheme_out = 0;
+  if (c == nullptr) return kEFAILEDSOCKET;
+  FanCtx ctx;
+  ctx.service = service;
+  ctx.method = method;
+  ctx.payload = payload;
+  ctx.payload_len = payload_len;
+  ctx.timeout_ms = timeout_ms;
+  ctx.parent = nat_begin_call_trace();
+  int total = 0;
+  {
+    int tok = c->gate.enter();
+    const ServerListVer* v = c->cur.load(std::memory_order_seq_cst);
+    total = nat_lb_dynpart_pick(v, nat_lb_rand01());
+    if (total > 0) {
+      auto it = v->parts.find(total);
+      // pick() only returns totals present in v->parts with nonzero
+      // capacity, so the find always lands — guard anyway (a capacity-0
+      // fallback arm in pick would otherwise seat an empty fan)
+      if (it == v->parts.end()) {
+        total = 0;
+      } else {
+        const std::vector<std::vector<uint32_t>>& groups = it->second;
+        ctx.subs.resize((size_t)total);
+        for (int p = 0; p < total; p++) {
+          ctx.subs[p].ctx = &ctx;
+          const std::vector<uint32_t>* g =
+              p < (int)groups.size() ? &groups[p] : nullptr;
+          if (g != nullptr && !g->empty()) {
+            uint64_t cur =
+                c->cursor.fetch_add(1, std::memory_order_relaxed);
+            for (size_t step = 0; step < g->size(); step++) {
+              NatLbBackend* b =
+                  v->backends[(*g)[(cur + step) % g->size()]];
+              if (nat_lb_backend_usable(b)) {
+                ctx.subs[p].b = b;
+                NAT_REF_ACQUIRE(b, clus.call);
+                break;
+              }
+            }
+          }
+          if (ctx.subs[p].b == nullptr) {
+            ctx.subs[p].err = kEFAILEDSOCKET;
+            ctx.subs[p].err_text = "no backend for partition";
+          }
+        }
+      }
+    }
+    c->gate.exit(tok);
+  }
+  if (scheme_out != nullptr) *scheme_out = total;
+  if (total == 0) {
+    NAT_REF_RELEASE(c, clus.verb);
+    if (err_text_out != nullptr) {
+      const char* msg = "no partition scheme with capacity";
+      // natcheck:allow(resacct): FFI error text, freed by the caller
+      *err_text_out = (char*)malloc(strlen(msg) + 1);
+      memcpy(*err_text_out, msg, strlen(msg) + 1);
+    }
+    // natcheck:allow(refown-leak-path): total == 0 means the seat walk
+    // above never ran, so no clus.call reference is held on this arm
+    return kETOOMANYFAILS;
+  }
+  // natcheck:allow(refown-leak-path): every seated dynpart sub's
+  // clus.call is released by fan_run (fan_account_and_finish)
+  return fan_run(c, &ctx, "dynpart", fail_limit, resp_out, resp_len,
+                 err_text_out, failed_out);
+}
+
+// Equivalence probe for the dynpart pick (tests + /status debugging):
+// dumps the live version's scheme table — ascending part_total order
+// with each scheme's capacity — and the scheme the weighted walk picks
+// for a CALLER-SUPPLIED point x01, so the Python DynPartLB walk can be
+// replayed against the identical inputs. Returns the scheme count (may
+// exceed max_schemes; only max_schemes rows are written).
+int nat_cluster_dynpart_debug(void* h, double x01, int* totals_out,
+                              int* caps_out, int max_schemes,
+                              int* chosen_out) {
+  NatCluster* c = cluster_pin(h);
+  if (chosen_out != nullptr) *chosen_out = 0;
+  if (c == nullptr) return 0;
+  int n = 0;
+  {
+    int tok = c->gate.enter();
+    const ServerListVer* v = c->cur.load(std::memory_order_seq_cst);
+    for (const auto& kv : v->parts) {
+      if (n < max_schemes) {
+        if (totals_out != nullptr) totals_out[n] = kv.first;
+        if (caps_out != nullptr) {
+          caps_out[n] = nat_lb_dynpart_capacity(v, kv.first);
+        }
+      }
+      n++;
+    }
+    if (chosen_out != nullptr) *chosen_out = nat_lb_dynpart_pick(v, x01);
+    c->gate.exit(tok);
+  }
+  NAT_REF_RELEASE(c, clus.verb);
+  return n;
+}
+
 // Per-backend observability rows (the /status cluster table and the
 // nat_cluster_* Prometheus rows ride this).
 int nat_cluster_stats(void* h, NatClusterRow* out, int max) {
@@ -978,8 +1125,9 @@ int nat_cluster_stats(void* h, NatClusterRow* out, int max) {
 }
 
 // Fan-out bench loop (bench.py fanout lanes + the swarm churn drill):
-// `concurrency` pthreads drive mode 0 (selective; param = max_retry) or
-// mode 1 (parallel; param = fail_limit) calls for `seconds`. Returns
+// `concurrency` pthreads drive mode 0 (selective; param = max_retry),
+// mode 1 (parallel; param = fail_limit), or mode 2 (dynpart; param =
+// fail_limit — the autoscale drill's flood) calls for `seconds`. Returns
 // qps; out_calls/out_failed count completed verbs; out_p99_us reports
 // the verb-latency p99 from merged log2 histograms.
 double nat_cluster_bench(void* h, int mode, const char* service,
@@ -1010,7 +1158,14 @@ double nat_cluster_bench(void* h, int mode, const char* service,
         char* err = nullptr;
         uint64_t t0 = nat_now_ns();
         int rc;
-        if (mode == 1) {
+        if (mode == 2) {
+          int nfail = 0;
+          int scheme = 0;
+          rc = nat_cluster_dynpart_call(h, service, method, payload,
+                                        payload_len, timeout_ms, param,
+                                        &resp, &rlen, &err, &nfail,
+                                        &scheme);
+        } else if (mode == 1) {
           int nfail = 0;
           rc = nat_cluster_parallel_call(h, service, method, payload,
                                          payload_len, timeout_ms, param,

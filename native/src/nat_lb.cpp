@@ -17,6 +17,7 @@ int nat_lb_policy_parse(const char* name) {
   if (strcmp(name, "random") == 0) return NAT_LB_RANDOM;
   if (strcmp(name, "wr") == 0) return NAT_LB_WR;
   if (strcmp(name, "la") == 0) return NAT_LB_LA;
+  if (strcmp(name, "_dynpart") == 0) return NAT_LB_DYNPART;
   // both reference hash registrations map onto the one native ring
   if (strcmp(name, "c_hash") == 0 || strcmp(name, "c_murmurhash") == 0 ||
       strcmp(name, "c_md5") == 0) {
@@ -216,6 +217,55 @@ static inline uint64_t lb_rand() {
   x ^= x << 17;
   tls_lb_rand = x;
   return x;
+}
+
+double nat_lb_rand01() {
+  return (double)(lb_rand() >> 11) / (double)(1ull << 53);
+}
+
+int nat_lb_dynpart_capacity(const ServerListVer* v, int part_total) {
+  auto it = v->parts.find(part_total);
+  if (it == v->parts.end()) return 0;
+  const std::vector<std::vector<uint32_t>>& groups = it->second;
+  if ((int)groups.size() < part_total) return 0;
+  int cap = 0;
+  for (int p = 0; p < part_total; p++) {
+    int live = 0;
+    for (uint32_t idx : groups[(size_t)p]) {
+      if (nat_lb_backend_usable(v->backends[idx])) live++;
+    }
+    if (live == 0) return 0;  // incomplete scheme: unusable as a whole
+    cap += live;
+  }
+  return cap;
+}
+
+int nat_lb_dynpart_pick(const ServerListVer* v, double x01) {
+  // DynPartLB.select_server natively: capacities sampled ONCE into a
+  // local walk (a concurrent membership/usability change cannot skew
+  // the pick), weighted random over the ascending-total scheme order —
+  // the same order the Python channel registers its schemes in.
+  int totals[64];
+  int caps[64];
+  int n = 0;
+  long long sum = 0;
+  for (const auto& kv : v->parts) {
+    if (n >= 64) break;
+    int cap = nat_lb_dynpart_capacity(v, kv.first);
+    if (cap <= 0) continue;
+    totals[n] = kv.first;
+    caps[n] = cap;
+    sum += cap;
+    n++;
+  }
+  if (n == 0) return 0;
+  double x = x01 * (double)sum;
+  double acc = 0.0;
+  for (int i = 0; i < n; i++) {
+    acc += (double)caps[i];
+    if (x <= acc) return totals[i];
+  }
+  return totals[n - 1];
 }
 
 static inline bool lb_excluded(const NatLbBackend* b,

@@ -42,6 +42,9 @@ enum NatLbPolicy : int {
   NAT_LB_CHASH,       // consistent hashing with bounded remap (ketama)
   NAT_LB_LA,          // locality-aware: 1 / (ema_latency * (inflight+1))
   NAT_LB_WR,          // weighted random
+  NAT_LB_DYNPART,     // _dynpart: partition scheme picked per call,
+                      // weighted by live capacity (SURVEY §2.6); backend
+                      // selection inside a scheme falls back to rr
 };
 int nat_lb_policy_parse(const char* name);
 
@@ -170,5 +173,22 @@ int nat_lb_select(const ServerListVer* v, int policy,
 // Deterministic 64-bit point hash shared by the ring builder and the
 // remap property test (FNV-1a over the endpoint, mixed per replica).
 uint64_t nat_lb_chash_point(const char* endpoint, uint32_t replica);
+
+// _dynpart scheme capacity: usable-backend count of the part_total
+// scheme, or 0 when ANY of its partition groups has no usable member —
+// a half-dead scheme must lose to a complete one during a resize, or
+// the pick itself manufactures failed sub-calls.
+int nat_lb_dynpart_capacity(const ServerListVer* v, int part_total);
+
+// _dynpart scheme pick (DynPartLB.select_server natively): schemes walk
+// in ascending part_total order, weighted random by capacity with the
+// point x01 in [0,1) supplied by the caller — production passes
+// nat_lb_rand01(), the equivalence probe passes a fixed point so the
+// Python DynPartLB walk lands on the same scheme. Returns the chosen
+// part_total, or 0 when no scheme has capacity.
+int nat_lb_dynpart_pick(const ServerListVer* v, double x01);
+
+// Uniform [0,1) from the per-thread LB xorshift stream.
+double nat_lb_rand01();
 
 }  // namespace brpc_tpu

@@ -6,6 +6,7 @@
 #include "nat_stats.h"
 
 #include <stdio.h>
+#include <string.h>
 #include <stdlib.h>
 
 #include <mutex>
@@ -127,6 +128,10 @@ static const char* kCounterNames[NS_COUNTER_COUNT] = {
     "nat_fabric_recover_drops",
     "nat_bulk_fill_frames",
     "nat_stats_snapshots",
+    "nat_dynpart_resizes",
+    "nat_autoscale_grows",
+    "nat_autoscale_shrinks",
+    "nat_autoscale_blocked",
 };
 
 static const char* kLaneNames[NL_LANE_COUNT] = {
@@ -406,6 +411,22 @@ uint64_t nat_stats_now_ns() { return nat_now_ns(); }
 const char* nat_stats_counter_name(int id) {
   if (id < 0 || id >= NS_COUNTER_COUNT) return "";
   return kCounterNames[id];
+}
+
+// By-name counter bump for embedder-side events that belong in the ONE
+// native counter surface (the autoscaler's grow/shrink/blocked actions:
+// a Python controller, but its counters must ride /vars, /brpc_metrics
+// and the fleet scrape like every native counter). Returns the counter
+// id, or -1 for an unknown name.
+int nat_stats_counter_bump(const char* name, uint64_t delta) {
+  if (name == nullptr) return -1;
+  for (int i = 0; i < NS_COUNTER_COUNT; i++) {
+    if (strcmp(kCounterNames[i], name) == 0) {
+      nat_counter_add(i, delta);
+      return i;
+    }
+  }
+  return -1;
 }
 
 // Combined snapshot of every counter (gauges computed in place). Returns

@@ -95,6 +95,13 @@ enum NatCounterId : int {
   NS_STATS_SNAPSHOTS,       // builtin.stats snapshots built (the fleet
                             // scrape counter — a collector at 1Hz shows
                             // here, so overhead questions are answerable)
+  NS_DYNPART_RESIZES,       // server-list publishes that changed the
+                            // partition-scheme layout (dynpart resize)
+  NS_AUTOSCALE_GROWS,       // autoscaler grow actions applied (bumped
+                            // from the fleet controller via counter_bump)
+  NS_AUTOSCALE_SHRINKS,     // autoscaler shrink actions applied
+  NS_AUTOSCALE_BLOCKED,     // autoscaler actions withheld (SLO burning,
+                            // min/max bound, members still draining)
   NS_COUNTER_COUNT,
 };
 

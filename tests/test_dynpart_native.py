@@ -1,0 +1,302 @@
+"""Native DynamicPartitionChannel (ISSUE 20): the `_dynpart` scheme
+pick ported into nat_lb/nat_cluster.
+
+Covers the elastic-capacity contracts: native-vs-Python equivalence
+(same list + capacity -> same partition count and group assignment),
+the whole-scheme capacity rule (one empty group zeroes the scheme),
+resize publication as a new server-list version (nat_dynpart_resizes
+bumps on layout change, NOT on a weight-only refresh), the
+DynamicPartitionChannel(native=True) fast path, and the slow
+resize-under-fault matrix (grow/shrink x SIGKILL/write:err storms,
+zero failed RPCs once the bounded retry settles) that the chaos lane's
+`resize` round replays with destructive seeds armed in the members."""
+import os
+import threading
+import time
+
+import pytest
+
+from brpc_tpu import rpc  # noqa: F401 (protocol registry init)
+from brpc_tpu.rpc.proto import echo_pb2
+
+native = pytest.importorskip("brpc_tpu.native")
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+from brpc_tpu.rpc.native_cluster import NativeCluster  # noqa: E402
+
+
+@pytest.fixture()
+def swarm_server():
+    """One native echo server on 8 ports (the multi-port swarm seam)."""
+    port = native.rpc_server_start(native_echo=True)
+    ports = [port]
+    for _ in range(7):
+        ports.append(native.rpc_server_add_port())
+    yield ports
+    native.rpc_server_stop()
+
+
+def _tagged(ports, tags):
+    return [(f"127.0.0.1:{p}", 1, t) for p, t in zip(ports, tags)]
+
+
+# ---------------------------------------------------------------------------
+# the verb + the pick
+# ---------------------------------------------------------------------------
+
+def test_dynpart_call_fans_the_chosen_scheme(swarm_server):
+    """Scheme picked per call from the live totals; the fan covers every
+    group of the chosen scheme exactly once (echo merge = one response
+    per group), capacity-weighted so both schemes serve traffic."""
+    with NativeCluster(lb="_dynpart") as c:
+        c.update(_tagged(swarm_server[:3], ["0/1", "0/2", "1/2"]))
+        seen = set()
+        for i in range(30):
+            rc, body, err, failed, scheme = c.dynpart_call(
+                "EchoService.Echo", b"D", timeout_ms=3000)
+            assert rc == 0, err
+            assert failed == 0
+            assert scheme in (1, 2)
+            assert body == b"D" * scheme  # one sub-response per group
+            seen.add(scheme)
+        # capacity 1 vs 2: over 30 weighted picks both schemes serve
+        assert seen == {1, 2}
+
+
+def test_dynpart_pick_matches_python_lb(swarm_server, monkeypatch):
+    """Equivalence probe: the native pick at a fixed point x01 chooses
+    the same partition count the Python DynPartLB does for the same
+    scheme/capacity table (same ascending walk, same x <= acc rule)."""
+    from brpc_tpu.rpc import load_balancer as lb_mod
+
+    tags = ["0/1", "0/1",              # scheme 1: one group of 2
+            "0/2", "1/2",              # scheme 2: two groups of 1
+            "0/4", "1/4", "2/4", "3/4"]  # scheme 4: four groups of 1
+    with NativeCluster(lb="_dynpart") as c:
+        c.update(_tagged(swarm_server, tags))
+        dbg = c.dynpart_debug(0.0)
+        assert dbg["schemes"] == [(1, 2), (2, 2), (4, 4)]
+
+        pylb = lb_mod.create_load_balancer("_dynpart")
+        caps = dict(dbg["schemes"])
+        for total in sorted(caps):
+            pylb.add_server(total)
+        pylb.set_capacity_fn(lambda sid: caps[sid])
+
+        point = [0.0]
+        monkeypatch.setattr(lb_mod.random, "uniform",
+                            lambda a, b: point[0] * b)
+        for i in range(97):
+            point[0] = i / 97.0
+            want = pylb.select_server()
+            got = c.dynpart_debug(point[0])["chosen"]
+            assert got == want, f"x01={point[0]}: native {got} != py {want}"
+
+
+def test_dynpart_group_assignment_matches_python_channel(swarm_server):
+    """Same list -> same group assignment: the per-scheme capacity the
+    native cluster derives from the tag grammar equals what the Python
+    DynamicPartitionChannel's sub-channels count for the same feed."""
+    import tempfile
+
+    from brpc_tpu.rpc.combo_channels import DynamicPartitionChannel
+
+    tags = ["0/1", "0/1", "0/2", "1/2", "0/3", "1/3", "2/3"]
+    ports = swarm_server[:len(tags)]
+    with tempfile.NamedTemporaryFile("w", suffix=".ns",
+                                     delete=False) as f:
+        for p, t in zip(ports, tags):
+            f.write(f"127.0.0.1:{p} {t}\n")
+        naming = f.name
+    try:
+        with NativeCluster(lb="_dynpart") as c:
+            c.watch(f"file://{naming}")
+            dbg = c.dynpart_debug(0.0)
+            assert dbg["schemes"] == [(1, 2), (2, 2), (3, 3)]
+            pc = DynamicPartitionChannel()
+            assert pc.init(f"file://{naming}") == 0
+            for total, cap in dbg["schemes"]:
+                assert pc._scheme_capacity(total) == cap, total
+    finally:
+        os.unlink(naming)
+
+
+def test_dynpart_empty_group_zeroes_the_scheme(swarm_server):
+    """The whole-scheme capacity rule: a scheme with ANY unpopulated
+    group reports capacity 0 and is never picked (it could not answer
+    for every partition), leaving the complete scheme to serve."""
+    with NativeCluster(lb="_dynpart") as c:
+        c.update(_tagged(swarm_server[:2], ["0/1", "0/2"]))  # no 1/2
+        dbg = c.dynpart_debug(0.99)
+        assert (2, 0) in dbg["schemes"]
+        assert (1, 1) in dbg["schemes"]
+        assert dbg["chosen"] == 1
+        for _ in range(8):
+            rc, body, err, failed, scheme = c.dynpart_call(
+                "EchoService.Echo", b"z", timeout_ms=2000)
+            assert rc == 0 and scheme == 1, err
+
+
+def test_dynpart_no_capacity_fails_fast(swarm_server):
+    """No scheme with capacity: the verb must answer promptly with a
+    clear error, not hang an empty fan."""
+    with NativeCluster(lb="_dynpart") as c:
+        c.update(_tagged(swarm_server[:1], ["0/2"]))  # incomplete only
+        t0 = time.time()
+        rc, _, err, failed, scheme = c.dynpart_call(
+            "EchoService.Echo", b"x", timeout_ms=2000)
+        assert rc != 0 and "capacity" in err
+        assert scheme == 0
+        assert time.time() - t0 < 1.0
+
+
+def test_dynpart_resize_counter_tracks_layout_changes(swarm_server):
+    """nat_dynpart_resizes bumps when a publish CHANGES the partition
+    layout; a weight-only refresh publishes a new version without being
+    a resize."""
+    def resizes():
+        return native.stats_counters().get("nat_dynpart_resizes", 0)
+
+    with NativeCluster(lb="_dynpart") as c:
+        c.update(_tagged(swarm_server[:2], ["0/1", "0/1"]))
+        base = resizes()
+        # weight-only refresh: same layout, new weights -> not a resize
+        c.update([(f"127.0.0.1:{p}", 5, "0/1")
+                  for p in swarm_server[:2]])
+        assert resizes() == base
+        # layout change: the elastic scheme appears -> a resize
+        c.update(_tagged(swarm_server[:4], ["0/1", "0/1", "0/2", "1/2"]))
+        assert resizes() == base + 1
+        # and shrinking back is another
+        c.update(_tagged(swarm_server[:2], ["0/1", "0/1"]))
+        assert resizes() == base + 2
+
+
+def test_dynamic_partition_channel_native_fast_path(swarm_server,
+                                                    tmp_path):
+    from brpc_tpu.rpc.combo_channels import (DynamicPartitionChannel,
+                                             PartitionParser)
+
+    nf = tmp_path / "dynparts.ns"
+    nf.write_text(f"127.0.0.1:{swarm_server[0]} 0/1\n"
+                  f"127.0.0.1:{swarm_server[1]} 0/2\n"
+                  f"127.0.0.1:{swarm_server[2]} 1/2\n")
+    dpc = DynamicPartitionChannel(native=True)
+    assert dpc.init(f"file://{nf}") == 0
+    try:
+        for i in range(6):
+            cntl = rpc.Controller()
+            cntl.timeout_ms = 3000
+            resp = echo_pb2.EchoResponse()
+            dpc.call_method("EchoService.Echo", cntl,
+                            echo_pb2.EchoRequest(message="dyn"), resp)
+            assert not cntl.failed(), cntl.error_text
+            assert resp.message == "dyn"
+            assert cntl.partition_count in (1, 2)
+    finally:
+        dpc.stop()
+    # the C++ core speaks the default "i/n" grammar only: a custom
+    # parser must be refused loudly, not silently misgrouped
+
+    class _HexParser(PartitionParser):
+        pass
+
+    with pytest.raises(ValueError):
+        DynamicPartitionChannel(native=True).init(
+            f"file://{nf}", parser=_HexParser())
+
+
+# ---------------------------------------------------------------------------
+# resize-under-fault matrix (slow): grow/shrink x SIGKILL/write-error
+# storms, zero failed RPCs once the bounded retry settles. The chaos
+# lane's `resize` round re-runs exactly these with CHURN_SPEC armed.
+# ---------------------------------------------------------------------------
+
+_RESIZE_BASE_PORT = {
+    ("grow", "sigkill"): 27200,
+    ("grow", "write_err"): 27260,
+    ("shrink", "sigkill"): 27320,
+    ("shrink", "write_err"): 27380,
+}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fault", ["sigkill", "write_err"])
+@pytest.mark.parametrize("op", ["grow", "shrink"])
+def test_resize_under_fault_zero_failed(op, fault, tmp_path):
+    """A dynpart resize is never caller-visible: a client flood rides
+    through a live grow/shrink with a destructive fault landing
+    mid-resize (SIGKILL of the freshest member, or EPIPE storms in
+    every member), and zero calls fail once the bounded retry (the
+    fanout swarm drill's idiom) settles."""
+    from brpc_tpu.fleet.autoscaler import SwarmPool
+
+    env = dict(os.environ)
+    env.pop("NAT_FAULT", None)  # the CLIENT side stays clean
+    if fault == "write_err":
+        env["BRPC_TPU_CHURN_FAULT"] = "seed=42;write:err=EPIPE:p=0.002"
+    else:
+        env.pop("BRPC_TPU_CHURN_FAULT", None)
+
+    naming = str(tmp_path / "resize.ns")
+    holder = []
+
+    def republish():
+        if holder:
+            holder[0].refresh()
+
+    resizes0 = native.stats_counters().get("nat_dynpart_resizes", 0)
+    pool = SwarmPool(naming, base_port=_RESIZE_BASE_PORT[(op, fault)],
+                     publish_cb=republish, env=env)
+    cluster = None
+    stop = threading.Event()
+    calls, failed = [0], []
+
+    def flood():
+        while not stop.is_set():
+            rc, err = 1, ""
+            for _ in range(3):  # bounded retry: a re-pick moves the
+                rc, _b, err, _n, _s = cluster.dynpart_call(  # rr cursor
+                    "EchoService.Echo", b"rz", timeout_ms=3000)
+                if rc == 0:
+                    break
+            calls[0] += 1
+            if rc != 0:
+                failed.append((rc, err))
+            time.sleep(0.005)
+
+    try:
+        # anchor "0/1" x2 + elastic "0/2","1/2"
+        assert pool.grow(4) == 4, "swarm spawn failed"
+        cluster = NativeCluster(lb="_dynpart", connect_timeout_ms=1000,
+                                health_check_ms=100,
+                                name=f"resize-{op}-{fault}")
+        holder.append(cluster.watch(f"file://{naming}"))
+        t = threading.Thread(target=flood, daemon=True)
+        t.start()
+        time.sleep(0.8)  # flood settles: connections dialed
+
+        if op == "grow":
+            assert pool.grow(2) == 2  # elastic resizes 2-way -> 4-way
+        else:
+            assert pool.shrink(1) == 1  # collapses to one "0/1" of 3
+        if fault == "sigkill":
+            # the crash lands right on the heels of the resize, on the
+            # freshest member, and is never announced to the feed
+            assert pool.kill_one() is not None
+            time.sleep(1.0)  # cool-down routes around the corpse
+            pool.publish()  # then the feed catches up (autoscaler role)
+        time.sleep(1.5)
+
+        stop.set()
+        t.join(timeout=10)
+        assert not failed, f"{len(failed)} failed: {failed[:5]}"
+        assert calls[0] > 100, calls[0]
+        assert native.stats_counters().get("nat_dynpart_resizes", 0) \
+            > resizes0
+    finally:
+        stop.set()
+        if cluster is not None:
+            cluster.close()
+        pool.close()

@@ -497,13 +497,11 @@ def test_mesh_channel_host_axis(swarm_server):
 
     mesh = collectives.make_mesh({"dp": len(jax.devices())})
     mc = MeshChannel(mesh, "dp")
-    # device axis: the fused-collective lowering still works (skipped on
-    # hosts with the known jax.shard_map env drift — the pre-existing
-    # tier-1 failure class test_parallel_collectives tracks)
-    if hasattr(jax, "shard_map"):
-        out = mc.parallel_call(lambda x: x * 2, np.ones(8, np.float32),
-                               merger="add")
-        assert float(out[0]) == 2.0 * len(jax.devices())
+    # device axis: the fused-collective lowering (brpc_tpu.jaxcompat
+    # resolves the jax.shard_map location/kwarg drift)
+    out = mc.parallel_call(lambda x: x * 2, np.ones(8, np.float32),
+                           merger="add")
+    assert float(out[0]) == 2.0 * len(jax.devices())
     # host axis: native fan-out over cluster backends
     with _mk_cluster(swarm_server[:3]) as cluster:
         mc.attach_host_cluster(cluster)

@@ -156,6 +156,11 @@ def test_every_counter_enum_in_prometheus_exposition(server):
                  "nat_cluster_backends_added",
                  "nat_cluster_backends_removed"):
         assert name in exposed, name
+    # the elastic-capacity counters specifically (the ISSUE 20 satellite:
+    # dynpart resizes + the autoscaler's grow/shrink/blocked verdicts)
+    for name in ("nat_dynpart_resizes", "nat_autoscale_grows",
+                 "nat_autoscale_shrinks", "nat_autoscale_blocked"):
+        assert name in exposed, name
 
 
 def test_observatory_vars_in_prometheus_exposition(server):
@@ -177,6 +182,12 @@ def test_observatory_vars_in_prometheus_exposition(server):
     try:
         cluster.update([f"127.0.0.1:{port}"])
         cluster.call("EchoService.Echo", b"drift", timeout_ms=2000)
+        # settle the 0.25s-TTL snapshot caches: an exposition rendered
+        # within the TTL of an earlier test's dump replays that test's
+        # conn/cluster snapshot, which predates the rows asserted below
+        from brpc_tpu.bvar import native_vars
+
+        native_vars.settle_for_tests()
         status, body = _get(port, "/brpc_metrics")
         assert status == 200
         for vname in ("nat_method_count", "nat_method_errors",

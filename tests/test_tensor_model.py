@@ -45,22 +45,15 @@ def test_ring_attention_matches_local():
     expect = local_attention(q, k, v, causal=True)
 
     mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
-    try:
-        ring = jax.shard_map(
-            lambda q, k, v: ring_attention(q, k, v, "sp", causal=True),
-            mesh=mesh,
-            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
-            out_specs=P(None, "sp"),
-            check_vma=False,
-        )
-    except TypeError:
-        ring = jax.shard_map(
-            lambda q, k, v: ring_attention(q, k, v, "sp", causal=True),
-            mesh=mesh,
-            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
-            out_specs=P(None, "sp"),
-            check_rep=False,
-        )
+    from brpc_tpu.jaxcompat import shard_map
+
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "sp", causal=True),
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+        check=False,
+    )
     got = jax.jit(ring)(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expect), atol=2e-5)
 

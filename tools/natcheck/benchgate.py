@@ -116,6 +116,14 @@ HEADLINE_LANES: Dict[str, float] = {
     # subsystem leaked after teardown, so a failing drill trips the
     # band like a throughput collapse
     "conn_scale_conns": DEFAULT_TOL,
+    # elastic-capacity drill (ISSUE 20): the autoscaler resizing a
+    # dynpart swarm under the replayed golden-capture ramp with a
+    # mid-resize SIGKILL. The lane reports the replay qps only when the
+    # WHOLE contract held (zero failed RPCs through grows/shrinks/the
+    # crash, p99 under the ceiling, capacity tracking offered load), so
+    # any contract breach trips the band as a collapse to 0. Ramp-mode
+    # replay qps itself carries the replay-lane noise class.
+    "autoscale_qps": 0.50,
 }
 
 # Latency CEILING lanes: these regress UPWARD — the gate fails when the
@@ -124,6 +132,10 @@ HEADLINE_LANES: Dict[str, float] = {
 CEILING_LANES: Dict[str, float] = {
     "fanout_p99_us": 0.50,
     "swarm_p99_us": 0.50,
+    # autoscale drill probe p99 (ISSUE 20): paced dynpart probes riding
+    # through live resizes — latency regressing upward here means a
+    # resize became caller-visible
+    "autoscale_p99_us": 0.50,
     # memory-observatory ceilings (ISSUE 14): per-connection accounted
     # bytes (a regression here is a memory-cost regression even when
     # qps holds) and the accept-storm recovery time. Both noisy on the

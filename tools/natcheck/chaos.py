@@ -197,6 +197,40 @@ def _swarm_leg() -> Tuple[List[Finding], str]:
     return findings, out
 
 
+def _resize_leg() -> Tuple[List[Finding], str]:
+    """Resize round (ISSUE 20): the dynpart resize-under-fault matrix
+    (tests/test_dynpart_native.py) — swarm members added/retired live so
+    the partition scheme set resizes mid-flood, with DESTRUCTIVE seeds
+    armed in every member process (EPIPE write storms) and a SIGKILL
+    landing mid-resize. The assertion is the elastic-capacity contract:
+    a resize is never caller-visible and zero calls fail once the
+    bounded retry settles."""
+    findings: List[Finding] = []
+    env = dict(os.environ)
+    env.pop("NAT_FAULT", None)  # the CLIENT side stays clean; servers
+    env["BRPC_TPU_CHURN_FAULT"] = CHURN_SPEC  # armed via the pool hook
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             "tests/test_dynpart_native.py", "-q",
+             "-k", "resize_under_fault",
+             "-p", "no:cacheprovider"],
+            capture_output=True, timeout=900, env=env, cwd=REPO_ROOT)
+    except subprocess.TimeoutExpired:
+        return [Finding("chaos", "resize-hang", "tests/",
+                        "resize round timed out (publish wedged?)")], \
+            "chaos resize: TIMED OUT"
+    out = proc.stdout.decode(errors="replace") + \
+        proc.stderr.decode(errors="replace")
+    if proc.returncode != 0:
+        tail = out.strip().splitlines()[-1] if out.strip() else "?"
+        findings.append(Finding(
+            "chaos", "resize", "tests/test_dynpart_native.py",
+            f"resize round rc={proc.returncode}: {tail}"))
+    return findings, out
+
+
 def run(write_log: bool = True) -> List[Finding]:
     findings: List[Finding] = []
     sections = []
@@ -214,6 +248,10 @@ def run(write_log: bool = True) -> List[Finding]:
     got, out = _swarm_leg()
     findings.extend(got)
     sections.append(("swarm round (fan-out churn under %s)" %
+                     CHURN_SPEC, out))
+    got, out = _resize_leg()
+    findings.extend(got)
+    sections.append(("resize round (dynpart resize-under-fault under %s)" %
                      CHURN_SPEC, out))
 
     if write_log:
